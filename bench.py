@@ -1,0 +1,122 @@
+"""Headline benchmark: SHA1 full-recheck throughput, TPU vs CPU baseline.
+
+Workload = BASELINE.md primary metric: pieces/sec on a full re-verify of a
+synthetic torrent with 256 KiB pieces (the reference's singlefile.torrent
+geometry, metainfo_test.ts:26-29). The CPU baseline is streaming hashlib
+(OpenSSL — strictly faster than the reference's Deno WebCrypto path, so
+speedups reported here are conservative). The TPU path is the full
+pipeline: Storage.read_batch → pad → transfer → masked SHA1 chain →
+on-device digest compare.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs: BENCH_TOTAL_MB (default 1024), BENCH_BATCH (default 1024),
+BENCH_BACKEND (jax|pallas, default best available).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    total_mb = int(os.environ.get("BENCH_TOTAL_MB", "1024"))
+    batch = int(os.environ.get("BENCH_BATCH", "1024"))
+    backend = os.environ.get("BENCH_BACKEND", "")
+    plen = 256 * 1024
+    n_pieces = total_mb * (1 << 20) // plen
+    total = n_pieces * plen
+
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, size=total, dtype=np.uint8)
+
+    # ---- CPU baseline: streaming hashlib over every piece -------------
+    cpu_pieces = min(n_pieces, 1024)  # sample; extrapolation is linear
+    t0 = time.perf_counter()
+    for i in range(cpu_pieces):
+        hashlib.sha1(payload[i * plen : (i + 1) * plen].tobytes()).digest()
+    cpu_secs_sampled = time.perf_counter() - t0
+    cpu_pps = cpu_pieces / cpu_secs_sampled
+
+    # Expected digests (authoring side, also hashlib).
+    digests = [
+        hashlib.sha1(payload[i * plen : (i + 1) * plen].tobytes()).digest()
+        for i in range(n_pieces)
+    ]
+
+    # ---- TPU path -----------------------------------------------------
+    import jax
+
+    # This image's sitecustomize pins jax_platforms to the axon TPU plugin;
+    # honor an explicit platform request (e.g. BENCH_PLATFORM=cpu) so the
+    # bench can run where the operator points it.
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    from torrent_tpu.codec.metainfo import InfoDict
+    from torrent_tpu.models.verifier import TPUVerifier
+    from torrent_tpu.storage.storage import Storage
+
+    if not backend:
+        backend = "jax"
+
+    class _PayloadMethod:
+        """Zero-copy storage backend over the benchmark payload."""
+
+        def get(self, path, offset, length):
+            return payload[offset : offset + length].tobytes()
+
+        def set(self, path, offset, data):
+            raise NotImplementedError
+
+        def exists(self, path, length=None):
+            return True
+
+    info = InfoDict(
+        name="bench", piece_length=plen, pieces=tuple(digests), length=total, files=None
+    )
+    storage = Storage(_PayloadMethod(), info)
+
+    verifier = TPUVerifier(piece_length=plen, batch_size=batch, backend=backend)
+    # Warmup: compile + first transfer.
+    warm_idx = list(range(min(batch, n_pieces)))
+    padded, view = np.zeros((batch, verifier.padded_len), dtype=np.uint8), None
+    from torrent_tpu.ops.padding import digests_to_words, pad_in_place
+
+    storage.read_batch(warm_idx, out=padded[: len(warm_idx), :plen])
+    lengths = np.full(batch, plen, dtype=np.int64)
+    nblocks = pad_in_place(padded, lengths)
+    expected = np.zeros((batch, 5), dtype=np.uint32)
+    expected[: len(warm_idx)] = digests_to_words(digests[: len(warm_idx)])
+    verifier.verify_batch(padded, nblocks, expected)
+
+    t0 = time.perf_counter()
+    bitfield = verifier.verify_storage(storage, info)
+    tpu_secs = time.perf_counter() - t0
+    assert bitfield.all(), f"verify failed: {int(bitfield.sum())}/{n_pieces}"
+    tpu_pps = n_pieces / tpu_secs
+
+    result = {
+        "metric": "sha1_recheck_256KiB_pieces_per_sec",
+        "value": round(tpu_pps, 1),
+        "unit": "pieces/s",
+        "vs_baseline": round(tpu_pps / cpu_pps, 2),
+    }
+    print(json.dumps(result))
+    print(
+        f"# detail: devices={jax.devices()} backend={backend} n_pieces={n_pieces} "
+        f"tpu={tpu_pps:.0f} p/s ({tpu_pps * plen / 2**30:.2f} GiB/s) "
+        f"cpu={cpu_pps:.0f} p/s ({cpu_pps * plen / 2**30:.2f} GiB/s)",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

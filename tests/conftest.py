@@ -1,0 +1,36 @@
+"""Test env: force JAX onto a virtual 8-device CPU platform.
+
+Must run before any `import jax` anywhere. The multi-chip sharding tests
+(tests/test_parallel.py) rely on these 8 virtual devices to exercise the
+same `jax.sharding.Mesh` code paths the driver dry-runs.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# This environment's sitecustomize registers a TPU backend and pins
+# jax_platforms; tests must run on the virtual 8-device CPU platform, so
+# override via jax.config (wins even after the plugin registered).
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pathlib
+
+import pytest
+
+REFERENCE_FIXTURES = pathlib.Path("/root/reference/test_data")
+
+
+@pytest.fixture
+def ref_fixtures() -> pathlib.Path:
+    """Golden .torrent fixtures from the mounted reference snapshot."""
+    if not REFERENCE_FIXTURES.is_dir():
+        pytest.skip("reference fixtures not mounted")
+    return REFERENCE_FIXTURES

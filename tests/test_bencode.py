@@ -1,0 +1,116 @@
+"""Bencode codec tests (reference had none for bencode.ts — new coverage)."""
+
+import pytest
+
+from torrent_tpu.codec.bencode import (
+    BencodeError,
+    bdecode,
+    bdecode_with_info_span,
+    bencode,
+)
+
+
+class TestEncode:
+    def test_bytes(self):
+        assert bencode(b"spam") == b"4:spam"
+        assert bencode(b"") == b"0:"
+
+    def test_str_utf8(self):
+        assert bencode("café") == b"5:caf\xc3\xa9"
+
+    def test_int(self):
+        assert bencode(0) == b"i0e"
+        assert bencode(-42) == b"i-42e"
+        assert bencode(2**63) == b"i9223372036854775808e"
+
+    def test_list(self):
+        assert bencode([b"a", 1, [b"b"]]) == b"l1:ai1el1:bee"
+
+    def test_dict_sorted_canonical(self):
+        # BEP 3: keys sorted as raw bytes, not insertion order.
+        assert bencode({b"zz": 1, b"a": 2}) == b"d1:ai2e2:zzi1ee"
+
+    def test_dict_insertion_order_compat(self):
+        assert bencode({b"zz": 1, b"a": 2}, sort_keys=False) == b"d2:zzi1e1:ai2ee"
+
+    def test_str_keys(self):
+        assert bencode({"b": 1, "a": 2}) == b"d1:ai2e1:bi1ee"
+
+    def test_bool_rejected(self):
+        with pytest.raises(BencodeError):
+            bencode(True)
+
+    def test_unencodable(self):
+        with pytest.raises(BencodeError):
+            bencode(1.5)
+
+    def test_large_buffer(self):
+        # The reference needed a 10k chunking workaround (bencode.ts:35-42);
+        # real byte buffers make 10 MB a non-event.
+        blob = b"\xab" * (10 * 1024 * 1024)
+        out = bencode(blob)
+        assert out.startswith(b"10485760:")
+        assert len(out) == len(blob) + 9
+
+
+class TestDecode:
+    def test_roundtrip(self):
+        val = {b"info": {b"pieces": b"\x00" * 40, b"piece length": 16384}, b"x": [1, b"y"]}
+        assert bdecode(bencode(val)) == val
+
+    def test_int(self):
+        assert bdecode(b"i-3e") == -3
+
+    def test_binary_dict_keys(self):
+        # Scrape responses key `files` by raw 20-byte hashes. The reference
+        # needed bdecodeBytestringMap (bencode.ts:168-202); bytes keys are
+        # native here.
+        h = bytes(range(20))
+        data = bencode({b"files": {h: {b"complete": 1}}})
+        assert bdecode(data)[b"files"][h][b"complete"] == 1
+
+    def test_trailing_data_strict(self):
+        with pytest.raises(BencodeError):
+            bdecode(b"i1e garbage")
+        assert bdecode(b"i1ex", strict=False) == 1
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            b"",
+            b"i12",  # unterminated int
+            b"i1x2e",  # junk in int
+            b"i03e",  # leading zero
+            b"i-0e",  # negative zero
+            b"5:abc",  # truncated string
+            b"12",  # no colon
+            b"l i1e",  # bad list element
+            b"li1e",  # unterminated list
+            b"d3:abc",  # dict value missing
+            b"di1ei2ee",  # non-string dict key
+            b"x",  # unknown type
+            b"99999999999:",  # absurd truncated string
+        ],
+    )
+    def test_malformed(self, bad):
+        with pytest.raises(BencodeError):
+            bdecode(bad)
+
+
+class TestInfoSpan:
+    def test_span_hashes_original_bytes(self):
+        info = {b"name": b"f", b"piece length": 1, b"pieces": b"\x01" * 20, b"length": 1}
+        data = bencode({b"announce": b"http://t", b"info": info})
+        decoded, span = bdecode_with_info_span(data)
+        assert decoded[b"info"] == info
+        start, end = span
+        assert data[start:end] == bencode(info)
+
+    def test_no_info_key(self):
+        data = bencode({b"a": 1})
+        decoded, span = bdecode_with_info_span(data)
+        assert span is None and decoded == {b"a": 1}
+
+    def test_non_dict_top_level(self):
+        with pytest.raises(BencodeError):
+            bdecode_with_info_span(b"i1e")

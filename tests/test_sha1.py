@@ -1,0 +1,117 @@
+"""SHA1 kernel correctness: NIST vectors, hashlib cross-check, ragged batches.
+
+The reference delegates SHA1 to WebCrypto and has no hash tests; the TPU
+build's kernels need golden coverage (SURVEY §4 lessons): FIPS 180-4
+vectors plus randomized differential tests against hashlib.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from torrent_tpu.ops.padding import (
+    alloc_padded,
+    digests_to_words,
+    num_blocks_for,
+    pad_in_place,
+    pad_pieces,
+    padded_len_for,
+    words_to_digests,
+)
+from torrent_tpu.ops.sha1_jax import sha1_pieces_jax
+
+
+def sha1_batch(pieces):
+    padded, nblocks = pad_pieces(pieces)
+    words = np.asarray(sha1_pieces_jax(padded, nblocks))
+    return words_to_digests(words)
+
+
+class TestPadding:
+    @pytest.mark.parametrize(
+        "n,expect",
+        [(0, 64), (55, 64), (56, 128), (64, 128), (119, 128), (120, 192), (262144, 262208)],
+    )
+    def test_padded_len(self, n, expect):
+        assert padded_len_for(n) == expect
+        assert int(num_blocks_for(n)) * 64 == expect
+
+    def test_pad_matches_spec(self):
+        msg = b"abc"
+        padded, view = alloc_padded(1, 8)
+        view[0, :3] = np.frombuffer(msg, dtype=np.uint8)
+        nblocks = pad_in_place(padded, np.array([3]))
+        assert nblocks.tolist() == [1]
+        row = padded[0]
+        assert row[3] == 0x80
+        assert not row[4:62].any()
+        assert int.from_bytes(row[56:64].tobytes(), "big") == 24  # bit length
+
+    def test_pad_rejects_oversize(self):
+        padded, _ = alloc_padded(1, 8)
+        with pytest.raises(ValueError):
+            pad_in_place(padded, np.array([60]))
+
+    def test_digest_words_roundtrip(self):
+        digs = [hashlib.sha1(bytes([i])).digest() for i in range(7)]
+        assert words_to_digests(digests_to_words(digs)) == digs
+
+
+class TestNISTVectors:
+    """FIPS 180-4 / NIST CAVP known-answer tests."""
+
+    VECTORS = [
+        (b"", "da39a3ee5e6b4b0d3255bfef95601890afd80709"),
+        (b"abc", "a9993e364706816aba3e25717850c26c9cd0d89d"),
+        (
+            b"abcdbcdecdefdefgefghfghighijhijkijkljklmklmnlmnomnopnopq",
+            "84983e441c3bd26ebaae4aa1f95129e5e54670f1",
+        ),
+        (b"a" * 1_000_000, "34aa973cd4c4daa4f61eeb2bdbad27316534016f"),
+        # 119/120/127/128: padding boundary straddles
+        (b"x" * 119, hashlib.sha1(b"x" * 119).hexdigest()),
+        (b"x" * 120, hashlib.sha1(b"x" * 120).hexdigest()),
+        (b"x" * 127, hashlib.sha1(b"x" * 127).hexdigest()),
+        (b"x" * 128, hashlib.sha1(b"x" * 128).hexdigest()),
+    ]
+
+    def test_vectors_batched_together(self):
+        msgs = [m for m, _ in self.VECTORS]
+        digs = sha1_batch(msgs)
+        for (msg, hexd), got in zip(self.VECTORS, digs):
+            assert got.hex() == hexd, f"len={len(msg)}"
+
+
+class TestDifferential:
+    def test_random_uniform_lengths(self):
+        rng = np.random.default_rng(42)
+        pieces = [rng.integers(0, 256, size=1024, dtype=np.uint8).tobytes() for _ in range(33)]
+        got = sha1_batch(pieces)
+        want = [hashlib.sha1(p).digest() for p in pieces]
+        assert got == want
+
+    def test_ragged_batch(self):
+        rng = np.random.default_rng(7)
+        lens = [0, 1, 63, 64, 65, 500, 4096, 700]
+        pieces = [rng.integers(0, 256, size=n, dtype=np.uint8).tobytes() for n in lens]
+        got = sha1_batch(pieces)
+        want = [hashlib.sha1(p).digest() for p in pieces]
+        assert got == want
+
+    def test_torrent_shaped_batch(self):
+        # 256 KiB pieces + short last piece, like a real recheck batch.
+        rng = np.random.default_rng(3)
+        plen = 256 * 1024
+        data = rng.integers(0, 256, size=plen * 3 + 12345, dtype=np.uint8).tobytes()
+        pieces = [data[i : i + plen] for i in range(0, len(data), plen)]
+        got = sha1_batch(pieces)
+        want = [hashlib.sha1(p).digest() for p in pieces]
+        assert got == want
+
+    def test_single_piece_batch(self):
+        assert sha1_batch([b"hello world"]) == [hashlib.sha1(b"hello world").digest()]
+
+    def test_empty_batch(self):
+        padded, nblocks = pad_pieces([])
+        assert padded.shape[0] == 0

@@ -1,0 +1,301 @@
+"""Storage + piece math tests.
+
+Covers the reference's storage_test.ts territory — single-file,
+within-one-file, and across-file-boundary reads/writes (storage_test.ts:
+142-335) — plus the new read_batch path and last-piece geometry.
+"""
+
+import numpy as np
+import pytest
+
+from torrent_tpu.codec.metainfo import FileEntry, InfoDict
+from torrent_tpu.storage.piece import (
+    BLOCK_SIZE,
+    block_length,
+    num_blocks,
+    piece_length,
+    validate_received_block,
+    validate_requested_block,
+)
+from torrent_tpu.storage.storage import (
+    FsStorage,
+    MemoryStorage,
+    Storage,
+    StorageError,
+)
+
+
+def make_info(length, piece_len, files=None, name="t"):
+    n = (length + piece_len - 1) // piece_len
+    return InfoDict(
+        name=name,
+        piece_length=piece_len,
+        pieces=tuple(bytes([i % 256]) * 20 for i in range(n)),
+        length=length,
+        files=files,
+    )
+
+
+class TestPieceMath:
+    def test_piece_length_even_division(self):
+        # length % piece_length == 0 edge (piece.ts:16-19 || fallback)
+        info = make_info(4 * BLOCK_SIZE, 2 * BLOCK_SIZE)
+        assert piece_length(info, 0) == 2 * BLOCK_SIZE
+        assert piece_length(info, 1) == 2 * BLOCK_SIZE
+
+    def test_piece_length_short_last(self):
+        info = make_info(5 * BLOCK_SIZE + 7, 2 * BLOCK_SIZE)
+        assert info.num_pieces == 3
+        assert piece_length(info, 2) == BLOCK_SIZE + 7
+
+    def test_piece_length_out_of_range(self):
+        info = make_info(100, 50)
+        with pytest.raises(IndexError):
+            piece_length(info, 2)
+        with pytest.raises(IndexError):
+            piece_length(info, -1)
+
+    def test_num_blocks_and_block_length(self):
+        info = make_info(3 * BLOCK_SIZE + 100, 2 * BLOCK_SIZE)
+        assert num_blocks(info, 0) == 2
+        assert num_blocks(info, 1) == 2  # BLOCK_SIZE + 100 → 2 blocks
+        assert block_length(info, 1, BLOCK_SIZE) == 100
+
+    def test_validate_requested_block(self):
+        info = make_info(2 * BLOCK_SIZE + 100, 2 * BLOCK_SIZE)
+        assert validate_requested_block(info, 0, 0, BLOCK_SIZE)
+        assert validate_requested_block(info, 0, 100, 200)
+        assert validate_requested_block(info, 1, 0, 100)
+        assert not validate_requested_block(info, 1, 0, 101)  # past last piece
+        assert not validate_requested_block(info, 0, 0, BLOCK_SIZE + 1)  # > cap
+        assert not validate_requested_block(info, 0, 0, 0)
+        assert not validate_requested_block(info, 2, 0, 10)  # bad index
+        assert not validate_requested_block(info, 0, -1, 10)
+
+    def test_validate_received_block(self):
+        info = make_info(2 * BLOCK_SIZE + 100, 2 * BLOCK_SIZE)
+        assert validate_received_block(info, 0, 0, BLOCK_SIZE)
+        assert validate_received_block(info, 0, BLOCK_SIZE, BLOCK_SIZE)
+        assert validate_received_block(info, 1, 0, 100)  # final short block
+        assert not validate_received_block(info, 1, 0, BLOCK_SIZE)
+        assert not validate_received_block(info, 0, 1, BLOCK_SIZE)  # unaligned
+        assert not validate_received_block(info, 0, 2 * BLOCK_SIZE, 1)  # past end
+
+
+def multi_info():
+    # Three files; 100 KiB pieces deliberately span the file boundaries.
+    files = (
+        FileEntry(length=150_000, path=("a.bin",)),
+        FileEntry(length=50_000, path=("sub", "b.bin")),
+        FileEntry(length=123_456, path=("c.bin",)),
+    )
+    total = sum(f.length for f in files)
+    return make_info(total, 102_400, files=files, name="multi")
+
+
+class TestStorageMapping:
+    def test_single_file_fanout(self):
+        info = make_info(100_000, 16384)
+        st = Storage(MemoryStorage(), info)
+        segs = list(st.segments(5, 1000))
+        assert segs == [(("t",), 5, 1000)]
+
+    def test_boundary_spanning_read_write(self):
+        info = multi_info()
+        st = Storage(MemoryStorage(), info)
+        # Piece 1 covers [102400, 204800): spans a.bin end(150000),
+        # all of b.bin (150000-200000), into c.bin.
+        segs = list(st.segments(102_400, 102_400))
+        assert segs == [
+            (("multi", "a.bin"), 102_400, 47_600),
+            (("multi", "sub", "b.bin"), 0, 50_000),
+            (("multi", "c.bin"), 0, 4_800),
+        ]
+        data = bytes(range(256)) * 400  # 102_400 bytes
+        st.set(102_400, data)
+        assert st.get(102_400, 102_400) == data
+
+    def test_zero_length_file_skipped(self):
+        files = (
+            FileEntry(length=100, path=("a",)),
+            FileEntry(length=0, path=("empty",)),
+            FileEntry(length=100, path=("b",)),
+        )
+        info = make_info(200, 128, files=files)
+        st = Storage(MemoryStorage(), info)
+        segs = list(st.segments(50, 100))
+        assert segs == [(("t", "a"), 50, 50), (("t", "b"), 0, 50)]
+
+    def test_out_of_range_raises(self):
+        info = make_info(1000, 512)
+        st = Storage(MemoryStorage(), info)
+        with pytest.raises(StorageError):
+            list(st.segments(900, 200))
+        with pytest.raises(StorageError):
+            list(st.segments(-1, 10))
+
+    def test_duplicate_block_suppressed(self):
+        info = make_info(BLOCK_SIZE * 2, BLOCK_SIZE * 2)
+        st = Storage(MemoryStorage(), info)
+        assert st.set(0, b"x" * BLOCK_SIZE) is True
+        assert st.set(0, b"y" * BLOCK_SIZE) is False
+        assert st.get(0, 1) == b"x"
+
+    def test_mark_pieces_written(self):
+        info = make_info(BLOCK_SIZE * 4, BLOCK_SIZE * 2)
+        st = Storage(MemoryStorage(), info)
+        st.mark_pieces_written([1])
+        assert st.set(2 * BLOCK_SIZE, b"z" * BLOCK_SIZE) is False
+        assert st.set(0, b"z" * BLOCK_SIZE) is True
+
+    def test_exists(self):
+        info = multi_info()
+        m = MemoryStorage()
+        st = Storage(m, info)
+        assert not st.exists()
+        for f in info.files:
+            m.set(("multi", *f.path), 0, b"\x01" * f.length)
+        assert st.exists()
+
+
+class TestReadBatch:
+    def test_values_and_lengths(self):
+        info = multi_info()
+        st = Storage(MemoryStorage(), info)
+        rng = np.random.default_rng(0)
+        payload = rng.integers(0, 256, size=info.length, dtype=np.uint8).tobytes()
+        # write via global offsets in big chunks
+        for off in range(0, info.length, 65536):
+            chunk = payload[off : off + 65536]
+            for path, foff, clen in st.segments(off, len(chunk)):
+                pass
+            st.set(off, chunk)
+        buf, lengths = st.read_batch(range(info.num_pieces))
+        assert buf.shape == (info.num_pieces, info.piece_length)
+        for i in range(info.num_pieces):
+            plen = piece_length(info, i)
+            assert lengths[i] == plen
+            expect = payload[i * info.piece_length : i * info.piece_length + plen]
+            assert buf[i, :plen].tobytes() == expect
+            assert not buf[i, plen:].any()
+
+    def test_missing_file_zero_fills(self):
+        info = multi_info()
+        st = Storage(MemoryStorage(), info)  # nothing written
+        buf, lengths = st.read_batch([0, 1])
+        assert not buf.any()
+        assert lengths.tolist() == [102_400, 102_400]
+
+    def test_out_buffer_reuse(self):
+        info = make_info(1024, 256)
+        st = Storage(MemoryStorage(), info)
+        st.set(0, b"\xff" * 1024)
+        out = np.ones((2, 256), dtype=np.uint8)
+        buf, _ = st.read_batch([0, 3], out=out)
+        assert buf is out
+        assert (buf == 0xFF).all()
+        with pytest.raises(StorageError):
+            st.read_batch([0], out=np.zeros((2, 2), dtype=np.uint8))
+
+
+class TestFsStorage:
+    def test_roundtrip_and_dirs(self, tmp_path):
+        fs = FsStorage(tmp_path)
+        fs.set(("d", "sub", "f.bin"), 100, b"hello")
+        assert (tmp_path / "d" / "sub" / "f.bin").exists()
+        assert fs.get(("d", "sub", "f.bin"), 100, 5) == b"hello"
+        # sparse region before offset reads as zeros
+        assert fs.get(("d", "sub", "f.bin"), 0, 4) == b"\x00" * 4
+        fs.close()
+
+    def test_short_read_raises(self, tmp_path):
+        fs = FsStorage(tmp_path)
+        fs.set(("f",), 0, b"abc")
+        with pytest.raises(StorageError):
+            fs.get(("f",), 0, 10)
+        fs.close()
+
+    def test_missing_file(self, tmp_path):
+        fs = FsStorage(tmp_path)
+        with pytest.raises(StorageError):
+            fs.get(("nope",), 0, 1)
+        assert not fs.exists(("nope",))
+
+    def test_exists_with_length(self, tmp_path):
+        fs = FsStorage(tmp_path)
+        fs.set(("f",), 0, b"abcd")
+        assert fs.exists(("f",), 4)
+        assert not fs.exists(("f",), 5)
+
+    def test_unsafe_paths_rejected(self, tmp_path):
+        fs = FsStorage(tmp_path)
+        for bad in [("..", "evil"), ("a/b",), ("",), (".",)]:
+            with pytest.raises(StorageError):
+                fs.set(bad, 0, b"x")
+
+    def test_overwrite_does_not_truncate(self, tmp_path):
+        fs = FsStorage(tmp_path)
+        fs.set(("f",), 0, b"A" * 100)
+        fs.set(("f",), 10, b"B" * 5)
+        assert fs.get(("f",), 0, 100) == b"A" * 10 + b"B" * 5 + b"A" * 85
+        fs.close()
+
+    def test_end_to_end_with_storage_facade(self, tmp_path):
+        info = multi_info()
+        st = Storage(FsStorage(tmp_path), info)
+        data = bytes([i % 251 for i in range(info.length)])
+        for off in range(0, info.length, 102_400):
+            st.set(off, data[off : off + 102_400])
+        buf, lengths = st.read_batch(range(info.num_pieces))
+        flat = b"".join(
+            buf[i, : lengths[i]].tobytes() for i in range(info.num_pieces)
+        )
+        assert flat == data
+
+
+class TestReviewRegressions:
+    """Regressions for the round-1 code-review findings."""
+
+    def test_failed_write_does_not_poison_dedup(self):
+        info = make_info(BLOCK_SIZE, BLOCK_SIZE)
+
+        class FlakyMethod(MemoryStorage):
+            fail = True
+
+            def set(self, path, offset, data):
+                if self.fail:
+                    self.fail = False
+                    raise StorageError("disk full")
+                super().set(path, offset, data)
+
+        st = Storage(FlakyMethod(), info)
+        with pytest.raises(StorageError):
+            st.set(0, b"x" * BLOCK_SIZE)
+        # retry after failure must actually write
+        assert st.set(0, b"x" * BLOCK_SIZE) is True
+        assert st.get(0, 1) == b"x"
+
+    def test_fsstorage_oserror_wrapped(self, tmp_path):
+        fs = FsStorage(tmp_path)
+        fs.set(("f",), 0, b"abc")
+        f = fs._open_read(("f",))
+        f.close()  # force ValueError/OSError on next pread via stale handle
+        # cache notices closed handle and reopens — so instead check set():
+        import os
+
+        target = tmp_path / "dir"
+        target.write_text("not a dir")
+        with pytest.raises(StorageError):
+            fs.set(("dir", "sub"), 0, b"x")  # makedirs over a file → OSError
+
+    def test_zero_length_torrent_with_pieces_rejected(self):
+        from torrent_tpu.codec.bencode import bencode
+        from torrent_tpu.codec.metainfo import parse_metainfo
+
+        info = {
+            b"name": b"t",
+            b"piece length": 16384,
+            b"pieces": b"\x00" * 40,
+            b"length": 0,
+        }
+        assert parse_metainfo(bencode({b"announce": b"http://t", b"info": info})) is None

@@ -1,0 +1,145 @@
+"""Verify plane tests: CPU vs TPU-path equivalence, sharded mesh execution.
+
+These run on the virtual 8-device CPU platform (conftest.py), exercising
+the same Mesh/NamedSharding code the driver dry-runs multi-chip.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from torrent_tpu.codec.metainfo import FileEntry, InfoDict
+from torrent_tpu.models.verifier import TPUVerifier
+from torrent_tpu.parallel.mesh import make_mesh
+from torrent_tpu.parallel.verify import verify_pieces
+from torrent_tpu.storage.storage import MemoryStorage, Storage
+
+
+def build_torrent(length, piece_len, files=None, seed=0, name="v"):
+    """Create (info, storage, payload) with real hashes over random data."""
+    rng = np.random.default_rng(seed)
+    payload = rng.integers(0, 256, size=length, dtype=np.uint8).tobytes()
+    pieces = tuple(
+        hashlib.sha1(payload[i : i + piece_len]).digest() for i in range(0, length, piece_len)
+    )
+    info = InfoDict(
+        name=name, piece_length=piece_len, pieces=pieces, length=length, files=files
+    )
+    storage = Storage(MemoryStorage(), info)
+    for off in range(0, length, 1 << 20):
+        storage.set(off, payload[off : off + (1 << 20)])
+    return info, storage, payload
+
+
+class TestVerifyCpu:
+    def test_all_valid(self):
+        info, storage, _ = build_torrent(300_000, 65536)
+        bf = verify_pieces(storage, info, hasher="cpu")
+        assert bf.all() and bf.shape == (info.num_pieces,)
+
+    def test_corruption_detected(self):
+        info, storage, payload = build_torrent(300_000, 65536)
+        storage.method.set(("v",), 70_000, b"\x00CORRUPT\x00")
+        bf = verify_pieces(storage, info, hasher="cpu")
+        assert not bf[1]
+        assert bf[0] and bf[2:].all()
+
+    def test_missing_data(self):
+        info, _, _ = build_torrent(300_000, 65536)
+        empty = Storage(MemoryStorage(), info)
+        assert not verify_pieces(empty, info, hasher="cpu").any()
+
+
+class TestVerifyTpu:
+    @pytest.mark.parametrize("batch_size", [8, 64])
+    def test_matches_cpu(self, batch_size):
+        info, storage, _ = build_torrent(500_000, 32768, seed=2)
+        # corrupt two pieces
+        storage.method.set(("v",), 33_000, b"XX")
+        storage.method.set(("v",), 480_000, b"YY")
+        cpu = verify_pieces(storage, info, hasher="cpu")
+        tpu = verify_pieces(storage, info, hasher="tpu", batch_size=batch_size)
+        assert (cpu == tpu).all()
+        assert not cpu[1]
+
+    def test_short_last_piece(self):
+        info, storage, _ = build_torrent(100_000, 32768, seed=3)  # last = 1696 B
+        bf = verify_pieces(storage, info, hasher="tpu", batch_size=8)
+        assert bf.all()
+
+    def test_multi_file_boundary_spanning(self):
+        files = (
+            FileEntry(length=50_000, path=("a",)),
+            FileEntry(length=80_000, path=("b", "c")),
+            FileEntry(length=20_123, path=("d",)),
+        )
+        info, storage, _ = build_torrent(150_123, 65536, files=files, seed=4)
+        bf = verify_pieces(storage, info, hasher="tpu", batch_size=8)
+        assert bf.all()
+
+    def test_explicit_mesh_all_devices(self):
+        import jax
+
+        mesh = make_mesh(jax.devices())
+        assert mesh.size == 8  # conftest forces 8 virtual devices
+        info, storage, _ = build_torrent(400_000, 16384, seed=5)
+        bf = verify_pieces(storage, info, hasher="tpu", batch_size=16, mesh=mesh)
+        assert bf.all()
+
+    def test_unknown_hasher(self):
+        info, storage, _ = build_torrent(32768, 32768)
+        with pytest.raises(ValueError):
+            verify_pieces(storage, info, hasher="gpu")
+
+
+class TestTPUVerifier:
+    def test_hash_pieces_matches_hashlib(self):
+        rng = np.random.default_rng(1)
+        pieces = [rng.integers(0, 256, size=n, dtype=np.uint8).tobytes() for n in (100, 16384, 5)]
+        v = TPUVerifier(piece_length=16384, batch_size=8)
+        assert v.hash_pieces(pieces) == [hashlib.sha1(p).digest() for p in pieces]
+
+    def test_hash_pieces_multi_launch(self):
+        # more pieces than batch_size → chunked launches, one executable
+        pieces = [bytes([i]) * 100 for i in range(20)]
+        v = TPUVerifier(piece_length=128, batch_size=8)
+        assert v.hash_pieces(pieces) == [hashlib.sha1(p).digest() for p in pieces]
+
+    def test_piece_too_long_rejected(self):
+        v = TPUVerifier(piece_length=64, batch_size=8)
+        with pytest.raises(ValueError):
+            v.hash_pieces([b"x" * 65])
+
+    def test_piece_length_mismatch_rejected(self):
+        info, storage, _ = build_torrent(32768, 32768)
+        v = TPUVerifier(piece_length=16384, batch_size=8)
+        with pytest.raises(ValueError):
+            v.verify_storage(storage, info)
+
+    def test_batch_rounds_to_mesh_multiple(self):
+        import jax
+
+        mesh = make_mesh(jax.devices())
+        v = TPUVerifier(piece_length=64, batch_size=9, mesh=mesh)
+        assert v.batch_size % mesh.size == 0
+
+    def test_last_result_metrics(self):
+        info, storage, _ = build_torrent(200_000, 32768, seed=6)
+        v = TPUVerifier(piece_length=32768, batch_size=8)
+        bf = v.verify_storage(storage, info)
+        assert bf.all()
+        r = v.last_result
+        assert r.complete and r.n_pieces == info.num_pieces
+        assert r.bytes_hashed == 200_000 and r.pieces_per_sec > 0
+
+    def test_hash_bytes(self):
+        v = TPUVerifier(piece_length=64, batch_size=8)
+        assert v.hash_bytes(b"abc") == hashlib.sha1(b"abc").digest()
+
+    def test_progress_callback(self):
+        info, storage, _ = build_torrent(300_000, 16384, seed=7)
+        calls = []
+        v = TPUVerifier(piece_length=16384, batch_size=8)
+        v.verify_storage(storage, info, progress_cb=lambda done, total: calls.append((done, total)))
+        assert calls[-1][0] == info.num_pieces

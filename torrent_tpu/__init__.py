@@ -1,0 +1,30 @@
+"""torrent_tpu — a TPU-native BitTorrent framework.
+
+A from-scratch re-design of the capabilities of rclarey/torrent (a Deno
+BitTorrent client + tracker library) as a Python/JAX framework whose hash
+plane — piece SHA1 verification and authoring — runs batched on TPU via
+JAX/Pallas, vmapped over pieces and sharded over a device mesh.
+
+Layer map (mirrors reference layers, re-designed TPU-first; see SURVEY.md):
+
+- ``torrent_tpu.utils``    — byte helpers, timeouts, logging        (ref L0)
+- ``torrent_tpu.codec``    — bencode, validators, metainfo          (ref L1/L2)
+- ``torrent_tpu.storage``  — piece math, pluggable storage          (ref L5)
+- ``torrent_tpu.ops``      — SHA1 kernels: pure-JAX + Pallas TPU    (new)
+- ``torrent_tpu.parallel`` — mesh/sharding + batched verify plane   (new)
+- ``torrent_tpu.models``   — the flagship ``TPUVerifier`` pipeline  (new)
+- ``torrent_tpu.net``      — tracker client, peer wire protocol     (ref L3a/L4)
+- ``torrent_tpu.server``   — tracker server + in-memory tracker     (ref L3b)
+- ``torrent_tpu.session``  — Torrent/Client session runtime         (ref L6)
+- ``torrent_tpu.bridge``   — localhost HTTP bridge to the verifier  (new)
+- ``torrent_tpu.tools``    — make_torrent authoring CLI             (ref L7)
+
+(Empty subpackages in this tree are landing in build order — SURVEY.md §7.)
+"""
+
+__version__ = "0.1.0"
+
+from torrent_tpu.codec.bencode import bencode, bdecode
+from torrent_tpu.codec.metainfo import parse_metainfo, Metainfo
+
+__all__ = ["bencode", "bdecode", "parse_metainfo", "Metainfo", "__version__"]

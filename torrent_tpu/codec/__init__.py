@@ -1,0 +1,18 @@
+from torrent_tpu.codec.bencode import (
+    bencode,
+    bdecode,
+    bdecode_with_info_span,
+    BencodeError,
+)
+from torrent_tpu.codec.metainfo import parse_metainfo, Metainfo, InfoDict, FileEntry
+
+__all__ = [
+    "bencode",
+    "bdecode",
+    "bdecode_with_info_span",
+    "BencodeError",
+    "parse_metainfo",
+    "Metainfo",
+    "InfoDict",
+    "FileEntry",
+]

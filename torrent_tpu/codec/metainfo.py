@@ -1,0 +1,169 @@
+""".torrent metainfo parsing (reference layer L2: metainfo.ts, 148 LoC).
+
+Parses and shape-validates a ``.torrent`` file into typed dataclasses:
+normalizes ``piece length`` → ``piece_length``, splits the ``pieces`` blob
+into 20-byte SHA1 digests (metainfo.ts:111), sums multi-file lengths
+(metainfo.ts:125), and computes the BEP 3 infohash.
+
+Infohash design note: the reference re-bencodes the decoded info dict and
+hashes that (metainfo.ts:141-143), which only matches because its codec
+preserves key order. Here the decoder reports the *byte span* of the raw
+``info`` value (codec/bencode.py:bdecode_with_info_span) and the hash is
+taken over the original bytes — correct for any foreign torrent regardless
+of key order or duplicate quirks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from torrent_tpu.codec import valid
+from torrent_tpu.codec.bencode import BencodeError, bdecode_with_info_span
+from torrent_tpu.utils.bytesio import partition
+
+SHA1_LEN = 20
+
+
+@dataclass(frozen=True)
+class FileEntry:
+    """One file of a multi-file torrent (metainfo.ts MultiFileFields)."""
+
+    length: int
+    path: tuple[str, ...]  # path components, decoded UTF-8
+
+
+@dataclass(frozen=True)
+class InfoDict:
+    """Normalized info dict (metainfo.ts:44-60).
+
+    ``files`` is None for single-file torrents; ``length`` is always the
+    total payload size (summed for multi-file, metainfo.ts:125).
+    """
+
+    name: str
+    piece_length: int
+    pieces: tuple[bytes, ...]  # 20-byte SHA1 digests
+    length: int
+    files: tuple[FileEntry, ...] | None = None
+
+    @property
+    def num_pieces(self) -> int:
+        return len(self.pieces)
+
+    @property
+    def is_multi_file(self) -> bool:
+        return self.files is not None
+
+
+@dataclass(frozen=True)
+class Metainfo:
+    """Parsed .torrent (metainfo.ts Metainfo)."""
+
+    announce: str
+    info: InfoDict
+    info_hash: bytes  # 20-byte SHA1 over the raw bencoded info dict
+    # Raw decoded top-level dict (bytes keys) for extra fields like
+    # `comment`, `creation date`, `announce-list` — preserved, not dropped.
+    raw: dict = field(repr=False, default_factory=dict)
+
+
+_FILE_SHAPE = valid.obj(
+    {
+        b"length": valid.num(),
+        b"path": valid.arr(valid.bstr()),
+    }
+)
+
+_INFO_SHAPE = valid.obj(
+    {
+        b"name": valid.bstr(),
+        b"piece length": valid.num(),
+        b"pieces": valid.multiple_len_bytes(SHA1_LEN),
+        b"length": valid.optional(valid.num()),
+        b"files": valid.optional(valid.arr(_FILE_SHAPE)),
+    }
+)
+
+_METAINFO_SHAPE = valid.obj(
+    {
+        b"announce": valid.bstr(),
+        b"info": _INFO_SHAPE,
+    }
+)
+
+
+def parse_metainfo(data: bytes) -> Metainfo | None:
+    """Parse .torrent bytes; returns None on any failure (metainfo.ts:145-147).
+
+    Exactly one of ``info.length`` / ``info.files`` must be present
+    (single- vs multi-file mode); geometry is sanity-checked: the digest
+    count must match ``ceil(length / piece_length)``.
+    """
+    try:
+        decoded, info_span = bdecode_with_info_span(data)
+    except BencodeError:
+        return None
+    if not _METAINFO_SHAPE(decoded):
+        return None
+    raw_info = decoded[b"info"]
+    has_length = raw_info.get(b"length") is not None
+    has_files = raw_info.get(b"files") is not None
+    if has_length == has_files:  # both or neither
+        return None
+    if info_span is None:
+        return None
+
+    try:
+        name = raw_info[b"name"].decode("utf-8")
+    except UnicodeDecodeError:
+        return None
+    piece_length = raw_info[b"piece length"]
+    if piece_length <= 0:
+        return None
+    pieces = tuple(partition(raw_info[b"pieces"], SHA1_LEN))
+
+    files: tuple[FileEntry, ...] | None = None
+    if has_files:
+        entries = []
+        total = 0
+        for f in raw_info[b"files"]:
+            if f[b"length"] < 0 or not f[b"path"]:
+                return None
+            try:
+                path = tuple(p.decode("utf-8") for p in f[b"path"])
+            except UnicodeDecodeError:
+                return None
+            entries.append(FileEntry(length=f[b"length"], path=path))
+            total += f[b"length"]
+        files = tuple(entries)
+        length = total
+    else:
+        length = raw_info[b"length"]
+        if length < 0:
+            return None
+
+    expected_pieces = (length + piece_length - 1) // piece_length
+    if expected_pieces != len(pieces):
+        return None
+
+    try:
+        announce = decoded[b"announce"].decode("utf-8")
+    except UnicodeDecodeError:
+        return None
+
+    start, end = info_span
+    info_hash = hashlib.sha1(data[start:end]).digest()
+
+    return Metainfo(
+        announce=announce,
+        info=InfoDict(
+            name=name,
+            piece_length=piece_length,
+            pieces=pieces,
+            length=length,
+            files=files,
+        ),
+        info_hash=info_hash,
+        raw=decoded,
+    )

@@ -1,0 +1,3 @@
+from torrent_tpu.models.verifier import TPUVerifier
+
+__all__ = ["TPUVerifier"]

@@ -1,0 +1,20 @@
+from torrent_tpu.ops.padding import (
+    padded_len_for,
+    alloc_padded,
+    pad_in_place,
+    pad_pieces,
+    digests_to_words,
+    words_to_digests,
+)
+from torrent_tpu.ops.sha1_jax import sha1_pieces_jax, make_sha1_fn
+
+__all__ = [
+    "padded_len_for",
+    "alloc_padded",
+    "pad_in_place",
+    "pad_pieces",
+    "digests_to_words",
+    "words_to_digests",
+    "sha1_pieces_jax",
+    "make_sha1_fn",
+]

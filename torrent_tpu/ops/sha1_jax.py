@@ -1,0 +1,144 @@
+"""Batched SHA1 in pure JAX — the portable device path of the hash plane.
+
+Replaces the reference's per-piece WebCrypto ``crypto.subtle.digest``
+(tools/make_torrent.ts:29, metainfo.ts:142) with one XLA program hashing
+thousands of pieces at once:
+
+- **Batch axis = pieces** (the reference's only data parallelism, its
+  ``Promise.all`` over digests, tools/make_torrent.ts:111 — here it's the
+  vectorized lane dimension of the VPU).
+- **Serial axis = the SHA1 block chain** within a piece, expressed as
+  ``lax.scan`` over ``[nblk]`` — compiled once regardless of chain length.
+- **Ragged batches** (short final piece) are handled with a per-row block
+  count and masked state updates: all shapes static, no recompiles.
+
+Data is uploaded as raw ``uint8[B, padded]`` and byte-swizzled to
+big-endian u32 on device (bitcast + shifts — free relative to HBM reads),
+then transposed to ``[nblk, 16, B]`` so each scan step streams one
+contiguous slab and each schedule word ``w[t]`` is a contiguous ``[B]``
+vector filling VPU lanes.
+
+The TPU-optimized Pallas variant with identical semantics lives in
+``ops/sha1_pallas.py``; both satisfy ``make_sha1_fn``'s contract.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# FIPS 180-4 constants.
+_IV = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0)
+_K = (0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xCA62C1D6)
+
+
+def _rotl(x: jax.Array, n: int) -> jax.Array:
+    return (x << np.uint32(n)) | (x >> np.uint32(32 - n))
+
+
+def _bswap32(x: jax.Array) -> jax.Array:
+    """Little-endian u32 (from bitcast of LE byte quads) → big-endian value."""
+    return (
+        ((x & np.uint32(0x000000FF)) << np.uint32(24))
+        | ((x & np.uint32(0x0000FF00)) << np.uint32(8))
+        | ((x >> np.uint32(8)) & np.uint32(0x0000FF00))
+        | (x >> np.uint32(24))
+    )
+
+
+def _compress(state, w16):
+    """One SHA1 compression: state 5×[B], w16 list of 16 [B] u32 vectors.
+
+    80 rounds unrolled in Python (static trace); the 80-word schedule is a
+    16-entry rolling window so only 16 [B] vectors are live at a time.
+    """
+    a, b, c, d, e = state
+    w = list(w16)
+    for t in range(80):
+        if t < 16:
+            wt = w[t]
+        else:
+            wt = _rotl(w[(t - 3) % 16] ^ w[(t - 8) % 16] ^ w[(t - 14) % 16] ^ w[t % 16], 1)
+            w[t % 16] = wt
+        if t < 20:
+            f = (b & c) | (jnp.bitwise_not(b) & d)
+            k = _K[0]
+        elif t < 40:
+            f = b ^ c ^ d
+            k = _K[1]
+        elif t < 60:
+            f = (b & c) | (b & d) | (c & d)
+            k = _K[2]
+        else:
+            f = b ^ c ^ d
+            k = _K[3]
+        tmp = _rotl(a, 5) + f + e + np.uint32(k) + wt
+        e, d, c, b, a = d, c, _rotl(b, 30), a, tmp
+    return (
+        state[0] + a,
+        state[1] + b,
+        state[2] + c,
+        state[3] + d,
+        state[4] + e,
+    )
+
+
+def bytes_to_schedule(data_u8: jax.Array) -> jax.Array:
+    """``uint8[B, padded]`` → ``uint32[nblk, 16, B]`` big-endian schedule."""
+    b, padded = data_u8.shape
+    nblk = padded // 64
+    quads = data_u8.reshape(b, nblk * 16, 4)
+    words = jax.lax.bitcast_convert_type(quads, jnp.uint32)  # LE quads
+    words = _bswap32(words)
+    # [B, nblk, 16] → [nblk, 16, B]: one transpose so every scan step and
+    # every schedule word is a contiguous [B] slab in HBM/VMEM.
+    return jnp.transpose(words.reshape(b, nblk, 16), (1, 2, 0))
+
+
+def sha1_chain(schedule: jax.Array, nblocks: jax.Array) -> jax.Array:
+    """Run the masked block chain. schedule u32[nblk,16,B], nblocks i32[B].
+
+    Returns digests as ``uint32[B, 5]`` big-endian state words.
+    """
+    nblk, _, b = schedule.shape
+    init = tuple(jnp.full((b,), v, dtype=jnp.uint32) for v in _IV)
+
+    def step(carry, xs):
+        state, t = carry
+        block = xs  # u32[16, B]
+        w16 = [block[i] for i in range(16)]
+        new = _compress(state, w16)
+        keep = t < nblocks  # bool[B]
+        state = tuple(jnp.where(keep, n, o) for n, o in zip(new, state))
+        return (state, t + 1), None
+
+    (final, _), _ = jax.lax.scan(step, (init, jnp.int32(0)), schedule)
+    return jnp.stack(final, axis=1)  # [B, 5]
+
+
+@functools.partial(jax.jit, static_argnames=())
+def sha1_pieces_jax(data_u8: jax.Array, nblocks: jax.Array) -> jax.Array:
+    """Batched SHA1: ``uint8[B, padded]``, ``int32[B]`` → ``uint32[B, 5]``."""
+    return sha1_chain(bytes_to_schedule(data_u8), nblocks)
+
+
+def make_sha1_fn(backend: str = "jax"):
+    """Return a jittable ``(data_u8[B, padded], nblocks[B]) -> u32[B, 5]``.
+
+    ``backend``: ``"jax"`` (this module, runs anywhere XLA does) or
+    ``"pallas"`` (hand-tiled TPU kernel, ops/sha1_pallas.py).
+    """
+    if backend == "jax":
+        return sha1_pieces_jax
+    if backend == "pallas":
+        try:
+            from torrent_tpu.ops.sha1_pallas import sha1_pieces_pallas
+        except ImportError as e:
+            raise NotImplementedError(
+                "pallas sha1 backend not available in this build"
+            ) from e
+        return sha1_pieces_pallas
+    raise ValueError(f"unknown sha1 backend {backend!r}")

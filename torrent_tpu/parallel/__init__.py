@@ -1,0 +1,10 @@
+from torrent_tpu.parallel.mesh import make_mesh, batch_sharding, replicated_sharding
+from torrent_tpu.parallel.verify import verify_pieces, VerifyResult
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "verify_pieces",
+    "VerifyResult",
+]

@@ -1,0 +1,124 @@
+"""Batched piece verification — the resume-recheck / authoring hash plane.
+
+This is the subsystem the reference *lacks* (SURVEY §8.3: downloaded
+pieces are never SHA1-checked; resume-recheck is an unchecked roadmap
+item, README.md:34) and the BASELINE north star: ``verify_pieces(storage,
+info)`` reads pieces in large batches (``Storage.read_batch``), pads them
+on host, and hashes them on device — pieces sharded ``(hosts, dp)`` over
+the mesh, digests compared on device, one bool per piece returned.
+
+Pipeline shape (per batch of B pieces):
+
+    disk → read_batch → pad_in_place → device put (sharded) ┐
+                                    sha1 chain (scan)       │ overlapped:
+                                    compare vs expected     │ next batch's
+                                    psum-free bool[B] ──────┘ disk read runs
+                                                              on a host thread
+
+The CPU path (``hasher="cpu"``) is streaming hashlib — the measured
+baseline the TPU path is benchmarked against (BASELINE.md configs 1-2).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+from torrent_tpu.codec.metainfo import InfoDict
+from torrent_tpu.storage.piece import piece_length
+from torrent_tpu.storage.storage import Storage, StorageError
+
+
+@dataclass
+class VerifyResult:
+    """Outcome of a full verify pass."""
+
+    bitfield: np.ndarray  # bool[n_pieces]
+    n_pieces: int
+    n_valid: int
+    bytes_hashed: int
+    seconds: float
+
+    @property
+    def complete(self) -> bool:
+        return self.n_valid == self.n_pieces
+
+    @property
+    def pieces_per_sec(self) -> float:
+        return self.n_pieces / self.seconds if self.seconds > 0 else float("inf")
+
+    @property
+    def gib_per_sec(self) -> float:
+        return self.bytes_hashed / self.seconds / 2**30 if self.seconds > 0 else float("inf")
+
+
+ProgressCb = Callable[[int, int], None]  # (pieces_done, pieces_total)
+
+
+def verify_pieces_cpu(
+    storage: Storage, info: InfoDict, progress_cb: ProgressCb | None = None
+) -> np.ndarray:
+    """Streaming hashlib recheck — the measured CPU baseline."""
+    n = info.num_pieces
+    bitfield = np.zeros(n, dtype=bool)
+    for idx in range(n):
+        try:
+            data = storage.read_piece(idx)
+        except StorageError:
+            continue
+        if len(data) == piece_length(info, idx) and hashlib.sha1(data).digest() == info.pieces[idx]:
+            bitfield[idx] = True
+        if progress_cb and (idx + 1) % 256 == 0:
+            progress_cb(idx + 1, n)
+    if progress_cb:
+        progress_cb(n, n)
+    return bitfield
+
+
+def verify_pieces_tpu(
+    storage: Storage,
+    info: InfoDict,
+    batch_size: int = 1024,
+    backend: str = "jax",
+    mesh=None,
+    progress_cb: ProgressCb | None = None,
+    io_threads: int = 4,
+) -> np.ndarray:
+    """Batched device recheck; overlaps disk reads with device hashing."""
+    from torrent_tpu.models.verifier import TPUVerifier
+
+    verifier = TPUVerifier(
+        piece_length=info.piece_length,
+        batch_size=batch_size,
+        backend=backend,
+        mesh=mesh,
+    )
+    return verifier.verify_storage(
+        storage, info, progress_cb=progress_cb, io_threads=io_threads
+    )
+
+
+def verify_pieces(
+    storage: Storage,
+    info: InfoDict,
+    hasher: str = "cpu",
+    progress_cb: ProgressCb | None = None,
+    **tpu_kwargs,
+) -> np.ndarray:
+    """Recheck every piece; returns ``bool[n_pieces]``.
+
+    ``hasher`` mirrors the BASELINE API contract: ``"cpu"`` (default,
+    streaming hashlib — the reference's std/crypto analogue) or ``"tpu"``
+    (batched device path; on CPU-only hosts XLA still runs it, so the flag
+    selects *strategy*, not hardware availability).
+    """
+    if info.num_pieces == 0:
+        return np.zeros(0, dtype=bool)
+    if hasher == "cpu":
+        return verify_pieces_cpu(storage, info, progress_cb)
+    if hasher == "tpu":
+        return verify_pieces_tpu(storage, info, progress_cb=progress_cb, **tpu_kwargs)
+    raise ValueError(f"unknown hasher {hasher!r}")

@@ -1,0 +1,18 @@
+from torrent_tpu.storage.piece import (
+    BLOCK_SIZE,
+    piece_length,
+    validate_requested_block,
+    validate_received_block,
+)
+from torrent_tpu.storage.storage import FsStorage, MemoryStorage, Storage, StorageMethod
+
+__all__ = [
+    "BLOCK_SIZE",
+    "piece_length",
+    "validate_requested_block",
+    "validate_received_block",
+    "Storage",
+    "StorageMethod",
+    "FsStorage",
+    "MemoryStorage",
+]

@@ -1,0 +1,66 @@
+"""Pallas SHA1 kernel tests — interpret mode on CPU (SURVEY §4 lesson:
+``interpret=True`` pallas_call for CI without TPUs), differential vs hashlib.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from torrent_tpu.ops.padding import pad_pieces, words_to_digests
+from torrent_tpu.ops.sha1_pallas import TILE, sha1_pieces_pallas
+
+
+def pallas_digests(pieces):
+    padded, nblocks = pad_pieces(pieces)
+    words = np.asarray(sha1_pieces_pallas(padded, nblocks, interpret=True))
+    return words_to_digests(words)
+
+
+class TestPallasKernel:
+    def test_nist_vectors(self):
+        msgs = [
+            b"",
+            b"abc",
+            b"abcdbcdecdefdefgefghfghighijhijkijkljklmklmnlmnomnopnopq",
+        ]
+        want = [
+            "da39a3ee5e6b4b0d3255bfef95601890afd80709",
+            "a9993e364706816aba3e25717850c26c9cd0d89d",
+            "84983e441c3bd26ebaae4aa1f95129e5e54670f1",
+        ]
+        got = pallas_digests(msgs)
+        assert [d.hex() for d in got] == want
+
+    def test_ragged_differential(self):
+        rng = np.random.default_rng(11)
+        lens = [0, 1, 55, 56, 63, 64, 65, 119, 120, 127, 128, 300, 1024]
+        pieces = [rng.integers(0, 256, size=n, dtype=np.uint8).tobytes() for n in lens]
+        assert pallas_digests(pieces) == [hashlib.sha1(p).digest() for p in pieces]
+
+    def test_batch_padding_to_tile(self):
+        # 3 pieces → padded to TILE rows internally, result sliced back
+        pieces = [b"one", b"two2", b"three"]
+        out = pallas_digests(pieces)
+        assert len(out) == 3
+        assert out == [hashlib.sha1(p).digest() for p in pieces]
+
+    def test_chain_multiblock(self):
+        # pieces long enough to need several 64-byte blocks with distinct
+        # lengths per lane — exercises the masked chain freeze
+        rng = np.random.default_rng(13)
+        pieces = [rng.integers(0, 256, size=n, dtype=np.uint8).tobytes() for n in (320, 64, 256, 130)]
+        assert pallas_digests(pieces) == [hashlib.sha1(p).digest() for p in pieces]
+
+    def test_agrees_with_jax_backend(self):
+        from torrent_tpu.ops.sha1_jax import sha1_pieces_jax
+
+        rng = np.random.default_rng(17)
+        pieces = [rng.integers(0, 256, size=200, dtype=np.uint8).tobytes() for _ in range(5)]
+        padded, nblocks = pad_pieces(pieces)
+        a = np.asarray(sha1_pieces_jax(padded, nblocks))
+        b = np.asarray(sha1_pieces_pallas(padded, nblocks, interpret=True))
+        assert (a == b).all()
+
+    def test_tile_constant(self):
+        assert TILE == 1024

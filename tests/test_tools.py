@@ -1,0 +1,201 @@
+"""make_torrent authoring + UPnP helpers + bridge service tests."""
+
+import asyncio
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from torrent_tpu.codec.metainfo import parse_metainfo
+from torrent_tpu.tools.make_torrent import (
+    choose_piece_length,
+    collect_files,
+    make_torrent,
+)
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+class TestPieceLengthHeuristic:
+    def test_bounds_and_target(self):
+        # power of 2, 32 KiB ≤ len ≤ 1 MiB, ~size/1000
+        assert choose_piece_length(0) == 32 * 1024
+        assert choose_piece_length(1 << 20) == 32 * 1024
+        assert choose_piece_length(100 << 20) == 128 * 1024
+        assert choose_piece_length(1 << 40) == 1024 * 1024  # capped
+        for size in (5 << 20, 300 << 20, 7 << 30):
+            plen = choose_piece_length(size)
+            assert plen & (plen - 1) == 0
+            assert 32 * 1024 <= plen <= 1024 * 1024
+
+
+class TestMakeTorrent:
+    def _write_tree(self, root):
+        rng = np.random.default_rng(8)
+        (root / "sub").mkdir(parents=True)
+        files = {
+            "a.bin": rng.integers(0, 256, size=70_000, dtype=np.uint8).tobytes(),
+            os.path.join("sub", "b.bin"): rng.integers(0, 256, size=40_001, dtype=np.uint8).tobytes(),
+            "z.bin": rng.integers(0, 256, size=5, dtype=np.uint8).tobytes(),
+        }
+        for rel, data in files.items():
+            (root / rel).write_bytes(data)
+        return files
+
+    def test_single_file_roundtrip(self, tmp_path):
+        payload = np.random.default_rng(1).integers(0, 256, size=150_000, dtype=np.uint8).tobytes()
+        target = tmp_path / "data.bin"
+        target.write_bytes(payload)
+        data = make_torrent(str(target), "http://t.local/announce", piece_length=32768)
+        m = parse_metainfo(data)
+        assert m is not None
+        assert m.info.name == "data.bin" and m.info.length == 150_000
+        assert m.announce == "http://t.local/announce"
+        # digests must match ground truth
+        for i, d in enumerate(m.info.pieces):
+            assert d == hashlib.sha1(payload[i * 32768 : (i + 1) * 32768]).digest()
+
+    def test_multi_file_boundary_spanning(self, tmp_path):
+        files = self._write_tree(tmp_path)
+        data = make_torrent(str(tmp_path), "http://t.local/announce", piece_length=65536)
+        m = parse_metainfo(data)
+        assert m is not None and m.info.is_multi_file
+        # deterministic sorted walk
+        assert [f.path for f in m.info.files] == [("a.bin",), ("z.bin",), ("sub", "b.bin")]
+        concat = files["a.bin"] + files["z.bin"] + files[os.path.join("sub", "b.bin")]
+        assert m.info.length == len(concat)
+        for i, d in enumerate(m.info.pieces):
+            assert d == hashlib.sha1(concat[i * 65536 : (i + 1) * 65536]).digest()
+
+    def test_tpu_hasher_identical_output(self, tmp_path):
+        payload = np.random.default_rng(2).integers(0, 256, size=100_000, dtype=np.uint8).tobytes()
+        target = tmp_path / "x.bin"
+        target.write_bytes(payload)
+        cpu = make_torrent(str(target), "http://t/announce", piece_length=32768, hasher="cpu")
+        tpu = make_torrent(str(target), "http://t/announce", piece_length=32768, hasher="tpu")
+        # identical except creation date (strip both)
+        m1, m2 = parse_metainfo(cpu), parse_metainfo(tpu)
+        assert m1.info_hash == m2.info_hash
+
+    def test_empty_dir_rejected(self, tmp_path):
+        (tmp_path / "empty").mkdir()
+        with pytest.raises(ValueError, match="no files"):
+            make_torrent(str(tmp_path / "empty"), "http://t/a")
+
+    def test_missing_path(self):
+        with pytest.raises(FileNotFoundError):
+            make_torrent("/nonexistent/nope", "http://t/a")
+
+    def test_collect_files_deterministic(self, tmp_path):
+        self._write_tree(tmp_path)
+        assert collect_files(str(tmp_path)) == collect_files(str(tmp_path))
+
+
+class TestUpnpHelpers:
+    def test_soap_envelope(self):
+        from torrent_tpu.net.upnp import WAN_SERVICE, soap_envelope
+
+        env = soap_envelope("AddPortMapping", {"ExternalPort": "6881", "Protocol": "TCP"})
+        assert b"<u:AddPortMapping" in env
+        assert WAN_SERVICE.encode() in env
+        assert b"<NewExternalPort>6881</NewExternalPort>" in env
+        assert b"<NewProtocol>TCP</NewProtocol>" in env
+
+    def test_extract_control_url_relative_and_absolute(self):
+        from torrent_tpu.net.upnp import UpnpError, extract_control_url
+
+        xml = (
+            b"<device><serviceList><service>"
+            b"<serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>"
+            b"<controlURL>/ctl/IPConn</controlURL>"
+            b"</service></serviceList></device>"
+        )
+        url = extract_control_url(xml, "http://192.168.1.1:5000/desc.xml")
+        assert url == "http://192.168.1.1:5000/ctl/IPConn"
+        xml_abs = xml.replace(b"/ctl/IPConn", b"http://10.0.0.1:80/c")
+        assert extract_control_url(xml_abs, "http://x/") == "http://10.0.0.1:80/c"
+        with pytest.raises(UpnpError, match="no WANIPConnection"):
+            extract_control_url(b"<device/>", "http://x/")
+
+    def test_ssdp_search_shape(self):
+        from torrent_tpu.net.upnp import SSDP_SEARCH
+
+        assert SSDP_SEARCH.startswith("M-SEARCH * HTTP/1.1")
+        assert "239.255.255.250:1900" in SSDP_SEARCH
+        assert "InternetGatewayDevice" in SSDP_SEARCH
+
+
+class TestBridge:
+    def test_digests_and_verify(self):
+        async def go():
+            from torrent_tpu.bridge.service import serve_bridge
+            from torrent_tpu.codec.bencode import bdecode, bencode
+
+            server = await serve_bridge(port=0, hasher="cpu")
+            try:
+                pieces = [b"alpha", b"beta" * 1000, b""]
+                body = bencode({b"pieces": pieces})
+
+                async def post(path, payload):
+                    reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+                    writer.write(
+                        f"POST {path} HTTP/1.1\r\nHost: x\r\nContent-Length: {len(payload)}\r\n\r\n".encode()
+                        + payload
+                    )
+                    await writer.drain()
+                    status = await reader.readline()
+                    clen = 0
+                    while True:
+                        line = await reader.readline()
+                        if line in (b"\r\n", b""):
+                            break
+                        if line.lower().startswith(b"content-length:"):
+                            clen = int(line.split(b":", 1)[1])
+                    resp = await reader.readexactly(clen)
+                    writer.close()
+                    return int(status.split()[1]), resp
+
+                status, resp = await post("/v1/digests", body)
+                assert status == 200
+                digests = bdecode(resp)[b"digests"]
+                assert digests == [hashlib.sha1(p).digest() for p in pieces]
+
+                expected = list(digests)
+                expected[1] = b"\x00" * 20  # corrupt one
+                status, resp = await post(
+                    "/v1/verify", bencode({b"pieces": pieces, b"expected": expected})
+                )
+                assert status == 200
+                assert bdecode(resp)[b"ok"] == b"\x01\x00\x01"
+
+                status, resp = await post("/v1/digests", b"garbage")
+                assert status == 400
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+    def test_info_route(self):
+        async def go():
+            from torrent_tpu.bridge.service import serve_bridge
+            from torrent_tpu.codec.bencode import bdecode
+
+            server = await serve_bridge(port=0, hasher="cpu")
+            try:
+                reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+                writer.write(b"GET /v1/info HTTP/1.1\r\nHost: x\r\n\r\n")
+                await writer.drain()
+                data = await reader.read()
+                body = data.split(b"\r\n\r\n", 1)[1]
+                info = bdecode(body)
+                assert info[b"backend"] == b"cpu" and info[b"devices"] >= 1
+                writer.close()
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())

@@ -24,7 +24,42 @@ Layer map (mirrors reference layers, re-designed TPU-first; see SURVEY.md):
 
 __version__ = "0.1.0"
 
-from torrent_tpu.codec.bencode import bencode, bdecode
-from torrent_tpu.codec.metainfo import parse_metainfo, Metainfo
+# Public API surface. The reference's mod.ts exports only codec + tracker
+# (mod.ts:1-3, SURVEY §1 note); here the session layer is first-class.
+from torrent_tpu.codec.bencode import bencode, bdecode, BencodeError
+from torrent_tpu.codec.metainfo import parse_metainfo, Metainfo, InfoDict, FileEntry
+from torrent_tpu.net.tracker import announce, scrape, TrackerError
+from torrent_tpu.net.types import AnnounceEvent, AnnounceInfo, AnnounceResponse
+from torrent_tpu.session.client import Client, ClientConfig
+from torrent_tpu.session.torrent import Torrent, TorrentConfig, TorrentState
+from torrent_tpu.storage.storage import Storage, StorageMethod, FsStorage, MemoryStorage
+from torrent_tpu.parallel.verify import verify_pieces
+from torrent_tpu.tools.make_torrent import make_torrent
 
-__all__ = ["bencode", "bdecode", "parse_metainfo", "Metainfo", "__version__"]
+__all__ = [
+    "bencode",
+    "bdecode",
+    "BencodeError",
+    "parse_metainfo",
+    "Metainfo",
+    "InfoDict",
+    "FileEntry",
+    "announce",
+    "scrape",
+    "TrackerError",
+    "AnnounceEvent",
+    "AnnounceInfo",
+    "AnnounceResponse",
+    "Client",
+    "ClientConfig",
+    "Torrent",
+    "TorrentConfig",
+    "TorrentState",
+    "Storage",
+    "StorageMethod",
+    "FsStorage",
+    "MemoryStorage",
+    "verify_pieces",
+    "make_torrent",
+    "__version__",
+]

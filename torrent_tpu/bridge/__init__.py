@@ -1,0 +1,3 @@
+from torrent_tpu.bridge.service import BridgeServer, serve_bridge
+
+__all__ = ["BridgeServer", "serve_bridge"]

@@ -1,0 +1,174 @@
+"""Localhost HTTP bridge to the TPU hash plane.
+
+The BASELINE north star's topology: a non-Python BitTorrent client (e.g.
+the reference's Deno runtime) streams piece buffers to a local JAX
+sidecar and gets digests/verdicts back. Wire format is bencode — the one
+codec every BitTorrent client already has:
+
+  POST /v1/digests   body {pieces: [bytes, ...]}
+                     → {digests: [20-byte sha1, ...]}
+  POST /v1/verify    body {pieces: [bytes, ...], expected: [20B, ...]}
+                     → {ok: bytes}            (one 0x00/0x01 per piece)
+  GET  /v1/info      → {backend, devices, batch} (capability probe)
+
+Hand-rolled asyncio HTTP (one round-trip, large bodies, Content-Length
+framing) — no web framework needed for three routes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from torrent_tpu.codec.bencode import BencodeError, bdecode, bencode
+from torrent_tpu.utils.log import get_logger
+
+log = get_logger("bridge")
+
+MAX_BODY = 1 << 30  # 1 GiB of piece data per request
+
+
+class BridgeServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, hasher: str = "tpu"):
+        self.host = host
+        self.port = port
+        self.hasher = hasher
+        self._server: asyncio.AbstractServer | None = None
+        self._verifiers: dict[int, object] = {}
+
+    async def start(self) -> "BridgeServer":
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("bridge listening on %s:%d", self.host, self.port)
+        return self
+
+    def close(self) -> None:
+        if self._server:
+            self._server.close()
+
+    async def wait_closed(self) -> None:
+        if self._server:
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------ hashing
+
+    def _digests(self, pieces: list[bytes]) -> list[bytes]:
+        if self.hasher == "cpu":
+            import hashlib
+
+            return [hashlib.sha1(p).digest() for p in pieces]
+        from torrent_tpu.models.verifier import TPUVerifier
+
+        cap = max((len(p) for p in pieces), default=64)
+        # bucket by next power of two so a handful of executables serve
+        # any piece geometry
+        bucket = 1 << (cap - 1).bit_length() if cap > 1 else 1
+        verifier = self._verifiers.get(bucket)
+        if verifier is None:
+            verifier = TPUVerifier(piece_length=bucket, batch_size=256)
+            self._verifiers[bucket] = verifier
+        return verifier.hash_pieces(pieces)
+
+    # --------------------------------------------------------------- http
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            request_line = (await asyncio.wait_for(reader.readline(), 60)).split()
+            if len(request_line) < 2:
+                return await self._reply(writer, 400, b"bad request")
+            method, target = request_line[0].decode(), request_line[1].decode()
+            content_length = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                if line.lower().startswith(b"content-length:"):
+                    content_length = int(line.split(b":", 1)[1])
+            if content_length > MAX_BODY:
+                return await self._reply(writer, 413, b"body too large")
+            body = await reader.readexactly(content_length) if content_length else b""
+            await self._route(writer, method, target, body)
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError, ConnectionError, OSError):
+            writer.close()
+        except Exception as e:  # one bad request must not kill the sidecar
+            log.error("bridge error: %s", e)
+            await self._reply(writer, 500, str(e).encode())
+
+    async def _route(self, writer, method: str, target: str, body: bytes):
+        if method == "GET" and target == "/v1/info":
+            import jax
+
+            payload = bencode(
+                {
+                    b"backend": self.hasher.encode(),
+                    b"devices": len(jax.devices()),
+                    b"version": b"torrent-tpu/0.1",
+                }
+            )
+            return await self._reply(writer, 200, payload)
+        if method != "POST":
+            return await self._reply(writer, 405, b"method not allowed")
+        try:
+            req = bdecode(body)
+        except BencodeError as e:
+            return await self._reply(writer, 400, f"bad bencode: {e}".encode())
+        if not isinstance(req, dict) or not isinstance(req.get(b"pieces"), list):
+            return await self._reply(writer, 400, b"missing pieces list")
+        pieces = req[b"pieces"]
+        if not all(isinstance(p, bytes) for p in pieces):
+            return await self._reply(writer, 400, b"pieces must be bytestrings")
+
+        if target == "/v1/digests":
+            digests = await asyncio.to_thread(self._digests, pieces)
+            return await self._reply(writer, 200, bencode({b"digests": digests}))
+        if target == "/v1/verify":
+            expected = req.get(b"expected")
+            if (
+                not isinstance(expected, list)
+                or len(expected) != len(pieces)
+                or not all(isinstance(e, bytes) and len(e) == 20 for e in expected)
+            ):
+                return await self._reply(writer, 400, b"expected must be 20-byte hashes")
+            digests = await asyncio.to_thread(self._digests, pieces)
+            ok = bytes(
+                1 if d == e else 0 for d, e in zip(digests, expected)
+            )
+            return await self._reply(writer, 200, bencode({b"ok": ok}))
+        await self._reply(writer, 404, b"not found")
+
+    async def _reply(self, writer, status: int, body: bytes):
+        try:
+            head = (
+                f"HTTP/1.1 {status} X\r\nContent-Type: application/octet-stream\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+            )
+            writer.write(head.encode("latin-1") + body)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+
+async def serve_bridge(host: str = "127.0.0.1", port: int = 8421, hasher: str = "tpu") -> BridgeServer:
+    return await BridgeServer(host, port, hasher).start()
+
+
+def main():  # pragma: no cover - manual entrypoint
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8421)
+    parser.add_argument("--hasher", choices=("cpu", "tpu"), default="tpu")
+    args = parser.parse_args()
+
+    async def go():
+        server = await serve_bridge(args.host, args.port, args.hasher)
+        print(f"bridge listening on {args.host}:{server.port}")
+        await server.wait_closed()
+
+    asyncio.run(go())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -65,6 +65,27 @@ class TPUVerifier:
         self.padded_len = padded_len_for(piece_length)
         self.backend = backend
         sha1_fn = make_sha1_fn(backend)
+        if backend == "pallas":
+            # A pallas_call has no SPMD partitioning rule, so on a >1-device
+            # mesh we shard it explicitly: each device runs the kernel on its
+            # local piece sub-batch (embarrassingly parallel, no collectives).
+            # Per-device sub-batches must be TILE(=1024)-aligned or every
+            # launch pads with wasted sentinel rows.
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            from torrent_tpu.ops.sha1_pallas import TILE
+
+            if self.mesh.size > 1:
+                spec = P(tuple(self.mesh.axis_names))
+                sha1_fn = shard_map(
+                    sha1_fn,
+                    mesh=self.mesh,
+                    in_specs=(spec, spec),
+                    out_specs=spec,
+                    check_rep=False,
+                )
+            self.batch_size = round_up_to_multiple(self.batch_size, TILE * self.mesh.size)
         shard = batch_sharding(self.mesh)
 
         def _digests(data_u8, nblocks):
@@ -143,16 +164,32 @@ class TPUVerifier:
         b = self.batch_size
         plen = self.piece_length
 
-        # Two staging buffers: the IO thread fills one while the device
+        # Two staging buffers: the IO threads fill one while the device
         # consumes the other (the TPU analogue of the reference's
         # Promise.all hashing pipeline, tools/make_torrent.ts:96-111).
+        # ``io_threads`` stripes each batch's disk reads in parallel.
         staging = [alloc_padded(b, plen) for _ in range(2)]
+        stripes = max(1, io_threads)
+        io_pool = ThreadPoolExecutor(max_workers=stripes) if stripes > 1 else None
 
         def load(slot: int, start: int):
             padded, view = staging[slot]
             idxs = range(start, min(start + b, n))
             k = len(idxs)
-            storage.read_batch(idxs, out=view[:k])
+            if io_pool is not None and k > stripes:
+                step = (k + stripes - 1) // stripes
+                futs = [
+                    io_pool.submit(
+                        storage.read_batch,
+                        idxs[s : s + step],
+                        out=view[s : min(s + step, k)],
+                    )
+                    for s in range(0, k, step)
+                ]
+                for f in futs:
+                    f.result()
+            else:
+                storage.read_batch(idxs, out=view[:k])
             padded[:, plen:] = 0  # clear pad tail (stale 0x80/bitlen bytes)
             if k < b:
                 padded[k:] = 0
@@ -167,21 +204,25 @@ class TPUVerifier:
             return padded, nblocks, expected, k
 
         t0 = time.perf_counter()
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            fut = pool.submit(load, 0, 0)
-            start = 0
-            slot = 0
-            while start < n:
-                padded, nblocks, expected, k = fut.result()
-                next_start = start + b
-                if next_start < n:
-                    slot = 1 - slot
-                    fut = pool.submit(load, slot, next_start)
-                ok = self.verify_batch(padded, nblocks, expected)
-                bitfield[start : start + k] = ok[:k]
-                if progress_cb:
-                    progress_cb(min(next_start, n), n)
-                start = next_start
+        try:
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                fut = pool.submit(load, 0, 0)
+                start = 0
+                slot = 0
+                while start < n:
+                    padded, nblocks, expected, k = fut.result()
+                    next_start = start + b
+                    if next_start < n:
+                        slot = 1 - slot
+                        fut = pool.submit(load, slot, next_start)
+                    ok = self.verify_batch(padded, nblocks, expected)
+                    bitfield[start : start + k] = ok[:k]
+                    if progress_cb:
+                        progress_cb(min(next_start, n), n)
+                    start = next_start
+        finally:
+            if io_pool is not None:
+                io_pool.shutdown(wait=False)
         self.last_result = VerifyResult(
             bitfield=bitfield,
             n_pieces=n,

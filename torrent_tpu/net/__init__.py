@@ -1,0 +1,21 @@
+from torrent_tpu.net.types import (
+    AnnounceEvent,
+    AnnounceInfo,
+    AnnouncePeer,
+    AnnounceResponse,
+    ScrapeEntry,
+    UdpTrackerAction,
+)
+from torrent_tpu.net.tracker import announce, scrape, TrackerError
+
+__all__ = [
+    "AnnounceEvent",
+    "AnnounceInfo",
+    "AnnouncePeer",
+    "AnnounceResponse",
+    "ScrapeEntry",
+    "UdpTrackerAction",
+    "announce",
+    "scrape",
+    "TrackerError",
+]

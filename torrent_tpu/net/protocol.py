@@ -1,0 +1,238 @@
+"""Peer wire protocol (ref L4: protocol.ts, 271 LoC) on asyncio streams.
+
+The 68-byte handshake is split into send / read-infohash / read-peerid
+phases so an accepting client can route on the info hash (and drop
+unknown torrents) *before* replying (protocol.ts:36-67, client.ts:85-104).
+
+All nine standard messages (BEP 3) are length-prefixed; ``read_message``
+demuxes with bounds checks, skips unknown ids **iteratively** (the
+reference recursed, unbounded on hostile streams — SURVEY §8.12), and
+returns ``None`` on EOF/reset (protocol.ts:267-270).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+from dataclasses import dataclass
+
+from torrent_tpu.net.constants import PROTOCOL_STRING
+from torrent_tpu.utils.bitfield import Bitfield
+from torrent_tpu.utils.bytesio import read_int, write_int
+
+
+class ProtocolError(Exception):
+    pass
+
+
+class MsgId(enum.IntEnum):
+    """(protocol.ts:11-23). KEEPALIVE is a length-0 frame, no id byte."""
+
+    CHOKE = 0
+    UNCHOKE = 1
+    INTERESTED = 2
+    NOT_INTERESTED = 3
+    HAVE = 4
+    BITFIELD = 5
+    REQUEST = 6
+    PIECE = 7
+    CANCEL = 8
+
+
+# Sanity cap on inbound frames: a piece message is 9 + 16 KiB; bitfields
+# for even million-piece torrents are ~128 KiB. Anything past 256 KiB+16
+# is hostile or corrupt.
+MAX_MESSAGE_LEN = 256 * 1024 + 16
+
+
+@dataclass(frozen=True)
+class KeepAlive:
+    pass
+
+
+@dataclass(frozen=True)
+class Choke:
+    pass
+
+
+@dataclass(frozen=True)
+class Unchoke:
+    pass
+
+
+@dataclass(frozen=True)
+class Interested:
+    pass
+
+
+@dataclass(frozen=True)
+class NotInterested:
+    pass
+
+
+@dataclass(frozen=True)
+class Have:
+    index: int
+
+
+@dataclass(frozen=True)
+class BitfieldMsg:
+    raw: bytes
+
+
+@dataclass(frozen=True)
+class Request:
+    index: int
+    begin: int
+    length: int
+
+
+@dataclass(frozen=True)
+class Piece:
+    index: int
+    begin: int
+    block: bytes
+
+
+@dataclass(frozen=True)
+class Cancel:
+    index: int
+    begin: int
+    length: int
+
+
+PeerMsg = (
+    KeepAlive | Choke | Unchoke | Interested | NotInterested | Have | BitfieldMsg | Request | Piece | Cancel
+)
+
+
+# ============================================================= handshake
+
+
+def handshake_bytes(info_hash: bytes, peer_id: bytes) -> bytes:
+    """pstrlen + pstr + 8 reserved + info_hash + peer_id (protocol.ts:25-34)."""
+    if len(info_hash) != 20 or len(peer_id) != 20:
+        raise ProtocolError("info_hash and peer_id must be 20 bytes")
+    return bytes([len(PROTOCOL_STRING)]) + PROTOCOL_STRING + b"\x00" * 8 + info_hash + peer_id
+
+
+async def send_handshake(writer: asyncio.StreamWriter, info_hash: bytes, peer_id: bytes) -> None:
+    writer.write(handshake_bytes(info_hash, peer_id))
+    await writer.drain()
+
+
+async def read_handshake_head(reader: asyncio.StreamReader) -> bytes:
+    """Phase 1: through the info hash; returns the 20-byte hash
+    (protocol.ts:48-61 startReceiveHandshake)."""
+    try:
+        pstrlen = (await reader.readexactly(1))[0]
+        pstr = await reader.readexactly(pstrlen)
+        if pstr != PROTOCOL_STRING:
+            raise ProtocolError(f"unknown protocol string {pstr!r}")
+        await reader.readexactly(8)  # reserved
+        return await reader.readexactly(20)
+    except asyncio.IncompleteReadError as e:
+        raise ProtocolError("handshake truncated") from e
+
+
+async def read_handshake_peer_id(reader: asyncio.StreamReader) -> bytes:
+    """Phase 2 (protocol.ts:63-67 endReceiveHandshake)."""
+    try:
+        return await reader.readexactly(20)
+    except asyncio.IncompleteReadError as e:
+        raise ProtocolError("handshake truncated") from e
+
+
+# ============================================================== encoders
+
+
+def _frame(msg_id: int, payload: bytes = b"") -> bytes:
+    return write_int(1 + len(payload), 4) + bytes([msg_id]) + payload
+
+
+def encode_message(msg: PeerMsg) -> bytes:
+    """Encode any message (protocol.ts:69-161's sendX family, data-first)."""
+    match msg:
+        case KeepAlive():
+            return write_int(0, 4)
+        case Choke():
+            return _frame(MsgId.CHOKE)
+        case Unchoke():
+            return _frame(MsgId.UNCHOKE)
+        case Interested():
+            return _frame(MsgId.INTERESTED)
+        case NotInterested():
+            return _frame(MsgId.NOT_INTERESTED)
+        case Have(index):
+            return _frame(MsgId.HAVE, write_int(index, 4))
+        case BitfieldMsg(raw):
+            return _frame(MsgId.BITFIELD, raw)
+        case Request(index, begin, length):
+            return _frame(MsgId.REQUEST, write_int(index, 4) + write_int(begin, 4) + write_int(length, 4))
+        case Piece(index, begin, block):
+            return _frame(MsgId.PIECE, write_int(index, 4) + write_int(begin, 4) + block)
+        case Cancel(index, begin, length):
+            return _frame(MsgId.CANCEL, write_int(index, 4) + write_int(begin, 4) + write_int(length, 4))
+    raise ProtocolError(f"cannot encode {msg!r}")
+
+
+async def send_message(writer: asyncio.StreamWriter, msg: PeerMsg) -> None:
+    writer.write(encode_message(msg))
+    await writer.drain()
+
+
+def send_bitfield(writer: asyncio.StreamWriter, bitfield: Bitfield) -> None:
+    """Queued write (no drain): first message after handshake
+    (protocol.ts:108-115)."""
+    writer.write(encode_message(BitfieldMsg(bitfield.to_bytes())))
+
+
+# =============================================================== decoder
+
+
+def decode_message(msg_id: int, payload: bytes) -> PeerMsg | None:
+    """Payload → message; None for unknown ids (caller skips)."""
+    if msg_id == MsgId.CHOKE and not payload:
+        return Choke()
+    if msg_id == MsgId.UNCHOKE and not payload:
+        return Unchoke()
+    if msg_id == MsgId.INTERESTED and not payload:
+        return Interested()
+    if msg_id == MsgId.NOT_INTERESTED and not payload:
+        return NotInterested()
+    if msg_id == MsgId.HAVE and len(payload) == 4:
+        return Have(index=read_int(payload, 4))
+    if msg_id == MsgId.BITFIELD:
+        return BitfieldMsg(raw=payload)
+    if msg_id == MsgId.REQUEST and len(payload) == 12:
+        return Request(read_int(payload, 4, 0), read_int(payload, 4, 4), read_int(payload, 4, 8))
+    if msg_id == MsgId.PIECE and len(payload) >= 8:
+        return Piece(read_int(payload, 4, 0), read_int(payload, 4, 4), payload[8:])
+    if msg_id == MsgId.CANCEL and len(payload) == 12:
+        return Cancel(read_int(payload, 4, 0), read_int(payload, 4, 4), read_int(payload, 4, 8))
+    if msg_id in set(MsgId):
+        raise ProtocolError(f"malformed payload for message id {msg_id}")
+    return None
+
+
+async def read_message(reader: asyncio.StreamReader) -> PeerMsg | None:
+    """Read one frame; None on clean EOF / connection error
+    (protocol.ts:211-271). Loops over unknown ids instead of recursing.
+    """
+    while True:
+        try:
+            length = read_int(await reader.readexactly(4), 4)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            return None
+        if length == 0:
+            return KeepAlive()
+        if length > MAX_MESSAGE_LEN:
+            raise ProtocolError(f"frame of {length} bytes exceeds cap")
+        try:
+            body = await reader.readexactly(length)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            return None
+        msg = decode_message(body[0], body[1:])
+        if msg is not None:
+            return msg
+        # unknown message id: skip and read the next frame

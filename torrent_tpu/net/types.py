@@ -1,0 +1,84 @@
+"""Tracker wire types (reference: types.ts, 99 LoC)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class AnnounceEvent(str, enum.Enum):
+    """Announce event (types.ts:3-15)."""
+
+    STARTED = "started"
+    STOPPED = "stopped"
+    COMPLETED = "completed"
+    EMPTY = "empty"
+
+
+class UdpTrackerAction(enum.IntEnum):
+    """BEP 15 action codes (types.ts:94-99)."""
+
+    CONNECT = 0
+    ANNOUNCE = 1
+    SCRAPE = 2
+    ERROR = 3
+
+
+# BEP 15 event encoding (types.ts:18-23). `empty` is 0 on the wire.
+UDP_EVENT_CODE: dict[AnnounceEvent, int] = {
+    AnnounceEvent.EMPTY: 0,
+    AnnounceEvent.COMPLETED: 1,
+    AnnounceEvent.STARTED: 2,
+    AnnounceEvent.STOPPED: 3,
+}
+UDP_CODE_EVENT = {v: k for k, v in UDP_EVENT_CODE.items()}
+
+
+@dataclass
+class AnnounceInfo:
+    """Everything a tracker announce needs (types.ts:41-67)."""
+
+    info_hash: bytes  # 20 bytes
+    peer_id: bytes  # 20 bytes
+    port: int
+    uploaded: int = 0
+    downloaded: int = 0
+    left: int = 0
+    event: AnnounceEvent = AnnounceEvent.EMPTY
+    num_want: int | None = None
+    ip: str | None = None
+    key: bytes | None = None  # random per-session id for NAT'd peers
+    compact: bool = True  # request compact peer lists (BEP 23)
+
+
+@dataclass(frozen=True)
+class AnnouncePeer:
+    """One peer from an announce response (types.ts:32-39)."""
+
+    ip: str
+    port: int
+    peer_id: bytes | None = None  # absent in compact responses
+
+
+@dataclass
+class AnnounceResponse:
+    """Parsed announce response (tracker.ts:253-278)."""
+
+    interval: int
+    peers: list[AnnouncePeer] = field(default_factory=list)
+    complete: int | None = None  # seeders
+    incomplete: int | None = None  # leechers
+    warning: str | None = None
+    min_interval: int | None = None
+    tracker_id: bytes | None = None
+
+
+@dataclass(frozen=True)
+class ScrapeEntry:
+    """Per-torrent scrape stats (types.ts:69-78)."""
+
+    info_hash: bytes
+    complete: int
+    downloaded: int
+    incomplete: int
+    name: str | None = None

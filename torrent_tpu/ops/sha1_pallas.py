@@ -1,0 +1,155 @@
+"""Hand-tiled Pallas TPU SHA1 kernel — the fast path of the hash plane.
+
+Same contract as ops/sha1_jax.py (``(data_u8[B, padded], nblocks[B]) →
+u32[B, 5]``), but laid out for the VPU explicitly:
+
+- Pieces are tiled **1024 per program** and shaped ``(8, 128)`` — every
+  schedule word ``w[t]``, every state variable, and every round temp is
+  exactly one int32 vector register (8 sublanes × 128 lanes).
+- Input is pre-swizzled (one fused XLA pass: bitcast + byteswap +
+  transpose) to ``[R, nblk, 16, 8, 128]`` so each grid step's DMA is one
+  **contiguous 64 KiB slab** from HBM.
+- Grid is ``(R, nblk)`` with the block axis innermost ("arbitrary"
+  semantics): the 5-word running state lives in the revisited output
+  block in VMEM across the whole chain — initialized at ``k == 0``,
+  written back to HBM once per batch tile.
+- Ragged batches: per-lane ``k < nblocks`` masks freeze a piece's state
+  once its (shorter) chain ends — same semantics as the scan mask in
+  sha1_jax.py, no dynamic shapes.
+
+The 80 rounds are Python-unrolled with a 16-register rolling schedule
+window: ~21 live vregs, well inside the register file; no VMEM traffic
+inside the round loop at all.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from torrent_tpu.ops.sha1_jax import _IV, _K, _bswap32, _rotl
+
+# Pieces per program instance: one (8, 128) int32 vreg worth of lanes.
+TILE_SUB = 8
+TILE_LANE = 128
+TILE = TILE_SUB * TILE_LANE  # 1024
+
+
+def _sha1_kernel(words_ref, nblocks_ref, state_ref):
+    """One SHA1 block step for a 1024-piece tile.
+
+    words_ref:   u32[1, 1, 16, 8, 128] — this block's 16 schedule words
+    nblocks_ref: i32[1, 8, 128]        — per-piece chain lengths
+    state_ref:   u32[1, 5, 8, 128]     — running digest state (revisited
+                                          across the k grid axis)
+    """
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        for i, v in enumerate(_IV):
+            state_ref[0, i] = jnp.full((TILE_SUB, TILE_LANE), v, dtype=jnp.uint32)
+
+    h0 = state_ref[0, 0]
+    h1 = state_ref[0, 1]
+    h2 = state_ref[0, 2]
+    h3 = state_ref[0, 3]
+    h4 = state_ref[0, 4]
+
+    a, b, c, d, e = h0, h1, h2, h3, h4
+    w = [words_ref[0, 0, t] for t in range(16)]
+    for t in range(80):
+        if t < 16:
+            wt = w[t]
+        else:
+            wt = _rotl(w[(t - 3) % 16] ^ w[(t - 8) % 16] ^ w[(t - 14) % 16] ^ w[t % 16], 1)
+            w[t % 16] = wt
+        if t < 20:
+            f = (b & c) | (jnp.bitwise_not(b) & d)
+            kc = _K[0]
+        elif t < 40:
+            f = b ^ c ^ d
+            kc = _K[1]
+        elif t < 60:
+            f = (b & c) | (b & d) | (c & d)
+            kc = _K[2]
+        else:
+            f = b ^ c ^ d
+            kc = _K[3]
+        tmp = _rotl(a, 5) + f + e + np.uint32(kc) + wt
+        e, d, c, b, a = d, c, _rotl(b, 30), a, tmp
+
+    keep = k < nblocks_ref[0]
+    state_ref[0, 0] = jnp.where(keep, h0 + a, h0)
+    state_ref[0, 1] = jnp.where(keep, h1 + b, h1)
+    state_ref[0, 2] = jnp.where(keep, h2 + c, h2)
+    state_ref[0, 3] = jnp.where(keep, h3 + d, h3)
+    state_ref[0, 4] = jnp.where(keep, h4 + e, h4)
+
+
+def _swizzle(data_u8: jax.Array, r: int, nblk: int) -> jax.Array:
+    """u8[R*1024, nblk*64] → u32[R, nblk, 16, 8, 128], big-endian words."""
+    quads = data_u8.reshape(r, TILE_SUB, TILE_LANE, nblk, 16, 4)
+    words = _bswap32(jax.lax.bitcast_convert_type(quads, jnp.uint32))
+    return jnp.transpose(words, (0, 3, 4, 1, 2))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _sha1_pallas_aligned(data_u8, nblocks, interpret):
+    b, padded = data_u8.shape
+    nblk = padded // 64
+    r = b // TILE
+    words = _swizzle(data_u8, r, nblk)
+    nb = nblocks.astype(jnp.int32).reshape(r, TILE_SUB, TILE_LANE)
+    state = pl.pallas_call(
+        _sha1_kernel,
+        grid=(r, nblk),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, 16, TILE_SUB, TILE_LANE),
+                lambda i, k: (i, k, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, TILE_SUB, TILE_LANE), lambda i, k: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 5, TILE_SUB, TILE_LANE), lambda i, k: (i, 0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((r, 5, TILE_SUB, TILE_LANE), jnp.uint32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(words, nb)
+    # [R, 5, 8, 128] → [B, 5]
+    return jnp.transpose(state, (0, 2, 3, 1)).reshape(b, 5)
+
+
+def _auto_interpret() -> bool:
+    """Run the real Mosaic kernel on TPU-kind devices, interpret elsewhere."""
+    d = jax.devices()[0]
+    return "tpu" not in d.device_kind.lower() and d.platform not in ("tpu", "axon")
+
+
+def sha1_pieces_pallas(
+    data_u8: jax.Array, nblocks: jax.Array, interpret: bool | None = None
+) -> jax.Array:
+    """Batched SHA1 via the Pallas kernel; pads the batch to a TILE multiple.
+
+    Rows added by padding get ``nblocks=0`` (their chain never runs) and
+    are sliced off the result.
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    b = data_u8.shape[0]
+    bp = ((b + TILE - 1) // TILE) * TILE
+    if bp != b:
+        data_u8 = jnp.pad(data_u8, ((0, bp - b), (0, 0)))
+        nblocks = jnp.pad(nblocks, (0, bp - b))
+    out = _sha1_pallas_aligned(data_u8, nblocks, interpret)
+    return out[:b]

@@ -1,0 +1,26 @@
+from torrent_tpu.server.tracker import (
+    AnnounceRequest,
+    HttpAnnounceRequest,
+    HttpScrapeRequest,
+    ScrapeRequest,
+    ServeOptions,
+    TrackerServer,
+    UdpAnnounceRequest,
+    UdpScrapeRequest,
+    serve_tracker,
+)
+from torrent_tpu.server.in_memory import InMemoryTracker, run_tracker
+
+__all__ = [
+    "AnnounceRequest",
+    "ScrapeRequest",
+    "HttpAnnounceRequest",
+    "HttpScrapeRequest",
+    "UdpAnnounceRequest",
+    "UdpScrapeRequest",
+    "ServeOptions",
+    "TrackerServer",
+    "serve_tracker",
+    "InMemoryTracker",
+    "run_tracker",
+]

@@ -1,0 +1,200 @@
+"""Reference in-memory tracker (ref: server/in_memory_tracker.ts, 186 LoC).
+
+The policy layer over TrackerServer's transport stream: per-torrent swarm
+state, seeder/leecher accounting, random peer selection, idle sweeps.
+
+Deliberate fixes vs the reference (SURVEY §8.13):
+- ``random_selection`` cannot loop forever when the pool is exactly the
+  requester (in_memory_tracker.ts:42-50) — it samples from a materialized
+  candidate list.
+- Scrape returns stats for the hashes it knows and zeros for the ones it
+  doesn't, instead of rejecting the whole batch when any hash is unknown
+  (in_memory_tracker.ts:155-159).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass, field
+
+from torrent_tpu.net.constants import DEFAULT_ANNOUNCE_INTERVAL
+from torrent_tpu.net.types import AnnounceEvent, AnnouncePeer
+from torrent_tpu.server.tracker import (
+    AnnounceRequest,
+    ScrapeRequest,
+    ServeOptions,
+    TrackerServer,
+    serve_tracker,
+)
+
+PEER_TTL = 15 * 60  # evict peers idle > 15 min (in_memory_tracker.ts:16)
+SWEEP_INTERVAL = 15 * 60
+
+
+@dataclass
+class PeerState:
+    peer_id: bytes
+    ip: str
+    port: int
+    left: int
+    last_seen: float = field(default_factory=time.monotonic)
+
+    @property
+    def is_seeder(self) -> bool:
+        # seeder/leecher classification (in_memory_tracker.ts:23-28)
+        return self.left == 0
+
+
+@dataclass
+class FileInfo:
+    """Swarm state for one torrent (in_memory_tracker.ts:53-59)."""
+
+    complete: int = 0  # current seeders
+    downloaded: int = 0  # lifetime completions
+    incomplete: int = 0  # current leechers
+    peers: dict[bytes, PeerState] = field(default_factory=dict)
+
+
+class InMemoryTracker:
+    """Tracker policy over in-process maps; drive with handle()."""
+
+    def __init__(self, interval: int = DEFAULT_ANNOUNCE_INTERVAL):
+        self.interval = interval
+        self.files: dict[bytes, FileInfo] = {}
+
+    # ------------------------------------------------------------ helpers
+
+    def random_selection(self, info: FileInfo, exclude: bytes, n: int) -> list[AnnouncePeer]:
+        """Up to n random peers, excluding the requester (in_memory_tracker.ts:30-51)."""
+        candidates = [p for pid, p in info.peers.items() if pid != exclude]
+        if len(candidates) > n:
+            candidates = random.sample(candidates, n)
+        return [AnnouncePeer(ip=p.ip, port=p.port, peer_id=p.peer_id) for p in candidates]
+
+    # ------------------------------------------------------------ announce
+
+    async def handle_announce(self, req: AnnounceRequest) -> None:
+        """State update + response (in_memory_tracker.ts:79-143)."""
+        info = self.files.setdefault(req.info_hash, FileInfo())
+        prev = info.peers.get(req.peer_id)
+
+        if req.event == AnnounceEvent.STOPPED:
+            if prev is not None:
+                del info.peers[req.peer_id]
+                if prev.is_seeder:
+                    info.complete -= 1
+                else:
+                    info.incomplete -= 1
+            await req.respond(self.interval, info.complete, info.incomplete, [])
+            return
+
+        now_seeder = req.left == 0
+        if prev is None:
+            if now_seeder:
+                info.complete += 1
+            else:
+                info.incomplete += 1
+            if req.event == AnnounceEvent.COMPLETED and now_seeder:
+                info.downloaded += 1
+        else:
+            if prev.is_seeder != now_seeder:
+                if now_seeder:  # leecher → seeder promotion (:113-125)
+                    info.incomplete -= 1
+                    info.complete += 1
+                    info.downloaded += 1
+                else:
+                    info.complete -= 1
+                    info.incomplete += 1
+            elif req.event == AnnounceEvent.COMPLETED and now_seeder:
+                info.downloaded += 1
+
+        info.peers[req.peer_id] = PeerState(
+            peer_id=req.peer_id, ip=req.ip, port=req.port, left=req.left
+        )
+        peers = self.random_selection(info, req.peer_id, req.num_want)
+        await req.respond(self.interval, info.complete, info.incomplete, peers)
+
+    # ------------------------------------------------------------ scrape
+
+    async def handle_scrape(self, req: ScrapeRequest) -> None:
+        """(in_memory_tracker.ts:145-164); unknown hashes scrape as zeros."""
+        files = []
+        for h in req.info_hashes:
+            info = self.files.get(h)
+            if info is None:
+                files.append((h, 0, 0, 0))
+            else:
+                files.append((h, info.complete, info.downloaded, info.incomplete))
+        await req.respond(files)
+
+    # ------------------------------------------------------------ sweep
+
+    def sweep(self) -> int:
+        """Evict idle peers (in_memory_tracker.ts:61-77); returns evictions."""
+        cutoff = time.monotonic() - PEER_TTL
+        evicted = 0
+        for info in self.files.values():
+            for pid in [pid for pid, p in info.peers.items() if p.last_seen < cutoff]:
+                p = info.peers.pop(pid)
+                if p.is_seeder:
+                    info.complete -= 1
+                else:
+                    info.incomplete -= 1
+                evicted += 1
+        return evicted
+
+    # ------------------------------------------------------------ dispatch
+
+    async def handle(self, req) -> None:
+        if isinstance(req, AnnounceRequest):
+            await self.handle_announce(req)
+        elif isinstance(req, ScrapeRequest):
+            await self.handle_scrape(req)
+
+
+async def run_tracker(opts: ServeOptions | None = None) -> tuple[TrackerServer, asyncio.Task]:
+    """Serve + drive an InMemoryTracker (in_memory_tracker.ts:167-181).
+
+    Returns the server (for ports/close) and the pump task. The periodic
+    sweep rides the pump loop's timeout rather than a separate timer.
+    """
+    server = await serve_tracker(opts)
+    tracker = InMemoryTracker(interval=(opts.interval if opts else DEFAULT_ANNOUNCE_INTERVAL))
+
+    async def pump():
+        last_sweep = time.monotonic()
+        it = server.__aiter__()
+        while True:
+            try:
+                req = await asyncio.wait_for(it.__anext__(), timeout=60)
+            except asyncio.TimeoutError:
+                req = None
+            except StopAsyncIteration:
+                break
+            if req is not None:
+                try:
+                    await tracker.handle(req)
+                except Exception:
+                    pass  # one bad request must not kill the tracker
+            if time.monotonic() - last_sweep > SWEEP_INTERVAL:
+                tracker.sweep()
+                last_sweep = time.monotonic()
+
+    task = asyncio.create_task(pump())
+    task.tracker = tracker  # expose state for tests/stats
+    return server, task
+
+
+def main():  # pragma: no cover - manual entrypoint (in_memory_tracker.ts:183-186)
+    async def go():
+        server, task = await run_tracker(ServeOptions(http_port=8000, udp_port=6969))
+        print(f"tracker listening: http={server.http_port} udp={server.udp_port}")
+        await task
+
+    asyncio.run(go())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

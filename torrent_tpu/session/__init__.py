@@ -1,0 +1,5 @@
+from torrent_tpu.session.peer import PeerConnection
+from torrent_tpu.session.torrent import Torrent, TorrentState
+from torrent_tpu.session.client import Client, ClientConfig
+
+__all__ = ["PeerConnection", "Torrent", "TorrentState", "Client", "ClientConfig"]

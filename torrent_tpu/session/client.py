@@ -1,0 +1,154 @@
+"""Client: TCP listener, torrent registry, accept loop (ref L6: client.ts).
+
+Owns the listening socket and peer identity, routes inbound handshakes to
+torrents by info hash *before* replying so unknown torrents are dropped
+silently (client.ts:85-104), and shares one TPUVerifier across torrents
+when the 'tpu' hasher is selected.
+
+Fixed vs the reference: config defaults are copied per-instance instead
+of mutating a shared defaults object (client.ts:47, SURVEY §8.2), and the
+broken ``fileStorage`` import (§8.1) has no analogue — storage backends
+are injected explicitly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import string
+from dataclasses import dataclass, field
+
+from torrent_tpu.codec.metainfo import Metainfo
+from torrent_tpu.net import protocol as proto
+from torrent_tpu.session.torrent import Torrent, TorrentConfig
+from torrent_tpu.storage.storage import FsStorage, Storage, StorageMethod
+from torrent_tpu.utils.log import get_logger
+
+log = get_logger("session.client")
+
+PEER_ID_PREFIX = b"-TT0100-"  # torrent-tpu 0.1 (client.ts:19-31 analogue)
+
+
+def generate_peer_id() -> bytes:
+    suffix = "".join(random.choices(string.ascii_letters + string.digits, k=12))
+    return PEER_ID_PREFIX + suffix.encode("ascii")
+
+
+@dataclass
+class ClientConfig:
+    """(client.ts:13-23). Fresh instance per Client — never shared."""
+
+    port: int = 0  # 0 = ephemeral
+    host: str = "0.0.0.0"
+    peer_id: bytes = field(default_factory=generate_peer_id)
+    hasher: str = "cpu"  # 'cpu' | 'tpu' piece verification (BASELINE API)
+    torrent: TorrentConfig = field(default_factory=TorrentConfig)
+    enable_upnp: bool = False  # optional, off by default (SURVEY §7.8)
+
+
+class Client:
+    def __init__(self, config: ClientConfig | None = None):
+        self.config = config or ClientConfig()
+        self.config.torrent.hasher = self.config.hasher
+        self.torrents: dict[bytes, Torrent] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._verifier_cache: dict[int, object] = {}
+        self.external_ip: str | None = None
+        self.port: int | None = None  # assigned by start()
+
+    # ------------------------------------------------------------- startup
+
+    async def start(self) -> None:
+        """listen → learn real port → (optional UPnP) → accept loop
+        (client.ts:69-83)."""
+        self._server = await asyncio.start_server(
+            self._accept, self.config.host, self.config.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        if self.config.enable_upnp:
+            try:
+                from torrent_tpu.net.upnp import get_ip_addrs_and_map_port
+
+                ips = await get_ip_addrs_and_map_port(self.port)
+                self.external_ip = ips.external_ip
+            except Exception as e:  # UPnP is best-effort
+                log.warning("UPnP setup failed: %s", e)
+
+    async def close(self) -> None:
+        for torrent in list(self.torrents.values()):
+            await torrent.stop()
+        self.torrents.clear()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------ torrents
+
+    def _verifier_for(self, piece_length: int):
+        """One shared TPUVerifier per piece geometry (compiled once)."""
+        if self.config.hasher != "tpu":
+            return None
+        v = self._verifier_cache.get(piece_length)
+        if v is None:
+            from torrent_tpu.models.verifier import TPUVerifier
+
+            v = TPUVerifier(
+                piece_length=piece_length,
+                batch_size=self.config.torrent.verify_batch_size,
+            )
+            self._verifier_cache[piece_length] = v
+        return v
+
+    async def add(self, metainfo: Metainfo, storage: Storage | StorageMethod | str) -> Torrent:
+        """Register + start a torrent (client.ts:53-67).
+
+        ``storage`` may be a ready Storage, a StorageMethod, or a
+        directory path (convenience, mirrors `Client.add(metainfo, dir)`).
+        """
+        if self.port is None:
+            raise RuntimeError("Client.start() must be awaited before add()")
+        if metainfo.info_hash in self.torrents:
+            raise ValueError("torrent already added")
+        if isinstance(storage, str):
+            storage = Storage(FsStorage(storage), metainfo.info)
+        elif not isinstance(storage, Storage):
+            storage = Storage(storage, metainfo.info)
+        torrent = Torrent(
+            metainfo=metainfo,
+            storage=storage,
+            peer_id=self.config.peer_id,
+            port=self.port,
+            config=self.config.torrent,
+            verifier=self._verifier_for(metainfo.info.piece_length),
+        )
+        self.torrents[metainfo.info_hash] = torrent
+        await torrent.start()
+        return torrent
+
+    async def remove(self, info_hash: bytes) -> None:
+        torrent = self.torrents.pop(info_hash, None)
+        if torrent:
+            await torrent.stop()
+
+    # -------------------------------------------------------------- accept
+
+    async def _accept(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        """Inbound handshake: route on info hash before replying
+        (client.ts:85-104)."""
+        try:
+            info_hash = await asyncio.wait_for(proto.read_handshake_head(reader), timeout=15)
+            torrent = self.torrents.get(info_hash)
+            if torrent is None:
+                writer.close()  # unknown torrent: drop pre-reply
+                return
+            await proto.send_handshake(writer, info_hash, self.config.peer_id)
+            peer_id = await asyncio.wait_for(proto.read_handshake_peer_id(reader), timeout=15)
+            if peer_id == self.config.peer_id:
+                writer.close()
+                return
+            addr = writer.get_extra_info("peername")
+            await torrent.add_peer(
+                peer_id, reader, writer, address=tuple(addr[:2]) if addr else None
+            )
+        except (proto.ProtocolError, asyncio.TimeoutError, ConnectionError, OSError):
+            writer.close()

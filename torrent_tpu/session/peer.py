@@ -1,0 +1,65 @@
+"""Per-peer connection state (ref: peer.ts, 27 LoC — extended).
+
+The reference tracks the four BitTorrent state flags in spec-default
+position and a bitfield (peer.ts:17-25). A working leech/seed scheduler
+additionally needs per-peer in-flight request tracking, transfer
+accounting for the choke policy, and liveness timestamps.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+from torrent_tpu.utils.bitfield import Bitfield
+
+
+@dataclass
+class PeerConnection:
+    peer_id: bytes
+    reader: asyncio.StreamReader
+    writer: asyncio.StreamWriter
+    num_pieces: int
+    address: tuple[str, int] | None = None
+
+    # BEP 3 spec-default flag positions (peer.ts:17-20)
+    am_choking: bool = True
+    am_interested: bool = False
+    peer_choking: bool = True
+    peer_interested: bool = False
+
+    bitfield: Bitfield = None  # set in __post_init__
+    # blocks we've requested from this peer and not yet received
+    inflight: set[tuple[int, int, int]] = field(default_factory=set)
+
+    bytes_down: int = 0  # payload received from peer
+    bytes_up: int = 0  # payload sent to peer
+    _rate_mark: tuple[float, int] = (0.0, 0)  # (time, bytes_down) snapshot
+
+    last_rx: float = field(default_factory=time.monotonic)
+    last_tx: float = field(default_factory=time.monotonic)
+
+    def __post_init__(self):
+        if self.bitfield is None:
+            self.bitfield = Bitfield(self.num_pieces)
+
+    def download_rate(self) -> float:
+        """Bytes/sec since the last choke-policy snapshot."""
+        t0, b0 = self._rate_mark
+        dt = time.monotonic() - t0
+        if dt <= 0:
+            return 0.0
+        return (self.bytes_down - b0) / dt
+
+    def snapshot_rate(self) -> None:
+        self._rate_mark = (time.monotonic(), self.bytes_down)
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    def __repr__(self) -> str:
+        return f"PeerConnection({self.peer_id[:8]!r}, have={self.bitfield.count()}/{self.num_pieces})"

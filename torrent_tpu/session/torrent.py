@@ -1,0 +1,652 @@
+"""Torrent session: announce loop, peer loops, scheduler (ref L6: torrent.ts).
+
+The reference's torrent.ts stops at message handling — no piece picker,
+no choke policy, no verification, bitfield never updated (SURVEY §8.3).
+This is the completed design:
+
+- **announce loop** (torrent.ts:224-244): started/empty/completed events,
+  cancellable interval sleep with early wake (``request_peers``), live
+  uploaded/downloaded/left counters.
+- **scheduler**: rarest-first piece picking over peer availability with
+  random tie-break, per-peer request pipelining, endgame mode (duplicate
+  the last in-flight blocks, cancel on arrival).
+- **choke policy**: periodic round unchoking the top downloaders plus one
+  optimistic random peer (BEP 3 semantics).
+- **verification hook** (the gap at torrent.ts:183-193): pieces assemble
+  in memory, SHA1-verify off-thread (or batched on TPU via the hash
+  plane), and only verified pieces are written + ``have``-broadcast.
+- **resume-recheck**: ``start()`` runs ``verify_pieces`` (hasher
+  'cpu'|'tpu') to rebuild the bitfield before announcing — the subsystem
+  the reference lists as roadmap (README.md:34) and the BASELINE north
+  star.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import random
+import time
+from collections import Counter
+from dataclasses import dataclass, field
+from enum import Enum
+
+from torrent_tpu.codec.metainfo import Metainfo
+from torrent_tpu.net import protocol as proto
+from torrent_tpu.net.constants import DEFAULT_NUM_WANT
+from torrent_tpu.net.tracker import TrackerError, announce
+from torrent_tpu.net.types import AnnounceEvent, AnnounceInfo
+from torrent_tpu.session.peer import PeerConnection
+from torrent_tpu.storage.piece import (
+    BLOCK_SIZE,
+    piece_length,
+    validate_received_block,
+    validate_requested_block,
+)
+from torrent_tpu.storage.storage import Storage, StorageError
+from torrent_tpu.utils.bitfield import Bitfield
+from torrent_tpu.utils.log import get_logger
+
+log = get_logger("session.torrent")
+
+
+class TorrentState(Enum):
+    """(torrent.ts:39-43 — which the reference never advances, §8.3)."""
+
+    STOPPED = "stopped"
+    CHECKING = "checking"
+    DOWNLOADING = "downloading"
+    SEEDING = "seeding"
+
+
+@dataclass
+class _PartialPiece:
+    """A piece being assembled in memory before verification."""
+
+    index: int
+    length: int
+    buffer: bytearray
+    received: set[int] = field(default_factory=set)  # block offsets
+
+    @property
+    def complete(self) -> bool:
+        return len(self.received) * BLOCK_SIZE >= self.length
+
+
+@dataclass
+class TorrentConfig:
+    max_peers: int = 50
+    pipeline_depth: int = 16  # outstanding requests per peer
+    unchoke_slots: int = 3  # + 1 optimistic
+    choke_interval: float = 10.0
+    keepalive_interval: float = 100.0
+    peer_timeout: float = 240.0
+    announce_retry: float = 30.0
+    hasher: str = "cpu"  # 'cpu' | 'tpu' — resume-recheck + batch verify
+    verify_batch_size: int = 256
+
+
+class Torrent:
+    def __init__(
+        self,
+        metainfo: Metainfo,
+        storage: Storage,
+        peer_id: bytes,
+        port: int,
+        config: TorrentConfig | None = None,
+        verifier=None,  # optional TPUVerifier to share across torrents
+    ):
+        self.metainfo = metainfo
+        self.info = metainfo.info
+        self.storage = storage
+        self.peer_id = peer_id
+        self.port = port
+        self.config = config or TorrentConfig()
+        self.verifier = verifier
+
+        self.state = TorrentState.STOPPED
+        self.bitfield = Bitfield(self.info.num_pieces)
+        self.peers: dict[bytes, PeerConnection] = {}
+        self._partials: dict[int, _PartialPiece] = {}
+        self._tasks: set[asyncio.Task] = set()
+        self._wake = asyncio.Event()
+        self._stopping = False
+        self._endgame = False
+        self._pending_completed = False  # BEP 3 `completed` owed to tracker
+        self._dialing: set[tuple[str, int]] = set()
+        # Incremental scheduler state: per-piece availability counts, a
+        # rarity-ordered pick queue (rebuilt lazily when dirty), and a
+        # multiset of blocks in flight across all peers — keeps block
+        # ingest O(1)-ish instead of rescanning every peer bitfield.
+        self._avail = [0] * self.info.num_pieces
+        self._rarity_order: list[int] = []
+        self._rarity_dirty = True
+        self._inflight_count: Counter = Counter()
+
+        # live announce counters (fixed vs torrent.ts:66-69 which never
+        # updates them)
+        self.uploaded = 0
+        self.downloaded = 0
+        # random per-session announce key (torrent.ts:62-74)
+        self.key = random.randbytes(4)
+
+        self.on_complete: asyncio.Event = asyncio.Event()
+
+    # ----------------------------------------------------------- lifecycle
+
+    @property
+    def left(self) -> int:
+        have_bytes = sum(
+            piece_length(self.info, i) for i in range(self.info.num_pieces) if self.bitfield.has(i)
+        )
+        return max(0, self.info.length - have_bytes)
+
+    async def start(self) -> None:
+        """Recheck existing data, then join the swarm."""
+        self.state = TorrentState.CHECKING
+        await self.recheck()
+        self.state = TorrentState.SEEDING if self.bitfield.complete else TorrentState.DOWNLOADING
+        if self.bitfield.complete:
+            self.on_complete.set()
+        self._stopping = False
+        self._spawn(self._announce_loop(), name="announce")
+        self._spawn(self._choke_loop(), name="choke")
+        self._spawn(self._keepalive_loop(), name="keepalive")
+
+    def _spawn(self, coro, name=None) -> asyncio.Task:
+        """Track a task for teardown; completed tasks self-evict."""
+        task = asyncio.create_task(coro, name=name)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    async def recheck(self) -> None:
+        """Rebuild the bitfield by hashing what's on disk (resume path)."""
+        from torrent_tpu.parallel.verify import verify_pieces
+
+        if not any(
+            self.storage.method.exists(path) for path, _, _ in self.storage._files
+        ):
+            return  # nothing on disk, skip the scan
+        cfg = self.config
+        kwargs = {}
+        if cfg.hasher == "tpu":
+            kwargs = {"batch_size": cfg.verify_batch_size}
+            if self.verifier is not None:
+                ok = await asyncio.to_thread(
+                    self.verifier.verify_storage, self.storage, self.info
+                )
+                self._apply_recheck(ok)
+                return
+        ok = await asyncio.to_thread(
+            verify_pieces, self.storage, self.info, cfg.hasher, None, **kwargs
+        )
+        self._apply_recheck(ok)
+
+    def _apply_recheck(self, ok) -> None:
+        self.bitfield.from_numpy(ok)
+        self.storage.mark_pieces_written(i for i in range(len(ok)) if ok[i])
+        log.info(
+            "recheck: %d/%d pieces valid", self.bitfield.count(), self.info.num_pieces
+        )
+
+    async def stop(self) -> None:
+        self._stopping = True
+        tasks = list(self._tasks)
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        for peer in list(self.peers.values()):
+            peer.close()
+        self.peers.clear()
+        try:
+            await asyncio.wait_for(
+                announce(self.metainfo.announce, self._announce_info(AnnounceEvent.STOPPED)),
+                timeout=5,
+            )
+        except Exception:
+            pass  # best-effort goodbye
+        self.state = TorrentState.STOPPED
+
+    # ------------------------------------------------------------ announce
+
+    def _announce_info(self, event: AnnounceEvent) -> AnnounceInfo:
+        return AnnounceInfo(
+            info_hash=self.metainfo.info_hash,
+            peer_id=self.peer_id,
+            port=self.port,
+            uploaded=self.uploaded,
+            downloaded=self.downloaded,
+            left=self.left,
+            event=event,
+            num_want=DEFAULT_NUM_WANT if len(self.peers) < self.config.max_peers else 0,
+            key=self.key,
+        )
+
+    async def _announce_loop(self) -> None:
+        """(torrent.ts:224-244) with early wake via request_peers()."""
+        started_sent = False
+        while not self._stopping:
+            if not started_sent:
+                event = AnnounceEvent.STARTED
+            elif self._pending_completed:
+                event = AnnounceEvent.COMPLETED  # report the snatch (BEP 3)
+            else:
+                event = AnnounceEvent.EMPTY
+            interval = self.config.announce_retry
+            try:
+                res = await announce(self.metainfo.announce, self._announce_info(event))
+                if event == AnnounceEvent.STARTED:
+                    started_sent = True
+                elif event == AnnounceEvent.COMPLETED:
+                    self._pending_completed = False
+                interval = max(5, res.interval)
+                self._connect_new_peers(res.peers)
+            except TrackerError as e:
+                log.warning("announce failed: %s", e)
+            except Exception as e:
+                log.warning("announce error: %s", e)
+            self._wake.clear()
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout=interval)
+            except asyncio.TimeoutError:
+                pass
+
+    def request_peers(self) -> None:
+        """Early announce wake (torrent.ts:104-107)."""
+        self._wake.set()
+
+    # ------------------------------------------------------------- dialing
+
+    def _connect_new_peers(self, candidates) -> None:
+        """Outbound dials, deduped and capped (fixes SURVEY §8.14)."""
+        if self.state == TorrentState.SEEDING:
+            return  # seeds serve inbound connections; nothing to fetch
+        connected = {p.address for p in self.peers.values() if p.address}
+        for cand in candidates:
+            if len(self.peers) + len(self._dialing) >= self.config.max_peers:
+                break
+            addr = (cand.ip, cand.port)
+            if addr in connected or addr in self._dialing:
+                continue
+            if cand.peer_id == self.peer_id:
+                continue
+            self._dialing.add(addr)
+            self._spawn(self._dial(addr, cand.peer_id))
+
+    async def _dial(self, addr: tuple[str, int], expect_peer_id: bytes | None) -> None:
+        """connect/handshake/verify/register (torrent.ts:198-222)."""
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(addr[0], addr[1]), timeout=10
+            )
+        except (OSError, asyncio.TimeoutError):
+            self._dialing.discard(addr)
+            return
+        try:
+            await proto.send_handshake(writer, self.metainfo.info_hash, self.peer_id)
+            ih = await asyncio.wait_for(proto.read_handshake_head(reader), timeout=10)
+            pid = await asyncio.wait_for(proto.read_handshake_peer_id(reader), timeout=10)
+            if ih != self.metainfo.info_hash or (expect_peer_id and pid != expect_peer_id):
+                raise proto.ProtocolError("handshake mismatch")
+            if pid == self.peer_id:
+                raise proto.ProtocolError("connected to self")
+        except (proto.ProtocolError, asyncio.TimeoutError, OSError):
+            writer.close()
+            self._dialing.discard(addr)
+            return
+        self._dialing.discard(addr)
+        await self.add_peer(pid, reader, writer, address=addr)
+
+    # ------------------------------------------------------------ peer mgmt
+
+    async def add_peer(self, peer_id, reader, writer, address=None) -> None:
+        """Register + spawn the message loop (torrent.ts:79-102)."""
+        if peer_id in self.peers:
+            # Keep the established connection, close the duplicate — the
+            # reference overwrote the map entry and leaked the old socket
+            # (§8.14). Stale survivors die via the peer timeout.
+            writer.close()
+            return
+        if len(self.peers) >= self.config.max_peers:
+            writer.close()
+            return
+        peer = PeerConnection(
+            peer_id=peer_id,
+            reader=reader,
+            writer=writer,
+            num_pieces=self.info.num_pieces,
+            address=address,
+        )
+        self.peers[peer_id] = peer
+        proto.send_bitfield(writer, self.bitfield)
+        peer.snapshot_rate()
+        self._spawn(self._peer_loop(peer), name=f"peer-{peer_id[:8].hex()}")
+
+    def _drop_peer(self, peer: PeerConnection) -> None:
+        """Teardown on loop exit (torrent.ts:88-99) + reschedule its blocks."""
+        peer.close()
+        if self.peers.get(peer.peer_id) is peer:
+            del self.peers[peer.peer_id]
+        for i in range(self.info.num_pieces):
+            if peer.bitfield.has(i):
+                self._avail[i] -= 1
+        self._rarity_dirty = True
+        self._release_inflight(peer)
+
+    def _release_inflight(self, peer: PeerConnection) -> None:
+        for blk in peer.inflight:
+            if self._inflight_count[blk] > 0:
+                self._inflight_count[blk] -= 1
+        peer.inflight.clear()
+
+    # ------------------------------------------------------- message loop
+
+    async def _peer_loop(self, peer: PeerConnection) -> None:
+        """All nine message handlers (torrent.ts:114-196, completed)."""
+        try:
+            while not self._stopping:
+                msg = await asyncio.wait_for(
+                    proto.read_message(peer.reader), timeout=self.config.peer_timeout
+                )
+                if msg is None:
+                    break
+                peer.last_rx = time.monotonic()
+                await self._handle_message(peer, msg)
+        except (proto.ProtocolError, asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        finally:
+            self._drop_peer(peer)
+
+    async def _handle_message(self, peer: PeerConnection, msg) -> None:
+        match msg:
+            case proto.KeepAlive():
+                pass
+            case proto.Choke():
+                peer.peer_choking = True
+                self._release_inflight(peer)  # choke voids outstanding requests
+            case proto.Unchoke():
+                peer.peer_choking = False
+                await self._fill_pipeline(peer)
+            case proto.Interested():
+                peer.peer_interested = True
+            case proto.NotInterested():
+                peer.peer_interested = False
+            case proto.Have(index):
+                if 0 <= index < self.info.num_pieces:
+                    if not peer.bitfield.has(index):
+                        peer.bitfield.set(index)
+                        self._avail[index] += 1
+                        self._rarity_dirty = True
+                    await self._update_interest(peer)
+            case proto.BitfieldMsg(raw):
+                for i in range(self.info.num_pieces):
+                    if peer.bitfield.has(i):
+                        self._avail[i] -= 1
+                try:
+                    peer.bitfield = Bitfield(self.info.num_pieces, raw)
+                except ValueError:
+                    raise proto.ProtocolError("bad bitfield")
+                for i in range(self.info.num_pieces):
+                    if peer.bitfield.has(i):
+                        self._avail[i] += 1
+                self._rarity_dirty = True
+                await self._update_interest(peer)
+            case proto.Request(index, begin, length):
+                await self._serve_request(peer, index, begin, length)
+            case proto.Piece(index, begin, block):
+                await self._ingest_block(peer, index, begin, block)
+            case proto.Cancel(index, begin, length):
+                pass  # we serve requests synchronously; nothing queued to cancel
+
+    # ------------------------------------------------------------- leeching
+
+    async def _update_interest(self, peer: PeerConnection) -> None:
+        want = any(
+            peer.bitfield.has(i)
+            for i in self.bitfield.missing()
+        )
+        if want and not peer.am_interested:
+            peer.am_interested = True
+            await proto.send_message(peer.writer, proto.Interested())
+        elif not want and peer.am_interested:
+            peer.am_interested = False
+            await proto.send_message(peer.writer, proto.NotInterested())
+        if want and not peer.peer_choking:
+            await self._fill_pipeline(peer)
+
+    def _rebuild_rarity(self) -> None:
+        """Missing pieces ordered rarest-first with a stable random tiebreak."""
+        missing = list(self.bitfield.missing())
+        jitter = {i: random.random() for i in missing}
+        missing.sort(key=lambda i: (self._avail[i], jitter[i]))
+        self._rarity_order = missing
+        self._rarity_dirty = False
+
+    def _blocks_of(self, index: int):
+        plen = piece_length(self.info, index)
+        for begin in range(0, plen, BLOCK_SIZE):
+            yield (index, begin, min(BLOCK_SIZE, plen - begin))
+
+    def _missing_blocks(self, index: int):
+        partial = self._partials.get(index)
+        for blk in self._blocks_of(index):
+            if partial is not None and blk[1] in partial.received:
+                continue
+            yield blk
+
+    async def _fill_pipeline(self, peer: PeerConnection) -> None:
+        """Rarest-first picking + pipelining; endgame duplication."""
+        if peer.peer_choking or self.bitfield.complete:
+            return
+        budget = self.config.pipeline_depth - len(peer.inflight)
+        if budget <= 0:
+            return
+        wanted: list[tuple[int, int, int]] = []
+
+        def take_from(index: int) -> bool:
+            for blk in self._missing_blocks(index):
+                if self._inflight_count[blk] > 0 or blk in peer.inflight:
+                    continue
+                wanted.append(blk)
+                if len(wanted) >= budget:
+                    return True
+            return False
+
+        # Prefer finishing partial pieces, then rarest-first fresh pieces.
+        for index in list(self._partials):
+            if peer.bitfield.has(index) and not self.bitfield.has(index):
+                if take_from(index):
+                    break
+        if len(wanted) < budget:
+            if self._rarity_dirty:
+                self._rebuild_rarity()
+            for index in self._rarity_order:
+                if (
+                    self.bitfield.has(index)
+                    or index in self._partials
+                    or not peer.bitfield.has(index)
+                ):
+                    continue
+                if take_from(index):
+                    break
+
+        if not wanted:
+            # Endgame: everything missing is in flight somewhere — duplicate
+            # requests so one slow peer can't stall completion.
+            remaining = [
+                blk
+                for i in self.bitfield.missing()
+                if peer.bitfield.has(i)
+                for blk in self._missing_blocks(i)
+                if blk not in peer.inflight
+            ]
+            if not remaining:
+                return
+            self._endgame = True
+            random.shuffle(remaining)
+            wanted = remaining[:budget]
+
+        for blk in wanted:
+            peer.inflight.add(blk)
+            self._inflight_count[blk] += 1
+            await proto.send_message(peer.writer, proto.Request(*blk))
+
+    async def _ingest_block(self, peer: PeerConnection, index, begin, block) -> None:
+        """(torrent.ts:183-193) + assembly, verification, have broadcast."""
+        if not validate_received_block(self.info, index, begin, len(block)):
+            raise proto.ProtocolError("invalid piece block geometry")
+        blk = (index, begin, len(block))
+        if blk in peer.inflight:
+            peer.inflight.discard(blk)
+            if self._inflight_count[blk] > 0:
+                self._inflight_count[blk] -= 1
+        peer.bytes_down += len(block)
+        if self.bitfield.has(index):
+            return  # duplicate from endgame
+        partial = self._partials.get(index)
+        if partial is None:
+            partial = self._partials[index] = _PartialPiece(
+                index=index,
+                length=piece_length(self.info, index),
+                buffer=bytearray(piece_length(self.info, index)),
+            )
+        if begin in partial.received:
+            return
+        partial.buffer[begin : begin + len(block)] = block
+        partial.received.add(begin)
+        self.downloaded += len(block)
+
+        if self._endgame:
+            await self._cancel_everywhere((index, begin, len(block)), except_peer=peer)
+
+        if partial.complete:
+            await self._finish_piece(partial)
+        await self._fill_pipeline(peer)
+
+    async def _cancel_everywhere(self, blk, except_peer) -> None:
+        for p in self.peers.values():
+            if p is except_peer or blk not in p.inflight:
+                continue
+            p.inflight.discard(blk)
+            if self._inflight_count[blk] > 0:
+                self._inflight_count[blk] -= 1
+            try:
+                await proto.send_message(p.writer, proto.Cancel(*blk))
+            except (ConnectionError, OSError):
+                pass
+
+    async def _finish_piece(self, partial: _PartialPiece) -> None:
+        """Verify → persist → have-broadcast (the §8.3 missing hook)."""
+        del self._partials[partial.index]
+        data = bytes(partial.buffer)
+        expected = self.info.pieces[partial.index]
+        digest = await asyncio.to_thread(lambda: hashlib.sha1(data).digest())
+        if digest != expected:
+            log.warning("piece %d failed verification; re-requesting", partial.index)
+            self.downloaded -= partial.length  # don't count poisoned data
+            return
+        base = partial.index * self.info.piece_length
+        try:
+            await asyncio.to_thread(self._write_piece, base, data)
+        except StorageError as e:
+            log.error("failed to persist piece %d: %s", partial.index, e)
+            return
+        self.bitfield.set(partial.index)
+        for p in self.peers.values():
+            try:
+                await proto.send_message(p.writer, proto.Have(index=partial.index))
+            except (ConnectionError, OSError):
+                pass
+            if p.am_interested:
+                await self._update_interest(p)
+        if self.bitfield.complete:
+            self.state = TorrentState.SEEDING
+            self._endgame = False
+            self._pending_completed = True
+            self.on_complete.set()
+            self.request_peers()  # announce `completed` promptly
+
+    def _write_piece(self, base: int, data: bytes) -> None:
+        for off in range(0, len(data), BLOCK_SIZE):
+            self.storage.set(base + off, data[off : off + BLOCK_SIZE])
+
+    # ------------------------------------------------------------- seeding
+
+    async def _serve_request(self, peer: PeerConnection, index, begin, length) -> None:
+        """request handler (torrent.ts:158-176), gated on our choke state."""
+        if peer.am_choking:
+            return  # spec: ignore requests while choking
+        if not validate_requested_block(self.info, index, begin, length):
+            raise proto.ProtocolError("invalid request")
+        if not self.bitfield.has(index):
+            return
+        try:
+            block = await asyncio.to_thread(
+                self.storage.get, index * self.info.piece_length + begin, length
+            )
+        except StorageError as e:
+            log.error("serving piece %d failed: %s", index, e)
+            return
+        await proto.send_message(peer.writer, proto.Piece(index, begin, block))
+        peer.bytes_up += length
+        self.uploaded += length
+        peer.last_tx = time.monotonic()
+
+    # ---------------------------------------------------------- choke loop
+
+    async def _choke_loop(self) -> None:
+        """Unchoke top downloaders + one optimistic random (BEP 3)."""
+        optimistic: bytes | None = None
+        rounds = 0
+        while not self._stopping:
+            await asyncio.sleep(self.config.choke_interval)
+            peers = list(self.peers.values())
+            interested = [p for p in peers if p.peer_interested]
+            interested.sort(key=lambda p: p.download_rate(), reverse=True)
+            unchoke = set(id(p) for p in interested[: self.config.unchoke_slots])
+            if rounds % 3 == 0 or optimistic not in self.peers:
+                rest = [p for p in interested[self.config.unchoke_slots :]]
+                optimistic = random.choice(rest).peer_id if rest else None
+            if optimistic in self.peers:
+                unchoke.add(id(self.peers[optimistic]))
+            for p in peers:
+                should_unchoke = id(p) in unchoke
+                try:
+                    if should_unchoke and p.am_choking:
+                        p.am_choking = False
+                        await proto.send_message(p.writer, proto.Unchoke())
+                    elif not should_unchoke and not p.am_choking:
+                        p.am_choking = True
+                        await proto.send_message(p.writer, proto.Choke())
+                except (ConnectionError, OSError):
+                    pass
+                p.snapshot_rate()
+            rounds += 1
+
+    async def _keepalive_loop(self) -> None:
+        while not self._stopping:
+            await asyncio.sleep(self.config.keepalive_interval)
+            for p in list(self.peers.values()):
+                try:
+                    await proto.send_message(p.writer, proto.KeepAlive())
+                except (ConnectionError, OSError):
+                    self._drop_peer(p)
+
+    # ------------------------------------------------------------- status
+
+    def status(self) -> dict:
+        return {
+            "state": self.state.value,
+            "pieces": f"{self.bitfield.count()}/{self.info.num_pieces}",
+            "peers": len(self.peers),
+            "downloaded": self.downloaded,
+            "uploaded": self.uploaded,
+            "left": self.left,
+            "endgame": self._endgame,
+        }

@@ -211,8 +211,9 @@ class FsStorage:
     def get(self, path: tuple[str, ...], offset: int, length: int) -> bytes:
         f = self._open_read(path)
         try:
-            with self._lock:
-                data = os.pread(f.fileno(), length, offset)
+            # pread is positional and atomic — no lock needed; the lock
+            # only guards the handle cache in _open_read.
+            data = os.pread(f.fileno(), length, offset)
         except (OSError, ValueError) as e:
             raise StorageError(f"read failed from {path}: {e}") from e
         if len(data) != length:

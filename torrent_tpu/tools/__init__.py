@@ -1,0 +1,3 @@
+from torrent_tpu.tools.make_torrent import make_torrent
+
+__all__ = ["make_torrent"]

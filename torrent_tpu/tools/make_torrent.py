@@ -1,0 +1,198 @@
+""".torrent authoring (ref L7: tools/make_torrent.ts, 250 LoC).
+
+The reference's only compute-bound path: read every piece, SHA1 it, emit
+the metainfo (tools/make_torrent.ts:115-174). Differences by design:
+
+- **Batched hashing**: pieces accumulate into batches and hash through
+  the device hash plane (``TPUVerifier.hash_pieces``) or hashlib
+  (``hasher='cpu'``) — the reference pipelines per-piece WebCrypto
+  promises (tools/make_torrent.ts:96-111); we pipeline whole batches.
+- Same piece-length heuristic: power of two, 32 KiB–1 MiB, targeting
+  ~1000 pieces (tools/make_torrent.ts:18-33).
+- Multi-file pieces span file boundaries via a carry buffer
+  (tools/make_torrent.ts:62-113) — here a single streaming reader over
+  the concatenated file list.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterator
+
+from torrent_tpu.codec.bencode import bencode
+
+MIN_PIECE_LEN = 32 * 1024
+MAX_PIECE_LEN = 1024 * 1024
+TARGET_PIECES = 1000
+
+
+def choose_piece_length(total_size: int) -> int:
+    """Power of 2 in [32 KiB, 1 MiB] targeting ~1000 pieces
+    (tools/make_torrent.ts:18-33)."""
+    target = max(1, total_size // TARGET_PIECES)
+    plen = MIN_PIECE_LEN
+    while plen < target and plen < MAX_PIECE_LEN:
+        plen *= 2
+    return plen
+
+
+def collect_files(root: str) -> list[tuple[str, int]]:
+    """Deterministic walk → [(relpath, size)] (tools/make_torrent.ts:35-60)."""
+    out: list[tuple[str, int]] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            out.append((os.path.relpath(full, root), os.path.getsize(full)))
+    return out
+
+
+def _iter_pieces(paths: list[str], piece_len: int) -> Iterator[bytes]:
+    """Stream fixed-size pieces across file boundaries (the carry-buffer
+    loop of tools/make_torrent.ts:62-113, as a generator)."""
+    carry = bytearray()
+    for path in paths:
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(max(piece_len, 1 << 20))
+                if not chunk:
+                    break
+                carry += chunk
+                while len(carry) >= piece_len:
+                    yield bytes(carry[:piece_len])
+                    del carry[:piece_len]
+    if carry:
+        yield bytes(carry)
+
+
+@dataclass
+class _Hasher:
+    """Batched piece hasher: hashlib or the TPU hash plane."""
+
+    hasher: str = "cpu"
+    piece_length: int = MIN_PIECE_LEN
+    batch_size: int = 256
+    _verifier: object = None
+
+    def digests(self, pieces: Iterator[bytes], progress: Callable | None = None) -> list[bytes]:
+        if self.hasher == "cpu":
+            import hashlib
+
+            out = []
+            for i, p in enumerate(pieces):
+                out.append(hashlib.sha1(p).digest())
+                if progress and (i + 1) % 64 == 0:
+                    progress(i + 1)
+            return out
+        if self.hasher == "tpu":
+            from torrent_tpu.models.verifier import TPUVerifier
+
+            if self._verifier is None:
+                self._verifier = TPUVerifier(
+                    piece_length=self.piece_length, batch_size=self.batch_size
+                )
+            out = []
+            batch: list[bytes] = []
+            for p in pieces:
+                batch.append(p)
+                if len(batch) >= self.batch_size:
+                    out.extend(self._verifier.hash_pieces(batch))
+                    batch.clear()
+                    if progress:
+                        progress(len(out))
+            if batch:
+                out.extend(self._verifier.hash_pieces(batch))
+            return out
+        raise ValueError(f"unknown hasher {self.hasher!r}")
+
+
+def make_torrent(
+    path: str,
+    tracker: str,
+    comment: str | None = None,
+    piece_length: int | None = None,
+    hasher: str = "cpu",
+    progress: Callable | None = None,
+) -> bytes:
+    """Author a .torrent for a file or directory (tools/make_torrent.ts:115).
+
+    Returns the bencoded metainfo bytes; caller writes them where it wants.
+    """
+    path = os.fspath(path)
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    is_dir = os.path.isdir(path)
+    name = os.path.basename(os.path.abspath(path))
+
+    if is_dir:
+        files = collect_files(path)
+        if not files:
+            raise ValueError(f"directory {path!r} contains no files")
+        total = sum(size for _, size in files)
+        abs_paths = [os.path.join(path, rel) for rel, _ in files]
+    else:
+        total = os.path.getsize(path)
+        abs_paths = [path]
+
+    plen = piece_length or choose_piece_length(total)
+    hasher_obj = _Hasher(hasher=hasher, piece_length=plen)
+    digests = hasher_obj.digests(_iter_pieces(abs_paths, plen), progress)
+
+    info: dict = {
+        b"name": name.encode("utf-8"),
+        b"piece length": plen,
+        b"pieces": b"".join(digests),
+    }
+    if is_dir:
+        info[b"files"] = [
+            {b"length": size, b"path": [c.encode("utf-8") for c in rel.split(os.sep)]}
+            for rel, size in files
+        ]
+    else:
+        info[b"length"] = total
+
+    top: dict = {b"announce": tracker.encode("utf-8"), b"info": info}
+    if comment:
+        top[b"comment"] = comment.encode("utf-8")
+    top[b"creation date"] = int(time.time())
+    top[b"created by"] = b"torrent-tpu/0.1"
+    return bencode(top)
+
+
+def main(argv: list[str] | None = None) -> int:  # pragma: no cover - CLI shell
+    """argv CLI with a \\r progress line (tools/make_torrent.ts:190-250)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="make_torrent", description=__doc__)
+    parser.add_argument("path", help="file or directory to share")
+    parser.add_argument("tracker", help="announce URL")
+    parser.add_argument("-o", "--output", help="output .torrent path")
+    parser.add_argument("-c", "--comment")
+    parser.add_argument("--piece-length", type=int)
+    parser.add_argument("--hasher", choices=("cpu", "tpu"), default="cpu")
+    args = parser.parse_args(argv)
+
+    def progress(n):
+        sys.stderr.write(f"\rhashed {n} pieces...")
+        sys.stderr.flush()
+
+    data = make_torrent(
+        args.path,
+        args.tracker,
+        comment=args.comment,
+        piece_length=args.piece_length,
+        hasher=args.hasher,
+        progress=progress,
+    )
+    out = args.output or (os.path.basename(os.path.abspath(args.path)) + ".torrent")
+    with open(out, "wb") as f:
+        f.write(data)
+    sys.stderr.write(f"\rwrote {out} ({len(data)} bytes)\n")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""Piece bitfield (the reference sizes one per peer, peer.ts:25).
+
+BEP 3 bit order: bit 0 of byte 0 is piece 0, MSB-first within each byte.
+Spare bits in the final byte must be zero on the wire.
+"""
+
+from __future__ import annotations
+
+
+class Bitfield:
+    __slots__ = ("n", "_bytes")
+
+    def __init__(self, n: int, data: bytes | None = None):
+        self.n = n
+        nbytes = (n + 7) // 8
+        if data is None:
+            self._bytes = bytearray(nbytes)
+        else:
+            if len(data) != nbytes:
+                raise ValueError(f"bitfield needs {nbytes} bytes for {n} pieces, got {len(data)}")
+            if n % 8 and data[-1] & ((1 << (8 - n % 8)) - 1):
+                raise ValueError("bitfield has spare bits set")
+            self._bytes = bytearray(data)
+
+    def __len__(self) -> int:
+        return self.n
+
+    def has(self, i: int) -> bool:
+        if not 0 <= i < self.n:
+            raise IndexError(i)
+        return bool(self._bytes[i >> 3] & (0x80 >> (i & 7)))
+
+    def set(self, i: int, value: bool = True) -> None:
+        if not 0 <= i < self.n:
+            raise IndexError(i)
+        if value:
+            self._bytes[i >> 3] |= 0x80 >> (i & 7)
+        else:
+            self._bytes[i >> 3] &= ~(0x80 >> (i & 7)) & 0xFF
+
+    def count(self) -> int:
+        return sum(bin(b).count("1") for b in self._bytes)
+
+    @property
+    def complete(self) -> bool:
+        return self.count() == self.n
+
+    def to_bytes(self) -> bytes:
+        return bytes(self._bytes)
+
+    def missing(self):
+        """Indices not yet set."""
+        return (i for i in range(self.n) if not self.has(i))
+
+    def from_numpy(self, arr) -> None:
+        """Bulk-load from a bool array (the verify plane's output)."""
+        if len(arr) != self.n:
+            raise ValueError("array length mismatch")
+        for i, v in enumerate(arr):
+            self.set(i, bool(v))
+
+    def __repr__(self) -> str:
+        return f"Bitfield({self.count()}/{self.n})"

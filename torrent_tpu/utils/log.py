@@ -1,0 +1,30 @@
+"""Structured per-subsystem loggers.
+
+The reference has no observability beyond stray console.logs and ~20
+`// TODO log` sites (SURVEY §5); here every subsystem logs under the
+``torrent_tpu.*`` hierarchy so applications can filter per layer.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_ROOT = "torrent_tpu"
+_configured = False
+
+
+def get_logger(subsystem: str) -> logging.Logger:
+    global _configured
+    if not _configured:
+        level = os.environ.get("TORRENT_TPU_LOG", "WARNING").upper()
+        logger = logging.getLogger(_ROOT)
+        if not logger.handlers:
+            handler = logging.StreamHandler()
+            handler.setFormatter(
+                logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+            )
+            logger.addHandler(handler)
+        logger.setLevel(level if level in logging._nameToLevel else "WARNING")
+        _configured = True
+    return logging.getLogger(f"{_ROOT}.{subsystem}")

@@ -25,6 +25,22 @@ import time
 import numpy as np
 
 
+def _tpu_reachable(timeout: float = 180.0) -> bool:
+    """Probe device init in a subprocess — a wedged TPU tunnel hangs
+    ``jax.devices()`` indefinitely, which must not take the bench with it."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     total_mb = int(os.environ.get("BENCH_TOTAL_MB", "1024"))
     batch = int(os.environ.get("BENCH_BATCH", "1024"))
@@ -57,6 +73,13 @@ def main() -> None:
     # honor an explicit platform request (e.g. BENCH_PLATFORM=cpu) so the
     # bench can run where the operator points it.
     plat = os.environ.get("BENCH_PLATFORM")
+    if not plat and not _tpu_reachable():
+        print(
+            "# WARNING: TPU device init unreachable (tunnel down?); "
+            "falling back to CPU platform — vs_baseline will understate TPU speedup",
+            file=sys.stderr,
+        )
+        plat = "cpu"
     if plat:
         jax.config.update("jax_platforms", plat)
 

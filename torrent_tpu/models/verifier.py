@@ -108,11 +108,17 @@ class TPUVerifier:
         self, padded: np.ndarray, nblocks: np.ndarray, expected_words: np.ndarray
     ) -> np.ndarray:
         """bool[B]: does each padded row hash to its expected digest words."""
-        return np.asarray(self._verify_step(padded, nblocks, expected_words))
+        from torrent_tpu.utils.trace import maybe_profile_batch
+
+        with maybe_profile_batch("sha1_verify_batch"):
+            return np.asarray(self._verify_step(padded, nblocks, expected_words))
 
     def digest_batch(self, padded: np.ndarray, nblocks: np.ndarray) -> np.ndarray:
         """uint32[B, 5] big-endian digest words for each row."""
-        return np.asarray(self._digest_step(padded, nblocks))
+        from torrent_tpu.utils.trace import maybe_profile_batch
+
+        with maybe_profile_batch("sha1_digest_batch"):
+            return np.asarray(self._digest_step(padded, nblocks))
 
     # ------------------------------------------------------------ authoring
 

@@ -1,0 +1,78 @@
+"""Multitracker support, BEP 12 (reference roadmap item, README.md:37).
+
+``announce-list`` is a list of tiers; each tier a list of tracker URLs.
+Per BEP 12: shuffle within each tier once, try tiers in order and URLs
+within a tier in order, and promote a responding tracker to the front of
+its tier so it's tried first next time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from torrent_tpu.net.tracker import TrackerError, announce
+from torrent_tpu.net.types import AnnounceInfo, AnnounceResponse
+from torrent_tpu.utils.log import get_logger
+
+log = get_logger("net.multitracker")
+
+
+def parse_announce_list(raw: dict) -> list[list[str]] | None:
+    """Extract tiers from a decoded metainfo top-level dict."""
+    tiers_raw = raw.get(b"announce-list")
+    if not isinstance(tiers_raw, list):
+        return None
+    tiers: list[list[str]] = []
+    for tier_raw in tiers_raw:
+        if not isinstance(tier_raw, list):
+            continue
+        tier = [
+            url.decode("utf-8", "replace") for url in tier_raw if isinstance(url, bytes)
+        ]
+        if tier:
+            tiers.append(tier)
+    return tiers or None
+
+
+class TrackerList:
+    """Tiered tracker rotation state for one torrent."""
+
+    def __init__(self, announce_url: str, tiers: list[list[str]] | None = None):
+        if tiers:
+            self.tiers = [list(t) for t in tiers]
+            for tier in self.tiers:
+                random.shuffle(tier)  # BEP 12: shuffle once at load
+            # the single `announce` field is the fallback tier if absent
+            if not any(announce_url in tier for tier in self.tiers):
+                self.tiers.append([announce_url])
+        else:
+            self.tiers = [[announce_url]]
+
+    def urls(self):
+        for tier in self.tiers:
+            for url in list(tier):
+                yield tier, url
+
+    def promote(self, tier: list[str], url: str) -> None:
+        """Move a responding tracker to its tier's front (BEP 12)."""
+        try:
+            tier.remove(url)
+        except ValueError:
+            return
+        tier.insert(0, url)
+
+    async def announce(self, info: AnnounceInfo) -> AnnounceResponse:
+        """Try every tracker in tier order; first success wins."""
+        last_err: Exception | None = None
+        for tier, url in self.urls():
+            try:
+                res = await announce(url, info)
+            except (TrackerError, OSError, asyncio.TimeoutError) as e:
+                # any single-tracker failure must not abort the rotation
+                log.debug("tracker %s failed: %s", url, e)
+                last_err = e
+                continue
+            self.promote(tier, url)
+            return res
+        raise TrackerError(f"all trackers failed; last error: {last_err}")

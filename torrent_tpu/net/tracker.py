@@ -335,9 +335,12 @@ async def _udp_call(
     attempts = max_attempts if max_attempts is not None else UDP_MAX_ATTEMPTS
 
     loop = asyncio.get_running_loop()
-    transport, proto = await loop.create_datagram_endpoint(
-        _UdpRpc, remote_addr=(host, port)
-    )
+    try:
+        transport, proto = await loop.create_datagram_endpoint(
+            _UdpRpc, remote_addr=(host, port)
+        )
+    except OSError as e:  # DNS failure / unroutable host must be retryable
+        raise TrackerError(f"UDP tracker unreachable: {e}") from e
     addr = None  # connected socket: sendto uses default peer
     try:
         last_err: Exception | None = None

@@ -104,27 +104,38 @@ def soap_envelope(action: str, args: dict[str, str]) -> bytes:
     ).encode("utf-8")
 
 
-async def _soap_call(control_url: str, action: str, args: dict[str, str]) -> bytes:
+async def _soap_call(
+    control_url: str, action: str, args: dict[str, str], timeout: float = 10.0
+) -> bytes:
     parts = urlsplit(control_url)
     host = parts.hostname or ""
     port = parts.port or 80
     body = soap_envelope(action, args)
-    reader, writer = await asyncio.open_connection(host, port)
+
+    async def go() -> bytes:
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            head = (
+                f"POST {parts.path or '/'} HTTP/1.1\r\nHost: {host}:{port}\r\n"
+                f'SOAPAction: "{WAN_SERVICE}#{action}"\r\n'
+                "Content-Type: text/xml\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+            )
+            writer.write(head.encode("latin-1") + body)
+            await writer.drain()
+            response = await reader.read()
+            if b"200" not in response.split(b"\r\n", 1)[0]:
+                raise UpnpError(f"SOAP {action} failed: {response[:200]!r}")
+            return response
+        finally:
+            writer.close()
+
     try:
-        head = (
-            f"POST {parts.path or '/'} HTTP/1.1\r\nHost: {host}:{port}\r\n"
-            f'SOAPAction: "{WAN_SERVICE}#{action}"\r\n'
-            "Content-Type: text/xml\r\n"
-            f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
-        )
-        writer.write(head.encode("latin-1") + body)
-        await writer.drain()
-        response = await reader.read()
-        if b"200" not in response.split(b"\r\n", 1)[0]:
-            raise UpnpError(f"SOAP {action} failed: {response[:200]!r}")
-        return response
-    finally:
-        writer.close()
+        # half-broken router firmware loves accepting connections and
+        # never answering; a stalled gateway must not hang Client.start()
+        return await asyncio.wait_for(go(), timeout)
+    except asyncio.TimeoutError:
+        raise UpnpError(f"SOAP {action} timed out after {timeout}s")
 
 
 def get_internal_ip(probe_host: str = "8.8.8.8") -> str:

@@ -1,5 +1,6 @@
 from torrent_tpu.parallel.mesh import make_mesh, batch_sharding, replicated_sharding
 from torrent_tpu.parallel.verify import verify_pieces, VerifyResult
+from torrent_tpu.parallel.bulk import verify_library, LibraryResult
 
 __all__ = [
     "make_mesh",
@@ -7,4 +8,6 @@ __all__ = [
     "replicated_sharding",
     "verify_pieces",
     "VerifyResult",
+    "verify_library",
+    "LibraryResult",
 ]

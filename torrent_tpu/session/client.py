@@ -44,6 +44,7 @@ class ClientConfig:
     hasher: str = "cpu"  # 'cpu' | 'tpu' piece verification (BASELINE API)
     torrent: TorrentConfig = field(default_factory=TorrentConfig)
     enable_upnp: bool = False  # optional, off by default (SURVEY §7.8)
+    resume: bool = True  # fastresume checkpoints for path-based storage
 
 
 class Client:
@@ -109,7 +110,12 @@ class Client:
             raise RuntimeError("Client.start() must be awaited before add()")
         if metainfo.info_hash in self.torrents:
             raise ValueError("torrent already added")
+        resume_store = None
         if isinstance(storage, str):
+            if self.config.resume:
+                from torrent_tpu.session.resume import FsResumeStore
+
+                resume_store = FsResumeStore(storage)
             storage = Storage(FsStorage(storage), metainfo.info)
         elif not isinstance(storage, Storage):
             storage = Storage(storage, metainfo.info)
@@ -120,6 +126,7 @@ class Client:
             port=self.port,
             config=self.config.torrent,
             verifier=self._verifier_for(metainfo.info.piece_length),
+            resume_store=resume_store,
         )
         self.torrents[metainfo.info_hash] = torrent
         await torrent.start()

@@ -34,7 +34,7 @@ from enum import Enum
 from torrent_tpu.codec.metainfo import Metainfo
 from torrent_tpu.net import protocol as proto
 from torrent_tpu.net.constants import DEFAULT_NUM_WANT
-from torrent_tpu.net.tracker import TrackerError, announce
+from torrent_tpu.net.tracker import TrackerError
 from torrent_tpu.net.types import AnnounceEvent, AnnounceInfo
 from torrent_tpu.session.peer import PeerConnection
 from torrent_tpu.storage.piece import (
@@ -95,7 +95,10 @@ class Torrent:
         port: int,
         config: TorrentConfig | None = None,
         verifier=None,  # optional TPUVerifier to share across torrents
+        resume_store=None,  # optional session/resume.py store
     ):
+        from torrent_tpu.net.multitracker import TrackerList, parse_announce_list
+
         self.metainfo = metainfo
         self.info = metainfo.info
         self.storage = storage
@@ -103,6 +106,10 @@ class Torrent:
         self.port = port
         self.config = config or TorrentConfig()
         self.verifier = verifier
+        self.resume_store = resume_store
+        self.trackers = TrackerList(
+            metainfo.announce, parse_announce_list(metainfo.raw)
+        )
 
         self.state = TorrentState.STOPPED
         self.bitfield = Bitfield(self.info.num_pieces)
@@ -142,9 +149,10 @@ class Torrent:
         return max(0, self.info.length - have_bytes)
 
     async def start(self) -> None:
-        """Recheck existing data, then join the swarm."""
+        """Resume from checkpoint or recheck existing data, then join."""
         self.state = TorrentState.CHECKING
-        await self.recheck()
+        if not self._try_fastresume():
+            await self.recheck()
         self.state = TorrentState.SEEDING if self.bitfield.complete else TorrentState.DOWNLOADING
         if self.bitfield.complete:
             self.on_complete.set()
@@ -159,6 +167,66 @@ class Torrent:
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
         return task
+
+    def _try_fastresume(self) -> bool:
+        """Load a fastresume checkpoint; False → caller runs full recheck.
+
+        Claimed pieces are sanity-checked against file existence (not
+        content — that's what ``recheck`` is for; a stale checkpoint at
+        worst serves bad pieces which peers' own verification rejects).
+        """
+        if self.resume_store is None:
+            return False
+        rd = self.resume_store.load(self.metainfo.info_hash)
+        if rd is None or rd.num_pieces != self.info.num_pieces:
+            return False
+        try:
+            bf = Bitfield(self.info.num_pieces, rd.bitfield)
+        except ValueError:
+            return False
+        if bf.count() > 0:
+            # each claimed piece's files must exist AND reach the extent
+            # that piece needs — a crash-truncated file fails here and
+            # falls back to the full recheck
+            needed_extent: dict[tuple, int] = {}
+            for i in range(self.info.num_pieces):
+                if bf.has(i):
+                    for path, foff, chunk in self.storage.segments(
+                        i * self.info.piece_length, piece_length(self.info, i)
+                    ):
+                        needed_extent[path] = max(needed_extent.get(path, 0), foff + chunk)
+            if not all(
+                self.storage.method.exists(p, length)
+                for p, length in needed_extent.items()
+            ):
+                return False
+        self.bitfield = bf
+        self._rarity_dirty = True
+        self.storage.mark_pieces_written(
+            i for i in range(self.info.num_pieces) if bf.has(i)
+        )
+        self.uploaded = rd.uploaded
+        self.downloaded = rd.downloaded
+        log.info("fastresume: %d/%d pieces", bf.count(), self.info.num_pieces)
+        return True
+
+    def _checkpoint(self) -> None:
+        if self.resume_store is None:
+            return
+        from torrent_tpu.session.resume import ResumeData
+
+        try:
+            self.resume_store.save(
+                ResumeData(
+                    info_hash=self.metainfo.info_hash,
+                    num_pieces=self.info.num_pieces,
+                    bitfield=self.bitfield.to_bytes(),
+                    uploaded=self.uploaded,
+                    downloaded=self.downloaded,
+                )
+            )
+        except OSError as e:
+            log.warning("checkpoint save failed: %s", e)
 
     async def recheck(self) -> None:
         """Rebuild the bitfield by hashing what's on disk (resume path)."""
@@ -204,9 +272,10 @@ class Torrent:
         for peer in list(self.peers.values()):
             peer.close()
         self.peers.clear()
+        self._checkpoint()
         try:
             await asyncio.wait_for(
-                announce(self.metainfo.announce, self._announce_info(AnnounceEvent.STOPPED)),
+                self.trackers.announce(self._announce_info(AnnounceEvent.STOPPED)),
                 timeout=5,
             )
         except Exception:
@@ -240,7 +309,7 @@ class Torrent:
                 event = AnnounceEvent.EMPTY
             interval = self.config.announce_retry
             try:
-                res = await announce(self.metainfo.announce, self._announce_info(event))
+                res = await self.trackers.announce(self._announce_info(event))
                 if event == AnnounceEvent.STARTED:
                     started_sent = True
                 elif event == AnnounceEvent.COMPLETED:
@@ -385,16 +454,19 @@ class Torrent:
                         self._rarity_dirty = True
                     await self._update_interest(peer)
             case proto.BitfieldMsg(raw):
-                for i in range(self.info.num_pieces):
-                    if peer.bitfield.has(i):
-                        self._avail[i] -= 1
                 try:
-                    peer.bitfield = Bitfield(self.info.num_pieces, raw)
+                    new_bf = Bitfield(self.info.num_pieces, raw)
                 except ValueError:
+                    # construct-before-decrement: a bad bitfield must leave
+                    # availability untouched (drop-peer will decrement the
+                    # old one exactly once)
                     raise proto.ProtocolError("bad bitfield")
                 for i in range(self.info.num_pieces):
                     if peer.bitfield.has(i):
+                        self._avail[i] -= 1
+                    if new_bf.has(i):
                         self._avail[i] += 1
+                peer.bitfield = new_bf
                 self._rarity_dirty = True
                 await self._update_interest(peer)
             case proto.Request(index, begin, length):
@@ -558,6 +630,8 @@ class Torrent:
             log.error("failed to persist piece %d: %s", partial.index, e)
             return
         self.bitfield.set(partial.index)
+        if self.bitfield.count() % 16 == 0:
+            self._checkpoint()  # periodic progress checkpoint
         for p in self.peers.values():
             try:
                 await proto.send_message(p.writer, proto.Have(index=partial.index))
@@ -569,6 +643,7 @@ class Torrent:
             self.state = TorrentState.SEEDING
             self._endgame = False
             self._pending_completed = True
+            self._checkpoint()
             self.on_complete.set()
             self.request_peers()  # announce `completed` promptly
 

@@ -1,0 +1,57 @@
+"""Profiling hooks around the hash plane (SURVEY §5: reference has none).
+
+Set ``TORRENT_TPU_PROFILE=/some/dir`` to capture a ``jax.profiler`` trace
+of the first verify/digest launches (viewable in XProf/TensorBoard);
+``annotate()`` scopes named regions so batches are attributable in the
+timeline either way.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from torrent_tpu.utils.log import get_logger
+
+log = get_logger("trace")
+
+_trace_dir = os.environ.get("TORRENT_TPU_PROFILE")
+_trace_started = False
+_trace_done = False  # capture happens once; later batches run unprofiled
+_batches_to_trace = int(os.environ.get("TORRENT_TPU_PROFILE_BATCHES", "8"))
+_batches_seen = 0
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region in the device timeline (no-op off-device)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def maybe_profile_batch(name: str):
+    """Profile the first N hash batches when TORRENT_TPU_PROFILE is set."""
+    global _trace_started, _batches_seen, _trace_done
+    import jax
+
+    if _trace_dir is None or _trace_done:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+        return
+    if not _trace_started:
+        jax.profiler.start_trace(_trace_dir)
+        _trace_started = True
+        log.info("profiler trace started → %s", _trace_dir)
+    _batches_seen += 1
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        if _batches_seen >= _batches_to_trace and _trace_started:
+            jax.profiler.stop_trace()
+            _trace_started = False
+            _trace_done = True
+            log.info("profiler trace stopped after %d batches", _batches_seen)

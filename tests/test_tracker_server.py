@@ -181,7 +181,17 @@ class TestInMemoryTrackerUnit:
 
         info = FileInfo(complete=1, incomplete=1)
         fresh = PeerState(peer_id=b"f" * 20, ip="1.1.1.1", port=1, left=5)
-        stale = PeerState(peer_id=b"s" * 20, ip="2.2.2.2", port=2, left=0, last_seen=0.0)
+        # Clearly past the TTL regardless of how recently the host booted
+        # (monotonic clocks start near 0 on fresh VMs, so last_seen=0.0 can
+        # still be "fresh" when uptime < PEER_TTL).
+        import time as _time
+
+        from torrent_tpu.server.in_memory import PEER_TTL
+
+        stale = PeerState(
+            peer_id=b"s" * 20, ip="2.2.2.2", port=2, left=0,
+            last_seen=_time.monotonic() - PEER_TTL - 1,
+        )
         info.peers = {b"f" * 20: fresh, b"s" * 20: stale}
         t.files[H1] = info
         assert t.sweep() == 1

@@ -62,12 +62,20 @@ class TrackerList:
             return
         tier.insert(0, url)
 
-    async def announce(self, info: AnnounceInfo) -> AnnounceResponse:
-        """Try every tracker in tier order; first success wins."""
+    async def announce(
+        self, info: AnnounceInfo, per_tracker_timeout: float = 45.0
+    ) -> AnnounceResponse:
+        """Try every tracker in tier order; first success wins.
+
+        Each tracker gets at most ``per_tracker_timeout`` seconds before the
+        rotation moves on — otherwise a single dead UDP tracker would hold
+        the announce loop for its full BEP 15 retry ladder (8 attempts at
+        15·2ⁿ s ≈ an hour) while later tiers sit untried.
+        """
         last_err: Exception | None = None
         for tier, url in self.urls():
             try:
-                res = await announce(url, info)
+                res = await asyncio.wait_for(announce(url, info), per_tracker_timeout)
             except (TrackerError, OSError, asyncio.TimeoutError) as e:
                 # any single-tracker failure must not abort the rotation
                 log.debug("tracker %s failed: %s", url, e)

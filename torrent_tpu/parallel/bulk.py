@@ -58,10 +58,14 @@ def verify_library(
     total_bytes = sum(info.length for _, info in items)
 
     if hasher == "cpu":
+        done_pieces = 0
         for i, (storage, info) in enumerate(items):
             bitfields[i] = verify_pieces_cpu(storage, info)
+            done_pieces += info.num_pieces
             if progress_cb:
-                progress_cb(i + 1, len(items))
+                # same (pieces_done, pieces_total) contract as the tpu path
+                # and parallel/verify.py's ProgressCb
+                progress_cb(done_pieces, total_pieces)
         return LibraryResult(
             bitfields, total_pieces, total_bytes, time.perf_counter() - t0
         )

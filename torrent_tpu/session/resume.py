@@ -63,9 +63,21 @@ class ResumeData:
                 uploaded=d[b"uploaded"],
                 downloaded=d[b"downloaded"],
             )
-        except (KeyError, TypeError):
+        except KeyError:
+            return None
+        # Field types are attacker-controlled (bdecode gives int|bytes|...);
+        # any type confusion means a corrupt checkpoint → full recheck.
+        if not (
+            isinstance(rd.info_hash, bytes)
+            and isinstance(rd.num_pieces, int)
+            and isinstance(rd.bitfield, bytes)
+            and isinstance(rd.uploaded, int)
+            and isinstance(rd.downloaded, int)
+        ):
             return None
         if len(rd.info_hash) != 20 or rd.num_pieces < 0:
+            return None
+        if rd.uploaded < 0 or rd.downloaded < 0:
             return None
         try:
             Bitfield(rd.num_pieces, rd.bitfield)

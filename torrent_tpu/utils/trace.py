@@ -22,6 +22,21 @@ _batches_to_trace = int(os.environ.get("TORRENT_TPU_PROFILE_BATCHES", "8"))
 _batches_seen = 0
 
 
+def _flush_trace() -> None:
+    """Stop an open trace (idempotent); registered atexit once started."""
+    global _trace_started, _trace_done
+    if _trace_started:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _trace_started = False
+        _trace_done = True
+        log.info("profiler trace flushed at exit")
+
+
 @contextlib.contextmanager
 def annotate(name: str):
     """Named region in the device timeline (no-op off-device)."""
@@ -44,6 +59,11 @@ def maybe_profile_batch(name: str):
     if not _trace_started:
         jax.profiler.start_trace(_trace_dir)
         _trace_started = True
+        # Runs with fewer than N batches would otherwise exit with the
+        # trace open and unflushed — close it at interpreter exit.
+        import atexit
+
+        atexit.register(_flush_trace)
         log.info("profiler trace started → %s", _trace_dir)
     _batches_seen += 1
     try:

@@ -1,0 +1,236 @@
+"""Magnet links + BEP 10 extension protocol + BEP 9 ut_metadata tests.
+
+Covers the reference's unchecked "Magnet Links" roadmap item
+(README.md:39): URI parsing, extension wire codec, metadata assembly,
+and a full e2e magnet join against a live seeding client.
+"""
+
+import asyncio
+import hashlib
+
+import numpy as np
+import pytest
+
+from torrent_tpu.codec.bencode import bencode
+from torrent_tpu.codec.magnet import Magnet, MagnetError, parse_magnet
+from torrent_tpu.codec.metainfo import metainfo_from_info_bytes, parse_metainfo
+from torrent_tpu.net import extension as ext
+from torrent_tpu.session.client import Client, ClientConfig, generate_peer_id
+from torrent_tpu.session.metadata import MetadataError, fetch_metadata
+from torrent_tpu.session.torrent import TorrentConfig, TorrentState
+from torrent_tpu.storage.storage import MemoryStorage, Storage
+
+from test_session import build_torrent_bytes, fast_config, run
+
+IH = bytes(range(20))
+
+
+class TestMagnetParse:
+    def test_hex(self):
+        m = parse_magnet(f"magnet:?xt=urn:btih:{IH.hex()}")
+        assert m.info_hash == IH and m.display_name is None and m.trackers == ()
+
+    def test_base32(self):
+        import base64
+
+        b32 = base64.b32encode(IH).decode()
+        assert parse_magnet(f"magnet:?xt=urn:btih:{b32}").info_hash == IH
+
+    def test_full(self):
+        uri = (
+            f"magnet:?xt=urn:btih:{IH.hex()}&dn=My%20File"
+            "&tr=http%3A%2F%2Ft1%2Fannounce&tr=udp%3A%2F%2Ft2%3A6969"
+            "&x.pe=127.0.0.1:6881&x.pe=[::1]:6882"
+        )
+        m = parse_magnet(uri)
+        assert m.display_name == "My File"
+        assert m.trackers == ("http://t1/announce", "udp://t2:6969")
+        assert m.peer_addrs == (("127.0.0.1", 6881), ("::1", 6882))
+
+    def test_roundtrip(self):
+        m = Magnet(IH, "x y", ("http://t/a",), (("10.0.0.1", 51413),))
+        assert parse_magnet(m.to_uri()) == m
+
+    @pytest.mark.parametrize(
+        "uri",
+        [
+            "http://not-magnet",
+            "magnet:?dn=nohash",
+            "magnet:?xt=urn:btih:zz",
+            f"magnet:?xt=urn:btih:{IH.hex()}&x.pe=noport",
+            f"magnet:?xt=urn:btih:{IH.hex()}&x.pe=h:0",
+        ],
+    )
+    def test_malformed(self, uri):
+        with pytest.raises(MagnetError):
+            parse_magnet(uri)
+
+
+class TestExtensionCodec:
+    def test_reserved_bit(self):
+        r = ext.extension_reserved()
+        assert ext.supports_extensions(r)
+        assert not ext.supports_extensions(b"\x00" * 8)
+        assert not ext.supports_extensions(b"")
+
+    def test_extended_handshake_roundtrip(self):
+        payload = ext.encode_extended_handshake(metadata_size=12345, version="tt/0.1")
+        st = ext.ExtensionState(enabled=True)
+        ext.decode_extended_handshake(payload, st)
+        assert st.handshaken and st.metadata_size == 12345
+        # our side advertises ut_metadata id 1
+        assert st.ut_metadata_id == ext.LOCAL_EXT_IDS[ext.UT_METADATA]
+
+    def test_bad_handshake_degrades(self):
+        st = ext.ExtensionState(enabled=True)
+        ext.decode_extended_handshake(b"garbage", st)
+        assert not st.handshaken and st.ut_metadata_id == 0
+
+    def test_metadata_message_framing(self):
+        data = b"\xde\xad" * 100
+        payload = ext.encode_metadata_data(piece=0, total_size=200, data=data)
+        mm = ext.decode_metadata_message(payload)
+        assert mm.msg_type == ext.MsgType.DATA and mm.piece == 0
+        assert mm.total_size == 200 and mm.data == data
+        req = ext.decode_metadata_message(ext.encode_metadata_request(3))
+        assert req.msg_type == ext.MsgType.REQUEST and req.piece == 3
+        rej = ext.decode_metadata_message(ext.encode_metadata_reject(7))
+        assert rej.msg_type == ext.MsgType.REJECT and rej.piece == 7
+        assert ext.decode_metadata_message(b"not bencode") is None
+
+    def test_assembler_multi_piece(self):
+        blob = np.random.default_rng(3).integers(0, 256, size=40_000, dtype=np.uint8).tobytes()
+        ih = hashlib.sha1(blob).digest()
+        asm = ext.MetadataAssembler(len(blob))
+        assert asm.n_pieces == 3 and asm.missing() == [0, 1, 2]
+        for i in (2, 0, 1):  # out of order
+            piece = ext.metadata_piece(blob, i)
+            asm.add(ext.MetadataMessage(ext.MsgType.DATA, i, len(blob), piece))
+        assert asm.complete
+        assert asm.result(ih) == blob
+
+    def test_assembler_rejects_poison(self):
+        blob = b"x" * 1000
+        asm = ext.MetadataAssembler(len(blob))
+        asm.add(ext.MetadataMessage(ext.MsgType.DATA, 0, len(blob), b"y" * 1000))
+        assert asm.complete
+        assert asm.result(hashlib.sha1(blob).digest()) is None
+        assert not asm.complete  # cleared for refetch
+
+    def test_assembler_wrong_sizes(self):
+        asm = ext.MetadataAssembler(ext.METADATA_PIECE_SIZE + 10)
+        # non-final piece must be exactly 16 KiB
+        assert not asm.add(ext.MetadataMessage(ext.MsgType.DATA, 0, 0, b"short"))
+        # out-of-range piece index
+        assert not asm.add(ext.MetadataMessage(ext.MsgType.DATA, 9, 0, b"x" * 10))
+        with pytest.raises(ValueError):
+            ext.MetadataAssembler(0)
+
+
+class TestMetainfoFromInfoBytes:
+    def test_roundtrip_hash(self):
+        data = build_torrent_bytes(b"p" * 1000, 512, b"http://t/a")
+        m = parse_metainfo(data)
+        from torrent_tpu.codec.bencode import bdecode
+
+        info_bytes = bencode(bdecode(data)[b"info"], sort_keys=False)
+        assert hashlib.sha1(info_bytes).digest() == m.info_hash
+        m2 = metainfo_from_info_bytes(info_bytes, announce="http://t/a")
+        assert m2 is not None
+        assert m2.info_hash == m.info_hash
+        assert m2.info == m.info
+
+    def test_garbage(self):
+        assert metainfo_from_info_bytes(b"nonsense") is None
+
+
+class TestMagnetE2E:
+    def test_magnet_join_and_download(self):
+        """Leech knows only the magnet URI + seeder address (x.pe); it must
+        fetch the info dict over ut_metadata, then download and verify."""
+
+        async def go():
+            rng = np.random.default_rng(7)
+            payload = rng.integers(0, 256, size=150_000, dtype=np.uint8).tobytes()
+            torrent_bytes = build_torrent_bytes(
+                payload, 32768, b"http://127.0.0.1:1/announce", name=b"magnet-e2e"
+            )
+            m = parse_metainfo(torrent_bytes)
+
+            seed = Client(ClientConfig(host="127.0.0.1"))
+            leech = Client(ClientConfig(host="127.0.0.1"))
+            seed.config.torrent = fast_config()
+            leech.config.torrent = fast_config()
+            await seed.start()
+            await leech.start()
+            try:
+                seed_storage = Storage(MemoryStorage(), m.info)
+                for off in range(0, len(payload), 65536):
+                    seed_storage.set(off, payload[off : off + 65536])
+                t_seed = await seed.add(m, seed_storage)
+                assert t_seed.state == TorrentState.SEEDING
+
+                magnet = Magnet(
+                    info_hash=m.info_hash,
+                    display_name="magnet-e2e",
+                    peer_addrs=(("127.0.0.1", seed.port),),
+                )
+                t_leech = await leech.add_magnet(
+                    magnet, Storage(MemoryStorage(), m.info)
+                )
+                assert t_leech.metainfo.info_hash == m.info_hash
+                assert t_leech.info.name == "magnet-e2e"
+                await asyncio.wait_for(t_leech.on_complete.wait(), timeout=30)
+                assert t_leech.storage.get(0, len(payload)) == payload
+            finally:
+                await seed.close()
+                await leech.close()
+
+        run(go())
+
+    def test_trackerless_torrent_has_no_announce_loop(self):
+        """x.pe-only magnet → empty TrackerList → no announce task hammering
+        an empty URL (review finding)."""
+        from torrent_tpu.net.multitracker import TrackerList
+
+        assert not TrackerList("")
+        assert not TrackerList("", [["", ""]])
+        assert TrackerList("http://t/a")
+
+        async def go():
+            data = build_torrent_bytes(b"q" * 1000, 512, b"http://x/a")
+            from torrent_tpu.codec.bencode import bdecode
+
+            info_bytes = bencode(bdecode(data)[b"info"], sort_keys=False)
+            mi = metainfo_from_info_bytes(info_bytes)  # announce=""
+            from torrent_tpu.session.torrent import Torrent
+
+            t = Torrent(
+                metainfo=mi,
+                storage=Storage(MemoryStorage(), mi.info),
+                peer_id=generate_peer_id(),
+                port=6881,
+                config=fast_config(),
+            )
+            await t.start()
+            names = {task.get_name() for task in t._tasks}
+            assert "announce" not in names and "choke" in names
+            await t.stop()
+
+        run(go())
+
+    def test_magnet_no_sources(self):
+        async def go():
+            magnet = Magnet(info_hash=IH)
+            with pytest.raises(MetadataError, match="no reachable peer sources"):
+                await fetch_metadata(magnet, peer_id=generate_peer_id())
+
+        run(go())
+
+    def test_magnet_dead_peer(self):
+        async def go():
+            magnet = Magnet(info_hash=IH, peer_addrs=(("127.0.0.1", 1),))
+            with pytest.raises(MetadataError, match="all metadata sources failed"):
+                await fetch_metadata(magnet, peer_id=generate_peer_id(), peer_timeout=1.0)
+
+        run(go())

@@ -70,13 +70,12 @@ class TestHandshake:
             server, (cr, cw), (sr, sw) = await loopback()
             await send_handshake(cw, INFO_HASH, PEER_A)
             # accept side routes on the hash before replying
-            ih = await read_handshake_head(sr)
-            assert ih == INFO_HASH
+            ih, reserved = await read_handshake_head(sr)
+            assert ih == INFO_HASH and reserved == b"\x00" * 8
             await send_handshake(sw, INFO_HASH, PEER_B)
             pid = await read_handshake_peer_id(sr)
             assert pid == PEER_A
-            ih2 = await read_handshake_head(cr)
-            pid2 = await read_handshake_peer_id(cr)
+            (ih2, _), pid2 = await read_handshake_head(cr), await read_handshake_peer_id(cr)
             assert ih2 == INFO_HASH and pid2 == PEER_B
             cw.close(); sw.close(); server.close()
 

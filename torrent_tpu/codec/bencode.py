@@ -104,6 +104,17 @@ def bdecode(data: bytes | bytearray | memoryview, strict: bool = True):
     return value
 
 
+def bdecode_prefix(data: bytes | bytearray | memoryview):
+    """Decode one value from the head of ``data``; return ``(value, end)``.
+
+    ``end`` is the number of bytes consumed. Needed by BEP 9 ut_metadata
+    framing, where a bencoded dict is immediately followed by raw piece
+    bytes that are not part of the dict.
+    """
+    buf = bytes(data)
+    return _decode_at(buf, 0)
+
+
 def bdecode_with_info_span(data: bytes | bytearray | memoryview):
     """Decode a top-level dict, also returning the byte span of ``info``.
 

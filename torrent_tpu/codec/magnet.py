@@ -1,0 +1,91 @@
+"""Magnet URI parsing (BEP 9 §magnet — a reference roadmap item).
+
+The reference lists "Magnet Links" unchecked (README.md:39); this module
+plus ``net/extension.py`` (BEP 10 extension protocol + ut_metadata) and
+``session/metadata.py`` (the fetch driver) complete it: a client can join
+a swarm from ``magnet:?xt=urn:btih:...`` alone and learn the info dict
+from its peers.
+
+Supported fields: ``xt`` (btih, 40-hex or 32-base32), ``dn`` display
+name, ``tr`` tracker URLs (repeatable), ``x.pe`` direct peer addresses
+(repeatable, BEP 9 extension used by several clients for trackerless
+bootstrap).
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+from dataclasses import dataclass, field
+from urllib.parse import parse_qs, urlparse
+
+
+class MagnetError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Magnet:
+    info_hash: bytes  # 20 raw bytes
+    display_name: str | None = None
+    trackers: tuple[str, ...] = ()
+    peer_addrs: tuple[tuple[str, int], ...] = field(default_factory=tuple)
+
+    def to_uri(self) -> str:
+        parts = [f"magnet:?xt=urn:btih:{self.info_hash.hex()}"]
+        if self.display_name:
+            from urllib.parse import quote
+
+            parts.append(f"dn={quote(self.display_name)}")
+        for tr in self.trackers:
+            from urllib.parse import quote
+
+            parts.append(f"tr={quote(tr, safe='')}")
+        for host, port in self.peer_addrs:
+            parts.append(f"x.pe={host}:{port}")
+        return "&".join(parts)
+
+
+def _decode_btih(value: str) -> bytes:
+    if len(value) == 40:
+        try:
+            return binascii.unhexlify(value)
+        except binascii.Error as e:
+            raise MagnetError(f"bad hex info hash {value!r}") from e
+    if len(value) == 32:
+        try:
+            return base64.b32decode(value.upper())
+        except binascii.Error as e:
+            raise MagnetError(f"bad base32 info hash {value!r}") from e
+    raise MagnetError(f"info hash must be 40 hex or 32 base32 chars, got {value!r}")
+
+
+def parse_magnet(uri: str) -> Magnet:
+    """Parse a magnet URI; raises ``MagnetError`` on anything malformed."""
+    parsed = urlparse(uri)
+    if parsed.scheme != "magnet":
+        raise MagnetError(f"not a magnet URI: {uri!r}")
+    params = parse_qs(parsed.query)
+    info_hash = None
+    for xt in params.get("xt", []):
+        if xt.startswith("urn:btih:"):
+            info_hash = _decode_btih(xt[len("urn:btih:") :])
+            break
+    if info_hash is None:
+        raise MagnetError("magnet URI has no urn:btih exact topic")
+    peers: list[tuple[str, int]] = []
+    for pe in params.get("x.pe", []):
+        host, _, port_s = pe.rpartition(":")
+        try:
+            port = int(port_s)
+        except ValueError as e:
+            raise MagnetError(f"bad x.pe address {pe!r}") from e
+        if not host or not 0 < port < 65536:
+            raise MagnetError(f"bad x.pe address {pe!r}")
+        peers.append((host.strip("[]"), port))
+    return Magnet(
+        info_hash=info_hash,
+        display_name=params["dn"][0] if params.get("dn") else None,
+        trackers=tuple(params.get("tr", [])),
+        peer_addrs=tuple(peers),
+    )

@@ -19,7 +19,7 @@ import hashlib
 from dataclasses import dataclass, field
 
 from torrent_tpu.codec import valid
-from torrent_tpu.codec.bencode import BencodeError, bdecode_with_info_span
+from torrent_tpu.codec.bencode import BencodeError, bdecode, bdecode_with_info_span
 from torrent_tpu.utils.bytesio import partition
 
 SHA1_LEN = 20
@@ -167,3 +167,27 @@ def parse_metainfo(data: bytes) -> Metainfo | None:
         info_hash=info_hash,
         raw=decoded,
     )
+
+
+def metainfo_from_info_bytes(
+    info_bytes: bytes, announce: str = "", announce_list: list[list[str]] | None = None
+) -> Metainfo | None:
+    """Build a full ``Metainfo`` from a bare serialized info dict.
+
+    The magnet-link path (BEP 9): after ut_metadata delivers the verified
+    info-dict bytes, wrap them in a minimal torrent envelope. The
+    re-encode of the decoded dict is byte-exact (decode preserves key
+    order), so the computed ``info_hash`` matches ``sha1(info_bytes)``.
+    """
+    from torrent_tpu.codec.bencode import bencode
+
+    envelope: dict = {b"announce": announce.encode("utf-8")}
+    if announce_list:
+        envelope[b"announce-list"] = [
+            [t.encode("utf-8") for t in tier] for tier in announce_list
+        ]
+    try:
+        envelope[b"info"] = bdecode(info_bytes)
+    except BencodeError:
+        return None
+    return parse_metainfo(bencode(envelope, sort_keys=False))

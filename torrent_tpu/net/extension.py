@@ -1,0 +1,213 @@
+"""BEP 10 extension protocol + BEP 9 ut_metadata metadata exchange.
+
+The reference stops at the nine BEP 3 messages (protocol.ts:69-161) and
+lists magnet links as roadmap (README.md:39). This module supplies the
+wire layer that makes them work:
+
+- **BEP 10**: reserved-bit 20 in the handshake advertises support; message
+  id 20 carries ``(ext_id, bencoded payload)``. Ext id 0 is the extended
+  handshake ``{m: {name: id, ...}, metadata_size?, v?}`` through which
+  peers agree on ids for concrete extensions.
+- **BEP 9 (ut_metadata)**: the info dict, serialized, split into 16 KiB
+  pieces, exchanged via ``{msg_type: request(0)|data(1)|reject(2),
+  piece: n}`` dicts; a ``data`` payload is the dict immediately followed
+  by the raw piece bytes. The fetched blob is SHA1-verified against the
+  magnet's info hash before use.
+
+The session layer (session/torrent.py) serves ut_metadata requests from
+any torrent with a full metainfo, so every seeder is a metadata provider;
+session/metadata.py drives the fetching side for magnet joins.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from dataclasses import dataclass
+
+from torrent_tpu.codec.bencode import BencodeError, bdecode, bdecode_prefix, bencode
+
+# BEP 9: metadata is exchanged in 16 KiB pieces.
+METADATA_PIECE_SIZE = 16 * 1024
+# Upper bound we'll accept for a peer-advertised metadata_size: a 64 MiB
+# info dict is far beyond any real torrent (multi-TB torrents with tiny
+# pieces stay under ~10 MiB of piece hashes).
+MAX_METADATA_SIZE = 64 * 1024 * 1024
+
+# Extended-handshake message names → our local ext ids. Id 0 is reserved
+# for the handshake itself by BEP 10.
+UT_METADATA = b"ut_metadata"
+LOCAL_EXT_IDS = {UT_METADATA: 1}
+
+# Reserved-byte mask: bit 20 counting from the MSB of the 8-byte field,
+# i.e. byte 5, value 0x10 (BEP 10).
+EXTENSION_RESERVED_BYTE = 5
+EXTENSION_RESERVED_BIT = 0x10
+
+
+class MsgType:
+    """ut_metadata msg_type values (BEP 9)."""
+
+    REQUEST = 0
+    DATA = 1
+    REJECT = 2
+
+
+def supports_extensions(reserved: bytes) -> bool:
+    return len(reserved) == 8 and bool(reserved[EXTENSION_RESERVED_BYTE] & EXTENSION_RESERVED_BIT)
+
+
+def extension_reserved() -> bytes:
+    r = bytearray(8)
+    r[EXTENSION_RESERVED_BYTE] |= EXTENSION_RESERVED_BIT
+    return bytes(r)
+
+
+@dataclass
+class ExtensionState:
+    """Per-peer BEP 10 negotiation state."""
+
+    enabled: bool = False  # peer set reserved bit 20
+    handshaken: bool = False  # we received their ext handshake
+    ut_metadata_id: int = 0  # peer's id for ut_metadata (0 = unsupported)
+    metadata_size: int = 0  # peer-advertised info-dict size in bytes
+
+
+def encode_extended_handshake(metadata_size: int | None = None, version: str = "") -> bytes:
+    """Payload for extended message id 0 (our side of the negotiation)."""
+    d: dict = {b"m": {name: eid for name, eid in LOCAL_EXT_IDS.items()}}
+    if metadata_size is not None:
+        d[b"metadata_size"] = metadata_size
+    if version:
+        d[b"v"] = version.encode()
+    return bencode(d)
+
+
+def decode_extended_handshake(payload: bytes, state: ExtensionState) -> None:
+    """Apply a peer's extended handshake to its negotiation state.
+
+    Malformed handshakes degrade to "no extensions" rather than raising:
+    BEP 10 is advisory and a bad dict just means we won't use them.
+    """
+    try:
+        d = bdecode(payload)
+    except BencodeError:
+        return
+    if not isinstance(d, dict):
+        return
+    state.handshaken = True
+    m = d.get(b"m")
+    if isinstance(m, dict):
+        mid = m.get(UT_METADATA)
+        if isinstance(mid, int) and 0 < mid < 256:
+            state.ut_metadata_id = mid
+    size = d.get(b"metadata_size")
+    if isinstance(size, int) and 0 < size <= MAX_METADATA_SIZE:
+        state.metadata_size = size
+
+
+# ------------------------------------------------------------ ut_metadata
+
+
+def num_metadata_pieces(metadata_size: int) -> int:
+    return max(1, math.ceil(metadata_size / METADATA_PIECE_SIZE))
+
+
+def encode_metadata_request(piece: int) -> bytes:
+    return bencode({b"msg_type": MsgType.REQUEST, b"piece": piece})
+
+
+def encode_metadata_data(piece: int, total_size: int, data: bytes) -> bytes:
+    return bencode({b"msg_type": MsgType.DATA, b"piece": piece, b"total_size": total_size}) + data
+
+
+def encode_metadata_reject(piece: int) -> bytes:
+    return bencode({b"msg_type": MsgType.REJECT, b"piece": piece})
+
+
+@dataclass(frozen=True)
+class MetadataMessage:
+    msg_type: int
+    piece: int
+    total_size: int = 0
+    data: bytes = b""
+
+
+def decode_metadata_message(payload: bytes) -> MetadataMessage | None:
+    """Parse a ut_metadata payload; None if malformed.
+
+    BEP 9's framing quirk: a ``data`` message is a bencoded dict with the
+    raw piece bytes appended immediately after the dict's final ``e`` —
+    so the decoder must report how much of the buffer the dict consumed.
+    """
+    try:
+        d, consumed = bdecode_prefix(payload)
+    except BencodeError:
+        return None
+    if not isinstance(d, dict):
+        return None
+    msg_type = d.get(b"msg_type")
+    piece = d.get(b"piece")
+    if not isinstance(msg_type, int) or not isinstance(piece, int) or piece < 0:
+        return None
+    total_size = d.get(b"total_size", 0)
+    if not isinstance(total_size, int) or total_size < 0:
+        total_size = 0
+    return MetadataMessage(
+        msg_type=msg_type, piece=piece, total_size=total_size, data=payload[consumed:]
+    )
+
+
+class MetadataAssembler:
+    """Collects ut_metadata data pieces and verifies the finished dict.
+
+    One per magnet fetch; feed ``MetadataMessage``s with
+    ``add(msg)`` and poll ``complete`` / ``result(info_hash)``.
+    """
+
+    def __init__(self, metadata_size: int):
+        if not 0 < metadata_size <= MAX_METADATA_SIZE:
+            raise ValueError(f"implausible metadata_size {metadata_size}")
+        self.size = metadata_size
+        self.n_pieces = num_metadata_pieces(metadata_size)
+        self._pieces: dict[int, bytes] = {}
+
+    @property
+    def complete(self) -> bool:
+        return len(self._pieces) == self.n_pieces
+
+    def missing(self) -> list[int]:
+        return [i for i in range(self.n_pieces) if i not in self._pieces]
+
+    def add(self, msg: MetadataMessage) -> bool:
+        """Ingest a DATA message; True if it advanced the assembly."""
+        if msg.msg_type != MsgType.DATA or not 0 <= msg.piece < self.n_pieces:
+            return False
+        want = (
+            self.size - msg.piece * METADATA_PIECE_SIZE
+            if msg.piece == self.n_pieces - 1
+            else METADATA_PIECE_SIZE
+        )
+        data = msg.data[:want] if len(msg.data) > want else msg.data
+        if len(data) != want or msg.piece in self._pieces:
+            return False
+        self._pieces[msg.piece] = data
+        return True
+
+    def result(self, info_hash: bytes) -> bytes | None:
+        """The verified info-dict bytes, or None if hash check fails."""
+        if not self.complete:
+            return None
+        blob = b"".join(self._pieces[i] for i in range(self.n_pieces))
+        if hashlib.sha1(blob).digest() != info_hash:
+            self._pieces.clear()  # poisoned; refetch from scratch
+            return None
+        return blob
+
+
+def metadata_piece(info_bytes: bytes, piece: int) -> bytes | None:
+    """Server side: slice piece ``piece`` out of a serialized info dict."""
+    n = num_metadata_pieces(len(info_bytes))
+    if not 0 <= piece < n:
+        return None
+    return info_bytes[piece * METADATA_PIECE_SIZE : (piece + 1) * METADATA_PIECE_SIZE]

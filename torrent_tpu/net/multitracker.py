@@ -40,14 +40,20 @@ class TrackerList:
 
     def __init__(self, announce_url: str, tiers: list[list[str]] | None = None):
         if tiers:
-            self.tiers = [list(t) for t in tiers]
+            self.tiers = [[u for u in t if u] for t in tiers]
+            self.tiers = [t for t in self.tiers if t]
             for tier in self.tiers:
                 random.shuffle(tier)  # BEP 12: shuffle once at load
             # the single `announce` field is the fallback tier if absent
-            if not any(announce_url in tier for tier in self.tiers):
+            if announce_url and not any(announce_url in tier for tier in self.tiers):
                 self.tiers.append([announce_url])
         else:
-            self.tiers = [[announce_url]]
+            # Trackerless torrents (x.pe-only magnets) have no tiers at
+            # all; the session skips its announce loop entirely.
+            self.tiers = [[announce_url]] if announce_url else []
+
+    def __bool__(self) -> bool:
+        return bool(self.tiers)
 
     def urls(self):
         for tier in self.tiers:

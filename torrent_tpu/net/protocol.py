@@ -37,6 +37,7 @@ class MsgId(enum.IntEnum):
     REQUEST = 6
     PIECE = 7
     CANCEL = 8
+    EXTENDED = 20  # BEP 10 extension protocol (net/extension.py)
 
 
 # Sanity cap on inbound frames: a piece message is 9 + 16 KiB; bitfields
@@ -101,36 +102,56 @@ class Cancel:
     length: int
 
 
+@dataclass(frozen=True)
+class Extended:
+    """BEP 10 frame: <id 20><ext_id u8><payload>. ext_id 0 = ext handshake."""
+
+    ext_id: int
+    payload: bytes
+
+
 PeerMsg = (
-    KeepAlive | Choke | Unchoke | Interested | NotInterested | Have | BitfieldMsg | Request | Piece | Cancel
+    KeepAlive | Choke | Unchoke | Interested | NotInterested | Have | BitfieldMsg | Request | Piece | Cancel | Extended
 )
 
 
 # ============================================================= handshake
 
 
-def handshake_bytes(info_hash: bytes, peer_id: bytes) -> bytes:
-    """pstrlen + pstr + 8 reserved + info_hash + peer_id (protocol.ts:25-34)."""
+def handshake_bytes(info_hash: bytes, peer_id: bytes, reserved: bytes = b"\x00" * 8) -> bytes:
+    """pstrlen + pstr + 8 reserved + info_hash + peer_id (protocol.ts:25-34).
+
+    ``reserved`` carries feature bits — bit 20 (byte 5, 0x10) advertises
+    the BEP 10 extension protocol (net/extension.py).
+    """
     if len(info_hash) != 20 or len(peer_id) != 20:
         raise ProtocolError("info_hash and peer_id must be 20 bytes")
-    return bytes([len(PROTOCOL_STRING)]) + PROTOCOL_STRING + b"\x00" * 8 + info_hash + peer_id
+    if len(reserved) != 8:
+        raise ProtocolError("reserved must be 8 bytes")
+    return bytes([len(PROTOCOL_STRING)]) + PROTOCOL_STRING + reserved + info_hash + peer_id
 
 
-async def send_handshake(writer: asyncio.StreamWriter, info_hash: bytes, peer_id: bytes) -> None:
-    writer.write(handshake_bytes(info_hash, peer_id))
+async def send_handshake(
+    writer: asyncio.StreamWriter,
+    info_hash: bytes,
+    peer_id: bytes,
+    reserved: bytes = b"\x00" * 8,
+) -> None:
+    writer.write(handshake_bytes(info_hash, peer_id, reserved))
     await writer.drain()
 
 
-async def read_handshake_head(reader: asyncio.StreamReader) -> bytes:
-    """Phase 1: through the info hash; returns the 20-byte hash
-    (protocol.ts:48-61 startReceiveHandshake)."""
+async def read_handshake_head(reader: asyncio.StreamReader) -> tuple[bytes, bytes]:
+    """Phase 1: through the info hash; returns ``(info_hash, reserved)``
+    (protocol.ts:48-61 startReceiveHandshake — the reference discards the
+    reserved bytes; we keep them for BEP 10 feature negotiation)."""
     try:
         pstrlen = (await reader.readexactly(1))[0]
         pstr = await reader.readexactly(pstrlen)
         if pstr != PROTOCOL_STRING:
             raise ProtocolError(f"unknown protocol string {pstr!r}")
-        await reader.readexactly(8)  # reserved
-        return await reader.readexactly(20)
+        reserved = await reader.readexactly(8)
+        return await reader.readexactly(20), reserved
     except asyncio.IncompleteReadError as e:
         raise ProtocolError("handshake truncated") from e
 
@@ -173,6 +194,8 @@ def encode_message(msg: PeerMsg) -> bytes:
             return _frame(MsgId.PIECE, write_int(index, 4) + write_int(begin, 4) + block)
         case Cancel(index, begin, length):
             return _frame(MsgId.CANCEL, write_int(index, 4) + write_int(begin, 4) + write_int(length, 4))
+        case Extended(ext_id, payload):
+            return _frame(MsgId.EXTENDED, bytes([ext_id]) + payload)
     raise ProtocolError(f"cannot encode {msg!r}")
 
 
@@ -210,6 +233,8 @@ def decode_message(msg_id: int, payload: bytes) -> PeerMsg | None:
         return Piece(read_int(payload, 4, 0), read_int(payload, 4, 4), payload[8:])
     if msg_id == MsgId.CANCEL and len(payload) == 12:
         return Cancel(read_int(payload, 4, 0), read_int(payload, 4, 4), read_int(payload, 4, 8))
+    if msg_id == MsgId.EXTENDED and len(payload) >= 1:
+        return Extended(ext_id=payload[0], payload=payload[1:])
     if msg_id in set(MsgId):
         raise ProtocolError(f"malformed payload for message id {msg_id}")
     return None

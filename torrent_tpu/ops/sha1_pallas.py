@@ -38,31 +38,22 @@ from torrent_tpu.ops.sha1_jax import _IV, _K, _bswap32, _rotl
 TILE_SUB = 8
 TILE_LANE = 128
 TILE = TILE_SUB * TILE_LANE  # 1024
+# SHA1 blocks chained per grid step. Each block is only ~640 vector ops on
+# a (8, 128) tile — far less than the fixed per-step cost (DMA issue,
+# revisited-block bookkeeping), so one-block steps are overhead-bound.
+# The kernel runs UNROLL blocks per step via an in-kernel fori_loop (NOT
+# Python unrolling — 640 rounds in one basic block sends the backend
+# compiler superlinear); 16 keeps the step's DMA at 1 MiB.
+UNROLL = 16
 
 
-def _sha1_kernel(words_ref, nblocks_ref, state_ref):
-    """One SHA1 block step for a 1024-piece tile.
+def _one_block(state, w):
+    """One 80-round SHA1 compression. state: 5-tuple of u32 vregs; w: 16 words.
 
-    words_ref:   u32[1, 1, 16, 8, 128] — this block's 16 schedule words
-    nblocks_ref: i32[1, 8, 128]        — per-piece chain lengths
-    state_ref:   u32[1, 5, 8, 128]     — running digest state (revisited
-                                          across the k grid axis)
+    The 80-word schedule is a 16-entry rolling window so only 16 vectors
+    are live at a time. Returns the chained (not yet masked) new state.
     """
-    k = pl.program_id(1)
-
-    @pl.when(k == 0)
-    def _init():
-        for i, v in enumerate(_IV):
-            state_ref[0, i] = jnp.full((TILE_SUB, TILE_LANE), v, dtype=jnp.uint32)
-
-    h0 = state_ref[0, 0]
-    h1 = state_ref[0, 1]
-    h2 = state_ref[0, 2]
-    h3 = state_ref[0, 3]
-    h4 = state_ref[0, 4]
-
-    a, b, c, d, e = h0, h1, h2, h3, h4
-    w = [words_ref[0, 0, t] for t in range(16)]
+    a, b, c, d, e = state
     for t in range(80):
         if t < 16:
             wt = w[t]
@@ -83,13 +74,40 @@ def _sha1_kernel(words_ref, nblocks_ref, state_ref):
             kc = _K[3]
         tmp = _rotl(a, 5) + f + e + np.uint32(kc) + wt
         e, d, c, b, a = d, c, _rotl(b, 30), a, tmp
+    return (state[0] + a, state[1] + b, state[2] + c, state[3] + d, state[4] + e)
 
-    keep = k < nblocks_ref[0]
-    state_ref[0, 0] = jnp.where(keep, h0 + a, h0)
-    state_ref[0, 1] = jnp.where(keep, h1 + b, h1)
-    state_ref[0, 2] = jnp.where(keep, h2 + c, h2)
-    state_ref[0, 3] = jnp.where(keep, h3 + d, h3)
-    state_ref[0, 4] = jnp.where(keep, h4 + e, h4)
+
+def _sha1_kernel(words_ref, nblocks_ref, state_ref, *, unroll: int):
+    """``unroll`` chained SHA1 block steps for a 1024-piece tile.
+
+    words_ref:   u32[1, unroll, 16, 8, 128] — this step's schedule words
+    nblocks_ref: i32[1, 8, 128]             — per-piece chain lengths
+    state_ref:   u32[1, 5, 8, 128]          — running digest state
+                 (revisited across the k grid axis; read once, written once)
+    """
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        for i, v in enumerate(_IV):
+            state_ref[0, i] = jnp.full((TILE_SUB, TILE_LANE), v, dtype=jnp.uint32)
+
+    nblocks = nblocks_ref[0]
+
+    def body(j, state):
+        # Dynamic index on a leading (untiled) VMEM axis — one 64 KiB slab.
+        w = [words_ref[0, j, t] for t in range(16)]
+        new = _one_block(state, w)
+        keep = k * unroll + j < nblocks
+        return tuple(jnp.where(keep, n, o) for n, o in zip(new, state))
+
+    state = tuple(state_ref[0, i] for i in range(5))
+    if unroll == 1:
+        state = body(0, state)
+    else:
+        state = jax.lax.fori_loop(0, unroll, body, state)
+    for i in range(5):
+        state_ref[0, i] = state[i]
 
 
 def _swizzle(data_u8: jax.Array, r: int, nblk: int) -> jax.Array:
@@ -104,14 +122,24 @@ def _sha1_pallas_aligned(data_u8, nblocks, interpret):
     b, padded = data_u8.shape
     nblk = padded // 64
     r = b // TILE
+    # Short chains (authoring tests, tiny pieces) keep unroll = chain
+    # length so no work or trace time is wasted; long chains use the full
+    # amortization factor. Static per input shape — no recompiles.
+    unroll = min(UNROLL, nblk)
+    # Round the chain up to an unroll multiple with zero blocks; they sit
+    # beyond every row's nblocks so the masked updates skip them.
+    nblk_pad = ((nblk + unroll - 1) // unroll) * unroll
+    if nblk_pad != nblk:
+        data_u8 = jnp.pad(data_u8, ((0, 0), (0, (nblk_pad - nblk) * 64)))
+        nblk = nblk_pad
     words = _swizzle(data_u8, r, nblk)
     nb = nblocks.astype(jnp.int32).reshape(r, TILE_SUB, TILE_LANE)
     state = pl.pallas_call(
-        _sha1_kernel,
-        grid=(r, nblk),
+        functools.partial(_sha1_kernel, unroll=unroll),
+        grid=(r, nblk // unroll),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, 16, TILE_SUB, TILE_LANE),
+                (1, unroll, 16, TILE_SUB, TILE_LANE),
                 lambda i, k: (i, k, 0, 0, 0),
                 memory_space=pltpu.VMEM,
             ),

@@ -132,6 +132,39 @@ class Client:
         await torrent.start()
         return torrent
 
+    async def add_magnet(
+        self, magnet, storage: Storage | StorageMethod | str
+    ) -> Torrent:
+        """Join a swarm from a magnet link (BEP 9/10 — reference roadmap
+        README.md:39): fetch the info dict from peers, then ``add``.
+
+        ``magnet`` is a ``codec.magnet.Magnet`` or a ``magnet:?...`` URI.
+        """
+        from torrent_tpu.codec.magnet import Magnet, parse_magnet
+        from torrent_tpu.session.metadata import fetch_metadata
+
+        if self.port is None:
+            raise RuntimeError("Client.start() must be awaited before add_magnet()")
+        if isinstance(magnet, str):
+            magnet = parse_magnet(magnet)
+        if not isinstance(magnet, Magnet):
+            raise TypeError("magnet must be a Magnet or magnet URI string")
+        if magnet.info_hash in self.torrents:
+            raise ValueError("torrent already added")
+        metainfo = await fetch_metadata(
+            magnet, peer_id=self.config.peer_id, port=self.port
+        )
+        torrent = await self.add(metainfo, storage)
+        if magnet.peer_addrs:
+            # Trackerless magnets (x.pe bootstrap): hand the known peers
+            # straight to the scheduler instead of waiting on an announce.
+            from torrent_tpu.net.types import AnnouncePeer
+
+            torrent._connect_new_peers(
+                [AnnouncePeer(ip=h, port=p) for h, p in magnet.peer_addrs]
+            )
+        return torrent
+
     async def remove(self, info_hash: bytes) -> None:
         torrent = self.torrents.pop(info_hash, None)
         if torrent:
@@ -143,19 +176,29 @@ class Client:
         """Inbound handshake: route on info hash before replying
         (client.ts:85-104)."""
         try:
-            info_hash = await asyncio.wait_for(proto.read_handshake_head(reader), timeout=15)
+            info_hash, reserved = await asyncio.wait_for(
+                proto.read_handshake_head(reader), timeout=15
+            )
             torrent = self.torrents.get(info_hash)
             if torrent is None:
                 writer.close()  # unknown torrent: drop pre-reply
                 return
-            await proto.send_handshake(writer, info_hash, self.config.peer_id)
+            from torrent_tpu.net.extension import extension_reserved
+
+            await proto.send_handshake(
+                writer, info_hash, self.config.peer_id, extension_reserved()
+            )
             peer_id = await asyncio.wait_for(proto.read_handshake_peer_id(reader), timeout=15)
             if peer_id == self.config.peer_id:
                 writer.close()
                 return
             addr = writer.get_extra_info("peername")
             await torrent.add_peer(
-                peer_id, reader, writer, address=tuple(addr[:2]) if addr else None
+                peer_id,
+                reader,
+                writer,
+                address=tuple(addr[:2]) if addr else None,
+                reserved=reserved,
             )
         except (proto.ProtocolError, asyncio.TimeoutError, ConnectionError, OSError):
             writer.close()

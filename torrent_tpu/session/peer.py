@@ -12,6 +12,7 @@ import asyncio
 import time
 from dataclasses import dataclass, field
 
+from torrent_tpu.net.extension import ExtensionState
 from torrent_tpu.utils.bitfield import Bitfield
 
 
@@ -22,6 +23,9 @@ class PeerConnection:
     writer: asyncio.StreamWriter
     num_pieces: int
     address: tuple[str, int] | None = None
+    # BEP 10 negotiation state (net/extension.py); ``enabled`` is set from
+    # the peer's handshake reserved bit 20.
+    ext: ExtensionState = field(default_factory=ExtensionState)
 
     # BEP 3 spec-default flag positions (peer.ts:17-20)
     am_choking: bool = True

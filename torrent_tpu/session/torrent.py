@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 
 from torrent_tpu.codec.metainfo import Metainfo
+from torrent_tpu.net import extension as ext
 from torrent_tpu.net import protocol as proto
 from torrent_tpu.net.constants import DEFAULT_NUM_WANT
 from torrent_tpu.net.tracker import TrackerError
@@ -130,6 +131,11 @@ class Torrent:
         self._rarity_dirty = True
         self._inflight_count: Counter = Counter()
 
+        # Serialized info dict for BEP 9 metadata serving — byte-exact
+        # re-encode of the decoded dict (decode preserves key order, so
+        # sha1(info_bytes) == info_hash).
+        self._info_bytes: bytes | None = None
+
         # live announce counters (fixed vs torrent.ts:66-69 which never
         # updates them)
         self.uploaded = 0
@@ -157,7 +163,8 @@ class Torrent:
         if self.bitfield.complete:
             self.on_complete.set()
         self._stopping = False
-        self._spawn(self._announce_loop(), name="announce")
+        if self.trackers:
+            self._spawn(self._announce_loop(), name="announce")
         self._spawn(self._choke_loop(), name="choke")
         self._spawn(self._keepalive_loop(), name="keepalive")
 
@@ -273,13 +280,14 @@ class Torrent:
             peer.close()
         self.peers.clear()
         self._checkpoint()
-        try:
-            await asyncio.wait_for(
-                self.trackers.announce(self._announce_info(AnnounceEvent.STOPPED)),
-                timeout=5,
-            )
-        except Exception:
-            pass  # best-effort goodbye
+        if self.trackers:
+            try:
+                await asyncio.wait_for(
+                    self.trackers.announce(self._announce_info(AnnounceEvent.STOPPED)),
+                    timeout=5,
+                )
+            except Exception:
+                pass  # best-effort goodbye
         self.state = TorrentState.STOPPED
 
     # ------------------------------------------------------------ announce
@@ -358,8 +366,10 @@ class Torrent:
             self._dialing.discard(addr)
             return
         try:
-            await proto.send_handshake(writer, self.metainfo.info_hash, self.peer_id)
-            ih = await asyncio.wait_for(proto.read_handshake_head(reader), timeout=10)
+            await proto.send_handshake(
+                writer, self.metainfo.info_hash, self.peer_id, ext.extension_reserved()
+            )
+            ih, reserved = await asyncio.wait_for(proto.read_handshake_head(reader), timeout=10)
             pid = await asyncio.wait_for(proto.read_handshake_peer_id(reader), timeout=10)
             if ih != self.metainfo.info_hash or (expect_peer_id and pid != expect_peer_id):
                 raise proto.ProtocolError("handshake mismatch")
@@ -370,11 +380,13 @@ class Torrent:
             self._dialing.discard(addr)
             return
         self._dialing.discard(addr)
-        await self.add_peer(pid, reader, writer, address=addr)
+        await self.add_peer(pid, reader, writer, address=addr, reserved=reserved)
 
     # ------------------------------------------------------------ peer mgmt
 
-    async def add_peer(self, peer_id, reader, writer, address=None) -> None:
+    async def add_peer(
+        self, peer_id, reader, writer, address=None, reserved: bytes = b"\x00" * 8
+    ) -> None:
         """Register + spawn the message loop (torrent.ts:79-102)."""
         if peer_id in self.peers:
             # Keep the established connection, close the duplicate — the
@@ -392,8 +404,18 @@ class Torrent:
             num_pieces=self.info.num_pieces,
             address=address,
         )
+        peer.ext.enabled = ext.supports_extensions(reserved)
         self.peers[peer_id] = peer
         proto.send_bitfield(writer, self.bitfield)
+        if peer.ext.enabled:
+            # BEP 10: extended handshake right after the bitfield,
+            # advertising ut_metadata so magnet joiners can fetch the
+            # info dict from us.
+            writer.write(
+                proto.encode_message(
+                    proto.Extended(0, ext.encode_extended_handshake(len(self.info_bytes())))
+                )
+            )
         peer.snapshot_rate()
         self._spawn(self._peer_loop(peer), name=f"peer-{peer_id[:8].hex()}")
 
@@ -475,6 +497,49 @@ class Torrent:
                 await self._ingest_block(peer, index, begin, block)
             case proto.Cancel(index, begin, length):
                 pass  # we serve requests synchronously; nothing queued to cancel
+            case proto.Extended(ext_id, payload):
+                await self._handle_extended(peer, ext_id, payload)
+
+    # ----------------------------------------------------- BEP 10 extensions
+
+    def info_bytes(self) -> bytes:
+        """Canonical serialized info dict (BEP 9 metadata payload)."""
+        if self._info_bytes is None:
+            from torrent_tpu.codec.bencode import bencode
+
+            raw_info = self.metainfo.raw.get(b"info")
+            if raw_info is not None:
+                # sort_keys=False: the decoded dict preserves the file's
+                # key order, so this re-encode is byte-exact and hashes
+                # back to info_hash.
+                self._info_bytes = bencode(raw_info, sort_keys=False)
+            else:  # synthetic metainfo (tests): canonical order
+                self._info_bytes = b""
+        return self._info_bytes
+
+    async def _handle_extended(self, peer: PeerConnection, ext_id: int, payload: bytes) -> None:
+        """BEP 10 demux: ext handshake (0) or our ut_metadata id."""
+        if not peer.ext.enabled:
+            return  # never advertised the reserved bit; ignore
+        if ext_id == 0:
+            ext.decode_extended_handshake(payload, peer.ext)
+            return
+        if ext_id == ext.LOCAL_EXT_IDS[ext.UT_METADATA]:
+            msg = ext.decode_metadata_message(payload)
+            if msg is None or peer.ext.ut_metadata_id == 0:
+                return
+            if msg.msg_type == ext.MsgType.REQUEST:
+                info = self.info_bytes()
+                piece = ext.metadata_piece(info, msg.piece) if info else None
+                if piece is None:
+                    reply = ext.encode_metadata_reject(msg.piece)
+                else:
+                    reply = ext.encode_metadata_data(msg.piece, len(info), piece)
+                await proto.send_message(
+                    peer.writer, proto.Extended(peer.ext.ut_metadata_id, reply)
+                )
+            # DATA/REJECT towards a complete torrent: nothing to do (the
+            # magnet fetch path, session/metadata.py, has its own loop).
 
     # ------------------------------------------------------------- leeching
 

@@ -88,7 +88,9 @@ def main() -> None:
     from torrent_tpu.storage.storage import Storage
 
     if not backend:
-        backend = "jax"
+        # pallas is the fast path on real TPUs; interpret-mode pallas on a
+        # CPU fallback would be pathological, so use the XLA backend there.
+        backend = "jax" if plat == "cpu" else "pallas"
 
     class _PayloadMethod:
         """Zero-copy storage backend over the benchmark payload."""

@@ -51,6 +51,12 @@ class TestMagnetParse:
         m = Magnet(IH, "x y", ("http://t/a",), (("10.0.0.1", 51413),))
         assert parse_magnet(m.to_uri()) == m
 
+    def test_roundtrip_ipv6(self):
+        m = Magnet(IH, peer_addrs=(("::1", 6882), ("2001:db8::7", 51413)))
+        uri = m.to_uri()
+        assert "x.pe=[::1]:6882" in uri  # bracketed form for external clients
+        assert parse_magnet(uri) == m
+
     @pytest.mark.parametrize(
         "uri",
         [

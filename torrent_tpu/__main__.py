@@ -1,0 +1,7 @@
+"""``python -m torrent_tpu`` → the proof-of-concept CLI (tools/cli.py)."""
+
+import sys
+
+from torrent_tpu.tools.cli import main
+
+sys.exit(main())

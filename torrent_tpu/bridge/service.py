@@ -153,14 +153,14 @@ async def serve_bridge(host: str = "127.0.0.1", port: int = 8421, hasher: str = 
     return await BridgeServer(host, port, hasher).start()
 
 
-def main():  # pragma: no cover - manual entrypoint
+def main(argv=None):  # pragma: no cover - manual entrypoint
     import argparse
 
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8421)
     parser.add_argument("--hasher", choices=("cpu", "tpu"), default="tpu")
-    args = parser.parse_args()
+    args = parser.parse_args(argv)
 
     async def go():
         server = await serve_bridge(args.host, args.port, args.hasher)
@@ -168,6 +168,7 @@ def main():  # pragma: no cover - manual entrypoint
         await server.wait_closed()
 
     asyncio.run(go())
+    return 0
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -42,7 +42,8 @@ class Magnet:
 
             parts.append(f"tr={quote(tr, safe='')}")
         for host, port in self.peer_addrs:
-            parts.append(f"x.pe={host}:{port}")
+            h = f"[{host}]" if ":" in host else host  # IPv6 re-bracketing
+            parts.append(f"x.pe={h}:{port}")
         return "&".join(parts)
 
 

@@ -1,0 +1,74 @@
+"""Build the native IO engine shared library with g++.
+
+No pybind11/setuptools machinery needed for a C-ABI .so; one compiler
+invocation, cached next to the source and rebuilt when the source is
+newer. Import-time use goes through ``load()`` which returns None (pure-
+Python fallback) whenever a toolchain or binary is unavailable — the
+framework never hard-requires the native engine.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+
+_SRC = pathlib.Path(__file__).with_name("io_engine.cpp")
+_LIB = pathlib.Path(__file__).with_name("libtorrent_tpu_io.so")
+
+
+def build(force: bool = False) -> pathlib.Path | None:
+    """Compile the engine if needed; returns the .so path or None."""
+    if not _SRC.exists():
+        return None
+    if not force and _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _LIB
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O2",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-pthread",
+        str(_SRC),
+        "-o",
+        str(_LIB),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return _LIB
+
+
+def load():
+    """ctypes handle to the built engine, or None if unavailable."""
+    import ctypes
+
+    path = build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError:
+        return None
+    lib.tt_io_create.restype = ctypes.c_void_p
+    lib.tt_io_create.argtypes = [ctypes.c_int]
+    lib.tt_io_destroy.restype = None
+    lib.tt_io_destroy.argtypes = [ctypes.c_void_p]
+    lib.tt_io_read_batch.restype = ctypes.c_int
+    lib.tt_io_read_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    return lib
+
+
+if __name__ == "__main__":
+    out = build(force=True)
+    print(f"built: {out}" if out else "build failed")

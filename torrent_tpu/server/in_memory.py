@@ -187,13 +187,30 @@ async def run_tracker(opts: ServeOptions | None = None) -> tuple[TrackerServer, 
     return server, task
 
 
-def main():  # pragma: no cover - manual entrypoint (in_memory_tracker.ts:183-186)
+def main(argv=None):  # pragma: no cover - manual entrypoint (in_memory_tracker.ts:183-186)
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--http-port", type=int, default=8000)
+    parser.add_argument(
+        "--udp-port", type=int, default=6969, help="negative value disables UDP"
+    )
+    parser.add_argument("--interval", type=int, default=600)
+    args = parser.parse_args(argv)
+
     async def go():
-        server, task = await run_tracker(ServeOptions(http_port=8000, udp_port=6969))
+        server, task = await run_tracker(
+            ServeOptions(
+                http_port=args.http_port,
+                udp_port=args.udp_port if args.udp_port >= 0 else None,
+                interval=args.interval,
+            )
+        )
         print(f"tracker listening: http={server.http_port} udp={server.udp_port}")
         await task
 
     asyncio.run(go())
+    return 0
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -151,8 +151,12 @@ class Client:
             raise TypeError("magnet must be a Magnet or magnet URI string")
         if magnet.info_hash in self.torrents:
             raise ValueError("torrent already added")
+        # Throwaway peer id for the metadata connections: if the fetch
+        # socket's EOF hasn't been reaped by the seeder when the real
+        # download dials in, our own id would trip its duplicate-peer
+        # guard and the data connection would be dropped.
         metainfo = await fetch_metadata(
-            magnet, peer_id=self.config.peer_id, port=self.port
+            magnet, peer_id=generate_peer_id(), port=self.port
         )
         torrent = await self.add(metainfo, storage)
         if magnet.peer_addrs:

@@ -161,6 +161,8 @@ class Storage:
                 raise StorageError("read_batch out buffer has wrong shape/dtype")
             out[:] = 0
         lengths = np.empty(n, dtype=np.int64)
+        if self._native_read_batch(indices, out, lengths):
+            return out, lengths
         for row, idx in enumerate(indices):
             plen = piece_length(self.info, idx)
             lengths[row] = plen
@@ -174,6 +176,58 @@ class Storage:
                     pass  # leave zeros; SHA1 mismatch will flag the piece
                 pos += chunk
         return out, lengths
+
+    def _native_read_batch(self, indices, out: np.ndarray, lengths: np.ndarray) -> bool:
+        """Batch read via the C++ pread pool (native/io_engine.cpp).
+
+        Only for filesystem-backed storage; any unreadable range is left
+        zeroed (same semantics as the Python path — SHA1 flags the piece).
+        Returns False to fall back when native IO is unavailable.
+        """
+        if not isinstance(self.method, FsStorage):
+            return False
+        if out.strides[1] != 1 or out.strides[0] < out.shape[1]:
+            return False  # need row-strided uint8 memory
+        try:
+            from torrent_tpu.native.io_engine import NativeIOError, get_engine
+        except ImportError:
+            return False
+        engine = get_engine()
+        if engine is None:
+            return False
+        row_stride = out.strides[0]
+        paths: list[str] = []
+        sizes: list[int] = []
+        findex: dict[tuple[str, ...], int | None] = {}
+        quads: list[tuple[int, int, int, int]] = []
+        for row, idx in enumerate(indices):
+            plen = piece_length(self.info, idx)
+            lengths[row] = plen
+            pos = 0
+            for path, foff, chunk in self.segments(idx * self.info.piece_length, plen):
+                fi = findex.get(path, -1)
+                if fi == -1:
+                    try:
+                        ap = self.method._abspath(path)
+                        size = os.stat(ap).st_size
+                        fi = len(paths)
+                        paths.append(ap)
+                        sizes.append(size)
+                    except (StorageError, OSError):
+                        fi = None  # missing file: whole range stays zero
+                    findex[path] = fi
+                if fi is not None and sizes[fi] - foff >= chunk:
+                    quads.append((fi, foff, row * row_stride + pos, chunk))
+                # else: leave the whole segment zeroed — same all-or-nothing
+                # semantics as the Python path's short-read StorageError
+                pos += chunk
+        extent = (out.shape[0] - 1) * row_stride + out.shape[1] if out.shape[0] else 0
+        try:
+            engine.read_into(paths, quads, out.ctypes.data, extent, keepalive=out)
+        except (NativeIOError, ValueError):
+            out[:] = 0  # a failed segment can leave partial bytes; the
+            return False  # Python fallback rebuilds from a clean buffer
+        return True
 
 
 # ---------------------------------------------------------------- backends

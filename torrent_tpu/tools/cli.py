@@ -1,0 +1,235 @@
+"""torrent-tpu — the proof-of-concept CLI (reference roadmap, README.md:36).
+
+One multiplexed entry point over the whole framework::
+
+    torrent-tpu info     FILE.torrent
+    torrent-tpu make     PATH TRACKER [-o OUT] [--comment C] [--piece-length N] [--hasher cpu|tpu]
+    torrent-tpu verify   FILE.torrent DIR [--hasher cpu|tpu] [--batch N]
+    torrent-tpu download SOURCE DIR [--port P] [--hasher cpu|tpu] [--seed] [--no-resume]
+    torrent-tpu tracker  [--http-port P] [--udp-port P] [--interval S]
+    torrent-tpu bridge   [--port P] [--hasher cpu|tpu]
+
+``download`` accepts either a ``.torrent`` file or a ``magnet:?...`` URI
+(BEP 9 metadata fetch). Also runnable as ``python -m torrent_tpu``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+import sys
+
+
+def _cmd_info(args) -> int:
+    from torrent_tpu.codec.metainfo import parse_metainfo
+
+    with open(args.torrent, "rb") as f:
+        m = parse_metainfo(f.read())
+    if m is None:
+        print("error: not a valid .torrent file", file=sys.stderr)
+        return 1
+    info = m.info
+    print(f"name:         {info.name}")
+    print(f"info hash:    {m.info_hash.hex()}")
+    print(f"announce:     {m.announce}")
+    print(f"total size:   {info.length:,} bytes")
+    print(f"piece length: {info.piece_length:,}")
+    print(f"pieces:       {info.num_pieces:,}")
+    if info.files is not None:
+        print(f"files:        {len(info.files)}")
+        for fe in info.files[:20]:
+            print(f"  {'/'.join(fe.path)}  ({fe.length:,} bytes)")
+        if len(info.files) > 20:
+            print(f"  ... and {len(info.files) - 20} more")
+    return 0
+
+
+def _cmd_make(args) -> int:
+    from torrent_tpu.tools.make_torrent import make_torrent
+
+    def progress(n):
+        print(f"\rhashed {n} pieces", end="", file=sys.stderr, flush=True)
+
+    data = make_torrent(
+        args.path,
+        args.tracker,
+        comment=args.comment,
+        piece_length=args.piece_length,
+        hasher=args.hasher,
+        progress=progress,
+    )
+    print("", file=sys.stderr)
+    out = args.output or (args.path.rstrip("/").rsplit("/", 1)[-1] + ".torrent")
+    with open(out, "wb") as f:
+        f.write(data)
+    print(f"wrote {out} ({len(data):,} bytes)")
+    return 0
+
+
+def _cmd_verify(args) -> int:
+    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.parallel.verify import verify_pieces
+    from torrent_tpu.storage.storage import FsStorage, Storage
+
+    with open(args.torrent, "rb") as f:
+        m = parse_metainfo(f.read())
+    if m is None:
+        print("error: not a valid .torrent file", file=sys.stderr)
+        return 1
+
+    def progress(done, total):
+        print(f"\rverified {done}/{total} pieces", end="", file=sys.stderr, flush=True)
+
+    kwargs = {"batch_size": args.batch} if args.hasher == "tpu" else {}
+    ok = verify_pieces(
+        Storage(FsStorage(args.dir), m.info),
+        m.info,
+        hasher=args.hasher,
+        progress_cb=progress,
+        **kwargs,
+    )
+    print("", file=sys.stderr)
+    valid = int(ok.sum())
+    print(f"{valid}/{m.info.num_pieces} pieces valid")
+    if valid < m.info.num_pieces:
+        bad = [i for i in range(m.info.num_pieces) if not ok[i]]
+        print(f"first invalid pieces: {bad[:10]}")
+        return 2
+    return 0
+
+
+async def _download(args) -> int:
+    from torrent_tpu.session.client import Client, ClientConfig
+
+    config = ClientConfig(port=args.port, hasher=args.hasher, resume=not args.no_resume)
+    client = Client(config)
+    await client.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover - non-unix
+            pass
+    try:
+        if args.source.startswith("magnet:"):
+            print("fetching metadata from swarm...", file=sys.stderr)
+            torrent = await client.add_magnet(args.source, args.dir)
+        else:
+            from torrent_tpu.codec.metainfo import parse_metainfo
+
+            with open(args.source, "rb") as f:
+                m = parse_metainfo(f.read())
+            if m is None:
+                print("error: not a valid .torrent file", file=sys.stderr)
+                return 1
+            torrent = await client.add(m, args.dir)
+        print(f"listening on port {client.port}", file=sys.stderr)
+
+        async def report():
+            while not stop.is_set():
+                s = torrent.status()
+                print(
+                    f"\r[{s['state']}] pieces {s['pieces']} peers {s['peers']} "
+                    f"down {s['downloaded']:,} up {s['uploaded']:,}   ",
+                    end="",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                await asyncio.sleep(1)
+
+        reporter = asyncio.ensure_future(report())
+        done_wait = asyncio.ensure_future(torrent.on_complete.wait())
+        stop_wait = asyncio.ensure_future(stop.wait())
+        await asyncio.wait({done_wait, stop_wait}, return_when=asyncio.FIRST_COMPLETED)
+        if torrent.on_complete.is_set():
+            print("\ndownload complete", file=sys.stderr)
+            if args.seed and not stop.is_set():
+                print("seeding (ctrl-c to stop)", file=sys.stderr)
+                await stop.wait()
+        reporter.cancel()
+        done_wait.cancel()
+        stop_wait.cancel()
+        return 0 if torrent.on_complete.is_set() else 130
+    finally:
+        await client.close()
+
+
+def _cmd_download(args) -> int:
+    return asyncio.run(_download(args))
+
+
+def _cmd_tracker(args) -> int:
+    from torrent_tpu.server.in_memory import main as tracker_main
+
+    udp = args.udp_port if args.udp_port is not None else -1  # -1 = disabled
+    return tracker_main(
+        ["--http-port", str(args.http_port), "--udp-port", str(udp),
+         "--interval", str(args.interval)]
+    )
+
+
+def _cmd_bridge(args) -> int:
+    from torrent_tpu.bridge.service import main as bridge_main
+
+    return bridge_main(["--port", str(args.port), "--hasher", args.hasher])
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="torrent-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("info", help="print .torrent metadata")
+    sp.add_argument("torrent")
+    sp.set_defaults(fn=_cmd_info)
+
+    sp = sub.add_parser("make", help="author a .torrent (TPU-batched hashing)")
+    sp.add_argument("path")
+    sp.add_argument("tracker")
+    sp.add_argument("-o", "--output")
+    sp.add_argument("--comment")
+    sp.add_argument("--piece-length", type=int)
+    sp.add_argument("--hasher", choices=("cpu", "tpu"), default="cpu")
+    sp.set_defaults(fn=_cmd_make)
+
+    sp = sub.add_parser("verify", help="recheck downloaded data against a .torrent")
+    sp.add_argument("torrent")
+    sp.add_argument("dir")
+    sp.add_argument("--hasher", choices=("cpu", "tpu"), default="cpu")
+    sp.add_argument("--batch", type=int, default=256)
+    sp.set_defaults(fn=_cmd_verify)
+
+    sp = sub.add_parser("download", help="download a .torrent file or magnet URI")
+    sp.add_argument("source", help=".torrent path or magnet:?xt=urn:btih:... URI")
+    sp.add_argument("dir")
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--hasher", choices=("cpu", "tpu"), default="cpu")
+    sp.add_argument("--seed", action="store_true", help="keep seeding after completion")
+    sp.add_argument("--no-resume", action="store_true", help="skip fastresume checkpoints")
+    sp.set_defaults(fn=_cmd_download)
+
+    sp = sub.add_parser("tracker", help="run the in-memory tracker server")
+    sp.add_argument("--http-port", type=int, default=8080)
+    sp.add_argument("--udp-port", type=int, default=None)
+    sp.add_argument("--interval", type=int, default=600)
+    sp.set_defaults(fn=_cmd_tracker)
+
+    sp = sub.add_parser("bridge", help="run the TPU hash-plane HTTP bridge")
+    sp.add_argument("--port", type=int, default=8421)
+    sp.add_argument("--hasher", choices=("cpu", "tpu"), default="tpu")
+    sp.set_defaults(fn=_cmd_bridge)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except KeyboardInterrupt:  # pragma: no cover - interactive
+        return 130
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -51,7 +51,15 @@ def load():
     try:
         lib = ctypes.CDLL(str(path))
     except OSError:
-        return None
+        # Stale/foreign binary (other arch, older glibc): rebuild from
+        # source once before giving up on the native engine.
+        path = build(force=True)
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            return None
     lib.tt_io_create.restype = ctypes.c_void_p
     lib.tt_io_create.argtypes = [ctypes.c_int]
     lib.tt_io_destroy.restype = None

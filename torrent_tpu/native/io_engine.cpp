@@ -57,19 +57,30 @@ int read_segment(int fd, const Segment& seg, uint8_t* out) {
   return 0;
 }
 
+// All per-batch state lives in one heap object handed to workers via
+// shared_ptr, so a straggler thread that wakes late can only ever touch
+// ITS batch's counters — never a newer batch's (claiming an index from a
+// fresh batch's counter while holding stale segment pointers would
+// double-claim segments and return before the buffer is complete).
+// `done` is flipped and cv_done notified under the mutex; checking the
+// predicate under the same mutex in submit() makes the wakeup lossless.
+struct Batch {
+  const Segment* segs;
+  const int* fds;
+  uint8_t* out;
+  int32_t* statuses;
+  int64_t n_segs;
+  std::atomic<int64_t> next{0};
+  std::atomic<int64_t> remaining;
+};
+
 struct Pool {
   std::vector<std::thread> workers;
   std::mutex mu;
   std::condition_variable cv_work, cv_done;
-  // current batch
-  const Segment* segs = nullptr;
-  const int* fds = nullptr;
-  uint8_t* out = nullptr;
-  int32_t* statuses = nullptr;
-  std::atomic<int64_t> next{0};
-  int64_t n_segs = 0;
-  std::atomic<int64_t> remaining{0};
-  uint64_t generation = 0;
+  std::shared_ptr<Batch> current;  // guarded by mu
+  uint64_t generation = 0;         // guarded by mu
+  bool batch_done = false;         // guarded by mu
   bool shutting_down = false;
 
   explicit Pool(int n_threads) {
@@ -90,18 +101,24 @@ struct Pool {
   void run() {
     uint64_t seen = 0;
     for (;;) {
+      std::shared_ptr<Batch> batch;
       {
         std::unique_lock<std::mutex> lock(mu);
         cv_work.wait(lock, [&] { return shutting_down || generation != seen; });
         if (shutting_down) return;
         seen = generation;
+        batch = current;
       }
       for (;;) {
-        int64_t i = next.fetch_add(1);
-        if (i >= n_segs) break;
-        const Segment& s = segs[i];
-        statuses[i] = read_segment(fds[s.file_index], s, out);
-        if (remaining.fetch_sub(1) == 1) cv_done.notify_all();
+        int64_t i = batch->next.fetch_add(1);
+        if (i >= batch->n_segs) break;
+        const Segment& s = batch->segs[i];
+        batch->statuses[i] = read_segment(batch->fds[s.file_index], s, batch->out);
+        if (batch->remaining.fetch_sub(1) == 1) {
+          std::lock_guard<std::mutex> lock(mu);
+          batch_done = true;
+          cv_done.notify_all();
+        }
       }
     }
   }
@@ -110,21 +127,23 @@ struct Pool {
   int submit(const Segment* s, int64_t n, const int* f, uint8_t* o,
              int32_t* st) {
     if (n == 0) return 0;
+    auto batch = std::make_shared<Batch>();
+    batch->segs = s;
+    batch->fds = f;
+    batch->out = o;
+    batch->statuses = st;
+    batch->n_segs = n;
+    batch->remaining.store(n);
     {
       std::lock_guard<std::mutex> lock(mu);
-      segs = s;
-      fds = f;
-      out = o;
-      statuses = st;
-      n_segs = n;
-      next.store(0);
-      remaining.store(n);
+      current = batch;
+      batch_done = false;
       ++generation;
     }
     cv_work.notify_all();
     {
       std::unique_lock<std::mutex> lock(mu);
-      cv_done.wait(lock, [&] { return remaining.load() == 0; });
+      cv_done.wait(lock, [&] { return batch_done; });
     }
     for (int64_t i = 0; i < n; ++i)
       if (st[i] != 0) return st[i];

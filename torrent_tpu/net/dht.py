@@ -1,0 +1,500 @@
+"""BEP 5 Mainline DHT — Kademlia peer discovery over UDP.
+
+Beyond the reference's surface (its roadmap stops at magnet links,
+README.md:39): a magnet join without trackers needs a peer source, and
+the mainline DHT is that source. Implemented from the BEP 5 spec:
+
+- **KRPC**: single-packet bencoded dicts over UDP — ``{t, y: q|r|e, ...}``
+  with the four queries ``ping``, ``find_node``, ``get_peers``,
+  ``announce_peer``.
+- **Routing table**: 160 XOR-metric k-buckets (k=8) keyed by distance
+  to our node id; stale entries are pinged before eviction, fresh nodes
+  replace dead ones (Kademlia's LRU discipline).
+- **Iterative lookups**: alpha=3 parallel queries converging on the k
+  closest nodes to a target; ``get_peers`` lookups collect both values
+  (peer lists) and write tokens for a follow-up ``announce_peer``.
+- **Tokens**: ``sha1(secret || ip)`` with a rotated secret (current +
+  previous accepted) so only nodes that recently answered us can store
+  peers — the BEP 5 anti-spoofing rule.
+
+Everything is a single asyncio ``DatagramProtocol`` endpoint; the whole
+subsystem is exercised against itself on localhost in tests/test_dht.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import random
+import time
+from dataclasses import dataclass, field
+
+from torrent_tpu.codec.bencode import BencodeError, bdecode, bencode
+from torrent_tpu.utils.bytesio import read_int, write_int
+from torrent_tpu.utils.log import get_logger
+
+log = get_logger("net.dht")
+
+K = 8  # bucket size / closest-set size
+ALPHA = 3  # lookup parallelism
+RPC_TIMEOUT = 3.0
+TOKEN_ROTATE_SECS = 300
+PEER_TTL_SECS = 30 * 60
+MAX_PEERS_PER_HASH = 2000
+BOOTSTRAP_TARGET_RETRIES = 2
+
+
+def random_node_id() -> bytes:
+    return os.urandom(20)
+
+
+def xor_distance(a: bytes, b: bytes) -> int:
+    return int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+
+
+def pack_compact_peer(ip: str, port: int) -> bytes:
+    """6-byte IPv4 peer (BEP 5 'values' entry; same layout the tracker's
+    compact response uses)."""
+    return bytes(int(x) for x in ip.split(".")) + write_int(port, 2)
+
+
+def unpack_compact_peers(blob: bytes) -> list[tuple[str, int]]:
+    out = []
+    for i in range(0, len(blob) - len(blob) % 6, 6):
+        ip = ".".join(str(b) for b in blob[i : i + 4])
+        out.append((ip, read_int(blob[i + 4 : i + 6], 2)))
+    return out
+
+
+def pack_compact_node(node_id: bytes, ip: str, port: int) -> bytes:
+    """26-byte node entry: id + compact address."""
+    return node_id + pack_compact_peer(ip, port)
+
+
+def unpack_compact_nodes(blob: bytes) -> list[tuple[bytes, str, int]]:
+    out = []
+    for i in range(0, len(blob) - len(blob) % 26, 26):
+        nid = blob[i : i + 20]
+        addr = unpack_compact_peers(blob[i + 20 : i + 26])
+        if addr:
+            out.append((nid, addr[0][0], addr[0][1]))
+    return out
+
+
+# ------------------------------------------------------------ routing table
+
+
+@dataclass
+class NodeInfo:
+    node_id: bytes
+    ip: str
+    port: int
+    last_seen: float = field(default_factory=time.monotonic)
+    failed: int = 0
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return (self.ip, self.port)
+
+    @property
+    def good(self) -> bool:
+        return self.failed < 2 and time.monotonic() - self.last_seen < 15 * 60
+
+
+class RoutingTable:
+    """160 XOR k-buckets keyed by shared-prefix length with our id."""
+
+    def __init__(self, own_id: bytes):
+        self.own_id = own_id
+        self.buckets: list[list[NodeInfo]] = [[] for _ in range(160)]
+
+    def _bucket_of(self, node_id: bytes) -> list[NodeInfo]:
+        d = xor_distance(self.own_id, node_id)
+        if d == 0:
+            return self.buckets[159]
+        return self.buckets[min(159, 159 - (d.bit_length() - 1))]
+
+    def update(self, node_id: bytes, ip: str, port: int) -> None:
+        """Mark a node seen (insert / refresh / LRU-replace-dead)."""
+        if len(node_id) != 20 or node_id == self.own_id:
+            return
+        bucket = self._bucket_of(node_id)
+        for n in bucket:
+            if n.node_id == node_id:
+                n.ip, n.port = ip, port
+                n.last_seen = time.monotonic()
+                n.failed = 0
+                return
+        node = NodeInfo(node_id, ip, port)
+        if len(bucket) < K:
+            bucket.append(node)
+            return
+        # full: replace the worst dead entry, else drop (BEP 5 favors
+        # long-lived nodes; pinging before replace happens in maintenance)
+        worst = min(bucket, key=lambda n: (n.good, -n.failed, n.last_seen))
+        if not worst.good:
+            bucket[bucket.index(worst)] = node
+
+    def note_failure(self, node_id: bytes) -> None:
+        for n in self._bucket_of(node_id):
+            if n.node_id == node_id:
+                n.failed += 1
+                return
+
+    def closest(self, target: bytes, count: int = K) -> list[NodeInfo]:
+        nodes = [n for bucket in self.buckets for n in bucket if n.good]
+        nodes.sort(key=lambda n: xor_distance(n.node_id, target))
+        return nodes[:count]
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self.buckets)
+
+
+# ------------------------------------------------------------------- tokens
+
+
+class TokenJar:
+    """Rotated write tokens: sha1(secret || ip), current + previous valid."""
+
+    def __init__(self):
+        self._secret = os.urandom(16)
+        self._prev = os.urandom(16)
+        self._rotated = time.monotonic()
+
+    def _maybe_rotate(self) -> None:
+        if time.monotonic() - self._rotated > TOKEN_ROTATE_SECS:
+            self._prev, self._secret = self._secret, os.urandom(16)
+            self._rotated = time.monotonic()
+
+    def issue(self, ip: str) -> bytes:
+        self._maybe_rotate()
+        return hashlib.sha1(self._secret + ip.encode()).digest()[:8]
+
+    def valid(self, ip: str, token: bytes) -> bool:
+        self._maybe_rotate()
+        return token in (
+            hashlib.sha1(self._secret + ip.encode()).digest()[:8],
+            hashlib.sha1(self._prev + ip.encode()).digest()[:8],
+        )
+
+
+# ----------------------------------------------------------------- endpoint
+
+
+class DHTError(Exception):
+    pass
+
+
+class _Protocol(asyncio.DatagramProtocol):
+    def __init__(self, node: "DHTNode"):
+        self.node = node
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        self.node._on_datagram(data, addr)
+
+    def error_received(self, exc) -> None:  # pragma: no cover - host-dependent
+        log.debug("dht socket error: %s", exc)
+
+
+class DHTNode:
+    """One mainline-DHT endpoint: server + query client + lookups."""
+
+    def __init__(self, node_id: bytes | None = None, port: int = 0, host: str = "0.0.0.0"):
+        self.node_id = node_id or random_node_id()
+        self.host = host
+        self.port = port
+        self.table = RoutingTable(self.node_id)
+        self.tokens = TokenJar()
+        # info_hash -> {(ip, port): stored_at}
+        self.peer_store: dict[bytes, dict[tuple[str, int], float]] = {}
+        self._transport: asyncio.DatagramTransport | None = None
+        self._pending: dict[bytes, asyncio.Future] = {}
+        self._tid_counter = random.randrange(1 << 16)
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def start(self) -> "DHTNode":
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _Protocol(self), local_addr=(self.host, self.port)
+        )
+        self.port = self._transport.get_extra_info("sockname")[1]
+        return self
+
+    def close(self) -> None:
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.cancel()
+        self._pending.clear()
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    # ------------------------------------------------------------ raw KRPC
+
+    def _next_tid(self) -> bytes:
+        self._tid_counter = (self._tid_counter + 1) & 0xFFFF
+        return write_int(self._tid_counter, 2)
+
+    async def _query(self, addr: tuple[str, int], q: str, args: dict) -> dict:
+        """Send one KRPC query; return the response ``r`` dict."""
+        if self._transport is None:
+            raise DHTError("node not started")
+        tid = self._next_tid()
+        msg = {b"t": tid, b"y": b"q", b"q": q.encode(), b"a": {b"id": self.node_id, **args}}
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[tid] = fut
+        try:
+            self._transport.sendto(bencode(msg), addr)
+            return await asyncio.wait_for(fut, RPC_TIMEOUT)
+        except asyncio.TimeoutError as e:
+            raise DHTError(f"{q} to {addr} timed out") from e
+        finally:
+            self._pending.pop(tid, None)
+
+    def _respond(self, addr, tid: bytes, r: dict) -> None:
+        if self._transport is not None:
+            self._transport.sendto(
+                bencode({b"t": tid, b"y": b"r", b"r": {b"id": self.node_id, **r}}), addr
+            )
+
+    def _error(self, addr, tid: bytes, code: int, text: str) -> None:
+        if self._transport is not None:
+            self._transport.sendto(
+                bencode({b"t": tid, b"y": b"e", b"e": [code, text.encode()]}), addr
+            )
+
+    # ------------------------------------------------------------- inbound
+
+    def _on_datagram(self, data: bytes, addr) -> None:
+        try:
+            msg = bdecode(data)
+        except BencodeError:
+            return
+        if not isinstance(msg, dict):
+            return
+        tid = msg.get(b"t")
+        kind = msg.get(b"y")
+        if not isinstance(tid, bytes):
+            return
+        if kind == b"r":
+            r = msg.get(b"r")
+            fut = self._pending.get(tid)
+            if fut is not None and not fut.done() and isinstance(r, dict):
+                rid = r.get(b"id")
+                if isinstance(rid, bytes) and len(rid) == 20:
+                    self.table.update(rid, addr[0], addr[1])
+                fut.set_result(r)
+            return
+        if kind == b"e":
+            fut = self._pending.get(tid)
+            if fut is not None and not fut.done():
+                e = msg.get(b"e")
+                text = e[1].decode("utf-8", "replace") if isinstance(e, list) and len(e) > 1 and isinstance(e[1], bytes) else "remote error"
+                fut.set_exception(DHTError(text))
+            return
+        if kind != b"q":
+            return
+        q = msg.get(b"q")
+        a = msg.get(b"a")
+        if not isinstance(a, dict):
+            return
+        qid = a.get(b"id")
+        if isinstance(qid, bytes) and len(qid) == 20:
+            self.table.update(qid, addr[0], addr[1])
+        try:
+            self._handle_query(addr, tid, q, a)
+        except Exception as e:  # malformed args must never kill the endpoint
+            log.debug("dht query error from %s: %s", addr, e)
+            self._error(addr, tid, 203, "protocol error")
+
+    def _handle_query(self, addr, tid: bytes, q, a: dict) -> None:
+        if q == b"ping":
+            self._respond(addr, tid, {})
+            return
+        if q == b"find_node":
+            target = a.get(b"target")
+            if not isinstance(target, bytes) or len(target) != 20:
+                self._error(addr, tid, 203, "bad target")
+                return
+            nodes = b"".join(
+                pack_compact_node(n.node_id, n.ip, n.port)
+                for n in self.table.closest(target)
+            )
+            self._respond(addr, tid, {b"nodes": nodes})
+            return
+        if q == b"get_peers":
+            info_hash = a.get(b"info_hash")
+            if not isinstance(info_hash, bytes) or len(info_hash) != 20:
+                self._error(addr, tid, 203, "bad info_hash")
+                return
+            r: dict = {b"token": self.tokens.issue(addr[0])}
+            peers = self._live_peers(info_hash)
+            if peers:
+                r[b"values"] = [pack_compact_peer(ip, port) for ip, port in peers]
+            else:
+                r[b"nodes"] = b"".join(
+                    pack_compact_node(n.node_id, n.ip, n.port)
+                    for n in self.table.closest(info_hash)
+                )
+            self._respond(addr, tid, r)
+            return
+        if q == b"announce_peer":
+            info_hash = a.get(b"info_hash")
+            token = a.get(b"token")
+            port = a.get(b"port")
+            if not isinstance(info_hash, bytes) or len(info_hash) != 20:
+                self._error(addr, tid, 203, "bad info_hash")
+                return
+            if not isinstance(token, bytes) or not self.tokens.valid(addr[0], token):
+                self._error(addr, tid, 203, "bad token")
+                return
+            if a.get(b"implied_port"):
+                port = addr[1]
+            if not isinstance(port, int) or not 0 < port < 65536:
+                self._error(addr, tid, 203, "bad port")
+                return
+            store = self.peer_store.setdefault(info_hash, {})
+            if len(store) < MAX_PEERS_PER_HASH:
+                store[(addr[0], port)] = time.monotonic()
+            self._respond(addr, tid, {})
+            return
+        self._error(addr, tid, 204, "method unknown")
+
+    def _live_peers(self, info_hash: bytes) -> list[tuple[str, int]]:
+        store = self.peer_store.get(info_hash)
+        if not store:
+            return []
+        cutoff = time.monotonic() - PEER_TTL_SECS
+        for key in [k for k, ts in store.items() if ts < cutoff]:
+            del store[key]
+        return list(store)
+
+    # --------------------------------------------------------- client RPCs
+
+    async def ping(self, addr: tuple[str, int]) -> bytes:
+        r = await self._query(addr, "ping", {})
+        rid = r.get(b"id")
+        if not isinstance(rid, bytes) or len(rid) != 20:
+            raise DHTError("ping response missing id")
+        return rid
+
+    async def find_node(self, addr, target: bytes) -> list[tuple[bytes, str, int]]:
+        r = await self._query(addr, "find_node", {b"target": target})
+        nodes = r.get(b"nodes")
+        return unpack_compact_nodes(nodes) if isinstance(nodes, bytes) else []
+
+    async def get_peers(
+        self, addr, info_hash: bytes
+    ) -> tuple[list[tuple[str, int]], list[tuple[bytes, str, int]], bytes | None]:
+        """→ (peers, closer_nodes, write_token)."""
+        r = await self._query(addr, "get_peers", {b"info_hash": info_hash})
+        token = r.get(b"token")
+        peers: list[tuple[str, int]] = []
+        values = r.get(b"values")
+        if isinstance(values, list):
+            for v in values:
+                if isinstance(v, bytes):
+                    peers.extend(unpack_compact_peers(v))
+        nodes_blob = r.get(b"nodes")
+        nodes = unpack_compact_nodes(nodes_blob) if isinstance(nodes_blob, bytes) else []
+        return peers, nodes, token if isinstance(token, bytes) else None
+
+    async def announce_peer(self, addr, info_hash: bytes, port: int, token: bytes) -> None:
+        await self._query(
+            addr,
+            "announce_peer",
+            {b"info_hash": info_hash, b"port": port, b"token": token, b"implied_port": 0},
+        )
+
+    # ------------------------------------------------------------- lookups
+
+    async def bootstrap(self, addrs: list[tuple[str, int]]) -> int:
+        """Ping seeds then walk towards our own id to fill the table."""
+        for addr in addrs:
+            try:
+                self.table.update(await self.ping(addr), addr[0], addr[1])
+            except DHTError:
+                continue
+        for _ in range(BOOTSTRAP_TARGET_RETRIES):
+            await self.lookup_nodes(self.node_id)
+        return len(self.table)
+
+    async def _iterative(self, target: bytes, want_peers: bool):
+        """Kademlia convergence loop shared by node and peer lookups."""
+        queried: set[tuple[str, int]] = set()
+        candidates: dict[tuple[str, int], bytes] = {
+            n.addr: n.node_id for n in self.table.closest(target, K * 2)
+        }
+        found_peers: set[tuple[str, int]] = set()
+        tokens: dict[tuple[str, int], bytes] = {}
+
+        def rank(addr) -> int:
+            return xor_distance(candidates[addr], target)
+
+        while True:
+            frontier = sorted(
+                (a for a in candidates if a not in queried), key=rank
+            )[:ALPHA]
+            if not frontier:
+                break
+
+            async def visit(addr):
+                queried.add(addr)
+                try:
+                    if want_peers:
+                        peers, nodes, token = await self.get_peers(addr, target)
+                        if token:
+                            tokens[addr] = token
+                        found_peers.update(peers)
+                        return nodes
+                    return await self.find_node(addr, target)
+                except DHTError:
+                    self.table.note_failure(candidates[addr])
+                    return []
+
+            results = await asyncio.gather(*(visit(a) for a in frontier))
+            progressed = False
+            for nodes in results:
+                for nid, ip, port in nodes:
+                    a = (ip, port)
+                    if a not in candidates:
+                        candidates[a] = nid
+                        progressed = True
+            # stop when the closest K known are all queried and nothing new
+            closest = sorted(candidates, key=rank)[:K]
+            if not progressed and all(a in queried for a in closest):
+                break
+        closest = sorted((a for a in candidates if a in queried), key=rank)[:K]
+        return found_peers, closest, candidates, tokens
+
+    async def lookup_nodes(self, target: bytes) -> list[tuple[str, int]]:
+        _, closest, _, _ = await self._iterative(target, want_peers=False)
+        return closest
+
+    async def lookup_peers(self, info_hash: bytes) -> list[tuple[str, int]]:
+        peers, _, _, _ = await self._iterative(info_hash, want_peers=True)
+        return sorted(peers)
+
+    async def announce(self, info_hash: bytes, port: int) -> int:
+        """get_peers convergence then announce_peer to the closest K.
+
+        Returns how many nodes accepted the announce.
+        """
+        _, closest, candidates, tokens = await self._iterative(info_hash, want_peers=True)
+        accepted = 0
+        for addr in closest:
+            token = tokens.get(addr)
+            if token is None:
+                continue
+            try:
+                await self.announce_peer(addr, info_hash, port, token)
+                accepted += 1
+            except DHTError:
+                continue
+        return accepted

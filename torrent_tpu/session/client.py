@@ -45,6 +45,9 @@ class ClientConfig:
     torrent: TorrentConfig = field(default_factory=TorrentConfig)
     enable_upnp: bool = False  # optional, off by default (SURVEY §7.8)
     resume: bool = True  # fastresume checkpoints for path-based storage
+    enable_dht: bool = False  # BEP 5 mainline DHT (net/dht.py)
+    dht_port: int = 0  # 0 = ephemeral UDP port
+    dht_bootstrap: tuple = ()  # ((host, port), ...) seed nodes
 
 
 class Client:
@@ -56,6 +59,7 @@ class Client:
         self._verifier_cache: dict[int, object] = {}
         self.external_ip: str | None = None
         self.port: int | None = None  # assigned by start()
+        self.dht = None  # net.dht.DHTNode when enable_dht
 
     # ------------------------------------------------------------- startup
 
@@ -66,6 +70,14 @@ class Client:
             self._accept, self.config.host, self.config.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.config.enable_dht:
+            from torrent_tpu.net.dht import DHTNode
+
+            self.dht = await DHTNode(
+                port=self.config.dht_port, host=self.config.host
+            ).start()
+            if self.config.dht_bootstrap:
+                await self.dht.bootstrap([tuple(a) for a in self.config.dht_bootstrap])
         if self.config.enable_upnp:
             try:
                 from torrent_tpu.net.upnp import get_ip_addrs_and_map_port
@@ -79,6 +91,9 @@ class Client:
         for torrent in list(self.torrents.values()):
             await torrent.stop()
         self.torrents.clear()
+        if self.dht is not None:
+            self.dht.close()
+            self.dht = None
         if self._server:
             self._server.close()
             await self._server.wait_closed()
@@ -127,6 +142,7 @@ class Client:
             config=self.config.torrent,
             verifier=self._verifier_for(metainfo.info.piece_length),
             resume_store=resume_store,
+            dht=self.dht,
         )
         self.torrents[metainfo.info_hash] = torrent
         await torrent.start()
@@ -156,7 +172,7 @@ class Client:
         # download dials in, our own id would trip its duplicate-peer
         # guard and the data connection would be dropped.
         metainfo = await fetch_metadata(
-            magnet, peer_id=generate_peer_id(), port=self.port
+            magnet, peer_id=generate_peer_id(), port=self.port, dht=self.dht
         )
         torrent = await self.add(metainfo, storage)
         if magnet.peer_addrs:

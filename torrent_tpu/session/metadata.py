@@ -101,13 +101,20 @@ async def fetch_metadata(
     port: int = 6881,
     peer_timeout: float = 10.0,
     max_concurrent: int = 8,
+    dht=None,
 ) -> Metainfo:
-    """Resolve a magnet to a full ``Metainfo`` using trackers + x.pe peers.
+    """Resolve a magnet to a full ``Metainfo`` using trackers + x.pe peers
+    + (when a ``net.dht.DHTNode`` is supplied) mainline-DHT discovery.
 
     Raises ``MetadataError`` if no reachable peer can serve a verified
     info dict.
     """
     candidates: list[tuple[str, int]] = list(magnet.peer_addrs)
+    if dht is not None:
+        try:
+            candidates.extend(await dht.lookup_peers(magnet.info_hash))
+        except Exception as e:
+            log.warning("dht peer lookup failed: %s", e)
     if magnet.trackers:
         from torrent_tpu.net.tracker import TrackerError, announce
 

@@ -85,6 +85,7 @@ class TorrentConfig:
     announce_retry: float = 30.0
     hasher: str = "cpu"  # 'cpu' | 'tpu' — resume-recheck + batch verify
     verify_batch_size: int = 256
+    dht_interval: float = 300.0  # DHT announce/lookup cadence
 
 
 class Torrent:
@@ -97,6 +98,7 @@ class Torrent:
         config: TorrentConfig | None = None,
         verifier=None,  # optional TPUVerifier to share across torrents
         resume_store=None,  # optional session/resume.py store
+        dht=None,  # optional net.dht.DHTNode for trackerless discovery
     ):
         from torrent_tpu.net.multitracker import TrackerList, parse_announce_list
 
@@ -108,6 +110,7 @@ class Torrent:
         self.config = config or TorrentConfig()
         self.verifier = verifier
         self.resume_store = resume_store
+        self.dht = dht
         self.trackers = TrackerList(
             metainfo.announce, parse_announce_list(metainfo.raw)
         )
@@ -165,6 +168,8 @@ class Torrent:
         self._stopping = False
         if self.trackers:
             self._spawn(self._announce_loop(), name="announce")
+        if self.dht is not None:
+            self._spawn(self._dht_loop(), name="dht")
         self._spawn(self._choke_loop(), name="choke")
         self._spawn(self._keepalive_loop(), name="keepalive")
 
@@ -337,6 +342,27 @@ class Torrent:
     def request_peers(self) -> None:
         """Early announce wake (torrent.ts:104-107)."""
         self._wake.set()
+
+    async def _dht_loop(self) -> None:
+        """BEP 5: announce our port and pull swarm peers from the DHT.
+
+        Runs alongside (or instead of — trackerless magnets) the tracker
+        announce loop.
+        """
+        from torrent_tpu.net.types import AnnouncePeer
+
+        ih = self.metainfo.info_hash
+        while not self._stopping:
+            try:
+                await self.dht.announce(ih, self.port)
+                if self.state != TorrentState.SEEDING:
+                    peers = await self.dht.lookup_peers(ih)
+                    self._connect_new_peers(
+                        [AnnouncePeer(ip=h, port=p) for h, p in peers]
+                    )
+            except Exception as e:
+                log.debug("dht round failed: %s", e)
+            await asyncio.sleep(self.config.dht_interval)
 
     # ------------------------------------------------------------- dialing
 

@@ -102,7 +102,21 @@ def _cmd_verify(args) -> int:
 async def _download(args) -> int:
     from torrent_tpu.session.client import Client, ClientConfig
 
-    config = ClientConfig(port=args.port, hasher=args.hasher, resume=not args.no_resume)
+    bootstrap = []
+    for spec in args.dht_bootstrap:
+        host, _, port_s = spec.rpartition(":")
+        try:
+            bootstrap.append((host.strip("[]"), int(port_s)))
+        except ValueError:
+            print(f"error: bad --dht-bootstrap {spec!r}", file=sys.stderr)
+            return 1
+    config = ClientConfig(
+        port=args.port,
+        hasher=args.hasher,
+        resume=not args.no_resume,
+        enable_dht=args.dht or bool(bootstrap),
+        dht_bootstrap=tuple(bootstrap),
+    )
     client = Client(config)
     await client.start()
     stop = asyncio.Event()
@@ -163,9 +177,8 @@ def _cmd_download(args) -> int:
 def _cmd_tracker(args) -> int:
     from torrent_tpu.server.in_memory import main as tracker_main
 
-    udp = args.udp_port if args.udp_port is not None else -1  # -1 = disabled
     return tracker_main(
-        ["--http-port", str(args.http_port), "--udp-port", str(udp),
+        ["--http-port", str(args.http_port), "--udp-port", str(args.udp_port),
          "--interval", str(args.interval)]
     )
 
@@ -207,11 +220,21 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hasher", choices=("cpu", "tpu"), default="cpu")
     sp.add_argument("--seed", action="store_true", help="keep seeding after completion")
     sp.add_argument("--no-resume", action="store_true", help="skip fastresume checkpoints")
+    sp.add_argument("--dht", action="store_true", help="enable BEP 5 mainline DHT discovery")
+    sp.add_argument(
+        "--dht-bootstrap",
+        action="append",
+        default=[],
+        metavar="HOST:PORT",
+        help="DHT bootstrap node (repeatable; implies --dht)",
+    )
     sp.set_defaults(fn=_cmd_download)
 
     sp = sub.add_parser("tracker", help="run the in-memory tracker server")
     sp.add_argument("--http-port", type=int, default=8080)
-    sp.add_argument("--udp-port", type=int, default=None)
+    # same default as the standalone torrent-tracker entrypoint; negative
+    # disables UDP
+    sp.add_argument("--udp-port", type=int, default=6969)
     sp.add_argument("--interval", type=int, default=600)
     sp.set_defaults(fn=_cmd_tracker)
 

@@ -1,0 +1,172 @@
+"""Tracker state persistence + CLI scrape tests.
+
+The reference's tracker is memory-only (state dies with the process,
+server/in_memory_tracker.ts:53-59); here a bencoded snapshot keeps
+lifetime counters and live peers across restarts.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from torrent_tpu.net.types import AnnounceEvent
+from torrent_tpu.server.in_memory import (
+    PEER_TTL,
+    FileInfo,
+    InMemoryTracker,
+    PeerState,
+    run_tracker,
+)
+from torrent_tpu.server.tracker import ServeOptions
+
+
+def run(coro, timeout=30):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+IH = bytes(range(20))
+
+
+def populated_tracker() -> InMemoryTracker:
+    t = InMemoryTracker()
+    info = FileInfo(complete=2, downloaded=17, incomplete=3)
+    info.peers[b"P" * 20] = PeerState(b"P" * 20, "10.0.0.1", 6881, left=0)
+    info.peers[b"Q" * 20] = PeerState(b"Q" * 20, "10.0.0.2", 6882, left=500)
+    t.files[IH] = info
+    return t
+
+
+class TestStateFile:
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "state.benc")
+        src = populated_tracker()
+        src.save_state(path)
+        dst = InMemoryTracker()
+        assert dst.load_state(path)
+        info = dst.files[IH]
+        assert (info.complete, info.downloaded, info.incomplete) == (2, 17, 3)
+        assert info.peers[b"P" * 20].ip == "10.0.0.1"
+        assert info.peers[b"Q" * 20].left == 500
+        # ages restored relative to now
+        assert time.monotonic() - info.peers[b"P" * 20].last_seen < 5
+
+    def test_stale_peers_swept_on_load(self, tmp_path):
+        path = str(tmp_path / "state.benc")
+        src = populated_tracker()
+        src.files[IH].peers[b"P" * 20].last_seen -= PEER_TTL + 60
+        src.save_state(path)
+        dst = InMemoryTracker()
+        assert dst.load_state(path)
+        assert b"P" * 20 not in dst.files[IH].peers  # expired in transit
+        assert b"Q" * 20 in dst.files[IH].peers
+
+    def test_load_missing_or_garbage(self, tmp_path):
+        t = InMemoryTracker()
+        assert not t.load_state(str(tmp_path / "nope"))
+        bad = tmp_path / "bad"
+        bad.write_bytes(b"not bencode at all")
+        assert not t.load_state(str(bad))
+        bad.write_bytes(b"d7:version i2ee")  # wrong version shape
+        assert not t.load_state(str(bad))
+
+    def test_run_tracker_restores_and_persists(self, tmp_path):
+        path = str(tmp_path / "state.benc")
+        populated_tracker().save_state(path)
+
+        async def go():
+            server, pump = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1), state_file=path
+            )
+            tracker = pump.tracker
+            assert tracker.files[IH].downloaded == 17  # restored
+            tracker.files[IH].downloaded = 99
+            server.close()  # ends the request stream; pump exits its loop
+            await asyncio.wait_for(pump, 10)
+            # shutdown persisted the mutation
+            fresh = InMemoryTracker()
+            assert fresh.load_state(path)
+            assert fresh.files[IH].downloaded == 99
+
+        run(go())
+
+
+class TestCliScrape:
+    def test_scrape_live_tracker(self, tmp_path, capsys):
+        """CLI scrape against a live in-memory tracker with one announce."""
+        import threading
+
+        from torrent_tpu.tools.cli import main
+
+        ready = threading.Event()
+        done = threading.Event()
+        box = {}
+
+        async def tracker_side():
+            server, pump = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            info = FileInfo(complete=1, downloaded=5, incomplete=2)
+            pump.tracker.files[IH] = info
+            box["port"] = server.http_port
+            ready.set()
+            while not done.is_set():
+                await asyncio.sleep(0.05)
+            server.close()
+            pump.cancel()
+
+        th = threading.Thread(target=lambda: asyncio.run(tracker_side()), daemon=True)
+        th.start()
+        assert ready.wait(15)
+        try:
+            rc = main(
+                ["scrape", "--url", f"http://127.0.0.1:{box['port']}/announce", IH.hex()]
+            )
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert f"{IH.hex()}  seeders=1 leechers=2 downloaded=5" in out
+        finally:
+            done.set()
+            th.join(10)
+
+    def test_scrape_arg_errors(self, capsys):
+        from torrent_tpu.tools.cli import main
+
+        assert main(["scrape", "--url", "http://x/announce", "zz"]) == 1
+        assert main(["scrape", "--url", "http://x/announce"]) == 1
+        assert main(["scrape", "--url", "http://x/announce", "ab" * 10]) == 1
+
+
+class TestLoadRobustness:
+    def test_malformed_counter_types(self, tmp_path):
+        """A snapshot with non-int counters must be skipped, not crash."""
+        from torrent_tpu.codec.bencode import bencode
+
+        bad = tmp_path / "bad"
+        bad.write_bytes(
+            bencode({b"version": 1, b"files": {IH: {b"complete": b"12"}}})
+        )
+        t = InMemoryTracker()
+        assert t.load_state(str(bad))  # loads, skipping the bad entry
+        assert IH not in t.files
+
+    def test_malformed_peer_fields(self, tmp_path):
+        from torrent_tpu.codec.bencode import bencode
+
+        bad = tmp_path / "bad"
+        bad.write_bytes(
+            bencode(
+                {
+                    b"version": 1,
+                    b"files": {
+                        IH: {
+                            b"complete": 1,
+                            b"peers": {b"P" * 20: {b"ip": 42, b"port": 1, b"left": 0}},
+                        }
+                    },
+                }
+            )
+        )
+        t = InMemoryTracker()
+        assert t.load_state(str(bad))
+        assert t.files[IH].peers == {}  # bad peer dropped, file kept

@@ -27,6 +27,7 @@ import asyncio
 import hashlib
 import os
 import random
+import socket
 import time
 from dataclasses import dataclass, field
 
@@ -285,11 +286,15 @@ class DHTNode:
         if kind == b"r":
             r = msg.get(b"r")
             fut = self._pending.get(tid)
-            if fut is not None and not fut.done() and isinstance(r, dict):
-                rid = r.get(b"id")
-                if isinstance(rid, bytes) and len(rid) == 20:
-                    self.table.update(rid, addr[0], addr[1])
-                fut.set_result(r)
+            if fut is not None and not fut.done():
+                if isinstance(r, dict):
+                    rid = r.get(b"id")
+                    if isinstance(rid, bytes) and len(rid) == 20:
+                        self.table.update(rid, addr[0], addr[1])
+                    fut.set_result(r)
+                else:
+                    # fail fast instead of burning the full RPC timeout
+                    fut.set_exception(DHTError("malformed response payload"))
             return
         if kind == b"e":
             fut = self._pending.get(tid)
@@ -415,10 +420,21 @@ class DHTNode:
     # ------------------------------------------------------------- lookups
 
     async def bootstrap(self, addrs: list[tuple[str, int]]) -> int:
-        """Ping seeds then walk towards our own id to fill the table."""
+        """Ping seeds then walk towards our own id to fill the table.
+
+        Seed hostnames are resolved first — the routing table must only
+        ever hold numeric IPv4 addresses (compact-node packing needs
+        them, and sendto on a hostname does blocking DNS per packet).
+        """
+        loop = asyncio.get_running_loop()
         for addr in addrs:
             try:
-                self.table.update(await self.ping(addr), addr[0], addr[1])
+                infos = await loop.getaddrinfo(addr[0], addr[1], family=socket.AF_INET)
+                ip_addr = (infos[0][4][0], addr[1])
+            except OSError:
+                continue
+            try:
+                self.table.update(await self.ping(ip_addr), ip_addr[0], ip_addr[1])
             except DHTError:
                 continue
         for _ in range(BOOTSTRAP_TARGET_RETRIES):

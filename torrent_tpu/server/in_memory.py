@@ -147,6 +147,101 @@ class InMemoryTracker:
 
     # ------------------------------------------------------------ dispatch
 
+    # -------------------------------------------------------- persistence
+
+    def save_state(self, path: str) -> None:
+        """Snapshot swarm state to disk (bencoded) so a tracker restart
+        keeps its lifetime counters and live peer lists.
+
+        ``last_seen`` is stored as *age in seconds* — monotonic clocks
+        don't survive a process, ages do.
+        """
+        import os
+
+        from torrent_tpu.codec.bencode import bencode
+
+        now = time.monotonic()
+        files = {}
+        for ih, info in self.files.items():
+            files[ih] = {
+                b"complete": info.complete,
+                b"downloaded": info.downloaded,
+                b"incomplete": info.incomplete,
+                b"peers": {
+                    ps.peer_id: {
+                        b"ip": ps.ip.encode(),
+                        b"port": ps.port,
+                        b"left": ps.left,
+                        b"age": int(now - ps.last_seen),
+                    }
+                    for ps in info.peers.values()
+                },
+            }
+        blob = bencode({b"version": 1, b"files": files})
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)  # atomic: no torn state file on crash
+
+    def load_state(self, path: str) -> bool:
+        """Restore a ``save_state`` snapshot; False if absent/invalid."""
+        from torrent_tpu.codec.bencode import BencodeError, bdecode
+
+        try:
+            with open(path, "rb") as f:
+                decoded = bdecode(f.read())
+        except (OSError, BencodeError):
+            return False
+        if not isinstance(decoded, dict) or decoded.get(b"version") != 1:
+            return False
+        files = decoded.get(b"files")
+        if not isinstance(files, dict):
+            return False
+        now = time.monotonic()
+        # Parse fully into a scratch dict first — a snapshot that turns
+        # out malformed halfway through must not leave partial state.
+        loaded: dict[bytes, FileInfo] = {}
+        try:
+            for ih, d in files.items():
+                if not (isinstance(ih, bytes) and len(ih) == 20 and isinstance(d, dict)):
+                    continue
+                counters = [d.get(k, 0) for k in (b"complete", b"downloaded", b"incomplete")]
+                if not all(isinstance(c, int) for c in counters):
+                    continue
+                info = FileInfo(
+                    complete=counters[0], downloaded=counters[1], incomplete=counters[2]
+                )
+                peers = d.get(b"peers")
+                if isinstance(peers, dict):
+                    for pid, p in peers.items():
+                        if not (isinstance(pid, bytes) and isinstance(p, dict)):
+                            continue
+                        ip, port, left = p.get(b"ip"), p.get(b"port"), p.get(b"left")
+                        age = p.get(b"age", 0)
+                        if not (
+                            isinstance(ip, bytes)
+                            and isinstance(port, int)
+                            and isinstance(left, int)
+                            and isinstance(age, int)
+                        ):
+                            continue
+                        try:
+                            info.peers[pid] = PeerState(
+                                peer_id=pid,
+                                ip=ip.decode(),
+                                port=port,
+                                left=left,
+                                last_seen=now - age,
+                            )
+                        except UnicodeDecodeError:
+                            continue
+                loaded[ih] = info
+        except (TypeError, ValueError, AttributeError):
+            return False
+        self.files.update(loaded)
+        self.sweep()  # drop peers whose stored age already exceeds the TTL
+        return True
+
     async def handle(self, req) -> None:
         if isinstance(req, AnnounceRequest):
             await self.handle_announce(req)
@@ -154,33 +249,51 @@ class InMemoryTracker:
             await self.handle_scrape(req)
 
 
-async def run_tracker(opts: ServeOptions | None = None) -> tuple[TrackerServer, asyncio.Task]:
+async def run_tracker(
+    opts: ServeOptions | None = None, state_file: str | None = None
+) -> tuple[TrackerServer, asyncio.Task]:
     """Serve + drive an InMemoryTracker (in_memory_tracker.ts:167-181).
 
     Returns the server (for ports/close) and the pump task. The periodic
     sweep rides the pump loop's timeout rather than a separate timer.
+    With ``state_file``, swarm state is restored at startup and saved on
+    every sweep and at shutdown — a restart keeps lifetime ``downloaded``
+    counters and live peers.
     """
     server = await serve_tracker(opts)
     tracker = InMemoryTracker(interval=(opts.interval if opts else DEFAULT_ANNOUNCE_INTERVAL))
+    if state_file:
+        tracker.load_state(state_file)
+
+    def _persist():
+        if state_file:
+            try:
+                tracker.save_state(state_file)
+            except OSError:
+                pass  # persistence is best-effort; serving goes on
 
     async def pump():
         last_sweep = time.monotonic()
         it = server.__aiter__()
-        while True:
-            try:
-                req = await asyncio.wait_for(it.__anext__(), timeout=60)
-            except asyncio.TimeoutError:
-                req = None
-            except StopAsyncIteration:
-                break
-            if req is not None:
+        try:
+            while True:
                 try:
-                    await tracker.handle(req)
-                except Exception:
-                    pass  # one bad request must not kill the tracker
-            if time.monotonic() - last_sweep > SWEEP_INTERVAL:
-                tracker.sweep()
-                last_sweep = time.monotonic()
+                    req = await asyncio.wait_for(it.__anext__(), timeout=60)
+                except asyncio.TimeoutError:
+                    req = None
+                except StopAsyncIteration:
+                    break
+                if req is not None:
+                    try:
+                        await tracker.handle(req)
+                    except Exception:
+                        pass  # one bad request must not kill the tracker
+                if time.monotonic() - last_sweep > SWEEP_INTERVAL:
+                    tracker.sweep()
+                    _persist()
+                    last_sweep = time.monotonic()
+        finally:
+            _persist()
 
     task = asyncio.create_task(pump())
     task.tracker = tracker  # expose state for tests/stats
@@ -196,6 +309,7 @@ def main(argv=None):  # pragma: no cover - manual entrypoint (in_memory_tracker.
         "--udp-port", type=int, default=6969, help="negative value disables UDP"
     )
     parser.add_argument("--interval", type=int, default=600)
+    parser.add_argument("--state-file", help="persist swarm state across restarts")
     args = parser.parse_args(argv)
 
     async def go():
@@ -204,7 +318,8 @@ def main(argv=None):  # pragma: no cover - manual entrypoint (in_memory_tracker.
                 http_port=args.http_port,
                 udp_port=args.udp_port if args.udp_port >= 0 else None,
                 interval=args.interval,
-            )
+            ),
+            state_file=args.state_file,
         )
         print(f"tracker listening: http={server.http_port} udp={server.udp_port}")
         await task

@@ -174,12 +174,66 @@ def _cmd_download(args) -> int:
     return asyncio.run(_download(args))
 
 
+def _cmd_scrape(args) -> int:
+    from torrent_tpu.net.tracker import TrackerError, scrape
+
+    hashes = []
+    if args.torrent:
+        from torrent_tpu.codec.metainfo import parse_metainfo
+
+        with open(args.torrent, "rb") as f:
+            m = parse_metainfo(f.read())
+        if m is None:
+            print("error: not a valid .torrent file", file=sys.stderr)
+            return 1
+        hashes.append(m.info_hash)
+        url = args.url or m.announce
+    else:
+        url = args.url
+    for h in args.info_hash:
+        try:
+            raw = bytes.fromhex(h)
+        except ValueError:
+            print(f"error: bad info hash {h!r}", file=sys.stderr)
+            return 1
+        if len(raw) != 20:
+            print(f"error: info hash must be 40 hex chars: {h!r}", file=sys.stderr)
+            return 1
+        hashes.append(raw)
+    if not url or not hashes:
+        print("error: need a tracker URL and at least one info hash", file=sys.stderr)
+        return 1
+
+    async def go():
+        try:
+            entries = await scrape(url, hashes)
+        except TrackerError as e:
+            print(f"scrape failed: {e}", file=sys.stderr)
+            return 1
+        # key by the entry's own hash — HTTP trackers return files in
+        # their own order and may omit hashes they don't know
+        by_hash = {e.info_hash: e for e in entries}
+        for h in hashes:
+            e = by_hash.get(h)
+            if e is None:
+                print(f"{h.hex()}  (unknown to tracker)")
+            else:
+                print(
+                    f"{h.hex()}  seeders={e.complete} leechers={e.incomplete} "
+                    f"downloaded={e.downloaded}"
+                )
+        return 0
+
+    return asyncio.run(go())
+
+
 def _cmd_tracker(args) -> int:
     from torrent_tpu.server.in_memory import main as tracker_main
 
     return tracker_main(
         ["--http-port", str(args.http_port), "--udp-port", str(args.udp_port),
          "--interval", str(args.interval)]
+        + (["--state-file", args.state_file] if args.state_file else [])
     )
 
 
@@ -230,12 +284,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.set_defaults(fn=_cmd_download)
 
+    sp = sub.add_parser("scrape", help="scrape seeder/leecher stats from a tracker")
+    sp.add_argument("--url", help="tracker announce URL (derived from --torrent if omitted)")
+    sp.add_argument("--torrent", help=".torrent whose tracker + hash to scrape")
+    sp.add_argument("info_hash", nargs="*", help="40-hex info hashes")
+    sp.set_defaults(fn=_cmd_scrape)
+
     sp = sub.add_parser("tracker", help="run the in-memory tracker server")
     sp.add_argument("--http-port", type=int, default=8080)
     # same default as the standalone torrent-tracker entrypoint; negative
     # disables UDP
     sp.add_argument("--udp-port", type=int, default=6969)
     sp.add_argument("--interval", type=int, default=600)
+    sp.add_argument("--state-file", help="persist swarm state across restarts")
     sp.set_defaults(fn=_cmd_tracker)
 
     sp = sub.add_parser("bridge", help="run the TPU hash-plane HTTP bridge")

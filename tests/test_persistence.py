@@ -45,7 +45,9 @@ class TestStateFile:
         dst = InMemoryTracker()
         assert dst.load_state(path)
         info = dst.files[IH]
-        assert (info.complete, info.downloaded, info.incomplete) == (2, 17, 3)
+        # downloaded is lifetime state; complete/incomplete are derived
+        # from the restored live peers (1 seeder P, 1 leecher Q)
+        assert (info.complete, info.downloaded, info.incomplete) == (1, 17, 1)
         assert info.peers[b"P" * 20].ip == "10.0.0.1"
         assert info.peers[b"Q" * 20].left == 500
         # ages restored relative to now
@@ -170,3 +172,36 @@ class TestLoadRobustness:
         t = InMemoryTracker()
         assert t.load_state(str(bad))
         assert t.files[IH].peers == {}  # bad peer dropped, file kept
+
+    def test_out_of_range_peer_fields_dropped(self, tmp_path):
+        """port > 65535 or negative age would poison announce packing /
+        TTL sweeps — such peers must not be restored, and counters must
+        reflect only surviving peers."""
+        from torrent_tpu.codec.bencode import bencode
+
+        bad = tmp_path / "bad"
+        bad.write_bytes(
+            bencode(
+                {
+                    b"version": 1,
+                    b"files": {
+                        IH: {
+                            b"complete": 3,  # phantom counters in snapshot
+                            b"incomplete": 4,
+                            b"downloaded": 8,
+                            b"peers": {
+                                b"A" * 20: {b"ip": b"1.1.1.1", b"port": 70000, b"left": 0},
+                                b"B" * 20: {b"ip": b"2.2.2.2", b"port": 6881, b"left": 0,
+                                            b"age": -5},
+                                b"C" * 20: {b"ip": b"3.3.3.3", b"port": 6882, b"left": 9},
+                            },
+                        }
+                    },
+                }
+            )
+        )
+        t = InMemoryTracker()
+        assert t.load_state(str(bad))
+        info = t.files[IH]
+        assert set(info.peers) == {b"C" * 20}
+        assert (info.complete, info.incomplete, info.downloaded) == (0, 1, 8)

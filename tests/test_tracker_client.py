@@ -329,3 +329,83 @@ class TestUdpTracker:
             await with_udp_tracker("silent", fn)
 
         run(go())
+
+
+class TestBep7Peers6:
+    def test_client_parses_peers6(self):
+        """BEP 7: 18-byte compact IPv6 entries in the peers6 key."""
+        import socket
+
+        async def go():
+            v6 = socket.inet_pton(socket.AF_INET6, "2001:db8::7") + write_int(7000, 2)
+            body = bencode(
+                {
+                    b"interval": 60,
+                    b"peers": bytes([10, 0, 0, 1]) + write_int(6881, 2),
+                    b"peers6": v6,
+                }
+            )
+            async with FakeHttpTracker(body) as t:
+                res = await announce(
+                    f"http://127.0.0.1:{t.port}/announce", make_info()
+                )
+                assert [(p.ip, p.port) for p in res.peers] == [
+                    ("10.0.0.1", 6881),
+                    ("2001:db8::7", 7000),
+                ]
+
+        run(go())
+
+    def test_bad_peers6_length_rejected(self):
+        async def go():
+            body = bencode({b"interval": 60, b"peers": b"", b"peers6": b"short"})
+            async with FakeHttpTracker(body) as t:
+                with pytest.raises(TrackerError, match="peers6"):
+                    await announce(f"http://127.0.0.1:{t.port}/announce", make_info())
+
+        run(go())
+
+    def test_server_packs_peers6_roundtrip(self):
+        """Our server's compact response splits v4/v6 peers per BEP 7 and
+        our client reassembles them — free integration coverage the
+        reference never had."""
+        import socket
+
+        from torrent_tpu.server.in_memory import FileInfo, PeerState, run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+
+        async def go():
+            server, pump = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            try:
+                ih = bytes(range(20))
+                info = FileInfo(complete=2, downloaded=0, incomplete=0)
+                info.peers[b"4" * 20] = PeerState(b"4" * 20, "10.1.1.1", 6881, left=0)
+                info.peers[b"6" * 20] = PeerState(b"6" * 20, "2001:db8::9", 6882, left=0)
+                pump.tracker.files[ih] = info
+                res = await announce(
+                    f"http://127.0.0.1:{server.http_port}/announce",
+                    make_info(info_hash=ih, left=100),
+                )
+                got = {(p.ip, p.port) for p in res.peers}
+                assert ("10.1.1.1", 6881) in got
+                assert ("2001:db8::9", 6882) in got
+            finally:
+                server.close()
+                pump.cancel()
+
+        run(go())
+
+    def test_peers6_only_response(self):
+        """BEP 7 IPv6-only tracker: no peers key at all is still valid."""
+        import socket
+
+        async def go():
+            v6 = socket.inet_pton(socket.AF_INET6, "::1") + write_int(9000, 2)
+            body = bencode({b"interval": 60, b"peers6": v6})
+            async with FakeHttpTracker(body) as t:
+                res = await announce(f"http://127.0.0.1:{t.port}/announce", make_info())
+                assert [(p.ip, p.port) for p in res.peers] == [("::1", 9000)]
+
+        run(go())

@@ -149,6 +149,20 @@ def _parse_compact_peers(blob: bytes) -> list[AnnouncePeer]:
     return peers
 
 
+def _parse_compact_peers6(blob: bytes) -> list[AnnouncePeer]:
+    """18-byte ip6+port entries (BEP 7 ``peers6`` — beyond the reference,
+    which is IPv4-only)."""
+    import socket
+
+    if len(blob) % 18 != 0:
+        raise TrackerError("compact peers6 blob not a multiple of 18")
+    peers = []
+    for i in range(0, len(blob), 18):
+        ip = socket.inet_ntop(socket.AF_INET6, blob[i : i + 16])
+        peers.append(AnnouncePeer(ip=ip, port=read_int(blob, 2, i + 16)))
+    return peers
+
+
 _FULL_PEER_SHAPE = valid.obj(
     {b"ip": valid.bstr(), b"port": valid.num(), b"peer id": valid.optional(valid.bstr())}
 )
@@ -171,6 +185,7 @@ def _parse_http_announce(body: bytes) -> AnnounceResponse:
     if not valid.is_int(interval):
         raise TrackerError("announce response missing interval")
     raw_peers = data.get(b"peers")
+    raw6 = data.get(b"peers6")
     if isinstance(raw_peers, bytes):
         peers = _parse_compact_peers(raw_peers)
     elif isinstance(raw_peers, list):
@@ -185,8 +200,12 @@ def _parse_http_announce(body: bytes) -> AnnounceResponse:
                     peer_id=p.get(b"peer id"),
                 )
             )
+    elif isinstance(raw6, bytes):
+        peers = []  # IPv6-only tracker (BEP 7): peers6 alone is valid
     else:
         raise TrackerError("announce response missing peers")
+    if isinstance(raw6, bytes):
+        peers.extend(_parse_compact_peers6(raw6))
     warning = data.get(b"warning message")
     return AnnounceResponse(
         interval=interval,

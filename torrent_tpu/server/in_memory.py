@@ -221,8 +221,11 @@ class InMemoryTracker:
                         if not (
                             isinstance(ip, bytes)
                             and isinstance(port, int)
+                            and 0 < port < 65536  # compact packing needs u16
                             and isinstance(left, int)
+                            and left >= 0
                             and isinstance(age, int)
+                            and age >= 0  # a future last_seen never expires
                         ):
                             continue
                         try:
@@ -235,6 +238,11 @@ class InMemoryTracker:
                             )
                         except UnicodeDecodeError:
                             continue
+                # Live counters are derived state — recompute from the
+                # peers that actually survived validation so a dropped
+                # entry can't leave a phantom seeder/leecher behind.
+                info.complete = sum(1 for ps in info.peers.values() if ps.is_seeder)
+                info.incomplete = len(info.peers) - info.complete
                 loaded[ih] = info
         except (TypeError, ValueError, AttributeError):
             return False

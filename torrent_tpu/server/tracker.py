@@ -96,10 +96,25 @@ def _pack_peers_compact(peers) -> bytes:
         try:
             octets = bytes(int(x) for x in p.ip.split("."))
         except ValueError:
-            continue  # non-IPv4 peers can't ride a compact response
+            continue  # IPv6 peers ride the peers6 key (BEP 7) instead
         if len(octets) != 4:
             continue
         out += octets + write_int(p.port, 2)
+    return bytes(out)
+
+
+def _pack_peers_compact6(peers) -> bytes:
+    """BEP 7 ``peers6``: 16-byte address + 2-byte port per IPv6 peer."""
+    import socket
+
+    out = bytearray()
+    for p in peers:
+        if ":" not in p.ip:
+            continue
+        try:
+            out += socket.inet_pton(socket.AF_INET6, p.ip) + write_int(p.port, 2)
+        except OSError:
+            continue
     return bytes(out)
 
 
@@ -120,14 +135,17 @@ class HttpAnnounceRequest(AnnounceRequest):
                 }
                 for p in peers
             ]
-        body = bencode(
-            {
-                b"interval": interval,
-                b"complete": complete,
-                b"incomplete": incomplete,
-                b"peers": peers_val,
-            }
-        )
+        reply = {
+            b"interval": interval,
+            b"complete": complete,
+            b"incomplete": incomplete,
+            b"peers": peers_val,
+        }
+        if self.compact:
+            peers6 = _pack_peers_compact6(peers)
+            if peers6:
+                reply[b"peers6"] = peers6  # BEP 7
+        body = bencode(reply)
         await _http_reply(self._writer, 200, body)
 
     async def reject(self, reason: str):

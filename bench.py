@@ -11,7 +11,17 @@ on-device digest compare.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Env knobs: BENCH_TOTAL_MB (default 1024), BENCH_BATCH (default 1024),
-BENCH_BACKEND (jax|pallas, default best available).
+BENCH_BACKEND (jax|pallas, default best available), BENCH_PLATFORM.
+
+BENCH_CONFIG selects the measured workload (BASELINE.md configs; every
+mode prints one JSON line):
+- ``headline`` (default) — config 1/4 shape: synthetic single-file full
+  recheck, 256 KiB pieces (BENCH_PIECE_KB to change, e.g. 1024 for the
+  100 GiB/1 MiB config at scale)
+- ``multifile``  — config 2: recheck with pieces spanning file boundaries
+- ``author``     — config 3: make_torrent-style authoring digests
+- ``bulk``       — config 5 at single-host scale: N torrents validated
+  concurrently through one shared verifier (BENCH_BULK_N, default 8)
 """
 
 from __future__ import annotations
@@ -45,7 +55,8 @@ def main() -> None:
     total_mb = int(os.environ.get("BENCH_TOTAL_MB", "1024"))
     batch = int(os.environ.get("BENCH_BATCH", "1024"))
     backend = os.environ.get("BENCH_BACKEND", "")
-    plen = 256 * 1024
+    config = os.environ.get("BENCH_CONFIG", "headline")
+    plen = int(os.environ.get("BENCH_PIECE_KB", "256")) * 1024
     n_pieces = total_mb * (1 << 20) // plen
     total = n_pieces * plen
 
@@ -93,10 +104,18 @@ def main() -> None:
         backend = "jax" if plat == "cpu" else "pallas"
 
     class _PayloadMethod:
-        """Zero-copy storage backend over the benchmark payload."""
+        """Zero-copy storage backend over the benchmark payload.
+
+        ``starts`` maps each file path to its global byte offset so the
+        multifile config's file-relative reads land correctly.
+        """
+
+        def __init__(self, starts=None):
+            self.starts = starts or {}
 
         def get(self, path, offset, length):
-            return payload[offset : offset + length].tobytes()
+            base = self.starts.get(path, 0)
+            return payload[base + offset : base + offset + length].tobytes()
 
         def set(self, path, offset, data):
             raise NotImplementedError
@@ -104,12 +123,91 @@ def main() -> None:
         def exists(self, path, length=None):
             return True
 
-    info = InfoDict(
-        name="bench", piece_length=plen, pieces=tuple(digests), length=total, files=None
-    )
-    storage = Storage(_PayloadMethod(), info)
+    if config == "multifile":
+        # config 2: ~7 uneven files so pieces span boundaries
+        from torrent_tpu.codec.metainfo import FileEntry
+
+        cuts = sorted({1, total // 3 - 1234, total // 2 + 77, total * 5 // 7, total})
+        files, prev = [], 0
+        for i, c in enumerate(cuts):
+            files.append(FileEntry(length=c - prev, path=(f"f{i}.bin",)))
+            prev = c
+        info = InfoDict(
+            name="bench",
+            piece_length=plen,
+            pieces=tuple(digests),
+            length=total,
+            files=tuple(files),
+        )
+    else:
+        info = InfoDict(
+            name="bench", piece_length=plen, pieces=tuple(digests), length=total, files=None
+        )
+    starts = {}
+    if info.files is not None:
+        pos = 0
+        for fe in info.files:
+            starts[(info.name, *fe.path)] = pos
+            pos += fe.length
+    storage = Storage(_PayloadMethod(starts), info)
 
     verifier = TPUVerifier(piece_length=plen, batch_size=batch, backend=backend)
+
+    if config == "author":
+        # config 3: authoring-side digests (make_torrent hot loop) via the
+        # batched hash plane; baseline = the sampled hashlib rate above.
+        # Pieces are materialized one batch at a time — a full list copy
+        # would double resident memory at the 10 GiB documented scale.
+        def batch_pieces(start):
+            stop = min(start + batch, n_pieces)
+            return [payload[i * plen : (i + 1) * plen].tobytes() for i in range(start, stop)]
+
+        verifier.hash_pieces(batch_pieces(0))  # warmup/compile
+        out = []
+        t0 = time.perf_counter()
+        for start in range(0, n_pieces, batch):
+            out.extend(verifier.hash_pieces(batch_pieces(start)))
+        secs = time.perf_counter() - t0
+        assert out == digests
+        pps = n_pieces / secs
+        print(
+            json.dumps(
+                {
+                    "metric": f"sha1_author_{plen // 1024}KiB_pieces_per_sec",
+                    "value": round(pps, 1),
+                    "unit": "pieces/s",
+                    "vs_baseline": round(pps / cpu_pps, 2),
+                }
+            )
+        )
+        return
+
+    if config == "bulk":
+        # config 5 at single-host scale: a library of torrents validated
+        # through one shared verifier.
+        from torrent_tpu.parallel.bulk import verify_library
+
+        n_torrents = int(os.environ.get("BENCH_BULK_N", "8"))
+        jobs = [(storage, info) for _ in range(n_torrents)]
+        # share one compiled verifier so the warmup's compile actually
+        # warms the timed run
+        verify_library(jobs[:1], verifier=verifier)
+        t0 = time.perf_counter()
+        result = verify_library(jobs, verifier=verifier)
+        secs = time.perf_counter() - t0
+        assert all(bf.all() for bf in result.bitfields)
+        pps = n_torrents * n_pieces / secs
+        print(
+            json.dumps(
+                {
+                    "metric": f"sha1_bulk_{n_torrents}x{total_mb}MB_pieces_per_sec",
+                    "value": round(pps, 1),
+                    "unit": "pieces/s",
+                    "vs_baseline": round(pps / cpu_pps, 2),
+                }
+            )
+        )
+        return
     # Warmup: compile + first transfer.
     warm_idx = list(range(min(batch, n_pieces)))
     padded, view = np.zeros((batch, verifier.padded_len), dtype=np.uint8), None
@@ -128,8 +226,11 @@ def main() -> None:
     assert bitfield.all(), f"verify failed: {int(bitfield.sum())}/{n_pieces}"
     tpu_pps = n_pieces / tpu_secs
 
+    metric = f"sha1_recheck_{plen // 1024}KiB_pieces_per_sec"
+    if config == "multifile":
+        metric = f"sha1_recheck_multifile_{plen // 1024}KiB_pieces_per_sec"
     result = {
-        "metric": "sha1_recheck_256KiB_pieces_per_sec",
+        "metric": metric,
         "value": round(tpu_pps, 1),
         "unit": "pieces/s",
         "vs_baseline": round(tpu_pps / cpu_pps, 2),

@@ -50,8 +50,14 @@ def verify_library(
     mesh=None,
     io_threads: int = 4,
     progress_cb=None,
+    verifier=None,
 ) -> LibraryResult:
-    """Recheck every torrent; returns per-torrent bitfields in order."""
+    """Recheck every torrent; returns per-torrent bitfields in order.
+
+    ``verifier``: reuse a compiled ``TPUVerifier`` across calls (its
+    geometry must match every torrent's piece length) — repeated library
+    sweeps then skip recompilation entirely.
+    """
     t0 = time.perf_counter()
     bitfields = [np.zeros(info.num_pieces, dtype=bool) for _, info in items]
     total_pieces = sum(info.num_pieces for _, info in items)
@@ -81,10 +87,18 @@ def verify_library(
 
     done = 0
     for plen, group in groups.items():
-        verifier = TPUVerifier(
-            piece_length=plen, batch_size=batch_size, backend=backend, mesh=mesh
-        )
-        b = verifier.batch_size
+        if verifier is not None:
+            if verifier.piece_length != plen:
+                raise ValueError(
+                    f"shared verifier is compiled for piece_length="
+                    f"{verifier.piece_length}, library has {plen}"
+                )
+            group_verifier = verifier
+        else:
+            group_verifier = TPUVerifier(
+                piece_length=plen, batch_size=batch_size, backend=backend, mesh=mesh
+            )
+        b = group_verifier.batch_size
         # Flattened torrent-major work list: rows of one batch that belong
         # to the same torrent are contiguous, so loads stay batched reads.
         work: list[tuple[int, int]] = [
@@ -143,7 +157,7 @@ def verify_library(
                     if nxt < len(work):
                         slot = 1 - slot
                         fut = pool.submit(load, slot, nxt)
-                    ok = verifier.verify_batch(padded, nblocks, exp)
+                    ok = group_verifier.verify_batch(padded, nblocks, exp)
                     for j, (ti, pi) in enumerate(rows):
                         bitfields[ti][pi] = ok[j]
                     done += len(rows)

@@ -119,6 +119,9 @@ class Torrent:
         self.bitfield = Bitfield(self.info.num_pieces)
         self.peers: dict[bytes, PeerConnection] = {}
         self._partials: dict[int, _PartialPiece] = {}
+        # TPU ingest-verification micro-batching (see _verify_piece_data)
+        self._verify_pending: list = []
+        self._verify_flushing = False
         self._tasks: set[asyncio.Task] = set()
         self._wake = asyncio.Event()
         self._stopping = False
@@ -705,12 +708,16 @@ class Torrent:
                 pass
 
     async def _finish_piece(self, partial: _PartialPiece) -> None:
-        """Verify → persist → have-broadcast (the §8.3 missing hook)."""
+        """Verify → persist → have-broadcast (the §8.3 missing hook).
+
+        With the TPU hasher, completed pieces from concurrent peers are
+        verified as one device batch (the swarm-ingest face of the hash
+        plane); otherwise per-piece hashlib off-thread.
+        """
         del self._partials[partial.index]
         data = bytes(partial.buffer)
         expected = self.info.pieces[partial.index]
-        digest = await asyncio.to_thread(lambda: hashlib.sha1(data).digest())
-        if digest != expected:
+        if not await self._verify_piece_data(partial.index, data, expected):
             log.warning("piece %d failed verification; re-requesting", partial.index)
             self.downloaded -= partial.length  # don't count poisoned data
             return
@@ -741,6 +748,63 @@ class Torrent:
     def _write_piece(self, base: int, data: bytes) -> None:
         for off in range(0, len(data), BLOCK_SIZE):
             self.storage.set(base + off, data[off : off + BLOCK_SIZE])
+
+    # ------------------------------------------------- ingest verification
+
+    async def _verify_piece_data(self, index: int, data: bytes, expected: bytes) -> bool:
+        """One piece's hash check, batched onto the TPU when available.
+
+        Concurrent finishers pile into ``_verify_pending`` and a single
+        micro-batch flush hashes them all in one device launch; callers
+        await their own piece's future. CPU mode: hashlib off-thread.
+        """
+        if self.verifier is None or self.config.hasher != "tpu":
+            digest = await asyncio.to_thread(lambda: hashlib.sha1(data).digest())
+            return digest == expected
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._verify_pending.append((index, data, expected, fut))
+        if not self._verify_flushing:
+            self._verify_flushing = True
+            self._spawn(self._flush_verify_batch(), name="verify-batch")
+        return await fut
+
+    async def _flush_verify_batch(self) -> None:
+        """Drain the pending-verification queue in device batches."""
+        try:
+            # one event-loop tick lets concurrent _finish_piece calls join
+            await asyncio.sleep(0)
+            while self._verify_pending:
+                batch = self._verify_pending[: self.config.verify_batch_size]
+                del self._verify_pending[: len(batch)]
+                pieces = [b[1] for b in batch]
+                expected = [b[2] for b in batch]
+                try:
+                    ok = await asyncio.to_thread(self._verify_batch_device, pieces, expected)
+                except Exception as e:  # device trouble: fail safe to hashlib
+                    log.warning("tpu ingest verify failed (%s); hashlib fallback", e)
+                    ok = await asyncio.to_thread(
+                        lambda: [
+                            hashlib.sha1(p).digest() == e2
+                            for p, e2 in zip(pieces, expected)
+                        ]
+                    )
+                for (_, _, _, fut), good in zip(batch, ok):
+                    if not fut.done():
+                        fut.set_result(bool(good))
+        finally:
+            self._verify_flushing = False
+            for idx, _, _, fut in self._verify_pending:
+                if not fut.done():
+                    fut.set_result(False)  # torn down mid-flight: re-request
+            self._verify_pending.clear()
+
+    def _verify_batch_device(self, pieces: list[bytes], expected: list[bytes]):
+        from torrent_tpu.ops.padding import digests_to_words
+
+        digests = self.verifier.hash_pieces(pieces)
+        want = digests_to_words(expected)
+        got = digests_to_words(digests)
+        return (got == want).all(axis=1)
 
     # ------------------------------------------------------------- seeding
 

@@ -17,7 +17,8 @@ from torrent_tpu.net.types import AnnounceEvent
 from torrent_tpu.server.in_memory import run_tracker
 from torrent_tpu.server.tracker import ServeOptions
 from torrent_tpu.session.client import Client, ClientConfig, generate_peer_id
-from torrent_tpu.session.torrent import Torrent, TorrentConfig, TorrentState
+from torrent_tpu.session.peer import PeerConnection
+from torrent_tpu.session.torrent import Torrent, TorrentConfig, TorrentState, _PartialPiece
 from torrent_tpu.storage.piece import BLOCK_SIZE
 from torrent_tpu.storage.storage import MemoryStorage, Storage
 
@@ -407,3 +408,111 @@ class TestTpuIngestVerify:
             assert t._verify_pending == [] and not t._verify_flushing
 
         run(go())
+
+
+class TestPoisonedPeerBan:
+    def _mk_peer(self, t, pid=b"E" * 20, ip="10.9.9.9"):
+        peer = PeerConnection(
+            peer_id=pid,
+            reader=object(),
+            writer=_FakeWriter(),
+            num_pieces=t.info.num_pieces,
+            address=(ip, 6881),
+        )
+        t.peers[peer.peer_id] = peer
+        return peer
+
+    async def _fail_piece(self, t, peer, index):
+        partial = _PartialPiece(index=index, length=32768, buffer=bytearray(b"\xff" * 32768))
+        partial.contributors.add((peer.peer_id, peer.address[0]))
+        partial.received.update(range(0, 32768, BLOCK_SIZE))
+        t._partials[index] = partial
+        await t._finish_piece(partial)
+
+    def test_corrupt_contributors_banned(self):
+        """Failure detection (SURVEY §5): an address feeding corrupt pieces
+        is dropped and banned from redial/re-accept."""
+
+        async def go():
+            t, payload = TestSchedulerUnits().make_torrent(payload_len=6 * 32768)
+            t.config.max_corrupt_pieces = 2
+            peer = self._mk_peer(t)
+            for i in range(2):
+                await self._fail_piece(t, peer, i)
+            assert peer.peer_id not in t.peers  # dropped
+            assert "10.9.9.9" in t._banned
+            # redial attempts skip the banned address
+            from torrent_tpu.net.types import AnnouncePeer
+
+            t._connect_new_peers([AnnouncePeer(ip="10.9.9.9", port=6881)])
+            assert not t._dialing
+            # inbound reconnect is refused
+            await t.add_peer(b"F" * 20, object(), _FakeWriter(), address=("10.9.9.9", 9))
+            assert b"F" * 20 not in t.peers
+
+        run(go())
+
+    def test_strikes_survive_reconnect(self):
+        """Cycling connections must not reset the corruption count."""
+
+        async def go():
+            t, _ = TestSchedulerUnits().make_torrent(payload_len=6 * 32768)
+            t.config.max_corrupt_pieces = 2
+            p1 = self._mk_peer(t, pid=b"A" * 20)
+            await self._fail_piece(t, p1, 0)
+            t._drop_peer(p1)  # attacker disconnects with 1 strike
+            p2 = self._mk_peer(t, pid=b"B" * 20)  # same IP, new identity
+            await self._fail_piece(t, p2, 1)
+            assert "10.9.9.9" in t._banned  # 1 + 1 strikes, same address
+
+        run(go())
+
+    def test_absolve_decays_strikes(self):
+        """A verified piece sheds a strike — honest co-contributors of a
+        poisoner are not collaterally banned."""
+
+        async def go():
+            t, payload = TestSchedulerUnits().make_torrent(payload_len=6 * 32768)
+            t.config.max_corrupt_pieces = 3
+            peer = self._mk_peer(t, ip="10.1.1.1")
+            await self._fail_piece(t, peer, 0)
+            assert t._corruption["10.1.1.1"] == 1
+            # now a GOOD piece this peer contributed to verifies
+            good = _PartialPiece(
+                index=1, length=32768, buffer=bytearray(payload[32768:65536])
+            )
+            good.contributors.add((peer.peer_id, "10.1.1.1"))
+            good.received.update(range(0, 32768, BLOCK_SIZE))
+            t._partials[1] = good
+            await t._finish_piece(good)
+            assert t.bitfield.has(1)
+            assert t._corruption["10.1.1.1"] == 0  # absolved
+
+        run(go())
+
+    def test_drop_peer_idempotent(self):
+        async def go():
+            t, _ = TestSchedulerUnits().make_torrent()
+            peer = self._mk_peer(t)
+            peer.bitfield.set(0)
+            t._avail[0] += 1
+            t._drop_peer(peer)
+            t._drop_peer(peer)  # peer-loop finally calls again
+            assert t._avail[0] == 0  # decremented exactly once
+
+        run(go())
+
+
+class _FakeWriter:
+    def __init__(self):
+        self.data = bytearray()
+        self.closed = False
+
+    def write(self, b):
+        self.data += b
+
+    async def drain(self):
+        pass
+
+    def close(self):
+        self.closed = True

@@ -39,6 +39,7 @@ class PeerConnection:
 
     bytes_down: int = 0  # payload received from peer
     bytes_up: int = 0  # payload sent to peer
+    corrupt_pieces: int = 0  # pieces this peer helped fail verification
     _rate_mark: tuple[float, int] = (0.0, 0)  # (time, bytes_down) snapshot
 
     last_rx: float = field(default_factory=time.monotonic)

@@ -68,6 +68,9 @@ class _PartialPiece:
     length: int
     buffer: bytearray
     received: set[int] = field(default_factory=set)  # block offsets
+    # (peer_id, ip) of every block contributor — corruption accounting
+    # must survive the contributor disconnecting, so the IP rides along
+    contributors: set[tuple[bytes, str | None]] = field(default_factory=set)
 
     @property
     def complete(self) -> bool:
@@ -78,6 +81,7 @@ class _PartialPiece:
 class TorrentConfig:
     max_peers: int = 50
     pipeline_depth: int = 16  # outstanding requests per peer
+    max_corrupt_pieces: int = 3  # hash failures before a peer is banned
     unchoke_slots: int = 3  # + 1 optimistic
     choke_interval: float = 10.0
     keepalive_interval: float = 100.0
@@ -128,6 +132,13 @@ class Torrent:
         self._endgame = False
         self._pending_completed = False  # BEP 3 `completed` owed to tracker
         self._dialing: set[tuple[str, int]] = set()
+        # Failure detection: corruption strikes accumulate per IP (so a
+        # poisoner can't evade by cycling connections) and decay when a
+        # piece the address contributed to verifies (so honest peers that
+        # co-contributed with a poisoner shed the suspicion). At the
+        # threshold the address is banned for the session.
+        self._corruption: Counter = Counter()  # ip -> strikes
+        self._banned: set[str] = set()  # by IP
         # Incremental scheduler state: per-piece availability counts, a
         # rarity-ordered pick queue (rebuilt lazily when dirty), and a
         # multiset of blocks in flight across all peers — keeps block
@@ -380,6 +391,8 @@ class Torrent:
             addr = (cand.ip, cand.port)
             if addr in connected or addr in self._dialing:
                 continue
+            if cand.ip in self._banned:
+                continue
             if cand.peer_id == self.peer_id:
                 continue
             self._dialing.add(addr)
@@ -426,6 +439,9 @@ class Torrent:
         if len(self.peers) >= self.config.max_peers:
             writer.close()
             return
+        if address and address[0] in self._banned:
+            writer.close()  # banned peers don't get back in by reconnecting
+            return
         peer = PeerConnection(
             peer_id=peer_id,
             reader=reader,
@@ -449,10 +465,15 @@ class Torrent:
         self._spawn(self._peer_loop(peer), name=f"peer-{peer_id[:8].hex()}")
 
     def _drop_peer(self, peer: PeerConnection) -> None:
-        """Teardown on loop exit (torrent.ts:88-99) + reschedule its blocks."""
+        """Teardown on loop exit (torrent.ts:88-99) + reschedule its blocks.
+
+        Idempotent: the ban path and the peer loop's finally can both call
+        this; availability must only be decremented once.
+        """
         peer.close()
-        if self.peers.get(peer.peer_id) is peer:
-            del self.peers[peer.peer_id]
+        if self.peers.get(peer.peer_id) is not peer:
+            return  # already dropped (or replaced by a newer connection)
+        del self.peers[peer.peer_id]
         for i in range(self.info.num_pieces):
             if peer.bitfield.has(i):
                 self._avail[i] -= 1
@@ -686,6 +707,9 @@ class Torrent:
             return
         partial.buffer[begin : begin + len(block)] = block
         partial.received.add(begin)
+        partial.contributors.add(
+            (peer.peer_id, peer.address[0] if peer.address else None)
+        )
         self.downloaded += len(block)
 
         if self._endgame:
@@ -693,6 +717,8 @@ class Torrent:
 
         if partial.complete:
             await self._finish_piece(partial)
+            if self.peers.get(peer.peer_id) is not peer:
+                return  # this very peer got banned/dropped by the verify
         await self._fill_pipeline(peer)
 
     async def _cancel_everywhere(self, blk, except_peer) -> None:
@@ -720,7 +746,9 @@ class Torrent:
         if not await self._verify_piece_data(partial.index, data, expected):
             log.warning("piece %d failed verification; re-requesting", partial.index)
             self.downloaded -= partial.length  # don't count poisoned data
+            self._credit_corruption(partial.contributors)
             return
+        self._absolve(partial.contributors)
         base = partial.index * self.info.piece_length
         try:
             await asyncio.to_thread(self._write_piece, base, data)
@@ -748,6 +776,34 @@ class Torrent:
     def _write_piece(self, base: int, data: bytes) -> None:
         for off in range(0, len(data), BLOCK_SIZE):
             self.storage.set(base + off, data[off : off + BLOCK_SIZE])
+
+    def _credit_corruption(self, contributors) -> None:
+        """Failure detection: strike every contributor address of a corrupt
+        piece (the faulty block can't be attributed more precisely without
+        per-block hashes); ban at the threshold. Strikes persist across
+        reconnects and decay via ``_absolve`` on verified pieces.
+        """
+        for peer_id, ip in contributors:
+            if ip is None or ip in self._banned:
+                continue
+            self._corruption[ip] += 1
+            peer = self.peers.get(peer_id)
+            if peer is not None:
+                peer.corrupt_pieces += 1
+            if self._corruption[ip] >= self.config.max_corrupt_pieces:
+                self._banned.add(ip)
+                log.warning(
+                    "banning %s: %d corrupt pieces", ip, self._corruption[ip]
+                )
+                for p in list(self.peers.values()):
+                    if p.address and p.address[0] == ip:
+                        self._drop_peer(p)
+
+    def _absolve(self, contributors) -> None:
+        """A verified piece sheds one strike per contributor address."""
+        for _, ip in contributors:
+            if ip is not None and self._corruption[ip] > 0:
+                self._corruption[ip] -= 1
 
     # ------------------------------------------------- ingest verification
 

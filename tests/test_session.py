@@ -516,3 +516,70 @@ class _FakeWriter:
 
     def close(self):
         self.closed = True
+
+
+class TestAntiSnubbing:
+    def test_snubbed_peer_releases_inflight(self):
+        """A peer that stops delivering frees its requested blocks for
+        other peers instead of holding them until the 240s peer timeout."""
+        import time as _time
+
+        async def go():
+            t, _ = TestSchedulerUnits().make_torrent()
+            t.config.snub_timeout = 5.0
+            slow = PeerConnection(
+                peer_id=b"S" * 20,
+                reader=object(),
+                writer=_FakeWriter(),
+                num_pieces=t.info.num_pieces,
+            )
+            t.peers[slow.peer_id] = slow
+            blk = (0, 0, BLOCK_SIZE)
+            slow.inflight.add(blk)
+            t._inflight_count[blk] += 1
+            slow.last_block_rx = _time.monotonic() - 1  # recent: kept
+            await t._release_snubbed()
+            assert blk in slow.inflight
+            slow.last_block_rx = _time.monotonic() - 60  # stalled: freed
+            await t._release_snubbed()
+            assert not slow.inflight and t._inflight_count[blk] == 0
+            assert slow.peer_id in t.peers  # connection itself survives
+
+        run(go())
+
+    def test_snubbed_peer_skipped_until_redeemed(self):
+        """Freed blocks must not bounce straight back to the snubber, and
+        NATed co-contributors take one strike per corrupt piece, not one
+        per connection."""
+        import time as _time
+
+        async def go():
+            t, _ = TestSchedulerUnits().make_torrent()
+            t.config.snub_timeout = 5.0
+            slow = PeerConnection(
+                peer_id=b"S" * 20, reader=object(), writer=_FakeWriter(),
+                num_pieces=t.info.num_pieces,
+            )
+            for i in range(t.info.num_pieces):
+                slow.bitfield.set(i)
+            slow.peer_choking = False
+            t.peers[slow.peer_id] = slow
+            blk = (0, 0, BLOCK_SIZE)
+            slow.inflight.add(blk)
+            t._inflight_count[blk] += 1
+            slow.last_block_rx = _time.monotonic() - 60
+            await t._release_snubbed()
+            assert slow.snubbed and not slow.inflight
+            await t._fill_pipeline(slow)
+            assert not slow.inflight  # no re-requests while snubbed
+            # a delivered block redeems
+            await t._handle_message(slow, __import__("torrent_tpu.net.protocol", fromlist=["Piece"]).Piece(0, 0, b"\x00" * BLOCK_SIZE))
+            assert not slow.snubbed
+
+            # NAT dedup: two peer ids, one IP, one corrupt piece = 1 strike
+            t2, _ = TestSchedulerUnits().make_torrent()
+            contributors = {(b"A" * 20, "9.9.9.9"), (b"B" * 20, "9.9.9.9")}
+            t2._credit_corruption(contributors)
+            assert t2._corruption["9.9.9.9"] == 1
+
+        run(go())

@@ -35,6 +35,7 @@ from torrent_tpu.session.torrent import Torrent, TorrentConfig, TorrentState
 from torrent_tpu.storage.storage import Storage, StorageMethod, FsStorage, MemoryStorage
 from torrent_tpu.parallel.verify import verify_pieces
 from torrent_tpu.tools.make_torrent import make_torrent
+from torrent_tpu.codec.magnet import Magnet, parse_magnet
 
 __all__ = [
     "bencode",
@@ -61,5 +62,12 @@ __all__ = [
     "MemoryStorage",
     "verify_pieces",
     "make_torrent",
+    "Magnet",
+    "parse_magnet",
     "__version__",
 ]
+
+# Heavier subsystems stay import-on-demand (no jax import at package
+# import time): torrent_tpu.models.verifier.TPUVerifier,
+# torrent_tpu.parallel.bulk.verify_library, torrent_tpu.net.dht.DHTNode,
+# torrent_tpu.bridge.service.BridgeServer.

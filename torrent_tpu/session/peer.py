@@ -44,6 +44,12 @@ class PeerConnection:
 
     last_rx: float = field(default_factory=time.monotonic)
     last_tx: float = field(default_factory=time.monotonic)
+    # last time a *piece block* arrived (anti-snubbing; last_rx counts any
+    # message, keepalives included, so it can't detect a data stall)
+    last_block_rx: float = field(default_factory=time.monotonic)
+    # stalled-while-owing-blocks flag: no fresh requests outside endgame
+    # until a block actually arrives
+    snubbed: bool = False
 
     def __post_init__(self):
         if self.bitfield is None:

@@ -84,6 +84,7 @@ class TorrentConfig:
     max_corrupt_pieces: int = 3  # hash failures before a peer is banned
     unchoke_slots: int = 3  # + 1 optimistic
     choke_interval: float = 10.0
+    snub_timeout: float = 30.0  # no block for this long → free its requests
     keepalive_interval: float = 100.0
     peer_timeout: float = 240.0
     announce_retry: float = 30.0
@@ -631,6 +632,8 @@ class Torrent:
         """Rarest-first picking + pipelining; endgame duplication."""
         if peer.peer_choking or self.bitfield.complete:
             return
+        if peer.snubbed and not self._endgame:
+            return  # earns requests back by delivering a block
         budget = self.config.pipeline_depth - len(peer.inflight)
         if budget <= 0:
             return
@@ -679,6 +682,10 @@ class Torrent:
             random.shuffle(remaining)
             wanted = remaining[:budget]
 
+        if not peer.inflight:
+            # fresh pipeline: restart the snub clock so an idle-but-honest
+            # peer isn't condemned for the time it spent choked
+            peer.last_block_rx = time.monotonic()
         for blk in wanted:
             peer.inflight.add(blk)
             self._inflight_count[blk] += 1
@@ -694,6 +701,8 @@ class Torrent:
             if self._inflight_count[blk] > 0:
                 self._inflight_count[blk] -= 1
         peer.bytes_down += len(block)
+        peer.last_block_rx = time.monotonic()
+        peer.snubbed = False  # delivering redeems
         if self.bitfield.has(index):
             return  # duplicate from endgame
         partial = self._partials.get(index)
@@ -783,13 +792,16 @@ class Torrent:
         per-block hashes); ban at the threshold. Strikes persist across
         reconnects and decay via ``_absolve`` on verified pieces.
         """
-        for peer_id, ip in contributors:
-            if ip is None or ip in self._banned:
-                continue
-            self._corruption[ip] += 1
+        for peer_id, _ in contributors:
             peer = self.peers.get(peer_id)
             if peer is not None:
                 peer.corrupt_pieces += 1
+        # one corrupt piece = one strike per ADDRESS — two NATed peers
+        # sharing an IP must not double-strike it for the same failure
+        for ip in {ip for _, ip in contributors}:
+            if ip is None or ip in self._banned:
+                continue
+            self._corruption[ip] += 1
             if self._corruption[ip] >= self.config.max_corrupt_pieces:
                 self._banned.add(ip)
                 log.warning(
@@ -801,7 +813,7 @@ class Torrent:
 
     def _absolve(self, contributors) -> None:
         """A verified piece sheds one strike per contributor address."""
-        for _, ip in contributors:
+        for ip in {ip for _, ip in contributors}:
             if ip is not None and self._corruption[ip] > 0:
                 self._corruption[ip] -= 1
 
@@ -886,12 +898,42 @@ class Torrent:
 
     # ---------------------------------------------------------- choke loop
 
+    async def _release_snubbed(self) -> None:
+        """Anti-snubbing: a peer that stopped delivering blocks while we
+        have requests outstanding to it gets those requests cancelled and
+        released, is flagged snubbed (no fresh requests outside endgame
+        until it delivers again), and the freed blocks are immediately
+        re-offered to every other ready peer. The connection survives —
+        it still counts for availability and may serve later."""
+        now = time.monotonic()
+        released_any = False
+        for p in self.peers.values():
+            if p.inflight and now - p.last_block_rx > self.config.snub_timeout:
+                log.debug(
+                    "peer %s snubbed: releasing %d in-flight blocks",
+                    p.peer_id[:8].hex(),
+                    len(p.inflight),
+                )
+                for blk in list(p.inflight):
+                    try:
+                        await proto.send_message(p.writer, proto.Cancel(*blk))
+                    except (ConnectionError, OSError):
+                        break
+                self._release_inflight(p)
+                p.snubbed = True
+                released_any = True
+        if released_any:
+            for p in list(self.peers.values()):
+                if not p.snubbed and not p.peer_choking and p.am_interested:
+                    await self._fill_pipeline(p)
+
     async def _choke_loop(self) -> None:
         """Unchoke top downloaders + one optimistic random (BEP 3)."""
         optimistic: bytes | None = None
         rounds = 0
         while not self._stopping:
             await asyncio.sleep(self.config.choke_interval)
+            await self._release_snubbed()
             peers = list(self.peers.values())
             interested = [p for p in peers if p.peer_interested]
             interested.sort(key=lambda p: p.download_rate(), reverse=True)

@@ -37,7 +37,8 @@ MAX_METADATA_SIZE = 64 * 1024 * 1024
 # Extended-handshake message names → our local ext ids. Id 0 is reserved
 # for the handshake itself by BEP 10.
 UT_METADATA = b"ut_metadata"
-LOCAL_EXT_IDS = {UT_METADATA: 1}
+UT_PEX = b"ut_pex"
+LOCAL_EXT_IDS = {UT_METADATA: 1, UT_PEX: 2}
 
 # Reserved-byte mask: bit 20 counting from the MSB of the 8-byte field,
 # i.e. byte 5, value 0x10 (BEP 10).
@@ -71,15 +72,26 @@ class ExtensionState:
     handshaken: bool = False  # we received their ext handshake
     ut_metadata_id: int = 0  # peer's id for ut_metadata (0 = unsupported)
     metadata_size: int = 0  # peer-advertised info-dict size in bytes
+    ut_pex_id: int = 0  # peer's id for ut_pex (BEP 11; 0 = unsupported)
+    listen_port: int = 0  # peer-advertised 'p' — its real dialable port
 
 
-def encode_extended_handshake(metadata_size: int | None = None, version: str = "") -> bytes:
-    """Payload for extended message id 0 (our side of the negotiation)."""
+def encode_extended_handshake(
+    metadata_size: int | None = None, version: str = "", listen_port: int = 0
+) -> bytes:
+    """Payload for extended message id 0 (our side of the negotiation).
+
+    ``listen_port`` is BEP 10's ``p`` key — without it an inbound peer's
+    dialable port is unknowable (its TCP source port is ephemeral) and
+    PEX gossip about it would be dead addresses.
+    """
     d: dict = {b"m": {name: eid for name, eid in LOCAL_EXT_IDS.items()}}
     if metadata_size is not None:
         d[b"metadata_size"] = metadata_size
     if version:
         d[b"v"] = version.encode()
+    if 0 < listen_port < 65536:
+        d[b"p"] = listen_port
     return bencode(d)
 
 
@@ -101,9 +113,15 @@ def decode_extended_handshake(payload: bytes, state: ExtensionState) -> None:
         mid = m.get(UT_METADATA)
         if isinstance(mid, int) and 0 < mid < 256:
             state.ut_metadata_id = mid
+        pid = m.get(UT_PEX)
+        if isinstance(pid, int) and 0 < pid < 256:
+            state.ut_pex_id = pid
     size = d.get(b"metadata_size")
     if isinstance(size, int) and 0 < size <= MAX_METADATA_SIZE:
         state.metadata_size = size
+    lp = d.get(b"p")
+    if isinstance(lp, int) and 0 < lp < 65536:
+        state.listen_port = lp
 
 
 # ------------------------------------------------------------ ut_metadata
@@ -203,6 +221,68 @@ class MetadataAssembler:
             self._pieces.clear()  # poisoned; refetch from scratch
             return None
         return blob
+
+
+# -------------------------------------------------------------- ut_pex
+
+
+def _pack_compact_v4(addrs) -> bytes:
+    out = bytearray()
+    for ip, port in addrs:
+        try:
+            octets = bytes(int(x) for x in ip.split("."))
+        except ValueError:
+            continue  # BEP 11's base message is IPv4; v6 needs added6
+        if len(octets) == 4 and 0 < port < 65536:
+            out += octets + port.to_bytes(2, "big")
+    return bytes(out)
+
+
+def _unpack_compact_v4(blob: bytes) -> list[tuple[str, int]]:
+    out = []
+    for i in range(0, len(blob) - len(blob) % 6, 6):
+        port = int.from_bytes(blob[i + 4 : i + 6], "big")
+        if port == 0:
+            continue  # undialable; a hostile PEX pads with these
+        ip = ".".join(str(b) for b in blob[i : i + 4])
+        out.append((ip, port))
+    return out
+
+
+def encode_pex(added, dropped=()) -> bytes:
+    """BEP 11 ut_pex payload: compact added/dropped v4 peer deltas."""
+    packed_added = _pack_compact_v4(added)
+    return bencode(
+        {
+            b"added": packed_added,
+            b"added.f": bytes(len(packed_added) // 6),  # no flags
+            b"dropped": _pack_compact_v4(dropped),
+        }
+    )
+
+
+@dataclass(frozen=True)
+class PexMessage:
+    added: tuple[tuple[str, int], ...]
+    dropped: tuple[tuple[str, int], ...]
+
+
+def decode_pex(payload: bytes) -> PexMessage | None:
+    """Parse a ut_pex payload; None if malformed (total, never raises)."""
+    try:
+        d = bdecode(payload)
+    except BencodeError:
+        return None
+    if not isinstance(d, dict):
+        return None
+    added = d.get(b"added", b"")
+    dropped = d.get(b"dropped", b"")
+    if not isinstance(added, bytes) or not isinstance(dropped, bytes):
+        return None
+    return PexMessage(
+        added=tuple(_unpack_compact_v4(added)),
+        dropped=tuple(_unpack_compact_v4(dropped)),
+    )
 
 
 def metadata_piece(info_bytes: bytes, piece: int) -> bytes | None:

@@ -219,6 +219,7 @@ class Client:
                 writer,
                 address=tuple(addr[:2]) if addr else None,
                 reserved=reserved,
+                inbound=True,
             )
         except (proto.ProtocolError, asyncio.TimeoutError, ConnectionError, OSError):
             writer.close()

@@ -47,9 +47,19 @@ class PeerConnection:
     # last time a *piece block* arrived (anti-snubbing; last_rx counts any
     # message, keepalives included, so it can't detect a data stall)
     last_block_rx: float = field(default_factory=time.monotonic)
-    # stalled-while-owing-blocks flag: no fresh requests outside endgame
-    # until a block actually arrives
-    snubbed: bool = False
+    # stalled-while-owing-blocks: no fresh requests outside endgame until
+    # this deadline passes or a block arrives (a permanent flag could
+    # deadlock the whole session after a transient network stall)
+    snubbed_until: float = 0.0
+    # whether the peer connected to us (its address port is then an
+    # ephemeral source port, NOT its listen port — PEX must not gossip it)
+    inbound: bool = False
+    # addresses already PEXed to this peer (BEP 11 sends deltas)
+    pex_sent: set[tuple[str, int]] = field(default_factory=set)
+
+    @property
+    def snubbed(self) -> bool:
+        return time.monotonic() < self.snubbed_until
 
     def __post_init__(self):
         if self.bitfield is None:

@@ -91,6 +91,7 @@ class TorrentConfig:
     hasher: str = "cpu"  # 'cpu' | 'tpu' — resume-recheck + batch verify
     verify_batch_size: int = 256
     dht_interval: float = 300.0  # DHT announce/lookup cadence
+    pex_interval: float = 60.0  # BEP 11 peer-exchange cadence
 
 
 class Torrent:
@@ -187,6 +188,7 @@ class Torrent:
             self._spawn(self._dht_loop(), name="dht")
         self._spawn(self._choke_loop(), name="choke")
         self._spawn(self._keepalive_loop(), name="keepalive")
+        self._spawn(self._pex_loop(), name="pex")
 
     def _spawn(self, coro, name=None) -> asyncio.Task:
         """Track a task for teardown; completed tasks self-evict."""
@@ -428,7 +430,13 @@ class Torrent:
     # ------------------------------------------------------------ peer mgmt
 
     async def add_peer(
-        self, peer_id, reader, writer, address=None, reserved: bytes = b"\x00" * 8
+        self,
+        peer_id,
+        reader,
+        writer,
+        address=None,
+        reserved: bytes = b"\x00" * 8,
+        inbound: bool = False,
     ) -> None:
         """Register + spawn the message loop (torrent.ts:79-102)."""
         if peer_id in self.peers:
@@ -449,17 +457,23 @@ class Torrent:
             writer=writer,
             num_pieces=self.info.num_pieces,
             address=address,
+            inbound=inbound,
         )
         peer.ext.enabled = ext.supports_extensions(reserved)
         self.peers[peer_id] = peer
         proto.send_bitfield(writer, self.bitfield)
         if peer.ext.enabled:
             # BEP 10: extended handshake right after the bitfield,
-            # advertising ut_metadata so magnet joiners can fetch the
-            # info dict from us.
+            # advertising ut_metadata (magnet joiners fetch the info dict
+            # from us) and our listen port (so PEX about us is dialable).
             writer.write(
                 proto.encode_message(
-                    proto.Extended(0, ext.encode_extended_handshake(len(self.info_bytes())))
+                    proto.Extended(
+                        0,
+                        ext.encode_extended_handshake(
+                            len(self.info_bytes()), listen_port=self.port
+                        ),
+                    )
                 )
             )
         peer.snapshot_rate()
@@ -574,6 +588,15 @@ class Torrent:
             return  # never advertised the reserved bit; ignore
         if ext_id == 0:
             ext.decode_extended_handshake(payload, peer.ext)
+            return
+        if ext_id == ext.LOCAL_EXT_IDS[ext.UT_PEX]:
+            pex = ext.decode_pex(payload)
+            if pex is not None and pex.added:
+                from torrent_tpu.net.types import AnnouncePeer
+
+                self._connect_new_peers(
+                    [AnnouncePeer(ip=h, port=p) for h, p in pex.added]
+                )
             return
         if ext_id == ext.LOCAL_EXT_IDS[ext.UT_METADATA]:
             msg = ext.decode_metadata_message(payload)
@@ -702,7 +725,7 @@ class Torrent:
                 self._inflight_count[blk] -= 1
         peer.bytes_down += len(block)
         peer.last_block_rx = time.monotonic()
-        peer.snubbed = False  # delivering redeems
+        peer.snubbed_until = 0.0  # delivering redeems
         if self.bitfield.has(index):
             return  # duplicate from endgame
         partial = self._partials.get(index)
@@ -907,7 +930,7 @@ class Torrent:
         it still counts for availability and may serve later."""
         now = time.monotonic()
         released_any = False
-        for p in self.peers.values():
+        for p in list(self.peers.values()):  # awaits below; dict may mutate
             if p.inflight and now - p.last_block_rx > self.config.snub_timeout:
                 log.debug(
                     "peer %s snubbed: releasing %d in-flight blocks",
@@ -920,7 +943,10 @@ class Torrent:
                     except (ConnectionError, OSError):
                         break
                 self._release_inflight(p)
-                p.snubbed = True
+                # time-limited, not permanent: after the cooldown the peer
+                # is retried even without having delivered (a transient
+                # stall of EVERY peer must not deadlock the session)
+                p.snubbed_until = now + 2 * self.config.snub_timeout
                 released_any = True
         if released_any:
             for p in list(self.peers.values()):
@@ -956,6 +982,50 @@ class Torrent:
                     pass
                 p.snapshot_rate()
             rounds += 1
+
+    def _dialable_addr(self, p: PeerConnection) -> tuple[str, int] | None:
+        """The address other peers could actually connect to.
+
+        Outbound connections dialed the peer's listen port; inbound ones
+        carry an ephemeral source port, so they're only gossipable when
+        the peer advertised its real port via BEP 10's ``p`` key.
+        """
+        if p.address is None or ":" in p.address[0]:  # base PEX is v4
+            return None
+        if not p.inbound:
+            return p.address
+        if p.ext.listen_port:
+            return (p.address[0], p.ext.listen_port)
+        return None
+
+    async def _pex_round(self) -> None:
+        """Send each PEX-capable peer the delta of connected addresses."""
+        current = {
+            addr
+            for p in self.peers.values()
+            if (addr := self._dialable_addr(p)) is not None
+        }
+        for p in list(self.peers.values()):
+            if not (p.ext.enabled and p.ext.ut_pex_id):
+                continue
+            mine = self._dialable_addr(p)
+            added = current - p.pex_sent - ({mine} if mine else set())
+            dropped = p.pex_sent - current
+            if not added and not dropped:
+                continue
+            try:
+                await proto.send_message(
+                    p.writer,
+                    proto.Extended(p.ext.ut_pex_id, ext.encode_pex(added, dropped)),
+                )
+            except (ConnectionError, OSError):
+                continue
+            p.pex_sent = (p.pex_sent | added) - dropped
+
+    async def _pex_loop(self) -> None:
+        while not self._stopping:
+            await asyncio.sleep(self.config.pex_interval)
+            await self._pex_round()
 
     async def _keepalive_loop(self) -> None:
         while not self._stopping:

@@ -1,0 +1,191 @@
+"""BEP 19 webseed tests: URL mapping, ranged fetches against a live HTTP
+server, and a webseed-only download (no tracker, no peers)."""
+
+import asyncio
+import hashlib
+import threading
+from functools import partial
+from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from test_session import build_torrent_bytes, fast_config, run
+from torrent_tpu.codec.bencode import bencode
+from torrent_tpu.codec.metainfo import parse_metainfo
+from torrent_tpu.session.client import Client, ClientConfig
+from torrent_tpu.session.webseed import WebSeedError, fetch_range, url_for
+from torrent_tpu.storage.storage import MemoryStorage, Storage
+
+
+class _RangeHandler(SimpleHTTPRequestHandler):
+    """SimpleHTTPRequestHandler + RFC 7233 single-range support."""
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def send_head(self):
+        rng = self.headers.get("Range")
+        if not rng or not rng.startswith("bytes="):
+            return super().send_head()
+        path = self.translate_path(self.path)
+        try:
+            f = open(path, "rb")
+        except OSError:
+            self.send_error(404)
+            return None
+        import os
+
+        size = os.fstat(f.fileno()).st_size
+        start_s, _, end_s = rng[len("bytes=") :].partition("-")
+        start = int(start_s)
+        end = min(int(end_s) if end_s else size - 1, size - 1)
+        if start >= size:
+            self.send_error(416)
+            f.close()
+            return None
+        self.send_response(206)
+        self.send_header("Content-Range", f"bytes {start}-{end}/{size}")
+        self.send_header("Content-Length", str(end - start + 1))
+        self.end_headers()
+        f.seek(start)
+        self._range_len = end - start + 1
+        return f
+
+    def copyfile(self, source, outputfile):
+        n = getattr(self, "_range_len", None)
+        if n is None:
+            return super().copyfile(source, outputfile)
+        outputfile.write(source.read(n))
+
+
+def serve_dir(root):
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), partial(_RangeHandler, directory=str(root))
+    )
+    th = threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}/"
+
+
+class TestUrlMapping:
+    def test_single_file_with_base_slash(self):
+        from torrent_tpu.codec.metainfo import InfoDict
+
+        info = InfoDict(name="a b.bin", piece_length=4, pieces=(b"x" * 20,), length=4)
+        assert url_for("http://s/d/", info, ("a b.bin",)) == "http://s/d/a%20b.bin"
+        # non-slash base for single-file: URL used as-is
+        assert url_for("http://s/direct.bin", info, ("a b.bin",)) == "http://s/direct.bin"
+
+    def test_multi_file_paths(self):
+        from torrent_tpu.codec.metainfo import FileEntry, InfoDict
+
+        info = InfoDict(
+            name="album",
+            piece_length=4,
+            pieces=(b"x" * 20,),
+            length=4,
+            files=(FileEntry(length=4, path=("cd 1", "t.mp3")),),
+        )
+        assert (
+            url_for("http://s/d/", info, ("album", "cd 1", "t.mp3"))
+            == "http://s/d/album/cd%201/t.mp3"
+        )
+        assert (
+            url_for("http://s/d", info, ("album", "cd 1", "t.mp3"))
+            == "http://s/d/album/cd%201/t.mp3"
+        )
+
+
+class TestRangedFetch:
+    def test_fetch_range_against_live_server(self, tmp_path):
+        blob = bytes(range(256)) * 40
+        (tmp_path / "f.bin").write_bytes(blob)
+        httpd, base = serve_dir(tmp_path)
+        try:
+            got = fetch_range(base + "f.bin", 100, 500)
+            assert got == blob[100:600]
+            with pytest.raises(WebSeedError):
+                fetch_range(base + "missing.bin", 0, 10)
+        finally:
+            httpd.shutdown()
+
+
+class TestWebseedDownload:
+    def test_webseed_only_download(self, tmp_path):
+        """No tracker, no peers: the whole payload arrives over HTTP and
+        verifies piece by piece."""
+
+        async def go():
+            rng = np.random.default_rng(91)
+            payload = rng.integers(0, 256, size=150_000, dtype=np.uint8).tobytes()
+            (tmp_path / "ws-test").write_bytes(payload)
+            httpd, base = serve_dir(tmp_path)
+            client = Client(ClientConfig(host="127.0.0.1"))
+            client.config.torrent = fast_config(webseed_retry=0.5)
+            await client.start()
+            try:
+                tb = bencode(
+                    {
+                        b"announce": b"",
+                        b"url-list": [base.encode()],
+                        b"info": {
+                            b"name": b"ws-test",
+                            b"piece length": 32768,
+                            b"pieces": b"".join(
+                                hashlib.sha1(payload[i : i + 32768]).digest()
+                                for i in range(0, len(payload), 32768)
+                            ),
+                            b"length": len(payload),
+                        },
+                    }
+                )
+                m = parse_metainfo(tb)
+                assert m is not None and m.web_seeds == (base,)
+                t = await client.add(m, Storage(MemoryStorage(), m.info))
+                await asyncio.wait_for(t.on_complete.wait(), timeout=30)
+                assert t.storage.get(0, len(payload)) == payload
+            finally:
+                await client.close()
+                httpd.shutdown()
+
+        run(go())
+
+    def test_corrupt_webseed_rejected(self, tmp_path):
+        """A webseed serving wrong bytes never pollutes storage."""
+
+        async def go():
+            rng = np.random.default_rng(92)
+            payload = rng.integers(0, 256, size=64_000, dtype=np.uint8).tobytes()
+            # serve DIFFERENT bytes than the torrent was authored for
+            (tmp_path / "ws-bad").write_bytes(b"\x00" * len(payload))
+            httpd, base = serve_dir(tmp_path)
+            client = Client(ClientConfig(host="127.0.0.1"))
+            client.config.torrent = fast_config(webseed_retry=0.2)
+            await client.start()
+            try:
+                tb = bencode(
+                    {
+                        b"announce": b"",
+                        b"url-list": [base.encode()],
+                        b"info": {
+                            b"name": b"ws-bad",
+                            b"piece length": 32768,
+                            b"pieces": b"".join(
+                                hashlib.sha1(payload[i : i + 32768]).digest()
+                                for i in range(0, len(payload), 32768)
+                            ),
+                            b"length": len(payload),
+                        },
+                    }
+                )
+                m = parse_metainfo(tb)
+                t = await client.add(m, Storage(MemoryStorage(), m.info))
+                await asyncio.sleep(1.5)  # several fetch attempts
+                assert t.bitfield.count() == 0  # nothing verified
+                assert not t.on_complete.is_set()
+            finally:
+                await client.close()
+                httpd.shutdown()
+
+        run(go())

@@ -67,6 +67,18 @@ class Metainfo:
     # `comment`, `creation date`, `announce-list` — preserved, not dropped.
     raw: dict = field(repr=False, default_factory=dict)
 
+    @property
+    def web_seeds(self) -> tuple[str, ...]:
+        """BEP 19 ``url-list`` (single string or list of strings)."""
+        ul = self.raw.get(b"url-list")
+        if isinstance(ul, bytes):
+            ul = [ul]
+        if not isinstance(ul, list):
+            return ()
+        return tuple(
+            u.decode("utf-8", "replace") for u in ul if isinstance(u, bytes) and u
+        )
+
 
 _FILE_SHAPE = valid.obj(
     {

@@ -92,6 +92,8 @@ class TorrentConfig:
     verify_batch_size: int = 256
     dht_interval: float = 300.0  # DHT announce/lookup cadence
     pex_interval: float = 60.0  # BEP 11 peer-exchange cadence
+    webseed_retry: float = 15.0  # backoff after a webseed failure
+    webseed_concurrency: int = 2  # parallel piece fetches per webseed
 
 
 class Torrent:
@@ -189,6 +191,8 @@ class Torrent:
         self._spawn(self._choke_loop(), name="choke")
         self._spawn(self._keepalive_loop(), name="keepalive")
         self._spawn(self._pex_loop(), name="pex")
+        for url in self.metainfo.web_seeds:
+            self._spawn(self._webseed_loop(url), name=f"webseed-{url[:24]}")
 
     def _spawn(self, coro, name=None) -> asyncio.Task:
         """Track a task for teardown; completed tasks self-evict."""
@@ -1026,6 +1030,62 @@ class Torrent:
         while not self._stopping:
             await asyncio.sleep(self.config.pex_interval)
             await self._pex_round()
+
+    # ------------------------------------------------------------ webseeds
+
+    def _pick_webseed_pieces(self, n: int) -> list[int]:
+        """Missing pieces nobody is working on, rarest (in the swarm)
+        first — the webseed complements peers instead of racing them."""
+        if self._rarity_dirty:
+            self._rebuild_rarity()
+        busy = {blk[0] for blk, c in self._inflight_count.items() if c > 0}
+        picked = []
+        for index in self._rarity_order:
+            if self.bitfield.has(index) or index in self._partials or index in busy:
+                continue
+            picked.append(index)
+            if len(picked) >= n:
+                break
+        return picked
+
+    async def _webseed_loop(self, url: str) -> None:
+        """BEP 19: fill missing pieces from an HTTP seed; every fetched
+        piece passes the same verify→persist→have path as wire pieces."""
+        from torrent_tpu.session.webseed import WebSeedError, fetch_piece
+
+        while not self._stopping and not self.bitfield.complete:
+            picked = self._pick_webseed_pieces(self.config.webseed_concurrency)
+            if not picked:
+                await asyncio.sleep(1.0)
+                continue
+            # reserve so peers/other webseeds skip these pieces meanwhile
+            reserved = []
+            for index in picked:
+                partial = _PartialPiece(
+                    index=index,
+                    length=piece_length(self.info, index),
+                    buffer=bytearray(piece_length(self.info, index)),
+                )
+                self._partials[index] = partial
+                reserved.append(partial)
+            try:
+                datas = await asyncio.gather(
+                    *(
+                        asyncio.to_thread(fetch_piece, url, self.storage, self.info, p.index)
+                        for p in reserved
+                    )
+                )
+            except WebSeedError as e:
+                for p in reserved:
+                    self._partials.pop(p.index, None)
+                log.warning("webseed %s failed: %s; backing off", url, e)
+                await asyncio.sleep(self.config.webseed_retry)
+                continue
+            for partial, data in zip(reserved, datas):
+                partial.buffer[:] = data
+                partial.received.update(range(0, partial.length, BLOCK_SIZE))
+                self.downloaded += partial.length
+                await self._finish_piece(partial)
 
     async def _keepalive_loop(self) -> None:
         while not self._stopping:

@@ -58,6 +58,9 @@ def _cmd_make(args) -> int:
         piece_length=args.piece_length,
         hasher=args.hasher,
         progress=progress,
+        announce_list=[[t] for t in args.also_tracker] or None,
+        private=args.private,
+        web_seeds=args.web_seed or None,
     )
     print("", file=sys.stderr)
     out = args.output or (args.path.rstrip("/").rsplit("/", 1)[-1] + ".torrent")
@@ -258,6 +261,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--comment")
     sp.add_argument("--piece-length", type=int)
     sp.add_argument("--hasher", choices=("cpu", "tpu"), default="cpu")
+    sp.add_argument("--also-tracker", action="append", default=[],
+                    help="extra tracker tier (BEP 12, repeatable)")
+    sp.add_argument("--private", action="store_true", help="BEP 27 private flag")
+    sp.add_argument("--web-seed", action="append", default=[],
+                    help="BEP 19 url-list entry (repeatable)")
     sp.set_defaults(fn=_cmd_make)
 
     sp = sub.add_parser("verify", help="recheck downloaded data against a .torrent")

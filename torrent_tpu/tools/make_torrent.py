@@ -116,10 +116,16 @@ def make_torrent(
     piece_length: int | None = None,
     hasher: str = "cpu",
     progress: Callable | None = None,
+    announce_list: list[list[str]] | None = None,
+    private: bool = False,
+    web_seeds: list[str] | None = None,
 ) -> bytes:
     """Author a .torrent for a file or directory (tools/make_torrent.ts:115).
 
     Returns the bencoded metainfo bytes; caller writes them where it wants.
+    ``announce_list`` adds BEP 12 tiers; ``private`` sets BEP 27's flag
+    (changes the infohash — clients then skip DHT/PEX); ``web_seeds``
+    adds a BEP 19 ``url-list``.
     """
     path = os.fspath(path)
     if not os.path.exists(path):
@@ -154,7 +160,16 @@ def make_torrent(
     else:
         info[b"length"] = total
 
+    if private:
+        info[b"private"] = 1  # BEP 27 — inside info: part of the infohash
+
     top: dict = {b"announce": tracker.encode("utf-8"), b"info": info}
+    if announce_list:
+        top[b"announce-list"] = [
+            [t.encode("utf-8") for t in tier] for tier in announce_list
+        ]
+    if web_seeds:
+        top[b"url-list"] = [u.encode("utf-8") for u in web_seeds]  # BEP 19
     if comment:
         top[b"comment"] = comment.encode("utf-8")
     top[b"creation date"] = int(time.time())

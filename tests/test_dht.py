@@ -270,3 +270,44 @@ class TestNetworkLookups:
                     n.close()
 
         run(go())
+
+
+class TestHostileInputHardening:
+    """Round-1 advisor findings: port-0 padding + response spoofing."""
+
+    def test_port_zero_peers_filtered(self):
+        # hostile nodes pad `values` with undialable port-0 entries; the
+        # PEX decoder already drops these — the DHT decoder must too
+        blob = pack_compact_peer("10.1.2.3", 51413) + pack_compact_peer("9.9.9.9", 0)
+        assert unpack_compact_peers(blob) == [("10.1.2.3", 51413)]
+
+    def test_response_from_wrong_address_ignored(self):
+        """A 16-bit tid is guessable; only the queried address may answer."""
+        from torrent_tpu.codec.bencode import bencode
+
+        async def go():
+            node = DHTNode(host="127.0.0.1", port=0)
+            loop = asyncio.get_running_loop()
+            fut = loop.create_future()
+            tid = b"\x00\x07"
+            node._pending[tid] = (("10.0.0.1", 7001), fut)
+            resp = bencode(
+                {b"t": tid, b"y": b"r", b"r": {b"id": nid(0xBEEF)}}
+            )
+            # spoofed source IP: dropped, future still pending
+            node._on_datagram(resp, ("6.6.6.6", 7001))
+            assert not fut.done()
+            # genuine source resolves it (IP-only match: port-rewriting
+            # NATs legitimately answer from a different source port)
+            node._on_datagram(resp, ("10.0.0.1", 9999))
+            assert fut.done() and fut.result() == {b"id": nid(0xBEEF)}
+            # spoofed error replies are dropped the same way
+            fut2 = loop.create_future()
+            node._pending[b"\x00\x08"] = (("10.0.0.1", 7001), fut2)
+            err = bencode({b"t": b"\x00\x08", b"y": b"e", b"e": [201, b"boom"]})
+            node._on_datagram(err, ("6.6.6.6", 7001))
+            assert not fut2.done()
+            node._on_datagram(err, ("10.0.0.1", 7001))
+            assert fut2.done() and isinstance(fut2.exception(), DHTError)
+
+        run(go())

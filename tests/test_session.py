@@ -583,3 +583,154 @@ class TestAntiSnubbing:
             assert t2._corruption["9.9.9.9"] == 1
 
         run(go())
+
+
+class TestAdviceRegressions:
+    """Round-1 advisor findings: webseed/peer race, BEP 27 private flag."""
+
+    def test_finish_piece_idempotent(self):
+        """Finishing the same partial twice (webseed + endgame peer both
+        complete it) must be a no-op the second time, not a KeyError."""
+
+        async def go():
+            t, payload = TestSchedulerUnits().make_torrent(payload_len=4 * 32768)
+            partial = _PartialPiece(
+                index=0, length=32768, buffer=bytearray(payload[:32768]), webseed=True
+            )
+            partial.received.update(range(0, 32768, BLOCK_SIZE))
+            t._partials[0] = partial
+            await t._finish_piece(partial)
+            assert t.bitfield.has(0)
+            before = t.bitfield.count()
+            await t._finish_piece(partial)  # stale second finish: no-op
+            assert t.bitfield.count() == before
+
+        run(go())
+
+    def test_fill_pipeline_skips_webseed_reservations(self):
+        """Peers must not race an in-flight HTTP fetch for a reserved
+        piece — outside endgame the scheduler skips webseed partials."""
+
+        async def go():
+            t, _ = TestSchedulerUnits().make_torrent(payload_len=4 * 32768)
+            reserved = _PartialPiece(
+                index=0, length=32768, buffer=bytearray(32768), webseed=True
+            )
+            t._partials[0] = reserved
+            peer = PeerConnection(
+                peer_id=b"W" * 20,
+                reader=object(),
+                writer=_FakeWriter(),
+                num_pieces=t.info.num_pieces,
+            )
+            peer.peer_choking = False
+            t.peers[peer.peer_id] = peer
+            for i in range(t.info.num_pieces):
+                peer.bitfield.set(i)
+                t._avail[i] += 1
+            t._rarity_dirty = True
+            await t._fill_pipeline(peer)
+            assert peer.inflight  # it did pick work...
+            assert all(blk[0] != 0 for blk in peer.inflight)  # ...but not piece 0
+
+        run(go())
+
+    def test_webseed_skips_piece_completed_by_peer(self):
+        """If a peer (endgame) finishes a reserved piece first, the
+        webseed's late finish must not double-count `downloaded`."""
+
+        async def go():
+            t, payload = TestSchedulerUnits().make_torrent(payload_len=4 * 32768)
+            reserved = _PartialPiece(
+                index=1,
+                length=32768,
+                buffer=bytearray(payload[32768:65536]),
+                webseed=True,
+            )
+            t._partials[1] = reserved
+            reserved.received.update(range(0, 32768, BLOCK_SIZE))
+            await t._finish_piece(reserved)  # "peer" completed it
+            downloaded_after_peer = t.downloaded
+            # the webseed loop's guard: stale partial no longer registered
+            assert t._partials.get(1) is not reserved
+            # a second finish on the stale object is a no-op
+            await t._finish_piece(reserved)
+            assert t.downloaded == downloaded_after_peer
+
+        run(go())
+
+    def _private_metainfo(self, payload, piece_len=32768):
+        pieces = b"".join(
+            hashlib.sha1(payload[i : i + piece_len]).digest()
+            for i in range(0, len(payload), piece_len)
+        )
+        return parse_metainfo(
+            bencode(
+                {
+                    b"announce": b"http://127.0.0.1:1/announce",
+                    b"info": {
+                        b"name": b"priv",
+                        b"piece length": piece_len,
+                        b"pieces": pieces,
+                        b"length": len(payload),
+                        b"private": 1,
+                    },
+                }
+            )
+        )
+
+    def test_private_torrent_skips_dht_and_pex(self):
+        """BEP 27: a private torrent must not announce to the DHT, gossip
+        PEX, or advertise ut_pex in its extended handshake."""
+
+        async def go():
+            rng = np.random.default_rng(6)
+            payload = rng.integers(0, 256, size=4 * 32768, dtype=np.uint8).tobytes()
+            m = self._private_metainfo(payload)
+            storage = Storage(MemoryStorage(), m.info)
+            t = Torrent(
+                metainfo=m,
+                storage=storage,
+                peer_id=generate_peer_id(),
+                port=1234,
+                config=fast_config(),
+                dht=object(),  # would crash if the dht loop ever ran
+            )
+            assert t.private
+            await t.start()
+            try:
+                names = {task.get_name() for task in t._tasks}
+                assert not any(n.startswith(("dht", "pex")) for n in names), names
+                # incoming PEX gossip is dropped
+                import torrent_tpu.net.extension as ext
+
+                peer = PeerConnection(
+                    peer_id=b"P" * 20,
+                    reader=object(),
+                    writer=_FakeWriter(),
+                    num_pieces=t.info.num_pieces,
+                )
+                t.peers[peer.peer_id] = peer
+                await t._handle_extended(
+                    peer,
+                    ext.LOCAL_EXT_IDS[ext.UT_PEX],
+                    bencode({b"added": b"\x7f\x00\x00\x01\x1a\xe1"}),
+                )
+                assert not t._dialing
+            finally:
+                await t.stop()
+
+        run(go())
+
+    def test_public_torrent_advertises_pex(self):
+        async def go():
+            t, _ = TestSchedulerUnits().make_torrent()
+            assert not t.private
+            await t.start()
+            try:
+                names = {task.get_name() for task in t._tasks}
+                assert any(n.startswith("pex") for n in names)
+            finally:
+                await t.stop()
+
+        run(go())

@@ -184,6 +184,12 @@ class TestWebseedDownload:
                 await asyncio.sleep(1.5)  # several fetch attempts
                 assert t.bitfield.count() == 0  # nothing verified
                 assert not t.on_complete.is_set()
+                # corrupt bytes were never counted as download progress
+                assert t.downloaded == 0
+                # the strike budget is exhausted → the URL is disabled and
+                # its loop has exited (no hot refetch forever)
+                names = {task.get_name() for task in t._tasks}
+                assert not any(n.startswith("webseed") for n in names), names
             finally:
                 await client.close()
                 httpd.shutdown()

@@ -61,11 +61,11 @@ def pack_compact_peer(ip: str, port: int) -> bytes:
 
 
 def unpack_compact_peers(blob: bytes) -> list[tuple[str, int]]:
-    out = []
-    for i in range(0, len(blob) - len(blob) % 6, 6):
-        ip = ".".join(str(b) for b in blob[i : i + 4])
-        out.append((ip, read_int(blob[i + 4 : i + 6], 2)))
-    return out
+    """BEP 5 'values' entries — the shared compact-v4 decoder (port-0
+    entries dropped, same as the PEX decoder)."""
+    from torrent_tpu.net.types import unpack_compact_v4
+
+    return unpack_compact_v4(blob)
 
 
 def pack_compact_node(node_id: bytes, ip: str, port: int) -> bytes:
@@ -210,7 +210,9 @@ class DHTNode:
         # info_hash -> {(ip, port): stored_at}
         self.peer_store: dict[bytes, dict[tuple[str, int], float]] = {}
         self._transport: asyncio.DatagramTransport | None = None
-        self._pending: dict[bytes, asyncio.Future] = {}
+        # tid -> (queried address, future): responses are only accepted
+        # from the address the query went to
+        self._pending: dict[bytes, tuple[tuple[str, int], asyncio.Future]] = {}
         self._tid_counter = random.randrange(1 << 16)
 
     # ------------------------------------------------------------- lifecycle
@@ -227,7 +229,7 @@ class DHTNode:
         if self._transport is not None:
             self._transport.close()
             self._transport = None
-        for fut in self._pending.values():
+        for _addr, fut in self._pending.values():
             if not fut.done():
                 fut.cancel()
         self._pending.clear()
@@ -249,7 +251,9 @@ class DHTNode:
         tid = self._next_tid()
         msg = {b"t": tid, b"y": b"q", b"q": q.encode(), b"a": {b"id": self.node_id, **args}}
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[tid] = fut
+        # The 16-bit tid alone is guessable: remember who we queried and
+        # only accept the response from that address.
+        self._pending[tid] = ((addr[0], addr[1]), fut)
         try:
             self._transport.sendto(bencode(msg), addr)
             return await asyncio.wait_for(fut, RPC_TIMEOUT)
@@ -285,23 +289,35 @@ class DHTNode:
             return
         if kind == b"r":
             r = msg.get(b"r")
-            fut = self._pending.get(tid)
-            if fut is not None and not fut.done():
-                if isinstance(r, dict):
-                    rid = r.get(b"id")
-                    if isinstance(rid, bytes) and len(rid) == 20:
-                        self.table.update(rid, addr[0], addr[1])
-                    fut.set_result(r)
-                else:
-                    # fail fast instead of burning the full RPC timeout
-                    fut.set_exception(DHTError("malformed response payload"))
+            entry = self._pending.get(tid)
+            if entry is not None:
+                queried_addr, fut = entry
+                # IP-only match: port-rewriting NATs legitimately answer
+                # from a different source port, and an off-path spoofer
+                # gains nothing from the port check (we chose the port).
+                if addr[0] != queried_addr[0]:
+                    log.debug("dht: response for tid from %s, queried %s; dropped", addr, queried_addr)
+                    return
+                if not fut.done():
+                    if isinstance(r, dict):
+                        rid = r.get(b"id")
+                        if isinstance(rid, bytes) and len(rid) == 20:
+                            self.table.update(rid, addr[0], addr[1])
+                        fut.set_result(r)
+                    else:
+                        # fail fast instead of burning the full RPC timeout
+                        fut.set_exception(DHTError("malformed response payload"))
             return
         if kind == b"e":
-            fut = self._pending.get(tid)
-            if fut is not None and not fut.done():
-                e = msg.get(b"e")
-                text = e[1].decode("utf-8", "replace") if isinstance(e, list) and len(e) > 1 and isinstance(e[1], bytes) else "remote error"
-                fut.set_exception(DHTError(text))
+            entry = self._pending.get(tid)
+            if entry is not None:
+                queried_addr, fut = entry
+                if addr[0] != queried_addr[0]:
+                    return
+                if not fut.done():
+                    e = msg.get(b"e")
+                    text = e[1].decode("utf-8", "replace") if isinstance(e, list) and len(e) > 1 and isinstance(e[1], bytes) else "remote error"
+                    fut.set_exception(DHTError(text))
             return
         if kind != b"q":
             return

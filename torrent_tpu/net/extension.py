@@ -26,6 +26,7 @@ import math
 from dataclasses import dataclass
 
 from torrent_tpu.codec.bencode import BencodeError, bdecode, bdecode_prefix, bencode
+from torrent_tpu.net.types import unpack_compact_v4 as _unpack_compact_v4
 
 # BEP 9: metadata is exchanged in 16 KiB pieces.
 METADATA_PIECE_SIZE = 16 * 1024
@@ -77,15 +78,22 @@ class ExtensionState:
 
 
 def encode_extended_handshake(
-    metadata_size: int | None = None, version: str = "", listen_port: int = 0
+    metadata_size: int | None = None,
+    version: str = "",
+    listen_port: int = 0,
+    exclude: tuple[bytes, ...] = (),
 ) -> bytes:
     """Payload for extended message id 0 (our side of the negotiation).
 
     ``listen_port`` is BEP 10's ``p`` key — without it an inbound peer's
     dialable port is unknowable (its TCP source port is ephemeral) and
-    PEX gossip about it would be dead addresses.
+    PEX gossip about it would be dead addresses. ``exclude`` drops
+    extensions from the advertised ``m`` dict (BEP 27 private torrents
+    must not advertise ut_pex).
     """
-    d: dict = {b"m": {name: eid for name, eid in LOCAL_EXT_IDS.items()}}
+    d: dict = {
+        b"m": {name: eid for name, eid in LOCAL_EXT_IDS.items() if name not in exclude}
+    }
     if metadata_size is not None:
         d[b"metadata_size"] = metadata_size
     if version:
@@ -238,15 +246,6 @@ def _pack_compact_v4(addrs) -> bytes:
     return bytes(out)
 
 
-def _unpack_compact_v4(blob: bytes) -> list[tuple[str, int]]:
-    out = []
-    for i in range(0, len(blob) - len(blob) % 6, 6):
-        port = int.from_bytes(blob[i + 4 : i + 6], "big")
-        if port == 0:
-            continue  # undialable; a hostile PEX pads with these
-        ip = ".".join(str(b) for b in blob[i : i + 4])
-        out.append((ip, port))
-    return out
 
 
 def encode_pex(added, dropped=()) -> bytes:

@@ -6,6 +6,23 @@ import enum
 from dataclasses import dataclass, field
 
 
+def unpack_compact_v4(blob: bytes) -> list[tuple[str, int]]:
+    """Decode 6-byte compact IPv4 (ip, port) entries (BEP 23 layout).
+
+    The one shared decoder for PEX, DHT values, and anything else that
+    speaks compact-v4 — port-0 entries are dropped everywhere (they are
+    undialable; hostile senders pad with them). Junk tail bytes ignored.
+    """
+    out = []
+    for i in range(0, len(blob) - len(blob) % 6, 6):
+        port = int.from_bytes(blob[i + 4 : i + 6], "big")
+        if port == 0:
+            continue
+        ip = ".".join(str(b) for b in blob[i : i + 4])
+        out.append((ip, port))
+    return out
+
+
 class AnnounceEvent(str, enum.Enum):
     """Announce event (types.ts:3-15)."""
 

@@ -71,6 +71,9 @@ class _PartialPiece:
     # (peer_id, ip) of every block contributor — corruption accounting
     # must survive the contributor disconnecting, so the IP rides along
     contributors: set[tuple[bytes, str | None]] = field(default_factory=set)
+    # Reserved by a webseed fetch: the block scheduler must not hand this
+    # piece to peers (they'd race the HTTP fetch), except in endgame.
+    webseed: bool = False
 
     @property
     def complete(self) -> bool:
@@ -94,6 +97,7 @@ class TorrentConfig:
     pex_interval: float = 60.0  # BEP 11 peer-exchange cadence
     webseed_retry: float = 15.0  # backoff after a webseed failure
     webseed_concurrency: int = 2  # parallel piece fetches per webseed
+    webseed_max_failures: int = 5  # consecutive bad pieces → URL disabled
 
 
 class Torrent:
@@ -169,6 +173,16 @@ class Torrent:
     # ----------------------------------------------------------- lifecycle
 
     @property
+    def private(self) -> bool:
+        """BEP 27: the info dict's ``private`` flag (part of the infohash).
+
+        Private torrents must not use DHT, PEX, or any peer source other
+        than their own trackers.
+        """
+        info_raw = self.metainfo.raw.get(b"info")
+        return isinstance(info_raw, dict) and info_raw.get(b"private") == 1
+
+    @property
     def left(self) -> int:
         have_bytes = sum(
             piece_length(self.info, i) for i in range(self.info.num_pieces) if self.bitfield.has(i)
@@ -186,11 +200,15 @@ class Torrent:
         self._stopping = False
         if self.trackers:
             self._spawn(self._announce_loop(), name="announce")
-        if self.dht is not None:
+        # BEP 27: a private torrent's peers come from its trackers ONLY —
+        # no DHT announces, no PEX gossip (tools/make_torrent.py writes
+        # the flag; without this gate the session would leak the swarm).
+        if self.dht is not None and not self.private:
             self._spawn(self._dht_loop(), name="dht")
         self._spawn(self._choke_loop(), name="choke")
         self._spawn(self._keepalive_loop(), name="keepalive")
-        self._spawn(self._pex_loop(), name="pex")
+        if not self.private:
+            self._spawn(self._pex_loop(), name="pex")
         for url in self.metainfo.web_seeds:
             self._spawn(self._webseed_loop(url), name=f"webseed-{url[:24]}")
 
@@ -475,7 +493,9 @@ class Torrent:
                     proto.Extended(
                         0,
                         ext.encode_extended_handshake(
-                            len(self.info_bytes()), listen_port=self.port
+                            len(self.info_bytes()),
+                            listen_port=self.port,
+                            exclude=(ext.UT_PEX,) if self.private else (),
                         ),
                     )
                 )
@@ -594,6 +614,8 @@ class Torrent:
             ext.decode_extended_handshake(payload, peer.ext)
             return
         if ext_id == ext.LOCAL_EXT_IDS[ext.UT_PEX]:
+            if self.private:
+                return  # BEP 27: ignore gossip a peer sends anyway
             pex = ext.decode_pex(payload)
             if pex is not None and pex.added:
                 from torrent_tpu.net.types import AnnouncePeer
@@ -676,7 +698,12 @@ class Torrent:
             return False
 
         # Prefer finishing partial pieces, then rarest-first fresh pieces.
-        for index in list(self._partials):
+        # Webseed-reserved partials are skipped: the HTTP fetch owns them
+        # (racing it would double-download; endgame below still covers
+        # them so a dead webseed can't stall completion).
+        for index, partial in list(self._partials.items()):
+            if partial.webseed:
+                continue
             if peer.bitfield.has(index) and not self.bitfield.has(index):
                 if take_from(index):
                     break
@@ -769,13 +796,24 @@ class Torrent:
             except (ConnectionError, OSError):
                 pass
 
-    async def _finish_piece(self, partial: _PartialPiece) -> None:
+    async def _finish_piece(self, partial: _PartialPiece) -> str:
         """Verify → persist → have-broadcast (the §8.3 missing hook).
+
+        Returns an outcome: ``"ok"``, ``"corrupt"`` (hash mismatch),
+        ``"io_error"`` (persist failed), or ``"stale"`` (another path
+        already finished this piece). Callers that attribute blame — the
+        webseed loop's per-URL strike counter — must distinguish corrupt
+        data from a local disk problem.
 
         With the TPU hasher, completed pieces from concurrent peers are
         verified as one device batch (the swarm-ingest face of the hash
         plane); otherwise per-piece hashlib off-thread.
         """
+        if self._partials.get(partial.index) is not partial:
+            # Another path (endgame peer vs webseed) already finished or
+            # reset this piece — finishing it twice would double-count
+            # stats and KeyError on the second removal.
+            return "stale"
         del self._partials[partial.index]
         data = bytes(partial.buffer)
         expected = self.info.pieces[partial.index]
@@ -783,14 +821,14 @@ class Torrent:
             log.warning("piece %d failed verification; re-requesting", partial.index)
             self.downloaded -= partial.length  # don't count poisoned data
             self._credit_corruption(partial.contributors)
-            return
+            return "corrupt"
         self._absolve(partial.contributors)
         base = partial.index * self.info.piece_length
         try:
             await asyncio.to_thread(self._write_piece, base, data)
         except StorageError as e:
             log.error("failed to persist piece %d: %s", partial.index, e)
-            return
+            return "io_error"
         self.bitfield.set(partial.index)
         if self.bitfield.count() % 16 == 0:
             self._checkpoint()  # periodic progress checkpoint
@@ -808,6 +846,7 @@ class Torrent:
             self._checkpoint()
             self.on_complete.set()
             self.request_peers()  # announce `completed` promptly
+        return "ok"
 
     def _write_piece(self, base: int, data: bytes) -> None:
         for off in range(0, len(data), BLOCK_SIZE):
@@ -1050,9 +1089,16 @@ class Torrent:
 
     async def _webseed_loop(self, url: str) -> None:
         """BEP 19: fill missing pieces from an HTTP seed; every fetched
-        piece passes the same verify→persist→have path as wire pieces."""
+        piece passes the same verify→persist→have path as wire pieces.
+
+        A webseed serving corrupt data has no wire contributors for the
+        strike system to ban, so the loop tracks consecutive hash
+        failures itself: backoff per failure, URL disabled at the
+        configured threshold (a hot refetch loop otherwise).
+        """
         from torrent_tpu.session.webseed import WebSeedError, fetch_piece
 
+        consecutive_failures = 0
         while not self._stopping and not self.bitfield.complete:
             picked = self._pick_webseed_pieces(self.config.webseed_concurrency)
             if not picked:
@@ -1065,6 +1111,7 @@ class Torrent:
                     index=index,
                     length=piece_length(self.info, index),
                     buffer=bytearray(piece_length(self.info, index)),
+                    webseed=True,
                 )
                 self._partials[index] = partial
                 reserved.append(partial)
@@ -1077,15 +1124,56 @@ class Torrent:
                 )
             except WebSeedError as e:
                 for p in reserved:
-                    self._partials.pop(p.index, None)
+                    if self._partials.get(p.index) is p:
+                        if p.received:
+                            # endgame peers delivered blocks meanwhile —
+                            # hand the partial (and their progress) back
+                            # to the block scheduler instead of discarding
+                            p.webseed = False
+                        else:
+                            del self._partials[p.index]
                 log.warning("webseed %s failed: %s; backing off", url, e)
                 await asyncio.sleep(self.config.webseed_retry)
                 continue
+            hash_failures = 0
             for partial, data in zip(reserved, datas):
+                if self._partials.get(partial.index) is not partial:
+                    # An endgame peer completed this piece while the HTTP
+                    # fetch was in flight — its _finish_piece already ran;
+                    # finishing ours too would double-count stats.
+                    continue
+                # Count only bytes the webseed actually contributed (endgame
+                # peers may have delivered blocks that ingest already
+                # counted), and clear those peers from the blame set — the
+                # buffer is now entirely the webseed's bytes, so a corrupt
+                # fetch must not strike innocent wire contributors.
+                already = sum(
+                    min(BLOCK_SIZE, partial.length - off) for off in partial.received
+                )
                 partial.buffer[:] = data
-                partial.received.update(range(0, partial.length, BLOCK_SIZE))
-                self.downloaded += partial.length
-                await self._finish_piece(partial)
+                partial.contributors.clear()
+                partial.received = set(range(0, partial.length, BLOCK_SIZE))
+                self.downloaded += partial.length - already
+                outcome = await self._finish_piece(partial)
+                if outcome == "corrupt":
+                    hash_failures += 1
+            if hash_failures:
+                consecutive_failures += hash_failures
+                if consecutive_failures >= self.config.webseed_max_failures:
+                    log.error(
+                        "webseed %s served %d corrupt pieces; disabling",
+                        url,
+                        consecutive_failures,
+                    )
+                    return
+                log.warning(
+                    "webseed %s served %d corrupt piece(s); backing off",
+                    url,
+                    hash_failures,
+                )
+                await asyncio.sleep(self.config.webseed_retry)
+            else:
+                consecutive_failures = 0
 
     async def _keepalive_loop(self) -> None:
         while not self._stopping:

@@ -31,6 +31,8 @@ from collections import Counter
 from dataclasses import dataclass, field
 from enum import Enum
 
+import numpy as np
+
 from torrent_tpu.codec.metainfo import Metainfo
 from torrent_tpu.net import extension as ext
 from torrent_tpu.net import protocol as proto
@@ -151,7 +153,7 @@ class Torrent:
         # rarity-ordered pick queue (rebuilt lazily when dirty), and a
         # multiset of blocks in flight across all peers — keeps block
         # ingest O(1)-ish instead of rescanning every peer bitfield.
-        self._avail = [0] * self.info.num_pieces
+        self._avail = np.zeros(self.info.num_pieces, dtype=np.int32)
         self._rarity_order: list[int] = []
         self._rarity_dirty = True
         self._inflight_count: Counter = Counter()
@@ -184,10 +186,16 @@ class Torrent:
 
     @property
     def left(self) -> int:
-        have_bytes = sum(
-            piece_length(self.info, i) for i in range(self.info.num_pieces) if self.bitfield.has(i)
-        )
-        return max(0, self.info.length - have_bytes)
+        # O(1): every piece is piece_length bytes except a possibly-short
+        # last piece — no per-announce scan over 100k-piece bitfields.
+        n = self.info.num_pieces
+        if n == 0:
+            return 0
+        missing = n - self.bitfield.count()
+        left = missing * self.info.piece_length
+        if not self.bitfield.has(n - 1):
+            left -= n * self.info.piece_length - self.info.length  # short tail
+        return max(0, left)
 
     async def start(self) -> None:
         """Resume from checkpoint or recheck existing data, then join."""
@@ -513,9 +521,7 @@ class Torrent:
         if self.peers.get(peer.peer_id) is not peer:
             return  # already dropped (or replaced by a newer connection)
         del self.peers[peer.peer_id]
-        for i in range(self.info.num_pieces):
-            if peer.bitfield.has(i):
-                self._avail[i] -= 1
+        self._avail -= peer.bitfield.as_numpy()
         self._rarity_dirty = True
         self._release_inflight(peer)
 
@@ -563,7 +569,16 @@ class Torrent:
                         peer.bitfield.set(index)
                         self._avail[index] += 1
                         self._rarity_dirty = True
-                    await self._update_interest(peer)
+                    # A Have can only turn interest ON, so this is O(1);
+                    # the full vector interest recheck is reserved for
+                    # bitfield replacement and our own piece completions
+                    # (where interest can flip off).
+                    if not self.bitfield.has(index):
+                        if not peer.am_interested:
+                            peer.am_interested = True
+                            await proto.send_message(peer.writer, proto.Interested())
+                        if not peer.peer_choking:
+                            await self._fill_pipeline(peer)
             case proto.BitfieldMsg(raw):
                 try:
                     new_bf = Bitfield(self.info.num_pieces, raw)
@@ -572,11 +587,9 @@ class Torrent:
                     # availability untouched (drop-peer will decrement the
                     # old one exactly once)
                     raise proto.ProtocolError("bad bitfield")
-                for i in range(self.info.num_pieces):
-                    if peer.bitfield.has(i):
-                        self._avail[i] -= 1
-                    if new_bf.has(i):
-                        self._avail[i] += 1
+                # in-place ufuncs cast bool→int32 themselves; no copies
+                self._avail += new_bf.as_numpy()
+                self._avail -= peer.bitfield.as_numpy()
                 peer.bitfield = new_bf
                 self._rarity_dirty = True
                 await self._update_interest(peer)
@@ -644,9 +657,10 @@ class Torrent:
     # ------------------------------------------------------------- leeching
 
     async def _update_interest(self, peer: PeerConnection) -> None:
-        want = any(
-            peer.bitfield.has(i)
-            for i in self.bitfield.missing()
+        # vectorized: "peer has any piece we're missing" without a Python
+        # scan per have/bitfield message
+        want = bool(
+            np.any(peer.bitfield.as_numpy() & ~self.bitfield.as_numpy())
         )
         if want and not peer.am_interested:
             peer.am_interested = True
@@ -659,10 +673,10 @@ class Torrent:
 
     def _rebuild_rarity(self) -> None:
         """Missing pieces ordered rarest-first with a stable random tiebreak."""
-        missing = list(self.bitfield.missing())
-        jitter = {i: random.random() for i in missing}
-        missing.sort(key=lambda i: (self._avail[i], jitter[i]))
-        self._rarity_order = missing
+        missing = np.flatnonzero(~self.bitfield.as_numpy())
+        jitter = np.random.random(len(missing))
+        order = np.lexsort((jitter, self._avail[missing]))
+        self._rarity_order = missing[order].tolist()
         self._rarity_dirty = False
 
     def _blocks_of(self, index: int):

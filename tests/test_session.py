@@ -807,3 +807,86 @@ class TestLargeGeometryScaling:
             assert t._avail[n - 1] == 2 and t._avail[0] == 1
 
         run(go())
+
+
+class TestConfigIsolationAndRaces:
+    """VERDICT weak #6 + #8: caller-owned configs are never mutated, and
+    concurrent delivery paths can't double-count or corrupt."""
+
+    def test_client_does_not_mutate_callers_torrent_config(self):
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        shared = TorrentConfig()
+        cfg = ClientConfig(hasher="tpu", torrent=shared)
+        Client(cfg)
+        assert shared.hasher == "cpu"  # untouched by construction
+
+        async def go():
+            client = Client(ClientConfig(host="127.0.0.1", hasher="cpu", torrent=shared))
+            await client.start()
+            try:
+                rng = np.random.default_rng(8)
+                payload = rng.integers(0, 256, size=2 * 32768, dtype=np.uint8).tobytes()
+                tb = build_torrent_bytes(payload, 32768, b"")
+                m = parse_metainfo(tb)
+                t = await client.add(m, Storage(MemoryStorage(), m.info))
+                # the torrent got a derived copy, not the caller's object
+                assert t.config is not shared
+                assert shared.hasher == "cpu"
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_two_peers_same_block_counted_once(self):
+        """Endgame duplicates: the same block arriving from two peers must
+        be ingested once — no double count, no buffer corruption."""
+
+        async def go():
+            t, payload = TestSchedulerUnits().make_torrent(payload_len=2 * 32768)
+            a = PeerConnection(
+                peer_id=b"A" * 20, reader=object(), writer=_FakeWriter(),
+                num_pieces=t.info.num_pieces,
+            )
+            b = PeerConnection(
+                peer_id=b"B" * 20, reader=object(), writer=_FakeWriter(),
+                num_pieces=t.info.num_pieces,
+            )
+            t.peers[a.peer_id] = a
+            t.peers[b.peer_id] = b
+            blocks = [
+                (begin, payload[begin : begin + BLOCK_SIZE])
+                for begin in range(0, 32768, BLOCK_SIZE)
+            ]
+            # interleave: A and B both deliver every block of piece 0
+            for begin, data in blocks:
+                await t._ingest_block(a, 0, begin, data)
+                await t._ingest_block(b, 0, begin, data)
+            assert t.bitfield.has(0)
+            assert t.downloaded == 32768  # each block counted exactly once
+
+        run(go())
+
+    def test_verifier_staging_buffer_reuse_is_safe(self):
+        """models/verifier.py contract: after _put_flat returns, the
+        caller may immediately overwrite the staging buffer without
+        corrupting the in-flight device batch."""
+        import hashlib as _hl
+
+        from torrent_tpu.models.verifier import TPUVerifier
+        from torrent_tpu.ops.padding import digests_to_words, pad_in_place
+
+        plen = 192
+        v = TPUVerifier(piece_length=plen, batch_size=8)
+        rng = np.random.default_rng(11)
+        pieces = [rng.integers(0, 256, plen, np.uint8).tobytes() for _ in range(8)]
+        padded = np.zeros((8, v.padded_len), dtype=np.uint8)
+        for i, p in enumerate(pieces):
+            padded[i, :plen] = np.frombuffer(p, dtype=np.uint8)
+        nblocks = pad_in_place(padded, np.full(8, plen, dtype=np.int64))
+        expected = digests_to_words([_hl.sha1(p).digest() for p in pieces])
+
+        chunks = v._put_flat(padded)
+        padded[:] = 0xFF  # hostile reuse: clobber the staging buffer NOW
+        ok = np.asarray(v._verify_step_flat(chunks, nblocks, expected))
+        assert ok.all(), "in-flight batch was corrupted by staging-buffer reuse"

@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import random
 import string
+import dataclasses
 from dataclasses import dataclass, field
 
 from torrent_tpu.codec.metainfo import Metainfo
@@ -53,7 +54,6 @@ class ClientConfig:
 class Client:
     def __init__(self, config: ClientConfig | None = None):
         self.config = config or ClientConfig()
-        self.config.torrent.hasher = self.config.hasher
         self.torrents: dict[bytes, Torrent] = {}
         self._server: asyncio.AbstractServer | None = None
         self._verifier_cache: dict[int, object] = {}
@@ -134,12 +134,19 @@ class Client:
             storage = Storage(FsStorage(storage), metainfo.info)
         elif not isinstance(storage, Storage):
             storage = Storage(storage, metainfo.info)
+        # Derive (never mutate) the per-torrent config: the client-level
+        # hasher choice is applied to a copy, so a TorrentConfig shared by
+        # the caller across clients stays untouched (the same
+        # shared-mutation bug class the reference had, SURVEY §8.2).
+        torrent_config = dataclasses.replace(
+            self.config.torrent, hasher=self.config.hasher
+        )
         torrent = Torrent(
             metainfo=metainfo,
             storage=storage,
             peer_id=self.config.peer_id,
             port=self.port,
-            config=self.config.torrent,
+            config=torrent_config,
             verifier=self._verifier_for(metainfo.info.piece_length),
             resume_store=resume_store,
             dht=self.dht,

@@ -1,0 +1,209 @@
+"""Streaming bridge ingest tests (north-star topology, SURVEY §2 bridge).
+
+The reference's Deno client would stream a 100 GiB recheck through the
+sidecar; these tests prove the sidecar's resident memory is bounded by
+its staging buffers, not the body: piece counts exceed the verifier's
+batch_size so multiple device flushes interleave with ingest, and the
+chunked-transfer case models a Deno ``fetch`` with a ReadableStream body.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+import pytest
+
+from torrent_tpu.codec.bencode import bdecode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _start(hasher: str):
+    from torrent_tpu.bridge.service import serve_bridge
+
+    return await serve_bridge(port=0, hasher=hasher)
+
+
+async def _post_raw(port: int, path: str, headers: dict[str, str], body: bytes,
+                    chunked: bool = False):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    head = [f"POST {path} HTTP/1.1", "Host: x"]
+    for k, v in headers.items():
+        head.append(f"{k}: {v}")
+    if chunked:
+        head.append("Transfer-Encoding: chunked")
+    else:
+        head.append(f"Content-Length: {len(body)}")
+    writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
+    if chunked:
+        # deliberately awkward chunk sizes so frames straddle chunk edges
+        pos, step = 0, 1000
+        while pos < len(body):
+            part = body[pos : pos + step]
+            writer.write(f"{len(part):x}\r\n".encode() + part + b"\r\n")
+            pos += step
+            step = step * 2 + 7
+        writer.write(b"0\r\n\r\n")
+    else:
+        writer.write(body)
+    await writer.drain()
+    status = int((await reader.readline()).split()[1])
+    clen = 0
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b""):
+            break
+        if line.lower().startswith(b"content-length:"):
+            clen = int(line.split(b":", 1)[1])
+    resp = await reader.readexactly(clen)
+    writer.close()
+    return status, resp
+
+
+def _frames(pieces, expected=None):
+    out = bytearray()
+    for i, p in enumerate(pieces):
+        out += len(p).to_bytes(4, "big") + p
+        if expected is not None:
+            out += expected[i]
+    return bytes(out)
+
+
+def _mk_pieces(n: int, plen: int) -> list[bytes]:
+    # ragged tail: last piece short, one empty-adjacent tiny piece
+    pieces = [bytes([i % 251]) * plen for i in range(n - 2)]
+    pieces.append(b"x" * (plen // 3 + 1))
+    pieces.append(b"y")
+    return pieces
+
+
+class TestStreamingBridge:
+    @pytest.mark.parametrize("hasher", ["cpu", "tpu"])
+    def test_stream_digests_multi_flush(self, hasher):
+        """Piece count > batch_size forces multiple staged device flushes."""
+
+        async def go():
+            server = await _start(hasher)
+            try:
+                plen = 1024
+                pieces = _mk_pieces(600, plen)  # batch_size=256 → 3 flushes
+                status, resp = await _post_raw(
+                    server.port,
+                    "/v1/stream/digests",
+                    {"X-Piece-Length": str(plen)},
+                    _frames(pieces),
+                )
+                assert status == 200
+                digests = bdecode(resp)[b"digests"]
+                assert digests == [hashlib.sha1(p).digest() for p in pieces]
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+    @pytest.mark.parametrize("hasher", ["cpu", "tpu"])
+    def test_stream_verify_chunked(self, hasher):
+        """Chunked transfer-encoding with frames straddling chunk edges."""
+
+        async def go():
+            server = await _start(hasher)
+            try:
+                plen = 2048
+                pieces = _mk_pieces(300, plen)
+                expected = [hashlib.sha1(p).digest() for p in pieces]
+                expected[7] = b"\x00" * 20
+                expected[299] = b"\xff" * 20
+                status, resp = await _post_raw(
+                    server.port,
+                    "/v1/stream/verify",
+                    {"X-Piece-Length": str(plen)},
+                    _frames(pieces, expected),
+                    chunked=True,
+                )
+                assert status == 200
+                body = bdecode(resp)
+                ok = body[b"ok"]
+                assert len(ok) == 300
+                assert ok[7] == 0 and ok[299] == 0
+                assert body[b"valid"] == 298
+                assert all(ok[i] == 1 for i in range(300) if i not in (7, 299))
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+    def test_stream_rejects_oversized_frame(self):
+        async def go():
+            server = await _start("cpu")
+            try:
+                body = _frames([b"z" * 100])
+                status, resp = await _post_raw(
+                    server.port, "/v1/stream/digests", {"X-Piece-Length": "64"}, body
+                )
+                assert status == 400
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+    def test_stream_requires_piece_length(self):
+        async def go():
+            server = await _start("cpu")
+            try:
+                status, _ = await _post_raw(
+                    server.port, "/v1/stream/digests", {}, _frames([b"a"])
+                )
+                assert status == 400
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+    def test_truncated_chunked_body_is_not_a_clean_200(self):
+        """A connection cut mid-chunked-body must not yield 200 over
+        partial frames (a silent partial recheck read as complete)."""
+
+        async def go():
+            server = await _start("cpu")
+            try:
+                reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+                body = _frames([b"a" * 64, b"b" * 64])
+                part = body[: len(body) // 2]
+                writer.write(
+                    b"POST /v1/stream/digests HTTP/1.1\r\nHost: x\r\n"
+                    b"X-Piece-Length: 64\r\nTransfer-Encoding: chunked\r\n\r\n"
+                    + f"{len(part):x}\r\n".encode()
+                    + part
+                    + b"\r\n"
+                )
+                await writer.drain()
+                writer.write_eof()  # cut the stream: no terminal 0-chunk
+                data = await reader.read()
+                assert b"200" not in data.split(b"\r\n", 1)[0]
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+    def test_stream_empty_body(self):
+        async def go():
+            server = await _start("cpu")
+            try:
+                status, resp = await _post_raw(
+                    server.port, "/v1/stream/digests", {"X-Piece-Length": "1024"}, b""
+                )
+                assert status == 200
+                assert bdecode(resp)[b"digests"] == []
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())

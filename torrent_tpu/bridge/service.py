@@ -11,20 +11,112 @@ codec every BitTorrent client already has:
                      → {ok: bytes}            (one 0x00/0x01 per piece)
   GET  /v1/info      → {backend, devices, batch} (capability probe)
 
-Hand-rolled asyncio HTTP (one round-trip, large bodies, Content-Length
-framing) — no web framework needed for three routes.
+Streaming ingest (the north-star topology: a Deno client pushing a
+100 GiB recheck must not need 100 GiB — or even 1 GiB — resident in the
+sidecar). The client declares the torrent's piece length in an
+``X-Piece-Length`` header and streams length-prefixed frames; the
+sidecar consumes them straight into the verifier's staging buffers,
+flushing a device batch every ``batch_size`` pieces. Resident memory is
+two staging buffers (~2 × batch × padded_len), independent of body size.
+Bodies may be Content-Length or chunked transfer-encoding (what a Deno
+``fetch`` with a ReadableStream body produces).
+
+  POST /v1/stream/digests   frames: u32be(len) | piece
+                            → {digests: [20B, ...]}
+  POST /v1/stream/verify    frames: u32be(len) | piece | 20B expected
+                            → {ok: bytes, valid: int}
+
+Hand-rolled asyncio HTTP — no web framework needed for five routes.
 """
 
 from __future__ import annotations
 
 import asyncio
+import threading
 
 from torrent_tpu.codec.bencode import BencodeError, bdecode, bencode
 from torrent_tpu.utils.log import get_logger
 
 log = get_logger("bridge")
 
-MAX_BODY = 1 << 30  # 1 GiB of piece data per request
+MAX_BODY = 1 << 30  # 1 GiB of piece data per buffered (non-stream) request
+# Cap on one streamed frame. 16 MiB is the practical BitTorrent piece-size
+# ceiling, and it keeps the staging-budget invariant honest even after
+# TPUVerifier rounds batch_size up to the mesh size: worst case is
+# 2 slots × max(batch, mesh) rows × ~16 MiB = 256 MiB on an 8-device mesh.
+MAX_PIECE = 16 << 20
+# An endless frame stream must not grow the result lists without bound:
+# 4M frames ≈ 80 MB of digests ≈ a 1 TiB torrent at 256 KiB pieces.
+MAX_STREAM_FRAMES = 1 << 22
+FRAME_TIMEOUT = 60.0  # idle seconds between frame reads before dropping
+
+
+class _BodyReader:
+    """Incremental body reader: Content-Length or chunked transfer-encoding.
+
+    Exposes ``read_upto(n)`` over the framed body and ``at_eof()`` once
+    the body is fully consumed — never holds more than one read's worth
+    of bytes beyond the StreamReader's own buffer.
+    """
+
+    def __init__(self, reader: asyncio.StreamReader, headers: dict[bytes, bytes]):
+        self._r = reader
+        te = headers.get(b"transfer-encoding", b"").lower()
+        self._chunked = b"chunked" in te
+        try:
+            self._remaining = int(headers.get(b"content-length", b"0") or 0)
+        except ValueError:
+            self._remaining = 0
+        self._chunk_left = 0  # bytes left in the current chunk (chunked mode)
+        self._done = not self._chunked and self._remaining == 0
+
+    async def _next_chunk(self) -> None:
+        size_line = await self._r.readline()
+        # tolerate the CRLF terminating the previous chunk
+        while size_line in (b"\r\n", b"\n"):
+            size_line = await self._r.readline()
+        if size_line == b"":
+            # connection cut mid-body: a truncated chunked stream must NOT
+            # read as clean EOF (a 200 over partial frames would be taken
+            # as a completed recheck)
+            raise asyncio.IncompleteReadError(b"", None)
+        size = int(size_line.split(b";", 1)[0].strip(), 16)
+        if size == 0:
+            # trailer section until blank line
+            while True:
+                line = await self._r.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            self._done = True
+        self._chunk_left = size
+
+    async def read_upto(self, n: int) -> bytes:
+        """Up to ``n`` body bytes; b"" at EOF."""
+        if self._done:
+            return b""
+        if self._chunked:
+            if self._chunk_left == 0:
+                await self._next_chunk()
+                if self._done:
+                    return b""
+            take = min(n, self._chunk_left)
+            data = await self._r.readexactly(take)
+            self._chunk_left -= take
+            return data
+        take = min(n, self._remaining)
+        data = await self._r.readexactly(take)
+        self._remaining -= take
+        if self._remaining == 0:
+            self._done = True
+        return data
+
+    async def at_eof(self) -> bool:
+        if self._done:
+            return True
+        if self._chunked and self._chunk_left == 0:
+            await self._next_chunk()
+            return self._done
+        return False
 
 
 class BridgeServer:
@@ -34,8 +126,13 @@ class BridgeServer:
         self.hasher = hasher
         self._server: asyncio.AbstractServer | None = None
         self._verifiers: dict[int, object] = {}
+        self._verifiers_lock = threading.Lock()
+        self._stream_gate: asyncio.Semaphore | None = None
 
     async def start(self) -> "BridgeServer":
+        # at most 4 concurrent streaming ingests hold staging buffers;
+        # further streams wait instead of multiplying resident memory
+        self._stream_gate = asyncio.Semaphore(4)
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
         log.info("bridge listening on %s:%d", self.host, self.port)
@@ -56,17 +153,230 @@ class BridgeServer:
             import hashlib
 
             return [hashlib.sha1(p).digest() for p in pieces]
-        from torrent_tpu.models.verifier import TPUVerifier
-
         cap = max((len(p) for p in pieces), default=64)
-        # bucket by next power of two so a handful of executables serve
-        # any piece geometry
-        bucket = 1 << (cap - 1).bit_length() if cap > 1 else 1
-        verifier = self._verifiers.get(bucket)
-        if verifier is None:
-            verifier = TPUVerifier(piece_length=bucket, batch_size=256)
-            self._verifiers[bucket] = verifier
-        return verifier.hash_pieces(pieces)
+        return self._stream_verifier(cap).hash_pieces(pieces)
+
+    # ~128 MiB per staging buffer regardless of piece size; the batch
+    # shrinks as pieces grow so a hostile X-Piece-Length can't OOM the
+    # sidecar (2 slots × budget ≈ 256 MiB peak, worst case one 64 MiB row
+    # per slot).
+    STAGING_BUDGET = 128 << 20
+
+    def _stream_verifier(self, plen: int):
+        """Verifier for the given piece length — pow-2 bucketed so a
+        handful of executables serve any geometry (shared by the buffered
+        and streaming routes)."""
+        from torrent_tpu.models.verifier import TPUVerifier
+        from torrent_tpu.ops.padding import padded_len_for
+
+        bucket = 1 << (plen - 1).bit_length() if plen > 1 else 1
+        # callers run on both the event loop and to_thread workers; the
+        # lock keeps a bucket from being built (and compiled) twice
+        with self._verifiers_lock:
+            verifier = self._verifiers.get(bucket)
+            if verifier is None:
+                batch = max(1, min(256, self.STAGING_BUDGET // padded_len_for(bucket)))
+                verifier = TPUVerifier(piece_length=bucket, batch_size=batch)
+                self._verifiers[bucket] = verifier
+        return verifier
+
+    # ----------------------------------------------------------- streaming
+
+    async def _route_stream(self, writer, target: str, headers, body: _BodyReader):
+        """Length-prefixed frame ingest with bounded resident memory.
+
+        Frames land directly in the verifier's staging buffers; a device
+        batch is flushed every ``batch_size`` pieces on a worker thread
+        while the event loop keeps ingesting into the other buffer
+        (``verify_batch``/``digest_batch`` return only after the staging
+        buffer is fully uploaded, so reuse after the flush future resolves
+        is safe). Peak memory ≈ 2 staging buffers, independent of body size.
+        """
+        mode = target.rsplit("/", 1)[-1]
+        if mode not in ("digests", "verify"):
+            return await self._reply(writer, 404, b"not found")
+        try:
+            plen = int(headers.get(b"x-piece-length", b"0") or 0)
+        except ValueError:
+            plen = 0
+        if plen <= 0 or plen > MAX_PIECE:
+            return await self._reply(writer, 400, b"X-Piece-Length required (1..16MiB)")
+
+        if self.hasher == "cpu":
+            return await self._stream_cpu(writer, mode, plen, body)
+        async with self._stream_gate:
+            await self._stream_tpu(writer, mode, plen, body)
+
+    @staticmethod
+    async def _read_idle_bounded(body: _BodyReader, n: int) -> bytes:
+        """``readexactly(n)`` where the timeout bounds *idle* time, not
+        total transfer time — each successful chunk resets the clock, so a
+        slow-but-live client streaming a big piece is never dropped."""
+        parts, got = [], 0
+        while got < n:
+            chunk = await asyncio.wait_for(
+                body.read_upto(min(n - got, 1 << 18)), FRAME_TIMEOUT
+            )
+            if not chunk:
+                raise asyncio.IncompleteReadError(b"".join(parts), n)
+            parts.append(chunk)
+            got += len(chunk)
+        return b"".join(parts)
+
+    async def _read_frame(self, body: _BodyReader, plen: int, with_expected: bool):
+        """One ``len | piece [| expected]`` frame, or None at clean EOF.
+
+        Reads are idle-bounded so a silent client can't pin staging
+        buffers forever. Raises ValueError on an oversized frame.
+        """
+        if await asyncio.wait_for(body.at_eof(), FRAME_TIMEOUT):
+            return None
+        ln = int.from_bytes(await self._read_idle_bounded(body, 4), "big")
+        if ln > plen:
+            raise ValueError("frame exceeds X-Piece-Length")
+        data = await self._read_idle_bounded(body, ln)
+        expected = await self._read_idle_bounded(body, 20) if with_expected else None
+        return data, expected
+
+    async def _stream_tpu(self, writer, mode: str, plen: int, body: _BodyReader):
+        import concurrent.futures
+
+        import numpy as np
+
+        from torrent_tpu.ops.padding import (
+            alloc_padded,
+            digests_to_words,
+            pad_in_place,
+            words_to_digests,
+        )
+
+        # verifier construction (JAX init, jit setup) and the ~128 MiB slot
+        # memsets run off the event loop so health probes and other
+        # connections stay live through them
+        verifier = await asyncio.to_thread(self._stream_verifier, plen)
+        b = verifier.batch_size
+        slots: list[dict] = []  # allocated lazily on the first frame
+
+        def make_slot():
+            padded, view = alloc_padded(b, verifier.piece_length)
+            return {
+                "padded": padded,
+                "view": view,
+                "lengths": np.zeros(b, dtype=np.int64),
+                "expected": np.zeros((b, 5), dtype=np.uint32),
+            }
+
+        loop = asyncio.get_running_loop()
+        flusher = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        pending: list = []  # in-order flush futures
+        digests: list[bytes] = []
+        ok_flags = bytearray()
+
+        def flush(slot, k):
+            nblocks = pad_in_place(slot["padded"], slot["lengths"])
+            nblocks[k:] = 0
+            if mode == "digests":
+                words = verifier.digest_batch(slot["padded"], nblocks)
+                return words_to_digests(words[:k])
+            ok = verifier.verify_batch(slot["padded"], nblocks, slot["expected"])
+            return bytes(ok[:k].astype(np.uint8))
+
+        def collect(res):
+            if mode == "digests":
+                digests.extend(res)
+            else:
+                ok_flags.extend(res)
+
+        try:
+            slot_idx, k, n_frames = 0, 0, 0
+            while True:
+                frame = await self._read_frame(body, plen, mode == "verify")
+                if frame is None:
+                    break
+                n_frames += 1
+                if n_frames > MAX_STREAM_FRAMES:
+                    return await self._reply(writer, 413, b"too many frames")
+                data, exp = frame
+                if not slots:
+                    slots = await asyncio.to_thread(lambda: [make_slot(), make_slot()])
+                slot = slots[slot_idx]
+                ln = len(data)
+                slot["padded"][k, ln:] = 0  # clear stale pad bytes from last use
+                slot["view"][k, :ln] = np.frombuffer(data, dtype=np.uint8)
+                slot["lengths"][k] = ln
+                if exp is not None:
+                    slot["expected"][k] = digests_to_words([exp])[0]
+                k += 1
+                if k == b:
+                    pending.append(loop.run_in_executor(flusher, flush, slot, k))
+                    slot_idx, k = 1 - slot_idx, 0
+                    if len(pending) == 2:
+                        collect(await pending.pop(0))
+            if k:
+                pending.append(loop.run_in_executor(flusher, flush, slots[slot_idx], k))
+            for fut in pending:
+                collect(await fut)
+            if mode == "digests":
+                payload = bencode({b"digests": digests})
+            else:
+                payload = bencode({b"ok": bytes(ok_flags), b"valid": sum(ok_flags)})
+            await self._reply(writer, 200, payload)
+        except ValueError as e:
+            await self._reply(writer, 400, str(e).encode())
+        finally:
+            flusher.shutdown(wait=False)
+
+    async def _stream_cpu(self, writer, mode: str, plen: int, body: _BodyReader):
+        """hashlib fallback for ``hasher='cpu'``.
+
+        Frames are hashed off the event loop in batches (≤64 frames or
+        8 MiB) so neither thread-hop overhead per small piece nor a long
+        inline hash of a big piece stalls concurrent connections.
+        """
+        import hashlib
+
+        digests: list[bytes] = []
+        ok_flags = bytearray()
+        batch: list[bytes] = []
+        batch_exp: list[bytes] = []
+        batch_bytes = 0
+        n_frames = 0
+
+        async def do_flush():
+            nonlocal batch, batch_exp, batch_bytes
+            ds = await asyncio.to_thread(
+                lambda ps: [hashlib.sha1(p).digest() for p in ps], batch
+            )
+            if mode == "digests":
+                digests.extend(ds)
+            else:
+                ok_flags.extend(1 if d == e else 0 for d, e in zip(ds, batch_exp))
+            batch, batch_exp, batch_bytes = [], [], 0
+
+        try:
+            while True:
+                frame = await self._read_frame(body, plen, mode == "verify")
+                if frame is None:
+                    break
+                n_frames += 1
+                if n_frames > MAX_STREAM_FRAMES:
+                    return await self._reply(writer, 413, b"too many frames")
+                data, exp = frame
+                batch.append(data)
+                batch_bytes += len(data)
+                if exp is not None:
+                    batch_exp.append(exp)
+                if len(batch) >= 64 or batch_bytes >= (8 << 20):
+                    await do_flush()
+            if batch:
+                await do_flush()
+        except ValueError as e:
+            return await self._reply(writer, 400, str(e).encode())
+        if mode == "digests":
+            payload = bencode({b"digests": digests})
+        else:
+            payload = bencode({b"ok": bytes(ok_flags), b"valid": sum(ok_flags)})
+        await self._reply(writer, 200, payload)
 
     # --------------------------------------------------------------- http
 
@@ -76,13 +386,21 @@ class BridgeServer:
             if len(request_line) < 2:
                 return await self._reply(writer, 400, b"bad request")
             method, target = request_line[0].decode(), request_line[1].decode()
-            content_length = 0
+            headers: dict[bytes, bytes] = {}
             while True:
-                line = await reader.readline()
+                line = await asyncio.wait_for(reader.readline(), 60)
                 if line in (b"\r\n", b"\n", b""):
                     break
-                if line.lower().startswith(b"content-length:"):
-                    content_length = int(line.split(b":", 1)[1])
+                if b":" in line:
+                    k, v = line.split(b":", 1)
+                    headers[k.strip().lower()] = v.strip()
+            if method == "POST" and target.startswith("/v1/stream/"):
+                body_reader = _BodyReader(reader, headers)
+                return await self._route_stream(writer, target, headers, body_reader)
+            try:
+                content_length = int(headers.get(b"content-length", b"0") or 0)
+            except ValueError:
+                return await self._reply(writer, 400, b"bad content-length")
             if content_length > MAX_BODY:
                 return await self._reply(writer, 413, b"body too large")
             body = await reader.readexactly(content_length) if content_length else b""
@@ -116,6 +434,10 @@ class BridgeServer:
         pieces = req[b"pieces"]
         if not all(isinstance(p, bytes) for p in pieces):
             return await self._reply(writer, 400, b"pieces must be bytestrings")
+        if any(len(p) > MAX_PIECE for p in pieces):
+            # same cap as the stream routes: an oversized piece would build
+            # (and cache) a verifier bucket far beyond the staging budget
+            return await self._reply(writer, 413, b"piece exceeds 16MiB cap")
 
         if target == "/v1/digests":
             digests = await asyncio.to_thread(self._digests, pieces)

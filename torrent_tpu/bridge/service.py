@@ -387,10 +387,14 @@ class BridgeServer:
                 return await self._reply(writer, 400, b"bad request")
             method, target = request_line[0].decode(), request_line[1].decode()
             headers: dict[bytes, bytes] = {}
+            header_bytes = 0
             while True:
                 line = await asyncio.wait_for(reader.readline(), 60)
                 if line in (b"\r\n", b"\n", b""):
                     break
+                header_bytes += len(line)
+                if header_bytes > (16 << 10):  # endless header lines ≠ a request
+                    return await self._reply(writer, 431, b"headers too large")
                 if b":" in line:
                     k, v = line.split(b":", 1)
                     headers[k.strip().lower()] = v.strip()

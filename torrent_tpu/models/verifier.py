@@ -21,7 +21,6 @@ traffic is output gathering.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
@@ -48,6 +47,7 @@ from torrent_tpu.parallel.mesh import (
     round_up_to_multiple,
 )
 from torrent_tpu.parallel.verify import VerifyResult
+from torrent_tpu.utils.env import env_int
 from torrent_tpu.storage.storage import Storage
 
 
@@ -74,7 +74,7 @@ class TPUVerifier:
             # local piece sub-batch (embarrassingly parallel, no collectives).
             # Per-device sub-batches must be TILE(=1024)-aligned or every
             # launch pads with wasted sentinel rows.
-            from jax.experimental.shard_map import shard_map
+            from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
             from torrent_tpu.ops.sha1_pallas import TILE
@@ -127,10 +127,7 @@ class TPUVerifier:
         # 4 concurrent streams saturate both a local PCIe path and this
         # image's relay tunnel; 8+ makes the tunnel collapse (measured
         # ~190 MiB/s vs ~1.7 GiB/s at 4 on the raw path).
-        try:
-            self._upload_chunks = max(1, int(os.environ.get("TORRENT_TPU_UPLOAD_CHUNKS", "4")))
-        except ValueError:
-            self._upload_chunks = 4
+        self._upload_chunks = env_int("TORRENT_TPU_UPLOAD_CHUNKS", 4)
         self._upload_pool: ThreadPoolExecutor | None = None
         # verify_batch/digest_batch may be called from several threads on a
         # shared verifier (the bridge does); first-use pool init must not race

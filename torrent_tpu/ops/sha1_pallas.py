@@ -33,18 +33,23 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from torrent_tpu.ops.sha1_jax import _IV, _K, _bswap32, _rotl
+from torrent_tpu.utils.env import env_int
 
-# Pieces per program instance: one (8, 128) int32 vreg worth of lanes.
-TILE_SUB = 8
+# Pieces per program instance: TILE_SUB sublane-rows × 128 lanes. At the
+# default 8 each state/schedule variable is exactly one int32 vreg; larger
+# TILE_SUB (16/32) makes every jnp op span multiple vregs, interleaving
+# independent SHA1 chains to fill the VPU's ALUs past the single chain's
+# serial dependency path (measured: the win on real v5e hardware).
+TILE_SUB = env_int("TORRENT_TPU_SHA1_TILE_SUB", 8)
 TILE_LANE = 128
-TILE = TILE_SUB * TILE_LANE  # 1024
+TILE = TILE_SUB * TILE_LANE
 # SHA1 blocks chained per grid step. Each block is only ~640 vector ops on
 # a (8, 128) tile — far less than the fixed per-step cost (DMA issue,
 # revisited-block bookkeeping), so one-block steps are overhead-bound.
 # The kernel runs UNROLL blocks per step via an in-kernel fori_loop (NOT
 # Python unrolling — 640 rounds in one basic block sends the backend
 # compiler superlinear); 16 keeps the step's DMA at 1 MiB.
-UNROLL = 16
+UNROLL = env_int("TORRENT_TPU_SHA1_UNROLL", 16)
 
 
 def _one_block(state, w):

@@ -1,0 +1,14 @@
+"""Environment-variable parsing helpers (shared by tuning knobs)."""
+
+from __future__ import annotations
+
+import os
+
+
+def env_int(name: str, default: int, minimum: int = 1) -> int:
+    """Integer env knob: malformed values fall back to ``default``,
+    parsed values are clamped to ``minimum``."""
+    try:
+        return max(minimum, int(os.environ.get(name, default)))
+    except ValueError:
+        return default

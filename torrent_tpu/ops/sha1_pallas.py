@@ -41,6 +41,11 @@ from torrent_tpu.utils.env import env_int
 # independent SHA1 chains to fill the VPU's ALUs past the single chain's
 # serial dependency path (measured: the win on real v5e hardware).
 TILE_SUB = env_int("TORRENT_TPU_SHA1_TILE_SUB", 8)
+if TILE_SUB % 8 or TILE_SUB > 64:
+    raise ValueError(
+        f"TORRENT_TPU_SHA1_TILE_SUB={TILE_SUB}: must be a multiple of 8 (the "
+        "int32 vreg sublane count) and <= 64 (VMEM block budget)"
+    )
 TILE_LANE = 128
 TILE = TILE_SUB * TILE_LANE
 # SHA1 blocks chained per grid step. Each block is only ~640 vector ops on
@@ -50,6 +55,11 @@ TILE = TILE_SUB * TILE_LANE
 # Python unrolling — 640 rounds in one basic block sends the backend
 # compiler superlinear); 16 keeps the step's DMA at 1 MiB.
 UNROLL = env_int("TORRENT_TPU_SHA1_UNROLL", 16)
+if UNROLL > 128:
+    raise ValueError(
+        f"TORRENT_TPU_SHA1_UNROLL={UNROLL}: > 128 blows the per-step VMEM "
+        "block (unroll*16 words per lane) with no amortization left to gain"
+    )
 
 
 def _one_block(state, w):

@@ -315,10 +315,14 @@ def _device_plane_pps(verifier, plen):
     import jax.numpy as jnp
 
     b = verifier.batch_size
-    # All batches stay device-resident during the timed queue. On CPU the
-    # "device" is host RAM and the plane/e2e distinction is moot, so keep
-    # the footprint small there.
-    n_batches = 4 if jax.devices()[0].platform != "cpu" else 2
+    # All batches stay device-resident during the timed queue; cap the
+    # working set so big geometries (4096 × 1 MiB pieces ≈ 4.3 GB/batch)
+    # leave HBM room for the kernel's swizzled copy. On CPU the "device"
+    # is host RAM and the plane/e2e distinction is moot — keep it small.
+    batch_bytes = b * verifier.padded_len
+    n_batches = max(2, min(4, (8 << 30) // max(1, batch_bytes)))
+    if jax.devices()[0].platform == "cpu":
+        n_batches = 2
     rng = np.random.default_rng(1234)
     base = np.zeros(verifier.padded_len, dtype=np.uint8)
     base[:plen] = rng.integers(0, 256, plen, dtype=np.uint8)

@@ -47,6 +47,48 @@ class TestCli:
         assert rc == 2
         assert "first invalid pieces: [0]" in capsys.readouterr().out
 
+    def test_make_v2_info_verify_roundtrip(self, payload_dir, tmp_path, capsys):
+        """BEP 52 flow: author --v2 → info autodetects → verify localizes
+        corruption to one file's piece without touching the other."""
+        out = str(tmp_path / "made_v2.torrent")
+        rc = main(
+            ["make", str(payload_dir), "http://127.0.0.1:1/announce", "-o", out,
+             "--piece-length", "16384", "--v2"]
+        )
+        assert rc == 0
+        assert "v2" in capsys.readouterr().out
+
+        rc = main(["info", out])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "BitTorrent v2" in text and "info hash v2" in text
+
+        rc = main(["verify", out, str(payload_dir.parent), "--hasher", "cpu"])
+        assert rc == 0
+        assert "(v2)" in capsys.readouterr().out
+
+        blob = bytearray((payload_dir / "one.bin").read_bytes())
+        blob[0] ^= 0xFF
+        (payload_dir / "one.bin").write_bytes(bytes(blob))
+        rc = main(["verify", out, str(payload_dir.parent), "--hasher", "cpu"])
+        assert rc == 2
+        text = capsys.readouterr().out
+        assert "one.bin: bad pieces [0]" in text
+
+    def test_make_v2_single_file(self, tmp_path, capsys):
+        """Single-file v2 payload verifies at <dir>/<name> (v1 Storage
+        convention), not <dir>/<name>/<name>."""
+        rng = np.random.default_rng(33)
+        payload = tmp_path / "solo.bin"
+        payload.write_bytes(rng.integers(0, 256, size=70_000, dtype=np.uint8).tobytes())
+        out = str(tmp_path / "solo_v2.torrent")
+        rc = main(["make", str(payload), "http://127.0.0.1:1/announce", "-o", out,
+                   "--piece-length", "16384", "--v2"])
+        assert rc == 0
+        rc = main(["verify", out, str(tmp_path), "--hasher", "cpu"])
+        assert rc == 0
+        assert "pieces valid (v2)" in capsys.readouterr().out
+
     def test_info_rejects_garbage(self, tmp_path, capsys):
         bad = tmp_path / "bad.torrent"
         bad.write_bytes(b"this is not bencode")

@@ -1,0 +1,301 @@
+"""BitTorrent v2 (BEP 52) plane: SHA-256 kernels, merkle trees, codec,
+author/verify round-trips.
+
+The oracle is an independent hashlib implementation written straight
+from the BEP 52 text (leaves = SHA-256 of 16 KiB blocks, zero-hash
+padding to the next power of two, interior nodes = SHA-256 of child
+concatenation) — it shares no code with the plane under test.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from torrent_tpu.codec.metainfo_v2 import (
+    BLOCK,
+    encode_metainfo_v2,
+    parse_metainfo_v2,
+)
+from torrent_tpu.models.merkle import (
+    digests_to_words32,
+    merkle_root,
+    sha256_pairs,
+    words32_to_digests,
+    zero_chain,
+)
+from torrent_tpu.models.v2 import build_v2, hash_file_v2, verify_v2
+from torrent_tpu.ops.padding import pad_pieces
+from torrent_tpu.ops.sha256_jax import sha256_pieces_jax
+
+
+# ------------------------------------------------------------------ oracle
+
+
+def oracle_root(data: bytes, piece_length: int) -> tuple[bytes, list[bytes]]:
+    """Straight-from-the-BEP hashlib merkle: returns (root, piece layer)."""
+    n_blocks = max(1, -(-len(data) // BLOCK))
+    leaves = [
+        hashlib.sha256(data[i * BLOCK : (i + 1) * BLOCK]).digest() for i in range(n_blocks)
+    ]
+    if len(data) <= piece_length:
+        target = 1 << max(0, (n_blocks - 1).bit_length())
+        leaves += [b"\x00" * 32] * (target - n_blocks)
+        while len(leaves) > 1:
+            leaves = [
+                hashlib.sha256(leaves[i] + leaves[i + 1]).digest()
+                for i in range(0, len(leaves), 2)
+            ]
+        return leaves[0], []
+    # pad leaves to a pow2 multiple of blocks-per-piece, reduce fully
+    lpp = piece_length // BLOCK
+    n_pieces = -(-n_blocks // lpp)
+    total = lpp * (1 << max(0, (n_pieces - 1).bit_length()))
+    leaves += [b"\x00" * 32] * (total - n_blocks)
+    level = leaves
+    layer = None
+    while len(level) > 1:
+        if len(level) == total // lpp:
+            layer = level[:n_pieces]
+        level = [
+            hashlib.sha256(level[i] + level[i + 1]).digest() for i in range(0, len(level), 2)
+        ]
+    if len(level) == total // lpp:  # single-piece-after-padding edge
+        layer = level[:n_pieces]
+    return level[0], list(layer)
+
+
+# ------------------------------------------------------------------ kernels
+
+
+class TestSha256Kernels:
+    NIST = [
+        (b"abc", "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"),
+        (b"", "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"),
+        (
+            b"abcdbcdecdefdefgefghfghighijhijkijkljklmklmnlmnomnopnopq",
+            "248d6a61d20638b8e5c026930c3e6039a33ce45964ff2167f6ecedd419db06c1",
+        ),
+    ]
+
+    def test_nist_vectors_jax(self):
+        msgs = [m for m, _ in self.NIST] + [b"a" * 1000, bytes(range(256)) * 7]
+        padded, nblocks = pad_pieces(msgs)
+        words = np.asarray(sha256_pieces_jax(padded, nblocks))
+        for i, m in enumerate(msgs):
+            got = b"".join(int(w).to_bytes(4, "big") for w in words[i])
+            assert got == hashlib.sha256(m).digest(), f"msg {i}"
+
+    def test_nist_vectors_pallas_interpret(self):
+        # short messages only — interpret mode simulates all 1024 lanes
+        from torrent_tpu.ops.sha256_pallas import sha256_pieces_pallas
+
+        msgs = [m for m, _ in self.NIST] + [b"x" * 120, b"y" * 300]
+        padded, nblocks = pad_pieces(msgs)
+        words = np.asarray(sha256_pieces_pallas(padded, nblocks, interpret=True))
+        for i, m in enumerate(msgs):
+            got = b"".join(int(w).to_bytes(4, "big") for w in words[i])
+            assert got == hashlib.sha256(m).digest(), f"msg {i}"
+
+    def test_pairs_matches_hashlib(self):
+        rng = np.random.default_rng(3)
+        kids = [rng.bytes(32) for _ in range(64)]
+        words = digests_to_words32(kids).reshape(-1, 16)
+        out = words32_to_digests(np.asarray(sha256_pairs(words)))
+        exp = [hashlib.sha256(kids[i] + kids[i + 1]).digest() for i in range(0, 64, 2)]
+        assert out == exp
+
+    def test_merkle_root_matches_oracle(self):
+        rng = np.random.default_rng(4)
+        leaves = [rng.bytes(32) for _ in range(16)]
+        words = digests_to_words32(leaves)
+        got = words32_to_digests(merkle_root(words)[None, :])[0]
+        level = leaves
+        while len(level) > 1:
+            level = [
+                hashlib.sha256(level[i] + level[i + 1]).digest()
+                for i in range(0, len(level), 2)
+            ]
+        assert got == level[0]
+
+    def test_zero_chain(self):
+        zc = zero_chain(3)
+        assert zc[0] == b"\x00" * 32
+        assert zc[1] == hashlib.sha256(b"\x00" * 64).digest()
+        assert zc[2] == hashlib.sha256(zc[1] + zc[1]).digest()
+
+
+# ------------------------------------------------------------------ plane
+
+
+PLEN = 4 * BLOCK  # 64 KiB pieces → 4 leaves per piece
+
+
+class TestHashFileV2:
+    @pytest.mark.parametrize("hasher", ["cpu", "tpu"])
+    @pytest.mark.parametrize(
+        "size",
+        [
+            1,  # sub-block
+            BLOCK,  # exactly one block
+            BLOCK + 1,
+            3 * BLOCK,  # sub-piece, non-pow2 blocks
+            PLEN,  # exactly one piece
+            PLEN + 1,  # multi-piece, ragged
+            3 * PLEN + BLOCK // 2,  # 4 pieces, ragged tail
+            8 * PLEN,  # pow2 pieces, aligned
+        ],
+    )
+    def test_matches_oracle(self, hasher, size):
+        rng = np.random.default_rng(size)
+        data = rng.bytes(size)
+        root, layer = hash_file_v2(data, PLEN, hasher=hasher)
+        exp_root, exp_layer = oracle_root(data, PLEN)
+        assert root == exp_root
+        assert list(layer) == exp_layer
+
+
+class TestV2RoundTrip:
+    def _corpus(self):
+        rng = np.random.default_rng(7)
+        return [
+            (("docs", "a.txt"), rng.bytes(3 * PLEN + 100)),
+            (("docs", "b.bin"), rng.bytes(BLOCK // 2)),
+            (("big.dat",), rng.bytes(5 * PLEN)),
+            (("empty.txt",), b""),
+        ]
+
+    def test_author_parse_verify(self):
+        files = self._corpus()
+        meta = build_v2(files, name="v2demo", piece_length=PLEN, hasher="cpu")
+        assert meta.info.name == "v2demo"
+        assert meta.truncated_info_hash == meta.info_hash_v2[:20]
+        # encode → reparse is stable
+        enc = encode_metainfo_v2(meta.info, meta.piece_layers, announce="http://t/a")
+        again = parse_metainfo_v2(enc)
+        assert again is not None and again.info == meta.info
+
+        lookup = {p: d for p, d in files}
+        res = verify_v2(lambda p: lookup.get(p), meta, hasher="cpu")
+        for f in meta.info.files:
+            assert res[f.path].all(), f.path
+
+    @pytest.mark.parametrize("hasher", ["cpu", "tpu"])
+    def test_corruption_flips_exactly_that_piece(self, hasher):
+        files = self._corpus()
+        meta = build_v2(files, name="v2demo", piece_length=PLEN, hasher=hasher)
+        lookup = {p: d for p, d in files}
+        # corrupt one byte inside piece 2 of big.dat
+        big = bytearray(lookup[("big.dat",)])
+        big[2 * PLEN + 5] ^= 0xFF
+        lookup[("big.dat",)] = bytes(big)
+        res = verify_v2(lambda p: lookup.get(p), meta, hasher=hasher)
+        bad = res[("big.dat",)]
+        assert not bad[2]
+        assert bad[0] and bad[1] and bad[3] and bad[4]
+        assert res[("docs", "a.txt")].all()
+
+    def test_hostile_layer_rejected_wholesale(self):
+        """A piece layer that matches the data but doesn't merkle up to
+        the published root must fail every piece (metadata lies about
+        where damage would localize)."""
+        import dataclasses
+
+        files = self._corpus()
+        meta = build_v2(files, name="v2demo", piece_length=PLEN, hasher="cpu")
+        big_root = next(f.pieces_root for f in meta.info.files if f.path == ("big.dat",))
+        layers = dict(meta.piece_layers)
+        tampered = list(layers[big_root])
+        tampered[0] = b"\xaa" * 32
+        layers[big_root] = tuple(tampered)
+        hostile = dataclasses.replace(meta, piece_layers=layers)
+        lookup = {p: d for p, d in files}
+        res = verify_v2(lambda p: lookup.get(p), hostile, hasher="cpu")
+        assert not res[("big.dat",)].any()
+        assert res[("docs", "a.txt")].all()  # other files untouched
+
+    def test_missing_and_truncated_files(self):
+        files = self._corpus()
+        meta = build_v2(files, name="v2demo", piece_length=PLEN, hasher="cpu")
+        lookup = {p: d for p, d in files}
+        lookup[("docs", "a.txt")] = lookup[("docs", "a.txt")][:-1]  # truncated
+        del lookup[("big.dat",)]  # missing
+        res = verify_v2(lambda p: lookup.get(p), meta, hasher="cpu")
+        assert not res[("docs", "a.txt")].any()
+        assert not res[("big.dat",)].any()
+        assert res[("docs", "b.bin")].all()
+        assert res[("empty.txt",)].shape == (0,)
+
+    def test_path_sources_stream_and_match_bytes(self, tmp_path):
+        """A filesystem-path source must hash identically to resident
+        bytes (the streaming author/verify path)."""
+        rng = np.random.default_rng(9)
+        data = rng.bytes(3 * PLEN + 777)
+        fp = tmp_path / "payload.bin"
+        fp.write_bytes(data)
+        r_bytes = hash_file_v2(data, PLEN, hasher="cpu")
+        r_path = hash_file_v2(str(fp), PLEN, hasher="cpu")
+        r_dev = hash_file_v2(str(fp), PLEN, hasher="tpu")
+        assert r_bytes == r_path == r_dev
+
+    def test_private_comment_survive_roundtrip(self):
+        meta = build_v2(
+            [(("f",), b"z" * (2 * PLEN))], name="x", piece_length=PLEN,
+            hasher="cpu", private=True, comment="hi",
+            announce_list=[["http://a/1"], ["http://b/2"]],
+            web_seeds=["http://ws/"],
+        )
+        assert meta.info.private
+        enc = encode_metainfo_v2(
+            meta.info, meta.piece_layers, comment="hi",
+            announce_list=[["http://a/1"], ["http://b/2"]], web_seeds=["http://ws/"],
+        )
+        again = parse_metainfo_v2(enc)
+        assert again is not None and again.info.private
+        assert again.raw[b"comment"] == b"hi"
+        assert again.raw[b"announce-list"] == [[b"http://a/1"], [b"http://b/2"]]
+        assert again.raw[b"url-list"] == [b"http://ws/"]
+        # private is inside info → changes the infohash
+        pub = build_v2([(("f",), b"z" * (2 * PLEN))], name="x",
+                       piece_length=PLEN, hasher="cpu", private=False)
+        assert pub.info_hash_v2 != meta.info_hash_v2
+
+    def test_traversal_components_fail_closed(self):
+        meta = build_v2([(("ok",), b"d" * 100)], name="x", piece_length=PLEN, hasher="cpu")
+        import dataclasses
+
+        for evil in ("..", ".", "a/b", "a\\b", "nul\x00"):
+            bad_file = dataclasses.replace(meta.info.files[0], path=(evil,))
+            bad_info = dataclasses.replace(meta.info, files=(bad_file,))
+            enc = encode_metainfo_v2(bad_info, {})
+            assert parse_metainfo_v2(enc) is None, evil
+
+    def test_cpu_tpu_agree(self):
+        files = self._corpus()
+        cpu = build_v2(files, name="x", piece_length=PLEN, hasher="cpu")
+        tpu = build_v2(files, name="x", piece_length=PLEN, hasher="tpu")
+        assert cpu.info == tpu.info
+        assert cpu.piece_layers == tpu.piece_layers
+        assert cpu.info_hash_v2 == tpu.info_hash_v2
+
+
+class TestV2CodecValidation:
+    def test_rejects_non_pow2_piece_length(self):
+        files = [(("f",), b"x" * 100)]
+        with pytest.raises(ValueError):
+            build_v2(files, name="x", piece_length=3 * BLOCK, hasher="cpu")
+
+    def test_parse_rejects_malformed(self):
+        meta = build_v2([(("f",), b"x" * (2 * PLEN))], name="x", piece_length=PLEN, hasher="cpu")
+        good = encode_metainfo_v2(meta.info, meta.piece_layers)
+        assert parse_metainfo_v2(good) is not None
+        assert parse_metainfo_v2(b"garbage") is None
+        assert parse_metainfo_v2(b"de") is None
+        # strip the piece layers a multi-piece file needs → fail closed
+        assert parse_metainfo_v2(encode_metainfo_v2(meta.info, {})) is None
+
+    def test_parse_ignores_v1_torrents(self, ref_fixtures):
+        data = (ref_fixtures / "singlefile.torrent").read_bytes()
+        assert parse_metainfo_v2(data) is None

@@ -36,6 +36,7 @@ from torrent_tpu.storage.storage import Storage, StorageMethod, FsStorage, Memor
 from torrent_tpu.parallel.verify import verify_pieces
 from torrent_tpu.tools.make_torrent import make_torrent
 from torrent_tpu.codec.magnet import Magnet, parse_magnet
+from torrent_tpu.codec.metainfo_v2 import MetainfoV2, InfoDictV2, V2File, parse_metainfo_v2
 
 __all__ = [
     "bencode",
@@ -64,8 +65,16 @@ __all__ = [
     "make_torrent",
     "Magnet",
     "parse_magnet",
+    "MetainfoV2",
+    "InfoDictV2",
+    "V2File",
+    "parse_metainfo_v2",
     "__version__",
 ]
+
+# v2 (BEP 52) pipeline entry points — import-on-demand like the other
+# jax-touching subsystems: torrent_tpu.models.v2.{build_v2, verify_v2,
+# hash_file_v2}.
 
 # Heavier subsystems stay import-on-demand (no jax import at package
 # import time): torrent_tpu.models.verifier.TPUVerifier,

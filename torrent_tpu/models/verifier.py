@@ -6,7 +6,7 @@ One object owning the compiled hash plane for a given piece geometry:
   configs 1, 2, 4): disk → ``Storage.read_batch`` → pad → device →
   masked SHA1 chain → on-device digest compare → ``bool`` bitfield.
   Disk IO for batch *i+1* overlaps device compute for batch *i*.
-- ``hash_pieces`` / ``hash_padded`` — authoring-side digests (BASELINE
+- ``hash_pieces`` / ``hash_bytes`` — authoring-side digests (BASELINE
   config 3; replaces tools/make_torrent.ts:28-32's per-piece WebCrypto).
 - ``verify_batch`` — the raw jitted step, used by the HTTP bridge and by
   ``__graft_entry__`` for compile checks.

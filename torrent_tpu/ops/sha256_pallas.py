@@ -1,0 +1,133 @@
+"""Hand-tiled Pallas TPU SHA-256 kernel — the v2 fast path.
+
+Identical structure to ``ops/sha1_pallas.py`` (see that module for the
+layout rationale): pieces tiled ``TILE_SUB × 128`` per program, input
+pre-swizzled to ``[R, nblk, 16, sub, 128]``, grid ``(R, nblk/unroll)``
+with the chain axis "arbitrary" and the running 8-word state living in
+the revisited output block. Only the compression differs: 64 rounds of
+FIPS 180-4 SHA-256 with a 16-entry rolling schedule window.
+
+BEP 52 workloads hit this kernel with two shapes: 16 KiB leaf blocks
+(nblk=9 with padding block) and 64-byte merkle pair messages (nblk=2) —
+both short chains, so ``unroll`` folds to the chain length and every
+piece is one grid step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from torrent_tpu.ops.sha1_pallas import TILE, TILE_LANE, TILE_SUB, UNROLL, _swizzle
+from torrent_tpu.ops.sha256_jax import _IV256, _K256, _round, _schedule_step
+
+
+def _one_block256(state, w, kc_ref):
+    """One 64-round SHA-256 compression on vreg-shaped u32 tensors.
+
+    16-round prologue + ``fori_loop`` over the three schedule groups
+    (static window indices within a group; the 48 tail K constants come
+    from ``kc_ref`` in SMEM, row-indexed by the loop variable) — the same
+    shape as the jax backend's ``_compress256``, and for the same reason:
+    a fully unrolled 64-round graph trips XLA's algebraic-simplifier
+    circular-rewrite loop in interpret mode.
+    """
+    vars8 = state
+    for t in range(16):
+        vars8 = _round(vars8, w[t], np.uint32(_K256[t]))
+
+    def group(g, carry):
+        vars8, w = carry
+        w = list(w)
+        for i in range(16):
+            wt = _schedule_step(w, i)
+            w[i] = wt
+            vars8 = _round(vars8, wt, kc_ref[g, i])
+        return (vars8, tuple(w))
+
+    new, _ = jax.lax.fori_loop(0, 3, group, (vars8, tuple(w)))
+    return tuple(s + n for s, n in zip(state, new))
+
+
+def _sha256_kernel(words_ref, nblocks_ref, kc_ref, state_ref, *, unroll: int):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        for i, v in enumerate(_IV256):
+            state_ref[0, i] = jnp.full((TILE_SUB, TILE_LANE), v, dtype=jnp.uint32)
+
+    nblocks = nblocks_ref[0]
+
+    def body(j, state):
+        w = [words_ref[0, j, t] for t in range(16)]
+        new = _one_block256(state, w, kc_ref)
+        keep = k * unroll + j < nblocks
+        return tuple(jnp.where(keep, n, o) for n, o in zip(new, state))
+
+    state = tuple(state_ref[0, i] for i in range(8))
+    if unroll == 1:
+        state = body(0, state)
+    else:
+        state = jax.lax.fori_loop(0, unroll, body, state)
+    for i in range(8):
+        state_ref[0, i] = state[i]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _sha256_pallas_aligned(data_u8, nblocks, interpret):
+    b, padded = data_u8.shape
+    nblk = padded // 64
+    r = b // TILE
+    unroll = min(UNROLL, nblk)
+    nblk_pad = ((nblk + unroll - 1) // unroll) * unroll
+    if nblk_pad != nblk:
+        data_u8 = jnp.pad(data_u8, ((0, 0), (0, (nblk_pad - nblk) * 64)))
+        nblk = nblk_pad
+    words = _swizzle(data_u8, r, nblk)
+    nb = nblocks.astype(jnp.int32).reshape(r, TILE_SUB, TILE_LANE)
+    kc = jnp.asarray(np.array(_K256[16:], dtype=np.uint32).reshape(3, 16))
+    state = pl.pallas_call(
+        functools.partial(_sha256_kernel, unroll=unroll),
+        grid=(r, nblk // unroll),
+        in_specs=[
+            pl.BlockSpec(
+                (1, unroll, 16, TILE_SUB, TILE_LANE),
+                lambda i, k: (i, k, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, TILE_SUB, TILE_LANE), lambda i, k: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, 16), lambda i, k: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 8, TILE_SUB, TILE_LANE), lambda i, k: (i, 0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((r, 8, TILE_SUB, TILE_LANE), jnp.uint32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(words, nb, kc)
+    return jnp.transpose(state, (0, 2, 3, 1)).reshape(b, 8)
+
+
+def sha256_pieces_pallas(
+    data_u8: jax.Array, nblocks: jax.Array, interpret: bool | None = None
+) -> jax.Array:
+    """Batched SHA-256 via Pallas; pads the batch to a TILE multiple."""
+    from torrent_tpu.ops.sha1_pallas import _auto_interpret
+
+    if interpret is None:
+        interpret = _auto_interpret()
+    b = data_u8.shape[0]
+    bp = ((b + TILE - 1) // TILE) * TILE
+    if bp != b:
+        data_u8 = jnp.pad(data_u8, ((0, bp - b), (0, 0)))
+        nblocks = jnp.pad(nblocks, (0, bp - b))
+    out = _sha256_pallas_aligned(data_u8, nblocks, interpret)
+    return out[:b]

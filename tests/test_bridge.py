@@ -137,6 +137,54 @@ class TestStreamingBridge:
 
         run(go())
 
+    @pytest.mark.parametrize("hasher", ["cpu", "tpu"])
+    def test_stream_sha256_digests_and_verify(self, hasher):
+        """X-Hash-Algo: sha256 switches the stream routes to the v2 plane
+        (32-byte digests/expected frames)."""
+
+        async def go():
+            server = await _start(hasher)
+            try:
+                plen = 1024
+                pieces = _mk_pieces(300, plen)  # > batch_size → multi-flush
+                headers = {"X-Piece-Length": str(plen), "X-Hash-Algo": "sha256"}
+                status, resp = await _post_raw(
+                    server.port, "/v1/stream/digests", headers, _frames(pieces)
+                )
+                assert status == 200
+                digests = bdecode(resp)[b"digests"]
+                assert digests == [hashlib.sha256(p).digest() for p in pieces]
+
+                expected = list(digests)
+                expected[11] = b"\x00" * 32
+                status, resp = await _post_raw(
+                    server.port, "/v1/stream/verify", headers,
+                    _frames(pieces, expected), chunked=True,
+                )
+                assert status == 200
+                body = bdecode(resp)
+                assert body[b"valid"] == 299 and body[b"ok"][11] == 0
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+    def test_stream_rejects_bad_algo(self):
+        async def go():
+            server = await _start("cpu")
+            try:
+                status, _ = await _post_raw(
+                    server.port, "/v1/stream/digests",
+                    {"X-Piece-Length": "64", "X-Hash-Algo": "md5"}, _frames([b"a"])
+                )
+                assert status == 400
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
     def test_stream_rejects_oversized_frame(self):
         async def go():
             server = await _start("cpu")

@@ -93,6 +93,34 @@ class TestWireDecoderProperties:
         except MagnetError:
             pass
 
+    @given(st.binary(max_size=512))
+    @settings(max_examples=200)
+    def test_metainfo_v2_parse_total(self, blob):
+        from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+
+        parse_metainfo_v2(blob)  # None or parsed, never raises
+
+    @given(st.binary(max_size=128), st.binary(max_size=64))
+    @settings(max_examples=100, deadline=None)
+    def test_metainfo_v2_mutated_valid_total(self, junk, tail):
+        """Splice junk into a VALID v2 torrent — parse must stay total."""
+        from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+
+        good = _valid_v2_blob()
+        cut = len(junk) % max(1, len(good))
+        parse_metainfo_v2(good[:cut] + junk + good[cut:] + tail)
+
+
+@__import__("functools").lru_cache(maxsize=1)
+def _valid_v2_blob() -> bytes:
+    """One authored v2 torrent, built once (the merkle jit compile must
+    not land inside a hypothesis deadline)."""
+    from torrent_tpu.codec.metainfo_v2 import encode_metainfo_v2
+    from torrent_tpu.models.v2 import build_v2
+
+    meta = build_v2([(("f",), b"q" * 40_000)], name="z", piece_length=16384, hasher="cpu")
+    return encode_metainfo_v2(meta.info, meta.piece_layers)
+
 
 class TestNumericProperties:
     @given(st.integers(min_value=0, max_value=2**63 - 1), st.integers(min_value=1, max_value=8))

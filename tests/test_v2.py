@@ -145,6 +145,8 @@ class TestHashFileV2:
             PLEN,  # exactly one piece
             PLEN + 1,  # multi-piece, ragged
             3 * PLEN + BLOCK // 2,  # 4 pieces, ragged tail
+            5 * PLEN,  # non-pow2 piece count → zero-SUBTREE-root padding
+            5 * PLEN + 1,  # same, ragged tail
             8 * PLEN,  # pow2 pieces, aligned
         ],
     )

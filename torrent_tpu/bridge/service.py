@@ -26,6 +26,10 @@ Bodies may be Content-Length or chunked transfer-encoding (what a Deno
   POST /v1/stream/verify    frames: u32be(len) | piece | 20B expected
                             → {ok: bytes, valid: int}
 
+An ``X-Hash-Algo: sha256`` header switches the stream routes to the v2
+hash plane (BEP 52 leaf/merkle hashing feeds on 32-byte digests); the
+default is sha1. Digest/expected width follows the algorithm.
+
 Hand-rolled asyncio HTTP — no web framework needed for five routes.
 """
 
@@ -162,20 +166,26 @@ class BridgeServer:
     # per slot).
     STAGING_BUDGET = 128 << 20
 
+    def _bucket_and_batch(self, plen: int) -> tuple[int, int]:
+        """Pow-2 piece-length bucket + the batch the staging budget affords."""
+        from torrent_tpu.ops.padding import padded_len_for
+
+        bucket = 1 << (plen - 1).bit_length() if plen > 1 else 1
+        batch = max(1, min(256, self.STAGING_BUDGET // padded_len_for(bucket)))
+        return bucket, batch
+
     def _stream_verifier(self, plen: int):
         """Verifier for the given piece length — pow-2 bucketed so a
         handful of executables serve any geometry (shared by the buffered
         and streaming routes)."""
         from torrent_tpu.models.verifier import TPUVerifier
-        from torrent_tpu.ops.padding import padded_len_for
 
-        bucket = 1 << (plen - 1).bit_length() if plen > 1 else 1
+        bucket, batch = self._bucket_and_batch(plen)
         # callers run on both the event loop and to_thread workers; the
         # lock keeps a bucket from being built (and compiled) twice
         with self._verifiers_lock:
             verifier = self._verifiers.get(bucket)
             if verifier is None:
-                batch = max(1, min(256, self.STAGING_BUDGET // padded_len_for(bucket)))
                 verifier = TPUVerifier(piece_length=bucket, batch_size=batch)
                 self._verifiers[bucket] = verifier
         return verifier
@@ -201,11 +211,14 @@ class BridgeServer:
             plen = 0
         if plen <= 0 or plen > MAX_PIECE:
             return await self._reply(writer, 400, b"X-Piece-Length required (1..16MiB)")
+        algo = headers.get(b"x-hash-algo", b"sha1").decode("latin-1").lower()
+        if algo not in ("sha1", "sha256"):
+            return await self._reply(writer, 400, b"X-Hash-Algo must be sha1 or sha256")
 
         if self.hasher == "cpu":
-            return await self._stream_cpu(writer, mode, plen, body)
+            return await self._stream_cpu(writer, mode, plen, body, algo)
         async with self._stream_gate:
-            await self._stream_tpu(writer, mode, plen, body)
+            await self._stream_tpu(writer, mode, plen, body, algo)
 
     @staticmethod
     async def _read_idle_bounded(body: _BodyReader, n: int) -> bytes:
@@ -223,7 +236,9 @@ class BridgeServer:
             got += len(chunk)
         return b"".join(parts)
 
-    async def _read_frame(self, body: _BodyReader, plen: int, with_expected: bool):
+    async def _read_frame(
+        self, body: _BodyReader, plen: int, with_expected: bool, digest_len: int = 20
+    ):
         """One ``len | piece [| expected]`` frame, or None at clean EOF.
 
         Reads are idle-bounded so a silent client can't pin staging
@@ -235,14 +250,51 @@ class BridgeServer:
         if ln > plen:
             raise ValueError("frame exceeds X-Piece-Length")
         data = await self._read_idle_bounded(body, ln)
-        expected = await self._read_idle_bounded(body, 20) if with_expected else None
+        expected = (
+            await self._read_idle_bounded(body, digest_len) if with_expected else None
+        )
         return data, expected
 
-    async def _stream_tpu(self, writer, mode: str, plen: int, body: _BodyReader):
+    def _stream_plane256(self, plen: int):
+        """Minimal SHA-256 batch plane for the stream routes (v2 digests
+        use 32-byte words; the sha1 TPUVerifier's on-device compare and
+        flat-upload machinery don't apply — digest words come back host-
+        side and compare there, [B, 8] u32 per batch is tiny)."""
+        from torrent_tpu.ops.sha256_jax import make_sha256_fn
+
+        bucket, batch = self._bucket_and_batch(plen)
+        key = ("sha256", bucket)
+        with self._verifiers_lock:
+            plane = self._verifiers.get(key)
+            if plane is None:
+                import jax
+
+                # always the scan backend: sha256_pieces_pallas pads every
+                # launch to TILE=1024 rows, which would blow the staging
+                # budget this batch size exists to enforce (a 16 MiB bucket
+                # would balloon to ~17 GB on device)
+                fn = make_sha256_fn("jax")
+
+                class _Plane:
+                    piece_length = bucket
+                    batch_size = batch
+
+                    @staticmethod
+                    def digest_words(padded, nblocks):
+                        import numpy as np
+
+                        return np.asarray(fn(jax.numpy.asarray(padded), jax.numpy.asarray(nblocks)))
+
+                plane = _Plane()
+                self._verifiers[key] = plane
+        return plane
+
+    async def _stream_tpu(self, writer, mode: str, plen: int, body: _BodyReader, algo: str):
         import concurrent.futures
 
         import numpy as np
 
+        from torrent_tpu.models.merkle import digests_to_words32, words32_to_digests
         from torrent_tpu.ops.padding import (
             alloc_padded,
             digests_to_words,
@@ -253,7 +305,14 @@ class BridgeServer:
         # verifier construction (JAX init, jit setup) and the ~128 MiB slot
         # memsets run off the event loop so health probes and other
         # connections stay live through them
-        verifier = await asyncio.to_thread(self._stream_verifier, plen)
+        if algo == "sha256":
+            verifier = await asyncio.to_thread(self._stream_plane256, plen)
+            dlen, words_dim = 32, 8
+            to_words = lambda d: digests_to_words32([d])[0]
+        else:
+            verifier = await asyncio.to_thread(self._stream_verifier, plen)
+            dlen, words_dim = 20, 5
+            to_words = lambda d: digests_to_words([d])[0]
         b = verifier.batch_size
         slots: list[dict] = []  # allocated lazily on the first frame
 
@@ -263,7 +322,7 @@ class BridgeServer:
                 "padded": padded,
                 "view": view,
                 "lengths": np.zeros(b, dtype=np.int64),
-                "expected": np.zeros((b, 5), dtype=np.uint32),
+                "expected": np.zeros((b, words_dim), dtype=np.uint32),
             }
 
         loop = asyncio.get_running_loop()
@@ -275,6 +334,12 @@ class BridgeServer:
         def flush(slot, k):
             nblocks = pad_in_place(slot["padded"], slot["lengths"])
             nblocks[k:] = 0
+            if algo == "sha256":
+                words = verifier.digest_words(slot["padded"], nblocks)
+                if mode == "digests":
+                    return words32_to_digests(words[:k])
+                ok = (words[:k] == slot["expected"][:k]).all(axis=1)
+                return bytes(ok.astype(np.uint8))
             if mode == "digests":
                 words = verifier.digest_batch(slot["padded"], nblocks)
                 return words_to_digests(words[:k])
@@ -290,7 +355,7 @@ class BridgeServer:
         try:
             slot_idx, k, n_frames = 0, 0, 0
             while True:
-                frame = await self._read_frame(body, plen, mode == "verify")
+                frame = await self._read_frame(body, plen, mode == "verify", digest_len=dlen)
                 if frame is None:
                     break
                 n_frames += 1
@@ -305,7 +370,7 @@ class BridgeServer:
                 slot["view"][k, :ln] = np.frombuffer(data, dtype=np.uint8)
                 slot["lengths"][k] = ln
                 if exp is not None:
-                    slot["expected"][k] = digests_to_words([exp])[0]
+                    slot["expected"][k] = to_words(exp)
                 k += 1
                 if k == b:
                     pending.append(loop.run_in_executor(flusher, flush, slot, k))
@@ -326,7 +391,7 @@ class BridgeServer:
         finally:
             flusher.shutdown(wait=False)
 
-    async def _stream_cpu(self, writer, mode: str, plen: int, body: _BodyReader):
+    async def _stream_cpu(self, writer, mode: str, plen: int, body: _BodyReader, algo: str = "sha1"):
         """hashlib fallback for ``hasher='cpu'``.
 
         Frames are hashed off the event loop in batches (≤64 frames or
@@ -342,10 +407,12 @@ class BridgeServer:
         batch_bytes = 0
         n_frames = 0
 
+        hfn = hashlib.sha256 if algo == "sha256" else hashlib.sha1
+
         async def do_flush():
             nonlocal batch, batch_exp, batch_bytes
             ds = await asyncio.to_thread(
-                lambda ps: [hashlib.sha1(p).digest() for p in ps], batch
+                lambda ps: [hfn(p).digest() for p in ps], batch
             )
             if mode == "digests":
                 digests.extend(ds)
@@ -355,7 +422,10 @@ class BridgeServer:
 
         try:
             while True:
-                frame = await self._read_frame(body, plen, mode == "verify")
+                frame = await self._read_frame(
+                    body, plen, mode == "verify",
+                    digest_len=32 if algo == "sha256" else 20,
+                )
                 if frame is None:
                     break
                 n_frames += 1

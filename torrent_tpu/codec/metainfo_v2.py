@@ -65,6 +65,18 @@ class MetainfoV2:
         return self.info_hash_v2[:20]
 
 
+def valid_path_component(name: str) -> bool:
+    """A BEP 52 path component: a plain UTF-8 name that cannot escape a
+    target directory when joined."""
+    if name in ("", ".", "..") or any(c in name for c in ("/", "\\", "\x00")):
+        return False
+    try:
+        name.encode("utf-8")
+    except UnicodeEncodeError:  # surrogateescape names from os.walk
+        return False
+    return True
+
+
 def _walk_file_tree(node: dict, prefix: tuple[str, ...], out: list[V2File]) -> bool:
     """Depth-first over the nested ``file tree`` dict. Returns False on a
     malformed node (the whole parse then fails closed)."""
@@ -74,10 +86,9 @@ def _walk_file_tree(node: dict, prefix: tuple[str, ...], out: list[V2File]) -> b
         if key == b"":
             return False  # a file marker may not appear amid siblings here
         name = key.decode("utf-8", "replace")
-        # fail closed on hostile path components: BEP 52 components are
-        # plain names; anything that could escape a target directory when
-        # joined (traversal, separators, NULs) rejects the whole torrent
-        if name in (".", "..") or any(c in name for c in ("/", "\\", "\x00")):
+        # fail closed on hostile path components: anything that could
+        # escape a target directory when joined rejects the whole torrent
+        if not valid_path_component(name):
             return False
         marker = child.get(b"")
         if marker is not None:

@@ -83,13 +83,13 @@ def zero_chain(levels: int) -> tuple[bytes, ...]:
 
 def digests_to_words32(digests) -> np.ndarray:
     """32-byte SHA-256 digests → ``u32[N, 8]`` big-endian words."""
-    arr = np.frombuffer(b"".join(digests), dtype=">u4").reshape(-1, 8)
-    return arr.astype(np.uint32)
+    from torrent_tpu.ops.padding import digests_to_words
+
+    return digests_to_words(digests, words=8)
 
 
-def words32_to_digests(words: np.ndarray) -> list[bytes]:
-    be = np.asarray(words, dtype=np.uint32).astype(">u4")
-    return [be[i].tobytes() for i in range(be.shape[0])]
+# width follows the array; the shared converter handles both planes
+from torrent_tpu.ops.padding import words_to_digests as words32_to_digests  # noqa: E402
 
 
 def pad_leaves(leaf_words: np.ndarray, target: int) -> np.ndarray:

@@ -10,8 +10,9 @@ Authoring and resume-recheck for BEP 52 torrents on the TPU hash plane:
 
 Leaves are uniform 16 KiB blocks → one padded batch through the SHA-256
 plane; every merkle level above them is a single ``sha256_pairs`` call
-(``models/merkle.py``). ``hasher='cpu'`` uses hashlib end-to-end — also
-the differential oracle for the device path in tests.
+(``models/merkle.py``). ``hasher='cpu'`` hashes leaves with hashlib (the
+dominant cost — the merkle reduction above them always runs on the
+device plane); the independent spec oracle lives in tests/test_v2.py.
 """
 
 from __future__ import annotations
@@ -159,6 +160,15 @@ def build_v2(
     """
     if piece_length < BLOCK or piece_length & (piece_length - 1):
         raise ValueError("piece_length must be a power of two >= 16 KiB")
+    from torrent_tpu.codec.metainfo_v2 import valid_path_component
+
+    for path, _ in files:
+        for part in path:
+            if not valid_path_component(part):
+                raise ValueError(
+                    f"path component {part!r} cannot appear in a v2 file tree "
+                    "(separator/traversal/non-UTF-8 names are not encodable)"
+                )
     v2files: list[V2File] = []
     layers: dict[bytes, tuple[bytes, ...]] = {}
     for path, source in sorted(files, key=lambda e: e[0]):

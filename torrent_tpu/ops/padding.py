@@ -87,17 +87,18 @@ def pad_pieces(pieces: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
     return padded, nblocks
 
 
-def digests_to_words(digests: list[bytes] | tuple[bytes, ...]) -> np.ndarray:
-    """20-byte SHA1 digests → ``uint32[B, 5]`` big-endian words.
+def digests_to_words(digests: list[bytes] | tuple[bytes, ...], words: int = 5) -> np.ndarray:
+    """Fixed-width digests → ``uint32[B, words]`` big-endian words.
 
-    The expected-hash side of on-device comparison: ``info.pieces``
-    uploaded once per torrent.
+    ``words=5`` is SHA1 (20-byte digests), ``words=8`` SHA-256. The
+    expected-hash side of on-device comparison: ``info.pieces`` uploaded
+    once per torrent.
     """
-    arr = np.frombuffer(b"".join(digests), dtype=">u4").reshape(len(digests), 5)
+    arr = np.frombuffer(b"".join(digests), dtype=">u4").reshape(len(digests), words)
     return arr.astype(np.uint32)
 
 
 def words_to_digests(words: np.ndarray) -> list[bytes]:
-    """``uint32[B, 5]`` state words → 20-byte digests (authoring path)."""
+    """``uint32[B, W]`` state words → digests (width follows the array)."""
     be = np.asarray(words, dtype=np.uint32).astype(">u4")
     return [be[i].tobytes() for i in range(be.shape[0])]

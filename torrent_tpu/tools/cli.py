@@ -111,12 +111,16 @@ def _make_v2(args) -> int:
                 rel = os.path.relpath(fp, path)
                 files.append((tuple(rel.split(os.sep)), fp))
     plen = args.piece_length or (1 << 20)
-    meta = build_v2(
-        files, name=name, piece_length=plen, hasher=args.hasher,
-        announce=args.tracker, private=args.private, comment=args.comment,
-        announce_list=[[t] for t in args.also_tracker] or None,
-        web_seeds=args.web_seed or None,
-    )
+    try:
+        meta = build_v2(
+            files, name=name, piece_length=plen, hasher=args.hasher,
+            announce=args.tracker, private=args.private, comment=args.comment,
+            announce_list=[[t] for t in args.also_tracker] or None,
+            web_seeds=args.web_seed or None,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     data = encode_metainfo_v2(
         meta.info, meta.piece_layers, announce=args.tracker,
         comment=args.comment,

@@ -170,6 +170,26 @@ class TestStreamingBridge:
 
         run(go())
 
+    def test_buffered_routes_reject_sha256(self):
+        """The bencode routes are sha1-only — a sha256 request must fail
+        closed, never silently return v1 digests."""
+
+        async def go():
+            server = await _start("cpu")
+            try:
+                from torrent_tpu.codec.bencode import bencode
+
+                status, _ = await _post_raw(
+                    server.port, "/v1/digests", {"X-Hash-Algo": "sha256"},
+                    bencode({b"pieces": [b"x"]}),
+                )
+                assert status == 400
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
     def test_stream_rejects_bad_algo(self):
         async def go():
             server = await _start("cpu")

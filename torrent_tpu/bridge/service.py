@@ -478,14 +478,21 @@ class BridgeServer:
             if content_length > MAX_BODY:
                 return await self._reply(writer, 413, b"body too large")
             body = await reader.readexactly(content_length) if content_length else b""
-            await self._route(writer, method, target, body)
+            await self._route(writer, method, target, body, headers)
         except (asyncio.TimeoutError, asyncio.IncompleteReadError, ConnectionError, OSError):
             writer.close()
         except Exception as e:  # one bad request must not kill the sidecar
             log.error("bridge error: %s", e)
             await self._reply(writer, 500, str(e).encode())
 
-    async def _route(self, writer, method: str, target: str, body: bytes):
+    async def _route(self, writer, method: str, target: str, body: bytes, headers=None):
+        # the buffered routes are sha1-only; a sha256 request must fail
+        # closed, not silently return v1 digests with a 200
+        algo = (headers or {}).get(b"x-hash-algo", b"sha1").decode("latin-1").lower()
+        if algo != "sha1":
+            return await self._reply(
+                writer, 400, b"buffered routes are sha1-only; use /v1/stream/* for sha256"
+            )
         if method == "GET" and target == "/v1/info":
             import jax
 

@@ -86,7 +86,7 @@ class TPUVerifier:
                     mesh=self.mesh,
                     in_specs=(spec, spec),
                     out_specs=spec,
-                    check_rep=False,
+                    check_vma=False,
                 )
             self.batch_size = round_up_to_multiple(self.batch_size, TILE * self.mesh.size)
         shard = batch_sharding(self.mesh)

@@ -46,6 +46,8 @@ mode prints one JSON line):
   (BENCH_TOTAL_MB=10240 for the documented 10 GiB scale)
 - ``bulk``       — config 5 at single-host scale: N torrents validated
   concurrently through one shared verifier (BENCH_BULK_N, default 8)
+- ``v2``         — bonus BEP 52 metric: SHA-256 leaf hashing + merkle
+  piece roots vs a full hashlib leaf+merkle baseline
 """
 
 from __future__ import annotations
@@ -76,6 +78,8 @@ def _metric_name(config: str, plen: int, total_mb: int) -> str:
     if config == "bulk":
         n = int(os.environ.get("BENCH_BULK_N", "8"))
         return f"sha1_bulk_{n}x{total_mb}MB_pieces_per_sec"
+    if config == "v2":
+        return f"sha256_v2_author_{kib}KiB_pieces_per_sec"
     return f"sha1_recheck_{kib}KiB_pieces_per_sec"
 
 
@@ -222,6 +226,84 @@ def _relay_via_child() -> None:
 
 
 # ------------------------------------------------------------- the bench
+
+
+def _execute_v2(total_mb: int, plen: int):
+    """BEP 52 authoring plane: SHA-256 leaves + merkle piece roots.
+
+    Baseline = hashlib leaves + hashlib merkle on the same payload; the
+    device side runs the batched sha256 plane + sha256_pairs levels.
+    Both sides measured over the full population.
+    """
+    import jax
+
+    from torrent_tpu.models.v2 import LEAF_BATCH, _leaf_words_device
+    from torrent_tpu.models.merkle import piece_roots_from_leaves, words32_to_digests
+
+    BLOCK = 16384
+    n_pieces = total_mb * (1 << 20) // plen
+    lpp = plen // BLOCK
+    vp = _VirtualPayload(n_pieces, plen)
+
+    # CPU baseline: hashlib leaves + merkle, full population
+    t0 = time.perf_counter()
+    cpu_roots = []
+    for i in range(n_pieces):
+        data = vp.piece(i)
+        level = [
+            hashlib.sha256(data[j * BLOCK : (j + 1) * BLOCK]).digest() for j in range(lpp)
+        ]
+        while len(level) > 1:
+            level = [
+                hashlib.sha256(level[j] + level[j + 1]).digest()
+                for j in range(0, len(level), 2)
+            ]
+        cpu_roots.append(level[0])
+    cpu_secs = time.perf_counter() - t0
+    cpu_pps = n_pieces / cpu_secs
+
+    # device plane: stream the same payload through the batched plane in
+    # LEAF_BATCH-block chunks (each chunk is block-aligned, so leaves
+    # across chunk boundaries line up with piece geometry)
+    total = n_pieces * plen
+    chunk_bytes = LEAF_BATCH * BLOCK
+
+    def chunks():
+        off = 0
+        while off < total:
+            n = min(chunk_bytes, total - off)
+            yield vp.read(off, n)
+            off += n
+
+    # warm every executable the timed loop will hit: the full-chunk
+    # bucket and (if the total isn't chunk-aligned) the tail bucket
+    _ = _leaf_words_device(b"\0" * chunk_bytes, "auto")
+    rem = total % chunk_bytes
+    if rem:
+        _ = _leaf_words_device(b"\0" * rem, "auto")
+    t0 = time.perf_counter()
+    leaf_words = np.concatenate(
+        [_leaf_words_device(c, "auto") for c in chunks()], axis=0
+    )
+    roots = piece_roots_from_leaves(leaf_words, lpp)
+    dev_secs = time.perf_counter() - t0
+    got = words32_to_digests(roots)
+    assert got == cpu_roots, "v2 device plane diverged from hashlib"
+    dev_pps = n_pieces / dev_secs
+    platform = jax.devices()[0].platform
+    print(
+        f"# detail: v2 plane {dev_pps:.0f} p/s ({dev_pps * plen / 2**30:.2f} GiB/s) "
+        f"cpu {cpu_pps:.0f} p/s ({cpu_pps * plen / 2**30:.2f} GiB/s)",
+        file=sys.stderr,
+    )
+    return {
+        "metric": _metric_name("v2", plen, total_mb),
+        "value": round(dev_pps, 1),
+        "unit": "pieces/s",
+        "vs_baseline": round(dev_pps / cpu_pps, 2),
+        "platform": platform,
+        "backend": "jax",
+    }
 
 
 def _prepare(total_mb: int, config: str, plen: int):
@@ -473,6 +555,10 @@ def main() -> None:
     # bench can run where the operator points it.
     if plat:
         jax.config.update("jax_platforms", plat)
+
+    if config == "v2":
+        print(json.dumps(_execute_v2(total_mb, plen)))
+        return
 
     backend = os.environ.get("BENCH_BACKEND", "")
     backend_requested = bool(backend)

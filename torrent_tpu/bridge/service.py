@@ -486,13 +486,6 @@ class BridgeServer:
             await self._reply(writer, 500, str(e).encode())
 
     async def _route(self, writer, method: str, target: str, body: bytes, headers=None):
-        # the buffered routes are sha1-only; a sha256 request must fail
-        # closed, not silently return v1 digests with a 200
-        algo = (headers or {}).get(b"x-hash-algo", b"sha1").decode("latin-1").lower()
-        if algo != "sha1":
-            return await self._reply(
-                writer, 400, b"buffered routes are sha1-only; use /v1/stream/* for sha256"
-            )
         if method == "GET" and target == "/v1/info":
             import jax
 
@@ -506,6 +499,14 @@ class BridgeServer:
             return await self._reply(writer, 200, payload)
         if method != "POST":
             return await self._reply(writer, 405, b"method not allowed")
+        # the buffered hash routes are sha1-only; a sha256 request must
+        # fail closed, not silently return v1 digests with a 200 (the
+        # algorithm-agnostic /v1/info above is exempt)
+        algo = (headers or {}).get(b"x-hash-algo", b"sha1").decode("latin-1").lower()
+        if algo != "sha1":
+            return await self._reply(
+                writer, 400, b"buffered routes are sha1-only; use /v1/stream/* for sha256"
+            )
         try:
             req = bdecode(body)
         except BencodeError as e:

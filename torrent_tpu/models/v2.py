@@ -75,10 +75,17 @@ def _leaf_words_device(source, backend: str) -> np.ndarray:
     """
     import jax
 
-    fn = make_sha256_fn(backend)
     total = source_len(source)
     n = max(1, -(-total // BLOCK))
     b = min(LEAF_BATCH, max(16, 1 << (n - 1).bit_length()))
+    if backend == "auto":
+        # the pallas kernel pads launches to TILE rows and only compiles
+        # for real (non-interpret) on TPU-kind devices — anywhere else
+        # (CPU, GPU) the scan backend wins
+        from torrent_tpu.ops.sha1_pallas import TILE, _auto_interpret
+
+        backend = "pallas" if b % TILE == 0 and not _auto_interpret() else "jax"
+    fn = make_sha256_fn(backend)
     out = np.zeros((n, 8), dtype=np.uint32)
     padded, view = alloc_padded(b, BLOCK)
     start = 0
@@ -132,8 +139,7 @@ def hash_file_v2(
     if hasher == "cpu":
         leaves = _leaf_words_cpu(source)
     else:
-        backend = "jax"  # scan backend; pallas for leaves needs TILE-size batches
-        leaves = _leaf_words_device(source, backend)
+        leaves = _leaf_words_device(source, "auto")
     if total <= piece_length:
         return small_file_root(leaves), ()
     lpp = piece_length // BLOCK
@@ -218,7 +224,7 @@ def verify_v2(
         if hasher == "cpu":
             leaves = _leaf_words_cpu(source)
         else:
-            leaves = _leaf_words_device(source, "jax")
+            leaves = _leaf_words_device(source, "auto")
         if f.length <= plen:
             ok[0] = small_file_root(leaves) == f.pieces_root
             results[f.path] = ok

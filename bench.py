@@ -241,7 +241,14 @@ def _execute_v2(total_mb: int, plen: int):
     from torrent_tpu.models.merkle import piece_roots_from_leaves, words32_to_digests
 
     BLOCK = 16384
+    if plen < BLOCK or plen % BLOCK or (plen // BLOCK) & (plen // BLOCK - 1):
+        raise SystemExit(
+            f"BENCH_CONFIG=v2 needs a piece length that is a power-of-two "
+            f"multiple of 16 KiB (got {plen})"
+        )
     n_pieces = total_mb * (1 << 20) // plen
+    if n_pieces < 1:
+        raise SystemExit("BENCH_CONFIG=v2 needs BENCH_TOTAL_MB >= one piece")
     lpp = plen // BLOCK
     vp = _VirtualPayload(n_pieces, plen)
 
@@ -276,11 +283,15 @@ def _execute_v2(total_mb: int, plen: int):
             off += n
 
     # warm every executable the timed loop will hit: the full-chunk
-    # bucket and (if the total isn't chunk-aligned) the tail bucket
+    # bucket, (if the total isn't chunk-aligned) the tail bucket, and the
+    # merkle pair executables for every level shape of this geometry
     _ = _leaf_words_device(b"\0" * chunk_bytes, "auto")
     rem = total % chunk_bytes
     if rem:
         _ = _leaf_words_device(b"\0" * rem, "auto")
+    _ = piece_roots_from_leaves(
+        np.zeros((n_pieces * lpp, 8), dtype=np.uint32), lpp
+    )
     t0 = time.perf_counter()
     leaf_words = np.concatenate(
         [_leaf_words_device(c, "auto") for c in chunks()], axis=0

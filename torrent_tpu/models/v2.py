@@ -52,11 +52,33 @@ def source_len(source) -> int:
 
 
 def _iter_source(source, chunk_bytes: int):
-    """Yield ``chunk_bytes``-sized slices of the source (last may be short)."""
+    """Yield ``chunk_bytes``-sized slices of the source (last may be short).
+
+    Path sources go through the native C++ pread pool when it's built
+    (striped parallel reads per chunk — the same engine behind
+    ``Storage.read_batch``); plain buffered reads otherwise.
+    """
     if isinstance(source, (bytes, bytearray, memoryview)):
         mv = memoryview(source)
         for off in range(0, len(mv), chunk_bytes):
             yield bytes(mv[off : off + chunk_bytes])
+        return
+    from torrent_tpu.native.io_engine import get_engine
+
+    engine = get_engine()
+    total = source_len(source)
+    if engine is not None and total > 0:
+        path = str(source)
+        buf = np.empty(chunk_bytes, dtype=np.uint8)
+        stripes = 4
+        for off in range(0, total, chunk_bytes):
+            n = min(chunk_bytes, total - off)
+            step = -(-n // stripes)
+            segs = [
+                (0, off + s, s, min(step, n - s)) for s in range(0, n, step)
+            ]
+            engine.read_segments([path], segs, buf[:n])
+            yield buf[:n].tobytes()
         return
     with open(source, "rb") as f:
         while True:
@@ -81,10 +103,13 @@ def _leaf_words_device(source, backend: str) -> np.ndarray:
     if backend == "auto":
         # the pallas kernel pads launches to TILE rows and only compiles
         # for real (non-interpret) on TPU-kind devices — anywhere else
-        # (CPU, GPU) the scan backend wins
-        from torrent_tpu.ops.sha1_pallas import TILE, _auto_interpret
+        # (CPU, GPU, or a jax without pallas at all) the scan backend wins
+        try:
+            from torrent_tpu.ops.sha1_pallas import TILE, _auto_interpret
 
-        backend = "pallas" if b % TILE == 0 and not _auto_interpret() else "jax"
+            backend = "pallas" if b % TILE == 0 and not _auto_interpret() else "jax"
+        except ImportError:
+            backend = "jax"
     fn = make_sha256_fn(backend)
     out = np.zeros((n, 8), dtype=np.uint32)
     padded, view = alloc_padded(b, BLOCK)

@@ -32,7 +32,7 @@ up to BENCH_TPU_WAIT seconds, and on timeout emits an explicit
 exit cleanly on its own. An explicit BENCH_PLATFORM (e.g. ``cpu``) runs
 inline with no child.
 
-Env knobs: BENCH_TOTAL_MB (default 1024), BENCH_BATCH (default 1024),
+Env knobs: BENCH_TOTAL_MB (default 1024), BENCH_BATCH (default 4096),
 BENCH_BACKEND (jax|pallas, default best available), BENCH_PLATFORM,
 BENCH_TPU_WAIT (default 1500 s), BENCH_PIECE_KB (default 256).
 
@@ -63,7 +63,10 @@ import numpy as np
 
 def _env_geometry():
     total_mb = int(os.environ.get("BENCH_TOTAL_MB", "1024"))
-    batch = int(os.environ.get("BENCH_BATCH", "1024"))
+    # 4096 measured best on the real chip: pallas throughput scales with
+    # batch (13.2 GiB/s at 4096 vs 3.3 at 1024 — per-dispatch latency
+    # amortizes) and the staging/device footprint stays ~1 GiB per batch
+    batch = int(os.environ.get("BENCH_BATCH", "4096"))
     config = os.environ.get("BENCH_CONFIG", "headline")
     plen = int(os.environ.get("BENCH_PIECE_KB", "256")) * 1024
     return total_mb, batch, config, plen

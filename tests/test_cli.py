@@ -89,6 +89,24 @@ class TestCli:
         assert rc == 0
         assert "pieces valid (v2)" in capsys.readouterr().out
 
+    def test_make_hybrid_roundtrip(self, payload_dir, tmp_path, capsys):
+        """--hybrid authors one blob both parsers read; verify routes via
+        the v2 path (pad files never exist on disk)."""
+        out = str(tmp_path / "hyb.torrent")
+        rc = main(["make", str(payload_dir), "http://127.0.0.1:1/announce", "-o", out,
+                   "--piece-length", "16384", "--hybrid"])
+        assert rc == 0
+        assert "hybrid v1+v2" in capsys.readouterr().out
+
+        from torrent_tpu.codec.metainfo import parse_metainfo
+
+        blob = open(out, "rb").read()
+        assert parse_metainfo(blob) is not None  # v1 clients read it too
+
+        rc = main(["verify", out, str(payload_dir.parent), "--hasher", "cpu"])
+        assert rc == 0
+        assert "(v2)" in capsys.readouterr().out
+
     def test_info_rejects_garbage(self, tmp_path, capsys):
         bad = tmp_path / "bad.torrent"
         bad.write_bytes(b"this is not bencode")

@@ -283,6 +283,91 @@ class TestV2RoundTrip:
         assert cpu.info_hash_v2 == tpu.info_hash_v2
 
 
+class TestHybrid:
+    """BEP 52 upgrade path: one blob, two generations of clients."""
+
+    def _corpus(self):
+        rng = np.random.default_rng(19)
+        return [
+            (("a.bin",), rng.bytes(2 * PLEN + 100)),  # padded: not last
+            (("b.bin",), rng.bytes(PLEN // 2)),  # padded
+            (("c.bin",), rng.bytes(PLEN + 7)),  # last: short tail, no pad
+        ]
+
+    @pytest.mark.parametrize("hasher", ["cpu", "tpu"])
+    def test_both_views_parse_and_v1_pieces_match_padded_stream(self, hasher):
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.models.v2 import build_hybrid
+
+        files = self._corpus()
+        blob, v2 = build_hybrid(files, name="hyb", piece_length=PLEN, hasher=hasher,
+                                announce="http://t/a")
+
+        v1 = parse_metainfo(blob)
+        assert v1 is not None and v2 is not None
+        assert v1.info_hash != v2.info_hash_v2[:20]  # different hash families
+
+        # v1 view: every file except the last starts on a piece boundary
+        # (pad files interleaved), and the piece hashes equal sha1 over
+        # the padded concatenated stream
+        stream = bytearray()
+        for i, (_, data) in enumerate(files):
+            stream += data
+            if i < len(files) - 1:
+                stream += b"\x00" * ((-len(data)) % PLEN)
+        exp = [
+            hashlib.sha1(bytes(stream[o : o + PLEN])).digest()
+            for o in range(0, len(stream), PLEN)
+        ]
+        assert list(v1.info.pieces) == exp
+        assert v1.info.length == len(stream)
+        pads = [f for f in v1.info.files if f.path[0] == ".pad"]
+        assert len(pads) == 2  # a.bin and b.bin both need padding
+
+        # v2 view matches a pure-v2 authoring of the same corpus
+        pure = build_v2(files, name="hyb", piece_length=PLEN, hasher=hasher)
+        assert v2.info == pure.info and v2.piece_layers == pure.piece_layers
+
+    def test_single_file_hybrid_has_no_pads(self):
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.models.v2 import build_hybrid
+
+        rng = np.random.default_rng(23)
+        data = rng.bytes(3 * PLEN + 5)
+        blob, v2 = build_hybrid([(("hyb",), data)], name="hyb", piece_length=PLEN,
+                                hasher="cpu", announce="http://t/a")
+        v1 = parse_metainfo(blob)
+        assert v1 is not None and v1.info.files is None  # single-file form
+        assert v1.info.length == len(data)
+        exp = [
+            hashlib.sha1(data[o : o + PLEN]).digest() for o in range(0, len(data), PLEN)
+        ]
+        assert list(v1.info.pieces) == exp
+
+    def test_hybrid_verifies_via_v2_path_on_disk(self, tmp_path):
+        """Round-trip through real files: author from path sources (one
+        streaming pass per file feeds both hash families), then verify
+        the on-disk payload — no pad files ever materialized."""
+        from torrent_tpu.models.v2 import build_hybrid, verify_v2
+
+        paths = {}
+        for p, data in self._corpus():
+            fp = tmp_path / "/".join(p)
+            fp.parent.mkdir(parents=True, exist_ok=True)
+            fp.write_bytes(data)
+            paths[p] = str(fp)
+        blob, v2 = build_hybrid(
+            [(p, fp) for p, fp in paths.items()], name="hyb",
+            piece_length=PLEN, hasher="cpu", announce="http://t/a",
+        )
+        # identical output to authoring from resident bytes
+        blob_mem, _ = build_hybrid(self._corpus(), name="hyb", piece_length=PLEN,
+                                   hasher="cpu", announce="http://t/a")
+        assert blob == blob_mem
+        res = verify_v2(lambda p: paths.get(p), v2, hasher="cpu")
+        assert all(ok.all() for ok in res.values())
+
+
 class TestV2CodecValidation:
     def test_rejects_non_pow2_piece_length(self):
         files = [(("f",), b"x" * 100)]

@@ -187,12 +187,19 @@ def encode_metainfo_v2(
     comment: str | None = None,
     announce_list: list[list[str]] | None = None,
     web_seeds: list[str] | None = None,
+    v1_pieces: list[bytes] | None = None,
+    v1_files: list[dict] | None = None,
+    v1_length: int | None = None,
 ) -> bytes:
-    """Bencode a pure-v2 .torrent from parsed/authored structures.
+    """Bencode a v2 (or, with the ``v1_*`` fields, hybrid) .torrent.
 
     ``comment``/``announce_list`` (BEP 12) / ``web_seeds`` (BEP 19) are
     top-level fields exactly as in v1; ``info.private`` (BEP 27) goes
-    inside the info dict so it is covered by the infohash.
+    inside the info dict so it is covered by the infohash. Passing
+    ``v1_pieces`` plus ``v1_files`` (multi-file, with BEP 47 pad entries)
+    or ``v1_length`` (single-file) adds the v1 generation's fields to the
+    same info dict — the BEP 52 upgrade path, one blob both client
+    generations read, two infohashes (sha1/sha256 of the same span).
     """
     tree: dict = {}
     for f in info.files:
@@ -203,12 +210,18 @@ def encode_metainfo_v2(
         if f.length > 0:
             marker[b"pieces root"] = f.pieces_root
         node[b""] = marker
-    info_dict = {
+    info_dict: dict = {
         b"meta version": 2,
         b"name": info.name.encode(),
         b"piece length": info.piece_length,
         b"file tree": tree,
     }
+    if v1_pieces is not None:
+        info_dict[b"pieces"] = b"".join(v1_pieces)
+        if v1_files is not None:
+            info_dict[b"files"] = v1_files
+        else:
+            info_dict[b"length"] = v1_length or 0
     if info.private:
         info_dict[b"private"] = 1
     root: dict = {b"info": info_dict}

@@ -17,6 +17,7 @@ device plane); the independent spec oracle lives in tests/test_v2.py.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 
 import numpy as np
@@ -88,8 +89,23 @@ def _iter_source(source, chunk_bytes: int):
             yield chunk
 
 
-def _leaf_words_device(source, backend: str) -> np.ndarray:
-    """SHA-256 leaf hashes for a file source → ``u32[n_blocks, 8]``.
+def _pick_leaf_backend(b: int, backend: str) -> str:
+    if backend != "auto":
+        return backend
+    # the pallas kernel pads launches to TILE rows and only compiles
+    # for real (non-interpret) on TPU-kind devices — anywhere else
+    # (CPU, GPU, or a jax without pallas at all) the scan backend wins
+    try:
+        from torrent_tpu.ops.sha1_pallas import TILE, _auto_interpret
+
+        return "pallas" if b % TILE == 0 and not _auto_interpret() else "jax"
+    except ImportError:
+        return "jax"
+
+
+def _leaf_words_from_chunks(chunks, total: int, backend: str) -> np.ndarray:
+    """SHA-256 leaf hashes from an iterator of block-aligned chunks
+    → ``u32[n_blocks, 8]``.
 
     Batch rows are pow-2 bucketed (floor 16, cap LEAF_BATCH) so arbitrary
     file sizes share a handful of compiled executables instead of one per
@@ -97,24 +113,13 @@ def _leaf_words_device(source, backend: str) -> np.ndarray:
     """
     import jax
 
-    total = source_len(source)
     n = max(1, -(-total // BLOCK))
     b = min(LEAF_BATCH, max(16, 1 << (n - 1).bit_length()))
-    if backend == "auto":
-        # the pallas kernel pads launches to TILE rows and only compiles
-        # for real (non-interpret) on TPU-kind devices — anywhere else
-        # (CPU, GPU, or a jax without pallas at all) the scan backend wins
-        try:
-            from torrent_tpu.ops.sha1_pallas import TILE, _auto_interpret
-
-            backend = "pallas" if b % TILE == 0 and not _auto_interpret() else "jax"
-        except ImportError:
-            backend = "jax"
-    fn = make_sha256_fn(backend)
+    fn = make_sha256_fn(_pick_leaf_backend(b, backend))
     out = np.zeros((n, 8), dtype=np.uint32)
     padded, view = alloc_padded(b, BLOCK)
     start = 0
-    for chunk in _iter_source(source, b * BLOCK):
+    for chunk in chunks:
         k = -(-len(chunk) // BLOCK)
         lengths = np.zeros(b, dtype=np.int64)
         padded[:] = 0
@@ -139,14 +144,25 @@ def _leaf_words_device(source, backend: str) -> np.ndarray:
     return out
 
 
-def _leaf_words_cpu(source) -> np.ndarray:
+def _leaf_words_device(source, backend: str) -> np.ndarray:
+    total = source_len(source)
+    n = max(1, -(-total // BLOCK))
+    b = min(LEAF_BATCH, max(16, 1 << (n - 1).bit_length()))
+    return _leaf_words_from_chunks(_iter_source(source, b * BLOCK), total, backend)
+
+
+def _leaf_words_cpu_from_chunks(chunks) -> np.ndarray:
     digs = []
-    for chunk in _iter_source(source, LEAF_BATCH * BLOCK):
+    for chunk in chunks:
         for i in range(0, len(chunk), BLOCK):
             digs.append(hashlib.sha256(chunk[i : i + BLOCK]).digest())
     if not digs:
         digs.append(hashlib.sha256(b"").digest())
     return digests_to_words32(digs)
+
+
+def _leaf_words_cpu(source) -> np.ndarray:
+    return _leaf_words_cpu_from_chunks(_iter_source(source, LEAF_BATCH * BLOCK))
 
 
 def hash_file_v2(
@@ -219,6 +235,146 @@ def build_v2(
     parsed = parse_metainfo_v2(encoded)
     assert parsed is not None, "authored v2 metainfo failed its own parse"
     return parsed
+
+
+@functools.lru_cache(maxsize=4)
+def _piece_verifier(plen: int):
+    """One SHA-1 hash-plane verifier per piece geometry (a fresh one per
+    file would recompile the same executable over and over)."""
+    from torrent_tpu.models.verifier import TPUVerifier
+
+    return TPUVerifier(piece_length=plen, batch_size=256)
+
+
+def _hybrid_hash_file(
+    source, plen: int, hasher: str, pad_tail: bool
+) -> tuple[bytes, tuple[bytes, ...], list[bytes]]:
+    """One streaming pass → (v2 pieces_root, v2 layer, v1 piece digests).
+
+    Both hash families consume the same chunk iterator, so hybrid
+    authoring reads each file from disk exactly once. ``pad_tail`` zero-
+    extends the final v1 piece to full length (BEP 47 — the pad bytes are
+    part of the hashed piece). Chunk size is the leaf bucket (a power-of-
+    two multiple of BLOCK, hence of ``plen`` whenever plen ≤ chunk), so
+    the v1 carry is only ever the file's final partial piece.
+    """
+    total = source_len(source)
+    if total == 0:
+        return b"\x00" * 32, (), []
+    n = max(1, -(-total // BLOCK))
+    bkt = min(LEAF_BATCH, max(16, 1 << (n - 1).bit_length()))
+    chunk_bytes = bkt * BLOCK
+
+    if hasher == "cpu":
+        import hashlib as _hl
+
+        hash_batch = lambda ps: [_hl.sha1(p).digest() for p in ps]
+    else:
+        hash_batch = _piece_verifier(plen).hash_pieces
+
+    v1_digs: list[bytes] = []
+    state = {"carry": b""}
+
+    def feed_sha1(chunk: bytes) -> None:
+        buf = state["carry"] + chunk
+        full = len(buf) // plen
+        if full:
+            v1_digs.extend(hash_batch([buf[i * plen : (i + 1) * plen] for i in range(full)]))
+        state["carry"] = buf[full * plen :]
+
+    def tee():
+        for chunk in _iter_source(source, chunk_bytes):
+            feed_sha1(chunk)
+            yield chunk
+
+    if hasher == "cpu":
+        leaves = _leaf_words_cpu_from_chunks(tee())
+    else:
+        leaves = _leaf_words_from_chunks(tee(), total, "auto")
+    tail = state["carry"]
+    if tail:
+        v1_digs.extend(hash_batch([tail.ljust(plen, b"\x00") if pad_tail else tail]))
+
+    if total <= plen:
+        return small_file_root(leaves), (), v1_digs
+    lpp = plen // BLOCK
+    roots = piece_roots_from_leaves(leaves, lpp)
+    layer = tuple(words32_to_digests(roots))
+    return file_root_from_piece_roots(roots, lpp), layer, v1_digs
+
+
+def build_hybrid(
+    files: list[tuple[tuple[str, ...], "bytes | str"]],
+    name: str,
+    piece_length: int,
+    hasher: str = "tpu",
+    announce: str | None = None,
+    private: bool = False,
+    comment: str | None = None,
+    announce_list: list[list[str]] | None = None,
+    web_seeds: list[str] | None = None,
+) -> tuple[bytes, MetainfoV2]:
+    """Author a hybrid v1+v2 torrent (BEP 52 upgrade path).
+
+    Every file except the last is padded to a piece boundary with a
+    BEP 47 pad file (``.pad/N``, attr ``p``) so v1 pieces never span
+    files — which is exactly what lets the v1 piece hashes and the v2
+    per-file merkle trees describe the same bytes. Returns the bencoded
+    torrent and its parsed v2 view (``parse_metainfo`` reads the same
+    blob for the v1 view).
+    """
+    if piece_length < BLOCK or piece_length & (piece_length - 1):
+        raise ValueError("piece_length must be a power of two >= 16 KiB")
+    from torrent_tpu.codec.metainfo_v2 import (
+        encode_metainfo_v2,
+        parse_metainfo_v2,
+        valid_path_component,
+    )
+
+    for path, _ in files:
+        for part in path:
+            if not valid_path_component(part):
+                raise ValueError(f"path component {part!r} not encodable in a file tree")
+
+    entries = sorted(files, key=lambda e: e[0])
+    v2files: list[V2File] = []
+    layers: dict[bytes, tuple[bytes, ...]] = {}
+    v1_pieces: list[bytes] = []
+    v1_files: list[dict] = []
+    single = len(entries) == 1 and entries[0][0] == (name,)
+    for idx, (path, source) in enumerate(entries):
+        last = idx == len(entries) - 1
+        root, layer, digs = _hybrid_hash_file(
+            source, piece_length, hasher, pad_tail=not last
+        )
+        length = source_len(source)
+        v2files.append(V2File(path=path, length=length, pieces_root=root))
+        if layer:
+            layers[root] = layer
+        v1_pieces.extend(digs)
+        v1_files.append({b"length": length, b"path": [p.encode() for p in path]})
+        pad = (-length) % piece_length
+        if not last and pad:
+            v1_files.append(
+                {b"length": pad, b"path": [b".pad", str(pad).encode()], b"attr": b"p"}
+            )
+    info = InfoDictV2(
+        name=name, piece_length=piece_length, files=tuple(v2files), private=private
+    )
+    encoded = encode_metainfo_v2(
+        info,
+        layers,
+        announce=announce,
+        comment=comment,
+        announce_list=announce_list,
+        web_seeds=web_seeds,
+        v1_pieces=v1_pieces,
+        v1_files=None if single else v1_files,
+        v1_length=source_len(entries[0][1]) if single else None,
+    )
+    parsed = parse_metainfo_v2(encoded)
+    assert parsed is not None, "authored hybrid failed its own v2 parse"
+    return encoded, parsed
 
 
 def verify_v2(

@@ -62,7 +62,7 @@ def _cmd_info(args) -> int:
 
 
 def _cmd_make(args) -> int:
-    if args.v2:
+    if args.v2 or args.hybrid:
         return _make_v2(args)
     from torrent_tpu.tools.make_torrent import make_torrent
 
@@ -111,26 +111,37 @@ def _make_v2(args) -> int:
                 rel = os.path.relpath(fp, path)
                 files.append((tuple(rel.split(os.sep)), fp))
     plen = args.piece_length or (1 << 20)
-    try:
-        meta = build_v2(
-            files, name=name, piece_length=plen, hasher=args.hasher,
-            announce=args.tracker, private=args.private, comment=args.comment,
-            announce_list=[[t] for t in args.also_tracker] or None,
-            web_seeds=args.web_seed or None,
-        )
-    except ValueError as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 1
-    data = encode_metainfo_v2(
-        meta.info, meta.piece_layers, announce=args.tracker,
-        comment=args.comment,
+    kwargs = dict(
+        name=name, piece_length=plen, hasher=args.hasher,
+        announce=args.tracker, private=args.private, comment=args.comment,
         announce_list=[[t] for t in args.also_tracker] or None,
         web_seeds=args.web_seed or None,
     )
+    try:
+        if args.hybrid:
+            from torrent_tpu.models.v2 import build_hybrid
+
+            data, meta = build_hybrid(files, **kwargs)
+            kind = "hybrid v1+v2"
+        else:
+            meta = build_v2(files, **kwargs)
+            data = encode_metainfo_v2(
+                meta.info, meta.piece_layers, announce=args.tracker,
+                comment=args.comment,
+                announce_list=[[t] for t in args.also_tracker] or None,
+                web_seeds=args.web_seed or None,
+            )
+            kind = "v2"
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     out = args.output or (name + ".torrent")
     with open(out, "wb") as f:
         f.write(data)
-    print(f"wrote {out} ({len(data):,} bytes, v2, infohash {meta.info_hash_v2.hex()[:16]}...)")
+    print(
+        f"wrote {out} ({len(data):,} bytes, {kind}, "
+        f"infohash {meta.info_hash_v2.hex()[:16]}...)"
+    )
     return 0
 
 
@@ -167,18 +178,20 @@ def _verify_v2(v2, args) -> int:
 
 def _cmd_verify(args) -> int:
     from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
     from torrent_tpu.parallel.verify import verify_pieces
     from torrent_tpu.storage.storage import FsStorage, Storage
 
     with open(args.torrent, "rb") as f:
         data = f.read()
+    # v2-aware parse first: hybrids verify via the per-file merkle path
+    # (pad files never exist on disk, so the v1 view would fail the
+    # pieces that cover them); pure-v1 torrents fall through unchanged.
+    v2 = parse_metainfo_v2(data)
+    if v2 is not None:
+        return _verify_v2(v2, args)
     m = parse_metainfo(data)
     if m is None:
-        from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
-
-        v2 = parse_metainfo_v2(data)
-        if v2 is not None:
-            return _verify_v2(v2, args)
         print("error: not a valid .torrent file", file=sys.stderr)
         return 1
 
@@ -369,6 +382,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="BEP 19 url-list entry (repeatable)")
     sp.add_argument("--v2", action="store_true",
                     help="author a BitTorrent v2 (BEP 52) torrent: SHA-256 merkle file tree")
+    sp.add_argument("--hybrid", action="store_true",
+                    help="author a hybrid v1+v2 torrent (BEP 52 upgrade path, BEP 47 pad files)")
     sp.set_defaults(fn=_cmd_make)
 
     sp = sub.add_parser("verify", help="recheck downloaded data against a .torrent")

@@ -37,6 +37,13 @@ class MsgId(enum.IntEnum):
     REQUEST = 6
     PIECE = 7
     CANCEL = 8
+    # BEP 6 fast extension (reserved bit 0x04 in byte 7); the reference
+    # stops at the nine BEP 3 messages (protocol.ts:202-209)
+    SUGGEST_PIECE = 13
+    HAVE_ALL = 14
+    HAVE_NONE = 15
+    REJECT_REQUEST = 16
+    ALLOWED_FAST = 17
     EXTENDED = 20  # BEP 10 extension protocol (net/extension.py)
 
 
@@ -103,6 +110,43 @@ class Cancel:
 
 
 @dataclass(frozen=True)
+class SuggestPiece:
+    """BEP 6: a hint that ``index`` would be a good next pick (e.g. the
+    sender has it cached)."""
+
+    index: int
+
+
+@dataclass(frozen=True)
+class HaveAll:
+    """BEP 6: replaces an all-ones bitfield as the opening message."""
+
+
+@dataclass(frozen=True)
+class HaveNone:
+    """BEP 6: replaces an all-zeros bitfield as the opening message."""
+
+
+@dataclass(frozen=True)
+class RejectRequest:
+    """BEP 6: explicit refusal of one outstanding Request. With the fast
+    extension a choke no longer silently voids requests — every dropped
+    request is rejected individually."""
+
+    index: int
+    begin: int
+    length: int
+
+
+@dataclass(frozen=True)
+class AllowedFast:
+    """BEP 6: grants the receiver permission to request ``index`` even
+    while choked (bootstraps fresh leechers past the first unchoke)."""
+
+    index: int
+
+
+@dataclass(frozen=True)
 class Extended:
     """BEP 10 frame: <id 20><ext_id u8><payload>. ext_id 0 = ext handshake."""
 
@@ -111,8 +155,32 @@ class Extended:
 
 
 PeerMsg = (
-    KeepAlive | Choke | Unchoke | Interested | NotInterested | Have | BitfieldMsg | Request | Piece | Cancel | Extended
+    KeepAlive | Choke | Unchoke | Interested | NotInterested | Have | BitfieldMsg | Request | Piece | Cancel
+    | SuggestPiece | HaveAll | HaveNone | RejectRequest | AllowedFast | Extended
 )
+
+# BEP 6 handshake advertisement: bit 0x04 of reserved byte 7.
+FAST_RESERVED_BYTE = 7
+FAST_RESERVED_BIT = 0x04
+
+
+def supports_fast(reserved: bytes) -> bool:
+    return len(reserved) == 8 and bool(reserved[FAST_RESERVED_BYTE] & FAST_RESERVED_BIT)
+
+
+def merge_reserved(*parts: bytes) -> bytes:
+    """OR together reserved-byte masks (BEP 10 | BEP 6 | ...)."""
+    out = bytearray(8)
+    for p in parts:
+        for i, byte in enumerate(p):
+            out[i] |= byte
+    return bytes(out)
+
+
+def fast_reserved() -> bytes:
+    r = bytearray(8)
+    r[FAST_RESERVED_BYTE] |= FAST_RESERVED_BIT
+    return bytes(r)
 
 
 # ============================================================= handshake
@@ -194,6 +262,19 @@ def encode_message(msg: PeerMsg) -> bytes:
             return _frame(MsgId.PIECE, write_int(index, 4) + write_int(begin, 4) + block)
         case Cancel(index, begin, length):
             return _frame(MsgId.CANCEL, write_int(index, 4) + write_int(begin, 4) + write_int(length, 4))
+        case SuggestPiece(index):
+            return _frame(MsgId.SUGGEST_PIECE, write_int(index, 4))
+        case HaveAll():
+            return _frame(MsgId.HAVE_ALL)
+        case HaveNone():
+            return _frame(MsgId.HAVE_NONE)
+        case RejectRequest(index, begin, length):
+            return _frame(
+                MsgId.REJECT_REQUEST,
+                write_int(index, 4) + write_int(begin, 4) + write_int(length, 4),
+            )
+        case AllowedFast(index):
+            return _frame(MsgId.ALLOWED_FAST, write_int(index, 4))
         case Extended(ext_id, payload):
             return _frame(MsgId.EXTENDED, bytes([ext_id]) + payload)
     raise ProtocolError(f"cannot encode {msg!r}")
@@ -233,6 +314,18 @@ def decode_message(msg_id: int, payload: bytes) -> PeerMsg | None:
         return Piece(read_int(payload, 4, 0), read_int(payload, 4, 4), payload[8:])
     if msg_id == MsgId.CANCEL and len(payload) == 12:
         return Cancel(read_int(payload, 4, 0), read_int(payload, 4, 4), read_int(payload, 4, 8))
+    if msg_id == MsgId.SUGGEST_PIECE and len(payload) == 4:
+        return SuggestPiece(index=read_int(payload, 4))
+    if msg_id == MsgId.HAVE_ALL and not payload:
+        return HaveAll()
+    if msg_id == MsgId.HAVE_NONE and not payload:
+        return HaveNone()
+    if msg_id == MsgId.REJECT_REQUEST and len(payload) == 12:
+        return RejectRequest(
+            read_int(payload, 4, 0), read_int(payload, 4, 4), read_int(payload, 4, 8)
+        )
+    if msg_id == MsgId.ALLOWED_FAST and len(payload) == 4:
+        return AllowedFast(index=read_int(payload, 4))
     if msg_id == MsgId.EXTENDED and len(payload) >= 1:
         return Extended(ext_id=payload[0], payload=payload[1:])
     if msg_id in set(MsgId):
@@ -261,3 +354,45 @@ async def read_message(reader: asyncio.StreamReader) -> PeerMsg | None:
         if msg is not None:
             return msg
         # unknown message id: skip and read the next frame
+
+
+# ======================================================= BEP 6 fast sets
+
+
+def allowed_fast_set(ip: str, info_hash: bytes, num_pieces: int, k: int = 10) -> list[int]:
+    """The canonical BEP 6 allowed-fast generation.
+
+    Both endpoints can derive the same set from (peer ip, info hash), so
+    grants survive reconnects and need no negotiation: iterate
+    ``x = SHA1(x)`` seeded with the /24-masked address + info hash and
+    harvest 4-byte big-endian words mod ``num_pieces`` until ``k``
+    distinct indices accumulate. IPv6 peers are masked to /64 (the spec
+    defines the v4 form; /64 is the conventional per-host prefix).
+    """
+    import hashlib
+    import ipaddress
+
+    if num_pieces <= 0:
+        return []
+    k = min(k, num_pieces)
+    try:
+        addr = ipaddress.ip_address(ip)
+    except ValueError:
+        return []
+    if addr.version == 4:
+        masked = (int(addr) & 0xFFFFFF00).to_bytes(4, "big")
+    else:
+        masked = (int(addr) >> 64 << 64).to_bytes(16, "big")
+    x = masked + info_hash
+    out: list[int] = []
+    seen: set[int] = set()
+    while len(out) < k:
+        x = hashlib.sha1(x).digest()
+        for i in range(0, 20, 4):
+            if len(out) >= k:
+                break
+            j = read_int(x, 4, i) % num_pieces
+            if j not in seen:
+                seen.add(j)
+                out.append(j)
+    return out

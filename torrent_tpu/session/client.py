@@ -213,7 +213,10 @@ class Client:
             from torrent_tpu.net.extension import extension_reserved
 
             await proto.send_handshake(
-                writer, info_hash, self.config.peer_id, extension_reserved()
+                writer,
+                info_hash,
+                self.config.peer_id,
+                proto.merge_reserved(extension_reserved(), proto.fast_reserved()),
             )
             peer_id = await asyncio.wait_for(proto.read_handshake_peer_id(reader), timeout=15)
             if peer_id == self.config.peer_id:

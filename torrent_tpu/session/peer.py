@@ -26,6 +26,22 @@ class PeerConnection:
     # BEP 10 negotiation state (net/extension.py); ``enabled`` is set from
     # the peer's handshake reserved bit 20.
     ext: ExtensionState = field(default_factory=ExtensionState)
+    # BEP 6 fast extension, negotiated via reserved bit 0x04 of byte 7
+    fast: bool = False
+    # pieces we granted this peer (it may request them while we choke it)
+    allowed_fast_out: set[int] = field(default_factory=set)
+    # pieces the peer granted us (requestable while it chokes us)
+    allowed_fast_in: set[int] = field(default_factory=set)
+    # subset of ``inflight`` that was requested while choked (under an
+    # allowed-fast grant); a reject of one of these withdraws the grant
+    inflight_choked: set[tuple[int, int, int]] = field(default_factory=set)
+    # consecutive RejectRequests with no block delivered in between; a
+    # persistently-rejecting (yet unchoked) peer trips the snub gate via
+    # this counter — the reject/re-request cycle itself keeps resetting
+    # the wall-clock snub timer, so time alone can't catch it
+    rejects_since_block: int = 0
+    # BEP 6 suggest-piece hints, most recent FIRST (newest hint wins)
+    suggested: list[int] = field(default_factory=list)
 
     # BEP 3 spec-default flag positions (peer.ts:17-20)
     am_choking: bool = True

@@ -442,7 +442,10 @@ class Torrent:
             return
         try:
             await proto.send_handshake(
-                writer, self.metainfo.info_hash, self.peer_id, ext.extension_reserved()
+                writer,
+                self.metainfo.info_hash,
+                self.peer_id,
+                proto.merge_reserved(ext.extension_reserved(), proto.fast_reserved()),
             )
             ih, reserved = await asyncio.wait_for(proto.read_handshake_head(reader), timeout=10)
             pid = await asyncio.wait_for(proto.read_handshake_peer_id(reader), timeout=10)
@@ -490,8 +493,26 @@ class Torrent:
             inbound=inbound,
         )
         peer.ext.enabled = ext.supports_extensions(reserved)
+        peer.fast = proto.supports_fast(reserved)
         self.peers[peer_id] = peer
-        proto.send_bitfield(writer, self.bitfield)
+        # Opening state message. BEP 6 peers get the compact have_all /
+        # have_none forms; everyone else gets the raw bitfield
+        # (protocol.ts:108-115 sends the bitfield unconditionally).
+        if peer.fast and self.bitfield.complete:
+            writer.write(proto.encode_message(proto.HaveAll()))
+        elif peer.fast and self.bitfield.count() == 0:
+            writer.write(proto.encode_message(proto.HaveNone()))
+        else:
+            proto.send_bitfield(writer, self.bitfield)
+        if peer.fast and address is not None:
+            # Canonical allowed-fast grants (both ends can derive the same
+            # set, so grants survive reconnects). Served while choked only
+            # for pieces we actually have; the rest get explicit rejects.
+            for i in proto.allowed_fast_set(
+                address[0], self.metainfo.info_hash, self.info.num_pieces
+            ):
+                peer.allowed_fast_out.add(i)
+                writer.write(proto.encode_message(proto.AllowedFast(i)))
         if peer.ext.enabled:
             # BEP 10: extended handshake right after the bitfield,
             # advertising ut_metadata (magnet joiners fetch the info dict
@@ -530,6 +551,17 @@ class Torrent:
             if self._inflight_count[blk] > 0:
                 self._inflight_count[blk] -= 1
         peer.inflight.clear()
+        peer.inflight_choked.clear()
+
+    async def _replace_bitfield(self, peer: PeerConnection, new_bf: Bitfield) -> None:
+        """Swap a peer's piece map (bitfield / have_all / have_none),
+        keeping the availability vector and interest state consistent."""
+        # in-place ufuncs cast bool→int32 themselves; no copies
+        self._avail += new_bf.as_numpy()
+        self._avail -= peer.bitfield.as_numpy()
+        peer.bitfield = new_bf
+        self._rarity_dirty = True
+        await self._update_interest(peer)
 
     # ------------------------------------------------------- message loop
 
@@ -555,7 +587,12 @@ class Torrent:
                 pass
             case proto.Choke():
                 peer.peer_choking = True
-                self._release_inflight(peer)  # choke voids outstanding requests
+                if not peer.fast:
+                    # BEP 3: choke silently voids outstanding requests.
+                    # BEP 6: it doesn't — the peer explicitly rejects each
+                    # one (the snub timer is the net under a peer that
+                    # chokes and never sends the rejects).
+                    self._release_inflight(peer)
             case proto.Unchoke():
                 peer.peer_choking = False
                 await self._fill_pipeline(peer)
@@ -577,8 +614,10 @@ class Torrent:
                         if not peer.am_interested:
                             peer.am_interested = True
                             await proto.send_message(peer.writer, proto.Interested())
-                        if not peer.peer_choking:
-                            await self._fill_pipeline(peer)
+                        # _fill_pipeline self-gates on choke state and
+                        # allowed-fast grants — a choked fast peer that
+                        # granted this very piece must still be asked
+                        await self._fill_pipeline(peer)
             case proto.BitfieldMsg(raw):
                 try:
                     new_bf = Bitfield(self.info.num_pieces, raw)
@@ -587,18 +626,64 @@ class Torrent:
                     # availability untouched (drop-peer will decrement the
                     # old one exactly once)
                     raise proto.ProtocolError("bad bitfield")
-                # in-place ufuncs cast bool→int32 themselves; no copies
-                self._avail += new_bf.as_numpy()
-                self._avail -= peer.bitfield.as_numpy()
-                peer.bitfield = new_bf
-                self._rarity_dirty = True
-                await self._update_interest(peer)
+                await self._replace_bitfield(peer, new_bf)
             case proto.Request(index, begin, length):
                 await self._serve_request(peer, index, begin, length)
             case proto.Piece(index, begin, block):
                 await self._ingest_block(peer, index, begin, block)
             case proto.Cancel(index, begin, length):
                 pass  # we serve requests synchronously; nothing queued to cancel
+            case proto.HaveAll() | proto.HaveNone():
+                if not peer.fast:
+                    raise proto.ProtocolError("have_all/have_none without fast ext")
+                new_bf = Bitfield(self.info.num_pieces)
+                if isinstance(msg, proto.HaveAll):
+                    new_bf.from_numpy(np.ones(self.info.num_pieces, dtype=bool))
+                await self._replace_bitfield(peer, new_bf)
+            case proto.SuggestPiece(index):
+                if peer.fast and 0 <= index < self.info.num_pieces:
+                    # bounded hint list, most recent first
+                    if index in peer.suggested:
+                        peer.suggested.remove(index)
+                    peer.suggested.insert(0, index)
+                    del peer.suggested[16:]
+            case proto.AllowedFast(index):
+                if peer.fast and 0 <= index < self.info.num_pieces:
+                    peer.allowed_fast_in.add(index)
+                    if (
+                        peer.peer_choking
+                        and peer.bitfield.has(index)
+                        and not self.bitfield.has(index)
+                    ):
+                        await self._fill_pipeline(peer)
+            case proto.RejectRequest(index, begin, length):
+                if not peer.fast:
+                    raise proto.ProtocolError("reject_request without fast ext")
+                blk = (index, begin, length)
+                if blk in peer.inflight:
+                    peer.inflight.discard(blk)
+                    if self._inflight_count[blk] > 0:
+                        self._inflight_count[blk] -= 1
+                    # Rejecting a request that was *issued under the grant*
+                    # (i.e. while choked) withdraws it — otherwise the
+                    # choked pipeline re-requests it forever. Rejects of
+                    # ordinary unchoked-time requests (the normal BEP 6
+                    # choke flow) must NOT burn the grant: it becomes
+                    # useful exactly now that we are choked.
+                    if blk in peer.inflight_choked:
+                        peer.inflight_choked.discard(blk)
+                        peer.allowed_fast_in.discard(index)
+                    # A peer that rejects everything we ask for must not
+                    # spin the request/reject loop at line rate: each
+                    # refill resets the wall-clock snub timer, so count
+                    # rejects instead and snub on a burst of them.
+                    peer.rejects_since_block += 1
+                    if peer.rejects_since_block >= 2 * self.config.pipeline_depth:
+                        peer.snubbed_until = (
+                            time.monotonic() + self.config.snub_timeout
+                        )
+                    else:
+                        await self._fill_pipeline(peer)
             case proto.Extended(ext_id, payload):
                 await self._handle_extended(peer, ext_id, payload)
 
@@ -668,7 +753,8 @@ class Torrent:
         elif not want and peer.am_interested:
             peer.am_interested = False
             await proto.send_message(peer.writer, proto.NotInterested())
-        if want and not peer.peer_choking:
+        if want:
+            # self-gated: no-ops while choked unless allowed-fast applies
             await self._fill_pipeline(peer)
 
     def _rebuild_rarity(self) -> None:
@@ -692,8 +778,15 @@ class Torrent:
             yield blk
 
     async def _fill_pipeline(self, peer: PeerConnection) -> None:
-        """Rarest-first picking + pipelining; endgame duplication."""
-        if peer.peer_choking or self.bitfield.complete:
+        """Rarest-first picking + pipelining; endgame duplication.
+
+        While choked, a BEP 6 peer can still be asked for its allowed-fast
+        grants — candidate pieces are then restricted to that set.
+        """
+        if self.bitfield.complete:
+            return
+        choked_fast = peer.peer_choking and peer.fast and bool(peer.allowed_fast_in)
+        if peer.peer_choking and not choked_fast:
             return
         if peer.snubbed and not self._endgame:
             return  # earns requests back by delivering a block
@@ -701,6 +794,9 @@ class Torrent:
         if budget <= 0:
             return
         wanted: list[tuple[int, int, int]] = []
+
+        def pickable(index: int) -> bool:
+            return not peer.peer_choking or index in peer.allowed_fast_in
 
         def take_from(index: int) -> bool:
             for blk in self._missing_blocks(index):
@@ -718,7 +814,20 @@ class Torrent:
         for index, partial in list(self._partials.items()):
             if partial.webseed:
                 continue
-            if peer.bitfield.has(index) and not self.bitfield.has(index):
+            if peer.bitfield.has(index) and not self.bitfield.has(index) and pickable(index):
+                if take_from(index):
+                    break
+        # BEP 6 suggest-piece hints outrank plain rarest-first: the sender
+        # says these are cheap for it to serve (e.g. still in cache)
+        if len(wanted) < budget:
+            for index in peer.suggested:
+                if (
+                    self.bitfield.has(index)
+                    or index in self._partials
+                    or not peer.bitfield.has(index)
+                    or not pickable(index)
+                ):
+                    continue
                 if take_from(index):
                     break
         if len(wanted) < budget:
@@ -729,18 +838,24 @@ class Torrent:
                     self.bitfield.has(index)
                     or index in self._partials
                     or not peer.bitfield.has(index)
+                    or not pickable(index)
                 ):
                     continue
                 if take_from(index):
                     break
 
         if not wanted:
+            if peer.peer_choking:
+                # The choked-fast path must never trip global endgame:
+                # "every granted piece is busy elsewhere" says nothing
+                # about the swarm as a whole.
+                return
             # Endgame: everything missing is in flight somewhere — duplicate
             # requests so one slow peer can't stall completion.
             remaining = [
                 blk
                 for i in self.bitfield.missing()
-                if peer.bitfield.has(i)
+                if peer.bitfield.has(i) and pickable(i)
                 for blk in self._missing_blocks(i)
                 if blk not in peer.inflight
             ]
@@ -756,6 +871,8 @@ class Torrent:
             peer.last_block_rx = time.monotonic()
         for blk in wanted:
             peer.inflight.add(blk)
+            if peer.peer_choking:
+                peer.inflight_choked.add(blk)  # issued under an allowed-fast grant
             self._inflight_count[blk] += 1
             await proto.send_message(peer.writer, proto.Request(*blk))
 
@@ -766,11 +883,13 @@ class Torrent:
         blk = (index, begin, len(block))
         if blk in peer.inflight:
             peer.inflight.discard(blk)
+            peer.inflight_choked.discard(blk)
             if self._inflight_count[blk] > 0:
                 self._inflight_count[blk] -= 1
         peer.bytes_down += len(block)
         peer.last_block_rx = time.monotonic()
         peer.snubbed_until = 0.0  # delivering redeems
+        peer.rejects_since_block = 0
         if self.bitfield.has(index):
             return  # duplicate from endgame
         partial = self._partials.get(index)
@@ -803,6 +922,7 @@ class Torrent:
             if p is except_peer or blk not in p.inflight:
                 continue
             p.inflight.discard(blk)
+            p.inflight_choked.discard(blk)
             if self._inflight_count[blk] > 0:
                 self._inflight_count[blk] -= 1
             try:
@@ -957,12 +1077,27 @@ class Torrent:
     # ------------------------------------------------------------- seeding
 
     async def _serve_request(self, peer: PeerConnection, index, begin, length) -> None:
-        """request handler (torrent.ts:158-176), gated on our choke state."""
-        if peer.am_choking:
-            return  # spec: ignore requests while choking
+        """request handler (torrent.ts:158-176), gated on our choke state.
+
+        BEP 6 changes both gates: a choked fast peer may still fetch its
+        allowed-fast pieces, and anything we won't serve is rejected
+        explicitly instead of silently dropped.
+        """
         if not validate_requested_block(self.info, index, begin, length):
             raise proto.ProtocolError("invalid request")
+
+        async def refuse():
+            # fast peers get an explicit reject; BEP 3 peers silent-drop
+            if peer.fast:
+                await proto.send_message(
+                    peer.writer, proto.RejectRequest(index, begin, length)
+                )
+
+        if peer.am_choking and not (peer.fast and index in peer.allowed_fast_out):
+            await refuse()
+            return
         if not self.bitfield.has(index):
+            await refuse()
             return
         try:
             block = await asyncio.to_thread(

@@ -1,0 +1,188 @@
+"""On-device Pallas SHA1 knob sweep (TILE_SUB x UNROLL).
+
+Ranks kernel tilings by sustained hash-plane throughput on the real
+chip, with the measurement methodology this image requires (see
+BASELINE.md "Measured environment characteristics"):
+
+- **Data lives on device.** The input batch is generated with the TPU
+  PRNG; only two rows ever cross the tunnel (for the hashlib golden
+  check). A host-built batch would spend 30 s per config on a 35 MiB/s
+  relay and measure the pipe, not the kernel.
+- **Every timed dispatch is distinct.** The kernel input is
+  ``rand ^ salt`` with a fresh salt per dispatch — identical repeated
+  dispatches get deduplicated by the remote backend and time as
+  impossibly fast.
+- **Completion is forced by fetching an on-device reduction** of the
+  final dispatch's digests (the device executes in-order, so the last
+  result landing implies the whole queue ran; ``block_until_ready``
+  alone returns early on this backend).
+
+Each (tile_sub, unroll) point reloads ``ops.sha1_pallas`` so the
+module-level tiling constants rebind; the digest of the salt=0 warmup
+is checked bit-exact against hashlib before any timing is trusted.
+
+Usage::
+
+    python -m torrent_tpu.tools.tune_sha1 [--piece-kb 256] [--batch 4096]
+        [--grid 8x16,8x32,16x16,16x32,32x8,32x16] [--iters 8]
+
+Prints one ranked JSON line per config plus a ``best`` summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import importlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _parse_grid(spec: str) -> list[tuple[int, int]]:
+    out = []
+    for part in spec.split(","):
+        ts, un = part.lower().split("x")
+        out.append((int(ts), int(un)))
+    return out
+
+
+def _pad_tail(plen: int) -> np.ndarray:
+    """The 64-byte SHA1 padding block for a message of exactly ``plen``
+    bytes (plen % 64 == 0, so the pad is a standalone final block)."""
+    assert plen % 64 == 0
+    tail = np.zeros(64, dtype=np.uint8)
+    tail[0] = 0x80
+    tail[-8:] = np.frombuffer((plen * 8).to_bytes(8, "big"), dtype=np.uint8)
+    return tail
+
+
+def run_sweep(
+    piece_kb: int,
+    batch: int,
+    grid: list[tuple[int, int]],
+    iters: int,
+    interpret: bool = False,
+):
+    import jax
+
+    if interpret:
+        # smoke-test mode: stay off the real device (this image's
+        # sitecustomize pins jax_platforms to the device plugin, so the
+        # env var alone is not enough)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    plen = piece_kb * 1024
+    padded = plen + 64
+    nblk = padded // 64
+    tail = _pad_tail(plen)
+
+    # One device-resident random payload, shared by every config. Golden
+    # rows 0 and batch-1 come back over the tunnel exactly once. Bits are
+    # generated as u32 inside one jit (u8 generation makes a 32-bit word
+    # per element — 4x the HBM — and the jit frees the intermediates).
+    key = jax.random.key(20260730)
+    rand = jax.jit(
+        lambda k: jax.lax.bitcast_convert_type(
+            jax.random.bits(k, (batch, plen // 4), jnp.uint32), jnp.uint8
+        ).reshape(batch, plen)
+    )(key)
+    rand_np_rows = {i: np.asarray(rand[i]) for i in (0, batch - 1)}
+    golden = {i: hashlib.sha1(rand_np_rows[i].tobytes()).digest() for i in rand_np_rows}
+    tail_dev = jax.device_put(tail)
+    nblocks = jnp.full((batch,), nblk, dtype=jnp.int32)
+
+    results = []
+    for tile_sub, unroll in grid:
+        os.environ["TORRENT_TPU_SHA1_TILE_SUB"] = str(tile_sub)
+        os.environ["TORRENT_TPU_SHA1_UNROLL"] = str(unroll)
+        import torrent_tpu.ops.sha1_pallas as sp
+
+        sp = importlib.reload(sp)
+        if batch % sp.TILE:
+            print(
+                f"# skip {tile_sub}x{unroll}: batch {batch} not a multiple of "
+                f"TILE {sp.TILE}",
+                file=sys.stderr,
+            )
+            continue
+
+        # rand/tail/nblocks are explicit arguments: a closed-over device
+        # array can get lowered as an embedded HLO constant (a 1 GiB
+        # program that takes minutes to build and ship over the relay)
+        @jax.jit
+        def hash_salted(r, t, nb, salt, _sp=sp):
+            data = jnp.concatenate([r ^ salt, jnp.broadcast_to(t, (batch, 64))], axis=1)
+            return _sp.sha1_pieces_pallas(data, nb, interpret=interpret)
+
+        reduce_sum = jax.jit(lambda s: jnp.sum(s, dtype=jnp.uint64))
+
+        try:
+            t0 = time.perf_counter()
+            state0 = hash_salted(rand, tail_dev, nblocks, jnp.uint8(0))
+            got = np.asarray(state0[np.array([0, batch - 1])])
+            compile_s = time.perf_counter() - t0
+        except Exception as e:  # Mosaic can reject a tiling outright
+            print(
+                json.dumps(
+                    {"tile_sub": tile_sub, "unroll": unroll, "error": repr(e)[:200]}
+                )
+            )
+            continue
+        for row, idx in ((0, 0), (1, batch - 1)):
+            want = np.frombuffer(golden[idx], dtype=">u4").astype(np.uint32)
+            if not np.array_equal(got[row], want):
+                raise SystemExit(
+                    f"golden mismatch at {tile_sub}x{unroll} row {idx}: "
+                    f"{got[row]} != {want}"
+                )
+
+        t0 = time.perf_counter()
+        outs = [
+            hash_salted(rand, tail_dev, nblocks, jnp.uint8(s))
+            for s in range(1, iters + 1)
+        ]
+        _ = int(reduce_sum(outs[-1]))
+        secs = time.perf_counter() - t0
+        pps = iters * batch / secs
+        line = {
+            "tile_sub": tile_sub,
+            "unroll": unroll,
+            "pieces_per_sec": round(pps, 1),
+            "gib_per_sec": round(pps * plen / 2**30, 2),
+            "compile_s": round(compile_s, 1),
+        }
+        results.append(line)
+        print(json.dumps(line), flush=True)
+
+    if results:
+        best = max(results, key=lambda r: r["pieces_per_sec"])
+        print(json.dumps({"best": best, "piece_kb": piece_kb, "batch": batch}))
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--piece-kb", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument(
+        "--grid", default="8x16,8x32,16x8,16x16,16x32,32x8,32x16,32x32"
+    )
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument(
+        "--interpret",
+        action="store_true",
+        help="interpret-mode kernel (CPU smoke test of the sweep itself)",
+    )
+    args = ap.parse_args()
+    run_sweep(
+        args.piece_kb, args.batch, _parse_grid(args.grid), args.iters, args.interpret
+    )
+
+
+if __name__ == "__main__":
+    main()

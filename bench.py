@@ -63,10 +63,14 @@ import numpy as np
 
 def _env_geometry():
     total_mb = int(os.environ.get("BENCH_TOTAL_MB", "1024"))
-    # 4096 measured best on the real chip: pallas throughput scales with
-    # batch (13.2 GiB/s at 4096 vs 3.3 at 1024 — per-dispatch latency
-    # amortizes) and the staging/device footprint stays ~1 GiB per batch
-    batch = int(os.environ.get("BENCH_BATCH", "4096"))
+    # Dispatch size dominates the hash plane: a ~55 ms fixed per-dispatch
+    # cost (relay RTT + marshaling) caps 4096-piece dispatches at ~67k
+    # p/s while the kernel itself sustains >40 GiB/s. Measured at 256 KiB
+    # (tools/tune_sha1.py, tile 32x16): 4096 → 67k p/s, 8192 → 169k,
+    # 16384 → 179k. Default 8192 keeps 2 distinct timed dispatches
+    # resident within the 8 GiB device-plane budget; 16384 gains +6% but
+    # drops the plane measurement to a single timed dispatch.
+    batch = int(os.environ.get("BENCH_BATCH", "8192"))
     config = os.environ.get("BENCH_CONFIG", "headline")
     plen = int(os.environ.get("BENCH_PIECE_KB", "256")) * 1024
     return total_mb, batch, config, plen
@@ -424,10 +428,8 @@ def _device_plane_pps(verifier, plen):
     base[:plen] = rng.integers(0, 256, plen, dtype=np.uint8)
     lengths = np.full(b, plen, dtype=np.int64)
 
-    # 2-D unaligned device_put hits XLA's element-relayout (~2 MiB/s on
-    # the tunnel); upload flat chunks at wire speed and reshape on device
-    to_2d = jax.jit(lambda cs: jnp.concatenate(cs).reshape(b, verifier.padded_len))
-
+    # resident row-block u32 chunks, dispatched through the verifier's
+    # flat step (the same executable verify_storage uses)
     datas, nbs, exps = [], [], []
     for i in range(n_batches):
         padded = np.tile(base, (b, 1))
@@ -438,15 +440,18 @@ def _device_plane_pps(verifier, plen):
         for row in (0, b - 1):
             d = hashlib.sha1(padded[row, :plen].tobytes()).digest()
             expected[row] = digests_to_words([d])[0]
-        datas.append(to_2d(verifier._put_flat(padded)))
+        datas.append(verifier._put_flat(padded))
         nbs.append(jax.device_put(nblocks))
         exps.append(jax.device_put(expected))
-    ok0 = np.asarray(verifier._verify_step(datas[0], nbs[0], exps[0]))  # compile
+    ok0 = np.asarray(verifier._verify_step_flat(datas[0], nbs[0], exps[0]))  # compile
     assert ok0[0] and ok0[b - 1], "device-plane golden check failed"
     # time batches 1..N-1 only: batch 0 was the warm-up call, and repeating
     # an identical dispatch can be deduplicated by remote backends
     t0 = time.perf_counter()
-    outs = [verifier._verify_step(datas[i], nbs[i], exps[i]) for i in range(1, n_batches)]
+    outs = [
+        verifier._verify_step_flat(datas[i], nbs[i], exps[i])
+        for i in range(1, n_batches)
+    ]
     last = np.asarray(outs[-1])
     secs = time.perf_counter() - t0
     assert last[0] and last[b - 1], "device-plane golden check failed"

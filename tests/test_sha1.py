@@ -31,11 +31,14 @@ def sha1_batch(pieces):
 class TestPadding:
     @pytest.mark.parametrize(
         "n,expect",
-        [(0, 64), (55, 64), (56, 128), (64, 128), (119, 128), (120, 192), (262144, 262208)],
+        [(0, 128), (55, 128), (56, 128), (64, 128), (119, 128), (120, 256), (262144, 262272)],
     )
     def test_padded_len(self, n, expect):
         assert padded_len_for(n) == expect
-        assert int(num_blocks_for(n)) * 64 == expect
+        assert padded_len_for(n) % 128 == 0  # lane-aligned device rows
+        # the spec minimum fits within the row; any ghost tail block sits
+        # beyond the per-row block count (masked off on device)
+        assert int(num_blocks_for(n)) * 64 <= expect
 
     def test_pad_matches_spec(self):
         msg = b"abc"
@@ -49,9 +52,9 @@ class TestPadding:
         assert int.from_bytes(row[56:64].tobytes(), "big") == 24  # bit length
 
     def test_pad_rejects_oversize(self):
-        padded, _ = alloc_padded(1, 8)
+        padded, _ = alloc_padded(1, 8)  # 128-byte rows
         with pytest.raises(ValueError):
-            pad_in_place(padded, np.array([60]))
+            pad_in_place(padded, np.array([120]))  # needs 192 > 128
 
     def test_digest_words_roundtrip(self):
         digs = [hashlib.sha1(bytes([i])).digest() for i in range(7)]

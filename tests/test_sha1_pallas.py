@@ -63,4 +63,6 @@ class TestPallasKernel:
         assert (a == b).all()
 
     def test_tile_constant(self):
-        assert TILE == 1024
+        # 32 sublanes x 128 lanes: the tuned default (see the sweep table
+        # in ops/sha1_pallas.py); env knobs can still override it
+        assert TILE == 4096

@@ -270,9 +270,9 @@ class BridgeServer:
                 import jax
 
                 # always the scan backend: sha256_pieces_pallas pads every
-                # launch to TILE=1024 rows, which would blow the staging
-                # budget this batch size exists to enforce (a 16 MiB bucket
-                # would balloon to ~17 GB on device)
+                # launch to a tile_sub*128-row multiple (>=1024), which
+                # would blow the staging budget this batch size exists to
+                # enforce (a 16 MiB bucket would balloon on device)
                 fn = make_sha256_fn("jax")
 
                 class _Plane:

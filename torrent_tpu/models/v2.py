@@ -89,18 +89,33 @@ def _iter_source(source, chunk_bytes: int):
             yield chunk
 
 
-def _pick_leaf_backend(b: int, backend: str) -> str:
-    if backend != "auto":
-        return backend
-    # the pallas kernel pads launches to TILE rows and only compiles
-    # for real (non-interpret) on TPU-kind devices — anywhere else
-    # (CPU, GPU, or a jax without pallas at all) the scan backend wins
-    try:
-        from torrent_tpu.ops.sha1_pallas import TILE, _auto_interpret
+def _make_leaf_fn(b: int, backend: str):
+    """SHA-256 fn for a ``b``-row leaf batch; ``auto`` prefers Pallas.
 
-        return "pallas" if b % TILE == 0 and not _auto_interpret() else "jax"
-    except ImportError:
-        return "jax"
+    The pallas kernel pads launches to a ``tile_sub*128``-row multiple and
+    only compiles for real (non-interpret) on TPU-kind devices — anywhere
+    else (CPU, GPU, or a jax without pallas at all) the scan backend
+    wins. tile_sub is a call parameter now, so any 1024-row-multiple
+    batch qualifies: pick the largest sublane count that divides ``b``
+    (pow-2 bucketed batches of 1024/2048 rows keep the fast path at
+    tile_sub 8/16 instead of silently falling back to the scan backend).
+    """
+    if backend == "auto":
+        try:
+            from torrent_tpu.ops.sha1_pallas import _auto_interpret
+
+            backend = "jax"
+            if not _auto_interpret():
+                for ts in (32, 16, 8):
+                    if b % (ts * 128) == 0:
+                        from torrent_tpu.ops.sha256_pallas import sha256_pieces_pallas
+
+                        return lambda d, nb, _ts=ts: sha256_pieces_pallas(
+                            d, nb, tile_sub=_ts
+                        )
+        except ImportError:
+            backend = "jax"
+    return make_sha256_fn(backend)
 
 
 def _leaf_words_from_chunks(chunks, total: int, backend: str) -> np.ndarray:
@@ -115,7 +130,7 @@ def _leaf_words_from_chunks(chunks, total: int, backend: str) -> np.ndarray:
 
     n = max(1, -(-total // BLOCK))
     b = min(LEAF_BATCH, max(16, 1 << (n - 1).bit_length()))
-    fn = make_sha256_fn(_pick_leaf_backend(b, backend))
+    fn = _make_leaf_fn(b, backend)
     out = np.zeros((n, 8), dtype=np.uint32)
     padded, view = alloc_padded(b, BLOCK)
     start = 0

@@ -68,16 +68,35 @@ class TPUVerifier:
         self.padded_len = padded_len_for(piece_length)
         self.backend = backend
         sha1_fn = make_sha1_fn(backend)
+        self.tile_sub = None
         if backend == "pallas":
             # A pallas_call has no SPMD partitioning rule, so on a >1-device
             # mesh we shard it explicitly: each device runs the kernel on its
             # local piece sub-batch (embarrassingly parallel, no collectives).
-            # Per-device sub-batches must be TILE(=1024)-aligned or every
+            # Per-device sub-batches must be tile-aligned or every
             # launch pads with wasted sentinel rows.
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
-            from torrent_tpu.ops.sha1_pallas import TILE
+            from torrent_tpu.ops.sha1_pallas import TILE_SUB, sha1_pieces_pallas
+
+            # Adaptive tiling: one tile row (tile_sub*128 pieces) is the
+            # kernel's swizzle/launch granularity, and its temporaries are
+            # ~2x the tile slab. Big pieces shrink the sublane count so a
+            # tile stays ~1 GiB regardless of piece size (the sweep's
+            # measured-best regime; at 4096x1 MiB a whole-batch slab OOMs
+            # a 16 GB chip outright).
+            budget = env_int("TORRENT_TPU_TILE_BYTES", 1_342_177_280)  # 1.25 GiB
+            ts = TILE_SUB
+            # step by 8s, not halving: the env default may be any multiple
+            # of 8 (halving 24 would land on 12 and crash _check_tiling)
+            while ts > 8 and ts * 128 * self.padded_len > budget:
+                ts -= 8
+            self.tile_sub = ts
+            tile = ts * 128
+
+            def sha1_fn(data, nblocks, _ts=ts):
+                return sha1_pieces_pallas(data, nblocks, tile_sub=_ts)
 
             if self.mesh.size > 1:
                 spec = P(tuple(self.mesh.axis_names))
@@ -88,7 +107,7 @@ class TPUVerifier:
                     out_specs=spec,
                     check_vma=False,
                 )
-            self.batch_size = round_up_to_multiple(self.batch_size, TILE * self.mesh.size)
+            self.batch_size = round_up_to_multiple(self.batch_size, tile * self.mesh.size)
         shard = batch_sharding(self.mesh)
 
         def _digests(data_u8, nblocks):
@@ -105,22 +124,38 @@ class TPUVerifier:
             _verify, in_shardings=(shard, shard, shard), out_shardings=shard
         )
 
-        # Fast single-device upload path. A 2-D uint8 batch whose minor dim
-        # isn't lane-aligned hits XLA's element-relayout transfer (~10x
-        # slower than memcpy); flat 1-D chunks transfer at wire speed, in
-        # parallel, and one on-device reshape (HBM copy) restores the
-        # batch. Multi-device meshes keep the sharded 2-D path (used by
-        # the dryrun/tests, where upload speed is irrelevant).
+        # Fast single-device upload path: row-block 2-D chunks put in
+        # parallel, joined with one axis-0 concat on device. padded_len is
+        # 128-byte aligned (ops/padding.py), so a 2-D put is a straight
+        # memcpy (measured at full wire speed on both PCIe and this
+        # image's tunnel). The earlier flatten→concat→reshape design is
+        # gone for a reason: XLA's AOT lowering of the big 1-D→2-D
+        # reshape materializes a (4,1)-subtiled intermediate padded 32x —
+        # a 16 GiB allocation at 512 KiB pieces. Multi-device meshes keep
+        # the sharded 2-D path (dryrun/tests, upload speed irrelevant).
         b, padded_len = self.batch_size, self.padded_len
 
+        # Chunks arrive as host-order u32 (ndarray.view is free and a
+        # u8→u32 bitcast on TPU lowers through a 4x-widened convert
+        # fusion — the pallas kernel consumes u32 directly). The scan
+        # backend still wants u8 rows; the bitcast back is cheap there
+        # (CPU/GPU lower it as a real reinterpret).
+        pallas = backend == "pallas"
+
+        def _join(chunks):
+            data = jnp.concatenate(chunks, axis=0)
+            if not pallas:
+                data = jax.lax.bitcast_convert_type(data, jnp.uint8).reshape(
+                    data.shape[0], -1
+                )
+            return data
+
         def _verify_flat(chunks, nblocks, expected):
-            data = jnp.concatenate(chunks).reshape(b, padded_len)
-            words = sha1_fn(data, nblocks)
+            words = sha1_fn(_join(chunks), nblocks)
             return jnp.all(words == expected, axis=1)
 
         def _digests_flat(chunks, nblocks):
-            data = jnp.concatenate(chunks).reshape(b, padded_len)
-            return sha1_fn(data, nblocks)
+            return sha1_fn(_join(chunks), nblocks)
 
         self._verify_step_flat = jax.jit(_verify_flat)
         self._digest_step_flat = jax.jit(_digests_flat)
@@ -149,7 +184,7 @@ class TPUVerifier:
         )
 
     def _put_flat(self, padded: np.ndarray) -> list[jax.Array]:
-        """Upload ``uint8[B, padded_len]`` as concurrent flat chunks.
+        """Upload ``uint8[B, padded_len]`` as concurrent row-block chunks.
 
         Blocks until every chunk is resident so the caller may reuse the
         staging buffer immediately.
@@ -157,10 +192,11 @@ class TPUVerifier:
         with self._upload_pool_lock:
             if self._upload_pool is None:
                 self._upload_pool = ThreadPoolExecutor(max_workers=self._upload_chunks)
-        flat = padded.reshape(-1)
-        n = flat.size
-        step = -(-n // self._upload_chunks)
-        views = [flat[i : i + step] for i in range(0, n, step)]
+        rows = padded.shape[0]
+        step = -(-rows // self._upload_chunks)
+        views = [
+            padded[i : i + step].view(np.uint32) for i in range(0, rows, step)
+        ]
         if self._upload_must_copy:
             put = lambda v: jax.device_put(v.copy())
         else:

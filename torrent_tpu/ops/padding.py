@@ -21,10 +21,18 @@ import numpy as np
 def padded_len_for(piece_len: int) -> int:
     """Padded byte length for messages of up to ``piece_len`` bytes.
 
-    ``((len + 8) // 64 + 1) * 64`` — always at least one byte of 0x80
-    marker plus the 8-byte length field beyond the message.
+    The SHA minimum is ``((len + 8) // 64 + 1) * 64`` — at least one byte
+    of 0x80 marker plus the 8-byte length field beyond the message. On
+    top of that the row is rounded up to a 128-byte multiple: a device
+    batch ``u8[B, padded_len]`` whose minor dim isn't lane-aligned (128)
+    forces XLA into padded relayouts — at 512 KiB pieces the AOT compiler
+    materializes a 32x-padded copy and dies with a 16 GiB allocation.
+    Rows never exceed ``num_blocks_for`` blocks on device: the ghost tail
+    block sits beyond every row's block count and is masked off by both
+    the scan and Pallas kernels.
     """
-    return ((piece_len + 8) // 64 + 1) * 64
+    n = ((piece_len + 8) // 64 + 1) * 64
+    return (n + 127) // 128 * 128
 
 
 def num_blocks_for(length) -> np.ndarray:

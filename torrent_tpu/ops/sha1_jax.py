@@ -64,13 +64,13 @@ def _compress(state, w16):
             wt = _rotl(w[(t - 3) % 16] ^ w[(t - 8) % 16] ^ w[(t - 14) % 16] ^ w[t % 16], 1)
             w[t % 16] = wt
         if t < 20:
-            f = (b & c) | (jnp.bitwise_not(b) & d)
+            f = d ^ (b & (c ^ d))  # ch, mux form: 3 ops vs 4
             k = _K[0]
         elif t < 40:
             f = b ^ c ^ d
             k = _K[1]
         elif t < 60:
-            f = (b & c) | (b & d) | (c & d)
+            f = (b & c) | (d & (b ^ c))  # maj via b^c factoring: 4 ops vs 5
             k = _K[2]
         else:
             f = b ^ c ^ d

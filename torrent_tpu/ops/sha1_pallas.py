@@ -1,25 +1,39 @@
 """Hand-tiled Pallas TPU SHA1 kernel — the fast path of the hash plane.
 
-Same contract as ops/sha1_jax.py (``(data_u8[B, padded], nblocks[B]) →
+Same contract as ops/sha1_jax.py (``(data[B, ...], nblocks[B]) →
 u32[B, 5]``), but laid out for the VPU explicitly:
 
-- Pieces are tiled **1024 per program** and shaped ``(8, 128)`` — every
-  schedule word ``w[t]``, every state variable, and every round temp is
-  exactly one int32 vector register (8 sublanes × 128 lanes).
-- Input is pre-swizzled (one fused XLA pass: bitcast + byteswap +
-  transpose) to ``[R, nblk, 16, 8, 128]`` so each grid step's DMA is one
-  **contiguous 64 KiB slab** from HBM.
-- Grid is ``(R, nblk)`` with the block axis innermost ("arbitrary"
+- Pieces are tiled ``tile_sub × 128`` per program — every schedule word
+  ``w[t]``, every state variable, and every round temp is ``tile_sub/8``
+  int32 vector registers (8 sublanes × 128 lanes each). Larger tile_sub
+  interleaves more independent SHA1 chains per vector op, hiding the
+  chain's serial dependency latency; the measured optimum on the real
+  v5-lite chip is 32 (tools/tune_sha1.py, 256 KiB pieces, batch 4096:
+  8x16 60.5k p/s · 16x16 65.1k · 32x8 67.0k · 32x16 67.1k; 32x32 and
+  64-sublane tilings are rejected by the Mosaic compiler).
+- Input is pre-swizzled (one fused XLA pass) to
+  ``[nblk, 16, tile_sub, 128]`` per tile row so each grid step's DMA is
+  one contiguous slab from HBM. The batch is processed **one tile row at
+  a time** inside the jit: the swizzle's transpose materializes
+  temporaries proportional to the slab, and per-tile slabs keep them
+  bounded (a whole-batch swizzle at 4096 × 1 MiB pieces is 4.3 GiB of
+  input and >8 GiB of temporaries — an instant HBM OOM).
+- Accepts ``uint8[B, padded]`` or ``uint32[B, padded//4]`` (host order)
+  input. The u32 form is the fast path: a u8→u32 bitcast lowers through
+  a 4×-widened convert fusion on TPU, while u32 input needs only the
+  in-place byteswap. Callers can reinterpret their staging buffer with
+  ``ndarray.view(np.uint32)`` for free.
+- Grid is ``(1, nblk)`` with the block axis innermost ("arbitrary"
   semantics): the 5-word running state lives in the revisited output
   block in VMEM across the whole chain — initialized at ``k == 0``,
-  written back to HBM once per batch tile.
+  written back to HBM once per tile.
 - Ragged batches: per-lane ``k < nblocks`` masks freeze a piece's state
   once its (shorter) chain ends — same semantics as the scan mask in
   sha1_jax.py, no dynamic shapes.
 
 The 80 rounds are Python-unrolled with a 16-register rolling schedule
-window: ~21 live vregs, well inside the register file; no VMEM traffic
-inside the round loop at all.
+window: ~21 live vreg values, well inside the register file; no VMEM
+traffic inside the round loop at all.
 """
 
 from __future__ import annotations
@@ -35,31 +49,33 @@ from jax.experimental.pallas import tpu as pltpu
 from torrent_tpu.ops.sha1_jax import _IV, _K, _bswap32, _rotl
 from torrent_tpu.utils.env import env_int
 
-# Pieces per program instance: TILE_SUB sublane-rows × 128 lanes. At the
-# default 8 each state/schedule variable is exactly one int32 vreg; larger
-# TILE_SUB (16/32) makes every jnp op span multiple vregs, interleaving
-# independent SHA1 chains to fill the VPU's ALUs past the single chain's
-# serial dependency path (measured: the win on real v5e hardware).
-TILE_SUB = env_int("TORRENT_TPU_SHA1_TILE_SUB", 8)
-if TILE_SUB % 8 or TILE_SUB > 64:
-    raise ValueError(
-        f"TORRENT_TPU_SHA1_TILE_SUB={TILE_SUB}: must be a multiple of 8 (the "
-        "int32 vreg sublane count) and <= 64 (VMEM block budget)"
-    )
 TILE_LANE = 128
-TILE = TILE_SUB * TILE_LANE
-# SHA1 blocks chained per grid step. Each block is only ~640 vector ops on
-# a (8, 128) tile — far less than the fixed per-step cost (DMA issue,
+# Default pieces-per-program sublane rows; see the sweep table above.
+TILE_SUB = env_int("TORRENT_TPU_SHA1_TILE_SUB", 32)
+# SHA1 blocks chained per grid step. Each block is only ~640 vector ops
+# per (8, 128) vreg — far less than the fixed per-step cost (DMA issue,
 # revisited-block bookkeeping), so one-block steps are overhead-bound.
 # The kernel runs UNROLL blocks per step via an in-kernel fori_loop (NOT
 # Python unrolling — 640 rounds in one basic block sends the backend
-# compiler superlinear); 16 keeps the step's DMA at 1 MiB.
+# compiler superlinear).
 UNROLL = env_int("TORRENT_TPU_SHA1_UNROLL", 16)
-if UNROLL > 128:
-    raise ValueError(
-        f"TORRENT_TPU_SHA1_UNROLL={UNROLL}: > 128 blows the per-step VMEM "
-        "block (unroll*16 words per lane) with no amortization left to gain"
-    )
+
+
+def _check_tiling(tile_sub: int, unroll: int) -> None:
+    if tile_sub % 8 or tile_sub > 64:
+        raise ValueError(
+            f"tile_sub={tile_sub}: must be a multiple of 8 (the int32 vreg "
+            "sublane count) and <= 64 (VMEM block budget)"
+        )
+    if unroll > 128:
+        raise ValueError(
+            f"unroll={unroll}: > 128 blows the per-step VMEM block "
+            "(unroll*16 words per lane) with no amortization left to gain"
+        )
+
+
+_check_tiling(TILE_SUB, UNROLL)
+TILE = TILE_SUB * TILE_LANE  # default tile (rows per program instance)
 
 
 def _one_block(state, w):
@@ -76,13 +92,16 @@ def _one_block(state, w):
             wt = _rotl(w[(t - 3) % 16] ^ w[(t - 8) % 16] ^ w[(t - 14) % 16] ^ w[t % 16], 1)
             w[t % 16] = wt
         if t < 20:
-            f = (b & c) | (jnp.bitwise_not(b) & d)
+            # ch(b,c,d) = (b&c)|(~b&d), 4 ops naively; the mux form needs 3
+            f = d ^ (b & (c ^ d))
             kc = _K[0]
         elif t < 40:
             f = b ^ c ^ d
             kc = _K[1]
         elif t < 60:
-            f = (b & c) | (b & d) | (c & d)
+            # maj(b,c,d) = (b&c)|(b&d)|(c&d), 5 ops naively; 4 via the
+            # b^c factoring (identical truth table)
+            f = (b & c) | (d & (b ^ c))
             kc = _K[2]
         else:
             f = b ^ c ^ d
@@ -92,12 +111,12 @@ def _one_block(state, w):
     return (state[0] + a, state[1] + b, state[2] + c, state[3] + d, state[4] + e)
 
 
-def _sha1_kernel(words_ref, nblocks_ref, state_ref, *, unroll: int):
-    """``unroll`` chained SHA1 block steps for a 1024-piece tile.
+def _sha1_kernel(words_ref, nblocks_ref, state_ref, *, unroll: int, tile_sub: int):
+    """``unroll`` chained SHA1 block steps for one ``tile_sub*128``-piece tile.
 
-    words_ref:   u32[1, unroll, 16, 8, 128] — this step's schedule words
-    nblocks_ref: i32[1, 8, 128]             — per-piece chain lengths
-    state_ref:   u32[1, 5, 8, 128]          — running digest state
+    words_ref:   u32[1, unroll, 16, tile_sub, 128] — this step's schedule words
+    nblocks_ref: i32[1, tile_sub, 128]             — per-piece chain lengths
+    state_ref:   u32[1, 5, tile_sub, 128]          — running digest state
                  (revisited across the k grid axis; read once, written once)
     """
     k = pl.program_id(1)
@@ -105,12 +124,12 @@ def _sha1_kernel(words_ref, nblocks_ref, state_ref, *, unroll: int):
     @pl.when(k == 0)
     def _init():
         for i, v in enumerate(_IV):
-            state_ref[0, i] = jnp.full((TILE_SUB, TILE_LANE), v, dtype=jnp.uint32)
+            state_ref[0, i] = jnp.full((tile_sub, TILE_LANE), v, dtype=jnp.uint32)
 
     nblocks = nblocks_ref[0]
 
     def body(j, state):
-        # Dynamic index on a leading (untiled) VMEM axis — one 64 KiB slab.
+        # Dynamic index on a leading (untiled) VMEM axis — one contiguous slab.
         w = [words_ref[0, j, t] for t in range(16)]
         new = _one_block(state, w)
         keep = k * unroll + j < nblocks
@@ -125,51 +144,73 @@ def _sha1_kernel(words_ref, nblocks_ref, state_ref, *, unroll: int):
         state_ref[0, i] = state[i]
 
 
-def _swizzle(data_u8: jax.Array, r: int, nblk: int) -> jax.Array:
-    """u8[R*1024, nblk*64] → u32[R, nblk, 16, 8, 128], big-endian words."""
-    quads = data_u8.reshape(r, TILE_SUB, TILE_LANE, nblk, 16, 4)
-    words = _bswap32(jax.lax.bitcast_convert_type(quads, jnp.uint32))
+def _swizzle_tile(tile_words_u32: jax.Array, nblk: int, tile_sub: int) -> jax.Array:
+    """Host-order u32[tile, nblk*16] → u32[1, nblk, 16, tile_sub, 128],
+    big-endian schedule words, one contiguous slab per chain step."""
+    words = _bswap32(tile_words_u32).reshape(1, tile_sub, TILE_LANE, nblk, 16)
     return jnp.transpose(words, (0, 3, 4, 1, 2))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _sha1_pallas_aligned(data_u8, nblocks, interpret):
-    b, padded = data_u8.shape
-    nblk = padded // 64
-    r = b // TILE
+@functools.partial(jax.jit, static_argnames=("interpret", "tile_sub", "unroll"))
+def _sha1_pallas_aligned(data, nblocks, interpret, tile_sub, unroll):
+    """Tile-aligned batch → digest words. ``data`` is u8[B, padded] or
+    (fast path) u32[B, padded//4]; B must be a ``tile_sub*128`` multiple.
+
+    The batch is processed one tile row per pallas_call so swizzle
+    temporaries stay proportional to a single tile, not the batch.
+    """
+    tile = tile_sub * TILE_LANE
+    b = data.shape[0]
+    if data.dtype == jnp.uint32:
+        data32 = data
+    else:
+        # compat path: u8 rows are bitcast in 4-byte quads (the widening
+        # lowering makes this the slow/memory-hungry form on TPU)
+        data32 = jax.lax.bitcast_convert_type(
+            data.reshape(b, data.shape[1] // 4, 4), jnp.uint32
+        )
+    nblk = data32.shape[1] // 16
     # Short chains (authoring tests, tiny pieces) keep unroll = chain
     # length so no work or trace time is wasted; long chains use the full
     # amortization factor. Static per input shape — no recompiles.
-    unroll = min(UNROLL, nblk)
+    unroll = min(unroll, nblk)
     # Round the chain up to an unroll multiple with zero blocks; they sit
     # beyond every row's nblocks so the masked updates skip them.
     nblk_pad = ((nblk + unroll - 1) // unroll) * unroll
     if nblk_pad != nblk:
-        data_u8 = jnp.pad(data_u8, ((0, 0), (0, (nblk_pad - nblk) * 64)))
+        data32 = jnp.pad(data32, ((0, 0), (0, (nblk_pad - nblk) * 16)))
         nblk = nblk_pad
-    words = _swizzle(data_u8, r, nblk)
-    nb = nblocks.astype(jnp.int32).reshape(r, TILE_SUB, TILE_LANE)
-    state = pl.pallas_call(
-        functools.partial(_sha1_kernel, unroll=unroll),
-        grid=(r, nblk // unroll),
+    nb = nblocks.astype(jnp.int32).reshape(b // tile, tile_sub, TILE_LANE)
+
+    call = pl.pallas_call(
+        functools.partial(_sha1_kernel, unroll=unroll, tile_sub=tile_sub),
+        grid=(1, nblk // unroll),
         in_specs=[
             pl.BlockSpec(
-                (1, unroll, 16, TILE_SUB, TILE_LANE),
+                (1, unroll, 16, tile_sub, TILE_LANE),
                 lambda i, k: (i, k, 0, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec((1, TILE_SUB, TILE_LANE), lambda i, k: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, tile_sub, TILE_LANE), lambda i, k: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
         ],
         out_specs=pl.BlockSpec(
-            (1, 5, TILE_SUB, TILE_LANE), lambda i, k: (i, 0, 0, 0), memory_space=pltpu.VMEM
+            (1, 5, tile_sub, TILE_LANE), lambda i, k: (i, 0, 0, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((r, 5, TILE_SUB, TILE_LANE), jnp.uint32),
+        out_shape=jax.ShapeDtypeStruct((1, 5, tile_sub, TILE_LANE), jnp.uint32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(words, nb)
-    # [R, 5, 8, 128] → [B, 5]
+    )
+
+    states = []
+    for r0 in range(0, b, tile):
+        words = _swizzle_tile(data32[r0 : r0 + tile], nblk, tile_sub)
+        states.append(call(words, nb[r0 // tile : r0 // tile + 1]))
+    state = jnp.concatenate(states, axis=0) if len(states) > 1 else states[0]
+    # [R, 5, tile_sub, 128] → [B, 5]
     return jnp.transpose(state, (0, 2, 3, 1)).reshape(b, 5)
 
 
@@ -180,19 +221,29 @@ def _auto_interpret() -> bool:
 
 
 def sha1_pieces_pallas(
-    data_u8: jax.Array, nblocks: jax.Array, interpret: bool | None = None
+    data: jax.Array,
+    nblocks: jax.Array,
+    interpret: bool | None = None,
+    tile_sub: int | None = None,
+    unroll: int | None = None,
 ) -> jax.Array:
-    """Batched SHA1 via the Pallas kernel; pads the batch to a TILE multiple.
+    """Batched SHA1 via the Pallas kernel; pads the batch to a tile multiple.
 
-    Rows added by padding get ``nblocks=0`` (their chain never runs) and
-    are sliced off the result.
+    ``data`` is ``uint8[B, padded]`` or host-order ``uint32[B, padded//4]``
+    (fast path — see module docstring). Rows added by padding get
+    ``nblocks=0`` (their chain never runs) and are sliced off the result.
+    ``tile_sub``/``unroll`` default to the env-tunable module constants.
     """
     if interpret is None:
         interpret = _auto_interpret()
-    b = data_u8.shape[0]
-    bp = ((b + TILE - 1) // TILE) * TILE
+    ts = TILE_SUB if tile_sub is None else tile_sub
+    un = UNROLL if unroll is None else unroll
+    _check_tiling(ts, un)
+    tile = ts * TILE_LANE
+    b = data.shape[0]
+    bp = ((b + tile - 1) // tile) * tile
     if bp != b:
-        data_u8 = jnp.pad(data_u8, ((0, bp - b), (0, 0)))
+        data = jnp.pad(data, ((0, bp - b), (0, 0)))
         nblocks = jnp.pad(nblocks, (0, bp - b))
-    out = _sha1_pallas_aligned(data_u8, nblocks, interpret)
+    out = _sha1_pallas_aligned(data, nblocks, interpret, ts, un)
     return out[:b]

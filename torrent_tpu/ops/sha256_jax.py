@@ -51,10 +51,10 @@ def _round(vars8, wt, kc):
     """One SHA-256 round on the 8 working variables."""
     a, b, c, d, e, f, g, h = vars8
     big_s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-    ch = (e & f) ^ (jnp.bitwise_not(e) & g)
+    ch = g ^ (e & (f ^ g))  # mux form: 3 ops vs 4
     temp1 = h + big_s1 + ch + kc + wt
     big_s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-    maj = (a & b) ^ (a & c) ^ (b & c)
+    maj = (a & b) | (c & (a ^ b))  # identical truth table, 4 ops vs 5
     return (temp1 + big_s0 + maj, a, b, c, d + temp1, e, f, g)
 
 

@@ -1,16 +1,18 @@
 """Hand-tiled Pallas TPU SHA-256 kernel — the v2 fast path.
 
 Identical structure to ``ops/sha1_pallas.py`` (see that module for the
-layout rationale): pieces tiled ``TILE_SUB × 128`` per program, input
-pre-swizzled to ``[R, nblk, 16, sub, 128]``, grid ``(R, nblk/unroll)``
-with the chain axis "arbitrary" and the running 8-word state living in
-the revisited output block. Only the compression differs: 64 rounds of
+layout rationale): pieces tiled ``tile_sub × 128`` per program, input
+pre-swizzled to ``[1, nblk, 16, sub, 128]`` slabs, one pallas_call per
+tile row (bounded swizzle temporaries), grid ``(1, nblk/unroll)`` with
+the chain axis "arbitrary" and the running 8-word state living in the
+revisited output block. Only the compression differs: 64 rounds of
 FIPS 180-4 SHA-256 with a 16-entry rolling schedule window.
 
 BEP 52 workloads hit this kernel with two shapes: 16 KiB leaf blocks
 (nblk=9 with padding block) and 64-byte merkle pair messages (nblk=2) —
 both short chains, so ``unroll`` folds to the chain length and every
-piece is one grid step.
+piece is one grid step. Like the SHA1 kernel it accepts ``uint8`` or
+host-order ``uint32`` input (u32 avoids the 4×-widened bitcast fusion).
 """
 
 from __future__ import annotations
@@ -23,7 +25,13 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from torrent_tpu.ops.sha1_pallas import TILE, TILE_LANE, TILE_SUB, UNROLL, _swizzle
+from torrent_tpu.ops.sha1_pallas import (
+    TILE_LANE,
+    TILE_SUB,
+    UNROLL,
+    _check_tiling,
+    _swizzle_tile,
+)
 from torrent_tpu.ops.sha256_jax import _IV256, _K256, _round, _schedule_step
 
 
@@ -54,13 +62,13 @@ def _one_block256(state, w, kc_ref):
     return tuple(s + n for s, n in zip(state, new))
 
 
-def _sha256_kernel(words_ref, nblocks_ref, kc_ref, state_ref, *, unroll: int):
+def _sha256_kernel(words_ref, nblocks_ref, kc_ref, state_ref, *, unroll: int, tile_sub: int):
     k = pl.program_id(1)
 
     @pl.when(k == 0)
     def _init():
         for i, v in enumerate(_IV256):
-            state_ref[0, i] = jnp.full((TILE_SUB, TILE_LANE), v, dtype=jnp.uint32)
+            state_ref[0, i] = jnp.full((tile_sub, TILE_LANE), v, dtype=jnp.uint32)
 
     nblocks = nblocks_ref[0]
 
@@ -79,55 +87,77 @@ def _sha256_kernel(words_ref, nblocks_ref, kc_ref, state_ref, *, unroll: int):
         state_ref[0, i] = state[i]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _sha256_pallas_aligned(data_u8, nblocks, interpret):
-    b, padded = data_u8.shape
-    nblk = padded // 64
-    r = b // TILE
-    unroll = min(UNROLL, nblk)
+@functools.partial(jax.jit, static_argnames=("interpret", "tile_sub", "unroll"))
+def _sha256_pallas_aligned(data, nblocks, interpret, tile_sub, unroll):
+    tile = tile_sub * TILE_LANE
+    b = data.shape[0]
+    if data.dtype == jnp.uint32:
+        data32 = data
+    else:
+        data32 = jax.lax.bitcast_convert_type(
+            data.reshape(b, data.shape[1] // 4, 4), jnp.uint32
+        )
+    nblk = data32.shape[1] // 16
+    unroll = min(unroll, nblk)
     nblk_pad = ((nblk + unroll - 1) // unroll) * unroll
     if nblk_pad != nblk:
-        data_u8 = jnp.pad(data_u8, ((0, 0), (0, (nblk_pad - nblk) * 64)))
+        data32 = jnp.pad(data32, ((0, 0), (0, (nblk_pad - nblk) * 16)))
         nblk = nblk_pad
-    words = _swizzle(data_u8, r, nblk)
-    nb = nblocks.astype(jnp.int32).reshape(r, TILE_SUB, TILE_LANE)
+    nb = nblocks.astype(jnp.int32).reshape(b // tile, tile_sub, TILE_LANE)
     kc = jnp.asarray(np.array(_K256[16:], dtype=np.uint32).reshape(3, 16))
-    state = pl.pallas_call(
-        functools.partial(_sha256_kernel, unroll=unroll),
-        grid=(r, nblk // unroll),
+
+    call = pl.pallas_call(
+        functools.partial(_sha256_kernel, unroll=unroll, tile_sub=tile_sub),
+        grid=(1, nblk // unroll),
         in_specs=[
             pl.BlockSpec(
-                (1, unroll, 16, TILE_SUB, TILE_LANE),
+                (1, unroll, 16, tile_sub, TILE_LANE),
                 lambda i, k: (i, k, 0, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec((1, TILE_SUB, TILE_LANE), lambda i, k: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, tile_sub, TILE_LANE), lambda i, k: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
             pl.BlockSpec((3, 16), lambda i, k: (0, 0), memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec(
-            (1, 8, TILE_SUB, TILE_LANE), lambda i, k: (i, 0, 0, 0), memory_space=pltpu.VMEM
+            (1, 8, tile_sub, TILE_LANE), lambda i, k: (i, 0, 0, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((r, 8, TILE_SUB, TILE_LANE), jnp.uint32),
+        out_shape=jax.ShapeDtypeStruct((1, 8, tile_sub, TILE_LANE), jnp.uint32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(words, nb, kc)
+    )
+
+    states = []
+    for r0 in range(0, b, tile):
+        words = _swizzle_tile(data32[r0 : r0 + tile], nblk, tile_sub)
+        states.append(call(words, nb[r0 // tile : r0 // tile + 1], kc))
+    state = jnp.concatenate(states, axis=0) if len(states) > 1 else states[0]
     return jnp.transpose(state, (0, 2, 3, 1)).reshape(b, 8)
 
 
 def sha256_pieces_pallas(
-    data_u8: jax.Array, nblocks: jax.Array, interpret: bool | None = None
+    data: jax.Array,
+    nblocks: jax.Array,
+    interpret: bool | None = None,
+    tile_sub: int | None = None,
+    unroll: int | None = None,
 ) -> jax.Array:
-    """Batched SHA-256 via Pallas; pads the batch to a TILE multiple."""
+    """Batched SHA-256 via Pallas; pads the batch to a tile multiple."""
     from torrent_tpu.ops.sha1_pallas import _auto_interpret
 
     if interpret is None:
         interpret = _auto_interpret()
-    b = data_u8.shape[0]
-    bp = ((b + TILE - 1) // TILE) * TILE
+    ts = TILE_SUB if tile_sub is None else tile_sub
+    un = UNROLL if unroll is None else unroll
+    _check_tiling(ts, un)
+    tile = ts * TILE_LANE
+    b = data.shape[0]
+    bp = ((b + tile - 1) // tile) * tile
     if bp != b:
-        data_u8 = jnp.pad(data_u8, ((0, bp - b), (0, 0)))
+        data = jnp.pad(data, ((0, bp - b), (0, 0)))
         nblocks = jnp.pad(nblocks, (0, bp - b))
-    out = _sha256_pallas_aligned(data_u8, nblocks, interpret)
+    out = _sha256_pallas_aligned(data, nblocks, interpret, ts, un)
     return out[:b]

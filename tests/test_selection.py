@@ -1,0 +1,203 @@
+"""File-selection / piece-priority tests (no reference counterpart —
+the reference downloads all-or-nothing; SURVEY §8.3's missing scheduler).
+"""
+
+import asyncio
+import hashlib
+
+import numpy as np
+import pytest
+
+from torrent_tpu.codec.bencode import bencode
+from torrent_tpu.codec.metainfo import parse_metainfo
+from torrent_tpu.net import protocol as proto
+from torrent_tpu.session.client import generate_peer_id
+from torrent_tpu.session.torrent import Torrent, TorrentConfig, TorrentState
+from torrent_tpu.storage.storage import MemoryStorage, Storage
+from tests.test_fast import _messages, _mk_fast_peer
+from tests.test_session import run
+
+
+PLEN = 32768
+
+
+def make_multifile_torrent(file_lens, piece_len=PLEN):
+    rng = np.random.default_rng(11)
+    payload = rng.integers(0, 256, sum(file_lens), dtype=np.uint8).tobytes()
+    pieces = b"".join(
+        hashlib.sha1(payload[i : i + piece_len]).digest()
+        for i in range(0, len(payload), piece_len)
+    )
+    data = bencode(
+        {
+            b"announce": b"http://127.0.0.1:1/announce",
+            b"info": {
+                b"name": b"sel",
+                b"piece length": piece_len,
+                b"pieces": pieces,
+                b"files": [
+                    {b"length": n, b"path": [b"f%d.bin" % i]}
+                    for i, n in enumerate(file_lens)
+                ],
+            },
+        }
+    )
+    m = parse_metainfo(data)
+    t = Torrent(
+        metainfo=m,
+        storage=Storage(MemoryStorage(), m.info),
+        peer_id=generate_peer_id(),
+        port=1234,
+        config=TorrentConfig(),
+    )
+    return t, payload
+
+
+class TestPieceMask:
+    def test_file_ranges_and_boundary_pieces(self):
+        async def go():
+            # f0 = 1.5 pieces, f1 = 2 pieces, f2 = tail
+            t, _ = make_multifile_torrent([PLEN + PLEN // 2, 2 * PLEN, PLEN // 4])
+            assert t.file_ranges() == [
+                (0, PLEN + PLEN // 2),
+                (PLEN + PLEN // 2, 2 * PLEN),
+                (3 * PLEN + PLEN // 2, PLEN // 4),
+            ]
+            await t.select_files([1])
+            # piece 1 straddles f0/f1 → wanted; piece 3 straddles f1/f2 → wanted
+            assert t._piece_priority.tolist() == [0, 1, 1, 1]
+            await t.select_files([0])
+            assert t._piece_priority.tolist() == [1, 1, 0, 0]
+            await t.select_files([2])
+            assert t._piece_priority.tolist() == [0, 0, 0, 1]
+
+        run(go())
+
+    def test_left_counts_only_wanted(self):
+        async def go():
+            t, _ = make_multifile_torrent([2 * PLEN, 2 * PLEN - 100])
+            assert t.left == 4 * PLEN - 100
+            await t.select_files([0])
+            assert t.left == 2 * PLEN
+            t.bitfield.set(0)
+            assert t.left == PLEN
+            # short tail only counts when its piece is wanted
+            await t.select_files([1])
+            assert t.left == 2 * PLEN - 100
+
+        run(go())
+
+    def test_bad_index_raises(self):
+        async def go():
+            t, _ = make_multifile_torrent([PLEN, PLEN])
+            with pytest.raises(IndexError):
+                await t.set_file_priorities({7: 1})
+            # select_files validates too: an unknown index must not
+            # silently produce an all-zero selection + instant "complete"
+            with pytest.raises(IndexError):
+                await t.select_files([7])
+            assert t._piece_priority.any()
+
+        run(go())
+
+    def test_priority_out_of_range_raises(self):
+        async def go():
+            t, _ = make_multifile_torrent([PLEN, PLEN])
+            with pytest.raises(ValueError):
+                await t.set_file_priorities({0: 128})  # int8 ceiling
+            with pytest.raises(ValueError):
+                await t.set_file_priorities({0: -1})
+
+        run(go())
+
+    def test_widening_selection_reopens_download(self):
+        async def go():
+            t, _ = make_multifile_torrent([2 * PLEN, 2 * PLEN])
+            await t.select_files([0])
+            t.state = TorrentState.DOWNLOADING
+            t.bitfield.set(0)
+            t.bitfield.set(1)
+            await t._maybe_completed()
+            assert t.state == TorrentState.SEEDING and t.on_complete.is_set()
+            await t.select_files([0, 1])
+            assert t.state == TorrentState.DOWNLOADING
+            assert not t.on_complete.is_set()
+            # finishing the widened selection completes again
+            t.bitfield.set(2)
+            t.bitfield.set(3)
+            await t._maybe_completed()
+            assert t.state == TorrentState.SEEDING and t.on_complete.is_set()
+
+        run(go())
+
+
+class TestSchedulerIntegration:
+    def test_pipeline_requests_only_wanted(self):
+        async def go():
+            t, _ = make_multifile_torrent([2 * PLEN, 2 * PLEN])
+            await t.select_files([1])
+            peer = _mk_fast_peer(t)
+            peer.peer_choking = False
+            peer.bitfield.from_numpy(np.ones(t.info.num_pieces, dtype=bool))
+            await t._fill_pipeline(peer)
+            reqs = {
+                m.index
+                for m in _messages(bytes(peer.writer.data))
+                if isinstance(m, proto.Request)
+            }
+            assert reqs and reqs <= {2, 3}
+
+        run(go())
+
+    def test_priority_orders_rarity(self):
+        async def go():
+            t, _ = make_multifile_torrent([2 * PLEN, 2 * PLEN])
+            await t.set_file_priorities({0: 1, 1: 3})
+            t._rebuild_rarity()
+            # higher-priority file's pieces come first regardless of avail
+            assert set(t._rarity_order[:2]) == {2, 3}
+
+        run(go())
+
+    def test_interest_ignores_unwanted(self):
+        async def go():
+            t, _ = make_multifile_torrent([2 * PLEN, 2 * PLEN])
+            await t.select_files([0])
+            peer = _mk_fast_peer(t)
+            # peer only has the unwanted file's exclusive piece
+            peer.bitfield.set(3)
+            await t._update_interest(peer)
+            assert not peer.am_interested
+            # selection change flips interest on immediately
+            await t.select_files([1])
+            assert peer.am_interested
+
+        run(go())
+
+    def test_completion_on_selection_satisfied(self):
+        async def go():
+            t, payload = make_multifile_torrent([2 * PLEN, 2 * PLEN])
+            await t.select_files([0])
+            t.state = TorrentState.DOWNLOADING
+            t.bitfield.set(0)
+            t.bitfield.set(1)
+            await t._maybe_completed()
+            assert t.state == TorrentState.SEEDING
+            assert t.on_complete.is_set()
+            assert t.left == 0
+
+        run(go())
+
+    def test_default_mask_unchanged_behavior(self):
+        async def go():
+            t, _ = make_multifile_torrent([2 * PLEN, 2 * PLEN])
+            t.state = TorrentState.DOWNLOADING
+            for i in range(3):
+                t.bitfield.set(i)
+            await t._maybe_completed()
+            assert t.state == TorrentState.DOWNLOADING  # piece 3 still missing
+            t.bitfield.set(3)
+            await t._maybe_completed()
+            assert t.state == TorrentState.SEEDING
+
+        run(go())

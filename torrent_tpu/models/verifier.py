@@ -133,8 +133,6 @@ class TPUVerifier:
         # reshape materializes a (4,1)-subtiled intermediate padded 32x —
         # a 16 GiB allocation at 512 KiB pieces. Multi-device meshes keep
         # the sharded 2-D path (dryrun/tests, upload speed irrelevant).
-        b, padded_len = self.batch_size, self.padded_len
-
         # Chunks arrive as host-order u32 (ndarray.view is free and a
         # u8→u32 bitcast on TPU lowers through a 4x-widened convert
         # fusion — the pallas kernel consumes u32 directly). The scan

@@ -155,6 +155,10 @@ class Torrent:
         # ingest O(1)-ish instead of rescanning every peer bitfield.
         self._avail = np.zeros(self.info.num_pieces, dtype=np.int32)
         self._rarity_order: list[int] = []
+        # Per-piece download priority (no reference counterpart — the
+        # reference downloads everything or nothing). 0 = skip, higher =
+        # sooner; derived from per-file priorities via set_file_priorities.
+        self._piece_priority = np.ones(self.info.num_pieces, dtype=np.int8)
         self._rarity_dirty = True
         self._inflight_count: Counter = Counter()
 
@@ -186,16 +190,93 @@ class Torrent:
 
     @property
     def left(self) -> int:
-        # O(1): every piece is piece_length bytes except a possibly-short
-        # last piece — no per-announce scan over 100k-piece bitfields.
+        """Bytes still to download, counting only *wanted* pieces.
+
+        One vectorized pass over the bool masks (a 100k-piece torrent is
+        a 100 KB numpy op — no Python per-piece loop); with everything
+        wanted (the default) this equals the whole-torrent remainder.
+        """
         n = self.info.num_pieces
         if n == 0:
             return 0
-        missing = n - self.bitfield.count()
-        left = missing * self.info.piece_length
-        if not self.bitfield.has(n - 1):
+        missing = (~self.bitfield.as_numpy()) & (self._piece_priority > 0)
+        left = int(missing.sum()) * self.info.piece_length
+        if missing[n - 1]:
             left -= n * self.info.piece_length - self.info.length  # short tail
         return max(0, left)
+
+    # ------------------------------------------------------ file selection
+
+    def file_ranges(self) -> list[tuple[int, int]]:
+        """Per-file ``(global_offset, length)`` spans, single- or multi-file."""
+        if self.info.files is None:
+            return [(0, self.info.length)]
+        out, pos = [], 0
+        for fe in self.info.files:
+            out.append((pos, fe.length))
+            pos += fe.length
+        return out
+
+    async def set_file_priorities(self, priorities: dict[int, int]) -> None:
+        """Per-file download priorities: 0 = skip, higher = sooner.
+
+        A piece overlapping any wanted file stays wanted (boundary pieces
+        take the max priority of the files they touch — skipping them
+        would corrupt the neighbouring wanted file). Files not named keep
+        priority 1. Takes effect immediately: interest and pipelines are
+        re-evaluated for every connected peer.
+        """
+        ranges = self.file_ranges()
+        for idx, p in priorities.items():
+            if not 0 <= idx < len(ranges):
+                raise IndexError(f"no file #{idx} (torrent has {len(ranges)})")
+            if not 0 <= int(p) <= 127:
+                raise ValueError(f"priority {p} for file #{idx}: must be 0..127")
+        plen = self.info.piece_length
+        prio = np.zeros(self.info.num_pieces, dtype=np.int8)
+        for i, (start, length) in enumerate(ranges):
+            p = int(priorities.get(i, 1))
+            if length == 0 or p <= 0:
+                continue
+            first, last = start // plen, (start + length - 1) // plen
+            np.maximum(prio[first : last + 1], p, out=prio[first : last + 1])
+        self._piece_priority = prio
+        self._rarity_dirty = True
+        if (
+            self.state == TorrentState.SEEDING
+            and self._wanted_remaining()
+            and not self._stopping
+        ):
+            # widening a satisfied selection re-opens the download: the
+            # completion latch resets and the webseed loops (which exit
+            # when nothing is wanted) are respawned
+            self.state = TorrentState.DOWNLOADING
+            self.on_complete.clear()
+            for url in self.metainfo.web_seeds:
+                self._spawn(self._webseed_loop(url), name=f"webseed-{url[:24]}")
+        for peer in list(self.peers.values()):
+            try:
+                await self._update_interest(peer)
+            except (ConnectionError, OSError):
+                pass
+        await self._maybe_completed()
+
+    async def select_files(self, wanted: list[int]) -> None:
+        """Download only the named file indices (sugar over priorities)."""
+        ranges = self.file_ranges()
+        want = set(wanted)
+        unknown = want - set(range(len(ranges)))
+        if unknown:
+            raise IndexError(
+                f"no file #{min(unknown)} (torrent has {len(ranges)})"
+            )
+        await self.set_file_priorities(
+            {i: (1 if i in want else 0) for i in range(len(ranges))}
+        )
+
+    def _wanted_remaining(self) -> int:
+        """Count of wanted pieces not yet verified on disk."""
+        return int(((~self.bitfield.as_numpy()) & (self._piece_priority > 0)).sum())
 
     async def start(self) -> None:
         """Resume from checkpoint or recheck existing data, then join."""
@@ -610,7 +691,7 @@ class Torrent:
                     # the full vector interest recheck is reserved for
                     # bitfield replacement and our own piece completions
                     # (where interest can flip off).
-                    if not self.bitfield.has(index):
+                    if not self.bitfield.has(index) and self._piece_priority[index] > 0:
                         if not peer.am_interested:
                             peer.am_interested = True
                             await proto.send_message(peer.writer, proto.Interested())
@@ -742,10 +823,14 @@ class Torrent:
     # ------------------------------------------------------------- leeching
 
     async def _update_interest(self, peer: PeerConnection) -> None:
-        # vectorized: "peer has any piece we're missing" without a Python
-        # scan per have/bitfield message
+        # vectorized: "peer has any wanted piece we're missing" without a
+        # Python scan per have/bitfield message
         want = bool(
-            np.any(peer.bitfield.as_numpy() & ~self.bitfield.as_numpy())
+            np.any(
+                peer.bitfield.as_numpy()
+                & ~self.bitfield.as_numpy()
+                & (self._piece_priority > 0)
+            )
         )
         if want and not peer.am_interested:
             peer.am_interested = True
@@ -758,10 +843,13 @@ class Torrent:
             await self._fill_pipeline(peer)
 
     def _rebuild_rarity(self) -> None:
-        """Missing pieces ordered rarest-first with a stable random tiebreak."""
-        missing = np.flatnonzero(~self.bitfield.as_numpy())
+        """Wanted missing pieces, highest file priority first, then
+        rarest-first with a stable random tiebreak."""
+        missing = np.flatnonzero(
+            (~self.bitfield.as_numpy()) & (self._piece_priority > 0)
+        )
         jitter = np.random.random(len(missing))
-        order = np.lexsort((jitter, self._avail[missing]))
+        order = np.lexsort((jitter, self._avail[missing], -self._piece_priority[missing]))
         self._rarity_order = missing[order].tolist()
         self._rarity_dirty = False
 
@@ -783,7 +871,7 @@ class Torrent:
         While choked, a BEP 6 peer can still be asked for its allowed-fast
         grants — candidate pieces are then restricted to that set.
         """
-        if self.bitfield.complete:
+        if self.bitfield.complete or not self._wanted_remaining():
             return
         choked_fast = peer.peer_choking and peer.fast and bool(peer.allowed_fast_in)
         if peer.peer_choking and not choked_fast:
@@ -855,7 +943,9 @@ class Torrent:
             remaining = [
                 blk
                 for i in self.bitfield.missing()
-                if peer.bitfield.has(i) and pickable(i)
+                if peer.bitfield.has(i)
+                and pickable(i)
+                and self._piece_priority[i] > 0
                 for blk in self._missing_blocks(i)
                 if blk not in peer.inflight
             ]
@@ -973,14 +1063,25 @@ class Torrent:
                 pass
             if p.am_interested:
                 await self._update_interest(p)
-        if self.bitfield.complete:
-            self.state = TorrentState.SEEDING
-            self._endgame = False
-            self._pending_completed = True
-            self._checkpoint()
-            self.on_complete.set()
-            self.request_peers()  # announce `completed` promptly
+        await self._maybe_completed()
         return "ok"
+
+    async def _maybe_completed(self) -> None:
+        """Transition to seeding once every *wanted* piece is on disk.
+
+        With the default everything-wanted mask this is the classic
+        bitfield-complete transition; under file selection the torrent
+        seeds what it has once the selection is satisfied (``left`` is 0,
+        so the tracker gets its BEP 3 ``completed``).
+        """
+        if self.state != TorrentState.DOWNLOADING or self._wanted_remaining():
+            return
+        self.state = TorrentState.SEEDING
+        self._endgame = False
+        self._pending_completed = True
+        self._checkpoint()
+        self.on_complete.set()
+        self.request_peers()  # announce `completed` promptly
 
     def _write_piece(self, base: int, data: bytes) -> None:
         for off in range(0, len(data), BLOCK_SIZE):
@@ -1248,7 +1349,7 @@ class Torrent:
         from torrent_tpu.session.webseed import WebSeedError, fetch_piece
 
         consecutive_failures = 0
-        while not self._stopping and not self.bitfield.complete:
+        while not self._stopping and self._wanted_remaining():
             picked = self._pick_webseed_pieces(self.config.webseed_concurrency)
             if not picked:
                 await asyncio.sleep(1.0)

@@ -5,7 +5,7 @@ One multiplexed entry point over the whole framework::
     torrent-tpu info     FILE.torrent
     torrent-tpu make     PATH TRACKER [-o OUT] [--comment C] [--piece-length N] [--hasher cpu|tpu]
     torrent-tpu verify   FILE.torrent DIR [--hasher cpu|tpu] [--batch N]
-    torrent-tpu download SOURCE DIR [--port P] [--hasher cpu|tpu] [--seed] [--no-resume]
+    torrent-tpu download SOURCE DIR [--port P] [--hasher cpu|tpu] [--seed] [--no-resume] [--files I,J]
     torrent-tpu tracker  [--http-port P] [--udp-port P] [--interval S]
     torrent-tpu bridge   [--port P] [--hasher cpu|tpu]
 
@@ -256,6 +256,14 @@ async def _download(args) -> int:
                 print("error: not a valid .torrent file", file=sys.stderr)
                 return 1
             torrent = await client.add(m, args.dir)
+        if args.files:
+            try:
+                wanted = sorted({int(x) for x in args.files.split(",")})
+                await torrent.select_files(wanted)
+            except (ValueError, IndexError) as e:
+                print(f"error: bad --files selection: {e}", file=sys.stderr)
+                return 1
+            print(f"downloading files {wanted} only", file=sys.stderr)
         print(f"listening on port {client.port}", file=sys.stderr)
 
         async def report():
@@ -400,6 +408,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hasher", choices=("cpu", "tpu"), default="cpu")
     sp.add_argument("--seed", action="store_true", help="keep seeding after completion")
     sp.add_argument("--no-resume", action="store_true", help="skip fastresume checkpoints")
+    sp.add_argument(
+        "--files",
+        metavar="I,J,...",
+        help="download only these file indices (see `info` for the list)",
+    )
     sp.add_argument("--dht", action="store_true", help="enable BEP 5 mainline DHT discovery")
     sp.add_argument(
         "--dht-bootstrap",

@@ -472,10 +472,12 @@ def _device_plane_pps(verifier, plen):
     b = verifier.batch_size
     # All batches stay device-resident during the timed queue; cap the
     # working set so big geometries (4096 × 1 MiB pieces ≈ 4.3 GB/batch)
-    # leave HBM room for the kernel's swizzled copy. On CPU the "device"
-    # is host RAM and the plane/e2e distinction is moot — keep it small.
+    # leave HBM room for the kernel's per-tile swizzle temporaries
+    # (~2 GiB with adaptive tiling — 10 GiB resident + temps fits the
+    # 15.75 GiB chip). On CPU the "device" is host RAM and the plane/e2e
+    # distinction is moot — keep it small.
     batch_bytes = b * verifier.padded_len
-    n_batches = max(2, min(4, (8 << 30) // max(1, batch_bytes)))
+    n_batches = max(2, min(4, (10 << 30) // max(1, batch_bytes)))
     if jax.devices()[0].platform == "cpu":
         n_batches = 2
     rng = np.random.default_rng(1234)

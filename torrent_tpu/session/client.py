@@ -49,10 +49,16 @@ class ClientConfig:
     enable_dht: bool = False  # BEP 5 mainline DHT (net/dht.py)
     dht_port: int = 0  # 0 = ephemeral UDP port
     dht_bootstrap: tuple = ()  # ((host, port), ...) seed nodes
+    # Client-global transfer caps in bytes/s (0 = unlimited): one token
+    # bucket per direction shared by every torrent (utils/ratelimit.py)
+    max_upload_bps: int = 0
+    max_download_bps: int = 0
 
 
 class Client:
     def __init__(self, config: ClientConfig | None = None):
+        from torrent_tpu.utils.ratelimit import TokenBucket
+
         self.config = config or ClientConfig()
         self.torrents: dict[bytes, Torrent] = {}
         self._server: asyncio.AbstractServer | None = None
@@ -60,6 +66,8 @@ class Client:
         self.external_ip: str | None = None
         self.port: int | None = None  # assigned by start()
         self.dht = None  # net.dht.DHTNode when enable_dht
+        self.upload_bucket = TokenBucket(self.config.max_upload_bps)
+        self.download_bucket = TokenBucket(self.config.max_download_bps)
 
     # ------------------------------------------------------------- startup
 
@@ -150,6 +158,9 @@ class Client:
             verifier=self._verifier_for(metainfo.info.piece_length),
             resume_store=resume_store,
             dht=self.dht,
+            upload_bucket=self.upload_bucket,
+            download_bucket=self.download_bucket,
+            external_ip=self.external_ip,
         )
         self.torrents[metainfo.info_hash] = torrent
         await torrent.start()

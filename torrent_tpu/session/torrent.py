@@ -113,6 +113,9 @@ class Torrent:
         verifier=None,  # optional TPUVerifier to share across torrents
         resume_store=None,  # optional session/resume.py store
         dht=None,  # optional net.dht.DHTNode for trackerless discovery
+        upload_bucket=None,  # optional utils/ratelimit.TokenBucket (client-global)
+        download_bucket=None,
+        external_ip=None,  # our public address, for BEP 40 dial ordering
     ):
         from torrent_tpu.net.multitracker import TrackerList, parse_announce_list
 
@@ -125,6 +128,9 @@ class Torrent:
         self.verifier = verifier
         self.resume_store = resume_store
         self.dht = dht
+        self.upload_bucket = upload_bucket
+        self.download_bucket = download_bucket
+        self.external_ip = external_ip
         self.trackers = TrackerList(
             metainfo.announce, parse_announce_list(metainfo.raw)
         )
@@ -159,6 +165,10 @@ class Torrent:
         # reference downloads everything or nothing). 0 = skip, higher =
         # sooner; derived from per-file priorities via set_file_priorities.
         self._piece_priority = np.ones(self.info.num_pieces, dtype=np.int8)
+        # cached count of wanted-but-missing pieces: _fill_pipeline gates
+        # on it per block, so it must be O(1) there (the numpy recount
+        # runs only on selection changes and recheck/resume)
+        self._wanted_missing = self.info.num_pieces
         self._rarity_dirty = True
         self._inflight_count: Counter = Counter()
 
@@ -241,6 +251,7 @@ class Torrent:
             first, last = start // plen, (start + length - 1) // plen
             np.maximum(prio[first : last + 1], p, out=prio[first : last + 1])
         self._piece_priority = prio
+        self._recount_wanted()
         self._rarity_dirty = True
         if (
             self.state == TorrentState.SEEDING
@@ -248,12 +259,15 @@ class Torrent:
             and not self._stopping
         ):
             # widening a satisfied selection re-opens the download: the
-            # completion latch resets and the webseed loops (which exit
-            # when nothing is wanted) are respawned
+            # completion latch resets, the webseed loops (which exit when
+            # nothing is wanted) are respawned, and the announce loop is
+            # woken — a peerless torrent must not sit out a full tracker
+            # interval before discovering anyone to fetch from
             self.state = TorrentState.DOWNLOADING
             self.on_complete.clear()
             for url in self.metainfo.web_seeds:
                 self._spawn(self._webseed_loop(url), name=f"webseed-{url[:24]}")
+            self.request_peers()
         for peer in list(self.peers.values()):
             try:
                 await self._update_interest(peer)
@@ -275,8 +289,13 @@ class Torrent:
         )
 
     def _wanted_remaining(self) -> int:
-        """Count of wanted pieces not yet verified on disk."""
-        return int(((~self.bitfield.as_numpy()) & (self._piece_priority > 0)).sum())
+        """Count of wanted pieces not yet verified on disk (cached)."""
+        return self._wanted_missing
+
+    def _recount_wanted(self) -> None:
+        self._wanted_missing = int(
+            ((~self.bitfield.as_numpy()) & (self._piece_priority > 0)).sum()
+        )
 
     async def start(self) -> None:
         """Resume from checkpoint or recheck existing data, then join."""
@@ -341,6 +360,7 @@ class Torrent:
             ):
                 return False
         self.bitfield = bf
+        self._recount_wanted()
         self._rarity_dirty = True
         self.storage.mark_pieces_written(
             i for i in range(self.info.num_pieces) if bf.has(i)
@@ -393,6 +413,7 @@ class Torrent:
 
     def _apply_recheck(self, ok) -> None:
         self.bitfield.from_numpy(ok)
+        self._recount_wanted()
         self.storage.mark_pieces_written(i for i in range(len(ok)) if ok[i])
         log.info(
             "recheck: %d/%d pieces valid", self.bitfield.count(), self.info.num_pieces
@@ -495,9 +516,23 @@ class Torrent:
     # ------------------------------------------------------------- dialing
 
     def _connect_new_peers(self, candidates) -> None:
-        """Outbound dials, deduped and capped (fixes SURVEY §8.14)."""
+        """Outbound dials, deduped and capped (fixes SURVEY §8.14).
+
+        With a known external address, candidates are dialed in BEP 40
+        canonical-priority order (net/priority.py) — both swarm ends
+        derive the same ranking, converging the neighbor graph.
+        """
         if self.state == TorrentState.SEEDING:
             return  # seeds serve inbound connections; nothing to fetch
+        if self.external_ip:
+            from torrent_tpu.net.priority import peer_priority
+
+            me = (self.external_ip, self.port)
+            candidates = sorted(
+                candidates,
+                key=lambda c: peer_priority(me, (c.ip, c.port)),
+                reverse=True,
+            )
         connected = {p.address for p in self.peers.values() if p.address}
         for cand in candidates:
             if len(self.peers) + len(self._dialing) >= self.config.max_peers:
@@ -980,6 +1015,14 @@ class Torrent:
         peer.last_block_rx = time.monotonic()
         peer.snubbed_until = 0.0  # delivering redeems
         peer.rejects_since_block = 0
+        if self.download_bucket is not None:
+            # pacing inside the peer loop applies TCP backpressure: the
+            # reader stops draining this peer until tokens free up. The
+            # snub clock is stamped before AND after the wait — a peer
+            # that is delivering but queued behind the client-global cap
+            # must not read as snubbed and lose its in-flight requests.
+            await self.download_bucket.take(len(block))
+            peer.last_block_rx = time.monotonic()
         if self.bitfield.has(index):
             return  # duplicate from endgame
         partial = self._partials.get(index)
@@ -1054,6 +1097,8 @@ class Torrent:
             log.error("failed to persist piece %d: %s", partial.index, e)
             return "io_error"
         self.bitfield.set(partial.index)
+        if self._piece_priority[partial.index] > 0:
+            self._wanted_missing = max(0, self._wanted_missing - 1)
         if self.bitfield.count() % 16 == 0:
             self._checkpoint()  # periodic progress checkpoint
         for p in self.peers.values():
@@ -1074,7 +1119,10 @@ class Torrent:
         seeds what it has once the selection is satisfied (``left`` is 0,
         so the tracker gets its BEP 3 ``completed``).
         """
-        if self.state != TorrentState.DOWNLOADING or self._wanted_remaining():
+        if self.state != TorrentState.DOWNLOADING:
+            return
+        self._recount_wanted()  # authoritative at the decision point
+        if self._wanted_missing:
             return
         self.state = TorrentState.SEEDING
         self._endgame = False
@@ -1200,6 +1248,8 @@ class Torrent:
         if not self.bitfield.has(index):
             await refuse()
             return
+        if self.upload_bucket is not None:
+            await self.upload_bucket.take(length)  # client-global upload cap
         try:
             block = await asyncio.to_thread(
                 self.storage.get, index * self.info.piece_length + begin, length

@@ -233,6 +233,8 @@ async def _download(args) -> int:
         resume=not args.no_resume,
         enable_dht=args.dht or bool(bootstrap),
         dht_bootstrap=tuple(bootstrap),
+        max_upload_bps=args.max_up * 1024,
+        max_download_bps=args.max_down * 1024,
     )
     client = Client(config)
     await client.start()
@@ -412,6 +414,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--files",
         metavar="I,J,...",
         help="download only these file indices (see `info` for the list)",
+    )
+    sp.add_argument(
+        "--max-up", type=int, default=0, metavar="KIB_S",
+        help="upload cap in KiB/s (0 = unlimited)",
+    )
+    sp.add_argument(
+        "--max-down", type=int, default=0, metavar="KIB_S",
+        help="download cap in KiB/s (0 = unlimited)",
     )
     sp.add_argument("--dht", action="store_true", help="enable BEP 5 mainline DHT discovery")
     sp.add_argument(

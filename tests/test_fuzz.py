@@ -16,6 +16,8 @@ from torrent_tpu.codec.magnet import MagnetError, parse_magnet
 from torrent_tpu.codec.metainfo import parse_metainfo
 from torrent_tpu.net.extension import decode_extended_handshake, decode_metadata_message
 from torrent_tpu.net.extension import ExtensionState
+from torrent_tpu.net import protocol as proto
+from torrent_tpu.net.priority import peer_priority
 from torrent_tpu.net.protocol import ProtocolError, decode_message
 from torrent_tpu.ops.padding import num_blocks_for, pad_pieces
 from torrent_tpu.storage.piece import piece_length
@@ -62,7 +64,45 @@ class TestBencodeProperties:
                 pass
 
 
+_u32 = st.integers(min_value=0, max_value=2**32 - 1)
+# every wire message type, BEP 3 + BEP 6 + BEP 10, with arbitrary fields
+_any_message = st.one_of(
+    st.just(proto.KeepAlive()),
+    st.just(proto.Choke()),
+    st.just(proto.Unchoke()),
+    st.just(proto.Interested()),
+    st.just(proto.NotInterested()),
+    st.just(proto.HaveAll()),
+    st.just(proto.HaveNone()),
+    st.builds(proto.Have, index=_u32),
+    st.builds(proto.SuggestPiece, index=_u32),
+    st.builds(proto.AllowedFast, index=_u32),
+    st.builds(proto.BitfieldMsg, raw=st.binary(max_size=64)),
+    st.builds(proto.Request, index=_u32, begin=_u32, length=_u32),
+    st.builds(proto.RejectRequest, index=_u32, begin=_u32, length=_u32),
+    st.builds(proto.Cancel, index=_u32, begin=_u32, length=_u32),
+    st.builds(proto.Piece, index=_u32, begin=_u32, block=st.binary(max_size=64)),
+    st.builds(
+        proto.Extended,
+        ext_id=st.integers(min_value=0, max_value=255),
+        payload=st.binary(max_size=64),
+    ),
+)
+
+
 class TestWireDecoderProperties:
+    @given(_any_message)
+    @settings(max_examples=300)
+    def test_encode_decode_roundtrip_all_types(self, msg):
+        """Every message type (incl. the BEP 6 five) survives the wire."""
+        enc = proto.encode_message(msg)
+        if isinstance(msg, proto.KeepAlive):
+            assert enc == b"\x00\x00\x00\x00"
+            return
+        length = int.from_bytes(enc[:4], "big")
+        assert length == len(enc) - 4
+        assert proto.decode_message(enc[4], enc[5:]) == msg
+
     @given(st.integers(min_value=0, max_value=255), st.binary(max_size=64))
     @settings(max_examples=300)
     def test_peer_message_decode_total(self, msg_id, payload):
@@ -72,6 +112,17 @@ class TestWireDecoderProperties:
             decode_message(msg_id, payload)
         except ProtocolError:
             pass
+
+    @given(
+        st.tuples(st.ip_addresses(v=4).map(str), st.integers(0, 65535)),
+        st.tuples(st.ip_addresses(v=4).map(str), st.integers(0, 65535)),
+    )
+    @settings(max_examples=200)
+    def test_peer_priority_symmetric_total(self, a, b):
+        """BEP 40 priority: symmetric, u32-ranged, never raises."""
+        p = peer_priority(a, b)
+        assert p == peer_priority(b, a)
+        assert 0 <= p < 2**32
 
     @given(st.binary(max_size=128))
     @settings(max_examples=200)

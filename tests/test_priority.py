@@ -1,6 +1,5 @@
 """BEP 40 canonical peer priority tests."""
 
-import numpy as np
 
 from torrent_tpu.net.priority import crc32c, peer_priority
 from torrent_tpu.net.types import AnnouncePeer
@@ -42,11 +41,18 @@ class TestPeerPriority:
         assert peer_priority(("1.2.3.4", 1), ("::1", 1)) == 0
         assert peer_priority(("nope", 1), ("1.2.3.4", 1)) == 0
 
-    def test_ipv6_same_host_uses_ports(self):
+    def test_ipv6_full_addresses(self):
         a, b = ("2001:db8::1", 10), ("2001:db8::2", 20)
-        # same /64 prefix → same upper bits → port-based hash path is NOT
-        # taken (different hosts), but the value is symmetric + nonzero
+        # distinct hosts in one /64 hash their FULL addresses — the
+        # ports path is reserved for identical IPs, so same-port peers
+        # in a /64 must NOT collide
         assert peer_priority(a, b) == peer_priority(b, a) != 0
+        assert peer_priority(("2001:db8::1", 5), ("2001:db8::2", 5)) != peer_priority(
+            ("2001:db8::3", 5), ("2001:db8::4", 5)
+        )
+        same_host = peer_priority(("2001:db8::1", 10), ("2001:db8::1", 20))
+        from torrent_tpu.net.priority import crc32c
+        assert same_host == crc32c((10).to_bytes(2, "big") + (20).to_bytes(2, "big"))
 
 
 class TestDialOrdering:
@@ -74,3 +80,25 @@ class TestDialOrdering:
             assert (winner.ip, winner.port) in t._dialing
 
         run(go())
+
+
+class TestBep24ExternalIp:
+    def test_announce_parses_external_ip_forms(self):
+        from torrent_tpu.net.tracker import _parse_http_announce
+        from torrent_tpu.codec.bencode import bencode
+
+        base = {b"interval": 60, b"peers": b""}
+        packed = _parse_http_announce(
+            bencode({**base, b"external ip": bytes([1, 2, 3, 4])})
+        )
+        assert packed.external_ip == "1.2.3.4"
+        text = _parse_http_announce(
+            bencode({**base, b"external ip": b"203.0.113.7"})
+        )
+        assert text.external_ip == "203.0.113.7"
+        v6 = _parse_http_announce(
+            bencode({**base, b"external ip": bytes(range(16))})
+        )
+        assert v6.external_ip is not None and ":" in v6.external_ip
+        junk = _parse_http_announce(bencode({**base, b"external ip": b"xx"}))
+        assert junk.external_ip is None

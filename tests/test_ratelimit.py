@@ -4,8 +4,6 @@ torrent.ts:158-176)."""
 
 import asyncio
 
-import numpy as np
-import pytest
 
 from torrent_tpu.net import protocol as proto
 from torrent_tpu.session.client import Client, ClientConfig

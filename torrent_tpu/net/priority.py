@@ -73,14 +73,19 @@ def peer_priority(a: tuple[str, int], b: tuple[str, int]) -> int:
             mask = 0xFFFF5555
         lo, hi = sorted((ia & mask, ib & mask))
         return crc32c(lo.to_bytes(4, "big") + hi.to_bytes(4, "big"))
-    # IPv6: same scheme over the upper 64 bits, /64 and /48 tiers
-    ia, ib = int(ip_a) >> 64, int(ip_b) >> 64
+    # IPv6: the same scheme over the FULL 128-bit addresses (truncating
+    # would let distinct hosts in one /64 collide into the ports path);
+    # the ports path is reserved for identical addresses, and the masks
+    # blur the host/subnet bits at /64 and /48 distance
+    ia, ib = int(ip_a), int(ip_b)
     if ia == ib:
         lo, hi = sorted((a[1] & 0xFFFF, b[1] & 0xFFFF))
         return crc32c(lo.to_bytes(2, "big") + hi.to_bytes(2, "big"))
-    if ia ^ ib < 1 << 16:  # same /48
-        mask = (1 << 64) - 1
-    else:
-        mask = 0xFFFFFFFFFFFF5555
+    if ia ^ ib < 1 << 64:  # same /64: full addresses
+        mask = (1 << 128) - 1
+    elif ia ^ ib < 1 << 80:  # same /48: keep /64, blur the host bits
+        mask = (((1 << 64) - 1) << 64) | 0x5555555555555555
+    else:  # keep /48, blur the rest
+        mask = (((1 << 48) - 1) << 80) | int("55" * 10, 16)
     lo, hi = sorted((ia & mask, ib & mask))
-    return crc32c(lo.to_bytes(8, "big") + hi.to_bytes(8, "big"))
+    return crc32c(lo.to_bytes(16, "big") + hi.to_bytes(16, "big"))

@@ -207,9 +207,24 @@ def _parse_http_announce(body: bytes) -> AnnounceResponse:
     if isinstance(raw6, bytes):
         peers.extend(_parse_compact_peers6(raw6))
     warning = data.get(b"warning message")
+    # BEP 24: trackers may echo the announcer's address, either as a
+    # 4/16-byte packed value or a text dotted-quad
+    ext = data.get(b"external ip")
+    external_ip = None
+    if isinstance(ext, bytes):
+        import ipaddress
+
+        try:
+            if len(ext) in (4, 16):
+                external_ip = str(ipaddress.ip_address(ext))
+            else:
+                external_ip = str(ipaddress.ip_address(ext.decode("ascii")))
+        except (ValueError, UnicodeDecodeError):
+            pass
     return AnnounceResponse(
         interval=interval,
         peers=peers,
+        external_ip=external_ip,
         complete=data.get(b"complete") if valid.is_int(data.get(b"complete")) else None,
         incomplete=data.get(b"incomplete") if valid.is_int(data.get(b"incomplete")) else None,
         warning=warning.decode("utf-8", "replace") if isinstance(warning, bytes) else None,

@@ -88,6 +88,9 @@ class AnnounceResponse:
     warning: str | None = None
     min_interval: int | None = None
     tracker_id: bytes | None = None
+    # BEP 24: the address the tracker saw us announce from — the session
+    # uses it to learn its public IP for BEP 40 dial ordering
+    external_ip: str | None = None
 
 
 @dataclass(frozen=True)

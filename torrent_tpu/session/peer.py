@@ -40,6 +40,10 @@ class PeerConnection:
     # this counter — the reject/re-request cycle itself keeps resetting
     # the wall-clock snub timer, so time alone can't catch it
     rejects_since_block: int = 0
+    # currently waiting in the client-global download token bucket: the
+    # peer IS delivering, it's just queued behind the cap — the snub
+    # sweep must not read the queue latency as a stall
+    pacing: bool = False
     # BEP 6 suggest-piece hints, most recent FIRST (newest hint wins)
     suggested: list[int] = field(default_factory=list)
 

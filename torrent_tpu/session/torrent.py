@@ -477,6 +477,11 @@ class Torrent:
                 elif event == AnnounceEvent.COMPLETED:
                     self._pending_completed = False
                 interval = max(5, res.interval)
+                if res.external_ip:
+                    # BEP 24: learn our public address from the tracker —
+                    # this is what makes BEP 40 dial ordering live without
+                    # UPnP (the common NAT'd configuration)
+                    self.external_ip = res.external_ip
                 self._connect_new_peers(res.peers)
             except TrackerError as e:
                 log.warning("announce failed: %s", e)
@@ -1018,11 +1023,16 @@ class Torrent:
         if self.download_bucket is not None:
             # pacing inside the peer loop applies TCP backpressure: the
             # reader stops draining this peer until tokens free up. The
-            # snub clock is stamped before AND after the wait — a peer
-            # that is delivering but queued behind the client-global cap
-            # must not read as snubbed and lose its in-flight requests.
-            await self.download_bucket.take(len(block))
-            peer.last_block_rx = time.monotonic()
+            # ``pacing`` flag exempts the peer from the snub sweep for
+            # the whole wait — under a low cap with many peers the FIFO
+            # queue latency alone can exceed snub_timeout, and cancelling
+            # a delivering peer's requests there would churn duplicates.
+            peer.pacing = True
+            try:
+                await self.download_bucket.take(len(block))
+            finally:
+                peer.pacing = False
+                peer.last_block_rx = time.monotonic()
         if self.bitfield.has(index):
             return  # duplicate from endgame
         partial = self._partials.get(index)
@@ -1274,6 +1284,8 @@ class Torrent:
         now = time.monotonic()
         released_any = False
         for p in list(self.peers.values()):  # awaits below; dict may mutate
+            if p.pacing:
+                continue  # queued in the download cap, not stalled
             if p.inflight and now - p.last_block_rx > self.config.snub_timeout:
                 log.debug(
                     "peer %s snubbed: releasing %d in-flight blocks",

@@ -1,0 +1,192 @@
+"""BEP 14 Local Service Discovery tests: wire codec + live loopback
+endpoints + client wiring. (No reference counterpart — the reference's
+only peer source is its tracker.)"""
+
+import asyncio
+
+import pytest
+
+from torrent_tpu.net.lsd import (
+    LocalServiceDiscovery,
+    decode_bt_search,
+    encode_bt_search,
+)
+from tests.test_session import run
+
+IH1 = bytes(range(20))
+IH2 = bytes(range(20, 40))
+
+
+class TestWire:
+    def test_roundtrip(self):
+        pkt = encode_bt_search("239.192.152.143:6771", 6881, [IH1, IH2], "c00kie")
+        assert pkt.startswith(b"BT-SEARCH * HTTP/1.1\r\n")
+        assert pkt.endswith(b"\r\n\r\n\r\n")
+        port, hashes, cookie = decode_bt_search(pkt)
+        assert port == 6881 and hashes == [IH1, IH2] and cookie == "c00kie"
+
+    def test_decode_rejects_garbage(self):
+        assert decode_bt_search(b"\xff\xfe") is None
+        assert decode_bt_search(b"GET / HTTP/1.1\r\n\r\n") is None
+        assert decode_bt_search(b"BT-SEARCH * HTTP/1.1\r\n\r\n") is None  # no port
+        assert (
+            decode_bt_search(
+                b"BT-SEARCH * HTTP/1.1\r\nPort: 0\r\nInfohash: " + b"A" * 40 + b"\r\n"
+            )
+            is None
+        )  # port 0
+        assert (
+            decode_bt_search(b"BT-SEARCH * HTTP/1.1\r\nPort: 6881\r\n") is None
+        )  # no hashes
+
+    def test_decode_skips_bad_hashes_keeps_good(self):
+        pkt = (
+            b"BT-SEARCH * HTTP/1.1\r\nPort: 1\r\n"
+            b"Infohash: nothex\r\nInfohash: " + IH1.hex().upper().encode() + b"\r\n\r\n"
+        )
+        port, hashes, cookie = decode_bt_search(pkt)
+        assert hashes == [IH1] and cookie is None
+
+
+class TestLoopbackEndpoints:
+    def test_two_endpoints_discover_each_other(self):
+        async def go():
+            found_a, found_b = [], []
+            # test mode: plain UDP on loopback; b announces to a's port
+            a = LocalServiceDiscovery(
+                6001, lambda ih, addr: found_a.append((ih, addr)),
+                group="127.0.0.1", port=0, multicast=False,
+            )
+            await a.start()
+            b = LocalServiceDiscovery(
+                6002, lambda ih, addr: found_b.append((ih, addr)),
+                group="127.0.0.1", port=a.port, multicast=False,
+            )
+            # b's socket must bind its own ephemeral port, not a's
+            b_port_req = b.port
+            b.port = 0
+            b.group = "127.0.0.1"
+
+            loop = asyncio.get_running_loop()
+            import socket as _s
+
+            sock = _s.socket(_s.AF_INET, _s.SOCK_DGRAM)
+            sock.bind(("127.0.0.1", 0))
+            from torrent_tpu.net.lsd import _Proto
+
+            b._transport, _ = await loop.create_datagram_endpoint(
+                lambda: _Proto(b), sock=sock
+            )
+            b.port = b_port_req  # where b SENDS (a's port)
+            try:
+                a._hashes.add(IH1)
+                b._hashes.add(IH1)
+                b._send_announce([IH1])  # b -> a's port
+                for _ in range(50):
+                    if found_a:
+                        break
+                    await asyncio.sleep(0.02)
+                assert found_a and found_a[0][0] == IH1
+                # a replied by unicast to b's source address
+                for _ in range(50):
+                    if found_b:
+                        break
+                    await asyncio.sleep(0.02)
+                assert found_b and found_b[0][0] == IH1
+                assert found_b[0][1][1] == 6001  # a's advertised listen port
+            finally:
+                a.close()
+                b.close()
+
+        run(go())
+
+    def test_own_cookie_ignored(self):
+        async def go():
+            found = []
+            a = LocalServiceDiscovery(
+                6001, lambda ih, addr: found.append(ih),
+                group="127.0.0.1", port=0, multicast=False,
+            )
+            await a.start()
+            try:
+                a._hashes.add(IH1)
+                # a datagram carrying a's own cookie must be dropped
+                pkt = encode_bt_search("x", 6001, [IH1], a.cookie)
+                a._on_datagram(pkt, ("127.0.0.1", 9))
+                assert not found
+                # same packet with a foreign cookie is accepted
+                pkt = encode_bt_search("x", 6001, [IH1], "other")
+                a._on_datagram(pkt, ("127.0.0.1", 9))
+                assert found == [IH1]
+            finally:
+                a.close()
+
+        run(go())
+
+    def test_unregistered_hash_ignored_and_reply_throttled(self):
+        async def go():
+            found = []
+            a = LocalServiceDiscovery(
+                6001, lambda ih, addr: found.append(ih),
+                group="127.0.0.1", port=0, multicast=False,
+            )
+            await a.start()
+            try:
+                a._on_datagram(
+                    encode_bt_search("x", 7, [IH2], "other"), ("127.0.0.1", 9)
+                )
+                assert not found  # IH2 not registered
+                a._hashes.add(IH1)
+                sent = []
+                a._send_announce = lambda hs, dest=None: sent.append(dest)
+                pkt = encode_bt_search("x", 7, [IH1], "other")
+                a._on_datagram(pkt, ("127.0.0.1", 9))
+                a._on_datagram(pkt, ("127.0.0.1", 9))
+                assert len(sent) == 1  # second reply throttled per-source
+            finally:
+                a.close()
+
+        run(go())
+
+
+class TestClientWiring:
+    def test_client_lsd_end_to_end_multicast(self):
+        """Real multicast on this host if the kernel allows it; the whole
+        path (register → multicast announce → peer callback) otherwise
+        runs in the loopback tests above."""
+
+        async def go():
+            from torrent_tpu.net.lsd import LSD_GROUP
+
+            import socket as _s
+
+            probe = _s.socket(_s.AF_INET, _s.SOCK_DGRAM)
+            try:
+                probe.setsockopt(
+                    _s.IPPROTO_IP,
+                    _s.IP_ADD_MEMBERSHIP,
+                    _s.inet_aton(LSD_GROUP) + _s.inet_aton("0.0.0.0"),
+                )
+            except OSError:
+                pytest.skip("multicast unavailable in this environment")
+            finally:
+                probe.close()
+
+            found = []
+            a = LocalServiceDiscovery(6001, lambda ih, addr: found.append(ih))
+            b = LocalServiceDiscovery(6002, lambda ih, addr: found.append(ih))
+            await a.start()
+            await b.start()
+            try:
+                a._hashes.add(IH1)
+                b.register(IH1)  # triggers an immediate multicast announce
+                for _ in range(100):
+                    if found:
+                        break
+                    await asyncio.sleep(0.02)
+                assert found and found[0] == IH1
+            finally:
+                a.close()
+                b.close()
+
+        run(go())

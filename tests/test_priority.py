@@ -51,7 +51,6 @@ class TestPeerPriority:
             ("2001:db8::3", 5), ("2001:db8::4", 5)
         )
         same_host = peer_priority(("2001:db8::1", 10), ("2001:db8::1", 20))
-        from torrent_tpu.net.priority import crc32c
         assert same_host == crc32c((10).to_bytes(2, "big") + (20).to_bytes(2, "big"))
 
 
@@ -102,3 +101,6 @@ class TestBep24ExternalIp:
         assert v6.external_ip is not None and ":" in v6.external_ip
         junk = _parse_http_announce(bencode({**base, b"external ip": b"xx"}))
         assert junk.external_ip is None
+        # 4-char TEXT address must parse as text, not as packed bytes
+        short_v6 = _parse_http_announce(bencode({**base, b"external ip": b"1::1"}))
+        assert short_v6.external_ip == "1::1"

@@ -1,0 +1,217 @@
+"""BEP 14 Local Service Discovery — find swarm peers on the local network.
+
+No reference counterpart (the reference's only peer source is its
+tracker, torrent.ts:224-244). LSD multicasts a small HTTP-styled
+``BT-SEARCH`` datagram to 239.192.152.143:6771 announcing
+(info_hash, listen port); every local client in the swarm replies with
+its own announce, so two laptops on one LAN find each other without any
+tracker round-trip — and transfer at LAN speed.
+
+Wire format (from the BEP)::
+
+    BT-SEARCH * HTTP/1.1\r\n
+    Host: 239.192.152.143:6771\r\n
+    Port: 6881\r\n
+    Infohash: <40 hex chars>\r\n
+    cookie: <opaque>\r\n
+    \r\n\r\n
+
+``cookie`` is an opaque per-client token used to drop our own
+multicast echoes. Multiple ``Infohash`` headers may appear in one
+datagram (we both send and accept that form). Private torrents
+(BEP 27) are never announced.
+
+The group/port are constructor parameters so tests can run the whole
+path over plain loopback UDP without multicast routing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import socket
+import time
+
+from torrent_tpu.utils.log import get_logger
+
+log = get_logger("lsd")
+
+LSD_GROUP = "239.192.152.143"
+LSD_PORT = 6771
+ANNOUNCE_INTERVAL = 300.0  # BEP 14 suggests ~5 min
+MAX_INFOHASHES_PER_PACKET = 16
+
+
+def encode_bt_search(host: str, port: int, info_hashes: list[bytes], cookie: str) -> bytes:
+    lines = [
+        "BT-SEARCH * HTTP/1.1",
+        f"Host: {host}",
+        f"Port: {port}",
+    ]
+    lines += [f"Infohash: {ih.hex().upper()}" for ih in info_hashes]
+    lines.append(f"cookie: {cookie}")
+    return ("\r\n".join(lines) + "\r\n\r\n\r\n").encode("ascii")
+
+
+def decode_bt_search(data: bytes) -> tuple[int, list[bytes], str | None] | None:
+    """→ (port, info_hashes, cookie) or None for anything malformed."""
+    try:
+        text = data.decode("ascii", "strict")
+    except UnicodeDecodeError:
+        return None
+    lines = text.split("\r\n")
+    if not lines or not lines[0].startswith("BT-SEARCH"):
+        return None
+    port = None
+    cookie = None
+    hashes: list[bytes] = []
+    for line in lines[1:]:
+        key, _, value = line.partition(":")
+        key = key.strip().lower()
+        value = value.strip()
+        if key == "port":
+            try:
+                port = int(value)
+            except ValueError:
+                return None
+        elif key == "infohash":
+            if len(value) != 40:
+                continue
+            try:
+                hashes.append(bytes.fromhex(value))
+            except ValueError:
+                continue
+        elif key == "cookie":
+            cookie = value
+    if port is None or not 0 < port < 65536 or not hashes:
+        return None
+    return port, hashes[:MAX_INFOHASHES_PER_PACKET], cookie
+
+
+class _Proto(asyncio.DatagramProtocol):
+    def __init__(self, owner: "LocalServiceDiscovery"):
+        self.owner = owner
+
+    def datagram_received(self, data, addr):
+        self.owner._on_datagram(data, addr)
+
+
+class LocalServiceDiscovery:
+    """One multicast endpoint shared by every torrent of a client.
+
+    ``on_peer(info_hash, (ip, port))`` fires for every non-self announce
+    matching a registered torrent. Registered torrents are re-announced
+    every ``interval`` seconds and immediately on registration.
+    """
+
+    def __init__(
+        self,
+        listen_port: int,
+        on_peer,
+        group: str = LSD_GROUP,
+        port: int = LSD_PORT,
+        interval: float = ANNOUNCE_INTERVAL,
+        multicast: bool = True,
+    ):
+        self.listen_port = listen_port
+        self.on_peer = on_peer
+        self.group = group
+        self.port = port
+        self.interval = interval
+        self.multicast = multicast
+        self.cookie = f"tt-{random.getrandbits(48):012x}"
+        self._hashes: set[bytes] = set()
+        self._transport = None
+        self._task: asyncio.Task | None = None
+        # rate-limit unicast replies per source (BEP 14 asks for reply
+        # throttling so a flood of searches can't amplify)
+        self._last_reply: dict[str, float] = {}
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if self.multicast:
+            sock.bind(("", self.port))
+            mreq = socket.inet_aton(self.group) + socket.inet_aton("0.0.0.0")
+            sock.setsockopt(socket.IPPROTO_IP, socket.IP_ADD_MEMBERSHIP, mreq)
+            sock.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_TTL, 1)
+            sock.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_LOOP, 1)
+        else:  # test mode: plain UDP on loopback
+            sock.bind((self.group, self.port))
+            self.port = sock.getsockname()[1]
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _Proto(self), sock=sock
+        )
+        self._task = asyncio.create_task(self._announce_loop())
+
+    def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        if self._transport is not None:
+            self._transport.close()
+
+    # ------------------------------------------------------------ torrents
+
+    def register(self, info_hash: bytes) -> None:
+        self._hashes.add(info_hash)
+        self._send_announce([info_hash])
+
+    def unregister(self, info_hash: bytes) -> None:
+        self._hashes.discard(info_hash)
+
+    # ------------------------------------------------------------ wire
+
+    def _send_announce(self, hashes, dest=None) -> None:
+        if self._transport is None or not hashes:
+            return
+        host = f"{self.group}:{self.port}"
+        for i in range(0, len(hashes), MAX_INFOHASHES_PER_PACKET):
+            pkt = encode_bt_search(
+                host,
+                self.listen_port,
+                list(hashes)[i : i + MAX_INFOHASHES_PER_PACKET],
+                self.cookie,
+            )
+            try:
+                self._transport.sendto(pkt, dest or (self.group, self.port))
+            except OSError as e:
+                log.debug("lsd send failed: %s", e)
+
+    def _on_datagram(self, data, addr) -> None:
+        parsed = decode_bt_search(data)
+        if parsed is None:
+            return
+        port, hashes, cookie = parsed
+        if cookie == self.cookie:
+            return  # our own multicast echo
+        matched = [ih for ih in hashes if ih in self._hashes]
+        for ih in matched:
+            try:
+                self.on_peer(ih, (addr[0], port))
+            except Exception as e:  # callback bugs must not kill the endpoint
+                log.warning("lsd on_peer failed: %s", e)
+        if matched:
+            # unicast our own announce back so the searcher learns us
+            # without waiting for our next multicast round; throttled
+            # per-source against search floods
+            now = time.monotonic()
+            if now - self._last_reply.get(addr[0], 0.0) > 60.0:
+                if len(self._last_reply) > 256:
+                    # bounded: spoofed-source floods must not grow this
+                    # dict for the client's lifetime
+                    self._last_reply = {
+                        ip: t
+                        for ip, t in self._last_reply.items()
+                        if now - t <= 60.0
+                    }
+                self._last_reply[addr[0]] = now
+                # reply to the datagram's source address: LSD senders
+                # bind the shared group port, so this reaches their
+                # endpoint in both multicast and loopback-test modes
+                self._send_announce(matched, dest=addr)
+
+    async def _announce_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval * (0.9 + 0.2 * random.random()))
+            self._send_announce(list(self._hashes))

@@ -18,7 +18,8 @@ can't flood a victim's top slots:
 - same /16           → both masked with 0xFFFFFF55, ascending
 - otherwise          → both masked with 0xFFFF5555, ascending
 
-IPv6 uses the same scheme on the first 8 bytes (/64 and /48 tiers).
+IPv6 uses the same scheme on the full 128-bit addresses, blurring the
+low bits at /64 and /48 distance (ports only for identical IPs).
 """
 
 from __future__ import annotations

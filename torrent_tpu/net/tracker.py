@@ -208,19 +208,23 @@ def _parse_http_announce(body: bytes) -> AnnounceResponse:
         peers.extend(_parse_compact_peers6(raw6))
     warning = data.get(b"warning message")
     # BEP 24: trackers may echo the announcer's address, either as a
-    # 4/16-byte packed value or a text dotted-quad
+    # 4/16-byte packed value or text. Text is tried first — a textual
+    # address of exactly 4 or 16 chars (e.g. "1::1") must not be
+    # misread as packed bytes. The session layer decides whether to
+    # trust the value (net/tracker only parses).
     ext = data.get(b"external ip")
     external_ip = None
     if isinstance(ext, bytes):
         import ipaddress
 
         try:
-            if len(ext) in (4, 16):
-                external_ip = str(ipaddress.ip_address(ext))
-            else:
-                external_ip = str(ipaddress.ip_address(ext.decode("ascii")))
+            external_ip = str(ipaddress.ip_address(ext.decode("ascii")))
         except (ValueError, UnicodeDecodeError):
-            pass
+            if len(ext) in (4, 16):
+                try:
+                    external_ip = str(ipaddress.ip_address(ext))
+                except ValueError:
+                    pass
     return AnnounceResponse(
         interval=interval,
         peers=peers,

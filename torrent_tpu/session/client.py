@@ -53,6 +53,7 @@ class ClientConfig:
     # bucket per direction shared by every torrent (utils/ratelimit.py)
     max_upload_bps: int = 0
     max_download_bps: int = 0
+    enable_lsd: bool = False  # BEP 14 local service discovery (net/lsd.py)
 
 
 class Client:
@@ -68,6 +69,7 @@ class Client:
         self.dht = None  # net.dht.DHTNode when enable_dht
         self.upload_bucket = TokenBucket(self.config.max_upload_bps)
         self.download_bucket = TokenBucket(self.config.max_download_bps)
+        self.lsd = None  # net.lsd.LocalServiceDiscovery when enable_lsd
 
     # ------------------------------------------------------------- startup
 
@@ -94,11 +96,31 @@ class Client:
                 self.external_ip = ips.external_ip
             except Exception as e:  # UPnP is best-effort
                 log.warning("UPnP setup failed: %s", e)
+        if self.config.enable_lsd:
+            try:
+                from torrent_tpu.net.lsd import LocalServiceDiscovery
+
+                self.lsd = LocalServiceDiscovery(self.port, self._on_lsd_peer)
+                await self.lsd.start()
+            except Exception as e:  # multicast may be unavailable
+                log.warning("LSD setup failed: %s", e)
+                self.lsd = None
+
+    def _on_lsd_peer(self, info_hash: bytes, addr: tuple[str, int]) -> None:
+        """BEP 14 callback: a local client announced this swarm."""
+        torrent = self.torrents.get(info_hash)
+        if torrent is not None and not torrent.private:
+            from torrent_tpu.net.types import AnnouncePeer
+
+            torrent._connect_new_peers([AnnouncePeer(ip=addr[0], port=addr[1])])
 
     async def close(self) -> None:
         for torrent in list(self.torrents.values()):
             await torrent.stop()
         self.torrents.clear()
+        if self.lsd is not None:
+            self.lsd.close()
+            self.lsd = None
         if self.dht is not None:
             self.dht.close()
             self.dht = None
@@ -164,6 +186,8 @@ class Client:
         )
         self.torrents[metainfo.info_hash] = torrent
         await torrent.start()
+        if self.lsd is not None and not torrent.private:
+            self.lsd.register(metainfo.info_hash)  # BEP 27: never private
         return torrent
 
     async def add_magnet(
@@ -205,6 +229,8 @@ class Client:
 
     async def remove(self, info_hash: bytes) -> None:
         torrent = self.torrents.pop(info_hash, None)
+        if self.lsd is not None:
+            self.lsd.unregister(info_hash)
         if torrent:
             await torrent.stop()
 

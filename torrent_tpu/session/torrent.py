@@ -480,8 +480,17 @@ class Torrent:
                 if res.external_ip:
                     # BEP 24: learn our public address from the tracker —
                     # this is what makes BEP 40 dial ordering live without
-                    # UPnP (the common NAT'd configuration)
-                    self.external_ip = res.external_ip
+                    # UPnP (the common NAT'd configuration). Only global
+                    # addresses are trusted: dial ordering is a soft
+                    # preference, and a hostile tracker shouldn't get to
+                    # skew it with loopback/multicast/reserved junk.
+                    import ipaddress
+
+                    try:
+                        if ipaddress.ip_address(res.external_ip).is_global:
+                            self.external_ip = res.external_ip
+                    except ValueError:
+                        pass
                 self._connect_new_peers(res.peers)
             except TrackerError as e:
                 log.warning("announce failed: %s", e)

@@ -235,6 +235,7 @@ async def _download(args) -> int:
         dht_bootstrap=tuple(bootstrap),
         max_upload_bps=args.max_up * 1024,
         max_download_bps=args.max_down * 1024,
+        enable_lsd=args.lsd,
     )
     client = Client(config)
     await client.start()
@@ -424,6 +425,9 @@ def build_parser() -> argparse.ArgumentParser:
         help="download cap in KiB/s (0 = unlimited)",
     )
     sp.add_argument("--dht", action="store_true", help="enable BEP 5 mainline DHT discovery")
+    sp.add_argument(
+        "--lsd", action="store_true", help="enable BEP 14 local service discovery"
+    )
     sp.add_argument(
         "--dht-bootstrap",
         action="append",

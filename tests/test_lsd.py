@@ -60,24 +60,9 @@ class TestLoopbackEndpoints:
             await a.start()
             b = LocalServiceDiscovery(
                 6002, lambda ih, addr: found_b.append((ih, addr)),
-                group="127.0.0.1", port=a.port, multicast=False,
+                group="127.0.0.1", port=0, multicast=False, dest_port=a.port,
             )
-            # b's socket must bind its own ephemeral port, not a's
-            b_port_req = b.port
-            b.port = 0
-            b.group = "127.0.0.1"
-
-            loop = asyncio.get_running_loop()
-            import socket as _s
-
-            sock = _s.socket(_s.AF_INET, _s.SOCK_DGRAM)
-            sock.bind(("127.0.0.1", 0))
-            from torrent_tpu.net.lsd import _Proto
-
-            b._transport, _ = await loop.create_datagram_endpoint(
-                lambda: _Proto(b), sock=sock
-            )
-            b.port = b_port_req  # where b SENDS (a's port)
+            await b.start()
             try:
                 a._hashes.add(IH1)
                 b._hashes.add(IH1)
@@ -97,6 +82,30 @@ class TestLoopbackEndpoints:
             finally:
                 a.close()
                 b.close()
+
+        run(go())
+
+    def test_off_lan_source_dropped(self):
+        """A unicast BT-SEARCH from a public source must be ignored: the
+        wildcard-bound port is internet-reachable and would otherwise
+        reflect TCP dials at an attacker-chosen address."""
+
+        async def go():
+            found = []
+            a = LocalServiceDiscovery(
+                6001, lambda ih, addr: found.append(ih),
+                group="127.0.0.1", port=0, multicast=False,
+            )
+            await a.start()
+            try:
+                a._hashes.add(IH1)
+                pkt = encode_bt_search("x", 6881, [IH1], "other")
+                a._on_datagram(pkt, ("8.8.8.8", 6771))  # public source
+                assert not found
+                a._on_datagram(pkt, ("192.168.1.9", 6771))  # private source
+                assert found == [IH1]
+            finally:
+                a.close()
 
         run(go())
 

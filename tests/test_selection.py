@@ -201,3 +201,30 @@ class TestSchedulerIntegration:
             assert t.state == TorrentState.SEEDING
 
         run(go())
+
+
+class TestSequentialMode:
+    def test_sequential_orders_by_index(self):
+        async def go():
+            t, _ = make_multifile_torrent([4 * PLEN])
+            t.config.sequential = True
+            t._avail[:] = [1, 9, 9, 1]  # rarity says 0 and 3 first
+            t._rebuild_rarity()
+            assert t._rarity_order == [0, 1, 2, 3]
+            # priorities still outrank the sequential order
+            await t.set_file_priorities({0: 1})
+            t.bitfield.set(0)
+            t._piece_priority[3] = 5
+            t._rebuild_rarity()
+            assert t._rarity_order == [3, 1, 2]
+
+        run(go())
+
+    def test_rarest_first_default(self):
+        async def go():
+            t, _ = make_multifile_torrent([4 * PLEN])
+            t._avail[:] = [9, 1, 9, 1]
+            t._rebuild_rarity()
+            assert set(t._rarity_order[:2]) == {1, 3}
+
+        run(go())

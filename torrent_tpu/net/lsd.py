@@ -112,11 +112,15 @@ class LocalServiceDiscovery:
         port: int = LSD_PORT,
         interval: float = ANNOUNCE_INTERVAL,
         multicast: bool = True,
+        dest_port: int | None = None,
     ):
         self.listen_port = listen_port
         self.on_peer = on_peer
         self.group = group
-        self.port = port
+        self.port = port  # bind port (updated to the real one by start())
+        # where announces are sent; in multicast mode the group port,
+        # in loopback test mode the peer endpoint's bind port
+        self.dest_port = port if dest_port is None else dest_port
         self.interval = interval
         self.multicast = multicast
         self.cookie = f"tt-{random.getrandbits(48):012x}"
@@ -130,16 +134,20 @@ class LocalServiceDiscovery:
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
         sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        if self.multicast:
-            sock.bind(("", self.port))
-            mreq = socket.inet_aton(self.group) + socket.inet_aton("0.0.0.0")
-            sock.setsockopt(socket.IPPROTO_IP, socket.IP_ADD_MEMBERSHIP, mreq)
-            sock.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_TTL, 1)
-            sock.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_LOOP, 1)
-        else:  # test mode: plain UDP on loopback
-            sock.bind((self.group, self.port))
-            self.port = sock.getsockname()[1]
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            if self.multicast:
+                sock.bind(("", self.port))
+                mreq = socket.inet_aton(self.group) + socket.inet_aton("0.0.0.0")
+                sock.setsockopt(socket.IPPROTO_IP, socket.IP_ADD_MEMBERSHIP, mreq)
+                sock.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_TTL, 1)
+                sock.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_LOOP, 1)
+            else:  # test mode: plain UDP on loopback
+                sock.bind((self.group, self.port))
+                self.port = sock.getsockname()[1]
+        except OSError:
+            sock.close()  # no fd leak on hosts without multicast
+            raise
         self._transport, _ = await loop.create_datagram_endpoint(
             lambda: _Proto(self), sock=sock
         )
@@ -165,7 +173,7 @@ class LocalServiceDiscovery:
     def _send_announce(self, hashes, dest=None) -> None:
         if self._transport is None or not hashes:
             return
-        host = f"{self.group}:{self.port}"
+        host = f"{self.group}:{self.dest_port}"
         for i in range(0, len(hashes), MAX_INFOHASHES_PER_PACKET):
             pkt = encode_bt_search(
                 host,
@@ -174,11 +182,23 @@ class LocalServiceDiscovery:
                 self.cookie,
             )
             try:
-                self._transport.sendto(pkt, dest or (self.group, self.port))
+                self._transport.sendto(pkt, dest or (self.group, self.dest_port))
             except OSError as e:
                 log.debug("lsd send failed: %s", e)
 
     def _on_datagram(self, data, addr) -> None:
+        # LSD is a LOCAL discovery protocol, but the wildcard-bound UDP
+        # port is reachable by plain unicast from anywhere: off-LAN
+        # sources must be dropped, or a spoofed BT-SEARCH turns every
+        # listener into a TCP-dial reflector against an arbitrary victim.
+        try:
+            import ipaddress
+
+            src = ipaddress.ip_address(addr[0])
+            if not (src.is_private or src.is_link_local or src.is_loopback):
+                return
+        except ValueError:
+            return
         parsed = decode_bt_search(data)
         if parsed is None:
             return
@@ -196,7 +216,10 @@ class LocalServiceDiscovery:
             # without waiting for our next multicast round; throttled
             # per-source against search floods
             now = time.monotonic()
-            if now - self._last_reply.get(addr[0], 0.0) > 60.0:
+            # membership test, not a 0.0 default: monotonic's epoch is
+            # arbitrary (seconds-since-boot on Linux), and a 0.0 sentinel
+            # would mute every first reply for the first minute of uptime
+            if addr[0] not in self._last_reply or now - self._last_reply[addr[0]] > 60.0:
                 if len(self._last_reply) > 256:
                     # bounded: spoofed-source floods must not grow this
                     # dict for the client's lifetime

@@ -98,6 +98,9 @@ class TorrentConfig:
     dht_interval: float = 300.0  # DHT announce/lookup cadence
     pex_interval: float = 60.0  # BEP 11 peer-exchange cadence
     webseed_retry: float = 15.0  # backoff after a webseed failure
+    # In-order piece picking for streaming/preview playback (rarest-first
+    # otherwise; file priorities still outrank the order either way)
+    sequential: bool = False
     webseed_concurrency: int = 2  # parallel piece fetches per webseed
     webseed_max_failures: int = 5  # consecutive bad pieces → URL disabled
 
@@ -893,12 +896,19 @@ class Torrent:
 
     def _rebuild_rarity(self) -> None:
         """Wanted missing pieces, highest file priority first, then
-        rarest-first with a stable random tiebreak."""
+        rarest-first with a stable random tiebreak — or in index order
+        when ``config.sequential`` (streaming playback wants the front
+        of the file, not the globally rarest piece)."""
         missing = np.flatnonzero(
             (~self.bitfield.as_numpy()) & (self._piece_priority > 0)
         )
-        jitter = np.random.random(len(missing))
-        order = np.lexsort((jitter, self._avail[missing], -self._piece_priority[missing]))
+        if self.config.sequential:
+            order = np.lexsort((missing, -self._piece_priority[missing]))
+        else:
+            jitter = np.random.random(len(missing))
+            order = np.lexsort(
+                (jitter, self._avail[missing], -self._piece_priority[missing])
+            )
         self._rarity_order = missing[order].tolist()
         self._rarity_dirty = False
 

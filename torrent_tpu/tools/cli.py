@@ -237,6 +237,8 @@ async def _download(args) -> int:
         max_download_bps=args.max_down * 1024,
         enable_lsd=args.lsd,
     )
+    if args.sequential:
+        config.torrent.sequential = True
     client = Client(config)
     await client.start()
     stop = asyncio.Event()
@@ -427,6 +429,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--dht", action="store_true", help="enable BEP 5 mainline DHT discovery")
     sp.add_argument(
         "--lsd", action="store_true", help="enable BEP 14 local service discovery"
+    )
+    sp.add_argument(
+        "--sequential",
+        action="store_true",
+        help="download pieces in order (streaming) instead of rarest-first",
     )
     sp.add_argument(
         "--dht-bootstrap",

@@ -318,9 +318,9 @@ class TPUVerifier:
             return padded, nblocks, expected, k
 
         # Three overlapped stages: disk reads (loader thread) ahead of
-        # uploads (chunked concurrent puts, which block) ahead of device
-        # compute (async dispatch — the device chews batch i while the
-        # host uploads batch i+1; results drain through a 2-deep queue).
+        # uploads (chunked concurrent puts) ahead of device compute
+        # (async dispatch). The async window is ONE batch — see the
+        # drain loop below for why it must not be widened.
         flat_path = self.mesh.size == 1
         inflight: deque = deque()
 
@@ -347,7 +347,14 @@ class TPUVerifier:
                         chunks = self._put_flat(padded)
                         ok_dev = self._verify_step_flat(chunks, nblocks, expected)
                         inflight.append((start, k, ok_dev))
-                        while len(inflight) > 2:
+                        # Window of 1: upload/compute of batch i+1 overlap
+                        # the result fetch of batch i, nothing more. On
+                        # remote-relay backends block_until_ready/asarray
+                        # provide the ONLY real backpressure, and a wider
+                        # window lets the client queue unbounded upload
+                        # copies in host RAM (a 100 GiB recheck ate 123 GB
+                        # before being stopped).
+                        while len(inflight) > 1:
                             drain_one()
                     else:
                         ok = self.verify_batch(padded, nblocks, expected)

@@ -38,6 +38,10 @@ log = get_logger("lsd")
 
 LSD_GROUP = "239.192.152.143"
 LSD_PORT = 6771
+
+import ipaddress as _ipaddress
+
+_CGNAT = _ipaddress.ip_network("100.64.0.0/10")  # RFC 6598 carrier-grade NAT
 ANNOUNCE_INTERVAL = 300.0  # BEP 14 suggests ~5 min
 MAX_INFOHASHES_PER_PACKET = 16
 
@@ -113,7 +117,9 @@ class LocalServiceDiscovery:
         interval: float = ANNOUNCE_INTERVAL,
         multicast: bool = True,
         dest_port: int | None = None,
+        allow_global: bool = False,
     ):
+        self.allow_global = allow_global
         self.listen_port = listen_port
         self.on_peer = on_peer
         self.group = group
@@ -128,8 +134,10 @@ class LocalServiceDiscovery:
         self._transport = None
         self._task: asyncio.Task | None = None
         # rate-limit unicast replies per source (BEP 14 asks for reply
-        # throttling so a flood of searches can't amplify)
+        # throttling so a flood of searches can't amplify), plus a global
+        # replies/s ceiling that bounds both amplification and the dict
         self._last_reply: dict[str, float] = {}
+        self._last_reply_any: float = -1e9
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -191,11 +199,22 @@ class LocalServiceDiscovery:
         # port is reachable by plain unicast from anywhere: off-LAN
         # sources must be dropped, or a spoofed BT-SEARCH turns every
         # listener into a TCP-dial reflector against an arbitrary victim.
+        # Accepted: RFC1918/link-local/loopback plus CGNAT (100.64/10).
+        # LANs numbered with globally-routable addresses need
+        # ``allow_global=True`` (the kernel gives us no way to tell a
+        # TTL-1 multicast arrival from internet unicast here, so the
+        # default stays closed).
         try:
             import ipaddress
 
             src = ipaddress.ip_address(addr[0])
-            if not (src.is_private or src.is_link_local or src.is_loopback):
+            local = (
+                src.is_private
+                or src.is_link_local
+                or src.is_loopback
+                or (src.version == 4 and src in _CGNAT)
+            )
+            if not local and not self.allow_global:
                 return
         except ValueError:
             return
@@ -219,10 +238,16 @@ class LocalServiceDiscovery:
             # membership test, not a 0.0 default: monotonic's epoch is
             # arbitrary (seconds-since-boot on Linux), and a 0.0 sentinel
             # would mute every first reply for the first minute of uptime
-            if addr[0] not in self._last_reply or now - self._last_reply[addr[0]] > 60.0:
+            if (
+                addr[0] not in self._last_reply
+                or now - self._last_reply[addr[0]] > 60.0
+            ) and now - self._last_reply_any >= 0.5:
+                # the global 2-replies/s ceiling both kills reflection
+                # amplification toward spoofed victims and hard-bounds
+                # the per-source dict (<=120 inserts/min regardless of
+                # how many spoofed sources a flood uses)
+                self._last_reply_any = now
                 if len(self._last_reply) > 256:
-                    # bounded: spoofed-source floods must not grow this
-                    # dict for the client's lifetime
                     self._last_reply = {
                         ip: t
                         for ip, t in self._last_reply.items()

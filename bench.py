@@ -32,9 +32,13 @@ up to BENCH_TPU_WAIT seconds, and on timeout emits an explicit
 exit cleanly on its own. An explicit BENCH_PLATFORM (e.g. ``cpu``) runs
 inline with no child.
 
-Env knobs: BENCH_TOTAL_MB (default 1024), BENCH_BATCH (default 4096),
-BENCH_BACKEND (jax|pallas, default best available), BENCH_PLATFORM,
-BENCH_TPU_WAIT (default 1500 s), BENCH_PIECE_KB (default 256).
+Env knobs: BENCH_TOTAL_MB (default 1024), BENCH_BATCH (default:
+auto-sized to ~2 GiB of staging per dispatch — 8192 rows at 256 KiB
+pieces, halving as pieces grow; dispatch size dominates throughput on
+this image, see BASELINE.md), BENCH_BACKEND (jax|pallas, default best
+available), BENCH_PLATFORM, BENCH_TPU_WAIT (default 1500 s),
+BENCH_PIECE_KB (default 256), BENCH_E2E_MB (cap the transfer-bound
+e2e pass of huge configs; plane + baseline stay full-scale).
 
 BENCH_CONFIG selects the measured workload (BASELINE.md configs; every
 mode prints one JSON line):
@@ -78,11 +82,12 @@ def _env_geometry():
     else:
         # auto-size to ~2 GiB of staging per dispatch (the measured-best
         # dispatch size at 256 KiB; bigger pieces scale the batch down so
-        # an author batch of 1 MiB pieces doesn't allocate 8.6 GB rows)
-        from torrent_tpu.ops.padding import padded_len_for
-
+        # an author batch of 1 MiB pieces doesn't allocate 8.6 GB rows).
+        # padded_len_for inlined: the wedge-safe relay parent runs this
+        # and must stay jax-free.
+        padded = (((plen + 8) // 64 + 1) * 64 + 127) // 128 * 128
         batch = 1024
-        while batch < 8192 and 2 * batch * padded_len_for(plen) <= (2 << 30) + (1 << 28):
+        while batch < 8192 and 2 * batch * padded <= (2 << 30) + (1 << 28):
             batch *= 2
     return total_mb, batch, config, plen
 
@@ -429,17 +434,21 @@ def _prepare(total_mb: int, config: str, plen: int):
         info = InfoDict(
             name="bench", piece_length=plen, pieces=tuple(digests), length=total, files=None
         )
+    storage = _build_storage(vp, info)
+    return vp, storage, info, digests, cpu_pps
+
+
+def _build_storage(vp: _VirtualPayload, info):
+    """Storage over the virtual payload, with per-file global offsets."""
+    from torrent_tpu.storage.storage import Storage
+
     starts = {}
     if info.files is not None:
         pos = 0
         for fe in info.files:
             starts[(info.name, *fe.path)] = pos
             pos += fe.length
-
-    from torrent_tpu.storage.storage import Storage
-
-    storage = Storage(_PayloadMethod(vp, starts), info)
-    return vp, storage, info, digests, cpu_pps
+    return Storage(_PayloadMethod(vp, starts), info)
 
 
 def _probe_h2d() -> float:
@@ -619,7 +628,6 @@ def _execute(backend, vp, storage, info, digests, cpu_pps, batch, config, plen, 
     e2e_pieces = min(n_pieces, max(1, e2e_mb * (1 << 20) // plen))
     if e2e_pieces < n_pieces:
         from torrent_tpu.codec.metainfo import FileEntry, InfoDict
-        from torrent_tpu.storage.storage import Storage
 
         e2e_len = e2e_pieces * plen
         sub_files = None
@@ -640,13 +648,7 @@ def _execute(backend, vp, storage, info, digests, cpu_pps, batch, config, plen, 
             length=e2e_len,
             files=sub_files,
         )
-        starts = {}
-        if sub_files is not None:
-            pos = 0
-            for fe in sub_files:
-                starts[(sub_info.name, *fe.path)] = pos
-                pos += fe.length
-        e2e_storage = Storage(_PayloadMethod(vp, starts), sub_info)
+        e2e_storage = _build_storage(vp, sub_info)
     else:
         e2e_pieces = n_pieces
         sub_info, e2e_storage = info, storage

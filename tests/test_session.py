@@ -890,3 +890,19 @@ class TestConfigIsolationAndRaces:
         padded[:] = 0xFF  # hostile reuse: clobber the staging buffer NOW
         ok = np.asarray(v._verify_step_flat(chunks, nblocks, expected))
         assert ok.all(), "in-flight batch was corrupted by staging-buffer reuse"
+
+
+class TestClientStatus:
+    def test_aggregate_status(self):
+        async def go():
+            c = Client(ClientConfig(port=0, enable_upnp=False, max_upload_bps=1000))
+            await c.start()
+            try:
+                s = c.status()
+                assert s["port"] == c.port and s["peers"] == 0
+                assert s["upload_cap_bps"] == 1000 and s["download_cap_bps"] == 0
+                assert s["torrents"] == {} and not s["dht"] and not s["lsd"]
+            finally:
+                await c.close()
+
+        run(go())

@@ -205,9 +205,7 @@ class LocalServiceDiscovery:
         # TTL-1 multicast arrival from internet unicast here, so the
         # default stays closed).
         try:
-            import ipaddress
-
-            src = ipaddress.ip_address(addr[0])
+            src = _ipaddress.ip_address(addr[0])
             local = (
                 src.is_private
                 or src.is_link_local

@@ -227,6 +227,26 @@ class Client:
             )
         return torrent
 
+    def status(self) -> dict:
+        """Aggregate client observability: per-torrent status plus
+        session-wide totals (SURVEY §5 'metrics' — the reference has no
+        counters beyond never-updated announce fields, torrent.ts:66-69)."""
+        torrents = {
+            t.metainfo.info_hash.hex(): t.status() for t in self.torrents.values()
+        }
+        return {
+            "port": self.port,
+            "external_ip": self.external_ip,
+            "dht": self.dht is not None,
+            "lsd": self.lsd is not None,
+            "peers": sum(len(t.peers) for t in self.torrents.values()),
+            "downloaded": sum(t.downloaded for t in self.torrents.values()),
+            "uploaded": sum(t.uploaded for t in self.torrents.values()),
+            "upload_cap_bps": self.upload_bucket.rate,
+            "download_cap_bps": self.download_bucket.rate,
+            "torrents": torrents,
+        }
+
     async def remove(self, info_hash: bytes) -> None:
         torrent = self.torrents.pop(info_hash, None)
         if self.lsd is not None:

@@ -1526,4 +1526,9 @@ class Torrent:
             "uploaded": self.uploaded,
             "left": self.left,
             "endgame": self._endgame,
+            "wanted_left": self._wanted_missing,
+            "sequential": self.config.sequential,
+            "download_rate": round(
+                sum(p.download_rate() for p in self.peers.values()), 1
+            ),
         }

@@ -1,0 +1,371 @@
+"""uTP (BEP 29) transport tests: packet codec, live loopback streams,
+loss/reordering recovery, connection lifecycle. (No reference
+counterpart — the reference is TCP-only.)"""
+
+import asyncio
+import random
+
+import pytest
+
+from torrent_tpu.net import utp
+from tests.test_session import run
+
+
+class TestCodec:
+    def test_roundtrip(self):
+        pkt = utp.encode_packet(
+            utp.ST_DATA, 0xBEEF, 123, 456, ts=7, ts_diff=9, wnd=1 << 16, payload=b"hi"
+        )
+        ptype, cid, ts, diff, wnd, seq, ack, payload = utp.decode_packet(pkt)
+        assert (ptype, cid, ts, diff, wnd, seq, ack, payload) == (
+            utp.ST_DATA, 0xBEEF, 7, 9, 1 << 16, 123, 456, b"hi",
+        )
+
+    def test_decode_rejects_garbage(self):
+        assert utp.decode_packet(b"") is None
+        assert utp.decode_packet(b"\x00" * 10) is None  # short
+        bad_ver = bytearray(utp.encode_packet(utp.ST_DATA, 1, 1, 1))
+        bad_ver[0] = (utp.ST_DATA << 4) | 7
+        assert utp.decode_packet(bytes(bad_ver)) is None
+        bad_type = bytearray(utp.encode_packet(utp.ST_DATA, 1, 1, 1))
+        bad_type[0] = (9 << 4) | utp.VERSION
+        assert utp.decode_packet(bytes(bad_type)) is None
+
+    def test_seq_lt_wraps(self):
+        assert utp._seq_lt(0xFFFE, 2)
+        assert not utp._seq_lt(2, 0xFFFE)
+        assert not utp._seq_lt(5, 5)
+
+
+async def _echo_pair():
+    """Acceptor echoes everything it reads back to the sender."""
+
+    async def echo(reader, writer):
+        while True:
+            data = await reader.read(65536)
+            if not data:
+                break
+            writer.write(data)
+            await writer.drain()
+        writer.close()
+
+    server = await utp.create_utp_endpoint("127.0.0.1", 0, on_accept=echo)
+    return server
+
+
+class TestLoopback:
+    def test_small_roundtrip(self):
+        async def go():
+            server = await _echo_pair()
+            try:
+                reader, writer = await utp.open_utp_connection(
+                    "127.0.0.1", server.port, timeout=5
+                )
+                writer.write(b"hello utp")
+                await writer.drain()
+                got = await asyncio.wait_for(reader.readexactly(9), 5)
+                assert got == b"hello utp"
+                writer.close()
+            finally:
+                server.close()
+
+        run(go())
+
+    def test_large_transfer_multi_packet(self):
+        async def go():
+            server = await _echo_pair()
+            try:
+                reader, writer = await utp.open_utp_connection(
+                    "127.0.0.1", server.port, timeout=5
+                )
+                payload = random.Random(7).randbytes(512 * 1024)
+                writer.write(payload)
+                await writer.drain()
+                got = await asyncio.wait_for(reader.readexactly(len(payload)), 30)
+                assert got == payload
+                writer.close()
+            finally:
+                server.close()
+
+        run(go())
+
+    def test_dial_refused_when_no_acceptor(self):
+        async def go():
+            server = await utp.create_utp_endpoint("127.0.0.1", 0, on_accept=None)
+            try:
+                with pytest.raises((ConnectionError, OSError)):
+                    await utp.open_utp_connection("127.0.0.1", server.port, timeout=5)
+            finally:
+                server.close()
+
+        run(go())
+
+    def test_fin_gives_reader_eof(self):
+        async def go():
+            done = asyncio.Event()
+            got = bytearray()
+
+            async def consume(reader, writer):
+                while True:
+                    data = await reader.read(65536)
+                    if not data:
+                        break
+                    got.extend(data)
+                done.set()
+
+            server = await utp.create_utp_endpoint("127.0.0.1", 0, on_accept=consume)
+            try:
+                reader, writer = await utp.open_utp_connection(
+                    "127.0.0.1", server.port, timeout=5
+                )
+                writer.write(b"x" * 5000)
+                await writer.drain()
+                writer.close()  # flush + FIN
+                await asyncio.wait_for(done.wait(), 10)
+                assert bytes(got) == b"x" * 5000
+            finally:
+                server.close()
+
+        run(go())
+
+
+class _LossyEndpoint(utp.UtpEndpoint):
+    """Deterministically drops a fraction of outgoing packets (never the
+    handshake) to force the retransmit machinery to do the work."""
+
+    def __init__(self, *a, drop_every=4, **kw):
+        super().__init__(*a, **kw)
+        self._n = 0
+        self._drop_every = drop_every
+
+    def sendto(self, data, addr):
+        parsed = utp.decode_packet(data)
+        self._n += 1
+        if (
+            parsed is not None
+            and parsed[0] == utp.ST_DATA
+            and self._n % self._drop_every == 0
+        ):
+            return  # dropped on the floor
+        super().sendto(data, addr)
+
+
+class TestLossRecovery:
+    def test_transfer_survives_25pct_data_loss(self):
+        async def go():
+            received = bytearray()
+            done = asyncio.Event()
+            total = 256 * 1024
+
+            async def consume(reader, writer):
+                while len(received) < total:
+                    data = await reader.read(65536)
+                    if not data:
+                        break
+                    received.extend(data)
+                done.set()
+
+            loop = asyncio.get_running_loop()
+            _, server = await loop.create_datagram_endpoint(
+                lambda: utp.UtpEndpoint(consume), local_addr=("127.0.0.1", 0)
+            )
+            _, client = await loop.create_datagram_endpoint(
+                lambda: _LossyEndpoint(drop_every=4), local_addr=("127.0.0.1", 0)
+            )
+            try:
+                reader, writer = await client.dial("127.0.0.1", server.port, timeout=5)
+                payload = random.Random(3).randbytes(total)
+                writer.write(payload)
+                await writer.drain()
+                await asyncio.wait_for(done.wait(), 60)
+                assert bytes(received) == payload
+            finally:
+                client.close()
+                server.close()
+
+        run(go())
+
+    def test_reordering_reassembles(self):
+        async def go():
+            # feed a connection three out-of-order DATA packets directly
+            class _Sink:
+                def sendto(self, data, addr):
+                    pass
+
+                def _forget(self, conn):
+                    pass
+
+            conn = utp.UtpConnection(_Sink(), ("1.2.3.4", 1), 10, 11)
+            conn.connected.set()
+            conn.ack_nr = 100
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 103, 0, b"c")
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 102, 0, b"b")
+            assert conn.reader._buffer == bytearray()  # hole at 101
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 101, 0, b"a")
+            assert bytes(conn.reader._buffer) == b"abc"
+            assert conn.ack_nr == 103
+
+        run(go())
+
+    def test_max_retransmits_kills_connection(self):
+        async def go():
+            class _Blackhole:
+                def sendto(self, data, addr):
+                    pass
+
+                def _forget(self, conn):
+                    pass
+
+            conn = utp.UtpConnection(_Blackhole(), ("1.2.3.4", 1), 10, 11)
+            conn.connected.set()
+            conn.rto = 0.01
+            await conn.send(b"doomed")
+            for _ in range(400):
+                if conn.closed:
+                    break
+                await asyncio.sleep(0.02)
+            assert conn.closed and conn._reset
+
+        run(go())
+
+
+class TestSwarmOverUtp:
+    def test_full_transfer_over_utp(self, tmp_path):
+        """Real two-client swarm where the peer connection itself runs
+        over uTP (BitTorrent handshake + all messages through the
+        reliable-UDP stream), verified by the writer types."""
+        import hashlib
+        import os
+
+        import numpy as np
+
+        from torrent_tpu.codec.bencode import bencode
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.net.utp import _UtpWriter
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            plen = 32768
+            payload = np.random.default_rng(21).integers(
+                0, 256, 5 * plen + 77, dtype=np.uint8
+            ).tobytes()
+            digs = [
+                hashlib.sha1(payload[i : i + plen]).digest()
+                for i in range(0, len(payload), plen)
+            ]
+            server, _ = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            meta = bencode(
+                {
+                    b"announce": b"http://127.0.0.1:%d/announce" % server.http_port,
+                    b"info": {
+                        b"name": b"utp.bin",
+                        b"piece length": plen,
+                        b"pieces": b"".join(digs),
+                        b"length": len(payload),
+                    },
+                }
+            )
+            m = parse_metainfo(meta)
+            seed_dir, leech_dir = str(tmp_path / "s"), str(tmp_path / "l")
+            os.makedirs(seed_dir)
+            os.makedirs(leech_dir)
+            open(os.path.join(seed_dir, "utp.bin"), "wb").write(payload)
+            c1 = Client(ClientConfig(port=0, enable_upnp=False, enable_utp=True))
+            c2 = Client(ClientConfig(port=0, enable_upnp=False, enable_utp=True))
+            await c1.start()
+            await c2.start()
+            try:
+                t1 = await c1.add(m, seed_dir)
+                t2 = await c2.add(m, leech_dir)
+                for _ in range(600):
+                    if t2.bitfield.complete:
+                        break
+                    await asyncio.sleep(0.05)
+                assert t2.bitfield.complete, f"uTP swarm stalled: {t2.status()}"
+                got = open(os.path.join(leech_dir, "utp.bin"), "rb").read()
+                assert got == payload
+                writers = [
+                    p.writer
+                    for p in list(t1.peers.values()) + list(t2.peers.values())
+                ]
+                assert writers and all(
+                    isinstance(w, _UtpWriter) for w in writers
+                ), f"expected uTP transports, got {[type(w) for w in writers]}"
+            finally:
+                await c1.close()
+                await c2.close()
+                server.close()
+
+        run(go())
+
+
+class TestTcpFallback:
+    def test_utp_client_reaches_tcp_only_seed(self, tmp_path):
+        """Happy-eyeballs: a uTP-enabled leech must still connect (fast)
+        to a TCP-only seed via the raced TCP dial."""
+        import hashlib
+        import os
+        import time as _time
+
+        import numpy as np
+
+        from torrent_tpu.codec.bencode import bencode
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            plen = 32768
+            payload = np.random.default_rng(31).integers(
+                0, 256, 3 * plen, dtype=np.uint8
+            ).tobytes()
+            digs = [
+                hashlib.sha1(payload[i : i + plen]).digest()
+                for i in range(0, len(payload), plen)
+            ]
+            server, _ = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            meta = bencode(
+                {
+                    b"announce": b"http://127.0.0.1:%d/announce" % server.http_port,
+                    b"info": {
+                        b"name": b"fb.bin",
+                        b"piece length": plen,
+                        b"pieces": b"".join(digs),
+                        b"length": len(payload),
+                    },
+                }
+            )
+            m = parse_metainfo(meta)
+            seed_dir, leech_dir = str(tmp_path / "s2"), str(tmp_path / "l2")
+            os.makedirs(seed_dir)
+            os.makedirs(leech_dir)
+            open(os.path.join(seed_dir, "fb.bin"), "wb").write(payload)
+            c1 = Client(ClientConfig(port=0, enable_upnp=False))  # TCP-only seed
+            c2 = Client(ClientConfig(port=0, enable_upnp=False, enable_utp=True))
+            await c1.start()
+            await c2.start()
+            try:
+                t1 = await c1.add(m, seed_dir)
+                t0 = _time.monotonic()
+                t2 = await c2.add(m, leech_dir)
+                for _ in range(600):
+                    if t2.bitfield.complete:
+                        break
+                    await asyncio.sleep(0.05)
+                assert t2.bitfield.complete, f"fallback stalled: {t2.status()}"
+                # fallback must be fast (happy-eyeballs), not a serial
+                # 8 s uTP timeout before TCP starts
+                assert _time.monotonic() - t0 < 15
+            finally:
+                await c1.close()
+                await c2.close()
+                server.close()
+
+        run(go())

@@ -1,0 +1,611 @@
+"""uTP — the micro transport protocol (BEP 29) over asyncio UDP.
+
+No reference counterpart (the reference is TCP-only, torrent.ts:198).
+uTP is the transport most real swarms run on: BitTorrent traffic yields
+to interactive traffic because LEDBAT backs off on one-way *delay*
+(long before loss), and UDP survives NATs that drop inbound TCP.
+
+Scope: a complete, tested transport usable by the session layer —
+``open_utp_connection`` / ``UtpListener`` return asyncio
+``(StreamReader, writer)`` pairs that drop into the same code paths as
+TCP streams (``writer.write/drain/close/get_extra_info``).
+
+Wire format (20-byte header, all big-endian)::
+
+    0       4       8               16
+    +-------+-------+---------------+
+    | type/ver (1)  | extension (1) | connection_id (2)
+    | timestamp_microseconds (4)    |
+    | timestamp_difference_us (4)   |
+    | wnd_size (4)                  |
+    | seq_nr (2)    | ack_nr (2)    |
+
+Types: ST_DATA=0, ST_FIN=1, ST_STATE=2, ST_RESET=3, ST_SYN=4; ver=1.
+Extension 1 is a selective-ack bitmask (received; we ack cumulatively).
+
+Reliability: per-packet retransmit with an RTT-driven RTO (Karn's rule:
+samples only from un-retransmitted packets), fast resend on 3 duplicate
+acks. Congestion: simplified LEDBAT — cwnd grows toward a 100 ms
+one-way-delay target and backs off proportionally past it, clamped to
+[2, 256] outstanding packets and the peer's advertised window.
+
+Connection ids (BEP 29): the initiator picks ``recv_id`` at random and
+sends SYN carrying it; the initiator *sends* with ``recv_id + 1``, the
+acceptor sends with ``recv_id``. One UDP socket multiplexes many
+connections by (addr, recv_id).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import struct
+import time
+
+from torrent_tpu.utils.log import get_logger
+
+log = get_logger("utp")
+
+ST_DATA, ST_FIN, ST_STATE, ST_RESET, ST_SYN = range(5)
+VERSION = 1
+HEADER = struct.Struct(">BBHIIIHH")
+MTU = 1400  # payload bytes per ST_DATA (conservative vs 1500-byte MTU)
+TARGET_DELAY_US = 100_000  # LEDBAT one-way-delay target
+MIN_CWND_PKTS = 2
+MAX_CWND_PKTS = 256
+DEFAULT_RTO = 1.0
+MAX_RETRANSMITS = 8
+RECV_WINDOW = 1 << 20  # advertised receive buffer
+
+
+def _now_us() -> int:
+    return int(time.monotonic() * 1_000_000) & 0xFFFFFFFF
+
+
+def encode_packet(
+    ptype: int,
+    conn_id: int,
+    seq_nr: int,
+    ack_nr: int,
+    *,
+    ts: int | None = None,
+    ts_diff: int = 0,
+    wnd: int = RECV_WINDOW,
+    payload: bytes = b"",
+) -> bytes:
+    return (
+        HEADER.pack(
+            (ptype << 4) | VERSION,
+            0,
+            conn_id & 0xFFFF,
+            _now_us() if ts is None else ts,
+            ts_diff & 0xFFFFFFFF,
+            wnd,
+            seq_nr & 0xFFFF,
+            ack_nr & 0xFFFF,
+        )
+        + payload
+    )
+
+
+def decode_packet(data: bytes):
+    """→ (type, conn_id, ts, ts_diff, wnd, seq, ack, payload) or None."""
+    if len(data) < HEADER.size:
+        return None
+    tv, ext, conn_id, ts, ts_diff, wnd, seq, ack = HEADER.unpack_from(data)
+    ptype, ver = tv >> 4, tv & 0xF
+    if ver != VERSION or ptype > ST_SYN:
+        return None
+    off = HEADER.size
+    while ext:  # skip extensions (we ack cumulatively)
+        if off + 2 > len(data):
+            return None
+        ext, elen = data[off], data[off + 1]
+        off += 2 + elen
+        if off > len(data):
+            return None
+    return ptype, conn_id, ts, ts_diff, wnd, seq, ack, data[off:]
+
+
+def _seq_lt(a: int, b: int) -> bool:
+    """a < b in mod-2^16 sequence space."""
+    return ((b - a) & 0xFFFF) < 0x8000 and a != b
+
+
+class _UtpReader(asyncio.StreamReader):
+    """StreamReader that reports consumption back to the connection so
+    window-update STATEs go out when the application drains the buffer
+    (without this, a paused sender never learns the window reopened)."""
+
+    _conn: "UtpConnection | None" = None
+
+    async def read(self, n: int = -1) -> bytes:
+        data = await super().read(n)
+        if self._conn is not None:
+            self._conn._after_consume()
+        return data
+
+    async def readexactly(self, n: int) -> bytes:
+        data = await super().readexactly(n)
+        if self._conn is not None:
+            self._conn._after_consume()
+        return data
+
+
+class UtpConnection:
+    """One reliable bidirectional stream over a shared UDP endpoint."""
+
+    def __init__(self, endpoint: "UtpEndpoint", addr, recv_id: int, send_id: int):
+        self.endpoint = endpoint
+        self.addr = addr
+        self.recv_id = recv_id
+        self.send_id = send_id
+        self.reader = _UtpReader()
+        self.reader._conn = self
+        self._advertised_low = False
+        self.seq_nr = random.randrange(1, 0xFFFF)  # next seq we will send
+        self.ack_nr = 0  # last in-order seq we received
+        self.connected = asyncio.Event()
+        self.closed = False
+        self._reset = False
+        # outstanding: seq -> [packet_bytes, sent_monotonic, retransmits]
+        self._outstanding: dict[int, list] = {}
+        self._send_room = asyncio.Event()
+        self._send_room.set()
+        self._ooo: dict[int, bytes] = {}  # out-of-order payloads
+        self._dup_acks = 0
+        self._last_ack_seen = -1
+        self._srtt: float | None = None
+        self._rttvar = 0.0
+        # our most recent one-way-delay measurement, echoed in every
+        # outgoing packet so the peer's LEDBAT gets its samples
+        self.last_ts_diff = 0
+        self.rto = DEFAULT_RTO
+        self.cwnd = MIN_CWND_PKTS * MTU
+        self.peer_wnd = RECV_WINDOW
+        self._fin_seq: int | None = None
+        self._fin_sent = False
+        self._timer: asyncio.TimerHandle | None = None
+
+    # ------------------------------------------------------------- sending
+
+    def _inflight_bytes(self) -> int:
+        return sum(len(p[0]) - HEADER.size for p in self._outstanding.values())
+
+    def recv_window(self) -> int:
+        """Receive window we advertise: buffer capacity minus occupancy
+        (a slow consumer — e.g. a rate-capped peer loop — thereby pauses
+        the remote sender instead of buffering without bound)."""
+        wnd = max(0, RECV_WINDOW - len(self.reader._buffer))
+        self._advertised_low = wnd < RECV_WINDOW // 2
+        return wnd
+
+    def _after_consume(self) -> None:
+        if (
+            self._advertised_low
+            and not self.closed
+            and RECV_WINDOW - len(self.reader._buffer) >= RECV_WINDOW // 2
+        ):
+            self._send_state()  # window update: tell the sender to resume
+
+    def _window(self) -> int:
+        # cwnd has an MTU floor; the PEER's advertised window does not —
+        # zero from the peer means pause (flow control, not congestion)
+        cwnd = max(MTU, min(int(self.cwnd), MAX_CWND_PKTS * MTU))
+        return min(cwnd, self.peer_wnd)
+
+    async def send(self, data: bytes) -> None:
+        """Chunk ``data`` into ST_DATA packets, honoring the window."""
+        if self.closed or self._reset:
+            raise ConnectionResetError("utp connection closed")
+        for off in range(0, len(data), MTU):
+            chunk = data[off : off + MTU]
+            while self._inflight_bytes() + len(chunk) > self._window():
+                self._send_room.clear()
+                try:
+                    # bounded wait: a zero/shrunken peer window reopens
+                    # via the peer's next window-update STATE, but if
+                    # that is lost only polling recovers
+                    await asyncio.wait_for(self._send_room.wait(), 0.5)
+                except asyncio.TimeoutError:
+                    pass
+                if self.closed or self._reset:
+                    raise ConnectionResetError("utp connection closed")
+            self.seq_nr = (self.seq_nr + 1) & 0xFFFF
+            pkt = encode_packet(
+                ST_DATA,
+                self.send_id,
+                self.seq_nr,
+                self.ack_nr,
+                ts_diff=self.last_ts_diff,
+                wnd=self.recv_window(),
+                payload=chunk,
+            )
+            self._outstanding[self.seq_nr] = [pkt, time.monotonic(), 0]
+            self.endpoint.sendto(pkt, self.addr)
+            self._arm_timer()
+
+    def send_fin(self) -> None:
+        if self._fin_sent or self._reset:
+            return
+        self._fin_sent = True
+        self.seq_nr = (self.seq_nr + 1) & 0xFFFF
+        pkt = encode_packet(
+            ST_FIN,
+            self.send_id,
+            self.seq_nr,
+            self.ack_nr,
+            ts_diff=self.last_ts_diff,
+            wnd=self.recv_window(),
+        )
+        self._outstanding[self.seq_nr] = [pkt, time.monotonic(), 0]
+        self.endpoint.sendto(pkt, self.addr)
+        self._arm_timer()
+
+    # ------------------------------------------------------------ receiving
+
+    def on_packet(self, ptype, ts, ts_diff, wnd, seq, ack, payload) -> None:
+        # honor the peer's advertised window as-is — zero means PAUSE
+        # (the send loop polls; a floor here would turn the peer's flow
+        # control into packet loss and an eventual reset)
+        self.peer_wnd = wnd
+        self.last_ts_diff = (_now_us() - ts) & 0xFFFFFFFF
+        if ptype == ST_RESET:
+            self._die(reset=True)
+            return
+        self._handle_ack(ptype, ack, ts_diff)
+        if ptype == ST_STATE:
+            if not self.connected.is_set():
+                # SYN-ACK: the peer acks our SYN. Its ST_STATE seq is the
+                # peer's CURRENT (virtual) position; its first data
+                # packet will carry seq+1, so expected = seq+1 ⇒ ack_nr
+                # must start at seq.
+                self.ack_nr = seq
+                self.connected.set()
+                # data that raced ahead of the SYN-ACK sits in the
+                # out-of-order buffer; deliver whatever now lines up
+                nxt = (self.ack_nr + 1) & 0xFFFF
+                while nxt in self._ooo:
+                    self.reader.feed_data(self._ooo.pop(nxt))
+                    self.ack_nr = nxt
+                    nxt = (nxt + 1) & 0xFFFF
+            return
+        if ptype in (ST_DATA, ST_FIN):
+            if ptype == ST_FIN:
+                self._fin_seq = seq
+            expected = (self.ack_nr + 1) & 0xFFFF
+            if seq == expected:
+                self.ack_nr = seq
+                if payload:
+                    self.reader.feed_data(payload)
+                # drain any buffered out-of-order successors
+                nxt = (self.ack_nr + 1) & 0xFFFF
+                while nxt in self._ooo:
+                    self.reader.feed_data(self._ooo.pop(nxt))
+                    self.ack_nr = nxt
+                    nxt = (nxt + 1) & 0xFFFF
+            elif _seq_lt(expected, seq):
+                if payload:
+                    self._ooo[seq] = payload  # hole: buffer until filled
+            # duplicate (seq < expected): just re-ack
+            self._send_state()
+            if self._fin_seq is not None and self.ack_nr == self._fin_seq:
+                self._die(reset=False)
+
+    def _handle_ack(self, ptype: int, ack: int, ts_diff: int) -> None:
+        acked = [
+            s for s in self._outstanding if not _seq_lt(ack, s)
+        ]  # s <= ack in seq space
+        if acked:
+            self._dup_acks = 0
+            self._last_ack_seen = ack
+            for s in acked:
+                pkt, sent_at, retx = self._outstanding.pop(s)
+                if retx == 0:  # Karn: only clean samples drive the RTO
+                    self._rtt_sample(time.monotonic() - sent_at)
+            self._ledbat(ts_diff, sum(1 for _ in acked))
+            if not self._send_room.is_set():
+                self._send_room.set()
+            self._arm_timer()
+        elif self._outstanding:
+            # Fast resend triggers on DUPLICATE pure acks only: acks
+            # piggybacked on ST_DATA are naturally stale while the peer's
+            # own data races our request (counting those retransmits
+            # every request and pins cwnd to the floor under
+            # bidirectional traffic).
+            if ptype != ST_STATE or ack != self._last_ack_seen:
+                self._last_ack_seen = ack
+                return
+            self._dup_acks += 1
+            # classic threshold is 3 dup acks, but a small window can't
+            # produce 3 (a 3-packet window yields at most 2) — without
+            # the adaptation every small-window loss costs a full RTO
+            need = min(3, max(2, len(self._outstanding) - 1))
+            if self._dup_acks >= need:
+                self._dup_acks = 0
+                self.cwnd = max(MIN_CWND_PKTS * MTU, self.cwnd * 0.5)
+                oldest = min(self._outstanding, key=lambda s: (s - ack) & 0xFFFF)
+                self._retransmit(oldest)
+
+    def _rtt_sample(self, rtt: float) -> None:
+        if self._srtt is None:
+            self._srtt, self._rttvar = rtt, rtt / 2
+        else:
+            self._rttvar = 0.75 * self._rttvar + 0.25 * abs(self._srtt - rtt)
+            self._srtt = 0.875 * self._srtt + 0.125 * rtt
+        # a clean sample also clears any timeout backoff compounding
+        self.rto = min(8.0, max(0.2, self._srtt + 4 * self._rttvar))
+
+    def _ledbat(self, ts_diff_us: int, acked_pkts: int) -> None:
+        """Delay-based cwnd update (simplified LEDBAT gain rule)."""
+        if ts_diff_us == 0 or ts_diff_us > 60_000_000:
+            return  # no usable delay sample
+        off_target = (TARGET_DELAY_US - ts_diff_us) / TARGET_DELAY_US
+        # full-target gain: one MTU per RTT when delay is zero
+        self.cwnd += off_target * MTU * acked_pkts * MTU / max(self.cwnd, MTU)
+        self.cwnd = max(MIN_CWND_PKTS * MTU, min(self.cwnd, MAX_CWND_PKTS * MTU))
+
+    # ----------------------------------------------------------- timers
+
+    def _arm_timer(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if not self._outstanding or self.closed:
+            return
+        self._timer = asyncio.get_running_loop().call_later(self.rto, self._on_timeout)
+
+    def _on_timeout(self) -> None:
+        self._timer = None
+        if not self._outstanding or self.closed:
+            return
+        self.rto = min(8.0, self.rto * 2)  # backoff
+        # multiplicative decrease, not full collapse: a floor-sized
+        # window can't generate the dup acks that drive fast resend,
+        # turning every subsequent loss into another full RTO
+        self.cwnd = max(MIN_CWND_PKTS * MTU, self.cwnd * 0.5)
+        oldest = min(
+            self._outstanding, key=lambda s: self._outstanding[s][1]
+        )
+        if self._outstanding[oldest][2] >= MAX_RETRANSMITS:
+            self._die(reset=True)
+            return
+        self._retransmit(oldest)
+        self._arm_timer()
+
+    def _retransmit(self, seq: int) -> None:
+        entry = self._outstanding.get(seq)
+        if entry is None:
+            return
+        entry[1] = time.monotonic()
+        entry[2] += 1
+        self.endpoint.sendto(entry[0], self.addr)
+
+    def _send_state(self) -> None:
+        self.endpoint.sendto(
+            encode_packet(
+                ST_STATE,
+                self.send_id,
+                self.seq_nr,
+                self.ack_nr,
+                ts_diff=self.last_ts_diff,
+                wnd=self.recv_window(),
+            ),
+            self.addr,
+        )
+
+    # ---------------------------------------------------------- lifecycle
+
+    def _die(self, reset: bool) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self._reset = reset
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        self._outstanding.clear()
+        self._send_room.set()
+        if reset:
+            self.reader.feed_eof()
+            if not self.connected.is_set():
+                self.connected.set()  # unblock dialers; they check _reset
+        else:
+            self.reader.feed_eof()
+        self.endpoint._forget(self)
+
+    def close(self) -> None:
+        if not self.closed:
+            self.send_fin()
+            # the FIN retransmit timer keeps the connection alive until
+            # acked or max-retransmits; reads see EOF immediately
+            self.reader.feed_eof()
+
+
+class _UtpWriter:
+    """StreamWriter-compatible facade over a UtpConnection.
+
+    ``write()`` must behave like a kernel socket: bytes start moving
+    without an explicit ``drain()`` (the session queues its opening
+    bitfield/extended-handshake with plain writes). A single background
+    flusher task drains the buffer in order; ``drain()`` awaits it
+    (providing the backpressure contract), ``close()`` chains the FIN
+    behind the last flushed byte.
+    """
+
+    def __init__(self, conn: UtpConnection):
+        self._conn = conn
+        self._buf = bytearray()
+        self._flusher: asyncio.Task | None = None
+        self._closing = False
+
+    def _kick(self) -> None:
+        if self._flusher is None or self._flusher.done():
+            try:
+                self._flusher = asyncio.get_running_loop().create_task(self._flush())
+            except RuntimeError:
+                pass
+
+    async def _flush(self) -> None:
+        while self._buf and not self._conn.closed:
+            buf, self._buf = bytes(self._buf), bytearray()
+            try:
+                await self._conn.send(buf)
+            except ConnectionError:
+                self._buf.clear()
+                return
+        if self._closing:
+            self._conn.close()
+
+    def write(self, data: bytes) -> None:
+        if self._closing:
+            return
+        self._buf += data
+        self._kick()
+
+    async def drain(self) -> None:
+        t = self._flusher
+        if t is not None and not t.done():
+            await asyncio.shield(t)
+        if self._conn._reset:
+            raise ConnectionResetError("utp connection reset")
+
+    def close(self) -> None:
+        if self._closing:
+            return
+        self._closing = True
+        t = self._flusher
+        if (t is None or t.done()) and not self._buf:
+            self._conn.close()
+        else:
+            self._kick()  # flusher sees _closing and FINs after the tail
+
+    def is_closing(self) -> bool:
+        return self._conn.closed
+
+    def get_extra_info(self, name, default=None):
+        if name == "peername":
+            return self._conn.addr
+        return default
+
+
+class UtpEndpoint(asyncio.DatagramProtocol):
+    """One UDP socket multiplexing inbound/outbound uTP connections."""
+
+    def __init__(self, on_accept=None):
+        self.on_accept = on_accept  # async callback(reader, writer)
+        self.transport = None
+        self._conns: dict[tuple, UtpConnection] = {}  # (addr, recv_id)
+        # secondary index: a peer's RESET echoes OUR send id, not our
+        # recv id, so teardown routing needs the other key too
+        self._by_send: dict[tuple, UtpConnection] = {}  # (addr, send_id)
+        # asyncio keeps only weak refs to tasks — accept handlers must be
+        # retained or GC can collect a handshake mid-flight
+        self._tasks: set[asyncio.Task] = set()
+        self.port: int | None = None
+
+    # asyncio protocol hooks
+    def connection_made(self, transport):
+        self.transport = transport
+        self.port = transport.get_extra_info("sockname")[1]
+
+    def sendto(self, data: bytes, addr) -> None:
+        if self.transport is not None:
+            self.transport.sendto(data, addr)
+
+    def datagram_received(self, data, addr):
+        parsed = decode_packet(data)
+        if parsed is None:
+            return
+        ptype, conn_id, ts, ts_diff, wnd, seq, ack, payload = parsed
+        now = _now_us()
+        diff = (now - ts) & 0xFFFFFFFF
+        conn = self._conns.get((addr, conn_id))
+        if conn is not None:
+            conn.on_packet(ptype, ts, diff, wnd, seq, ack, payload)
+            return
+        if ptype == ST_RESET:
+            # RESETs carry the id WE send with (the peer echoes what it
+            # saw) — route via the send-id index or drop
+            conn = self._by_send.get((addr, conn_id))
+            if conn is not None:
+                conn.on_packet(ptype, ts, diff, wnd, seq, ack, payload)
+            return
+        if ptype == ST_SYN:
+            existing = self._conns.get((addr, (conn_id + 1) & 0xFFFF))
+            if existing is not None:
+                existing._send_state()  # retransmitted SYN: re-ack, no new conn
+                return
+            if self.on_accept is None:
+                self.sendto(encode_packet(ST_RESET, conn_id, 0, seq), addr)
+                return
+            # acceptor: recv with conn_id+1, send with conn_id
+            conn = UtpConnection(
+                self, addr, recv_id=(conn_id + 1) & 0xFFFF, send_id=conn_id
+            )
+            conn.ack_nr = seq
+            conn.connected.set()
+            self._conns[(addr, conn.recv_id)] = conn
+            self._by_send[(addr, conn.send_id)] = conn
+            conn._send_state()  # SYN-ACK
+            task = asyncio.get_running_loop().create_task(
+                self.on_accept(conn.reader, _UtpWriter(conn))
+            )
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+        else:
+            # unknown connection: RESET so the peer gives up quickly
+            self.sendto(encode_packet(ST_RESET, conn_id, 0, seq), addr)
+
+    def _forget(self, conn: UtpConnection) -> None:
+        self._conns.pop((conn.addr, conn.recv_id), None)
+        self._by_send.pop((conn.addr, conn.send_id), None)
+
+    async def dial(self, host: str, port: int, timeout: float = 10.0):
+        """Initiate a connection → ``(StreamReader, writer)``."""
+        addr = (host, port)
+        recv_id = random.randrange(1, 0xFFFE)
+        conn = UtpConnection(
+            self, addr, recv_id=recv_id, send_id=(recv_id + 1) & 0xFFFF
+        )
+        self._conns[(addr, recv_id)] = conn
+        self._by_send[(addr, conn.send_id)] = conn
+        # SYN carries recv_id and consumes seq 1
+        pkt = encode_packet(ST_SYN, recv_id, conn.seq_nr, 0)
+        conn._outstanding[conn.seq_nr] = [pkt, time.monotonic(), 0]
+        self.sendto(pkt, addr)
+        conn._arm_timer()
+        try:
+            await asyncio.wait_for(conn.connected.wait(), timeout)
+        except asyncio.TimeoutError:
+            conn._die(reset=True)
+            raise ConnectionError(f"utp dial to {addr} timed out")
+        if conn._reset:
+            raise ConnectionRefusedError(f"utp dial to {addr} refused")
+        return conn.reader, _UtpWriter(conn)
+
+    def close(self) -> None:
+        for conn in list(self._conns.values()):
+            conn._die(reset=True)
+        if self.transport is not None:
+            self.transport.close()
+
+
+async def create_utp_endpoint(
+    host: str = "0.0.0.0", port: int = 0, on_accept=None
+) -> UtpEndpoint:
+    loop = asyncio.get_running_loop()
+    _, proto = await loop.create_datagram_endpoint(
+        lambda: UtpEndpoint(on_accept), local_addr=(host, port)
+    )
+    return proto
+
+
+async def open_utp_connection(host: str, port: int, timeout: float = 10.0):
+    """One-shot dial on a fresh ephemeral endpoint (TCP-open analogue)."""
+    ep = await create_utp_endpoint()
+    try:
+        return await ep.dial(host, port, timeout)
+    except Exception:
+        ep.close()
+        raise

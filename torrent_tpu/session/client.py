@@ -54,6 +54,9 @@ class ClientConfig:
     max_upload_bps: int = 0
     max_download_bps: int = 0
     enable_lsd: bool = False  # BEP 14 local service discovery (net/lsd.py)
+    # BEP 29 uTP transport (net/utp.py): accept uTP peers on the same
+    # port (UDP) and prefer uTP for outbound dials, TCP fallback
+    enable_utp: bool = False
 
 
 class Client:
@@ -70,6 +73,7 @@ class Client:
         self.upload_bucket = TokenBucket(self.config.max_upload_bps)
         self.download_bucket = TokenBucket(self.config.max_download_bps)
         self.lsd = None  # net.lsd.LocalServiceDiscovery when enable_lsd
+        self.utp = None  # net.utp.UtpEndpoint when enable_utp
 
     # ------------------------------------------------------------- startup
 
@@ -105,6 +109,15 @@ class Client:
             except Exception as e:  # multicast may be unavailable
                 log.warning("LSD setup failed: %s", e)
                 self.lsd = None
+        if self.config.enable_utp:
+            from torrent_tpu.net.utp import create_utp_endpoint
+
+            # same port number as the TCP listener, UDP side — inbound
+            # uTP streams run the ordinary BitTorrent handshake through
+            # the same accept path as TCP connections
+            self.utp = await create_utp_endpoint(
+                self.config.host, self.port, on_accept=self._accept
+            )
 
     def _on_lsd_peer(self, info_hash: bytes, addr: tuple[str, int]) -> None:
         """BEP 14 callback: a local client announced this swarm."""
@@ -121,6 +134,9 @@ class Client:
         if self.lsd is not None:
             self.lsd.close()
             self.lsd = None
+        if self.utp is not None:
+            self.utp.close()
+            self.utp = None
         if self.dht is not None:
             self.dht.close()
             self.dht = None
@@ -183,6 +199,7 @@ class Client:
             upload_bucket=self.upload_bucket,
             download_bucket=self.download_bucket,
             external_ip=self.external_ip,
+            utp_dial=self.utp.dial if self.utp is not None else None,
         )
         self.torrents[metainfo.info_hash] = torrent
         await torrent.start()

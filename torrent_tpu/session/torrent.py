@@ -119,6 +119,7 @@ class Torrent:
         upload_bucket=None,  # optional utils/ratelimit.TokenBucket (client-global)
         download_bucket=None,
         external_ip=None,  # our public address, for BEP 40 dial ordering
+        utp_dial=None,  # optional BEP 29 dialer: async (host, port) -> streams
     ):
         from torrent_tpu.net.multitracker import TrackerList, parse_announce_list
 
@@ -134,6 +135,7 @@ class Torrent:
         self.upload_bucket = upload_bucket
         self.download_bucket = download_bucket
         self.external_ip = external_ip
+        self._utp_dial = utp_dial
         self.trackers = TrackerList(
             metainfo.announce, parse_announce_list(metainfo.raw)
         )
@@ -565,12 +567,58 @@ class Torrent:
             self._spawn(self._dial(addr, cand.peer_id))
 
     async def _dial(self, addr: tuple[str, int], expect_peer_id: bytes | None) -> None:
-        """connect/handshake/verify/register (torrent.ts:198-222)."""
-        try:
-            reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(addr[0], addr[1]), timeout=10
+        """connect/handshake/verify/register (torrent.ts:198-222).
+
+        With uTP enabled (BEP 29) the dial races uTP against TCP,
+        happy-eyeballs style: uTP gets a short head start (it is the
+        transport most swarms prefer), TCP starts 250 ms later, first
+        connected stream wins and the loser is torn down. A TCP-only
+        peer therefore costs ~250 ms extra, not a full uTP timeout —
+        ICMP unreachable for UDP is not surfaced per-address by asyncio,
+        so a sequential uTP-then-TCP dial would stall every TCP-only
+        connection for seconds.
+        """
+        reader = writer = None
+        if self._utp_dial is not None:
+            utp_task = asyncio.ensure_future(
+                self._utp_dial(addr[0], addr[1], timeout=8)
             )
-        except (OSError, asyncio.TimeoutError):
+
+            async def tcp_late():
+                await asyncio.sleep(0.25)
+                return await asyncio.open_connection(addr[0], addr[1])
+
+            tcp_task = asyncio.ensure_future(tcp_late())
+            pending = {utp_task, tcp_task}
+            try:
+                end = time.monotonic() + 10
+                while pending and reader is None:
+                    done, pending = await asyncio.wait(
+                        pending,
+                        timeout=max(0, end - time.monotonic()),
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                    if not done:
+                        break  # overall timeout
+                    for t in done:
+                        if t.exception() is None and reader is None:
+                            reader, writer = t.result()
+            finally:
+                for t in pending:
+                    t.cancel()
+                for t in (utp_task, tcp_task):
+                    if t.done() and not t.cancelled() and t.exception() is None:
+                        r, w = t.result()
+                        if w is not writer:
+                            w.close()  # the losing transport
+        else:
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(addr[0], addr[1]), timeout=10
+                )
+            except (OSError, asyncio.TimeoutError):
+                reader = writer = None
+        if reader is None:
             self._dialing.discard(addr)
             return
         try:

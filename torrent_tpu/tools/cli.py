@@ -236,6 +236,7 @@ async def _download(args) -> int:
         max_upload_bps=args.max_up * 1024,
         max_download_bps=args.max_down * 1024,
         enable_lsd=args.lsd,
+        enable_utp=args.utp,
     )
     if args.sequential:
         config.torrent.sequential = True
@@ -434,6 +435,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--sequential",
         action="store_true",
         help="download pieces in order (streaming) instead of rarest-first",
+    )
+    sp.add_argument(
+        "--utp",
+        action="store_true",
+        help="enable BEP 29 uTP transport (prefer uTP dials, TCP fallback)",
     )
     sp.add_argument(
         "--dht-bootstrap",

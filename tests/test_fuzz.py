@@ -216,3 +216,29 @@ class TestNumericProperties:
         assert sum(sizes) == total
         assert all(s == plen for s in sizes[:-1])
         assert 0 < sizes[-1] <= plen
+
+
+class TestUtpDecoderProperties:
+    @given(st.binary(max_size=80))
+    @settings(max_examples=300)
+    def test_utp_decode_total(self, blob):
+        """decode_packet: a tuple or None, never an exception."""
+        from torrent_tpu.net.utp import decode_packet
+
+        out = decode_packet(blob)
+        assert out is None or len(out) == 8
+
+    @given(
+        st.integers(min_value=0, max_value=4),
+        st.integers(min_value=0, max_value=0xFFFF),
+        st.integers(min_value=0, max_value=0xFFFF),
+        st.integers(min_value=0, max_value=0xFFFF),
+        st.binary(max_size=64),
+    )
+    @settings(max_examples=200)
+    def test_utp_roundtrip(self, ptype, cid, seq, ack, payload):
+        from torrent_tpu.net.utp import decode_packet, encode_packet
+
+        enc = encode_packet(ptype, cid, seq, ack, ts=5, payload=payload)
+        ptype2, cid2, _, _, _, seq2, ack2, payload2 = decode_packet(enc)
+        assert (ptype2, cid2, seq2, ack2, payload2) == (ptype, cid, seq, ack, payload)

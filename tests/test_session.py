@@ -906,3 +906,50 @@ class TestClientStatus:
                 await c.close()
 
         run(go())
+
+
+class TestChokePolicy:
+    def test_seed_mode_unchokes_fastest_takers(self):
+        """Seeding reciprocity: no downloads to rank by, so the slots go
+        to the peers draining us fastest (max dissemination)."""
+        import time as _time
+
+        from torrent_tpu.net import protocol as proto
+        from tests.test_fast import _messages
+
+        async def go():
+            t, _ = TestSchedulerUnits().make_torrent()
+            t.state = TorrentState.SEEDING
+            t.config.unchoke_slots = 1
+            now = _time.monotonic()
+            fast = PeerConnection(
+                peer_id=b"U" * 20, reader=object(), writer=_FakeWriter(),
+                num_pieces=t.info.num_pieces,
+            )
+            slow = PeerConnection(
+                peer_id=b"V" * 20, reader=object(), writer=_FakeWriter(),
+                num_pieces=t.info.num_pieces,
+            )
+            for p, up in ((fast, 10_000_000), (slow, 100)):
+                p.peer_interested = True
+                p.am_choking = True
+                p.bytes_up = up
+                p._up_mark = (now - 10.0, 0)
+                t.peers[p.peer_id] = p
+            # drive one real choke round (not a reimplementation of its
+            # ranking): the fast taker must come out unchoked, the slow
+            # one not (modulo the optimistic slot, pinned to fast here)
+            t.config.choke_interval = 0.01
+            task = t._spawn(t._choke_loop())
+            for _ in range(100):
+                if not fast.am_choking:
+                    break
+                await asyncio.sleep(0.01)
+            t._stopping = True
+            task.cancel()
+            assert not fast.am_choking
+            unchoked = [m for m in _messages(bytes(fast.writer.data))
+                        if isinstance(m, proto.Unchoke)]
+            assert unchoked
+
+        run(go())

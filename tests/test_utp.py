@@ -369,3 +369,48 @@ class TestTcpFallback:
                 server.close()
 
         run(go())
+
+
+class TestFlowControl:
+    def test_slow_consumer_pauses_sender(self):
+        """A consumer that stops reading must close the advertised window
+        and pause the sender (bounded receive buffer), then reopen it on
+        drain — the uTP analogue of TCP backpressure that the session's
+        download rate caps rely on."""
+
+        async def go():
+            hold = asyncio.Event()
+            drained = asyncio.Event()
+            total = 4 * 1024 * 1024  # 4x the receive window
+
+            async def consume(reader, writer):
+                got = 0
+                await hold.wait()  # don't read until told
+                while got < total:
+                    data = await reader.read(65536)
+                    if not data:
+                        break
+                    got += len(data)
+                drained.set()
+
+            server = await utp.create_utp_endpoint("127.0.0.1", 0, on_accept=consume)
+            try:
+                reader, writer = await utp.open_utp_connection(
+                    "127.0.0.1", server.port, timeout=5
+                )
+                payload = b"z" * total
+                send_task = asyncio.create_task(writer._conn.send(payload))
+                await asyncio.sleep(1.0)
+                # with the consumer stalled, the server-side buffer must
+                # be capped near RECV_WINDOW, not hold all 4 MiB
+                conn = list(server._conns.values())[0]
+                buffered = len(conn.reader._buffer)
+                assert buffered <= utp.RECV_WINDOW + 64 * utp.MTU, buffered
+                assert not send_task.done()  # sender is paused
+                hold.set()  # consumer drains -> window reopens
+                await asyncio.wait_for(send_task, 60)
+                await asyncio.wait_for(drained.wait(), 60)
+            finally:
+                server.close()
+
+        run(go())

@@ -61,6 +61,7 @@ class PeerConnection:
     bytes_up: int = 0  # payload sent to peer
     corrupt_pieces: int = 0  # pieces this peer helped fail verification
     _rate_mark: tuple[float, int] = (0.0, 0)  # (time, bytes_down) snapshot
+    _up_mark: tuple[float, int] = (0.0, 0)  # (time, bytes_up) snapshot
 
     last_rx: float = field(default_factory=time.monotonic)
     last_tx: float = field(default_factory=time.monotonic)
@@ -86,15 +87,25 @@ class PeerConnection:
             self.bitfield = Bitfield(self.num_pieces)
 
     def download_rate(self) -> float:
-        """Bytes/sec since the last choke-policy snapshot."""
+        """Bytes/sec received since the last choke-policy snapshot."""
         t0, b0 = self._rate_mark
         dt = time.monotonic() - t0
         if dt <= 0:
             return 0.0
         return (self.bytes_down - b0) / dt
 
+    def upload_rate(self) -> float:
+        """Bytes/sec served since the last choke-policy snapshot."""
+        t0, b0 = self._up_mark
+        dt = time.monotonic() - t0
+        if dt <= 0:
+            return 0.0
+        return (self.bytes_up - b0) / dt
+
     def snapshot_rate(self) -> None:
-        self._rate_mark = (time.monotonic(), self.bytes_down)
+        now = time.monotonic()
+        self._rate_mark = (now, self.bytes_down)
+        self._up_mark = (now, self.bytes_up)
 
     def close(self) -> None:
         try:

@@ -1376,7 +1376,10 @@ class Torrent:
                     await self._fill_pipeline(p)
 
     async def _choke_loop(self) -> None:
-        """Unchoke top downloaders + one optimistic random (BEP 3)."""
+        """Unchoke top reciprocators + one optimistic random (BEP 3).
+
+        Leeching ranks by download rate (tit-for-tat); seeding ranks by
+        upload rate (serve whoever drains us fastest)."""
         optimistic: bytes | None = None
         rounds = 0
         while not self._stopping:
@@ -1384,7 +1387,14 @@ class Torrent:
             await self._release_snubbed()
             peers = list(self.peers.values())
             interested = [p for p in peers if p.peer_interested]
-            interested.sort(key=lambda p: p.download_rate(), reverse=True)
+            if self.state == TorrentState.SEEDING:
+                # a seed downloads nothing — reciprocity is meaningless.
+                # Serve the peers that drain us fastest (max swarm
+                # dissemination); the optimistic slot still rotates in
+                # newcomers with no rate history.
+                interested.sort(key=lambda p: p.upload_rate(), reverse=True)
+            else:
+                interested.sort(key=lambda p: p.download_rate(), reverse=True)
             unchoke = set(id(p) for p in interested[: self.config.unchoke_slots])
             if rounds % 3 == 0 or optimistic not in self.peers:
                 rest = [p for p in interested[self.config.unchoke_slots :]]

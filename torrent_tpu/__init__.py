@@ -37,6 +37,7 @@ from torrent_tpu.parallel.verify import verify_pieces
 from torrent_tpu.tools.make_torrent import make_torrent
 from torrent_tpu.codec.magnet import Magnet, parse_magnet
 from torrent_tpu.codec.metainfo_v2 import MetainfoV2, InfoDictV2, V2File, parse_metainfo_v2
+from torrent_tpu.utils.ratelimit import TokenBucket
 
 __all__ = [
     "bencode",
@@ -62,6 +63,7 @@ __all__ = [
     "FsStorage",
     "MemoryStorage",
     "verify_pieces",
+    "TokenBucket",
     "make_torrent",
     "Magnet",
     "parse_magnet",

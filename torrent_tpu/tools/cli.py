@@ -38,8 +38,8 @@ def _cmd_info(args) -> int:
             print(f"total size:   {v2.info.length:,} bytes")
             print(f"piece length: {v2.info.piece_length:,}")
             print(f"files:        {len(v2.info.files)}")
-            for fe in v2.info.files[:20]:
-                print(f"  {'/'.join(fe.path)}  ({fe.length:,} bytes)")
+            for i, fe in enumerate(v2.info.files[:20]):
+                print(f"  [{i}] {'/'.join(fe.path)}  ({fe.length:,} bytes)")
             if len(v2.info.files) > 20:
                 print(f"  ... and {len(v2.info.files) - 20} more")
             return 0
@@ -54,8 +54,9 @@ def _cmd_info(args) -> int:
     print(f"pieces:       {info.num_pieces:,}")
     if info.files is not None:
         print(f"files:        {len(info.files)}")
-        for fe in info.files[:20]:
-            print(f"  {'/'.join(fe.path)}  ({fe.length:,} bytes)")
+        # indices are the handles `download --files I,J` takes
+        for i, fe in enumerate(info.files[:20]):
+            print(f"  [{i}] {'/'.join(fe.path)}  ({fe.length:,} bytes)")
         if len(info.files) > 20:
             print(f"  ... and {len(info.files) - 20} more")
     return 0

@@ -953,3 +953,114 @@ class TestChokePolicy:
             assert unchoked
 
         run(go())
+
+
+class TestServeCache:
+    def test_piece_read_once_for_sequential_blocks(self):
+        async def go():
+            from tests.test_fast import _messages
+            from torrent_tpu.net import protocol as proto
+
+            t, payload = TestSchedulerUnits().make_torrent()
+            await asyncio.to_thread(t.storage.set, 0, payload)
+            for i in range(t.info.num_pieces):
+                t.bitfield.set(i)
+            reads = []
+            orig = t.storage.read_piece
+            t.storage.read_piece = lambda i: (reads.append(i), orig(i))[1]
+            peer = PeerConnection(
+                peer_id=b"C" * 20, reader=object(), writer=_FakeWriter(),
+                num_pieces=t.info.num_pieces,
+            )
+            peer.am_choking = False
+            t.peers[peer.peer_id] = peer
+            for begin in range(0, 32768, BLOCK_SIZE):
+                await t._serve_request(peer, 0, begin, BLOCK_SIZE)
+            assert reads == [0]  # one disk read for both blocks
+            blocks = [m for m in _messages(bytes(peer.writer.data))
+                      if isinstance(m, proto.Piece)]
+            assert b"".join(b.block for b in blocks) == payload[:32768]
+
+        run(go())
+
+    def test_cache_evicts_lru(self):
+        async def go():
+            t, payload = TestSchedulerUnits().make_torrent()
+            t.config.serve_cache_pieces = 2
+            await asyncio.to_thread(t.storage.set, 0, payload)
+            for i in range(t.info.num_pieces):
+                t.bitfield.set(i)
+            peer = PeerConnection(
+                peer_id=b"C" * 20, reader=object(), writer=_FakeWriter(),
+                num_pieces=t.info.num_pieces,
+            )
+            peer.am_choking = False
+            t.peers[peer.peer_id] = peer
+            for idx in (0, 1, 2):
+                await t._serve_request(peer, idx, 0, BLOCK_SIZE)
+            assert set(t._serve_cache) == {1, 2}
+            # touching 1 refreshes it; 2 is evicted next
+            await t._serve_request(peer, 1, 0, BLOCK_SIZE)
+            await t._serve_request(peer, 0, 0, BLOCK_SIZE)
+            assert set(t._serve_cache) == {1, 0}
+
+        run(go())
+
+    def test_concurrent_misses_share_one_read(self):
+        async def go():
+            t, payload = TestSchedulerUnits().make_torrent()
+            await asyncio.to_thread(t.storage.set, 0, payload)
+            for i in range(t.info.num_pieces):
+                t.bitfield.set(i)
+            reads = []
+            orig = t.storage.read_piece
+
+            def slow_read(i):
+                import time as _t
+
+                reads.append(i)
+                _t.sleep(0.05)
+                return orig(i)
+
+            t.storage.read_piece = slow_read
+            peers = []
+            for pid in (b"D" * 20, b"E" * 20):
+                p = PeerConnection(
+                    peer_id=pid, reader=object(), writer=_FakeWriter(),
+                    num_pieces=t.info.num_pieces,
+                )
+                p.am_choking = False
+                t.peers[pid] = p
+                peers.append(p)
+            await asyncio.gather(
+                t._serve_request(peers[0], 0, 0, BLOCK_SIZE),
+                t._serve_request(peers[1], 0, BLOCK_SIZE, BLOCK_SIZE),
+            )
+            assert reads == [0]  # one disk read shared by both misses
+            assert not t._serve_pending
+
+        run(go())
+
+    def test_huge_pieces_bypass_cache(self):
+        async def go():
+            t, payload = TestSchedulerUnits().make_torrent()
+            t.config.serve_cache_max_piece = 1024  # force bypass
+            await asyncio.to_thread(t.storage.set, 0, payload)
+            for i in range(t.info.num_pieces):
+                t.bitfield.set(i)
+            p = PeerConnection(
+                peer_id=b"F" * 20, reader=object(), writer=_FakeWriter(),
+                num_pieces=t.info.num_pieces,
+            )
+            p.am_choking = False
+            t.peers[p.peer_id] = p
+            await t._serve_request(p, 0, 0, BLOCK_SIZE)
+            assert not t._serve_cache  # block path, no whole-piece read
+            from tests.test_fast import _messages
+            from torrent_tpu.net import protocol as proto
+
+            blocks = [m for m in _messages(bytes(p.writer.data))
+                      if isinstance(m, proto.Piece)]
+            assert blocks[0].block == payload[:BLOCK_SIZE]
+
+        run(go())

@@ -101,6 +101,13 @@ class TorrentConfig:
     # In-order piece picking for streaming/preview playback (rarest-first
     # otherwise; file priorities still outrank the order either way)
     sequential: bool = False
+    # Whole pieces cached on the serve path (LRU): a piece is requested
+    # as 16+ sequential blocks, so this turns 16 preads into 1. Memory
+    # cost = serve_cache_pieces * piece_length PER TORRENT; the cache
+    # disables itself for pieces over serve_cache_max_piece (whole-piece
+    # reads would be 1000x amplification for one-block fetches there)
+    serve_cache_pieces: int = 8
+    serve_cache_max_piece: int = 2 * 1024 * 1024
     webseed_concurrency: int = 2  # parallel piece fetches per webseed
     webseed_max_failures: int = 5  # consecutive bad pieces → URL disabled
 
@@ -174,6 +181,10 @@ class Torrent:
         # on it per block, so it must be O(1) there (the numpy recount
         # runs only on selection changes and recheck/resume)
         self._wanted_missing = self.info.num_pieces
+        # serve-path LRU of whole pieces (dict ordering = recency) and
+        # in-flight reads shared by concurrent misses on the same piece
+        self._serve_cache: dict[int, bytes] = {}
+        self._serve_pending: dict[int, asyncio.Future] = {}
         self._rarity_dirty = True
         self._inflight_count: Counter = Counter()
 
@@ -1327,12 +1338,45 @@ class Torrent:
             return
         if self.upload_bucket is not None:
             await self.upload_bucket.take(length)  # client-global upload cap
-        try:
-            block = await asyncio.to_thread(
-                self.storage.get, index * self.info.piece_length + begin, length
-            )
-        except StorageError as e:
-            log.error("serving piece %d failed: %s", index, e)
+        # Serve through a small LRU of whole pieces: peers request a
+        # piece as ~16-64 sequential 16 KiB blocks, so reading the piece
+        # once turns 16+ random preads into one. Concurrent misses on the
+        # same piece share ONE read via _serve_pending; huge pieces skip
+        # the cache (whole-piece reads would amplify one-block fetches).
+        if self.info.piece_length > self.config.serve_cache_max_piece:
+            try:
+                block = await asyncio.to_thread(
+                    self.storage.get, index * self.info.piece_length + begin, length
+                )
+            except StorageError as e:
+                log.error("serving piece %d failed: %s", index, e)
+                return
+        else:
+            piece = self._serve_cache.get(index)
+            if piece is None:
+                task = self._serve_pending.get(index)
+                if task is None:
+                    task = asyncio.ensure_future(
+                        asyncio.to_thread(self.storage.read_piece, index)
+                    )
+                    self._serve_pending[index] = task
+                    task.add_done_callback(
+                        lambda _t, i=index: self._serve_pending.pop(i, None)
+                    )
+                try:
+                    piece = await asyncio.shield(task)
+                except StorageError as e:
+                    log.error("serving piece %d failed: %s", index, e)
+                    return
+                self._serve_cache[index] = piece
+                while len(self._serve_cache) > self.config.serve_cache_pieces:
+                    self._serve_cache.pop(next(iter(self._serve_cache)))
+            else:
+                self._serve_cache.pop(index)  # LRU refresh: reinsert at tail
+                self._serve_cache[index] = piece
+            block = piece[begin : begin + length]
+        if len(block) != length:
+            log.error("serving piece %d: short read", index)
             return
         await proto.send_message(peer.writer, proto.Piece(index, begin, block))
         peer.bytes_up += length

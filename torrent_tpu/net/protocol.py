@@ -45,6 +45,10 @@ class MsgId(enum.IntEnum):
     REJECT_REQUEST = 16
     ALLOWED_FAST = 17
     EXTENDED = 20  # BEP 10 extension protocol (net/extension.py)
+    # BEP 52 merkle hash transfer (v2/hybrid swarms; models/hashes.py)
+    HASH_REQUEST = 21
+    HASHES = 22
+    HASH_REJECT = 23
 
 
 # Sanity cap on inbound frames: a piece message is 9 + 16 KiB; bitfields
@@ -147,6 +151,49 @@ class AllowedFast:
 
 
 @dataclass(frozen=True)
+class HashRequest:
+    """BEP 52: ask for merkle hashes of the file rooted at ``pieces_root``.
+
+    ``base_layer`` counts up from the 16 KiB leaf layer; ``index`` /
+    ``length`` span a run of hashes there; ``proof_layers`` uncle hashes
+    chain the run's subtree root toward ``pieces_root``.
+    """
+
+    pieces_root: bytes
+    base_layer: int
+    index: int
+    length: int
+    proof_layers: int
+
+
+@dataclass(frozen=True)
+class Hashes:
+    """BEP 52 response: the request's five fields + the hash payload
+    (``length`` run hashes then ``proof_layers`` uncles, 32 bytes each)."""
+
+    pieces_root: bytes
+    base_layer: int
+    index: int
+    length: int
+    proof_layers: int
+    hashes: bytes
+
+    def hash_list(self) -> list[bytes]:
+        return [self.hashes[i : i + 32] for i in range(0, len(self.hashes), 32)]
+
+
+@dataclass(frozen=True)
+class HashReject:
+    """BEP 52: refusal of one HashRequest (fields echo the request)."""
+
+    pieces_root: bytes
+    base_layer: int
+    index: int
+    length: int
+    proof_layers: int
+
+
+@dataclass(frozen=True)
 class Extended:
     """BEP 10 frame: <id 20><ext_id u8><payload>. ext_id 0 = ext handshake."""
 
@@ -156,8 +203,31 @@ class Extended:
 
 PeerMsg = (
     KeepAlive | Choke | Unchoke | Interested | NotInterested | Have | BitfieldMsg | Request | Piece | Cancel
-    | SuggestPiece | HaveAll | HaveNone | RejectRequest | AllowedFast | Extended
+    | SuggestPiece | HaveAll | HaveNone | RejectRequest | AllowedFast
+    | HashRequest | Hashes | HashReject | Extended
 )
+
+
+def _hash_fields(msg) -> bytes:
+    return (
+        msg.pieces_root
+        + write_int(msg.base_layer, 4)
+        + write_int(msg.index, 4)
+        + write_int(msg.length, 4)
+        + write_int(msg.proof_layers, 4)
+    )
+
+
+def _parse_hash_fields(payload: bytes):
+    if len(payload) < 48:
+        raise ProtocolError("short BEP 52 hash message")
+    return (
+        payload[:32],
+        read_int(payload, 4, 32),
+        read_int(payload, 4, 36),
+        read_int(payload, 4, 40),
+        read_int(payload, 4, 44),
+    )
 
 # BEP 6 handshake advertisement: bit 0x04 of reserved byte 7.
 FAST_RESERVED_BYTE = 7
@@ -275,6 +345,12 @@ def encode_message(msg: PeerMsg) -> bytes:
             )
         case AllowedFast(index):
             return _frame(MsgId.ALLOWED_FAST, write_int(index, 4))
+        case HashRequest():
+            return _frame(MsgId.HASH_REQUEST, _hash_fields(msg))
+        case Hashes():
+            return _frame(MsgId.HASHES, _hash_fields(msg) + msg.hashes)
+        case HashReject():
+            return _frame(MsgId.HASH_REJECT, _hash_fields(msg))
         case Extended(ext_id, payload):
             return _frame(MsgId.EXTENDED, bytes([ext_id]) + payload)
     raise ProtocolError(f"cannot encode {msg!r}")
@@ -326,6 +402,12 @@ def decode_message(msg_id: int, payload: bytes) -> PeerMsg | None:
         )
     if msg_id == MsgId.ALLOWED_FAST and len(payload) == 4:
         return AllowedFast(index=read_int(payload, 4))
+    if msg_id == MsgId.HASH_REQUEST and len(payload) == 48:
+        return HashRequest(*_parse_hash_fields(payload))
+    if msg_id == MsgId.HASHES and len(payload) >= 48 and (len(payload) - 48) % 32 == 0:
+        return Hashes(*_parse_hash_fields(payload), hashes=payload[48:])
+    if msg_id == MsgId.HASH_REJECT and len(payload) == 48:
+        return HashReject(*_parse_hash_fields(payload))
     if msg_id == MsgId.EXTENDED and len(payload) >= 1:
         return Extended(ext_id=payload[0], payload=payload[1:])
     if msg_id in set(MsgId):

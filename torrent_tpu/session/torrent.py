@@ -52,6 +52,8 @@ from torrent_tpu.utils.log import get_logger
 
 log = get_logger("session.torrent")
 
+_UNSET = object()  # lazy-field sentinel (None is a meaningful value)
+
 
 class TorrentState(Enum):
     """(torrent.ts:39-43 — which the reference never advances, §8.3)."""
@@ -192,6 +194,8 @@ class Torrent:
         # re-encode of the decoded dict (decode preserves key order, so
         # sha1(info_bytes) == info_hash).
         self._info_bytes: bytes | None = None
+        # BEP 52 merkle layer cache (hybrid torrents), built on first use
+        self._hash_cache = _UNSET
 
         # live announce counters (fixed vs torrent.ts:66-69 which never
         # updates them)
@@ -876,8 +880,79 @@ class Torrent:
                         )
                     else:
                         await self._fill_pipeline(peer)
+            case proto.HashRequest():
+                await self._serve_hash_request(peer, msg)
+            case proto.Hashes() | proto.HashReject():
+                pass  # we serve hashes; the fetch side arrives with full
+                # v2-swarm downloads (the verify plane already handles
+                # layer validation for authored/checked torrents)
             case proto.Extended(ext_id, payload):
                 await self._handle_extended(peer, ext_id, payload)
+
+    # ------------------------------------------------- BEP 52 hash serving
+
+    def _hash_tree_cache(self):
+        """Lazy per-torrent merkle layer cache for hybrid torrents.
+
+        Hybrid `.torrent`s (BEP 52 upgrade path) carry a top-level
+        ``piece layers`` dict alongside the v1 info; v2-capable peers on
+        the v1 swarm may ask us for subtree hashes (messages 21-23).
+        Returns None for plain v1 torrents — those requests get rejects.
+        """
+        if self._hash_cache is _UNSET:
+            self._hash_cache = None
+            layers_raw = self.metainfo.raw.get(b"piece layers")
+            if isinstance(layers_raw, dict) and layers_raw:
+                from torrent_tpu.models.hashes import HashTreeCache
+
+                layers = {}
+                for root, blob in layers_raw.items():
+                    if isinstance(root, bytes) and len(root) == 32 and isinstance(blob, bytes):
+                        layers[root] = tuple(
+                            blob[i : i + 32] for i in range(0, len(blob), 32)
+                        )
+                if layers:
+                    cache = HashTreeCache(layers, self.info.piece_length)
+                    # single-piece files: their pieces root appears only
+                    # in the info file tree, not in piece layers
+                    info_raw = self.metainfo.raw.get(b"info", {})
+                    singles = []
+
+                    def walk(node):
+                        if not isinstance(node, dict):
+                            return
+                        for k, v in node.items():
+                            if k == b"" and isinstance(v, dict):
+                                pr = v.get(b"pieces root")
+                                if isinstance(pr, bytes) and len(pr) == 32 and pr not in layers:
+                                    singles.append(pr)
+                            else:
+                                walk(v)
+
+                    walk(info_raw.get(b"file tree", {}))
+                    cache.add_single_piece_roots(singles)
+                    self._hash_cache = cache
+        return self._hash_cache
+
+    async def _serve_hash_request(self, peer: PeerConnection, msg) -> None:
+        from torrent_tpu.models.hashes import HashRequestFields
+
+        fields = (msg.pieces_root, msg.base_layer, msg.index, msg.length, msg.proof_layers)
+        cache = self._hash_tree_cache()
+        served = None
+        if cache is not None:
+            # the first request per root rebuilds that file's merkle
+            # levels (~200k sha256 for a 100k-piece layer) — off the
+            # event loop, so piece traffic and timers keep flowing
+            served = await asyncio.to_thread(
+                cache.serve, HashRequestFields(*fields)
+            )
+        if served is None:
+            await proto.send_message(peer.writer, proto.HashReject(*fields))
+            return
+        await proto.send_message(
+            peer.writer, proto.Hashes(*fields, hashes=b"".join(served))
+        )
 
     # ----------------------------------------------------- BEP 10 extensions
 

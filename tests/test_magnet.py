@@ -240,3 +240,50 @@ class TestMagnetE2E:
                 await fetch_metadata(magnet, peer_id=generate_peer_id(), peer_timeout=1.0)
 
         run(go())
+
+
+class TestBtmh:
+    def test_hybrid_magnet_carries_both_topics(self):
+        from torrent_tpu.codec.magnet import Magnet, parse_magnet
+
+        uri = (
+            "magnet:?xt=urn:btih:" + "ab" * 20 + "&xt=urn:btmh:1220" + "cd" * 32
+        )
+        m = parse_magnet(uri)
+        assert m.info_hash == bytes.fromhex("ab" * 20)
+        assert m.info_hash_v2 == bytes.fromhex("cd" * 32)
+        assert parse_magnet(m.to_uri()) == m
+
+    def test_v2_only_parses_but_download_refused(self):
+        import asyncio
+
+        from torrent_tpu.codec.magnet import parse_magnet
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        m = parse_magnet("magnet:?xt=urn:btmh:1220" + "ee" * 32)
+        assert m.info_hash is None and m.info_hash_v2 is not None
+
+        async def go():
+            c = Client(ClientConfig(port=0, enable_upnp=False))
+            await c.start()
+            try:
+                with __import__("pytest").raises(ValueError, match="btmh"):
+                    await c.add_magnet(m, "/tmp")
+            finally:
+                await c.close()
+
+        asyncio.run(asyncio.wait_for(go(), 30))
+
+    def test_unrecognized_multihash_skipped_not_fatal(self):
+        import pytest
+
+        from torrent_tpu.codec.magnet import MagnetError, parse_magnet
+
+        # a hybrid magnet's btih must survive an exotic btmh beside it
+        m = parse_magnet(
+            "magnet:?xt=urn:btih:" + "ab" * 20 + "&xt=urn:btmh:1320" + "cd" * 32
+        )
+        assert m.info_hash is not None and m.info_hash_v2 is None
+        # junk btmh alone leaves no usable topic at all
+        with pytest.raises(MagnetError):
+            parse_magnet("magnet:?xt=urn:btmh:1220" + "cd" * 16)

@@ -26,13 +26,23 @@ class MagnetError(ValueError):
 
 @dataclass(frozen=True)
 class Magnet:
-    info_hash: bytes  # 20 raw bytes
+    # v1 (btih, 20 bytes) and/or v2 (btmh sha2-256 multihash, 32 bytes)
+    # exact topics; hybrid magnets carry both, pure-v2 only the latter
+    info_hash: bytes | None = None
     display_name: str | None = None
     trackers: tuple[str, ...] = ()
     peer_addrs: tuple[tuple[str, int], ...] = field(default_factory=tuple)
+    info_hash_v2: bytes | None = None
 
     def to_uri(self) -> str:
-        parts = [f"magnet:?xt=urn:btih:{self.info_hash.hex()}"]
+        topics = []
+        if self.info_hash is not None:
+            topics.append(f"xt=urn:btih:{self.info_hash.hex()}")
+        if self.info_hash_v2 is not None:
+            topics.append(f"xt=urn:btmh:1220{self.info_hash_v2.hex()}")
+        if not topics:
+            raise MagnetError("magnet needs at least one exact topic")
+        parts = ["magnet:?" + topics[0]] + topics[1:]
         if self.display_name:
             from urllib.parse import quote
 
@@ -68,12 +78,22 @@ def parse_magnet(uri: str) -> Magnet:
         raise MagnetError(f"not a magnet URI: {uri!r}")
     params = parse_qs(parsed.query)
     info_hash = None
+    info_hash_v2 = None
     for xt in params.get("xt", []):
-        if xt.startswith("urn:btih:"):
+        if xt.startswith("urn:btih:") and info_hash is None:
             info_hash = _decode_btih(xt[len("urn:btih:") :])
-            break
-    if info_hash is None:
-        raise MagnetError("magnet URI has no urn:btih exact topic")
+        elif xt.startswith("urn:btmh:") and info_hash_v2 is None:
+            # BEP 52: sha2-256 multihash — 0x12 (sha2-256) 0x20 (32 bytes).
+            # Unrecognized algos/shapes are SKIPPED, not fatal: a hybrid
+            # magnet's btih topic must stay usable whatever rides beside it
+            mh = xt[len("urn:btmh:") :]
+            if len(mh) == 68 and mh.lower().startswith("1220"):
+                try:
+                    info_hash_v2 = binascii.unhexlify(mh[4:])
+                except binascii.Error:
+                    pass
+    if info_hash is None and info_hash_v2 is None:
+        raise MagnetError("magnet URI has no urn:btih/btmh exact topic")
     peers: list[tuple[str, int]] = []
     for pe in params.get("x.pe", []):
         host, _, port_s = pe.rpartition(":")
@@ -86,6 +106,7 @@ def parse_magnet(uri: str) -> Magnet:
         peers.append((host.strip("[]"), port))
     return Magnet(
         info_hash=info_hash,
+        info_hash_v2=info_hash_v2,
         display_name=params["dn"][0] if params.get("dn") else None,
         trackers=tuple(params.get("tr", [])),
         peer_addrs=tuple(peers),

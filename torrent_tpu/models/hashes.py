@@ -63,8 +63,18 @@ class HashTreeCache:
         self.base = _layer_height(piece_length)
         self._trees: dict[bytes, list[list[bytes]]] = {}
         self._single_roots: set[bytes] = set()
+        # serve() runs in worker threads (session offloads the first
+        # build); one lock bounds a pipelined burst of requests for the
+        # same root to a single tree construction
+        import threading
+
+        self._build_lock = threading.Lock()
 
     def _tree_for(self, root: bytes) -> list[list[bytes]] | None:
+        with self._build_lock:
+            return self._tree_for_locked(root)
+
+    def _tree_for_locked(self, root: bytes) -> list[list[bytes]] | None:
         tree = self._trees.get(root)
         if tree is not None:
             return tree
@@ -137,15 +147,13 @@ class HashTreeCache:
         return run + proofs
 
 
-def verify_hash_response(
-    req: HashRequestFields, hashes: list[bytes], expect_proof_to_root: bool = True
-) -> bool:
+def verify_hash_response(req: HashRequestFields, hashes: list[bytes]) -> bool:
     """Client-side acceptance: the run + proofs must chain to pieces_root.
 
-    With ``proof_layers`` covering the whole distance to the root (the
-    normal request shape), the reduction must land exactly on
-    ``req.pieces_root``; otherwise the final node is unverifiable and we
-    refuse (a partial proof proves nothing without a trusted midpoint).
+    ``proof_layers`` must cover the whole distance to the root (the
+    normal request shape); anything shorter reduces to an unverifiable
+    midpoint and is refused — a partial proof proves nothing without a
+    trusted intermediate digest.
     """
     if (
         req.length < 1
@@ -168,6 +176,4 @@ def verify_hash_response(
         pair = (sibling + node) if pos & 1 else (node + sibling)
         node = hashlib.sha256(pair).digest()
         pos >>= 1
-    if expect_proof_to_root:
-        return pos == 0 and node == req.pieces_root
-    return True
+    return pos == 0 and node == req.pieces_root

@@ -224,6 +224,13 @@ class Client:
             magnet = parse_magnet(magnet)
         if not isinstance(magnet, Magnet):
             raise TypeError("magnet must be a Magnet or magnet URI string")
+        if magnet.info_hash is None:
+            # pure-v2 magnet (btmh only): v2 swarm downloads need the
+            # BEP 52 hash-fetch client side; hybrids carry btih and work
+            raise ValueError(
+                "v2-only magnet (urn:btmh) downloads are not supported yet — "
+                "hybrid magnets with a urn:btih topic work"
+            )
         if magnet.info_hash in self.torrents:
             raise ValueError("torrent already added")
         # Throwaway peer id for the metadata connections: if the fetch

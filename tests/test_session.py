@@ -1064,3 +1064,102 @@ class TestServeCache:
             assert blocks[0].block == payload[:BLOCK_SIZE]
 
         run(go())
+
+
+class TestSwarmResilience:
+    async def _swarm(self, tmp_path, n_pieces=24):
+        import os
+
+        plen = 32768
+        rng = np.random.default_rng(77)
+        payload = rng.integers(0, 256, n_pieces * plen - 123, dtype=np.uint8).tobytes()
+        data = None
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+
+        server, _ = await run_tracker(ServeOptions(http_port=0, udp_port=None, interval=1))
+        data = build_torrent_bytes(
+            payload, plen, b"http://127.0.0.1:%d/announce" % server.http_port,
+            name=b"resil.bin",
+        )
+        m = parse_metainfo(data)
+        seed_dir = str(tmp_path / "seed")
+        os.makedirs(seed_dir, exist_ok=True)
+        with open(os.path.join(seed_dir, "resil.bin"), "wb") as f:
+            f.write(payload)
+        return server, m, payload, seed_dir
+
+    def test_leech_survives_seed_death(self, tmp_path):
+        """A seed dying mid-transfer must not stall the leech: its
+        in-flight blocks release and the survivor finishes the job."""
+        import os
+
+        async def go():
+            server, m, payload, seed_dir = await self._swarm(tmp_path)
+            c_seed1 = Client(ClientConfig(port=0, enable_upnp=False))
+            c_seed2 = Client(ClientConfig(port=0, enable_upnp=False))
+            c_leech = Client(ClientConfig(port=0, enable_upnp=False))
+            for c in (c_seed1, c_seed2, c_leech):
+                await c.start()
+            try:
+                await c_seed1.add(m, seed_dir)
+                await c_seed2.add(m, seed_dir)
+                leech_dir = str(tmp_path / "leech1")
+                os.makedirs(leech_dir)
+                t = await c_leech.add(m, leech_dir)
+                # kill seed 1 as soon as the transfer is moving
+                for _ in range(600):
+                    if t.bitfield.count() >= 4:
+                        break
+                    await asyncio.sleep(0.02)
+                await c_seed1.close()
+                for _ in range(600):
+                    if t.bitfield.complete:
+                        break
+                    await asyncio.sleep(0.05)
+                assert t.bitfield.complete, f"stalled after seed death: {t.status()}"
+                got = open(os.path.join(leech_dir, "resil.bin"), "rb").read()
+                assert got == payload
+            finally:
+                await c_seed2.close()
+                await c_leech.close()
+                server.close()
+
+        run(go(), timeout=90)
+
+    def test_leeches_trade_pieces(self, tmp_path):
+        """Two leeches on one seed end up serving each other (the
+        have-broadcast + request path between non-seeds)."""
+        import os
+
+        async def go():
+            server, m, payload, seed_dir = await self._swarm(tmp_path)
+            c_seed = Client(ClientConfig(port=0, enable_upnp=False))
+            c_l1 = Client(ClientConfig(port=0, enable_upnp=False))
+            c_l2 = Client(ClientConfig(port=0, enable_upnp=False))
+            for c in (c_seed, c_l1, c_l2):
+                await c.start()
+            try:
+                await c_seed.add(m, seed_dir)
+                d1, d2 = str(tmp_path / "l1"), str(tmp_path / "l2")
+                os.makedirs(d1)
+                os.makedirs(d2)
+                t1 = await c_l1.add(m, d1)
+                t2 = await c_l2.add(m, d2)
+                for _ in range(800):
+                    if t1.bitfield.complete and t2.bitfield.complete:
+                        break
+                    await asyncio.sleep(0.05)
+                assert t1.bitfield.complete and t2.bitfield.complete
+                for d in (d1, d2):
+                    got = open(os.path.join(d, "resil.bin"), "rb").read()
+                    assert got == payload
+                # at least one leech uploaded to the other (piece trading)
+                assert t1.uploaded + t2.uploaded > 0
+            finally:
+                await c_seed.close()
+                await c_l1.close()
+                await c_l2.close()
+                server.close()
+
+        run(go(), timeout=120)

@@ -57,6 +57,9 @@ class ClientConfig:
     # BEP 29 uTP transport (net/utp.py): accept uTP peers on the same
     # port (UDP) and prefer uTP for outbound dials, TCP fallback
     enable_utp: bool = False
+    # CIDR blocklist ("10.0.0.0/8", "2001:db8::/32", single IPs too):
+    # matching peers are neither dialed nor accepted
+    ip_filter: tuple = ()
 
 
 class Client:
@@ -74,6 +77,12 @@ class Client:
         self.download_bucket = TokenBucket(self.config.max_download_bps)
         self.lsd = None  # net.lsd.LocalServiceDiscovery when enable_lsd
         self.utp = None  # net.utp.UtpEndpoint when enable_utp
+        if self.config.ip_filter:
+            from torrent_tpu.net.ipfilter import IpFilter
+
+            self.ip_filter = IpFilter(self.config.ip_filter)
+        else:
+            self.ip_filter = None
 
     # ------------------------------------------------------------- startup
 
@@ -200,6 +209,7 @@ class Client:
             download_bucket=self.download_bucket,
             external_ip=self.external_ip,
             utp_dial=self.utp.dial if self.utp is not None else None,
+            ip_filter=self.ip_filter,
         )
         self.torrents[metainfo.info_hash] = torrent
         await torrent.start()
@@ -238,7 +248,11 @@ class Client:
         # download dials in, our own id would trip its duplicate-peer
         # guard and the data connection would be dropped.
         metainfo = await fetch_metadata(
-            magnet, peer_id=generate_peer_id(), port=self.port, dht=self.dht
+            magnet,
+            peer_id=generate_peer_id(),
+            port=self.port,
+            dht=self.dht,
+            ip_filter=self.ip_filter,
         )
         torrent = await self.add(metainfo, storage)
         if magnet.peer_addrs:
@@ -284,6 +298,14 @@ class Client:
         """Inbound handshake: route on info hash before replying
         (client.ts:85-104)."""
         try:
+            peername = writer.get_extra_info("peername")
+            if (
+                peername
+                and self.ip_filter is not None
+                and self.ip_filter.blocked(peername[0])
+            ):
+                writer.close()  # blocklisted: drop before reading ANY bytes
+                return
             info_hash, reserved = await asyncio.wait_for(
                 proto.read_handshake_head(reader), timeout=15
             )

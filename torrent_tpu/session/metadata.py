@@ -102,6 +102,7 @@ async def fetch_metadata(
     peer_timeout: float = 10.0,
     max_concurrent: int = 8,
     dht=None,
+    ip_filter=None,  # optional net.ipfilter.IpFilter: candidates never dialed
 ) -> Metainfo:
     """Resolve a magnet to a full ``Metainfo`` using trackers + x.pe peers
     + (when a ``net.dht.DHTNode`` is supplied) mainline-DHT discovery.
@@ -135,6 +136,10 @@ async def fetch_metadata(
                 log.warning("magnet announce to %s failed: %s", tr, e)
     seen: set[tuple[str, int]] = set()
     candidates = [c for c in candidates if not (c in seen or seen.add(c))]
+    if ip_filter is not None:
+        # the blocklist covers the metadata fetch too — "never dialed"
+        # must hold before the torrent object even exists
+        candidates = [c for c in candidates if not ip_filter.blocked(c[0])]
     if not candidates:
         raise MetadataError("magnet has no reachable peer sources")
 

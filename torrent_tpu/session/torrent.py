@@ -129,6 +129,7 @@ class Torrent:
         download_bucket=None,
         external_ip=None,  # our public address, for BEP 40 dial ordering
         utp_dial=None,  # optional BEP 29 dialer: async (host, port) -> streams
+        ip_filter=None,  # optional net.ipfilter.IpFilter (client-global)
     ):
         from torrent_tpu.net.multitracker import TrackerList, parse_announce_list
 
@@ -145,6 +146,7 @@ class Torrent:
         self.download_bucket = download_bucket
         self.external_ip = external_ip
         self._utp_dial = utp_dial
+        self.ip_filter = ip_filter
         self.trackers = TrackerList(
             metainfo.announce, parse_announce_list(metainfo.raw)
         )
@@ -576,6 +578,8 @@ class Torrent:
                 continue
             if cand.ip in self._banned:
                 continue
+            if self.ip_filter is not None and self.ip_filter.blocked(cand.ip):
+                continue
             if cand.peer_id == self.peer_id:
                 continue
             self._dialing.add(addr)
@@ -679,6 +683,9 @@ class Torrent:
             return
         if address and address[0] in self._banned:
             writer.close()  # banned peers don't get back in by reconnecting
+            return
+        if address and self.ip_filter is not None and self.ip_filter.blocked(address[0]):
+            writer.close()  # blocklisted ranges are refused inbound too
             return
         peer = PeerConnection(
             peer_id=peer_id,

@@ -223,3 +223,147 @@ class TestVerifyTotality:
         assert cache.serve(
             HashRequestFields(root, cache.base, 0, MAX_RUN * 2, 0)
         ) is None
+
+
+class TestLayerFetch:
+    def test_magnet_style_leech_fetches_layers_from_seed(self, tmp_path):
+        """The fetch side: a leech whose metainfo lacks piece layers (the
+        ut_metadata case — layers live outside the info dict) pulls them
+        from a connected peer, verifies against the trusted pieces root,
+        and becomes able to serve hash requests itself."""
+        import asyncio
+        import os
+
+        import numpy as np
+
+        from tests.test_session import run
+        from torrent_tpu.codec.bencode import bdecode, bencode
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.models.v2 import build_hybrid
+        from torrent_tpu.models.hashes import HashRequestFields
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            plen = 4 * BLOCK
+            payload = np.random.default_rng(6).integers(
+                0, 256, 6 * plen + 99, dtype=np.uint8
+            ).tobytes()
+            server, _ = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            announce = "http://127.0.0.1:%d/announce" % server.http_port
+            data, meta = build_hybrid(
+                [(("lf.bin",), payload)],
+                name="lf.bin",
+                piece_length=plen,
+                hasher="cpu",
+                announce=announce,
+            )
+            stripped = dict(bdecode(data))
+            del stripped[b"piece layers"]
+            data_stripped = bencode(stripped, sort_keys=False)
+            m_full = parse_metainfo(data)
+            m_stripped = parse_metainfo(data_stripped)
+            assert m_full.info_hash == m_stripped.info_hash  # info untouched
+
+            seed_dir = str(tmp_path / "seedv2")
+            os.makedirs(seed_dir)
+            open(os.path.join(seed_dir, "lf.bin"), "wb").write(payload)
+            c_seed = Client(ClientConfig(port=0, enable_upnp=False))
+            c_leech = Client(ClientConfig(port=0, enable_upnp=False))
+            await c_seed.start()
+            await c_leech.start()
+            try:
+                t_seed = await c_seed.add(m_full, seed_dir)
+                assert t_seed._hash_tree_cache() is not None
+                leech_dir = str(tmp_path / "leechv2")
+                os.makedirs(leech_dir)
+                t = await c_leech.add(m_stripped, leech_dir)
+                assert t._hash_tree_cache() is None  # layers missing
+                for _ in range(400):
+                    if t.peers:
+                        break
+                    await asyncio.sleep(0.02)
+                assert t.peers, "leech never connected to seed"
+                ok = await t.fetch_v2_layers(timeout=10)
+                assert ok, "layer fetch failed"
+                cache = t._hash_tree_cache()
+                assert cache is not None
+                # the leech can now serve the full verified layer onward
+                root = next(iter(meta.piece_layers))
+                served = cache.serve(HashRequestFields(root, cache.base, 0, 8, 0))
+                assert served is not None
+            finally:
+                await c_seed.close()
+                await c_leech.close()
+                server.close()
+
+        run(go(), timeout=90)
+
+    def test_chunked_fetch_for_large_layers(self, tmp_path):
+        """A >MAX_RUN-piece file fetches its layer in proof-chained
+        chunks (the whole-layer request would exceed the DoS bound)."""
+        import asyncio
+        import os
+
+        import numpy as np
+
+        from tests.test_session import run
+        from torrent_tpu.codec.bencode import bdecode, bencode
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.models.v2 import build_hybrid
+        from torrent_tpu.models.hashes import MAX_RUN
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            plen = BLOCK  # 16 KiB pieces keep the payload small
+            n_pieces = MAX_RUN + 70  # padded 1024 > MAX_RUN -> chunked
+            payload = np.random.default_rng(8).integers(
+                0, 256, n_pieces * plen - 55, dtype=np.uint8
+            ).tobytes()
+            server, _ = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            announce = "http://127.0.0.1:%d/announce" % server.http_port
+            data, meta = build_hybrid(
+                [(("big.bin",), payload)],
+                name="big.bin",
+                piece_length=plen,
+                hasher="cpu",
+                announce=announce,
+            )
+            stripped = dict(bdecode(data))
+            del stripped[b"piece layers"]
+            m_full = parse_metainfo(data)
+            m_stripped = parse_metainfo(bencode(stripped, sort_keys=False))
+            seed_dir = str(tmp_path / "bseed")
+            os.makedirs(seed_dir)
+            open(os.path.join(seed_dir, "big.bin"), "wb").write(payload)
+            c_seed = Client(ClientConfig(port=0, enable_upnp=False))
+            c_leech = Client(ClientConfig(port=0, enable_upnp=False))
+            await c_seed.start()
+            await c_leech.start()
+            try:
+                await c_seed.add(m_full, seed_dir)
+                leech_dir = str(tmp_path / "bleech")
+                os.makedirs(leech_dir)
+                t = await c_leech.add(m_stripped, leech_dir)
+                for _ in range(400):
+                    if t.peers:
+                        break
+                    await asyncio.sleep(0.02)
+                assert t.peers
+                ok = await t.fetch_v2_layers(timeout=20)
+                assert ok, "chunked layer fetch failed"
+                root = next(iter(meta.piece_layers))
+                assert t._hash_tree_cache().piece_layers[root] == meta.piece_layers[root]
+            finally:
+                await c_seed.close()
+                await c_leech.close()
+                server.close()
+
+        run(go(), timeout=120)

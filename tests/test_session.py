@@ -1129,12 +1129,19 @@ class TestSwarmResilience:
 
     def test_leeches_trade_pieces(self, tmp_path):
         """Two leeches on one seed end up serving each other (the
-        have-broadcast + request path between non-seeds)."""
+        have-broadcast + request path between non-seeds). The seed
+        accepts only ONE peer, so the second leech can complete ONLY
+        through the first — trading is structural, not a race."""
         import os
 
         async def go():
             server, m, payload, seed_dir = await self._swarm(tmp_path)
-            c_seed = Client(ClientConfig(port=0, enable_upnp=False))
+            c_seed = Client(
+                ClientConfig(
+                    port=0, enable_upnp=False,
+                    torrent=TorrentConfig(max_peers=1, choke_interval=0.15),
+                )
+            )
             c_l1 = Client(ClientConfig(port=0, enable_upnp=False))
             c_l2 = Client(ClientConfig(port=0, enable_upnp=False))
             for c in (c_seed, c_l1, c_l2):
@@ -1146,15 +1153,18 @@ class TestSwarmResilience:
                 os.makedirs(d2)
                 t1 = await c_l1.add(m, d1)
                 t2 = await c_l2.add(m, d2)
-                for _ in range(800):
+                for _ in range(1600):
                     if t1.bitfield.complete and t2.bitfield.complete:
                         break
                     await asyncio.sleep(0.05)
-                assert t1.bitfield.complete and t2.bitfield.complete
+                assert t1.bitfield.complete and t2.bitfield.complete, (
+                    t1.status(), t2.status(),
+                )
                 for d in (d1, d2):
                     got = open(os.path.join(d, "resil.bin"), "rb").read()
                     assert got == payload
-                # at least one leech uploaded to the other (piece trading)
+                # the seed served exactly one leech; the other's bytes
+                # came peer-to-peer, so SOME leech upload must exist
                 assert t1.uploaded + t2.uploaded > 0
             finally:
                 await c_seed.close()

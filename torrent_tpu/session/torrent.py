@@ -198,6 +198,8 @@ class Torrent:
         self._info_bytes: bytes | None = None
         # BEP 52 merkle layer cache (hybrid torrents), built on first use
         self._hash_cache = _UNSET
+        # outstanding layer fetches: request fields -> Future[hashes|None]
+        self._hash_fetches: dict[tuple, asyncio.Future] = {}
 
         # live announce counters (fixed vs torrent.ts:66-69 which never
         # updates them)
@@ -890,9 +892,22 @@ class Torrent:
             case proto.HashRequest():
                 await self._serve_hash_request(peer, msg)
             case proto.Hashes() | proto.HashReject():
-                pass  # we serve hashes; the fetch side arrives with full
-                # v2-swarm downloads (the verify plane already handles
-                # layer validation for authored/checked torrents)
+                # responses are routed by (sender, fields): another peer
+                # echoing the same fields must not resolve — or poison —
+                # a wait addressed to someone else
+                key = (
+                    peer.peer_id,
+                    msg.pieces_root,
+                    msg.base_layer,
+                    msg.index,
+                    msg.length,
+                    msg.proof_layers,
+                )
+                fut = self._hash_fetches.get(key)
+                if fut is not None and not fut.done():
+                    fut.set_result(
+                        msg.hash_list() if isinstance(msg, proto.Hashes) else None
+                    )
             case proto.Extended(ext_id, payload):
                 await self._handle_extended(peer, ext_id, payload)
 
@@ -922,24 +937,113 @@ class Torrent:
                     cache = HashTreeCache(layers, self.info.piece_length)
                     # single-piece files: their pieces root appears only
                     # in the info file tree, not in piece layers
-                    info_raw = self.metainfo.raw.get(b"info", {})
-                    singles = []
-
-                    def walk(node):
-                        if not isinstance(node, dict):
-                            return
-                        for k, v in node.items():
-                            if k == b"" and isinstance(v, dict):
-                                pr = v.get(b"pieces root")
-                                if isinstance(pr, bytes) and len(pr) == 32 and pr not in layers:
-                                    singles.append(pr)
-                            else:
-                                walk(v)
-
-                    walk(info_raw.get(b"file tree", {}))
-                    cache.add_single_piece_roots(singles)
+                    cache.add_single_piece_roots(
+                        r for r, _ in self._v2_file_roots() if r not in layers
+                    )
                     self._hash_cache = cache
         return self._hash_cache
+
+    def _v2_file_roots(self) -> list[tuple[bytes, int]]:
+        """``(pieces_root, length)`` per file from the info file tree
+        (hybrid torrents); empty for plain v1."""
+        info_raw = self.metainfo.raw.get(b"info")
+        if not isinstance(info_raw, dict):
+            return []
+        out = []
+
+        def walk(node):
+            if not isinstance(node, dict):
+                return
+            for k, v in node.items():
+                if k == b"" and isinstance(v, dict):
+                    pr = v.get(b"pieces root")
+                    ln = v.get(b"length")
+                    if isinstance(pr, bytes) and len(pr) == 32 and isinstance(ln, int):
+                        out.append((pr, ln))
+                else:
+                    walk(v)
+
+        walk(info_raw.get(b"file tree", {}))
+        return out
+
+    async def _fetch_hash_run(
+        self, fields: tuple, req, deadline: float, per_peer: float
+    ):
+        """Ask connected peers (sequentially, short per-peer timeout) for
+        one verified hash run; None when nobody delivers in time."""
+        from torrent_tpu.models.hashes import verify_hash_response
+
+        for peer in list(self.peers.values()):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            key = (peer.peer_id, *fields)
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._hash_fetches[key] = fut
+            try:
+                await proto.send_message(peer.writer, proto.HashRequest(*fields))
+                got = await asyncio.wait_for(fut, min(per_peer, remaining))
+            except (asyncio.TimeoutError, ConnectionError, OSError):
+                got = None
+            finally:
+                self._hash_fetches.pop(key, None)
+            if got and verify_hash_response(req, got):
+                return got
+        return None
+
+    async def fetch_v2_layers(self, timeout: float = 30.0, per_peer: float = 5.0) -> bool:
+        """BEP 52 fetch side: pull missing piece layers from the swarm.
+
+        A magnet-joined hybrid learns its info dict via ut_metadata, but
+        piece layers live OUTSIDE the info dict — without them we can't
+        serve hash requests onward. Every run is verified against the
+        trusted ``pieces root`` before acceptance: small layers are
+        fetched whole (the full layer reduces directly to the root),
+        large ones in MAX_RUN chunks whose uncle proofs chain each chunk
+        to the root independently. Peers are tried with a short per-peer
+        timeout under one overall deadline (v1-only peers simply never
+        answer message 21). Returns True when every multi-piece file's
+        layer verified and installed (the torrent then serves onward).
+        """
+        from torrent_tpu.models.hashes import (
+            HashRequestFields,
+            HashTreeCache,
+            MAX_RUN,
+            _layer_height,
+        )
+
+        if self._hash_tree_cache() is not None:
+            return True  # already have layers (authored/parsed from disk)
+        roots = self._v2_file_roots()
+        if not roots:
+            return False  # not a hybrid torrent
+        plen = self.info.piece_length
+        base = _layer_height(plen)
+        deadline = time.monotonic() + timeout
+        layers: dict[bytes, tuple[bytes, ...]] = {}
+        singles = []
+        for root, length in roots:
+            n_pieces = max(1, -(-length // plen))
+            if n_pieces == 1:
+                singles.append(root)
+                continue
+            padded = 1 << (n_pieces - 1).bit_length()
+            run = min(padded, MAX_RUN)
+            # chunks above MAX_RUN verify via uncle proofs up to the root
+            proofs = (padded.bit_length() - 1) - (run.bit_length() - 1)
+            got_all: list[bytes] = []
+            for start in range(0, min(padded, n_pieces), run):
+                fields = (root, base, start, run, proofs)
+                req = HashRequestFields(*fields)
+                got = await self._fetch_hash_run(fields, req, deadline, per_peer)
+                if got is None:
+                    return False
+                got_all.extend(got[:run])
+            layers[root] = tuple(got_all[:n_pieces])
+        cache = HashTreeCache(layers, plen)
+        cache.add_single_piece_roots(singles)
+        self._hash_cache = cache
+        return True
 
     async def _serve_hash_request(self, peer: PeerConnection, msg) -> None:
         from torrent_tpu.models.hashes import HashRequestFields

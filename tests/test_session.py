@@ -1173,3 +1173,68 @@ class TestSwarmResilience:
                 server.close()
 
         run(go(), timeout=120)
+
+
+class TestPauseResume:
+    def test_pause_mid_transfer_then_resume_completes(self, tmp_path):
+        """Pause stops all transfer (both directions, connections kept);
+        resume finishes the download."""
+        import os
+
+        async def go():
+            server, m, payload, seed_dir = await TestSwarmResilience()._swarm(
+                tmp_path
+            )
+            c_seed = Client(ClientConfig(port=0, enable_upnp=False))
+            c_leech = Client(ClientConfig(port=0, enable_upnp=False))
+            await c_seed.start()
+            await c_leech.start()
+            try:
+                await c_seed.add(m, seed_dir)
+                d = str(tmp_path / "pl")
+                os.makedirs(d)
+                t = await c_leech.add(m, d)
+                for _ in range(600):
+                    if t.bitfield.count() >= 3:
+                        break
+                    await asyncio.sleep(0.02)
+                await t.pause()
+                assert t.status()["paused"]
+                assert not any(p.inflight for p in t.peers.values())
+                frozen = t.bitfield.count()
+                await asyncio.sleep(0.8)  # several choke intervals
+                assert t.bitfield.count() == frozen  # nothing moved
+                assert t.peers  # connections survived the pause
+                await t.resume()
+                for _ in range(800):
+                    if t.bitfield.complete:
+                        break
+                    await asyncio.sleep(0.05)
+                assert t.bitfield.complete, t.status()
+                got = open(os.path.join(d, "resil.bin"), "rb").read()
+                assert got == payload
+            finally:
+                await c_seed.close()
+                await c_leech.close()
+                server.close()
+
+        run(go(), timeout=90)
+
+    def test_paused_serve_ignores_requests(self):
+        async def go():
+            t, payload = TestSchedulerUnits().make_torrent()
+            await asyncio.to_thread(t.storage.set, 0, payload)
+            for i in range(t.info.num_pieces):
+                t.bitfield.set(i)
+            p = PeerConnection(
+                peer_id=b"G" * 20, reader=object(), writer=_FakeWriter(),
+                num_pieces=t.info.num_pieces,
+            )
+            p.am_choking = False
+            t.peers[p.peer_id] = p
+            await t.pause()
+            n = len(p.writer.data)
+            await t._serve_request(p, 0, 0, BLOCK_SIZE)
+            assert len(p.writer.data) == n  # no piece went out
+
+        run(go())

@@ -185,6 +185,8 @@ class Torrent:
         # on it per block, so it must be O(1) there (the numpy recount
         # runs only on selection changes and recheck/resume)
         self._wanted_missing = self.info.num_pieces
+        # paused: transfers suspended, connections and state kept alive
+        self.paused = False
         # serve-path LRU of whole pieces (dict ordering = recency) and
         # in-flight reads shared by concurrent misses on the same piece
         self._serve_cache: dict[int, bytes] = {}
@@ -198,8 +200,11 @@ class Torrent:
         self._info_bytes: bytes | None = None
         # BEP 52 merkle layer cache (hybrid torrents), built on first use
         self._hash_cache = _UNSET
-        # outstanding layer fetches: request fields -> Future[hashes|None]
+        # outstanding layer fetches: request fields -> Future[hashes|None];
+        # the lock serializes whole fetch_v2_layers runs (concurrent runs
+        # would clobber each other's pending futures)
         self._hash_fetches: dict[tuple, asyncio.Future] = {}
+        self._fetch_layers_lock = asyncio.Lock()
 
         # live announce counters (fixed vs torrent.ts:66-69 which never
         # updates them)
@@ -530,6 +535,42 @@ class Torrent:
         """Early announce wake (torrent.ts:104-107)."""
         self._wake.set()
 
+    # ------------------------------------------------------------- pausing
+
+    async def pause(self) -> None:
+        """Suspend transfers without tearing the session down.
+
+        Connections stay up (cheap to resume; availability intact) but:
+        outstanding requests are cancelled and released, no new requests
+        or serves happen, and peers are choked. The announce loop keeps
+        its interval (trackers still see us; BEP 21-style 'paused' is
+        not a wire concept in BEP 3).
+        """
+        if self.paused:
+            return
+        self.paused = True
+        for p in list(self.peers.values()):
+            await self._cancel_and_release(p)
+            if not p.am_choking:
+                p.am_choking = True
+                try:
+                    await proto.send_message(p.writer, proto.Choke())
+                except (ConnectionError, OSError):
+                    pass
+
+    async def resume(self) -> None:
+        """Undo ``pause``: refill pipelines; the choke loop re-unchokes."""
+        if not self.paused:
+            return
+        self.paused = False
+        for p in list(self.peers.values()):
+            if p.am_interested and not p.peer_choking:
+                try:
+                    await self._fill_pipeline(p)
+                except (ConnectionError, OSError):
+                    pass
+        self.request_peers()
+
     async def _dht_loop(self) -> None:
         """BEP 5: announce our port and pull swarm peers from the DHT.
 
@@ -757,6 +798,18 @@ class Torrent:
                 self._inflight_count[blk] -= 1
         peer.inflight.clear()
         peer.inflight_choked.clear()
+
+    async def _cancel_and_release(self, peer: PeerConnection) -> None:
+        """Cancel every outstanding request to ``peer`` on the wire and
+        release the blocks for other peers (pause + snub sweep share
+        this; a dead writer just stops the cancels — release happens
+        regardless)."""
+        for blk in list(peer.inflight):
+            try:
+                await proto.send_message(peer.writer, proto.Cancel(*blk))
+            except (ConnectionError, OSError):
+                break
+        self._release_inflight(peer)
 
     async def _replace_bitfield(self, peer: PeerConnection, new_bf: Bitfield) -> None:
         """Swap a peer's piece map (bitfield / have_all / have_none),
@@ -1005,6 +1058,10 @@ class Torrent:
         answer message 21). Returns True when every multi-piece file's
         layer verified and installed (the torrent then serves onward).
         """
+        async with self._fetch_layers_lock:
+            return await self._fetch_v2_layers_locked(timeout, per_peer)
+
+    async def _fetch_v2_layers_locked(self, timeout: float, per_peer: float) -> bool:
         from torrent_tpu.models.hashes import (
             HashRequestFields,
             HashTreeCache,
@@ -1175,7 +1232,7 @@ class Torrent:
         While choked, a BEP 6 peer can still be asked for its allowed-fast
         grants — candidate pieces are then restricted to that set.
         """
-        if self.bitfield.complete or not self._wanted_remaining():
+        if self.paused or self.bitfield.complete or not self._wanted_remaining():
             return
         choked_fast = peer.peer_choking and peer.fast and bool(peer.allowed_fast_in)
         if peer.peer_choking and not choked_fast:
@@ -1274,6 +1331,11 @@ class Torrent:
         """(torrent.ts:183-193) + assembly, verification, have broadcast."""
         if not validate_received_block(self.info, index, begin, len(block)):
             raise proto.ProtocolError("invalid piece block geometry")
+        if self.paused:
+            # blocks served before the peer processed our pause-time
+            # cancels are dropped (progress must freeze; they'll be
+            # re-requested after resume)
+            return
         blk = (index, begin, len(block))
         if blk in peer.inflight:
             peer.inflight.discard(blk)
@@ -1516,6 +1578,11 @@ class Torrent:
                     peer.writer, proto.RejectRequest(index, begin, length)
                 )
 
+        if self.paused:
+            # BEP 6 contract: anything we won't serve is rejected
+            # explicitly (a request can race our pause-time Choke)
+            await refuse()
+            return
         if peer.am_choking and not (peer.fast and index in peer.allowed_fast_out):
             await refuse()
             return
@@ -1589,12 +1656,7 @@ class Torrent:
                     p.peer_id[:8].hex(),
                     len(p.inflight),
                 )
-                for blk in list(p.inflight):
-                    try:
-                        await proto.send_message(p.writer, proto.Cancel(*blk))
-                    except (ConnectionError, OSError):
-                        break
-                self._release_inflight(p)
+                await self._cancel_and_release(p)
                 # time-limited, not permanent: after the cooldown the peer
                 # is retried even without having delivered (a transient
                 # stall of EVERY peer must not deadlock the session)
@@ -1614,6 +1676,8 @@ class Torrent:
         rounds = 0
         while not self._stopping:
             await asyncio.sleep(self.config.choke_interval)
+            if self.paused:
+                continue  # pause() choked everyone; stay that way
             await self._release_snubbed()
             peers = list(self.peers.values())
             interested = [p for p in peers if p.peer_interested]
@@ -1719,6 +1783,9 @@ class Torrent:
 
         consecutive_failures = 0
         while not self._stopping and self._wanted_remaining():
+            if self.paused:
+                await asyncio.sleep(1.0)
+                continue
             picked = self._pick_webseed_pieces(self.config.webseed_concurrency)
             if not picked:
                 await asyncio.sleep(1.0)
@@ -1814,6 +1881,7 @@ class Torrent:
             "uploaded": self.uploaded,
             "left": self.left,
             "endgame": self._endgame,
+            "paused": self.paused,
             "wanted_left": self._wanted_missing,
             "sequential": self.config.sequential,
             "download_rate": round(

@@ -414,3 +414,85 @@ class TestFlowControl:
                 server.close()
 
         run(go())
+
+
+class TestUtpWithRateCap:
+    def test_throttled_swarm_over_utp(self, tmp_path):
+        """Download cap + uTP together: the token bucket pauses the peer
+        loop, uTP's advertised window pushes the backpressure to the
+        sender, and the transfer still completes at ~the capped rate."""
+        import hashlib
+        import os
+        import time as _time
+
+        import numpy as np
+
+        from torrent_tpu.codec.bencode import bencode
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            plen = 32768
+            payload = np.random.default_rng(41).integers(
+                0, 256, 8 * plen, dtype=np.uint8
+            ).tobytes()
+            digs = [
+                hashlib.sha1(payload[i : i + plen]).digest()
+                for i in range(0, len(payload), plen)
+            ]
+            server, _ = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            meta = bencode(
+                {
+                    b"announce": b"http://127.0.0.1:%d/announce" % server.http_port,
+                    b"info": {
+                        b"name": b"tu.bin",
+                        b"piece length": plen,
+                        b"pieces": b"".join(digs),
+                        b"length": len(payload),
+                    },
+                }
+            )
+            m = parse_metainfo(meta)
+            seed_dir = str(tmp_path / "tus")
+            os.makedirs(seed_dir)
+            open(os.path.join(seed_dir, "tu.bin"), "wb").write(payload)
+            c1 = Client(ClientConfig(port=0, enable_upnp=False, enable_utp=True))
+            c2 = Client(
+                ClientConfig(
+                    port=0, enable_upnp=False, enable_utp=True,
+                    max_download_bps=128 * 1024,
+                )
+            )
+            await c1.start()
+            await c2.start()
+            try:
+                await c1.add(m, seed_dir)
+                d = str(tmp_path / "tul")
+                os.makedirs(d)
+                t0 = _time.monotonic()
+                t = await c2.add(m, d)
+                for _ in range(1200):
+                    if t.bitfield.complete:
+                        break
+                    await asyncio.sleep(0.05)
+                dt = _time.monotonic() - t0
+                assert t.bitfield.complete, t.status()
+                # 256 KiB at 128 KiB/s with a 1 s burst: >= ~1 s floor
+                assert dt >= 0.9, f"cap ignored over uTP: {dt:.2f}s"
+                got = open(os.path.join(d, "tu.bin"), "rb").read()
+                assert got == payload
+                from torrent_tpu.net.utp import _UtpWriter
+
+                assert all(
+                    isinstance(p.writer, _UtpWriter) for p in t.peers.values()
+                )
+            finally:
+                await c1.close()
+                await c2.close()
+                server.close()
+
+        run(go(), timeout=90)

@@ -23,7 +23,7 @@ _SMALL = {
 
 
 def _run_bench(extra_env, timeout=300):
-    env = dict(os.environ, **_SMALL, **extra_env)
+    env = {**os.environ, **_SMALL, **extra_env}
     proc = subprocess.run(
         [sys.executable, BENCH],
         env=env,
@@ -80,3 +80,14 @@ def test_relay_timeout_emits_unavailable_marker_without_killing_child():
     assert rec["value"] is None and rec["vs_baseline"] is None
     # the contract is explicitly to LEAVE the child running
     assert "leaving it to exit cleanly" in proc.stderr
+
+
+def test_e2e_cap_marks_record():
+    """BENCH_E2E_MB: the transfer-bound pass runs over a sub-range and
+    the record carries the honest marker; the plane/baseline fields stay
+    full-scale (the RAM-blowup guard for huge configs)."""
+    proc = _run_bench({"BENCH_TOTAL_MB": "8", "BENCH_E2E_MB": "2"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["e2e_measured_mb"] == 2
+    assert rec["value"] > 0 and rec["end_to_end_pps"] > 0

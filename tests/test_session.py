@@ -1238,3 +1238,26 @@ class TestPauseResume:
             assert len(p.writer.data) == n  # no piece went out
 
         run(go())
+
+
+class TestClientPauseAll:
+    def test_pause_all_and_resume_all(self, tmp_path):
+        async def go():
+            import os
+
+            server, m, payload, seed_dir = await TestSwarmResilience()._swarm(
+                tmp_path
+            )
+            c = Client(ClientConfig(port=0, enable_upnp=False))
+            await c.start()
+            try:
+                t = await c.add(m, seed_dir)
+                await c.pause_all()
+                assert t.paused
+                await c.resume_all()
+                assert not t.paused
+            finally:
+                await c.close()
+                server.close()
+
+        run(go())

@@ -285,6 +285,15 @@ class Client:
             "torrents": torrents,
         }
 
+    async def pause_all(self) -> None:
+        """Suspend every torrent's transfers (connections kept)."""
+        for t in list(self.torrents.values()):
+            await t.pause()
+
+    async def resume_all(self) -> None:
+        for t in list(self.torrents.values()):
+            await t.resume()
+
     async def remove(self, info_hash: bytes) -> None:
         torrent = self.torrents.pop(info_hash, None)
         if self.lsd is not None:

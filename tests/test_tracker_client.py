@@ -409,3 +409,159 @@ class TestBep7Peers6:
                 assert [(p.ip, p.port) for p in res.peers] == [("::1", 9000)]
 
         run(go())
+
+
+class ScriptedHttpServer:
+    """Serves raw pre-scripted HTTP responses, one per connection, in order.
+
+    Unlike FakeHttpTracker this sends whatever bytes the script says —
+    used for redirect chains and chunked transfer-encoding, which the
+    reference's fetch() handled implicitly (tracker.ts:26-31)."""
+
+    def __init__(self, responses: list[bytes]):
+        self.responses = list(responses)
+        self.requests: list[str] = []
+        self.server = None
+        self.port = None
+
+    async def __aenter__(self):
+        async def handle(reader, writer):
+            line = (await reader.readline()).decode("latin-1").strip()
+            self.requests.append(line)
+            while (await reader.readline()) not in (b"\r\n", b""):
+                pass
+            if self.responses:
+                writer.write(self.responses.pop(0))
+                await writer.drain()
+            writer.close()
+
+        self.server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+        return self
+
+    async def __aexit__(self, *exc):
+        self.server.close()
+        await self.server.wait_closed()
+
+
+def _chunked(body: bytes, chunk: int = 7) -> bytes:
+    out = b""
+    for i in range(0, len(body), chunk):
+        part = body[i : i + chunk]
+        out += f"{len(part):x}".encode() + b"\r\n" + part + b"\r\n"
+    return out + b"0\r\n\r\n"
+
+
+class TestHttpRobustness:
+    """Redirects + chunked bodies: VERDICT r2 weak #4 / next #5."""
+
+    def _ok(self, body: bytes) -> bytes:
+        return (
+            f"HTTP/1.1 200 OK\r\nContent-Length: {len(body)}\r\n\r\n".encode() + body
+        )
+
+    def _redirect(self, location: str, status: int = 302) -> bytes:
+        return f"HTTP/1.1 {status} Moved\r\nLocation: {location}\r\nContent-Length: 0\r\n\r\n".encode()
+
+    def test_announce_follows_redirect(self):
+        async def go():
+            body = bencode({b"interval": 60, b"peers": b""})
+            # First connection redirects to /announce2 on the same server,
+            # second serves the real answer.
+            srv = ScriptedHttpServer([b"", self._ok(body)])
+            async with srv:
+                srv.responses[0] = self._redirect(
+                    f"http://127.0.0.1:{srv.port}/announce2"
+                )
+                res = await announce(f"http://127.0.0.1:{srv.port}/announce", make_info())
+                assert res.interval == 60
+                assert srv.requests[1].startswith("GET /announce2 ")
+
+        run(go())
+
+    def test_announce_follows_relative_redirect(self):
+        async def go():
+            body = bencode({b"interval": 42, b"peers": b""})
+            srv = ScriptedHttpServer([self._redirect("/a2?x=1", 301), self._ok(body)])
+            async with srv:
+                res = await announce(f"http://127.0.0.1:{srv.port}/announce", make_info())
+                assert res.interval == 42
+                assert srv.requests[1].startswith("GET /a2?x=1 ")
+
+        run(go())
+
+    def test_redirect_loop_errors(self):
+        async def go():
+            srv = ScriptedHttpServer([])
+            async with srv:
+                loop_resp = self._redirect(f"http://127.0.0.1:{srv.port}/announce")
+                srv.responses.extend([loop_resp] * 10)
+                with pytest.raises(TrackerError, match="too many HTTP redirects"):
+                    await announce(f"http://127.0.0.1:{srv.port}/announce", make_info())
+
+        run(go())
+
+    def test_redirect_without_location_errors(self):
+        async def go():
+            srv = ScriptedHttpServer(
+                [b"HTTP/1.1 302 Moved\r\nContent-Length: 0\r\n\r\n"]
+            )
+            async with srv:
+                with pytest.raises(TrackerError, match="redirect without Location"):
+                    await announce(f"http://127.0.0.1:{srv.port}/announce", make_info())
+
+        run(go())
+
+    def test_chunked_announce_body(self):
+        async def go():
+            body = bencode(
+                {b"interval": 90, b"peers": bytes([10, 0, 0, 1]) + write_int(6881, 2)}
+            )
+            resp = (
+                b"HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n\r\n"
+                + _chunked(body)
+            )
+            srv = ScriptedHttpServer([resp])
+            async with srv:
+                res = await announce(f"http://127.0.0.1:{srv.port}/announce", make_info())
+                assert res.interval == 90
+                assert [(p.ip, p.port) for p in res.peers] == [("10.0.0.1", 6881)]
+
+        run(go())
+
+    def test_chunked_with_extensions_and_trailer(self):
+        async def go():
+            body = bencode({b"interval": 30, b"peers": b""})
+            # One chunk with an extension, plus a trailer header.
+            chunked = (
+                f"{len(body):x};name=val\r\n".encode() + body + b"\r\n"
+                b"0\r\nX-Trailer: 1\r\n\r\n"
+            )
+            resp = b"HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n\r\n" + chunked
+            srv = ScriptedHttpServer([resp])
+            async with srv:
+                res = await announce(f"http://127.0.0.1:{srv.port}/announce", make_info())
+                assert res.interval == 30
+
+        run(go())
+
+    def test_no_content_length_reads_to_eof(self):
+        async def go():
+            body = bencode({b"interval": 15, b"peers": b""})
+            resp = b"HTTP/1.1 200 OK\r\n\r\n" + body
+            srv = ScriptedHttpServer([resp])
+            async with srv:
+                res = await announce(f"http://127.0.0.1:{srv.port}/announce", make_info())
+                assert res.interval == 15
+
+        run(go())
+
+    def test_truncated_chunked_body_errors(self):
+        async def go():
+            resp = b"HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n\r\nff\r\nshort"
+            srv = ScriptedHttpServer([resp])
+            async with srv:
+                with pytest.raises(TrackerError, match="truncated"):
+                    await announce(f"http://127.0.0.1:{srv.port}/announce", make_info())
+
+        run(go())

@@ -16,7 +16,8 @@ class TestCodec:
         pkt = utp.encode_packet(
             utp.ST_DATA, 0xBEEF, 123, 456, ts=7, ts_diff=9, wnd=1 << 16, payload=b"hi"
         )
-        ptype, cid, ts, diff, wnd, seq, ack, payload = utp.decode_packet(pkt)
+        ptype, cid, ts, diff, wnd, seq, ack, payload, sack = utp.decode_packet(pkt)
+        assert sack is None
         assert (ptype, cid, ts, diff, wnd, seq, ack, payload) == (
             utp.ST_DATA, 0xBEEF, 7, 9, 1 << 16, 123, 456, b"hi",
         )
@@ -496,3 +497,353 @@ class TestUtpWithRateCap:
                 server.close()
 
         run(go(), timeout=90)
+
+
+class TestSack:
+    def test_sack_codec_roundtrip(self):
+        mask = bytes([0b101, 0, 0, 0b10000000])
+        pkt = utp.encode_packet(utp.ST_STATE, 5, 9, 11, sack=mask)
+        ptype, cid, ts, diff, wnd, seq, ack, payload, sack = utp.decode_packet(pkt)
+        assert (ptype, cid, seq, ack) == (utp.ST_STATE, 5, 9, 11)
+        assert sack == mask and payload == b""
+
+    def test_build_sack_sets_expected_bits(self):
+        class _Sink:
+            def sendto(self, data, addr):
+                pass
+
+            def _forget(self, conn):
+                pass
+
+        async def go():
+            conn = utp.UtpConnection(_Sink(), ("1.2.3.4", 1), 10, 11)
+            conn.connected.set()
+            conn.ack_nr = 100
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 102, 0, b"b")  # bit 0
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 105, 0, b"e")  # bit 3
+            mask = conn._build_sack()
+            assert mask is not None and len(mask) % 4 == 0
+            assert mask[0] == 0b1001
+
+        run(go())
+
+    def test_apply_sack_releases_and_fast_resends_hole(self):
+        sent = []
+
+        class _Record:
+            def sendto(self, data, addr):
+                sent.append(data)
+
+            def _forget(self, conn):
+                pass
+
+        async def go():
+            conn = utp.UtpConnection(_Record(), ("1.2.3.4", 1), 10, 11)
+            conn.connected.set()
+            for payload in (b"x" * 100, b"y" * 100, b"z" * 100, b"w" * 100):
+                await conn.send(payload)
+            first = min(conn._outstanding, key=lambda s: (conn.seq_nr - s) & 0xFFFF)
+            # peer acks nothing cumulatively (ack = first-1) but SACKs
+            # the three packets after the hole at `first`
+            ack = (first - 1) & 0xFFFF
+            mask = bytes([0b111, 0, 0, 0])  # first+1, first+2, first+3
+            before = len(sent)
+            conn.on_packet(utp.ST_STATE, 0, 0, 1 << 20, 0, ack, b"", mask)
+            assert list(conn._outstanding) == [first]  # others released
+            # the hole was fast-resent exactly once
+            assert len(sent) == before + 1
+            assert conn.retx_count == 1
+
+        run(go())
+
+    def test_sack_reduces_retransmitted_bytes(self):
+        """Same lossy transfer with and without SACK: the SACK run must
+        retransmit measurably fewer payload bytes (VERDICT r2 #6).
+
+        The link adds real latency — on a zero-RTT loopback both paths
+        retransmit only what was actually lost; with dup-acks arriving
+        across a 25 ms RTT the cumulative-ack path re-resends the same
+        hole every few duplicates while the SACK path resends it once."""
+
+        class _DelayedLossy(_LossyEndpoint):
+            def sendto(self, data, addr):
+                parsed = utp.decode_packet(data)
+                self._n += 1
+                if (
+                    parsed is not None
+                    and parsed[0] == utp.ST_DATA
+                    and self._n % self._drop_every == 0
+                ):
+                    return
+                transport = self.transport
+                asyncio.get_running_loop().call_later(
+                    0.0125, lambda: transport and transport.sendto(data, addr)
+                )
+
+        async def transfer_with(sack_on: bool) -> int:
+            old = utp.SACK_ENABLED
+            utp.SACK_ENABLED = sack_on
+            try:
+                received = bytearray()
+                done = asyncio.Event()
+                total = 256 * 1024
+
+                async def consume(reader, writer):
+                    while len(received) < total:
+                        data = await reader.read(65536)
+                        if not data:
+                            break
+                        received.extend(data)
+                    done.set()
+
+                loop = asyncio.get_running_loop()
+                _, server = await loop.create_datagram_endpoint(
+                    lambda: utp.UtpEndpoint(consume), local_addr=("127.0.0.1", 0)
+                )
+                # moderate loss: the window must stay large enough that a
+                # single loss yields a long dup-ack train (heavy loss pins
+                # cwnd at the floor where neither path resends spuriously)
+                _, client = await loop.create_datagram_endpoint(
+                    lambda: _DelayedLossy(drop_every=20),
+                    local_addr=("127.0.0.1", 0),
+                )
+                try:
+                    reader, writer = await client.dial(
+                        "127.0.0.1", server.port, timeout=5
+                    )
+                    payload = random.Random(11).randbytes(total)
+                    writer.write(payload)
+                    await writer.drain()
+                    await asyncio.wait_for(done.wait(), 60)
+                    assert bytes(received) == payload
+                    return writer._conn.retx_bytes
+                finally:
+                    client.close()
+                    server.close()
+            finally:
+                utp.SACK_ENABLED = old
+
+        async def go():
+            # single lossy runs have scheduling jitter: retry the
+            # comparison once before declaring a regression
+            for attempt in range(2):
+                with_sack = await transfer_with(True)
+                without = await transfer_with(False)
+                if with_sack < without:
+                    return
+            assert with_sack < without, (with_sack, without)
+
+        run(go(), timeout=300)
+
+
+class _ClampedEndpoint(utp.UtpEndpoint):
+    """Silently drops any datagram larger than `clamp` bytes — a
+    path-MTU black hole (no ICMP comes back on the real internet
+    either when a middlebox filters frag-needed)."""
+
+    clamp = 1300
+
+    def sendto(self, data, addr):
+        if len(data) > self.clamp:
+            return
+        super().sendto(data, addr)
+
+
+class TestPathMtu:
+    def test_transfer_through_1280_clamped_link(self):
+        """Dial-side SYN probing must settle on a payload budget that
+        fits a 1300-byte datagram clamp and complete a bulk transfer
+        (fixed 1400-byte payloads would black-hole forever)."""
+
+        async def go():
+            received = bytearray()
+            done = asyncio.Event()
+            total = 64 * 1024
+
+            async def consume(reader, writer):
+                while len(received) < total:
+                    data = await reader.read(65536)
+                    if not data:
+                        break
+                    received.extend(data)
+                done.set()
+
+            loop = asyncio.get_running_loop()
+            _, server = await loop.create_datagram_endpoint(
+                lambda: _ClampedEndpoint(consume), local_addr=("127.0.0.1", 0)
+            )
+            _, client = await loop.create_datagram_endpoint(
+                _ClampedEndpoint, local_addr=("127.0.0.1", 0)
+            )
+            try:
+                # shorten the probe RTOs so the ladder walks quickly
+                reader, writer = await client.dial("127.0.0.1", server.port, timeout=15)
+                conn = writer._conn
+                assert conn.mtu <= 1280, conn.mtu
+                payload = random.Random(13).randbytes(total)
+                writer.write(payload)
+                await writer.drain()
+                await asyncio.wait_for(done.wait(), 60)
+                assert bytes(received) == payload
+                # the acceptor adopted the probed budget for its own sends
+                srv_conn = list(server._conns.values())[0]
+                assert srv_conn.mtu <= 1280, srv_conn.mtu
+            finally:
+                client.close()
+                server.close()
+
+        run(go(), timeout=120)
+
+    def test_unclamped_dial_keeps_full_mtu(self):
+        async def go():
+            server = await _echo_pair()
+            try:
+                reader, writer = await utp.open_utp_connection(
+                    "127.0.0.1", server.port, timeout=5
+                )
+                assert writer._conn.mtu == utp.MTU_LADDER[0]
+                writer.close()
+            finally:
+                server.close()
+
+        run(go())
+
+
+class TestAdviceFixes:
+    """Round-2 ADVICE items: ooo FIN, hostile-sender windows, dial keying."""
+
+    class _Sink:
+        def sendto(self, data, addr):
+            pass
+
+        def _forget(self, conn):
+            pass
+
+    def test_out_of_order_fin_closes_without_rto(self):
+        async def go():
+            conn = utp.UtpConnection(self._Sink(), ("1.2.3.4", 1), 10, 11)
+            conn.connected.set()
+            conn.ack_nr = 100
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 102, 0, b"b")
+            conn.on_packet(utp.ST_FIN, 0, 0, 1 << 20, 103, 0, b"")  # ooo FIN
+            assert not conn.closed  # hole at 101 still open
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 101, 0, b"a")
+            assert bytes(conn.reader._buffer) == b"ab"
+            assert conn.closed and not conn._reset  # graceful, immediate
+
+        run(go())
+
+    def test_hostile_sender_cannot_overrun_recv_window(self):
+        async def go():
+            conn = utp.UtpConnection(self._Sink(), ("1.2.3.4", 1), 10, 11)
+            conn.connected.set()
+            conn.ack_nr = 0
+            chunk = b"q" * 65536
+            for seq in range(1, 100):  # ~6.2 MiB in-order, never consumed
+                conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, seq, 0, chunk)
+            assert len(conn.reader._buffer) <= utp.RECV_WINDOW
+            # over-window packets were not acked: sender must retransmit
+            assert conn.ack_nr < 99
+
+        run(go())
+
+    def test_ooo_buffer_bytes_capped(self):
+        async def go():
+            conn = utp.UtpConnection(self._Sink(), ("1.2.3.4", 1), 10, 11)
+            conn.connected.set()
+            conn.ack_nr = 0
+            chunk = b"q" * 65536
+            for seq in range(2, 120):  # hole at 1; all buffered out-of-order
+                conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, seq, 0, chunk)
+            assert conn._ooo_bytes <= utp.RECV_WINDOW
+
+        run(go())
+
+    def test_dial_by_hostname_resolves(self):
+        async def go():
+            server = await _echo_pair()
+            try:
+                reader, writer = await utp.open_utp_connection(
+                    "localhost", server.port, timeout=5
+                )
+                writer.write(b"named")
+                await writer.drain()
+                assert await asyncio.wait_for(reader.readexactly(5), 5) == b"named"
+                writer.close()
+            finally:
+                server.close()
+
+        run(go())
+
+    def test_dial_noncanonical_ipv6_text(self):
+        async def go():
+            try:
+                server = await utp.create_utp_endpoint("::1", 0, on_accept=None)
+            except OSError:
+                pytest.skip("no IPv6 loopback")
+
+            async def echo(reader, writer):
+                writer.write(await reader.read(5))
+                await writer.drain()
+
+            server.on_accept = echo
+            loop = asyncio.get_running_loop()
+            _, client = await loop.create_datagram_endpoint(
+                utp.UtpEndpoint, local_addr=("::1", 0)
+            )
+            try:
+                # "0:0:0:0:0:0:0:1" must canonicalize to "::1" so inbound
+                # datagrams (keyed by the kernel's text) find the conn
+                reader, writer = await client.dial(
+                    "0:0:0:0:0:0:0:1", server.port, timeout=5
+                )
+                writer.write(b"six66")
+                await writer.drain()
+                assert await asyncio.wait_for(reader.readexactly(5), 5) == b"six66"
+            finally:
+                client.close()
+                server.close()
+
+        run(go())
+
+
+class TestBareSynFallback:
+    def test_peer_dropping_padded_syns_still_connects(self):
+        """BEP 29 says SYN carries no data — a strict peer may discard
+        padded probe SYNs. The ladder must reach the bare-SYN fallback
+        within the default dial timeout (no RTO backoff while probing)."""
+
+        class _NoPaddedSyn(utp.UtpEndpoint):
+            def sendto(self, data, addr):
+                parsed = utp.decode_packet(data)
+                if (
+                    parsed is not None
+                    and parsed[0] == utp.ST_SYN
+                    and parsed[7]  # payload present
+                ):
+                    return  # strict peer never sees padded SYNs
+                super().sendto(data, addr)
+
+        async def go():
+            async def echo(reader, writer):
+                writer.write(await reader.read(4))
+                await writer.drain()
+
+            loop = asyncio.get_running_loop()
+            _, server = await loop.create_datagram_endpoint(
+                lambda: utp.UtpEndpoint(echo), local_addr=("127.0.0.1", 0)
+            )
+            _, client = await loop.create_datagram_endpoint(
+                _NoPaddedSyn, local_addr=("127.0.0.1", 0)
+            )
+            try:
+                reader, writer = await client.dial("127.0.0.1", server.port, timeout=10)
+                assert writer._conn.mtu == utp.MTU_LADDER[-1]
+                writer.write(b"bare")
+                await writer.drain()
+                assert await asyncio.wait_for(reader.readexactly(4), 5) == b"bare"
+            finally:
+                client.close()
+                server.close()
+
+        run(go(), timeout=30)

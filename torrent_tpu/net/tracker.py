@@ -21,7 +21,7 @@ import asyncio
 import random
 import ssl as ssl_mod
 import time
-from urllib.parse import urlsplit
+from urllib.parse import urljoin, urlsplit
 
 from torrent_tpu.codec import valid
 from torrent_tpu.codec.bencode import BencodeError, bdecode
@@ -56,8 +56,36 @@ class TrackerError(Exception):
 # ===================================================================== HTTP
 
 
-async def _http_get(url: str, timeout: float = HTTP_TIMEOUT) -> bytes:
-    """Minimal HTTP/1.1 GET returning the body; raw path passed verbatim."""
+HTTP_MAX_REDIRECTS = 5
+_REDIRECT_STATUSES = frozenset({301, 302, 303, 307, 308})
+
+
+async def _read_chunked(reader: asyncio.StreamReader) -> bytes:
+    """Decode a Transfer-Encoding: chunked body (RFC 9112 §7.1)."""
+    chunks = []
+    while True:
+        size_line = await reader.readline()
+        if not size_line:
+            raise TrackerError("HTTP tracker sent truncated chunked body")
+        # Chunk extensions (";ext=val") are legal; strip them.
+        size_text = size_line.split(b";", 1)[0].strip()
+        try:
+            size = int(size_text, 16)
+        except ValueError:
+            raise TrackerError(f"bad chunk size line {size_line!r}")
+        if size == 0:
+            # Drain optional trailer fields up to the blank line.
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            return b"".join(chunks)
+        chunks.append(await reader.readexactly(size))
+        await reader.readexactly(2)  # CRLF after each chunk
+
+
+async def _http_get_once(url: str) -> tuple[int, bytes, str | None]:
+    """One GET hop → (status, body, location). Raw path passed verbatim."""
     parts = urlsplit(url)
     if parts.scheme not in ("http", "https"):
         raise TrackerError(f"unsupported scheme {parts.scheme!r}")
@@ -68,44 +96,70 @@ async def _http_get(url: str, timeout: float = HTTP_TIMEOUT) -> bytes:
         path += "?" + parts.query
     ssl_ctx = ssl_mod.create_default_context() if parts.scheme == "https" else None
 
-    async def go() -> bytes:
-        reader, writer = await asyncio.open_connection(host, port, ssl=ssl_ctx)
+    reader, writer = await asyncio.open_connection(host, port, ssl=ssl_ctx)
+    try:
+        req = (
+            f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+            f"User-Agent: torrent-tpu/0.1\r\nAccept: */*\r\nConnection: close\r\n\r\n"
+        )
+        writer.write(req.encode("latin-1"))
+        await writer.drain()
+        status_line = await reader.readline()
+        pieces = status_line.split(None, 2)
+        if len(pieces) < 2 or not pieces[1].isdigit():
+            raise TrackerError(f"bad HTTP status line {status_line!r}")
+        status = int(pieces[1])
+        content_length = None
+        chunked = False
+        location = None
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            lower = line.lower()
+            if lower.startswith(b"content-length:"):
+                try:
+                    content_length = int(line.split(b":", 1)[1].strip())
+                except ValueError:
+                    raise TrackerError("bad Content-Length")
+            elif lower.startswith(b"transfer-encoding:"):
+                chunked = b"chunked" in lower.split(b":", 1)[1]
+            elif lower.startswith(b"location:"):
+                location = line.split(b":", 1)[1].strip().decode("latin-1")
+        if chunked:
+            # Chunked wins over Content-Length (RFC 9112 §6.3); the
+            # reference got both framings free from fetch (tracker.ts:26-31).
+            body = await _read_chunked(reader)
+        elif content_length is not None:
+            body = await reader.readexactly(content_length)
+        else:
+            body = await reader.read()  # Connection: close → EOF delimits
+        return status, body, location
+    finally:
+        writer.close()
         try:
-            req = (
-                f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
-                f"User-Agent: torrent-tpu/0.1\r\nAccept: */*\r\nConnection: close\r\n\r\n"
-            )
-            writer.write(req.encode("latin-1"))
-            await writer.drain()
-            status_line = await reader.readline()
-            pieces = status_line.split(None, 2)
-            if len(pieces) < 2 or not pieces[1].isdigit():
-                raise TrackerError(f"bad HTTP status line {status_line!r}")
-            status = int(pieces[1])
-            content_length = None
-            while True:
-                line = await reader.readline()
-                if line in (b"\r\n", b"\n", b""):
-                    break
-                if line.lower().startswith(b"content-length:"):
-                    try:
-                        content_length = int(line.split(b":", 1)[1].strip())
-                    except ValueError:
-                        raise TrackerError("bad Content-Length")
-            body = (
-                await reader.readexactly(content_length)
-                if content_length is not None
-                else await reader.read()
-            )
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+async def _http_get(url: str, timeout: float = HTTP_TIMEOUT) -> bytes:
+    """HTTP/1.1 GET returning the body, following up to HTTP_MAX_REDIRECTS
+    3xx hops and decoding chunked transfer-encoding."""
+
+    async def go() -> bytes:
+        current = url
+        for _ in range(HTTP_MAX_REDIRECTS + 1):
+            status, body, location = await _http_get_once(current)
+            if status in _REDIRECT_STATUSES:
+                if not location:
+                    raise TrackerError(f"HTTP {status} redirect without Location")
+                current = urljoin(current, location)
+                continue
             if status != 200:
                 raise TrackerError(f"tracker returned HTTP {status}")
             return body
-        finally:
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except Exception:
-                pass
+        raise TrackerError(f"too many HTTP redirects (>{HTTP_MAX_REDIRECTS})")
 
     try:
         return await asyncio.wait_for(go(), timeout)

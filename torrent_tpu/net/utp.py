@@ -21,13 +21,24 @@ Wire format (20-byte header, all big-endian)::
     | seq_nr (2)    | ack_nr (2)    |
 
 Types: ST_DATA=0, ST_FIN=1, ST_STATE=2, ST_RESET=3, ST_SYN=4; ver=1.
-Extension 1 is a selective-ack bitmask (received; we ack cumulatively).
+Extension 1 is a selective-ack bitmask, sent and honored: STATEs carry
+the out-of-order set (LSB-first bits from ack_nr+2), and received masks
+release SACKed packets from the retransmit queue and fast-resend the
+hole once ≥3 packets are acked past it.
 
 Reliability: per-packet retransmit with an RTT-driven RTO (Karn's rule:
 samples only from un-retransmitted packets), fast resend on 3 duplicate
 acks. Congestion: simplified LEDBAT — cwnd grows toward a 100 ms
 one-way-delay target and backs off proportionally past it, clamped to
 [2, 256] outstanding packets and the peer's advertised window.
+
+Path MTU: discovered at dial time by padding the SYN to the candidate
+payload budget and stepping down a ladder (1400→1280→1152→576) on each
+SYN timeout; the size that gets SYN-ACKed bounds ST_DATA chunking. Data
+packets are never re-split in flight — a lost ack is indistinguishable
+from a lost packet, so re-chunking an outstanding seq can double-feed
+bytes at the receiver (stream corruption); probing at handshake avoids
+the black hole without that hazard.
 
 Connection ids (BEP 29): the initiator picks ``recv_id`` at random and
 sends SYN carrying it; the initiator *sends* with ``recv_id + 1``, the
@@ -38,7 +49,9 @@ connections by (addr, recv_id).
 from __future__ import annotations
 
 import asyncio
+import ipaddress
 import random
+import socket
 import struct
 import time
 
@@ -49,7 +62,10 @@ log = get_logger("utp")
 ST_DATA, ST_FIN, ST_STATE, ST_RESET, ST_SYN = range(5)
 VERSION = 1
 HEADER = struct.Struct(">BBHIIIHH")
-MTU = 1400  # payload bytes per ST_DATA (conservative vs 1500-byte MTU)
+MTU = 1400  # default payload budget per ST_DATA (vs 1500-byte eth MTU)
+MTU_LADDER = (1400, 1280, 1152, 576)  # SYN-probe step-down candidates
+SACK_ENABLED = True  # module toggle so tests can measure SACK's effect
+SACK_MAX_BYTES = 8  # bitmask covers ack_nr+2 .. ack_nr+1+64
 TARGET_DELAY_US = 100_000  # LEDBAT one-way-delay target
 MIN_CWND_PKTS = 2
 MAX_CWND_PKTS = 256
@@ -72,11 +88,17 @@ def encode_packet(
     ts_diff: int = 0,
     wnd: int = RECV_WINDOW,
     payload: bytes = b"",
+    sack: bytes | None = None,
 ) -> bytes:
+    ext_blob = b""
+    first_ext = 0
+    if sack:
+        first_ext = 1  # extension 1 = selective ack (BEP 29)
+        ext_blob = bytes((0, len(sack))) + sack
     return (
         HEADER.pack(
             (ptype << 4) | VERSION,
-            0,
+            first_ext,
             conn_id & 0xFFFF,
             _now_us() if ts is None else ts,
             ts_diff & 0xFFFFFFFF,
@@ -84,12 +106,13 @@ def encode_packet(
             seq_nr & 0xFFFF,
             ack_nr & 0xFFFF,
         )
+        + ext_blob
         + payload
     )
 
 
 def decode_packet(data: bytes):
-    """→ (type, conn_id, ts, ts_diff, wnd, seq, ack, payload) or None."""
+    """→ (type, conn_id, ts, ts_diff, wnd, seq, ack, payload, sack) or None."""
     if len(data) < HEADER.size:
         return None
     tv, ext, conn_id, ts, ts_diff, wnd, seq, ack = HEADER.unpack_from(data)
@@ -97,14 +120,18 @@ def decode_packet(data: bytes):
     if ver != VERSION or ptype > ST_SYN:
         return None
     off = HEADER.size
-    while ext:  # skip extensions (we ack cumulatively)
+    sack = None
+    while ext:
         if off + 2 > len(data):
             return None
-        ext, elen = data[off], data[off + 1]
-        off += 2 + elen
-        if off > len(data):
+        cur, (ext, elen) = ext, (data[off], data[off + 1])
+        off += 2
+        if off + elen > len(data):
             return None
-    return ptype, conn_id, ts, ts_diff, wnd, seq, ack, data[off:]
+        if cur == 1:  # selective-ack bitmask
+            sack = data[off : off + elen]
+        off += elen
+    return ptype, conn_id, ts, ts_diff, wnd, seq, ack, data[off:], sack
 
 
 def _seq_lt(a: int, b: int) -> bool:
@@ -153,8 +180,15 @@ class UtpConnection:
         self._send_room = asyncio.Event()
         self._send_room.set()
         self._ooo: dict[int, bytes] = {}  # out-of-order payloads
+        self._ooo_bytes = 0  # capped at RECV_WINDOW (hostile-peer guard)
         self._dup_acks = 0
         self._last_ack_seen = -1
+        self._last_fast_resend = -1  # seq: one cwnd cut per SACK-detected hole
+        self._sacked: dict[int, int] = {}  # seq -> payload len, SACKed not acked
+        self.mtu = MTU  # payload budget; dial-time SYN probing may lower it
+        self._mtu_probe_idx: int | None = None  # ladder position while dialing
+        self.retx_count = 0  # retransmitted packets (observability + tests)
+        self.retx_bytes = 0
         self._srtt: float | None = None
         self._rttvar = 0.0
         # our most recent one-way-delay measurement, echoed in every
@@ -172,11 +206,16 @@ class UtpConnection:
     def _inflight_bytes(self) -> int:
         return sum(len(p[0]) - HEADER.size for p in self._outstanding.values())
 
+    def _occupancy(self) -> int:
+        """Bytes we hold for this connection: in-order buffer plus the
+        out-of-order set (both count — SACKed data still occupies us)."""
+        return len(self.reader._buffer) + self._ooo_bytes
+
     def recv_window(self) -> int:
         """Receive window we advertise: buffer capacity minus occupancy
         (a slow consumer — e.g. a rate-capped peer loop — thereby pauses
         the remote sender instead of buffering without bound)."""
-        wnd = max(0, RECV_WINDOW - len(self.reader._buffer))
+        wnd = max(0, RECV_WINDOW - self._occupancy())
         self._advertised_low = wnd < RECV_WINDOW // 2
         return wnd
 
@@ -184,7 +223,7 @@ class UtpConnection:
         if (
             self._advertised_low
             and not self.closed
-            and RECV_WINDOW - len(self.reader._buffer) >= RECV_WINDOW // 2
+            and RECV_WINDOW - self._occupancy() >= RECV_WINDOW // 2
         ):
             self._send_state()  # window update: tell the sender to resume
 
@@ -194,13 +233,21 @@ class UtpConnection:
         cwnd = max(MTU, min(int(self.cwnd), MAX_CWND_PKTS * MTU))
         return min(cwnd, self.peer_wnd)
 
+    def _flow_used(self) -> int:
+        # SACKed packets leave the retransmit queue but still occupy the
+        # peer's buffer until cumulatively acked — they must keep
+        # consuming advertised-window budget or a compliant sender
+        # overruns the receiver after a long SACK run
+        return self._inflight_bytes() + sum(self._sacked.values())
+
     async def send(self, data: bytes) -> None:
         """Chunk ``data`` into ST_DATA packets, honoring the window."""
         if self.closed or self._reset:
             raise ConnectionResetError("utp connection closed")
-        for off in range(0, len(data), MTU):
-            chunk = data[off : off + MTU]
-            while self._inflight_bytes() + len(chunk) > self._window():
+        step = self.mtu
+        for off in range(0, len(data), step):
+            chunk = data[off : off + step]
+            while self._flow_used() + len(chunk) > self._window():
                 self._send_room.clear()
                 try:
                     # bounded wait: a zero/shrunken peer window reopens
@@ -244,7 +291,18 @@ class UtpConnection:
 
     # ------------------------------------------------------------ receiving
 
-    def on_packet(self, ptype, ts, ts_diff, wnd, seq, ack, payload) -> None:
+    def _drain_ooo(self) -> None:
+        """Deliver buffered out-of-order successors now in line."""
+        nxt = (self.ack_nr + 1) & 0xFFFF
+        while nxt in self._ooo:
+            data = self._ooo.pop(nxt)
+            self._ooo_bytes -= len(data)
+            if data:
+                self.reader.feed_data(data)
+            self.ack_nr = nxt
+            nxt = (nxt + 1) & 0xFFFF
+
+    def on_packet(self, ptype, ts, ts_diff, wnd, seq, ack, payload, sack=None) -> None:
         # honor the peer's advertised window as-is — zero means PAUSE
         # (the send loop polls; a floor here would turn the peer's flow
         # control into packet loss and an eventual reset)
@@ -253,7 +311,7 @@ class UtpConnection:
         if ptype == ST_RESET:
             self._die(reset=True)
             return
-        self._handle_ack(ptype, ack, ts_diff)
+        self._handle_ack(ptype, ack, ts_diff, sack)
         if ptype == ST_STATE:
             if not self.connected.is_set():
                 # SYN-ACK: the peer acks our SYN. Its ST_STATE seq is the
@@ -263,47 +321,63 @@ class UtpConnection:
                 self.ack_nr = seq
                 self.connected.set()
                 # data that raced ahead of the SYN-ACK sits in the
-                # out-of-order buffer; deliver whatever now lines up
-                nxt = (self.ack_nr + 1) & 0xFFFF
-                while nxt in self._ooo:
-                    self.reader.feed_data(self._ooo.pop(nxt))
-                    self.ack_nr = nxt
-                    nxt = (nxt + 1) & 0xFFFF
+                # out-of-order buffer; deliver whatever now lines up —
+                # including a buffered FIN, which must close us here just
+                # like the ST_DATA drain path does (else close stalls an
+                # RTO until the peer retransmits the FIN)
+                self._drain_ooo()
+                if self._fin_seq is not None and self.ack_nr == self._fin_seq:
+                    self._send_state()
+                    self._die(reset=False)
             return
         if ptype in (ST_DATA, ST_FIN):
             if ptype == ST_FIN:
                 self._fin_seq = seq
             expected = (self.ack_nr + 1) & 0xFFFF
             if seq == expected:
+                if payload and self._occupancy() + len(payload) > RECV_WINDOW:
+                    # sender ignored our advertised window (hostile or
+                    # broken): drop without acking — it must retransmit
+                    # once the application drains and the window reopens
+                    self._send_state()
+                    return
                 self.ack_nr = seq
                 if payload:
                     self.reader.feed_data(payload)
-                # drain any buffered out-of-order successors
-                nxt = (self.ack_nr + 1) & 0xFFFF
-                while nxt in self._ooo:
-                    self.reader.feed_data(self._ooo.pop(nxt))
-                    self.ack_nr = nxt
-                    nxt = (nxt + 1) & 0xFFFF
+                self._drain_ooo()
             elif _seq_lt(expected, seq):
-                if payload:
-                    self._ooo[seq] = payload  # hole: buffer until filled
+                # hole: buffer until filled. FINs buffer too (else close
+                # stalls an RTO when the FIN outruns the last data), and
+                # total held bytes are capped so a flooder can't balloon
+                # the process.
+                if seq not in self._ooo and (
+                    payload or ptype == ST_FIN
+                ):
+                    if self._occupancy() + len(payload) <= RECV_WINDOW:
+                        self._ooo[seq] = payload
+                        self._ooo_bytes += len(payload)
             # duplicate (seq < expected): just re-ack
             self._send_state()
             if self._fin_seq is not None and self.ack_nr == self._fin_seq:
                 self._die(reset=False)
 
-    def _handle_ack(self, ptype: int, ack: int, ts_diff: int) -> None:
+    def _handle_ack(self, ptype: int, ack: int, ts_diff: int, sack: bytes | None = None) -> None:
         acked = [
             s for s in self._outstanding if not _seq_lt(ack, s)
         ]  # s <= ack in seq space
-        if acked:
-            self._dup_acks = 0
-            self._last_ack_seen = ack
+        if self._sacked:
+            for s in [s for s in self._sacked if not _seq_lt(ack, s)]:
+                del self._sacked[s]  # cumulative ack passed it: budget freed
+        n_sacked = self._apply_sack(ack, sack) if sack else 0
+        if acked or n_sacked:
+            if acked:
+                self._dup_acks = 0
+                self._last_ack_seen = ack
             for s in acked:
                 pkt, sent_at, retx = self._outstanding.pop(s)
                 if retx == 0:  # Karn: only clean samples drive the RTO
                     self._rtt_sample(time.monotonic() - sent_at)
-            self._ledbat(ts_diff, sum(1 for _ in acked))
+            self._ledbat(ts_diff, len(acked) + n_sacked)
             if not self._send_room.is_set():
                 self._send_room.set()
             self._arm_timer()
@@ -326,6 +400,37 @@ class UtpConnection:
                 self.cwnd = max(MIN_CWND_PKTS * MTU, self.cwnd * 0.5)
                 oldest = min(self._outstanding, key=lambda s: (s - ack) & 0xFFFF)
                 self._retransmit(oldest)
+
+    def _apply_sack(self, ack: int, sack: bytes) -> int:
+        """Honor a received selective-ack bitmask (bit 0 = ack+2,
+        LSB-first within each byte). Releases SACKed packets and
+        fast-resends the hole at ack+1 once ≥3 packets are acked past
+        it (one cwnd cut per distinct hole)."""
+        n_sacked = 0
+        popcount = 0
+        for byte_i, b in enumerate(sack):
+            if not b:
+                continue
+            for bit in range(8):
+                if b & (1 << bit):
+                    popcount += 1
+                    s = (ack + 2 + byte_i * 8 + bit) & 0xFFFF
+                    if s in self._outstanding:
+                        pkt = self._outstanding.pop(s)[0]
+                        # stays in flow-control accounting until the
+                        # cumulative ack passes it (see _flow_used)
+                        self._sacked[s] = max(0, len(pkt) - HEADER.size)
+                        n_sacked += 1
+        hole = (ack + 1) & 0xFFFF
+        if popcount >= 3 and hole in self._outstanding and self._last_fast_resend != hole:
+            # every masked bit is a packet the receiver holds beyond the
+            # hole — the hole is lost, not late; resend it now instead
+            # of waiting out an RTO (mask repeats each STATE, so cut
+            # cwnd only once per distinct hole)
+            self._last_fast_resend = hole
+            self.cwnd = max(MIN_CWND_PKTS * MTU, self.cwnd * 0.5)
+            self._retransmit(hole)
+        return n_sacked
 
     def _rtt_sample(self, rtt: float) -> None:
         if self._srtt is None:
@@ -359,7 +464,7 @@ class UtpConnection:
         self._timer = None
         if not self._outstanding or self.closed:
             return
-        self.rto = min(8.0, self.rto * 2)  # backoff
+        self.rto = min(8.0, self.rto * 2)  # backoff (SYN probes un-back-off below)
         # multiplicative decrease, not full collapse: a floor-sized
         # window can't generate the dup acks that drive fast resend,
         # turning every subsequent loss into another full RTO
@@ -367,9 +472,30 @@ class UtpConnection:
         oldest = min(
             self._outstanding, key=lambda s: self._outstanding[s][1]
         )
-        if self._outstanding[oldest][2] >= MAX_RETRANSMITS:
+        entry = self._outstanding[oldest]
+        if entry[2] >= MAX_RETRANSMITS:
             self._die(reset=True)
             return
+        if (entry[0][0] >> 4) == ST_SYN and self._mtu_probe_idx is not None:
+            # MTU-probe ladder: a vanished padded SYN may mean the pad
+            # exceeded the path MTU — shrink and re-encode before the
+            # resend; past the ladder, fall back to a bare SYN (max
+            # compat with peers that reject payload-carrying SYNs) while
+            # keeping the floor as the data budget. No RTO backoff while
+            # probing: the whole ladder incl. the bare fallback must walk
+            # within the default 10 s dial timeout (1 s per rung, not
+            # 1+2+4+8).
+            self.rto = DEFAULT_RTO
+            self._mtu_probe_idx += 1
+            pad = (
+                MTU_LADDER[self._mtu_probe_idx]
+                if self._mtu_probe_idx < len(MTU_LADDER)
+                else 0
+            )
+            self.mtu = MTU_LADDER[min(self._mtu_probe_idx, len(MTU_LADDER) - 1)]
+            entry[0] = encode_packet(
+                ST_SYN, self.recv_id, oldest, 0, payload=b"\x00" * pad
+            )
         self._retransmit(oldest)
         self._arm_timer()
 
@@ -379,9 +505,28 @@ class UtpConnection:
             return
         entry[1] = time.monotonic()
         entry[2] += 1
+        self.retx_count += 1
+        self.retx_bytes += max(0, len(entry[0]) - HEADER.size)
         self.endpoint.sendto(entry[0], self.addr)
 
+    def _build_sack(self) -> bytes | None:
+        """Bitmask of the out-of-order set: bit 0 = ack_nr+2, LSB-first
+        (BEP 29 extension 1; length a multiple of 4, ≥4)."""
+        base = (self.ack_nr + 2) & 0xFFFF
+        mask = bytearray(SACK_MAX_BYTES)
+        top = -1
+        for seq in self._ooo:
+            off = (seq - base) & 0xFFFF
+            if off < SACK_MAX_BYTES * 8:
+                mask[off >> 3] |= 1 << (off & 7)
+                top = max(top, off)
+        if top < 0:
+            return None
+        nbytes = max(4, ((top >> 3) + 4) & ~3)
+        return bytes(mask[:nbytes])
+
     def _send_state(self) -> None:
+        sack = self._build_sack() if (SACK_ENABLED and self._ooo) else None
         self.endpoint.sendto(
             encode_packet(
                 ST_STATE,
@@ -390,6 +535,7 @@ class UtpConnection:
                 self.ack_nr,
                 ts_diff=self.last_ts_diff,
                 wnd=self.recv_window(),
+                sack=sack,
             ),
             self.addr,
         )
@@ -405,6 +551,7 @@ class UtpConnection:
             self._timer.cancel()
             self._timer = None
         self._outstanding.clear()
+        self._sacked.clear()
         self._send_room.set()
         if reset:
             self.reader.feed_eof()
@@ -517,23 +664,32 @@ class UtpEndpoint(asyncio.DatagramProtocol):
         parsed = decode_packet(data)
         if parsed is None:
             return
-        ptype, conn_id, ts, ts_diff, wnd, seq, ack, payload = parsed
+        ptype, conn_id, ts, ts_diff, wnd, seq, ack, payload, sack = parsed
+        # kernel source addrs are 4-tuples for IPv6 — key on (host, port)
+        # so dialed (2-tuple) and inbound lookups agree
+        addr = (addr[0], addr[1])
         now = _now_us()
         diff = (now - ts) & 0xFFFFFFFF
         conn = self._conns.get((addr, conn_id))
         if conn is not None:
-            conn.on_packet(ptype, ts, diff, wnd, seq, ack, payload)
+            conn.on_packet(ptype, ts, diff, wnd, seq, ack, payload, sack)
             return
         if ptype == ST_RESET:
             # RESETs carry the id WE send with (the peer echoes what it
             # saw) — route via the send-id index or drop
             conn = self._by_send.get((addr, conn_id))
             if conn is not None:
-                conn.on_packet(ptype, ts, diff, wnd, seq, ack, payload)
+                conn.on_packet(ptype, ts, diff, wnd, seq, ack, payload, sack)
             return
         if ptype == ST_SYN:
             existing = self._conns.get((addr, (conn_id + 1) & 0xFFFF))
             if existing is not None:
+                if payload:
+                    # re-probe: only ever TIGHTEN (a stale larger first
+                    # SYN can arrive after a smaller successful one)
+                    existing.mtu = min(
+                        existing.mtu, max(MTU_LADDER[-1], len(payload))
+                    )
                 existing._send_state()  # retransmitted SYN: re-ack, no new conn
                 return
             if self.on_accept is None:
@@ -543,6 +699,11 @@ class UtpEndpoint(asyncio.DatagramProtocol):
             conn = UtpConnection(
                 self, addr, recv_id=(conn_id + 1) & 0xFFFF, send_id=conn_id
             )
+            if payload:
+                # SYN padding is the dialer's MTU probe; a symmetric path
+                # passed len(payload)+20 bytes our way, so adopt it as our
+                # own send budget too (bare SYN ⇒ keep the default)
+                conn.mtu = max(MTU_LADDER[-1], min(MTU, len(payload)))
             conn.ack_nr = seq
             conn.connected.set()
             self._conns[(addr, conn.recv_id)] = conn
@@ -561,9 +722,40 @@ class UtpEndpoint(asyncio.DatagramProtocol):
         self._conns.pop((conn.addr, conn.recv_id), None)
         self._by_send.pop((conn.addr, conn.send_id), None)
 
-    async def dial(self, host: str, port: int, timeout: float = 10.0):
-        """Initiate a connection → ``(StreamReader, writer)``."""
-        addr = (host, port)
+    async def _resolve(self, host: str, port: int) -> tuple[str, int]:
+        """Normalize ``host`` to the numeric text form the kernel will
+        report as the datagram source — dialing by hostname or
+        non-canonical IPv6 text must still match inbound lookups."""
+        try:
+            return str(ipaddress.ip_address(host)), port
+        except ValueError:
+            pass
+        fam = socket.AF_UNSPEC
+        sock = self.transport.get_extra_info("socket") if self.transport else None
+        if sock is not None:
+            fam = sock.family
+        try:
+            infos = await asyncio.get_running_loop().getaddrinfo(
+                host, port, family=fam, type=socket.SOCK_DGRAM
+            )
+        except OSError as e:
+            raise ConnectionError(f"utp dial: cannot resolve {host!r}: {e}") from e
+        if not infos:
+            raise ConnectionError(f"utp dial: no addresses for {host!r}")
+        sockaddr = infos[0][4]
+        return sockaddr[0], sockaddr[1]
+
+    async def dial(
+        self, host: str, port: int, timeout: float = 10.0, probe_mtu: bool = True
+    ):
+        """Initiate a connection → ``(StreamReader, writer)``.
+
+        ``probe_mtu`` pads the SYN to the top of MTU_LADDER and steps
+        down on each SYN timeout; the size that gets acked becomes the
+        connection's payload budget (bare-SYN fallback keeps compat
+        with peers that reject padded SYNs).
+        """
+        addr = await self._resolve(host, port)
         recv_id = random.randrange(1, 0xFFFE)
         conn = UtpConnection(
             self, addr, recv_id=recv_id, send_id=(recv_id + 1) & 0xFFFF
@@ -571,7 +763,12 @@ class UtpEndpoint(asyncio.DatagramProtocol):
         self._conns[(addr, recv_id)] = conn
         self._by_send[(addr, conn.send_id)] = conn
         # SYN carries recv_id and consumes seq 1
-        pkt = encode_packet(ST_SYN, recv_id, conn.seq_nr, 0)
+        pad = b""
+        if probe_mtu:
+            conn._mtu_probe_idx = 0
+            conn.mtu = MTU_LADDER[0]
+            pad = b"\x00" * MTU_LADDER[0]
+        pkt = encode_packet(ST_SYN, recv_id, conn.seq_nr, 0, payload=pad)
         conn._outstanding[conn.seq_nr] = [pkt, time.monotonic(), 0]
         self.sendto(pkt, addr)
         conn._arm_timer()
@@ -601,11 +798,13 @@ async def create_utp_endpoint(
     return proto
 
 
-async def open_utp_connection(host: str, port: int, timeout: float = 10.0):
+async def open_utp_connection(
+    host: str, port: int, timeout: float = 10.0, probe_mtu: bool = True
+):
     """One-shot dial on a fresh ephemeral endpoint (TCP-open analogue)."""
     ep = await create_utp_endpoint()
     try:
-        return await ep.dial(host, port, timeout)
+        return await ep.dial(host, port, timeout, probe_mtu=probe_mtu)
     except Exception:
         ep.close()
         raise

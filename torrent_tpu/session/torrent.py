@@ -1589,8 +1589,6 @@ class Torrent:
         if not self.bitfield.has(index):
             await refuse()
             return
-        if self.upload_bucket is not None:
-            await self.upload_bucket.take(length)  # client-global upload cap
         # Serve through a small LRU of whole pieces: peers request a
         # piece as ~16-64 sequential 16 KiB blocks, so reading the piece
         # once turns 16+ random preads into one. Concurrent misses on the
@@ -1631,6 +1629,10 @@ class Torrent:
         if len(block) != length:
             log.error("serving piece %d: short read", index)
             return
+        if self.upload_bucket is not None:
+            # client-global upload cap; debited only once the block read
+            # succeeded so storage errors don't burn cap budget
+            await self.upload_bucket.take(length)
         await proto.send_message(peer.writer, proto.Piece(index, begin, block))
         peer.bytes_up += length
         self.uploaded += length

@@ -351,7 +351,23 @@ def _execute_v2(total_mb: int, plen: int):
 
     fn = jax.jit(raw_fn)
     reduce_sum = jax.jit(lambda s: jnp.sum(s, dtype=jnp.uint32))
-    n_res = 3
+    # Queue enough resident batches that the fixed per-dispatch relay
+    # cost (~55 ms on this image) amortizes — the same treatment that
+    # took the SHA-1 plane from 12.8x to 24.1x. LEAF_BATCH x 16 KiB is
+    # 512 MiB per dispatch at the default. The salted per-run copies
+    # (below) hold a SECOND copy of every timed batch, so the resident
+    # cap is ~3 GiB to keep resident+salted+swizzle temporaries inside
+    # a 16 GiB-HBM chip.
+    batch_bytes = LEAF_BATCH * BLOCK
+    n_res = max(
+        3,
+        min(
+            int(os.environ.get("BENCH_V2_NRES", "13")),
+            (3 << 30) // max(1, batch_bytes) + 1,
+        ),
+    )
+    if platform == "cpu":
+        n_res = 3
     rng = np.random.default_rng(7)
     resident = []
     for i in range(n_res):
@@ -369,12 +385,26 @@ def _execute_v2(total_mb: int, plen: int):
     ).astype(np.uint32)
     assert np.array_equal(g0, want), "v2 leaf plane golden check failed"
     _ = int(reduce_sum(w0))
-    t0 = time.perf_counter()
-    outs = [fn(*resident[i]) for i in range(1, n_res)]
-    _ = int(reduce_sum(outs[-1]))
-    leaf_secs = time.perf_counter() - t0
     lpp_piece = plen // BLOCK
-    plane_pps = (n_res - 1) * LEAF_BATCH / lpp_piece / leaf_secs
+    # median-of-N distinct-input runs (round-2 verdict #4): each run
+    # re-salts word 0 of row 0 ON DEVICE (an HBM copy, paid outside the
+    # timed window) so no dispatch repeats an operand tuple the relay
+    # could dedup. Row 0's digest changes; goldens were checked above.
+    n_runs = max(1, int(os.environ.get("BENCH_RUNS", "3")))
+    salt_word = jax.jit(lambda d, s: d.at[0, 0].set(s))
+    rates = []
+    for run in range(n_runs):
+        salted = [
+            (salt_word(d, jnp.uint32(0xBEEF0000 + run)), nb)
+            for d, nb in resident[1:]
+        ]
+        jax.block_until_ready([d for d, _ in salted])
+        t0 = time.perf_counter()
+        outs = [fn(d, nb) for d, nb in salted]
+        _ = int(reduce_sum(outs[-1]))
+        leaf_secs = time.perf_counter() - t0
+        rates.append((n_res - 1) * LEAF_BATCH / lpp_piece / leaf_secs)
+    plane_pps = float(np.median(rates))
 
     print(
         f"# detail: v2 leaf plane {plane_pps:.0f} p/s "
@@ -392,6 +422,9 @@ def _execute_v2(total_mb: int, plen: int):
         "end_to_end_vs_baseline": round(dev_pps / cpu_pps, 2),
         "platform": platform,
         "backend": "jax" if platform == "cpu" else "pallas",
+        "batch": LEAF_BATCH,
+        "n_batches": n_res,
+        **_runs_fields(plane_pps, rates),
     }
 
 
@@ -471,6 +504,16 @@ def _probe_h2d() -> float:
     return 64 / (time.perf_counter() - t0)
 
 
+def _runs_fields(pps_median: float, runs: list) -> dict:
+    """Reproducibility fields (round-2 verdict #4), shared by every
+    hash-plane record: median-of-N run rates and their spread."""
+    return {
+        "n_runs": len(runs),
+        "runs_pps": [round(r, 1) for r in runs],
+        "spread": round((max(runs) - min(runs)) / max(pps_median, 1e-9), 3),
+    }
+
+
 def _device_plane_pps(verifier, plen):
     """Hash-plane throughput: distinct resident batches, queued launches,
     completion forced by fetching the final result (the device executes
@@ -480,6 +523,13 @@ def _device_plane_pps(verifier, plen):
     Rows within a batch share a random base with the row id stamped into
     the first 8 bytes — every piece distinct, digests computed by hashlib
     for golden rows so a wrong kernel fails loudly.
+
+    Returns ``(median_pps, run_rates)`` over BENCH_RUNS (default 3) timed
+    passes. Every pass re-stamps the run id into a spare expected-digest
+    row so no dispatch in any run repeats an earlier operand tuple —
+    repeated identical dispatches can be deduplicated by remote-relay
+    backends, which would fake a 2nd-run speedup (round-2 verdict asked
+    for median-of-N with the spread in the record, not best-of-sweeps).
     """
     import hashlib
 
@@ -522,17 +572,30 @@ def _device_plane_pps(verifier, plen):
         exps.append(jax.device_put(expected))
     ok0 = np.asarray(verifier._verify_step_flat(datas[0], nbs[0], exps[0]))  # compile
     assert ok0[0] and ok0[b - 1], "device-plane golden check failed"
-    # time batches 1..N-1 only: batch 0 was the warm-up call, and repeating
-    # an identical dispatch can be deduplicated by remote backends
-    t0 = time.perf_counter()
-    outs = [
-        verifier._verify_step_flat(datas[i], nbs[i], exps[i])
-        for i in range(1, n_batches)
-    ]
-    last = np.asarray(outs[-1])
-    secs = time.perf_counter() - t0
-    assert last[0] and last[b - 1], "device-plane golden check failed"
-    return (n_batches - 1) * b / secs
+    host_exps = [np.asarray(e) for e in exps]
+    n_runs = max(1, int(os.environ.get("BENCH_RUNS", "3")))
+    rates = []
+    for run in range(n_runs):
+        # distinct operands per run: stamp the run id into expected row 1
+        # (rows other than 0 / b-1 are never golden-checked) — tiny
+        # host->device puts, but they break relay-side dispatch dedup
+        run_exps = []
+        for e in host_exps:
+            e2 = e.copy()
+            if b > 2:
+                e2[1] = run + 1
+            run_exps.append(jax.device_put(e2))
+        # time batches 1..N-1 only: batch 0 was the warm-up call
+        t0 = time.perf_counter()
+        outs = [
+            verifier._verify_step_flat(datas[i], nbs[i], run_exps[i])
+            for i in range(1, n_batches)
+        ]
+        last = np.asarray(outs[-1])
+        secs = time.perf_counter() - t0
+        assert last[0] and last[b - 1], "device-plane golden check failed"
+        rates.append((n_batches - 1) * b / secs)
+    return float(np.median(rates)), rates
 
 
 def _execute(backend, vp, storage, info, digests, cpu_pps, batch, config, plen, total_mb):
@@ -545,15 +608,19 @@ def _execute(backend, vp, storage, info, digests, cpu_pps, batch, config, plen, 
     metric = _metric_name(config, plen, total_mb)
     platform = jax.devices()[0].platform
 
-    def result_line(pps):
-        return {
+    def result_line(pps, runs=None):
+        line = {
             "metric": metric,
             "value": round(pps, 1),
             "unit": "pieces/s",
             "vs_baseline": round(pps / cpu_pps, 2),
             "platform": platform,
             "backend": backend,
+            "batch": batch,
         }
+        if runs:
+            line.update(_runs_fields(pps, runs))
+        return line
 
     if config == "author":
         # config 3: authoring-side digests (make_torrent hot loop) via the
@@ -577,8 +644,8 @@ def _execute(backend, vp, storage, info, digests, cpu_pps, batch, config, plen, 
         # same dual-plane report as the recheck configs: value = the
         # device-resident hash plane, end_to_end = the full pipeline
         # (host assembly + transfer + digests)
-        plane_pps = _device_plane_pps(verifier, plen)
-        line = result_line(plane_pps)
+        plane_pps, plane_runs = _device_plane_pps(verifier, plen)
+        line = result_line(plane_pps, plane_runs)
         line["end_to_end_pps"] = round(n_pieces / secs, 1)
         line["end_to_end_vs_baseline"] = round(n_pieces / secs / cpu_pps, 2)
         return line
@@ -597,8 +664,8 @@ def _execute(backend, vp, storage, info, digests, cpu_pps, batch, config, plen, 
         result = verify_library(jobs, verifier=verifier)
         secs = time.perf_counter() - t0
         assert all(bf.all() for bf in result.bitfields)
-        plane_pps = _device_plane_pps(verifier, plen)
-        line = result_line(plane_pps)
+        plane_pps, plane_runs = _device_plane_pps(verifier, plen)
+        line = result_line(plane_pps, plane_runs)
         line["end_to_end_pps"] = round(n_torrents * n_pieces / secs, 1)
         line["end_to_end_vs_baseline"] = round(
             n_torrents * n_pieces / secs / cpu_pps, 2
@@ -662,7 +729,7 @@ def _execute(backend, vp, storage, info, digests, cpu_pps, batch, config, plen, 
     # Hash-plane measurement (the headline: device-resident batches).
     # On CPU the "device" is the host, so the two coincide; on the
     # tunneled TPU they diverge by the transfer bound.
-    plane_pps = _device_plane_pps(verifier, plen)
+    plane_pps, plane_runs = _device_plane_pps(verifier, plen)
     h2d = _probe_h2d() if platform != "cpu" else None
     print(
         f"# detail: devices={jax.devices()} backend={backend} n_pieces={n_pieces} "
@@ -672,7 +739,7 @@ def _execute(backend, vp, storage, info, digests, cpu_pps, batch, config, plen, 
         f"cpu={cpu_pps:.0f} p/s ({cpu_pps * plen / 2**30:.2f} GiB/s)",
         file=sys.stderr,
     )
-    line = result_line(plane_pps)
+    line = result_line(plane_pps, plane_runs)
     line["end_to_end_pps"] = round(e2e_pps, 1)
     line["end_to_end_vs_baseline"] = round(e2e_pps / cpu_pps, 2)
     if e2e_pieces < n_pieces:

@@ -91,3 +91,36 @@ def test_e2e_cap_marks_record():
     rec = json.loads(proc.stdout.strip().splitlines()[-1])
     assert rec["e2e_measured_mb"] == 2
     assert rec["value"] > 0 and rec["end_to_end_pps"] > 0
+
+
+def test_record_carries_median_of_n_fields():
+    """Round-2 verdict #4: every hash-plane record must carry the batch
+    knob, the run count, the per-run rates, and the spread so a reader
+    can tell tuning progress from variance."""
+    proc = _run_bench({"BENCH_RUNS": "3"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["batch"] == 4
+    assert rec["n_runs"] == 3
+    assert len(rec["runs_pps"]) == 3
+    assert rec["spread"] >= 0
+    # value is the MEDIAN of the runs
+    import statistics
+
+    assert abs(rec["value"] - statistics.median(rec["runs_pps"])) <= 0.15
+
+
+def test_v2_record_carries_median_of_n_fields():
+    proc = _run_bench(
+        {
+            "BENCH_CONFIG": "v2",
+            "BENCH_TOTAL_MB": "8",
+            "TORRENT_TPU_LEAF_BATCH": "1024",
+            "BENCH_RUNS": "3",
+        }
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["n_runs"] == 3 and len(rec["runs_pps"]) == 3
+    assert rec["batch"] == 1024 and rec["n_batches"] >= 3
+    assert rec["spread"] >= 0

@@ -240,5 +240,6 @@ class TestUtpDecoderProperties:
         from torrent_tpu.net.utp import decode_packet, encode_packet
 
         enc = encode_packet(ptype, cid, seq, ack, ts=5, payload=payload)
-        ptype2, cid2, _, _, _, seq2, ack2, payload2 = decode_packet(enc)
+        ptype2, cid2, _, _, _, seq2, ack2, payload2, sack = decode_packet(enc)
         assert (ptype2, cid2, seq2, ack2, payload2) == (ptype, cid, seq, ack, payload)
+        assert sack is None

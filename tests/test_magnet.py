@@ -254,11 +254,15 @@ class TestBtmh:
         assert m.info_hash_v2 == bytes.fromhex("cd" * 32)
         assert parse_magnet(m.to_uri()) == m
 
-    def test_v2_only_parses_but_download_refused(self):
+    def test_v2_only_parses_and_needs_a_peer_source(self):
+        """btmh-only magnets are accepted (pure-v2 swarm support,
+        tests/test_v2_swarm.py has the full e2e); with no peers/trackers
+        the join fails with MetadataError, not the old refusal."""
         import asyncio
 
         from torrent_tpu.codec.magnet import parse_magnet
         from torrent_tpu.session.client import Client, ClientConfig
+        from torrent_tpu.session.metadata import MetadataError
 
         m = parse_magnet("magnet:?xt=urn:btmh:1220" + "ee" * 32)
         assert m.info_hash is None and m.info_hash_v2 is not None
@@ -267,7 +271,7 @@ class TestBtmh:
             c = Client(ClientConfig(port=0, enable_upnp=False))
             await c.start()
             try:
-                with __import__("pytest").raises(ValueError, match="btmh"):
+                with __import__("pytest").raises(MetadataError):
                     await c.add_magnet(m, "/tmp")
             finally:
                 await c.close()

@@ -113,20 +113,13 @@ def _walk_file_tree(node: dict, prefix: tuple[str, ...], out: list[V2File]) -> b
     return True
 
 
-def parse_metainfo_v2(data: bytes) -> MetainfoV2 | None:
-    """Parse a v2 (or hybrid) .torrent; None on anything malformed.
+def parse_v2_info_dict(info) -> InfoDictV2 | None:
+    """Shape-validate a decoded BEP 52 info dict (bytes-keyed) alone.
 
-    Mirrors the fail-closed contract of ``parse_metainfo``
-    (metainfo.ts:145-147): no exceptions escape for bad input.
+    The info-only entry point for magnet joins, where the dict arrives
+    via ut_metadata and the piece layers come separately over BEP 52
+    hash transfer. Fail-closed: None on any malformation.
     """
-    try:
-        root, info_span = bdecode_with_info_span(data)
-    except BencodeError:
-        return None
-    if not isinstance(root, dict) or info_span is None:
-        return None
-    span_start, span_end = info_span
-    info = root.get(b"info")
     if not isinstance(info, dict) or info.get(b"meta version") != 2:
         return None
     name = info.get(b"name")
@@ -143,6 +136,33 @@ def parse_metainfo_v2(data: bytes) -> MetainfoV2 | None:
     files: list[V2File] = []
     if not _walk_file_tree(tree, (), files):
         return None
+    return InfoDictV2(
+        name=name.decode("utf-8", "replace"),
+        piece_length=plen,
+        files=tuple(files),
+        private=info.get(b"private") == 1,
+    )
+
+
+def parse_metainfo_v2(data: bytes) -> MetainfoV2 | None:
+    """Parse a v2 (or hybrid) .torrent; None on anything malformed.
+
+    Mirrors the fail-closed contract of ``parse_metainfo``
+    (metainfo.ts:145-147): no exceptions escape for bad input.
+    """
+    try:
+        root, info_span = bdecode_with_info_span(data)
+    except BencodeError:
+        return None
+    if not isinstance(root, dict) or info_span is None:
+        return None
+    span_start, span_end = info_span
+    info = root.get(b"info")
+    parsed_info = parse_v2_info_dict(info)
+    if parsed_info is None:
+        return None
+    plen = parsed_info.piece_length
+    files = parsed_info.files
 
     layers_raw = root.get(b"piece layers", {})
     if not isinstance(layers_raw, dict):
@@ -168,12 +188,7 @@ def parse_metainfo_v2(data: bytes) -> MetainfoV2 | None:
     announce = root.get(b"announce")
     return MetainfoV2(
         announce=announce.decode("utf-8", "replace") if isinstance(announce, bytes) else None,
-        info=InfoDictV2(
-            name=name.decode("utf-8", "replace"),
-            piece_length=plen,
-            files=tuple(files),
-            private=info.get(b"private") == 1,
-        ),
+        info=parsed_info,
         info_hash_v2=hashlib.sha256(data[span_start:span_end]).digest(),
         piece_layers=piece_layers,
         raw=root,

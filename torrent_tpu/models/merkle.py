@@ -141,3 +141,35 @@ def small_file_root(leaf_words: np.ndarray) -> bytes:
     n = leaf_words.shape[0]
     target = max(1, 1 << max(0, (n - 1).bit_length()))
     return words32_to_digests(merkle_root(pad_leaves(leaf_words, target))[None, :])[0]
+
+
+def piece_root_cpu(data: bytes, pad_leaves: int) -> bytes:
+    """Merkle root of one piece's data: SHA-256 16 KiB leaf hashes padded
+    with ZERO digests (BEP 52 "remaining leaf hashes ... set to zero" —
+    the pad is the zero VALUE, not the hash of zero bytes) up to
+    ``pad_leaves`` (a power of two), pairs folded to the root.
+
+    ``pad_leaves`` is blocks-per-piece for pieces of multi-piece files,
+    or the file's own next-power-of-two block count for single-piece
+    files — the per-piece expected digest in session/v2.py either way.
+    Host-side hashlib: one piece is at most 64 leaves (1 MiB pieces), so
+    the batched device planes only pay off across MANY pieces (see
+    piece_roots_from_leaves / parallel/verify.py).
+    """
+    from torrent_tpu.codec.metainfo_v2 import BLOCK
+
+    if pad_leaves < 1 or pad_leaves & (pad_leaves - 1):
+        raise ValueError("pad_leaves must be a power of two >= 1")
+    leaves = [
+        hashlib.sha256(data[i : i + BLOCK]).digest()
+        for i in range(0, len(data), BLOCK)
+    ] or [hashlib.sha256(b"").digest()]
+    if len(leaves) > pad_leaves:
+        raise ValueError(f"piece has {len(leaves)} leaves > pad target {pad_leaves}")
+    leaves += [b"\x00" * 32] * (pad_leaves - len(leaves))
+    while len(leaves) > 1:
+        leaves = [
+            hashlib.sha256(leaves[i] + leaves[i + 1]).digest()
+            for i in range(0, len(leaves), 2)
+        ]
+    return leaves[0]

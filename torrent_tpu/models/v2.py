@@ -112,11 +112,14 @@ def _make_leaf_fn(b: int, backend: str):
 
             backend = "jax"
             if not _auto_interpret():
-                for ts in (32, 16, 8):
-                    if b % (ts * 128) == 0:
-                        from torrent_tpu.ops.sha256_pallas import sha256_pieces_pallas
+                from torrent_tpu.ops import sha256_pallas as sp256
 
-                        return lambda d, nb, _ts=ts: sha256_pieces_pallas(
+                # try the tuned TORRENT_TPU_SHA256_TILE_SUB first — the
+                # knob must actually reach this hot path or the sweep
+                # tool's winner would be a no-op here
+                for ts in dict.fromkeys((sp256.TILE_SUB, 32, 16, 8)):
+                    if b % (ts * 128) == 0:
+                        return lambda d, nb, _ts=ts: sp256.sha256_pieces_pallas(
                             d, nb, tile_sub=_ts
                         )
         except ImportError:

@@ -230,6 +230,17 @@ class MetadataAssembler:
             return None
         return blob
 
+    def result_v2(self, info_hash_v2: bytes) -> bytes | None:
+        """v2 variant: verify against the full 32-byte SHA-256 infohash
+        (btmh magnets carry no SHA-1 to check against)."""
+        if not self.complete:
+            return None
+        blob = b"".join(self._pieces[i] for i in range(self.n_pieces))
+        if hashlib.sha256(blob).digest() != info_hash_v2:
+            self._pieces.clear()
+            return None
+        return blob
+
 
 # -------------------------------------------------------------- ut_pex
 

@@ -27,12 +27,21 @@ from jax.experimental.pallas import tpu as pltpu
 
 from torrent_tpu.ops.sha1_pallas import (
     TILE_LANE,
-    TILE_SUB,
-    UNROLL,
+    TILE_SUB as _SHA1_TILE_SUB,
+    UNROLL as _SHA1_UNROLL,
     _check_tiling,
     _swizzle_tile,
 )
 from torrent_tpu.ops.sha256_jax import _IV256, _K256, _round, _schedule_step
+from torrent_tpu.utils.env import env_int
+
+# SHA-256's sweet spot need not match SHA-1's (different rounds/registers
+# per block and the leaf plane's 16 KiB rows vs 256 KiB pieces) — own
+# knobs, defaulting to the SHA-1 tuning until tools/tune_sha256 says
+# otherwise on the real chip.
+TILE_SUB = env_int("TORRENT_TPU_SHA256_TILE_SUB", _SHA1_TILE_SUB)
+UNROLL = env_int("TORRENT_TPU_SHA256_UNROLL", _SHA1_UNROLL)
+_check_tiling(TILE_SUB, UNROLL)
 
 
 def _one_block256(state, w, kc_ref):

@@ -101,6 +101,99 @@ def verify_pieces_tpu(
     )
 
 
+def verify_pieces_v2_cpu(
+    storage: Storage, info, progress_cb: ProgressCb | None = None
+) -> np.ndarray:
+    """Streaming per-piece merkle recheck (session/v2.py geometry)."""
+    from torrent_tpu.models.merkle import piece_root_cpu
+
+    n = info.num_pieces
+    bitfield = np.zeros(n, dtype=bool)
+    for idx in range(n):
+        try:
+            data = storage.read_piece(idx)
+        except StorageError:
+            continue
+        if (
+            len(data) == info.piece_sizes[idx]
+            and piece_root_cpu(data, info.piece_pad_leaves[idx]) == info.pieces[idx]
+        ):
+            bitfield[idx] = True
+        if progress_cb and (idx + 1) % 256 == 0:
+            progress_cb(idx + 1, n)
+    if progress_cb:
+        progress_cb(n, n)
+    return bitfield
+
+
+def verify_pieces_v2_tpu(
+    storage: Storage,
+    info,
+    batch_size: int = 256,
+    progress_cb: ProgressCb | None = None,
+    **_ignored,
+) -> np.ndarray:
+    """Batched device merkle recheck: SHA-256 16 KiB leaves on the hash
+    plane, then one batched pair-reduction per tree level across the
+    whole piece batch (models/merkle.py)."""
+    from torrent_tpu.codec.metainfo_v2 import BLOCK
+    from torrent_tpu.models.merkle import merkle_root, words32_to_digests
+    from torrent_tpu.models.v2 import _make_leaf_fn
+    from torrent_tpu.ops.padding import alloc_padded, pad_in_place
+
+    import jax.numpy as jnp
+
+    n = info.num_pieces
+    bitfield = np.zeros(n, dtype=bool)
+    if n == 0:
+        return bitfield
+    # group pieces by leaf-pad target: multi-piece files all share
+    # blocks-per-piece, single-piece files use their own pow2 count
+    by_pad: dict[int, list[int]] = {}
+    for idx in range(n):
+        by_pad.setdefault(info.piece_pad_leaves[idx], []).append(idx)
+    leaf_rows = 1024  # device rows per leaf dispatch (pow2-bucketed fn)
+    fn = _make_leaf_fn(leaf_rows, "auto")
+    padded, view = alloc_padded(leaf_rows, BLOCK)
+    done = 0
+    for pad, indices in by_pad.items():
+        for bstart in range(0, len(indices), batch_size):
+            batch = indices[bstart : bstart + batch_size]
+            buf, lengths = storage.read_batch(batch)
+            ok_len = np.array(
+                [lengths[i] == info.piece_sizes[p] for i, p in enumerate(batch)]
+            )
+            m = len(batch)
+            grid = np.zeros((m, pad, 8), dtype=np.uint32)
+            # flatten every real block of the batch into leaf-plane rows
+            blocks: list[tuple[int, int, int]] = []  # (piece_i, block_i, blen)
+            for i in range(m):
+                ln = int(lengths[i])
+                for bi in range(-(-ln // BLOCK) if ln else 0):
+                    blocks.append((i, bi, min(BLOCK, ln - bi * BLOCK)))
+                if ln == 0 and info.piece_sizes[batch[i]] == 0:
+                    blocks.append((i, 0, 0))
+            for rstart in range(0, len(blocks), leaf_rows):
+                chunk = blocks[rstart : rstart + leaf_rows]
+                padded[:] = 0
+                row_len = np.zeros(leaf_rows, dtype=np.int64)
+                for r, (i, bi, blen) in enumerate(chunk):
+                    view[r, :blen] = buf[i, bi * BLOCK : bi * BLOCK + blen]
+                    row_len[r] = blen
+                nblocks = pad_in_place(padded, row_len)
+                nblocks[len(chunk) :] = 0
+                words = np.asarray(fn(jnp.asarray(padded), jnp.asarray(nblocks)))
+                for r, (i, bi, _blen) in enumerate(chunk):
+                    grid[i, bi] = words[r]
+            roots = words32_to_digests(merkle_root(grid))
+            for i, p in enumerate(batch):
+                bitfield[p] = bool(ok_len[i]) and roots[i] == info.pieces[p]
+            done += m
+            if progress_cb:
+                progress_cb(done, n)
+    return bitfield
+
+
 def verify_pieces(
     storage: Storage,
     info: InfoDict,
@@ -113,10 +206,17 @@ def verify_pieces(
     ``hasher`` mirrors the BASELINE API contract: ``"cpu"`` (default,
     streaming hashlib — the reference's std/crypto analogue) or ``"tpu"``
     (batched device path; on CPU-only hosts XLA still runs it, so the flag
-    selects *strategy*, not hardware availability).
+    selects *strategy*, not hardware availability). v2 session infos
+    (session/v2.py) route to the merkle recheck automatically.
     """
     if info.num_pieces == 0:
         return np.zeros(0, dtype=bool)
+    if getattr(info, "v2", False):
+        if hasher == "cpu":
+            return verify_pieces_v2_cpu(storage, info, progress_cb)
+        if hasher == "tpu":
+            return verify_pieces_v2_tpu(storage, info, progress_cb=progress_cb, **tpu_kwargs)
+        raise ValueError(f"unknown hasher {hasher!r}")
     if hasher == "cpu":
         return verify_pieces_cpu(storage, info, progress_cb)
     if hasher == "tpu":

@@ -175,9 +175,18 @@ class Client:
 
         ``storage`` may be a ready Storage, a StorageMethod, or a
         directory path (convenience, mirrors `Client.add(metainfo, dir)`).
+        ``metainfo`` may also be a parsed pure-v2 ``MetainfoV2`` (BEP 52):
+        it is wrapped into the flat-piece-space session view
+        (session/v2.py) and keyed/announced by the truncated SHA-256.
         """
         if self.port is None:
             raise RuntimeError("Client.start() must be awaited before add()")
+        from torrent_tpu.codec.metainfo_v2 import MetainfoV2
+
+        if isinstance(metainfo, MetainfoV2):
+            from torrent_tpu.session.v2 import v2_session_meta
+
+            metainfo = v2_session_meta(metainfo)
         if metainfo.info_hash in self.torrents:
             raise ValueError("torrent already added")
         resume_store = None
@@ -202,7 +211,11 @@ class Client:
             peer_id=self.config.peer_id,
             port=self.port,
             config=torrent_config,
-            verifier=self._verifier_for(metainfo.info.piece_length),
+            # the shared TPUVerifier is the SHA-1 plane — v2 pieces verify
+            # against merkle roots instead (session/torrent.py v2 branch)
+            verifier=None
+            if getattr(metainfo.info, "v2", False)
+            else self._verifier_for(metainfo.info.piece_length),
             resume_store=resume_store,
             dht=self.dht,
             upload_bucket=self.upload_bucket,
@@ -234,14 +247,14 @@ class Client:
             magnet = parse_magnet(magnet)
         if not isinstance(magnet, Magnet):
             raise TypeError("magnet must be a Magnet or magnet URI string")
-        if magnet.info_hash is None:
-            # pure-v2 magnet (btmh only): v2 swarm downloads need the
-            # BEP 52 hash-fetch client side; hybrids carry btih and work
-            raise ValueError(
-                "v2-only magnet (urn:btmh) downloads are not supported yet — "
-                "hybrid magnets with a urn:btih topic work"
-            )
-        if magnet.info_hash in self.torrents:
+        # pure-v2 magnets (btmh only) join the swarm under the TRUNCATED
+        # sha-256 infohash (BEP 52); hybrids/v1 use the btih topic
+        wire_hash = (
+            magnet.info_hash
+            if magnet.info_hash is not None
+            else magnet.info_hash_v2[:20]
+        )
+        if wire_hash in self.torrents:
             raise ValueError("torrent already added")
         # Throwaway peer id for the metadata connections: if the fetch
         # socket's EOF hasn't been reaped by the seeder when the real

@@ -30,10 +30,77 @@ class MetadataError(Exception):
     pass
 
 
+async def _fetch_layers_on_conn(
+    reader, writer, info_v2, timeout: float
+) -> dict[bytes, tuple[bytes, ...]]:
+    """Pull every multi-piece file's piece layer over an already-open
+    peer connection (BEP 52 messages 21-23), each run proven against the
+    file's trusted ``pieces root`` before acceptance.
+
+    A btmh magnet joiner needs this immediately after ut_metadata: the
+    info dict carries only per-file roots; the per-piece expected digests
+    (the piece layers) live outside it. Reuses the metadata connection —
+    the peer that served the info dict is the one best placed to serve
+    the layers, and no session object exists yet to route futures.
+    """
+    from torrent_tpu.models.hashes import (
+        MAX_RUN,
+        HashRequestFields,
+        _layer_height,
+        verify_hash_response,
+    )
+    from torrent_tpu.session.v2 import multi_piece_roots
+
+    plen = info_v2.piece_length
+    base = _layer_height(plen)
+    layers: dict[bytes, tuple[bytes, ...]] = {}
+    for root, n_pieces in multi_piece_roots(info_v2):
+        padded = 1 << (n_pieces - 1).bit_length()
+        run = min(padded, MAX_RUN)
+        # runs beyond MAX_RUN chain to the root via uncle proofs
+        proofs = (padded.bit_length() - 1) - (run.bit_length() - 1)
+        got_all: list[bytes] = []
+        for start in range(0, min(padded, n_pieces), run):
+            fields = (root, base, start, run, proofs)
+            req = HashRequestFields(*fields)
+            writer.write(proto.encode_message(proto.HashRequest(*fields)))
+            await writer.drain()
+            while True:
+                msg = await asyncio.wait_for(proto.read_message(reader), timeout=timeout)
+                if msg is None:
+                    raise MetadataError("peer closed during layer fetch")
+                if isinstance(msg, (proto.Hashes, proto.HashReject)) and (
+                    msg.pieces_root,
+                    msg.base_layer,
+                    msg.index,
+                    msg.length,
+                    msg.proof_layers,
+                ) == fields:
+                    if isinstance(msg, proto.HashReject):
+                        raise MetadataError("peer rejected piece-layer request")
+                    got = msg.hash_list()
+                    break
+                # bitfield/have/choke etc. — irrelevant, keep draining
+            if not verify_hash_response(req, got):
+                raise MetadataError("piece-layer response failed merkle proof")
+            got_all.extend(got[:run])
+        layers[root] = tuple(got_all[:n_pieces])
+    return layers
+
+
 async def _fetch_from_peer(
-    addr: tuple[str, int], info_hash: bytes, peer_id: bytes, timeout: float
-) -> bytes:
-    """Dial one peer and pull the whole info dict from it."""
+    addr: tuple[str, int],
+    info_hash: bytes,
+    peer_id: bytes,
+    timeout: float,
+    v2_hash: bytes | None = None,
+) -> tuple[bytes, dict | None]:
+    """Dial one peer and pull the whole info dict from it.
+
+    ``v2_hash`` switches validation to BEP 52 (SHA-256 of the blob must
+    equal the btmh topic) and additionally fetches the piece layers on
+    the same connection → ``(blob, layers)``; v1 returns ``(blob, None)``.
+    """
     reader, writer = await asyncio.wait_for(
         asyncio.open_connection(addr[0], addr[1]), timeout=timeout
     )
@@ -86,11 +153,30 @@ async def _fetch_from_peer(
                 raise MetadataError(f"peer rejected metadata piece {mm.piece}")
             if mm.msg_type == ext.MsgType.DATA:
                 assembler.add(mm)
-                if assembler.complete:
+                if not assembler.complete:
+                    continue
+                if v2_hash is None:
                     blob = assembler.result(info_hash)
                     if blob is None:
                         raise MetadataError("metadata failed hash verification")
-                    return blob
+                    return blob, None
+                blob = assembler.result_v2(v2_hash)
+                if blob is None:
+                    raise MetadataError("metadata failed sha-256 verification")
+                from torrent_tpu.codec.bencode import BencodeError, bdecode
+                from torrent_tpu.codec.metainfo_v2 import parse_v2_info_dict
+
+                # a btmh topic minted from a non-bencode blob passes the
+                # sha-256 check; the decode failure must stay a
+                # MetadataError so other candidate peers are still tried
+                try:
+                    info_v2 = parse_v2_info_dict(bdecode(blob, strict=False))
+                except BencodeError as e:
+                    raise MetadataError(f"fetched v2 info dict not bencode: {e}")
+                if info_v2 is None:
+                    raise MetadataError("fetched v2 info dict failed validation")
+                layers = await _fetch_layers_on_conn(reader, writer, info_v2, timeout)
+                return blob, layers
     finally:
         writer.close()
 
@@ -103,24 +189,32 @@ async def fetch_metadata(
     max_concurrent: int = 8,
     dht=None,
     ip_filter=None,  # optional net.ipfilter.IpFilter: candidates never dialed
-) -> Metainfo:
-    """Resolve a magnet to a full ``Metainfo`` using trackers + x.pe peers
-    + (when a ``net.dht.DHTNode`` is supplied) mainline-DHT discovery.
+) -> "Metainfo":
+    """Resolve a magnet to a full session metainfo using trackers + x.pe
+    peers + (when a ``net.dht.DHTNode`` is supplied) mainline-DHT
+    discovery.
 
-    Raises ``MetadataError`` if no reachable peer can serve a verified
-    info dict.
+    v1/hybrid magnets (btih) return a ``Metainfo``; pure-v2 magnets
+    (btmh only) fetch the info dict AND the piece layers (BEP 52 hash
+    transfer) and return a ``session.v2.V2SessionMeta``. Either result
+    drops straight into ``Client.add``. Raises ``MetadataError`` if no
+    reachable peer can serve a verified copy.
     """
+    v2_only = magnet.info_hash is None
+    # BEP 52: a pure-v2 swarm announces and handshakes with the
+    # TRUNCATED sha-256 infohash (the v2 analogue of protocol.ts:36-67)
+    wire_hash = magnet.info_hash if not v2_only else magnet.info_hash_v2[:20]
     candidates: list[tuple[str, int]] = list(magnet.peer_addrs)
     if dht is not None:
         try:
-            candidates.extend(await dht.lookup_peers(magnet.info_hash))
+            candidates.extend(await dht.lookup_peers(wire_hash))
         except Exception as e:
             log.warning("dht peer lookup failed: %s", e)
     if magnet.trackers:
         from torrent_tpu.net.tracker import TrackerError, announce
 
         info = AnnounceInfo(
-            info_hash=magnet.info_hash,
+            info_hash=wire_hash,
             peer_id=peer_id,
             port=port,
             uploaded=0,
@@ -146,26 +240,45 @@ async def fetch_metadata(
     sem = asyncio.Semaphore(max_concurrent)
     errors: list[str] = []
 
-    async def attempt(addr) -> bytes | None:
+    async def attempt(addr):
         async with sem:
             try:
-                return await _fetch_from_peer(addr, magnet.info_hash, peer_id, peer_timeout)
+                return await _fetch_from_peer(
+                    addr,
+                    wire_hash,
+                    peer_id,
+                    peer_timeout,
+                    v2_hash=magnet.info_hash_v2 if v2_only else None,
+                )
             except (MetadataError, proto.ProtocolError, OSError, asyncio.TimeoutError) as e:
                 errors.append(f"{addr}: {e}")
                 return None
 
     tasks = [asyncio.ensure_future(attempt(a)) for a in candidates]
-    blob: bytes | None = None
+    got = None
     try:
         for fut in asyncio.as_completed(tasks):
-            blob = await fut
-            if blob is not None:
+            got = await fut
+            if got is not None:
                 break
     finally:
         for t in tasks:
             t.cancel()
-    if blob is None:
+    if got is None:
         raise MetadataError(f"all metadata sources failed: {errors[:5]}")
+    blob, layers = got
+    if v2_only:
+        from torrent_tpu.session.v2 import V2Error, v2_session_meta_from_parts
+
+        try:
+            return v2_session_meta_from_parts(
+                blob,
+                magnet.info_hash_v2,
+                layers or {},
+                announce=magnet.trackers[0] if magnet.trackers else "",
+            )
+        except V2Error as e:
+            raise MetadataError(f"fetched v2 metadata unusable: {e}")
     mi = metainfo_from_info_bytes(
         blob,
         announce=magnet.trackers[0] if magnet.trackers else "",

@@ -151,6 +151,9 @@ class Torrent:
             metainfo.announce, parse_announce_list(metainfo.raw)
         )
 
+        # BEP 52 pure-v2 torrent (session/v2.py): 32-byte merkle piece
+        # digests, file-aligned piece space, truncated-sha256 wire hash
+        self.v2 = getattr(self.info, "v2", False)
         self.state = TorrentState.STOPPED
         self.bitfield = Bitfield(self.info.num_pieces)
         self.peers: dict[bytes, PeerConnection] = {}
@@ -239,6 +242,10 @@ class Torrent:
         if n == 0:
             return 0
         missing = (~self.bitfield.as_numpy()) & (self._piece_priority > 0)
+        sizes = getattr(self.info, "piece_sizes", None)
+        if sizes is not None:
+            # v2 piece space: every file's last piece may be short
+            return int(np.asarray(sizes)[missing].sum())
         left = int(missing.sum()) * self.info.piece_length
         if missing[n - 1]:
             left -= n * self.info.piece_length - self.info.length  # short tail
@@ -250,10 +257,12 @@ class Torrent:
         """Per-file ``(global_offset, length)`` spans, single- or multi-file."""
         if self.info.files is None:
             return [(0, self.info.length)]
+        aligned = getattr(self.info, "piece_aligned", False)
+        plen = self.info.piece_length
         out, pos = [], 0
         for fe in self.info.files:
             out.append((pos, fe.length))
-            pos += fe.length
+            pos += -(-fe.length // plen) * plen if aligned else fe.length
         return out
 
     async def set_file_priorities(self, priorities: dict[int, int]) -> None:
@@ -1510,7 +1519,17 @@ class Torrent:
         Concurrent finishers pile into ``_verify_pending`` and a single
         micro-batch flush hashes them all in one device launch; callers
         await their own piece's future. CPU mode: hashlib off-thread.
+        v2 torrents (session/v2.py): the expected digest is the piece's
+        merkle subtree root — SHA-256 leaves folded per BEP 52, off the
+        event loop (≤64 leaves per piece; the batched device planes pay
+        off on the full-recheck path, not per-piece ingest).
         """
+        if self.v2:
+            from torrent_tpu.models.merkle import piece_root_cpu
+
+            pad = self.info.piece_pad_leaves[index]
+            root = await asyncio.to_thread(piece_root_cpu, data, pad)
+            return root == expected
         if self.verifier is None or self.config.hasher != "tpu":
             digest = await asyncio.to_thread(lambda: hashlib.sha1(data).digest())
             return digest == expected

@@ -14,9 +14,16 @@ BLOCK_SIZE = 16 * 1024  # piece.ts:6
 
 
 def piece_length(info: InfoDict, index: int) -> int:
-    """Actual byte length of piece ``index`` (last piece may be short)."""
+    """Actual byte length of piece ``index`` (last piece may be short).
+
+    v2 session infos (session/v2.py) carry explicit per-piece sizes —
+    in BEP 52's file-aligned piece space the LAST PIECE OF EVERY FILE
+    may be short, not just the torrent's final piece."""
     if index < 0 or index >= info.num_pieces:
         raise IndexError(f"piece index {index} out of range [0, {info.num_pieces})")
+    sizes = getattr(info, "piece_sizes", None)
+    if sizes is not None:
+        return sizes[index]
     if index < info.num_pieces - 1:
         return info.piece_length
     rem = info.length - info.piece_length * (info.num_pieces - 1)

@@ -59,6 +59,15 @@ class Storage:
         self._files: list[tuple[tuple[str, ...], int, int]] = []
         if info.files is None:
             self._files.append(((info.name,), 0, info.length))
+        elif getattr(info, "piece_aligned", False):
+            # BEP 52 piece space: every file starts on a piece boundary;
+            # the tail gap after a short last piece is virtual (never on
+            # disk, never requested — pieces don't span files in v2)
+            plen = info.piece_length
+            pos = 0
+            for entry in info.files:
+                self._files.append(((info.name, *entry.path), pos, entry.length))
+                pos += -(-entry.length // plen) * plen
         else:
             pos = 0
             for entry in info.files:

@@ -256,9 +256,11 @@ async def _download(args) -> int:
             torrent = await client.add_magnet(args.source, args.dir)
         else:
             from torrent_tpu.codec.metainfo import parse_metainfo
+            from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
 
             with open(args.source, "rb") as f:
-                m = parse_metainfo(f.read())
+                data = f.read()
+            m = parse_metainfo(data) or parse_metainfo_v2(data)
             if m is None:
                 print("error: not a valid .torrent file", file=sys.stderr)
                 return 1

@@ -39,7 +39,8 @@ MAX_METADATA_SIZE = 64 * 1024 * 1024
 # for the handshake itself by BEP 10.
 UT_METADATA = b"ut_metadata"
 UT_PEX = b"ut_pex"
-LOCAL_EXT_IDS = {UT_METADATA: 1, UT_PEX: 2}
+UT_HOLEPUNCH = b"ut_holepunch"
+LOCAL_EXT_IDS = {UT_METADATA: 1, UT_PEX: 2, UT_HOLEPUNCH: 3}
 
 # Reserved-byte mask: bit 20 counting from the MSB of the 8-byte field,
 # i.e. byte 5, value 0x10 (BEP 10).
@@ -74,6 +75,7 @@ class ExtensionState:
     ut_metadata_id: int = 0  # peer's id for ut_metadata (0 = unsupported)
     metadata_size: int = 0  # peer-advertised info-dict size in bytes
     ut_pex_id: int = 0  # peer's id for ut_pex (BEP 11; 0 = unsupported)
+    ut_holepunch_id: int = 0  # peer's id for ut_holepunch (BEP 55)
     listen_port: int = 0  # peer-advertised 'p' — its real dialable port
 
 
@@ -124,6 +126,9 @@ def decode_extended_handshake(payload: bytes, state: ExtensionState) -> None:
         pid = m.get(UT_PEX)
         if isinstance(pid, int) and 0 < pid < 256:
             state.ut_pex_id = pid
+        hid = m.get(UT_HOLEPUNCH)
+        if isinstance(hid, int) and 0 < hid < 256:
+            state.ut_holepunch_id = hid
     size = d.get(b"metadata_size")
     if isinstance(size, int) and 0 < size <= MAX_METADATA_SIZE:
         state.metadata_size = size
@@ -301,3 +306,85 @@ def metadata_piece(info_bytes: bytes, piece: int) -> bytes | None:
     if not 0 <= piece < n:
         return None
     return info_bytes[piece * METADATA_PIECE_SIZE : (piece + 1) * METADATA_PIECE_SIZE]
+
+
+# ------------------------------------------------------------ ut_holepunch
+
+
+class HolepunchType:
+    """BEP 55 message types."""
+
+    RENDEZVOUS = 0x00
+    CONNECT = 0x01
+    ERROR = 0x02
+
+
+class HolepunchError:
+    """BEP 55 error codes (carried in ERROR messages)."""
+
+    NO_SUCH_PEER = 0x01
+    NOT_CONNECTED = 0x02
+    NO_SUPPORT = 0x03
+    NO_SELF = 0x04
+
+
+@dataclass(frozen=True)
+class HolepunchMessage:
+    """One BEP 55 frame: <type u8><addr_type u8><addr><port u16>[<err u32>].
+
+    The NAT-traversal rendezvous: a peer connected to both endpoints
+    relays simultaneous CONNECT messages so both sides dial at once and
+    punch their NAT mappings open. addr_type 0x00 = IPv4, 0x01 = IPv6.
+    """
+
+    msg_type: int
+    addr: tuple[str, int]
+    err_code: int = 0
+
+
+def encode_holepunch(msg: HolepunchMessage) -> bytes:
+    import socket as _socket
+
+    host, port = msg.addr
+    try:
+        packed = _socket.inet_pton(_socket.AF_INET, host)
+        addr_type = 0x00
+    except OSError:
+        packed = _socket.inet_pton(_socket.AF_INET6, host)
+        addr_type = 0x01
+    out = bytes((msg.msg_type, addr_type)) + packed + port.to_bytes(2, "big")
+    if msg.msg_type == HolepunchType.ERROR:
+        out += msg.err_code.to_bytes(4, "big")
+    return out
+
+
+def decode_holepunch(payload: bytes) -> HolepunchMessage | None:
+    """Parse a ut_holepunch payload; None if malformed (never raises)."""
+    import socket as _socket
+
+    if len(payload) < 2:
+        return None
+    msg_type, addr_type = payload[0], payload[1]
+    if msg_type not in (
+        HolepunchType.RENDEZVOUS,
+        HolepunchType.CONNECT,
+        HolepunchType.ERROR,
+    ):
+        return None
+    alen = 4 if addr_type == 0x00 else 16 if addr_type == 0x01 else None
+    if alen is None or len(payload) < 2 + alen + 2:
+        return None
+    try:
+        host = _socket.inet_ntop(
+            _socket.AF_INET if alen == 4 else _socket.AF_INET6,
+            payload[2 : 2 + alen],
+        )
+    except (OSError, ValueError):
+        return None
+    port = int.from_bytes(payload[2 + alen : 4 + alen], "big")
+    err = 0
+    if msg_type == HolepunchType.ERROR:
+        if len(payload) < 8 + alen:
+            return None
+        err = int.from_bytes(payload[4 + alen : 8 + alen], "big")
+    return HolepunchMessage(msg_type=msg_type, addr=(host, port), err_code=err)

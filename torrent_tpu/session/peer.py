@@ -56,6 +56,16 @@ class PeerConnection:
     bitfield: Bitfield = None  # set in __post_init__
     # blocks we've requested from this peer and not yet received
     inflight: set[tuple[int, int, int]] = field(default_factory=set)
+    # BEP 16 super-seeding (seed side): pieces we've revealed to this
+    # peer via targeted Haves, and the subset not yet confirmed spread
+    # (no OTHER peer has announced them back to us yet)
+    ss_advertised: set[int] = field(default_factory=set)
+    ss_unconfirmed: set[int] = field(default_factory=set)
+    # peers that ever saw our REAL bitfield are exempt from the BEP 16
+    # serve gate — hiding pieces we already told them about would just
+    # stall their transfers (covers super-seed enabled mid-session and
+    # the auto-flip when a super_seed-configured download completes)
+    ss_exempt: bool = False
 
     bytes_down: int = 0  # payload received from peer
     bytes_up: int = 0  # payload sent to peer
@@ -85,6 +95,15 @@ class PeerConnection:
     def __post_init__(self):
         if self.bitfield is None:
             self.bitfield = Bitfield(self.num_pieces)
+
+    def dial_address(self) -> tuple[str, int] | None:
+        """The address this peer can be dialed back on: its source IP plus
+        the BEP 10 ``p`` listen port when advertised (an inbound peer's
+        TCP source port is ephemeral, not its listener)."""
+        if self.address is None:
+            return None
+        port = self.ext.listen_port or self.address[1]
+        return (self.address[0], port)
 
     def download_rate(self) -> float:
         """Bytes/sec received since the last choke-policy snapshot."""

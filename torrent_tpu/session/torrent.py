@@ -112,6 +112,19 @@ class TorrentConfig:
     serve_cache_max_piece: int = 2 * 1024 * 1024
     webseed_concurrency: int = 2  # parallel piece fetches per webseed
     webseed_max_failures: int = 5  # consecutive bad pieces → URL disabled
+    # BEP 16 super-seeding: reveal pieces one-by-one via targeted Haves
+    # and advance only when ANOTHER peer echoes the piece back — the
+    # initial seed uploads ≈1 copy instead of N partial copies
+    super_seed: bool = False
+    super_seed_outstanding: int = 2  # unconfirmed pieces per peer
+
+
+# Piece sizes at or below this run their hash/pread/pwrite INLINE on the
+# event loop instead of via asyncio.to_thread: a thread hop costs ~0.5-2 ms
+# of scheduling latency while sha1/pread of 64 KiB is tens of µs — for
+# small-piece torrents the hops dominate end-to-end throughput (measured:
+# 4 KiB-piece swarms went from ~150 to >1000 pieces/s aggregate).
+INLINE_IO_MAX = 64 * 1024
 
 
 class Torrent:
@@ -154,6 +167,10 @@ class Torrent:
         # BEP 52 pure-v2 torrent (session/v2.py): 32-byte merkle piece
         # digests, file-aligned piece space, truncated-sha256 wire hash
         self.v2 = getattr(self.info, "v2", False)
+        # BEP 16 super-seeding state (lazily sized on first assignment)
+        self._ss_active = bool(self.config.super_seed)
+        self._ss_spread: np.ndarray | None = None  # bool[n]: echoed back
+        self._ss_assigned: np.ndarray | None = None  # int32[n]: live grants
         self.state = TorrentState.STOPPED
         self.bitfield = Bitfield(self.info.num_pieces)
         self.peers: dict[bytes, PeerConnection] = {}
@@ -724,12 +741,26 @@ class Torrent:
         inbound: bool = False,
     ) -> None:
         """Register + spawn the message loop (torrent.ts:79-102)."""
-        if peer_id in self.peers:
-            # Keep the established connection, close the duplicate — the
-            # reference overwrote the map entry and leaked the old socket
-            # (§8.14). Stale survivors die via the peer timeout.
-            writer.close()
-            return
+        existing = self.peers.get(peer_id)
+        if existing is not None:
+            if existing.inbound == inbound:
+                # True reconnect: keep the established connection, close
+                # the duplicate — the reference overwrote the map entry
+                # and leaked the old socket (§8.14). Stale survivors die
+                # via the peer timeout.
+                writer.close()
+                return
+            # Simultaneous open (each end dialed the other — the BEP 55
+            # holepunch MAKES this happen on purpose): both ends must
+            # keep the SAME connection or the cross-closes kill both.
+            # Deterministic tie-break: the connection initiated by the
+            # numerically smaller peer id survives on both sides.
+            new_initiated_by_us = not inbound
+            smaller_is_us = self.peer_id < peer_id
+            if new_initiated_by_us != smaller_is_us:
+                writer.close()  # the agreed loser
+                return
+            self._drop_peer(existing)  # replaced by the agreed survivor
         if len(self.peers) >= self.config.max_peers:
             writer.close()
             return
@@ -753,13 +784,25 @@ class Torrent:
         # Opening state message. BEP 6 peers get the compact have_all /
         # have_none forms; everyone else gets the raw bitfield
         # (protocol.ts:108-115 sends the bitfield unconditionally).
-        if peer.fast and self.bitfield.complete:
+        # Super-seeding (BEP 16) hides everything and reveals pieces
+        # one-by-one via the targeted Haves granted below.
+        if self.super_seeding():
+            if peer.fast:
+                writer.write(proto.encode_message(proto.HaveNone()))
+            else:
+                proto.send_bitfield(writer, Bitfield(self.info.num_pieces))
+        elif peer.fast and self.bitfield.complete:
             writer.write(proto.encode_message(proto.HaveAll()))
         elif peer.fast and self.bitfield.count() == 0:
             writer.write(proto.encode_message(proto.HaveNone()))
         else:
             proto.send_bitfield(writer, self.bitfield)
-        if peer.fast and address is not None:
+        if not self.super_seeding():
+            # this peer sees our real piece map now — if BEP 16 turns on
+            # later (runtime toggle, or a super_seed-configured download
+            # completing), the serve gate must not refuse it
+            peer.ss_exempt = True
+        if peer.fast and address is not None and not self.super_seeding():
             # Canonical allowed-fast grants (both ends can derive the same
             # set, so grants survive reconnects). Served while choked only
             # for pieces we actually have; the rest get explicit rejects.
@@ -779,11 +822,19 @@ class Torrent:
                         ext.encode_extended_handshake(
                             len(self.info_bytes()),
                             listen_port=self.port,
-                            exclude=(ext.UT_PEX,) if self.private else (),
+                            # BEP 27: no off-tracker peer sources — that
+                            # rules out holepunch introductions too
+                            exclude=(ext.UT_PEX, ext.UT_HOLEPUNCH)
+                            if self.private
+                            else (),
                         ),
                     )
                 )
             )
+        if self.super_seeding():
+            # initial BEP 16 grants: reveal the first pieces to this peer
+            for q in self._ss_pick(peer):
+                writer.write(proto.encode_message(proto.Have(index=q)))
         peer.snapshot_rate()
         self._spawn(self._peer_loop(peer), name=f"peer-{peer_id[:8].hex()}")
 
@@ -799,6 +850,12 @@ class Torrent:
         del self.peers[peer.peer_id]
         self._avail -= peer.bitfield.as_numpy()
         self._rarity_dirty = True
+        if self._ss_assigned is not None:
+            # unconfirmed BEP 16 grants return to the pool so the next
+            # peer can be offered them (least-granted-first picks them up)
+            for q in peer.ss_unconfirmed:
+                self._ss_assigned[q] -= 1
+            peer.ss_unconfirmed.clear()
         self._release_inflight(peer)
 
     def _release_inflight(self, peer: PeerConnection) -> None:
@@ -828,6 +885,15 @@ class Torrent:
         self._avail -= peer.bitfield.as_numpy()
         peer.bitfield = new_bf
         self._rarity_dirty = True
+        if self.super_seeding() and peer.ss_unconfirmed:
+            # grants the peer turns out to already have can never be
+            # confirmed by its uploads — return them and re-grant
+            stale = [q for q in peer.ss_unconfirmed if new_bf.has(q)]
+            for q in stale:
+                peer.ss_unconfirmed.discard(q)
+                self._ss_assigned[q] -= 1
+            if stale:
+                await self._ss_grant(peer)
         await self._update_interest(peer)
 
     # ------------------------------------------------------- message loop
@@ -865,6 +931,19 @@ class Torrent:
                 await self._fill_pipeline(peer)
             case proto.Interested():
                 peer.peer_interested = True
+                # Fast-path unchoke: when reciprocity slots are free, a
+                # newly interested peer starts transferring NOW instead of
+                # idling choked until the next 10 s rechoke tick (the tick
+                # still re-ranks everyone by rate later). Without this,
+                # every fresh connection wastes up to choke_interval
+                # seconds — the dominant latency in small swarms.
+                if not self.paused and peer.am_choking:
+                    unchoked = sum(
+                        1 for p in self.peers.values() if not p.am_choking
+                    )
+                    if unchoked < self.config.unchoke_slots + 1:
+                        peer.am_choking = False
+                        await proto.send_message(peer.writer, proto.Unchoke())
             case proto.NotInterested():
                 peer.peer_interested = False
             case proto.Have(index):
@@ -873,6 +952,8 @@ class Torrent:
                         peer.bitfield.set(index)
                         self._avail[index] += 1
                         self._rarity_dirty = True
+                    if self.super_seeding():
+                        await self._ss_on_peer_have(peer, index)
                     # A Have can only turn interest ON, so this is O(1);
                     # the full vector interest recheck is reserved for
                     # bitfield replacement and our own piece completions
@@ -1166,6 +1247,9 @@ class Torrent:
                     [AnnouncePeer(ip=h, port=p) for h, p in pex.added]
                 )
             return
+        if ext_id == ext.LOCAL_EXT_IDS[ext.UT_HOLEPUNCH]:
+            await self._handle_holepunch(peer, payload)
+            return
         if ext_id == ext.LOCAL_EXT_IDS[ext.UT_METADATA]:
             msg = ext.decode_metadata_message(payload)
             if msg is None or peer.ext.ut_metadata_id == 0:
@@ -1182,6 +1266,216 @@ class Torrent:
                 )
             # DATA/REJECT towards a complete torrent: nothing to do (the
             # magnet fetch path, session/metadata.py, has its own loop).
+
+    # -------------------------------------------------- BEP 16 super-seed
+
+    def super_seeding(self) -> bool:
+        """True while BEP 16 mode is active (needs a complete torrent)."""
+        return self._ss_active and self.bitfield.complete
+
+    async def set_super_seeding(self, on: bool) -> None:
+        """Toggle BEP 16 at runtime. Turning it ON only affects peers
+        that connect afterwards — existing peers already saw the real
+        bitfield, so they are exempted from the serve gate (hiding
+        pieces they know about would only stall them); turning it OFF
+        reveals everything to current peers."""
+        was = self.super_seeding()
+        if on and not was:
+            for p in self.peers.values():
+                p.ss_exempt = True
+        self._ss_active = bool(on)
+        if was and not self.super_seeding():
+            await self._ss_reveal_all()
+
+    def _ss_arrays(self) -> None:
+        if self._ss_spread is None:
+            n = self.info.num_pieces
+            self._ss_spread = np.zeros(n, dtype=bool)
+            self._ss_assigned = np.zeros(n, dtype=np.int32)
+
+    def _ss_pick(self, peer: PeerConnection) -> list[int]:
+        """Grant up to the outstanding quota of pieces to ``peer``:
+        least-granted unspread pieces the peer doesn't already have."""
+        self._ss_arrays()
+        grants = []
+        while len(peer.ss_unconfirmed) < self.config.super_seed_outstanding:
+            mask = ~self._ss_spread & ~peer.bitfield.as_numpy()
+            for q in peer.ss_advertised:
+                mask[q] = False
+            idxs = np.nonzero(mask)[0]
+            if len(idxs) == 0:
+                break
+            q = int(idxs[np.argmin(self._ss_assigned[idxs])])
+            self._ss_assigned[q] += 1
+            peer.ss_advertised.add(q)
+            peer.ss_unconfirmed.add(q)
+            grants.append(q)
+        return grants
+
+    async def _ss_grant(self, peer: PeerConnection) -> None:
+        for q in self._ss_pick(peer):
+            await proto.send_message(peer.writer, proto.Have(index=q))
+
+    async def _ss_on_peer_have(self, peer: PeerConnection, index: int) -> None:
+        """BEP 16 confirmation: a piece we granted is 'spread' once a
+        peer we did NOT grant it to announces it — the only way it can
+        have the piece is that a grantee uploaded it onward. A grantee's
+        own Have proves nothing (it downloaded from us), EXCEPT when
+        every connected peer now has the piece — then there is nobody
+        left to upload to and holding the grant open would wedge the
+        grantee's quota (this also covers the one-peer swarm, where
+        strict BEP 16 would deadlock with nobody to confirm)."""
+        self._ss_arrays()
+        if self._ss_spread[index]:
+            return
+        if index in peer.ss_advertised:
+            everyone_has = all(
+                p.bitfield.has(index) for p in self.peers.values()
+            )
+            if not everyone_has:
+                return  # grantee finished ITS download: not evidence
+        self._ss_spread[index] = True
+        # confirmation releases EVERY grantee's outstanding entry for
+        # this piece (a double-granted piece must not leak quota slots)
+        for p in list(self.peers.values()):
+            if index in p.ss_unconfirmed:
+                p.ss_unconfirmed.discard(index)
+                try:
+                    await self._ss_grant(p)
+                except (ConnectionError, OSError):
+                    continue  # peer went away; grants return via _drop_peer
+        if bool(self._ss_spread.all()):
+            # one full copy is out in the swarm: mission accomplished —
+            # revert to plain seeding (rarest-first swarm dynamics take
+            # over from here, per BEP 16's own guidance)
+            self._ss_active = False
+            await self._ss_reveal_all()
+
+    async def _ss_reveal_all(self) -> None:
+        """Exit super-seed mode: advertise every still-hidden piece to
+        every connected peer (bitfields can't be resent mid-connection;
+        Haves are always legal)."""
+        for p in list(self.peers.values()):
+            hidden = [
+                i
+                for i in range(self.info.num_pieces)
+                if self.bitfield.has(i) and i not in p.ss_advertised
+            ]
+            p.ss_advertised.update(hidden)
+            try:
+                # one batched write + one drain per peer: a per-message
+                # drain here would stall this peer loop for
+                # num_pieces x num_peers round-trips on big torrents
+                p.writer.write(
+                    b"".join(
+                        proto.encode_message(proto.Have(index=i)) for i in hidden
+                    )
+                )
+                await p.writer.drain()
+            except (ConnectionError, OSError):
+                continue
+
+    # ---------------------------------------------------- BEP 55 holepunch
+
+    async def _handle_holepunch(self, peer: PeerConnection, payload: bytes) -> None:
+        """Relay/act on a ut_holepunch frame (BEP 55 NAT traversal).
+
+        As relay: a RENDEZVOUS naming a peer we're connected to gets
+        simultaneous CONNECTs to both endpoints; unknown targets get an
+        ERROR. As endpoint: a CONNECT is an invitation to dial NOW (the
+        other side is dialing us at this instant — the parallel SYNs are
+        what punch the NAT mappings open; on loopback tests it is simply
+        an introduction service).
+        """
+        msg = ext.decode_holepunch(payload)
+        if msg is None:
+            return
+        if self.private:
+            # BEP 27: a private torrent's peers come from its trackers
+            # ONLY — a relayed introduction is an off-tracker peer source
+            # exactly like PEX, which is likewise disabled
+            return
+        if msg.msg_type == ext.HolepunchType.RENDEZVOUS:
+            target = None
+            for p in self.peers.values():
+                addr = p.dial_address()
+                if addr is not None and addr == msg.addr and p is not peer:
+                    target = p
+                    break
+            initiator_addr = peer.dial_address()
+            if target is None or initiator_addr is None:
+                reply = ext.HolepunchMessage(
+                    ext.HolepunchType.ERROR, msg.addr,
+                    err_code=ext.HolepunchError.NOT_CONNECTED,
+                )
+                await self._send_holepunch(peer, reply)
+                return
+            if not target.ext.ut_holepunch_id:
+                reply = ext.HolepunchMessage(
+                    ext.HolepunchType.ERROR, msg.addr,
+                    err_code=ext.HolepunchError.NO_SUPPORT,
+                )
+                await self._send_holepunch(peer, reply)
+                return
+            await self._send_holepunch(
+                target, ext.HolepunchMessage(ext.HolepunchType.CONNECT, initiator_addr)
+            )
+            await self._send_holepunch(
+                peer, ext.HolepunchMessage(ext.HolepunchType.CONNECT, msg.addr)
+            )
+            return
+        if msg.msg_type == ext.HolepunchType.CONNECT:
+            # an explicit introduction: dial NOW, bypassing the
+            # seeds-don't-dial policy in _connect_new_peers — the other
+            # endpoint is dialing us at this instant and the simultaneous
+            # SYNs are the whole point of BEP 55
+            addr = msg.addr
+            known = {p.address for p in self.peers.values() if p.address} | {
+                p.dial_address() for p in self.peers.values()
+            }
+            if addr in known or addr in self._dialing:
+                return
+            if len(self.peers) + len(self._dialing) >= self.config.max_peers:
+                return  # same budget every dial path honors — a relay
+                # streaming CONNECT frames must not mint unbounded dials
+            if addr[0] in self._banned or (
+                self.ip_filter is not None and self.ip_filter.blocked(addr[0])
+            ):
+                return
+            self._dialing.add(addr)
+            self._spawn(self._dial(addr, None))
+            return
+        if msg.msg_type == ext.HolepunchType.ERROR:
+            log.debug(
+                "holepunch rendezvous for %s failed: code %d", msg.addr, msg.err_code
+            )
+
+    async def _send_holepunch(self, peer: PeerConnection, msg) -> bool:
+        if not peer.ext.ut_holepunch_id:
+            return False
+        try:
+            payload = ext.encode_holepunch(msg)
+        except (OSError, OverflowError, ValueError):
+            # hostname instead of a numeric address, or a port outside
+            # u16 — unencodable targets are a caller error, not a reason
+            # to kill the peer loop
+            return False
+        await proto.send_message(
+            peer.writer, proto.Extended(peer.ext.ut_holepunch_id, payload)
+        )
+        return True
+
+    async def holepunch_rendezvous(
+        self, relay_peer_id: bytes, target: tuple[str, int]
+    ) -> bool:
+        """Ask a connected relay peer to introduce us to ``target``
+        (BEP 55 initiator side). True if the request was sent."""
+        relay = self.peers.get(relay_peer_id)
+        if relay is None or not relay.ext.ut_holepunch_id:
+            return False
+        return await self._send_holepunch(
+            relay, ext.HolepunchMessage(ext.HolepunchType.RENDEZVOUS, target)
+        )
 
     # ------------------------------------------------------------- leeching
 
@@ -1437,7 +1731,10 @@ class Torrent:
         self._absolve(partial.contributors)
         base = partial.index * self.info.piece_length
         try:
-            await asyncio.to_thread(self._write_piece, base, data)
+            if len(data) <= INLINE_IO_MAX:
+                self._write_piece(base, data)  # µs-scale pwrite: no hop
+            else:
+                await asyncio.to_thread(self._write_piece, base, data)
         except StorageError as e:
             log.error("failed to persist piece %d: %s", partial.index, e)
             return "io_error"
@@ -1528,9 +1825,13 @@ class Torrent:
             from torrent_tpu.models.merkle import piece_root_cpu
 
             pad = self.info.piece_pad_leaves[index]
+            if len(data) <= INLINE_IO_MAX:
+                return piece_root_cpu(data, pad) == expected
             root = await asyncio.to_thread(piece_root_cpu, data, pad)
             return root == expected
         if self.verifier is None or self.config.hasher != "tpu":
+            if len(data) <= INLINE_IO_MAX:
+                return hashlib.sha1(data).digest() == expected
             digest = await asyncio.to_thread(lambda: hashlib.sha1(data).digest())
             return digest == expected
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -1608,6 +1909,17 @@ class Torrent:
         if not self.bitfield.has(index):
             await refuse()
             return
+        if (
+            self.super_seeding()
+            and not peer.ss_exempt
+            and index not in peer.ss_advertised
+        ):
+            # BEP 16: only revealed pieces are served — a peer asking for
+            # something we never advertised is buggy or probing (peers
+            # that saw the real bitfield before the mode flipped on are
+            # exempt; refusing them would stall legitimate requests)
+            await refuse()
+            return
         # Serve through a small LRU of whole pieces: peers request a
         # piece as ~16-64 sequential 16 KiB blocks, so reading the piece
         # once turns 16+ random preads into one. Concurrent misses on the
@@ -1621,6 +1933,20 @@ class Torrent:
             except StorageError as e:
                 log.error("serving piece %d failed: %s", index, e)
                 return
+        elif self.info.piece_length <= INLINE_IO_MAX:
+            # small pieces: a synchronous pread is cheaper than the
+            # thread hop the whole-piece cache path would pay
+            piece = self._serve_cache.pop(index, None)
+            if piece is None:
+                try:
+                    piece = self.storage.read_piece(index)
+                except StorageError as e:
+                    log.error("serving piece %d failed: %s", index, e)
+                    return
+            self._serve_cache[index] = piece  # insert/LRU-refresh at tail
+            while len(self._serve_cache) > self.config.serve_cache_pieces:
+                self._serve_cache.pop(next(iter(self._serve_cache)))
+            block = piece[begin : begin + length]
         else:
             piece = self._serve_cache.get(index)
             if piece is None:
@@ -1903,6 +2229,7 @@ class Torrent:
             "left": self.left,
             "endgame": self._endgame,
             "paused": self.paused,
+            "super_seeding": self.super_seeding(),
             "wanted_left": self._wanted_missing,
             "sequential": self.config.sequential,
             "download_rate": round(

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-3 serialized chip queue. Waits for the round-2 recovery loop
+# (pid 10611: when_tunnel_recovers.sh -> cfg4 + headline) to exit, then
+# runs the REMAINING round-3 chip jobs strictly one at a time:
+#   1. cfgv2c  - v2 leaf plane with the new dispatch amortization
+#   2. tune_sha256 - leaf-kernel tiling sweep
+# Never overlaps TPU processes; never kills anything (axon relay rules).
+cd /root/repo
+while kill -0 10611 2>/dev/null; do sleep 60; done
+for attempt in $(seq 1 40); do
+  python -u -c "
+import json
+import jax, jax.numpy as jnp
+print(json.dumps({'ok': True, 'sum': int(jnp.sum(jax.device_put(jnp.ones(64))))}))
+" > .bench/probe_r3.log 2>&1
+  if grep -q '"ok": true' .bench/probe_r3.log; then
+    echo "r3 queue: tunnel alive attempt=$attempt $(date -u)" >> .bench/auto_chain_r3.log
+    env BENCH_CONFIG=v2 BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=3600 python bench.py \
+        > .bench/cfgv2c.json 2> .bench/cfgv2c.err
+    echo "cfgv2c done $(date -u): $(cat .bench/cfgv2c.json)" >> .bench/auto_chain_r3.log
+    python -m torrent_tpu.tools.tune_sha256 --iters 6 \
+        > .bench/tune_sha256.jsonl 2> .bench/tune_sha256.err
+    echo "tune_sha256 done $(date -u): $(tail -1 .bench/tune_sha256.jsonl)" >> .bench/auto_chain_r3.log
+    exit 0
+  fi
+  echo "r3 attempt=$attempt failed $(date -u)" >> .bench/auto_chain_r3.log
+  sleep 300
+done

@@ -299,3 +299,206 @@ class TestReviewRegressions:
             b"length": 0,
         }
         assert parse_metainfo(bencode({b"announce": b"http://t", b"info": info})) is None
+
+
+class TestBep47PadFiles:
+    """BEP 47 padding files: virtual zero spans that occupy piece space
+    but never touch disk (hybrid torrents always carry them)."""
+
+    def _meta(self, plen=32768):
+        import hashlib
+
+        from torrent_tpu.codec.bencode import bencode
+        from torrent_tpu.codec.metainfo import parse_metainfo
+
+        # file a (plen+100 bytes) + pad to the piece boundary + file b
+        a = bytes(range(256)) * ((plen + 100) // 256 + 1)
+        a = a[: plen + 100]
+        pad = plen - 100
+        b = b"B" * (plen // 2)
+        payload = a + b"\x00" * pad + b
+        digs = [
+            hashlib.sha1(payload[i : i + plen]).digest()
+            for i in range(0, len(payload), plen)
+        ]
+        meta = bencode(
+            {
+                b"announce": b"http://t/announce",
+                b"info": {
+                    b"name": b"padded",
+                    b"piece length": plen,
+                    b"pieces": b"".join(digs),
+                    b"files": [
+                        {b"length": len(a), b"path": [b"a.bin"]},
+                        {
+                            b"length": pad,
+                            b"path": [b".pad", str(pad).encode()],
+                            b"attr": b"p",
+                        },
+                        {b"length": len(b), b"path": [b"b.bin"]},
+                    ],
+                },
+            }
+        )
+        return parse_metainfo(meta), a, b, payload
+
+    def test_parser_marks_pad_entries(self):
+        m, a, b, _ = self._meta()
+        assert [f.pad for f in m.info.files] == [False, True, False]
+        assert m.info.length == len(a) + (32768 - 100) + len(b)
+
+    def test_reads_zero_fill_and_writes_skip_pads(self, tmp_path):
+        import os
+
+        m, a, b, payload = self._meta()
+        st = Storage(FsStorage(str(tmp_path)), m.info)
+        # write the whole payload through the piece-space API
+        for off in range(0, len(payload), 16384):
+            st.set(off, payload[off : off + 16384])
+        # no pad file/dir was created
+        assert not os.path.exists(os.path.join(str(tmp_path), "padded", ".pad"))
+        assert os.path.exists(os.path.join(str(tmp_path), "padded", "a.bin"))
+        assert os.path.exists(os.path.join(str(tmp_path), "padded", "b.bin"))
+        # reading back crosses the pad span and yields its zeros
+        assert st.get(0, len(payload)) == payload
+        # files on disk hold exactly the real bytes
+        assert open(os.path.join(str(tmp_path), "padded", "a.bin"), "rb").read() == a
+        assert open(os.path.join(str(tmp_path), "padded", "b.bin"), "rb").read() == b
+
+    def test_verify_passes_without_pad_files_on_disk(self, tmp_path):
+        """Seeding a padded torrent from a directory that has only the
+        real files (e.g. downloaded by a client that skips pads) must
+        verify clean — pad ranges read as zeros."""
+        import os
+
+        from torrent_tpu.parallel.verify import verify_pieces
+
+        m, a, b, _ = self._meta()
+        os.makedirs(os.path.join(str(tmp_path), "padded"))
+        open(os.path.join(str(tmp_path), "padded", "a.bin"), "wb").write(a)
+        open(os.path.join(str(tmp_path), "padded", "b.bin"), "wb").write(b)
+        st = Storage(FsStorage(str(tmp_path)), m.info)
+        bf = verify_pieces(st, m.info, hasher="cpu")
+        assert bf.all(), bf
+        assert st.exists()  # pads don't block the resume precondition
+
+    def test_read_batch_zero_fills_pads(self, tmp_path):
+        import os
+
+        import numpy as np
+
+        m, a, b, payload = self._meta()
+        os.makedirs(os.path.join(str(tmp_path), "padded"))
+        open(os.path.join(str(tmp_path), "padded", "a.bin"), "wb").write(a)
+        open(os.path.join(str(tmp_path), "padded", "b.bin"), "wb").write(b)
+        st = Storage(FsStorage(str(tmp_path)), m.info)
+        buf, lengths = st.read_batch(range(m.info.num_pieces))
+        for i in range(m.info.num_pieces):
+            want = payload[i * 32768 : i * 32768 + int(lengths[i])]
+            assert buf[i, : int(lengths[i])].tobytes() == want, f"piece {i}"
+
+    def test_padded_torrent_swarm_e2e(self, tmp_path):
+        """Two clients transfer a BEP 47 padded torrent: the leech
+        completes, real files round-trip, and no .pad artifacts appear."""
+        import asyncio
+        import os
+
+        from tests.test_session import run
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            server, _ = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            m, a, b, _ = self._meta()
+            # rewrite announce to the live tracker
+            import hashlib
+
+            from torrent_tpu.codec.bencode import bencode, bdecode
+            from torrent_tpu.codec.metainfo import parse_metainfo
+
+            raw = dict(m.raw)
+            raw[b"announce"] = (
+                b"http://127.0.0.1:%d/announce" % server.http_port
+            )
+            m = parse_metainfo(bencode(raw))
+            sd, ld = str(tmp_path / "es"), str(tmp_path / "el")
+            os.makedirs(os.path.join(sd, "padded"))
+            os.makedirs(ld)
+            open(os.path.join(sd, "padded", "a.bin"), "wb").write(a)
+            open(os.path.join(sd, "padded", "b.bin"), "wb").write(b)
+            c1 = Client(ClientConfig(port=0, enable_upnp=False))
+            c2 = Client(ClientConfig(port=0, enable_upnp=False))
+            await c1.start()
+            await c2.start()
+            try:
+                t1 = await c1.add(m, sd)
+                assert t1.bitfield.complete, "seed recheck failed without pads"
+                t2 = await c2.add(m, ld)
+                for _ in range(600):
+                    if t2.bitfield.complete:
+                        break
+                    await asyncio.sleep(0.05)
+                assert t2.bitfield.complete, t2.status()
+                assert open(os.path.join(ld, "padded", "a.bin"), "rb").read() == a
+                assert open(os.path.join(ld, "padded", "b.bin"), "rb").read() == b
+                assert not os.path.exists(os.path.join(ld, "padded", ".pad"))
+            finally:
+                await c1.close()
+                await c2.close()
+                server.close()
+
+        run(go(), timeout=60)
+
+    def test_pad_entries_never_drive_wanting(self):
+        """Deselecting every real file leaves nothing wanted — the pad
+        entry must not hold its boundary piece at default priority."""
+        import asyncio
+
+        from tests.test_session import fast_config, run
+        from torrent_tpu.session.client import generate_peer_id
+        from torrent_tpu.session.torrent import Torrent
+        from torrent_tpu.storage.storage import MemoryStorage
+
+        async def go():
+            m, a, b, _ = self._meta()
+            t = Torrent(
+                metainfo=m,
+                storage=Storage(MemoryStorage(), m.info),
+                peer_id=generate_peer_id(),
+                port=1234,
+                config=fast_config(),
+            )
+            await t.select_files([])  # nothing wanted
+            assert t.status()["wanted_left"] == 0, t._piece_priority
+            # selecting only b.bin wants exactly its piece
+            await t.select_files([2])
+            assert t.status()["wanted_left"] == 1
+
+        run(go())
+
+
+class TestLeafWindowing:
+    def test_windowed_reduction_matches_unwindowed(self):
+        """roots_batched_windowed with a tiny window (forcing many
+        flushes) matches the single-pass result bit-exactly."""
+        import numpy as np
+
+        from torrent_tpu.models.v2 import (
+            _leaf_words_cpu,
+            roots_batched,
+            roots_batched_windowed,
+        )
+
+        rng = np.random.default_rng(44)
+        plen = 32768
+        blobs = [
+            rng.integers(0, 256, s, dtype=np.uint8).tobytes()
+            for s in (5000, 3 * plen, plen, 2 * plen + 9, 100)
+        ]
+        entries = [(len(x), _leaf_words_cpu(x)) for x in blobs]
+        whole = roots_batched(entries, plen)
+        windowed = roots_batched_windowed(iter(entries), plen, window=2)
+        assert windowed == whole

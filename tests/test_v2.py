@@ -386,3 +386,69 @@ class TestV2CodecValidation:
     def test_parse_ignores_v1_torrents(self, ref_fixtures):
         data = (ref_fixtures / "singlefile.torrent").read_bytes()
         assert parse_metainfo_v2(data) is None
+
+
+class TestBatchedReductions:
+    def test_roots_batched_matches_per_file(self):
+        """roots_batched (round-3: one reduction per level per shape
+        group) must agree bit-exactly with the per-file hash_file_v2."""
+        import numpy as np
+
+        from torrent_tpu.models.v2 import (
+            _leaf_words_cpu,
+            hash_file_v2,
+            roots_batched,
+        )
+
+        rng = np.random.default_rng(42)
+        plen = 32768  # 2 blocks per piece
+        sizes = [0, 100, 16384, 20000, plen, plen + 1, 3 * plen + 7, 8 * plen]
+        blobs = [rng.integers(0, 256, s, dtype=np.uint8).tobytes() for s in sizes]
+        entries = [
+            (len(b), _leaf_words_cpu(b) if b else np.zeros((0, 8), np.uint32))
+            for b in blobs
+        ]
+        got = roots_batched(entries, plen)
+        for b, (root, layer) in zip(blobs, got):
+            want_root, want_layer = (
+                hash_file_v2(b, plen, hasher="cpu") if b else (b"\x00" * 32, ())
+            )
+            assert root == want_root
+            assert layer == want_layer
+
+    def test_reduction_dispatches_shrink_with_batching(self):
+        """The merkle pair-reduction runs once per LEVEL per shape group,
+        not once per level per FILE."""
+        import numpy as np
+
+        from torrent_tpu.models import merkle as M
+        from torrent_tpu.models.v2 import _leaf_words_cpu, roots_batched
+
+        rng = np.random.default_rng(43)
+        plen = 32768
+        # 8 multi-piece files of the same layer-shape group + 8 small
+        # single-leaf files: batched = ~1 (piece grid) + ~layer levels +
+        # 0 (single-leaf roots are the leaf itself); per-file would be
+        # 16+ reduction chains
+        blobs = [
+            rng.integers(0, 256, 4 * plen, dtype=np.uint8).tobytes()
+            for _ in range(8)
+        ] + [
+            rng.integers(0, 256, 5000, dtype=np.uint8).tobytes() for _ in range(8)
+        ]
+        entries = [(len(b), _leaf_words_cpu(b)) for b in blobs]
+        calls = []
+        orig = M.merkle_level
+
+        def counting(words):
+            calls.append(words.shape)
+            return orig(words)
+
+        M.merkle_level = counting
+        try:
+            roots_batched(entries, plen)
+        finally:
+            M.merkle_level = orig
+        # levels: piece grid (lpp=2 -> 1 level) + file layer (padded 4 ->
+        # 2 levels) = 3 total across ALL 16 files
+        assert len(calls) <= 4, calls

@@ -27,10 +27,17 @@ SHA1_LEN = 20
 
 @dataclass(frozen=True)
 class FileEntry:
-    """One file of a multi-file torrent (metainfo.ts MultiFileFields)."""
+    """One file of a multi-file torrent (metainfo.ts MultiFileFields).
+
+    ``pad`` marks a BEP 47 padding file (``attr`` contains ``p``): its
+    bytes are zeros that exist only to piece-align the next real file
+    (hybrid torrents always carry them). Pad spans occupy piece space
+    but are never written to or read from disk (storage/storage.py).
+    """
 
     length: int
     path: tuple[str, ...]  # path components, decoded UTF-8
+    pad: bool = False
 
 
 @dataclass(frozen=True)
@@ -146,7 +153,15 @@ def parse_metainfo(data: bytes) -> Metainfo | None:
                 path = tuple(p.decode("utf-8") for p in f[b"path"])
             except UnicodeDecodeError:
                 return None
-            entries.append(FileEntry(length=f[b"length"], path=path))
+            attr = f.get(b"attr")
+            entries.append(
+                FileEntry(
+                    length=f[b"length"],
+                    path=path,
+                    # BEP 47: attr is a string of flag chars; 'p' = pad
+                    pad=isinstance(attr, bytes) and b"p" in attr,
+                )
+            )
             total += f[b"length"]
         files = tuple(entries)
         length = total

@@ -189,6 +189,146 @@ def _leaf_words_cpu(source) -> np.ndarray:
     return _leaf_words_cpu_from_chunks(_iter_source(source, LEAF_BATCH * BLOCK))
 
 
+def _root_cpu(words: np.ndarray, pad_to: int, pad_digest: bytes = b"\x00" * 32) -> bytes:
+    """hashlib pair-fold of ``u32[n, 8]`` leaf/node words padded to
+    ``pad_to`` with ``pad_digest`` — the device-free merkle reduction the
+    ``hasher='cpu'`` paths use (a pure-CPU run must never touch the jax
+    backend: on hosts where the default device is remote or wedged, a
+    'cpu' author/verify would otherwise hang on the first dispatch)."""
+    nodes = list(words32_to_digests(words)) + [pad_digest] * (pad_to - words.shape[0])
+    while len(nodes) > 1:
+        nodes = [
+            hashlib.sha256(nodes[i] + nodes[i + 1]).digest()
+            for i in range(0, len(nodes), 2)
+        ]
+    return nodes[0]
+
+
+def roots_batched(
+    entries: "list[tuple[int, np.ndarray]]", piece_length: int, device: bool = True
+) -> list[tuple[bytes, tuple[bytes, ...]]]:
+    """(pieces_root, layer) for MANY files from precomputed leaf words,
+    with ONE pair-reduction dispatch per tree level per shape group
+    instead of one reduction chain per file (round-2 verdict #3: the
+    per-file merkle levels were many small dispatches).
+
+    ``entries`` is ``[(length, leaf_words u32[n,8]), ...]``. Three
+    batched stages, numerically identical to hash_file_v2:
+
+    1. small files (≤1 piece) group by their pow2 leaf-pad target; each
+       group stacks to ``[k, target, 8]`` and reduces together (the
+       leading axis of ``merkle_root`` flattens into the pair batch);
+    2. big files' leaf grids concatenate to ``[total_pieces, lpp, 8]``
+       — every piece root of every file in log2(lpp) dispatches;
+    3. per-file piece-root layers pad with the zero-piece-subtree root,
+       group by padded length, and reduce stacked the same way.
+    """
+    lpp = piece_length // BLOCK
+    out: list = [None] * len(entries)
+
+    # stage 1: single-piece files, grouped by pad target
+    small_groups: dict[int, list[int]] = {}
+    for i, (length, leaves) in enumerate(entries):
+        if length == 0:
+            out[i] = (b"\x00" * 32, ())
+        elif length <= piece_length:
+            n = leaves.shape[0]
+            target = max(1, 1 << max(0, (n - 1).bit_length()))
+            small_groups.setdefault(target, []).append(i)
+    for target, idxs in small_groups.items():
+        if device:
+            stacked = np.stack(
+                [pad_leaves(entries[i][1], target) for i in idxs]
+            )  # [k, target, 8]
+            roots = words32_to_digests(merkle_root(stacked))
+        else:
+            roots = [_root_cpu(entries[i][1], target) for i in idxs]
+        for i, r in zip(idxs, roots):
+            out[i] = (r, ())
+
+    # stage 2: all big files' piece roots in one reduction chain
+    big = [i for i, (length, _) in enumerate(entries) if length > piece_length]
+    if big:
+        counts = [-(-entries[i][0] // piece_length) for i in big]
+        if device:
+            grid = np.zeros((sum(counts), lpp, 8), dtype=np.uint32)
+            pos = 0
+            for i, n_pieces in zip(big, counts):
+                leaves = entries[i][1]
+                grid.reshape(-1, 8)[pos * lpp : pos * lpp + leaves.shape[0]] = leaves
+                pos += n_pieces
+            all_roots = merkle_root(grid)  # [sum_pieces, 8]
+        else:
+            rows = []
+            for i, n_pieces in zip(big, counts):
+                leaves = entries[i][1]
+                for p in range(n_pieces):
+                    rows.append(
+                        digests_to_words32(
+                            [_root_cpu(leaves[p * lpp : (p + 1) * lpp], lpp)]
+                        )[0]
+                    )
+            all_roots = np.stack(rows)
+
+        # stage 3: file roots from the piece-root layers, grouped by
+        # padded layer length (zero-piece-subtree padding, BEP 52)
+        height = lpp.bit_length() - 1
+        zero_root = zero_chain(height)[height]
+        zero_root_words = digests_to_words32([zero_root])[0]
+        layer_groups: dict[int, list[tuple[int, np.ndarray]]] = {}
+        pos = 0
+        for i, n_pieces in zip(big, counts):
+            roots_i = all_roots[pos : pos + n_pieces]
+            pos += n_pieces
+            padded_n = 1 << max(0, (n_pieces - 1).bit_length())
+            layer_groups.setdefault(padded_n, []).append((i, roots_i))
+        for padded_n, group in layer_groups.items():
+            if device:
+                stacked = np.tile(zero_root_words, (len(group), padded_n, 1))
+                for g, (_, roots_i) in enumerate(group):
+                    stacked[g, : roots_i.shape[0]] = roots_i
+                file_roots = words32_to_digests(merkle_root(stacked))
+            else:
+                file_roots = [
+                    _root_cpu(roots_i, padded_n, pad_digest=zero_root)
+                    for _, roots_i in group
+                ]
+            for (i, roots_i), fr in zip(group, file_roots):
+                out[i] = (fr, tuple(words32_to_digests(roots_i)))
+    return out
+
+
+# Leaf-word window for the batched reduction passes: flush once this
+# many leaves (32 B each) are resident. The default bounds leaf RAM at
+# ~64 MB (covering ~32 GiB of payload per window) — batching still
+# collapses reductions to one dispatch per level per shape group WITHIN
+# a window, without the corpus-proportional residency of an unbounded
+# pass.
+LEAF_WINDOW = env_int("TORRENT_TPU_LEAF_WINDOW", 1 << 21)
+
+
+def roots_batched_windowed(
+    entry_iter, piece_length: int, window: int | None = None, device: bool = True
+) -> list[tuple[bytes, tuple[bytes, ...]]]:
+    """Windowed driver for :func:`roots_batched`: consumes an iterator of
+    ``(length, leaf_words)`` and flushes whenever the resident leaf count
+    reaches ``window`` (default ``LEAF_WINDOW``), so memory stays bounded
+    no matter how large the corpus is. Results keep input order."""
+    window = window or LEAF_WINDOW
+    out: list[tuple[bytes, tuple[bytes, ...]]] = []
+    buf: list[tuple[int, np.ndarray]] = []
+    acc = 0
+    for entry in entry_iter:
+        buf.append(entry)
+        acc += entry[1].shape[0]
+        if acc >= window:
+            out.extend(roots_batched(buf, piece_length, device=device))
+            buf, acc = [], 0
+    if buf:
+        out.extend(roots_batched(buf, piece_length, device=device))
+    return out
+
+
 def hash_file_v2(
     source, piece_length: int, hasher: str = "tpu"
 ) -> tuple[bytes, tuple[bytes, ...]]:
@@ -203,8 +343,11 @@ def hash_file_v2(
         return b"\x00" * 32, ()
     if hasher == "cpu":
         leaves = _leaf_words_cpu(source)
-    else:
-        leaves = _leaf_words_device(source, "auto")
+        # device=False keeps a 'cpu' run off the jax backend entirely
+        # (on hosts with a remote/wedged default device the first
+        # dispatch would hang an explicitly-CPU author/verify)
+        return roots_batched([(total, leaves)], piece_length, device=False)[0]
+    leaves = _leaf_words_device(source, "auto")
     if total <= piece_length:
         return small_file_root(leaves), ()
     lpp = piece_length // BLOCK
@@ -240,11 +383,29 @@ def build_v2(
                     f"path component {part!r} cannot appear in a v2 file tree "
                     "(separator/traversal/non-UTF-8 names are not encodable)"
                 )
+    # phase 1: leaf words per file (streaming — bounded by the chunk
+    # size, not file size); phase 2: batched reduction passes across
+    # files (roots_batched_windowed: one dispatch per level per shape
+    # group within each bounded-residency window, not a chain per file)
+    ordered = sorted(files, key=lambda e: e[0])
+    lengths = [source_len(source) for _, source in ordered]
+
+    def leaf_entries():
+        for (_, source), total in zip(ordered, lengths):
+            if total == 0:
+                yield 0, np.zeros((0, 8), dtype=np.uint32)
+            elif hasher == "cpu":
+                yield total, _leaf_words_cpu(source)
+            else:
+                yield total, _leaf_words_device(source, "auto")
+
+    reduced = roots_batched_windowed(
+        leaf_entries(), piece_length, device=hasher != "cpu"
+    )
     v2files: list[V2File] = []
     layers: dict[bytes, tuple[bytes, ...]] = {}
-    for path, source in sorted(files, key=lambda e: e[0]):
-        root, layer = hash_file_v2(source, piece_length, hasher)
-        v2files.append(V2File(path=path, length=source_len(source), pieces_root=root))
+    for (path, _), total, (root, layer) in zip(ordered, lengths, reduced):
+        v2files.append(V2File(path=path, length=total, pieces_root=root))
         if layer:
             layers[root] = layer
     info = InfoDictV2(
@@ -319,12 +480,10 @@ def _hybrid_hash_file(
     if tail:
         v1_digs.extend(hash_batch([tail.ljust(plen, b"\x00") if pad_tail else tail]))
 
-    if total <= plen:
-        return small_file_root(leaves), (), v1_digs
-    lpp = plen // BLOCK
-    roots = piece_roots_from_leaves(leaves, lpp)
-    layer = tuple(words32_to_digests(roots))
-    return file_root_from_piece_roots(roots, lpp), layer, v1_digs
+    # device=False for 'cpu' keeps explicitly-CPU hybrid authoring off
+    # the jax backend (same remote/wedged-device hazard as hash_file_v2)
+    root, layer = roots_batched([(total, leaves)], plen, device=hasher != "cpu")[0]
+    return root, layer, v1_digs
 
 
 def build_hybrid(
@@ -416,25 +575,42 @@ def verify_v2(
     plen = meta.info.piece_length
     lpp = plen // BLOCK
     results: dict[tuple[str, ...], np.ndarray] = {}
+    # phase 1: select present, size-matching files; phase 2: windowed
+    # batched reduction passes (one dispatch per level per shape group
+    # within each bounded-residency window, not a chain per file)
+    todo: list[tuple[V2File, int]] = []  # (file, reduced index)
     for f in meta.info.files:
         n_pieces = f.num_pieces(plen)
-        ok = np.zeros(max(1, n_pieces), dtype=bool)
         source = read_file(f.path)
         if source is None or (source_len(source) != f.length):
-            results[f.path] = ok if f.length else np.ones(0, dtype=bool)
+            results[f.path] = (
+                np.zeros(max(1, n_pieces), dtype=bool)
+                if f.length
+                else np.ones(0, dtype=bool)
+            )
             continue
         if f.length == 0:
             results[f.path] = np.ones(0, dtype=bool)
             continue
-        if hasher == "cpu":
-            leaves = _leaf_words_cpu(source)
-        else:
-            leaves = _leaf_words_device(source, "auto")
+        todo.append((f, len(todo)))
+
+    def leaf_entries():
+        for f, _ in todo:
+            source = read_file(f.path)
+            if hasher == "cpu":
+                yield f.length, _leaf_words_cpu(source)
+            else:
+                yield f.length, _leaf_words_device(source, "auto")
+
+    reduced = roots_batched_windowed(leaf_entries(), plen, device=hasher != "cpu")
+    for f, ei in todo:
+        n_pieces = f.num_pieces(plen)
+        ok = np.zeros(max(1, n_pieces), dtype=bool)
+        got_root, got_layer = reduced[ei]
         if f.length <= plen:
-            ok[0] = small_file_root(leaves) == f.pieces_root
+            ok[0] = got_root == f.pieces_root
             results[f.path] = ok
             continue
-        roots = piece_roots_from_leaves(leaves, lpp)
         layer = meta.piece_layers.get(f.pieces_root, ())
         # metadata self-consistency: the published layer must merkle up to
         # the published root (a hostile layer otherwise localizes damage
@@ -446,8 +622,7 @@ def verify_v2(
         ):
             results[f.path] = ok
             continue
-        got = words32_to_digests(roots)
         for i in range(n_pieces):
-            ok[i] = got[i] == layer[i]
+            ok[i] = got_layer[i] == layer[i]
         results[f.path] = ok
     return results

@@ -288,8 +288,10 @@ class Torrent:
         A piece overlapping any wanted file stays wanted (boundary pieces
         take the max priority of the files they touch — skipping them
         would corrupt the neighbouring wanted file). Files not named keep
-        priority 1. Takes effect immediately: interest and pipelines are
-        re-evaluated for every connected peer.
+        priority 1; BEP 47 pad entries are always priority 0 (their bytes
+        are zeros — they must never keep a piece wanted on their own).
+        Takes effect immediately: interest and pipelines are re-evaluated
+        for every connected peer.
         """
         ranges = self.file_ranges()
         for idx, p in priorities.items():
@@ -298,8 +300,11 @@ class Torrent:
             if not 0 <= int(p) <= 127:
                 raise ValueError(f"priority {p} for file #{idx}: must be 0..127")
         plen = self.info.piece_length
+        entries = self.info.files or ()
         prio = np.zeros(self.info.num_pieces, dtype=np.int8)
         for i, (start, length) in enumerate(ranges):
+            if i < len(entries) and getattr(entries[i], "pad", False):
+                continue  # pad spans never drive wanting
             p = int(priorities.get(i, 1))
             if length == 0 or p <= 0:
                 continue
@@ -408,6 +413,8 @@ class Torrent:
                     for path, foff, chunk in self.storage.segments(
                         i * self.info.piece_length, piece_length(self.info, i)
                     ):
+                        if path is None:
+                            continue  # BEP 47 pad span: nothing on disk
                         needed_extent[path] = max(needed_extent.get(path, 0), foff + chunk)
             if not all(
                 self.storage.method.exists(p, length)
@@ -448,7 +455,9 @@ class Torrent:
         from torrent_tpu.parallel.verify import verify_pieces
 
         if not any(
-            self.storage.method.exists(path) for path, _, _ in self.storage._files
+            self.storage.method.exists(path)
+            for path, _, _ in self.storage._files
+            if path is not None  # pads never exist on disk
         ):
             return  # nothing on disk, skip the scan
         cfg = self.config

@@ -75,5 +75,8 @@ def fetch_piece(base: str, storage: Storage, info: InfoDict, index: int) -> byte
     plen = piece_length(info, index)
     out = bytearray()
     for path, foff, chunk in storage.segments(index * info.piece_length, plen):
+        if path is None:
+            out += bytes(chunk)  # BEP 47 pad span: zeros, nothing to fetch
+            continue
         out += fetch_range(url_for(base, info, path), foff, chunk)
     return bytes(out)

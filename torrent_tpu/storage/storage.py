@@ -57,6 +57,9 @@ class Storage:
         # (path, global_start, length) per file; single-file torrents store
         # at [name], multi-file at [name, *entry.path] (storage.ts:41-48).
         self._files: list[tuple[tuple[str, ...], int, int]] = []
+        # BEP 47 pad files are VIRTUAL zero spans: they occupy piece space
+        # (that's their whole purpose) but never touch disk — their table
+        # entries carry path=None and get()/set() zero-fill/skip them.
         if info.files is None:
             self._files.append(((info.name,), 0, info.length))
         elif getattr(info, "piece_aligned", False):
@@ -71,7 +74,12 @@ class Storage:
         else:
             pos = 0
             for entry in info.files:
-                self._files.append(((info.name, *entry.path), pos, entry.length))
+                path = (
+                    None
+                    if getattr(entry, "pad", False)
+                    else (info.name, *entry.path)
+                )
+                self._files.append((path, pos, entry.length))
                 pos += entry.length
         # Exact byte offsets of blocks already written (duplicate-write
         # suppression, storage.ts:39,67-87 — fixed per SURVEY §8.15).
@@ -108,7 +116,10 @@ class Storage:
     def get(self, offset: int, length: int) -> bytes:
         out = bytearray()
         for path, foff, chunk in self.segments(offset, length):
-            out += self.method.get(path, foff, chunk)
+            if path is None:  # BEP 47 pad span: zeros by definition
+                out += bytes(chunk)
+            else:
+                out += self.method.get(path, foff, chunk)
         return bytes(out)
 
     def set(self, offset: int, data: bytes) -> bool:
@@ -120,7 +131,8 @@ class Storage:
         try:
             pos = 0
             for path, foff, chunk in self.segments(offset, len(data)):
-                self.method.set(path, foff, data[pos : pos + chunk])
+                if path is not None:  # pad spans are never persisted
+                    self.method.set(path, foff, data[pos : pos + chunk])
                 pos += chunk
         except Exception:
             # A failed write must not poison duplicate suppression — the
@@ -133,7 +145,9 @@ class Storage:
     def exists(self) -> bool:
         """All files present at full length (resume precondition probe)."""
         return all(
-            self.method.exists(path, flen) for path, _, flen in self._files
+            self.method.exists(path, flen)
+            for path, _, flen in self._files
+            if path is not None  # pads have no on-disk presence to check
         )
 
     def mark_pieces_written(self, piece_indices) -> None:
@@ -178,6 +192,9 @@ class Storage:
             pos = 0
             base = idx * plen_max
             for path, foff, chunk in self.segments(base, plen):
+                if path is None:
+                    pos += chunk  # pad span: buffer is already zeros
+                    continue
                 try:
                     data = self.method.get(path, foff, chunk)
                     out[row, pos : pos + len(data)] = np.frombuffer(data, dtype=np.uint8)
@@ -214,6 +231,9 @@ class Storage:
             lengths[row] = plen
             pos = 0
             for path, foff, chunk in self.segments(idx * self.info.piece_length, plen):
+                if path is None:
+                    pos += chunk  # pad span: stays zero
+                    continue
                 fi = findex.get(path, -1)
                 if fi == -1:
                     try:

@@ -166,3 +166,70 @@ class TestCli:
         finally:
             done.set()
             th.join(10)
+
+    def test_download_pure_v2_torrent(self, tmp_path, capsys):
+        """CLI download of a pure-v2 (BEP 52) .torrent against a live
+        seed: the v1-parse fallback routes it through session/v2.py."""
+        import asyncio
+        import threading
+
+        import numpy as np
+
+        from torrent_tpu.models.v2 import build_v2
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+        from torrent_tpu.session.torrent import TorrentConfig
+
+        dest = tmp_path / "v2dest"
+        dest.mkdir()
+        payload = np.random.default_rng(66).integers(
+            0, 256, 5 * 32768 + 123, dtype=np.uint8
+        ).tobytes()
+        ready = threading.Event()
+        done = threading.Event()
+
+        async def seed_side():
+            server, pump = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            url = f"http://127.0.0.1:{server.http_port}/announce"
+            meta = build_v2(
+                [(("v.bin",), payload)],
+                name="v2cli",
+                piece_length=32768,
+                hasher="cpu",
+                announce=url,
+            )
+            from torrent_tpu.codec.metainfo_v2 import encode_metainfo_v2
+
+            (tmp_path / "cli-v2.torrent").write_bytes(
+                encode_metainfo_v2(meta.info, meta.piece_layers, announce=url)
+            )
+            sd = tmp_path / "v2seed" / "v2cli"
+            sd.mkdir(parents=True)
+            (sd / "v.bin").write_bytes(payload)
+            seed = Client(ClientConfig(host="127.0.0.1"))
+            seed.config.torrent = TorrentConfig(choke_interval=0.15, announce_retry=1.0)
+            await seed.start()
+            t = await seed.add(meta, str(tmp_path / "v2seed"))
+            assert t.bitfield.complete
+            ready.set()
+            while not done.is_set():
+                await asyncio.sleep(0.1)
+            await seed.close()
+            server.close()
+            await asyncio.wait_for(pump, 5)
+
+        th = threading.Thread(target=lambda: asyncio.run(seed_side()), daemon=True)
+        th.start()
+        assert ready.wait(30)
+        try:
+            rc = main(
+                ["download", str(tmp_path / "cli-v2.torrent"), str(dest), "--no-resume"]
+            )
+            assert rc == 0
+            assert (dest / "v2cli" / "v.bin").read_bytes() == payload
+        finally:
+            done.set()
+            th.join(10)

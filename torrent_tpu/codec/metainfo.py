@@ -25,6 +25,20 @@ from torrent_tpu.utils.bytesio import partition
 SHA1_LEN = 20
 
 
+def parse_url_list(ul) -> tuple[str, ...]:
+    """BEP 19 ``url-list``: a single URL string or a list of them.
+
+    Shared by the v1 ``Metainfo`` and v2 ``session.v2.V2SessionMeta``
+    web_seeds properties — one normalization for both planes."""
+    if isinstance(ul, bytes):
+        ul = [ul]
+    if not isinstance(ul, list):
+        return ()
+    return tuple(
+        u.decode("utf-8", "replace") for u in ul if isinstance(u, bytes) and u
+    )
+
+
 @dataclass(frozen=True)
 class FileEntry:
     """One file of a multi-file torrent (metainfo.ts MultiFileFields).
@@ -77,14 +91,7 @@ class Metainfo:
     @property
     def web_seeds(self) -> tuple[str, ...]:
         """BEP 19 ``url-list`` (single string or list of strings)."""
-        ul = self.raw.get(b"url-list")
-        if isinstance(ul, bytes):
-            ul = [ul]
-        if not isinstance(ul, list):
-            return ()
-        return tuple(
-            u.decode("utf-8", "replace") for u in ul if isinstance(u, bytes) and u
-        )
+        return parse_url_list(self.raw.get(b"url-list"))
 
 
 _FILE_SHAPE = valid.obj(

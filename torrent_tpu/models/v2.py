@@ -9,10 +9,13 @@ Authoring and resume-recheck for BEP 52 torrents on the TPU hash plane:
                         analogue of the v1 bitfield)
 
 Leaves are uniform 16 KiB blocks → one padded batch through the SHA-256
-plane; every merkle level above them is a single ``sha256_pairs`` call
-(``models/merkle.py``). ``hasher='cpu'`` hashes leaves with hashlib (the
-dominant cost — the merkle reduction above them always runs on the
-device plane); the independent spec oracle lives in tests/test_v2.py.
+plane; the merkle levels above them reduce one ``sha256_pairs`` dispatch
+per level per shape group across ALL files (``roots_batched``).
+``hasher='cpu'`` is device-free END TO END — hashlib leaves AND hashlib
+merkle folds (``_root_cpu``) — so an explicitly-CPU author/verify never
+touches the jax backend (on hosts whose default device is remote or
+wedged, the first dispatch would hang). The independent spec oracle
+lives in tests/test_v2.py.
 """
 
 from __future__ import annotations
@@ -575,10 +578,12 @@ def verify_v2(
     plen = meta.info.piece_length
     lpp = plen // BLOCK
     results: dict[tuple[str, ...], np.ndarray] = {}
-    # phase 1: select present, size-matching files; phase 2: windowed
-    # batched reduction passes (one dispatch per level per shape group
-    # within each bounded-residency window, not a chain per file)
-    todo: list[tuple[V2File, int]] = []  # (file, reduced index)
+    # phase 1: select present, size-matching files (stashing the source —
+    # calling read_file again later could observe a concurrently deleted
+    # or resized file and crash instead of marking it missing); phase 2:
+    # windowed batched reduction passes (one dispatch per level per shape
+    # group within each bounded-residency window, not a chain per file)
+    todo: list[tuple[V2File, object]] = []  # (file, source)
     for f in meta.info.files:
         n_pieces = f.num_pieces(plen)
         source = read_file(f.path)
@@ -592,18 +597,25 @@ def verify_v2(
         if f.length == 0:
             results[f.path] = np.ones(0, dtype=bool)
             continue
-        todo.append((f, len(todo)))
+        todo.append((f, source))
 
     def leaf_entries():
-        for f, _ in todo:
-            source = read_file(f.path)
-            if hasher == "cpu":
-                yield f.length, _leaf_words_cpu(source)
-            else:
-                yield f.length, _leaf_words_device(source, "auto")
+        for f, source in todo:
+            try:
+                if hasher == "cpu":
+                    yield f.length, _leaf_words_cpu(source)
+                else:
+                    yield f.length, _leaf_words_device(source, "auto")
+            except OSError:
+                # a path source deleted between phases: zero leaf words
+                # can't match any real root, so every piece of this file
+                # lands False — same verdict as a missing file
+                yield f.length, np.zeros(
+                    (max(1, -(-f.length // BLOCK)), 8), dtype=np.uint32
+                )
 
     reduced = roots_batched_windowed(leaf_entries(), plen, device=hasher != "cpu")
-    for f, ei in todo:
+    for ei, (f, _) in enumerate(todo):
         n_pieces = f.num_pieces(plen)
         ok = np.zeros(max(1, n_pieces), dtype=bool)
         got_root, got_layer = reduced[ei]
@@ -615,11 +627,21 @@ def verify_v2(
         # metadata self-consistency: the published layer must merkle up to
         # the published root (a hostile layer otherwise localizes damage
         # to the wrong pieces). Data corruption must NOT trip this — the
-        # per-piece comparison below is what localizes it.
-        if (
-            len(layer) != n_pieces
-            or file_root_from_piece_roots(digests_to_words32(layer), lpp) != f.pieces_root
-        ):
+        # per-piece comparison below is what localizes it. The cpu hasher
+        # folds with hashlib (device-free guarantee).
+        if len(layer) != n_pieces:
+            results[f.path] = ok
+            continue
+        if hasher == "cpu":
+            height = lpp.bit_length() - 1
+            padded_n = 1 << max(0, (n_pieces - 1).bit_length())
+            layer_root = _root_cpu(
+                digests_to_words32(layer), padded_n,
+                pad_digest=zero_chain(height)[height],
+            )
+        else:
+            layer_root = file_root_from_piece_roots(digests_to_words32(layer), lpp)
+        if layer_root != f.pieces_root:
             results[f.path] = ok
             continue
         for i in range(n_pieces):

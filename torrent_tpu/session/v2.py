@@ -79,9 +79,13 @@ class V2SessionMeta:
 
     @property
     def web_seeds(self) -> tuple[str, ...]:
-        # BEP 19 addressing differs for v2 (per-file URLs); the v1-shaped
-        # webseed fetcher must not fire on a v2 piece space
-        return ()
+        """BEP 19 ``url-list``. v2's aligned piece space makes webseeds
+        WORK with the generic per-segment fetcher: pieces never span
+        files, piece sizes never reach into the alignment gaps, so every
+        piece maps to exactly one ranged GET inside one file's URL."""
+        from torrent_tpu.codec.metainfo import parse_url_list
+
+        return parse_url_list(self.raw.get(b"url-list"))
 
 
 def _pad_target(length: int) -> int:

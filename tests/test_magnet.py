@@ -291,3 +291,121 @@ class TestBtmh:
         # junk btmh alone leaves no usable topic at all
         with pytest.raises(MagnetError):
             parse_magnet("magnet:?xt=urn:btmh:1220" + "cd" * 16)
+
+
+class TestBep53SelectOnly:
+    def test_parse_and_roundtrip(self):
+        from torrent_tpu.codec.magnet import Magnet, parse_magnet
+
+        m = parse_magnet("magnet:?xt=urn:btih:" + "ab" * 20 + "&so=0,2,4-7")
+        assert m.select_only == (0, 2, 4, 5, 6, 7)
+        # round-trips with run compression
+        assert "so=0,2,4-7" in m.to_uri()
+        assert parse_magnet(m.to_uri()).select_only == m.select_only
+        # no so= -> None (download everything)
+        m2 = parse_magnet("magnet:?xt=urn:btih:" + "ab" * 20)
+        assert m2.select_only is None
+
+    def test_bad_selection_rejected(self):
+        import pytest as _pytest
+
+        from torrent_tpu.codec.magnet import MagnetError, parse_magnet
+
+        for bad in ("x", "3-1", "-2", "1-"):
+            with _pytest.raises(MagnetError):
+                parse_magnet("magnet:?xt=urn:btih:" + "ab" * 20 + "&so=" + bad)
+
+    def test_magnet_selection_applied_e2e(self, tmp_path):
+        """A so= magnet downloads ONLY the selected file."""
+        import asyncio
+        import hashlib
+        import os
+
+        import numpy as np
+
+        from tests.test_session import run
+        from torrent_tpu.codec.bencode import bencode
+        from torrent_tpu.codec.magnet import Magnet
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            plen = 32768
+            rng = np.random.default_rng(77)
+            fa = rng.integers(0, 256, 2 * plen, dtype=np.uint8).tobytes()
+            fb = rng.integers(0, 256, 2 * plen, dtype=np.uint8).tobytes()
+            payload = fa + fb
+            digs = [
+                hashlib.sha1(payload[i : i + plen]).digest()
+                for i in range(0, len(payload), plen)
+            ]
+            server, _ = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            meta = bencode(
+                {
+                    b"announce": b"http://127.0.0.1:%d/announce" % server.http_port,
+                    b"info": {
+                        b"name": b"sel",
+                        b"piece length": plen,
+                        b"pieces": b"".join(digs),
+                        b"files": [
+                            {b"length": len(fa), b"path": [b"a.bin"]},
+                            {b"length": len(fb), b"path": [b"b.bin"]},
+                        ],
+                    },
+                }
+            )
+            m = parse_metainfo(meta)
+            sd, ld = str(tmp_path / "s"), str(tmp_path / "l")
+            os.makedirs(os.path.join(sd, "sel"))
+            os.makedirs(ld)
+            open(os.path.join(sd, "sel", "a.bin"), "wb").write(fa)
+            open(os.path.join(sd, "sel", "b.bin"), "wb").write(fb)
+            c1 = Client(ClientConfig(port=0, enable_upnp=False))
+            c2 = Client(ClientConfig(port=0, enable_upnp=False))
+            await c1.start()
+            await c2.start()
+            try:
+                await c1.add(m, sd)
+                magnet = Magnet(
+                    info_hash=m.info_hash,
+                    trackers=(f"http://127.0.0.1:{server.http_port}/announce",),
+                    peer_addrs=(("127.0.0.1", c1.port),),
+                    select_only=(1,),  # only b.bin
+                )
+                t = await asyncio.wait_for(c2.add_magnet(magnet.to_uri(), ld), 60)
+                for _ in range(600):
+                    if t.status()["wanted_left"] == 0:
+                        break
+                    await asyncio.sleep(0.05)
+                assert t.status()["wanted_left"] == 0, t.status()
+                assert (
+                    open(os.path.join(ld, "sel", "b.bin"), "rb").read() == fb
+                )
+                # a.bin was never wanted: absent or incomplete on disk
+                a_path = os.path.join(ld, "sel", "a.bin")
+                assert not os.path.exists(a_path) or open(a_path, "rb").read() != fa
+            finally:
+                await c1.close()
+                await c2.close()
+                server.close()
+
+        run(go(), timeout=60)
+
+    def test_range_bomb_rejected(self):
+        from torrent_tpu.codec.magnet import MagnetError, parse_magnet
+
+        with pytest.raises(MagnetError, match="exceeds"):
+            parse_magnet(
+                "magnet:?xt=urn:btih:" + "ab" * 20 + "&so=0-9999999999"
+            )
+
+    def test_empty_selection_roundtrips(self):
+        from torrent_tpu.codec.magnet import Magnet, parse_magnet
+
+        m = Magnet(info_hash=IH, select_only=())
+        assert "so=" in m.to_uri()
+        assert parse_magnet(m.to_uri()).select_only == ()

@@ -284,3 +284,70 @@ class TestV2SwarmE2E:
         bad[5] ^= 0xFF
         assert piece_root_cpu(good, 2) == info.pieces[1]
         assert piece_root_cpu(bytes(bad), 2) != info.pieces[1]
+
+
+class TestHybridDualSwarm:
+    def test_one_seed_dir_serves_both_identities(self, tmp_path):
+        """A BEP 52 hybrid torrent joins BOTH swarms from one directory:
+        Client.add(parse_metainfo(blob)) under the SHA-1 infohash and
+        Client.add(parse_metainfo_v2(blob)) under the truncated SHA-256
+        — v1 and v2 leeches each complete against the same seed files."""
+        import numpy as np
+
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+        from torrent_tpu.models.v2 import build_hybrid
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            plen = PLEN
+            fa = np.random.default_rng(91).integers(
+                0, 256, 3 * plen + 200, dtype=np.uint8
+            ).tobytes()
+            server, _ = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            ann = f"http://127.0.0.1:{server.http_port}/announce"
+            blob, _ = build_hybrid(
+                [(("h.bin",), fa)], name="hy", piece_length=plen,
+                hasher="cpu", announce=ann,
+            )
+            m1 = parse_metainfo(blob)
+            mv2 = parse_metainfo_v2(blob)
+            assert m1 is not None and mv2 is not None
+            assert m1.info_hash != mv2.truncated_info_hash
+            sd = str(tmp_path / "hs")
+            os.makedirs(os.path.join(sd, "hy"))
+            open(os.path.join(sd, "hy", "h.bin"), "wb").write(fa)
+            seed = Client(ClientConfig(port=0, enable_upnp=False))
+            lv1 = Client(ClientConfig(port=0, enable_upnp=False))
+            lv2 = Client(ClientConfig(port=0, enable_upnp=False))
+            await seed.start()
+            await lv1.start()
+            await lv2.start()
+            try:
+                t1 = await seed.add(m1, sd)
+                t2 = await seed.add(mv2, sd)
+                assert t1.bitfield.complete and t2.bitfield.complete
+                d1, d2 = str(tmp_path / "l1"), str(tmp_path / "l2")
+                os.makedirs(d1)
+                os.makedirs(d2)
+                tl1 = await lv1.add(m1, d1)
+                tl2 = await lv2.add(mv2, d2)
+                for _ in range(600):
+                    if tl1.bitfield.complete and tl2.bitfield.complete:
+                        break
+                    await asyncio.sleep(0.05)
+                assert tl1.bitfield.complete, tl1.status()
+                assert tl2.bitfield.complete, tl2.status()
+                assert open(os.path.join(d1, "hy", "h.bin"), "rb").read() == fa
+                assert open(os.path.join(d2, "hy", "h.bin"), "rb").read() == fa
+            finally:
+                await seed.close()
+                await lv1.close()
+                await lv2.close()
+                server.close()
+
+        run(go(), timeout=90)

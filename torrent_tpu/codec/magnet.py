@@ -24,6 +24,11 @@ class MagnetError(ValueError):
     pass
 
 
+# BEP 53 cap: magnet URIs are untrusted; a so= range may not select more
+# files than any real torrent plausibly has (prevents range bombs)
+MAX_SELECT_ONLY = 100_000
+
+
 @dataclass(frozen=True)
 class Magnet:
     # v1 (btih, 20 bytes) and/or v2 (btmh sha2-256 multihash, 32 bytes)
@@ -33,6 +38,8 @@ class Magnet:
     trackers: tuple[str, ...] = ()
     peer_addrs: tuple[tuple[str, int], ...] = field(default_factory=tuple)
     info_hash_v2: bytes | None = None
+    # BEP 53 "select only": file indices to download (None = everything)
+    select_only: tuple[int, ...] | None = None
 
     def to_uri(self) -> str:
         topics = []
@@ -54,6 +61,20 @@ class Magnet:
         for host, port in self.peer_addrs:
             h = f"[{host}]" if ":" in host else host  # IPv6 re-bracketing
             parts.append(f"x.pe={h}:{port}")
+        if self.select_only is not None:
+            # BEP 53: compress consecutive runs ("0,2,4-7")
+            runs: list[str] = []
+            idxs = sorted(set(self.select_only))
+            i = 0
+            while i < len(idxs):
+                j = i
+                while j + 1 < len(idxs) and idxs[j + 1] == idxs[j] + 1:
+                    j += 1
+                runs.append(
+                    str(idxs[i]) if i == j else f"{idxs[i]}-{idxs[j]}"
+                )
+                i = j + 1
+            parts.append("so=" + ",".join(runs))
         return "&".join(parts)
 
 
@@ -77,6 +98,9 @@ def parse_magnet(uri: str) -> Magnet:
     if parsed.scheme != "magnet":
         raise MagnetError(f"not a magnet URI: {uri!r}")
     params = parse_qs(parsed.query)
+    # bare "so=" is meaningful (explicit empty selection) but parse_qs
+    # drops blank values by default — look it up with blanks kept
+    params_blank = parse_qs(parsed.query, keep_blank_values=True)
     info_hash = None
     info_hash_v2 = None
     for xt in params.get("xt", []):
@@ -104,10 +128,36 @@ def parse_magnet(uri: str) -> Magnet:
         if not host or not 0 < port < 65536:
             raise MagnetError(f"bad x.pe address {pe!r}")
         peers.append((host.strip("[]"), port))
+    select_only: tuple[int, ...] | None = None
+    if "so" in params_blank:
+        # BEP 53: "so=0,2,4-7" — indices and inclusive ranges; a bare
+        # "so=" is an explicit EMPTY selection (download nothing yet).
+        # A magnet carrying an unparsable so= fails loudly (silently
+        # downloading EVERYTHING would violate the user's selection),
+        # and the total selection is capped: magnet URIs are untrusted
+        # input and "so=0-9999999999" must not materialize a range bomb.
+        picked: set[int] = set()
+        for part in params_blank["so"][0].split(","):
+            part = part.strip()
+            if not part:
+                continue
+            lo, dash, hi = part.partition("-")
+            try:
+                a = int(lo)
+                b = int(hi) if dash else a
+                if a < 0 or b < a:
+                    raise ValueError
+            except ValueError as e:
+                raise MagnetError(f"bad so= selection {part!r}") from e
+            if b - a + 1 > MAX_SELECT_ONLY - len(picked):
+                raise MagnetError(f"so= selection exceeds {MAX_SELECT_ONLY} files")
+            picked.update(range(a, b + 1))
+        select_only = tuple(sorted(picked))
     return Magnet(
         info_hash=info_hash,
         info_hash_v2=info_hash_v2,
         display_name=params["dn"][0] if params.get("dn") else None,
         trackers=tuple(params.get("tr", [])),
         peer_addrs=tuple(peers),
+        select_only=select_only,
     )

@@ -170,7 +170,12 @@ class Client:
             self._verifier_cache[piece_length] = v
         return v
 
-    async def add(self, metainfo: Metainfo, storage: Storage | StorageMethod | str) -> Torrent:
+    async def add(
+        self,
+        metainfo: Metainfo,
+        storage: Storage | StorageMethod | str,
+        wanted_files: list[int] | None = None,
+    ) -> Torrent:
         """Register + start a torrent (client.ts:53-67).
 
         ``storage`` may be a ready Storage, a StorageMethod, or a
@@ -178,6 +183,10 @@ class Client:
         ``metainfo`` may also be a parsed pure-v2 ``MetainfoV2`` (BEP 52):
         it is wrapped into the flat-piece-space session view
         (session/v2.py) and keyed/announced by the truncated SHA-256.
+        ``wanted_files`` applies a file selection BEFORE the torrent
+        starts (out-of-range indices dropped) — selecting after start
+        would let pieces of unselected files be requested and written
+        during the announce/connect window.
         """
         if self.port is None:
             raise RuntimeError("Client.start() must be awaited before add()")
@@ -225,6 +234,11 @@ class Client:
             ip_filter=self.ip_filter,
         )
         self.torrents[metainfo.info_hash] = torrent
+        if wanted_files is not None:
+            n_files = len(torrent.file_ranges())
+            await torrent.select_files(
+                [i for i in wanted_files if 0 <= i < n_files]
+            )
         await torrent.start()
         if self.lsd is not None and not torrent.private:
             self.lsd.register(metainfo.info_hash)  # BEP 27: never private
@@ -267,7 +281,17 @@ class Client:
             dht=self.dht,
             ip_filter=self.ip_filter,
         )
-        torrent = await self.add(metainfo, storage)
+        # BEP 53: the magnet's file selection is applied BEFORE the
+        # torrent starts (out-of-range indices dropped — the selection
+        # was minted against metadata the author may have mis-remembered;
+        # an empty valid set means "download nothing yet")
+        torrent = await self.add(
+            metainfo,
+            storage,
+            wanted_files=list(magnet.select_only)
+            if magnet.select_only is not None
+            else None,
+        )
         if magnet.peer_addrs:
             # Trackerless magnets (x.pe bootstrap): hand the known peers
             # straight to the scheduler instead of waiting on an announce.

@@ -241,6 +241,8 @@ async def _download(args) -> int:
     )
     if args.sequential:
         config.torrent.sequential = True
+    if getattr(args, "super_seed", False):
+        config.torrent.super_seed = True
     client = Client(config)
     await client.start()
     stop = asyncio.Event()
@@ -416,6 +418,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--port", type=int, default=0)
     sp.add_argument("--hasher", choices=("cpu", "tpu"), default="cpu")
     sp.add_argument("--seed", action="store_true", help="keep seeding after completion")
+    sp.add_argument(
+        "--super-seed",
+        action="store_true",
+        help="BEP 16 super-seeding while complete (reveal pieces one-by-one)",
+    )
     sp.add_argument("--no-resume", action="store_true", help="skip fastresume checkpoints")
     sp.add_argument(
         "--files",

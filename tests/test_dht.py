@@ -311,3 +311,58 @@ class TestHostileInputHardening:
             assert fut2.done() and isinstance(fut2.exception(), DHTError)
 
         run(go())
+
+
+class TestBep42:
+    """BEP 42 DHT security: node ids derived from external IPs."""
+
+    def test_generated_id_validates(self):
+        from torrent_tpu.net.dht import bep42_node_id, bep42_valid
+
+        for ip in ("93.184.216.34", "8.8.8.8", "2001:4860:4860::8888"):
+            nid = bep42_node_id(ip)
+            assert bep42_valid(nid, ip), (ip, nid.hex())
+            # and fails against a different global IP (w.h.p.)
+            assert not bep42_valid(nid, "144.52.10.9")
+
+    def test_private_ips_exempt(self):
+        from torrent_tpu.net.dht import bep42_valid, random_node_id
+
+        for ip in ("127.0.0.1", "10.1.2.3", "192.168.0.9", "::1", "fe80::1"):
+            assert bep42_valid(random_node_id(), ip)
+
+    def test_known_vector(self):
+        """BEP 42's published example: IP 124.31.75.21, r=1 -> id begins
+        5fbfbf (first 21 bits)."""
+        from torrent_tpu.net.dht import bep42_prefix
+
+        want = bep42_prefix("124.31.75.21", 1)
+        assert want is not None
+        assert want[0] == 0x5F and want[1] == 0xBF
+        assert want[2] & 0xF8 == 0xBF & 0xF8
+
+    def test_enforcing_node_rejects_bad_ids(self):
+        import asyncio
+
+        from torrent_tpu.net.dht import DHTNode, bep42_node_id
+
+        async def go():
+            n = DHTNode(enforce_bep42=True)
+            # a non-compliant id from a global IP is kept out of the table
+            n._table_update(b"\x00" * 20, "93.184.216.34", 6881)
+            assert len(n.table) == 0
+            # a compliant one gets in
+            good = bep42_node_id("93.184.216.34")
+            n._table_update(good, "93.184.216.34", 6881)
+            assert len(n.table) == 1
+            # private addresses are exempt either way
+            n._table_update(b"\x11" * 20, "10.0.0.5", 6881)
+            assert len(n.table) == 2
+
+        asyncio.run(go())
+
+    def test_external_ip_mints_compliant_own_id(self):
+        from torrent_tpu.net.dht import DHTNode, bep42_valid
+
+        n = DHTNode(external_ip="93.184.216.34")
+        assert bep42_valid(n.node_id, "93.184.216.34")

@@ -22,7 +22,7 @@ Layer map (mirrors reference layers, re-designed TPU-first; see SURVEY.md):
 (Empty subpackages in this tree are landing in build order — SURVEY.md §7.)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 # Public API surface. The reference's mod.ts exports only codec + tracker
 # (mod.ts:1-3, SURVEY §1 note); here the session layer is first-class.

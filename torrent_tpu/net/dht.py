@@ -46,6 +46,57 @@ MAX_PEERS_PER_HASH = 2000
 BOOTSTRAP_TARGET_RETRIES = 2
 
 
+def bep42_prefix(ip: str, r: int) -> bytes | None:
+    """BEP 42 node-id constraint: the first 21 bits of a node's id must
+    derive from CRC32-C of its masked IP. Returns the 3 expected prefix
+    bytes (last 5 bits of byte 2 are free), or None when the address is
+    exempt (loopback/private ranges — BEP 42 only binds global IPs)."""
+    import ipaddress
+
+    from torrent_tpu.net.priority import crc32c
+
+    try:
+        addr = ipaddress.ip_address(ip)
+    except ValueError:
+        return None
+    if addr.is_private or addr.is_loopback or addr.is_link_local:
+        return None
+    if addr.version == 4:
+        data = ((int(addr) & 0x030F3FFF) | (r << 29)).to_bytes(4, "big")
+    else:
+        hi64 = int(addr) >> 64  # BEP 42 v6: top 64 bits, masked, r on top
+        data = ((hi64 & 0x0103070F1F3F7FFF) | (r << 61)).to_bytes(8, "big")
+    crc = crc32c(data)
+    return bytes(((crc >> 24) & 0xFF, (crc >> 16) & 0xFF, (crc >> 8) & 0xF8))
+
+
+def bep42_valid(node_id: bytes, ip: str) -> bool:
+    """True when ``node_id`` satisfies BEP 42 for ``ip`` (exempt IPs are
+    always valid)."""
+    want = bep42_prefix(ip, node_id[-1] & 0x7)
+    if want is None:
+        return True
+    return (
+        node_id[0] == want[0]
+        and node_id[1] == want[1]
+        and (node_id[2] & 0xF8) == want[2]
+    )
+
+
+def bep42_node_id(ip: str) -> bytes:
+    """Generate a BEP 42-compliant id for our own external IP (random id
+    when the address is exempt)."""
+    raw = bytearray(random_node_id())
+    r = raw[-1] & 0x7
+    want = bep42_prefix(ip, r)
+    if want is None:
+        return bytes(raw)
+    raw[0] = want[0]
+    raw[1] = want[1]
+    raw[2] = want[2] | (raw[2] & 0x7)
+    return bytes(raw)
+
+
 def random_node_id() -> bytes:
     return os.urandom(20)
 
@@ -201,8 +252,22 @@ class _Protocol(asyncio.DatagramProtocol):
 class DHTNode:
     """One mainline-DHT endpoint: server + query client + lookups."""
 
-    def __init__(self, node_id: bytes | None = None, port: int = 0, host: str = "0.0.0.0"):
+    def __init__(
+        self,
+        node_id: bytes | None = None,
+        port: int = 0,
+        host: str = "0.0.0.0",
+        enforce_bep42: bool = False,
+        external_ip: str | None = None,
+    ):
+        """``enforce_bep42`` keeps nodes whose ids violate BEP 42's
+        IP-derived constraint out of the routing table (defense against
+        id-targeting attacks; off by default — plenty of live nodes
+        predate the BEP). ``external_ip`` mints our own id compliant."""
+        if node_id is None and external_ip is not None:
+            node_id = bep42_node_id(external_ip)
         self.node_id = node_id or random_node_id()
+        self.enforce_bep42 = enforce_bep42
         self.host = host
         self.port = port
         self.table = RoutingTable(self.node_id)
@@ -237,6 +302,16 @@ class DHTNode:
     @property
     def addr(self) -> tuple[str, int]:
         return (self.host, self.port)
+
+    def _table_update(self, node_id: bytes, ip: str, port: int) -> None:
+        """Routing-table insertion with optional BEP 42 enforcement:
+        nodes whose ids don't derive from their IP stay OUT of the table
+        (they can still answer the query that surfaced them — BEP 42
+        constrains routing state, not peer traffic)."""
+        if self.enforce_bep42 and not bep42_valid(node_id, ip):
+            log.debug("dht: rejecting non-BEP42 node %s at %s", node_id.hex()[:8], ip)
+            return
+        self.table.update(node_id, ip, port)
 
     # ------------------------------------------------------------ raw KRPC
 
@@ -302,7 +377,7 @@ class DHTNode:
                     if isinstance(r, dict):
                         rid = r.get(b"id")
                         if isinstance(rid, bytes) and len(rid) == 20:
-                            self.table.update(rid, addr[0], addr[1])
+                            self._table_update(rid, addr[0], addr[1])
                         fut.set_result(r)
                     else:
                         # fail fast instead of burning the full RPC timeout
@@ -327,7 +402,7 @@ class DHTNode:
             return
         qid = a.get(b"id")
         if isinstance(qid, bytes) and len(qid) == 20:
-            self.table.update(qid, addr[0], addr[1])
+            self._table_update(qid, addr[0], addr[1])
         try:
             self._handle_query(addr, tid, q, a)
         except Exception as e:  # malformed args must never kill the endpoint
@@ -450,6 +525,10 @@ class DHTNode:
             except OSError:
                 continue
             try:
+                # operator-chosen seeds bypass BEP 42 enforcement: the
+                # long-lived public bootstrap nodes predate the BEP, and
+                # rejecting them would leave the table empty — no
+                # candidates, no lookups, a bricked join
                 self.table.update(await self.ping(ip_addr), ip_addr[0], ip_addr[1])
             except DHTError:
                 continue

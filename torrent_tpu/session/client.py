@@ -49,6 +49,9 @@ class ClientConfig:
     enable_dht: bool = False  # BEP 5 mainline DHT (net/dht.py)
     dht_port: int = 0  # 0 = ephemeral UDP port
     dht_bootstrap: tuple = ()  # ((host, port), ...) seed nodes
+    # BEP 42: reject routing-table nodes whose ids don't derive from
+    # their IP (id-targeting defense; off by default for compat)
+    dht_enforce_bep42: bool = False
     # Client-global transfer caps in bytes/s (0 = unlimited): one token
     # bucket per direction shared by every torrent (utils/ratelimit.py)
     max_upload_bps: int = 0
@@ -93,15 +96,9 @@ class Client:
             self._accept, self.config.host, self.config.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
-        if self.config.enable_dht:
-            from torrent_tpu.net.dht import DHTNode
-
-            self.dht = await DHTNode(
-                port=self.config.dht_port, host=self.config.host
-            ).start()
-            if self.config.dht_bootstrap:
-                await self.dht.bootstrap([tuple(a) for a in self.config.dht_bootstrap])
         if self.config.enable_upnp:
+            # before DHT: a learned external IP lets the DHT node mint a
+            # BEP 42-compliant id at construction
             try:
                 from torrent_tpu.net.upnp import get_ip_addrs_and_map_port
 
@@ -109,6 +106,17 @@ class Client:
                 self.external_ip = ips.external_ip
             except Exception as e:  # UPnP is best-effort
                 log.warning("UPnP setup failed: %s", e)
+        if self.config.enable_dht:
+            from torrent_tpu.net.dht import DHTNode
+
+            self.dht = await DHTNode(
+                port=self.config.dht_port,
+                host=self.config.host,
+                enforce_bep42=self.config.dht_enforce_bep42,
+                external_ip=self.external_ip,
+            ).start()
+            if self.config.dht_bootstrap:
+                await self.dht.bootstrap([tuple(a) for a in self.config.dht_bootstrap])
         if self.config.enable_lsd:
             try:
                 from torrent_tpu.net.lsd import LocalServiceDiscovery

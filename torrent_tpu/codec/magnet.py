@@ -41,6 +41,16 @@ class Magnet:
     # BEP 53 "select only": file indices to download (None = everything)
     select_only: tuple[int, ...] | None = None
 
+    @property
+    def wire_hash(self) -> bytes:
+        """The 20-byte infohash used on the wire (registry key, handshake,
+        tracker/DHT announces): btih as-is, or the TRUNCATED sha-256 for
+        a pure-v2 (btmh-only) magnet per BEP 52."""
+        if self.info_hash is not None:
+            return self.info_hash
+        assert self.info_hash_v2 is not None  # parse_magnet guarantees one
+        return self.info_hash_v2[:20]
+
     def to_uri(self) -> str:
         topics = []
         if self.info_hash is not None:

@@ -25,11 +25,14 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import ipaddress
 import os
 import random
 import socket
 import time
 from dataclasses import dataclass, field
+
+from torrent_tpu.net.priority import crc32c
 
 from torrent_tpu.codec.bencode import BencodeError, bdecode, bencode
 from torrent_tpu.utils.bytesio import read_int, write_int
@@ -51,10 +54,6 @@ def bep42_prefix(ip: str, r: int) -> bytes | None:
     derive from CRC32-C of its masked IP. Returns the 3 expected prefix
     bytes (last 5 bits of byte 2 are free), or None when the address is
     exempt (loopback/private ranges — BEP 42 only binds global IPs)."""
-    import ipaddress
-
-    from torrent_tpu.net.priority import crc32c
-
     try:
         addr = ipaddress.ip_address(ip)
     except ValueError:

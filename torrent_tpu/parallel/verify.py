@@ -211,14 +211,11 @@ def verify_pieces(
     """
     if info.num_pieces == 0:
         return np.zeros(0, dtype=bool)
-    if getattr(info, "v2", False):
-        if hasher == "cpu":
-            return verify_pieces_v2_cpu(storage, info, progress_cb)
-        if hasher == "tpu":
-            return verify_pieces_v2_tpu(storage, info, progress_cb=progress_cb, **tpu_kwargs)
-        raise ValueError(f"unknown hasher {hasher!r}")
+    v2 = getattr(info, "v2", False)
     if hasher == "cpu":
-        return verify_pieces_cpu(storage, info, progress_cb)
+        fn = verify_pieces_v2_cpu if v2 else verify_pieces_cpu
+        return fn(storage, info, progress_cb)
     if hasher == "tpu":
-        return verify_pieces_tpu(storage, info, progress_cb=progress_cb, **tpu_kwargs)
+        fn = verify_pieces_v2_tpu if v2 else verify_pieces_tpu
+        return fn(storage, info, progress_cb=progress_cb, **tpu_kwargs)
     raise ValueError(f"unknown hasher {hasher!r}")

@@ -269,14 +269,7 @@ class Client:
             magnet = parse_magnet(magnet)
         if not isinstance(magnet, Magnet):
             raise TypeError("magnet must be a Magnet or magnet URI string")
-        # pure-v2 magnets (btmh only) join the swarm under the TRUNCATED
-        # sha-256 infohash (BEP 52); hybrids/v1 use the btih topic
-        wire_hash = (
-            magnet.info_hash
-            if magnet.info_hash is not None
-            else magnet.info_hash_v2[:20]
-        )
-        if wire_hash in self.torrents:
+        if magnet.wire_hash in self.torrents:
             raise ValueError("torrent already added")
         # Throwaway peer id for the metadata connections: if the fetch
         # socket's EOF hasn't been reaped by the seeder when the real

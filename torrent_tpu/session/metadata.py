@@ -203,7 +203,7 @@ async def fetch_metadata(
     v2_only = magnet.info_hash is None
     # BEP 52: a pure-v2 swarm announces and handshakes with the
     # TRUNCATED sha-256 infohash (the v2 analogue of protocol.ts:36-67)
-    wire_hash = magnet.info_hash if not v2_only else magnet.info_hash_v2[:20]
+    wire_hash = magnet.wire_hash
     candidates: list[tuple[str, int]] = list(magnet.peer_addrs)
     if dht is not None:
         try:

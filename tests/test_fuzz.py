@@ -12,7 +12,7 @@ import hashlib
 from hypothesis import given, settings, strategies as st
 
 from torrent_tpu.codec.bencode import BencodeError, bdecode, bdecode_prefix, bencode
-from torrent_tpu.codec.magnet import MagnetError, parse_magnet
+from torrent_tpu.codec.magnet import Magnet, MagnetError, parse_magnet
 from torrent_tpu.codec.metainfo import parse_metainfo
 from torrent_tpu.net.extension import decode_extended_handshake, decode_metadata_message
 from torrent_tpu.net.extension import ExtensionState
@@ -243,3 +243,83 @@ class TestUtpDecoderProperties:
         ptype2, cid2, _, _, _, seq2, ack2, payload2, sack = decode_packet(enc)
         assert (ptype2, cid2, seq2, ack2, payload2) == (ptype, cid, seq, ack, payload)
         assert sack is None
+
+
+class TestHolepunchProperties:
+    """BEP 55 codec totality + roundtrip (round-3 additions)."""
+
+    @given(st.binary(max_size=64))
+    @settings(max_examples=300)
+    def test_decode_total(self, blob):
+        from torrent_tpu.net.extension import decode_holepunch
+
+        decode_holepunch(blob)  # must never raise, whatever arrives
+
+    @given(
+        st.sampled_from([0, 1, 2]),
+        st.one_of(
+            st.ip_addresses(v=4).map(str), st.ip_addresses(v=6).map(str)
+        ),
+        st.integers(min_value=0, max_value=65535),
+        st.integers(min_value=0, max_value=0xFFFFFFFF),
+    )
+    @settings(max_examples=200)
+    def test_roundtrip(self, mtype, host, port, err):
+        from torrent_tpu.net.extension import (
+            HolepunchMessage,
+            decode_holepunch,
+            encode_holepunch,
+        )
+
+        msg = HolepunchMessage(mtype, (host, port), err_code=err if mtype == 2 else 0)
+        got = decode_holepunch(encode_holepunch(msg))
+        assert got is not None
+        # inet_ntop canonicalizes the text form; compare packed values
+        import socket as s
+
+        fam = s.AF_INET6 if ":" in host else s.AF_INET
+        assert s.inet_pton(fam, got.addr[0]) == s.inet_pton(fam, host)
+        assert (got.msg_type, got.addr[1], got.err_code) == (
+            msg.msg_type,
+            port,
+            msg.err_code,
+        )
+
+
+class TestSelectOnlyProperties:
+    """BEP 53 so= parse/emit roundtrip + totality."""
+
+    @given(st.lists(st.integers(min_value=0, max_value=5000), max_size=60))
+    @settings(max_examples=200)
+    def test_roundtrip(self, idxs):
+        m = Magnet(info_hash=b"\x11" * 20, select_only=tuple(idxs))
+        got = parse_magnet(m.to_uri())
+        assert got.select_only == tuple(sorted(set(idxs)))
+
+    @given(st.text(alphabet="0123456789,-x ", max_size=40))
+    @settings(max_examples=300)
+    def test_parse_total(self, so):
+        from urllib.parse import quote
+
+        try:
+            parse_magnet(
+                "magnet:?xt=urn:btih:" + "ab" * 20 + "&so=" + quote(so)
+            )
+        except MagnetError:
+            pass  # rejection is fine; anything else must not escape
+
+
+class TestBep42Properties:
+    @given(st.ip_addresses(v=4).map(str))
+    @settings(max_examples=200)
+    def test_generated_ids_always_validate(self, ip):
+        from torrent_tpu.net.dht import bep42_node_id, bep42_valid
+
+        assert bep42_valid(bep42_node_id(ip), ip)
+
+    @given(st.ip_addresses(v=6).map(str))
+    @settings(max_examples=100)
+    def test_v6_ids_always_validate(self, ip):
+        from torrent_tpu.net.dht import bep42_node_id, bep42_valid
+
+        assert bep42_valid(bep42_node_id(ip), ip)

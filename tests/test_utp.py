@@ -847,3 +847,115 @@ class TestBareSynFallback:
                 server.close()
 
         run(go(), timeout=30)
+
+
+class TestLateDataAfterClose:
+    def test_inflight_data_after_local_close_is_acked_not_crash(self):
+        """Regression: data still in flight when we close() used to hit
+        asyncio's 'feed_data after feed_eof' assertion and kill the
+        datagram handler. It must be acked (so the peer's retransmit
+        timers settle) and dropped."""
+        sent = []
+
+        class _Record:
+            def sendto(self, data, addr):
+                sent.append(utp.decode_packet(data))
+
+            def _forget(self, conn):
+                pass
+
+        async def go():
+            conn = utp.UtpConnection(_Record(), ("1.2.3.4", 1), 10, 11)
+            conn.connected.set()
+            conn.ack_nr = 100
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 101, 0, b"a")
+            conn.close()  # reader EOF'd; FIN out; conn still alive
+            # late in-flight data arrives — must not raise
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 102, 0, b"b")
+            assert conn.ack_nr == 102  # acked (dropped, not delivered)
+            acks = [p for p in sent if p and p[0] == utp.ST_STATE]
+            assert acks and acks[-1][6] == 102
+            # and the peer's FIN completes the close without a crash
+            conn.on_packet(utp.ST_FIN, 0, 0, 1 << 20, 103, 0, b"")
+            assert conn.closed and not conn._reset
+
+        run(go())
+
+
+class TestDelayedAcks:
+    class _Record:
+        def __init__(self):
+            self.sent = []
+
+        def sendto(self, data, addr):
+            self.sent.append(utp.decode_packet(data))
+
+        def _forget(self, conn):
+            pass
+
+        def states(self):
+            return [p for p in self.sent if p and p[0] == utp.ST_STATE]
+
+    def test_two_in_order_packets_one_ack(self):
+        async def go():
+            ep = self._Record()
+            conn = utp.UtpConnection(ep, ("1.2.3.4", 1), 10, 11)
+            conn.connected.set()
+            conn.ack_nr = 100
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 101, 0, b"a")
+            assert len(ep.states()) == 0  # first packet: ack delayed
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 102, 0, b"b")
+            assert len(ep.states()) == 1  # 2nd packet flushes ONE ack
+            assert ep.states()[-1][6] == 102
+
+        run(go())
+
+    def test_lone_packet_acks_via_timer(self):
+        async def go():
+            ep = self._Record()
+            conn = utp.UtpConnection(ep, ("1.2.3.4", 1), 10, 11)
+            conn.connected.set()
+            conn.ack_nr = 100
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 101, 0, b"a")
+            assert len(ep.states()) == 0
+            await asyncio.sleep(0.12)  # > 50 ms delack timer
+            assert len(ep.states()) == 1 and ep.states()[-1][6] == 101
+
+        run(go())
+
+    def test_hole_acks_immediately(self):
+        """Out-of-order arrivals must ack NOW — the sender's dup-ack
+        fast-resend and SACK feedback depend on prompt dup STATEs."""
+
+        async def go():
+            ep = self._Record()
+            conn = utp.UtpConnection(ep, ("1.2.3.4", 1), 10, 11)
+            conn.connected.set()
+            conn.ack_nr = 100
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 103, 0, b"c")  # hole
+            assert len(ep.states()) == 1  # immediate dup-ack w/ SACK
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 104, 0, b"d")
+            assert len(ep.states()) == 2
+
+        run(go())
+
+    def test_sacked_data_before_close_completes_fin_handshake(self):
+        """Regression for the _rx_closed stall: 102/103 buffered (and
+        SACKed — the peer will NOT retransmit them), local close, then
+        the hole fills and the FIN arrives. Sequencing must advance
+        THROUGH the discarded ooo data so the FIN handshake completes."""
+
+        async def go():
+            ep = self._Record()
+            conn = utp.UtpConnection(ep, ("1.2.3.4", 1), 10, 11)
+            conn.connected.set()
+            conn.ack_nr = 100
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 102, 0, b"b")
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 103, 0, b"c")
+            conn.close()  # reader EOF'd; 102/103 still in _ooo
+            conn.on_packet(utp.ST_DATA, 0, 0, 1 << 20, 101, 0, b"a")
+            assert conn.ack_nr == 103  # drained through in discard mode
+            conn.on_packet(utp.ST_FIN, 0, 0, 1 << 20, 104, 0, b"")
+            assert conn.closed and not conn._reset  # graceful completion
+
+        run(go())

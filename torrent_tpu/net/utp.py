@@ -199,7 +199,13 @@ class UtpConnection:
         self.peer_wnd = RECV_WINDOW
         self._fin_seq: int | None = None
         self._fin_sent = False
+        self._rx_closed = False  # reader EOF'd: drop (but ack) late data
         self._timer: asyncio.TimerHandle | None = None
+        # delayed acks: in-order data acks every 2nd packet (or 50 ms),
+        # halving ack traffic; holes/FINs/window-updates ack immediately
+        # so dup-ack fast-resend and SACK feedback keep their timing
+        self._delack_timer: asyncio.TimerHandle | None = None
+        self._unacked = 0
 
     # ------------------------------------------------------------- sending
 
@@ -292,12 +298,14 @@ class UtpConnection:
     # ------------------------------------------------------------ receiving
 
     def _drain_ooo(self) -> None:
-        """Deliver buffered out-of-order successors now in line."""
+        """Deliver buffered out-of-order successors now in line (in
+        discard mode after a local close: sequence numbers still advance
+        so the peer's FIN handshake completes, bytes go nowhere)."""
         nxt = (self.ack_nr + 1) & 0xFFFF
         while nxt in self._ooo:
             data = self._ooo.pop(nxt)
             self._ooo_bytes -= len(data)
-            if data:
+            if data and not self._rx_closed:
                 self.reader.feed_data(data)
             self.ack_nr = nxt
             nxt = (nxt + 1) & 0xFFFF
@@ -334,17 +342,27 @@ class UtpConnection:
             if ptype == ST_FIN:
                 self._fin_seq = seq
             expected = (self.ack_nr + 1) & 0xFFFF
+            in_order = False
             if seq == expected:
-                if payload and self._occupancy() + len(payload) > RECV_WINDOW:
+                if (
+                    payload
+                    and not self._rx_closed
+                    and self._occupancy() + len(payload) > RECV_WINDOW
+                ):
                     # sender ignored our advertised window (hostile or
                     # broken): drop without acking — it must retransmit
                     # once the application drains and the window reopens
                     self._send_state()
                     return
                 self.ack_nr = seq
-                if payload:
+                # after a local close the reader has EOF'd: sequencing
+                # still advances (the peer's FIN handshake must finish)
+                # but bytes are discarded — feed_data after feed_eof is
+                # an asyncio invariant violation
+                if payload and not self._rx_closed:
                     self.reader.feed_data(payload)
                 self._drain_ooo()
+                in_order = True
             elif _seq_lt(expected, seq):
                 # hole: buffer until filled. FINs buffer too (else close
                 # stalls an RTO when the FIN outruns the last data), and
@@ -356,9 +374,28 @@ class UtpConnection:
                     if self._occupancy() + len(payload) <= RECV_WINDOW:
                         self._ooo[seq] = payload
                         self._ooo_bytes += len(payload)
-            # duplicate (seq < expected): just re-ack
-            self._send_state()
-            if self._fin_seq is not None and self.ack_nr == self._fin_seq:
+            fin_reached = (
+                self._fin_seq is not None and self.ack_nr == self._fin_seq
+            )
+            if (
+                in_order
+                and not self._ooo
+                and ptype == ST_DATA
+                and not fin_reached
+                and not self._rx_closed  # close handshake acks promptly
+            ):
+                self._unacked += 1
+                if self._unacked >= 2:
+                    self._ack_now()
+                elif self._delack_timer is None:
+                    self._delack_timer = asyncio.get_running_loop().call_later(
+                        0.05, self._ack_now
+                    )
+            else:
+                # hole / duplicate / FIN: immediate ack — dup-ack counting
+                # and SACK masks at the sender depend on prompt feedback
+                self._ack_now()
+            if fin_reached:
                 self._die(reset=False)
 
     def _handle_ack(self, ptype: int, ack: int, ts_diff: int, sack: bytes | None = None) -> None:
@@ -525,6 +562,15 @@ class UtpConnection:
         nbytes = max(4, ((top >> 3) + 4) & ~3)
         return bytes(mask[:nbytes])
 
+    def _ack_now(self) -> None:
+        """Flush the (possibly delayed) ack immediately."""
+        if self._delack_timer is not None:
+            self._delack_timer.cancel()
+            self._delack_timer = None
+        self._unacked = 0
+        if not self.closed:
+            self._send_state()
+
     def _send_state(self) -> None:
         sack = self._build_sack() if (SACK_ENABLED and self._ooo) else None
         self.endpoint.sendto(
@@ -550,22 +596,25 @@ class UtpConnection:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
+        if self._delack_timer is not None:
+            self._delack_timer.cancel()
+            self._delack_timer = None
         self._outstanding.clear()
         self._sacked.clear()
         self._send_room.set()
-        if reset:
-            self.reader.feed_eof()
-            if not self.connected.is_set():
-                self.connected.set()  # unblock dialers; they check _reset
-        else:
-            self.reader.feed_eof()
+        self._rx_closed = True
+        self.reader.feed_eof()
+        if reset and not self.connected.is_set():
+            self.connected.set()  # unblock dialers; they check _reset
         self.endpoint._forget(self)
 
     def close(self) -> None:
         if not self.closed:
             self.send_fin()
             # the FIN retransmit timer keeps the connection alive until
-            # acked or max-retransmits; reads see EOF immediately
+            # acked or max-retransmits; reads see EOF immediately and
+            # late in-flight data is acked-but-dropped (_rx_closed)
+            self._rx_closed = True
             self.reader.feed_eof()
 
 

@@ -351,3 +351,78 @@ class TestHybridDualSwarm:
                 server.close()
 
         run(go(), timeout=90)
+
+
+class TestV2Lifecycle:
+    def test_pause_resume_and_remove(self, tmp_path):
+        """Session lifecycle on a pure-v2 torrent: pause freezes the
+        leech, resume completes it, remove unregisters the identity."""
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            server, _ = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            ann = f"http://127.0.0.1:{server.http_port}/announce"
+            meta, files = _build(announce=ann, seed=23)
+            sd = _seed_dir(tmp_path, "lc", files)
+            ld = str(tmp_path / "lcl")
+            os.makedirs(ld)
+            c1 = Client(ClientConfig(port=0, enable_upnp=False))
+            c2 = Client(ClientConfig(port=0, enable_upnp=False))
+            await c1.start()
+            await c2.start()
+            try:
+                t1 = await c1.add(meta, sd)
+                t2 = await c2.add(meta, ld)
+                await t2.pause()
+                before = t2.bitfield.count()
+                await asyncio.sleep(0.6)
+                assert t2.bitfield.count() == before  # frozen
+                await t2.resume()
+                for _ in range(600):
+                    if t2.bitfield.complete:
+                        break
+                    await asyncio.sleep(0.05)
+                assert t2.bitfield.complete, t2.status()
+                # remove by the truncated-sha256 wire key
+                await c2.remove(meta.info_hash_v2[:20])
+                assert meta.info_hash_v2[:20] not in c2.torrents
+            finally:
+                await c1.close()
+                await c2.close()
+                server.close()
+
+        run(go(), timeout=90)
+
+    def test_fastresume_roundtrip(self, tmp_path):
+        """A completed v2 download restarts from fastresume without a
+        recheck scan marking pieces invalid."""
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            server, _ = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            ann = f"http://127.0.0.1:{server.http_port}/announce"
+            meta, files = _build(announce=ann, seed=29)
+            sd = _seed_dir(tmp_path, "fr", files)
+            c1 = Client(ClientConfig(port=0, enable_upnp=False, resume=True))
+            await c1.start()
+            try:
+                t1 = await c1.add(meta, sd)
+                assert t1.bitfield.complete
+                await c1.remove(meta.info_hash_v2[:20])
+                # second add: the checkpoint written at seed-add time
+                # short-circuits the recheck
+                t1b = await c1.add(meta, sd)
+                assert t1b.bitfield.complete
+            finally:
+                await c1.close()
+                server.close()
+
+        run(go(), timeout=60)

@@ -17,7 +17,7 @@ from __future__ import annotations
 import base64
 import binascii
 from dataclasses import dataclass, field
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, quote, urlparse
 
 
 class MagnetError(ValueError):
@@ -40,6 +40,8 @@ class Magnet:
     info_hash_v2: bytes | None = None
     # BEP 53 "select only": file indices to download (None = everything)
     select_only: tuple[int, ...] | None = None
+    # BEP 9 §"magnet URI format" / BEP 19: ws= webseed URLs
+    web_seeds: tuple[str, ...] = ()
 
     @property
     def wire_hash(self) -> bytes:
@@ -61,16 +63,14 @@ class Magnet:
             raise MagnetError("magnet needs at least one exact topic")
         parts = ["magnet:?" + topics[0]] + topics[1:]
         if self.display_name:
-            from urllib.parse import quote
-
             parts.append(f"dn={quote(self.display_name)}")
         for tr in self.trackers:
-            from urllib.parse import quote
-
             parts.append(f"tr={quote(tr, safe='')}")
         for host, port in self.peer_addrs:
             h = f"[{host}]" if ":" in host else host  # IPv6 re-bracketing
             parts.append(f"x.pe={h}:{port}")
+        for ws in self.web_seeds:
+            parts.append(f"ws={quote(ws, safe='')}")
         if self.select_only is not None:
             # BEP 53: compress consecutive runs ("0,2,4-7")
             runs: list[str] = []
@@ -170,4 +170,5 @@ def parse_magnet(uri: str) -> Magnet:
         trackers=tuple(params.get("tr", [])),
         peer_addrs=tuple(peers),
         select_only=select_only,
+        web_seeds=tuple(u for u in params.get("ws", []) if u),
     )

@@ -293,6 +293,8 @@ class Client:
             if magnet.select_only is not None
             else None,
         )
+        for ws in magnet.web_seeds:
+            torrent.add_web_seed(ws)  # BEP 19 ws= params
         if magnet.peer_addrs:
             # Trackerless magnets (x.pe bootstrap): hand the known peers
             # straight to the scheduler instead of waiting on an announce.

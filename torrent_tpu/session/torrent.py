@@ -207,6 +207,14 @@ class Torrent:
         self._wanted_missing = self.info.num_pieces
         # paused: transfers suspended, connections and state kept alive
         self.paused = False
+        from torrent_tpu.session.webseed import allowed_url as _ws_allowed
+
+        # BEP 19 webseed URLs: the metainfo's url-list plus any added at
+        # runtime (magnet ws= params arrive after construction). Both
+        # sources are untrusted — only http/https survive.
+        self.web_seed_urls: list[str] = [
+            u for u in metainfo.web_seeds if _ws_allowed(u)
+        ]
         # serve-path LRU of whole pieces (dict ordering = recency) and
         # in-flight reads shared by concurrent misses on the same piece
         self._serve_cache: dict[int, bytes] = {}
@@ -325,7 +333,7 @@ class Torrent:
             # interval before discovering anyone to fetch from
             self.state = TorrentState.DOWNLOADING
             self.on_complete.clear()
-            for url in self.metainfo.web_seeds:
+            for url in self.web_seed_urls:
                 self._spawn(self._webseed_loop(url), name=f"webseed-{url[:24]}")
             self.request_peers()
         for peer in list(self.peers.values()):
@@ -377,8 +385,24 @@ class Torrent:
         self._spawn(self._keepalive_loop(), name="keepalive")
         if not self.private:
             self._spawn(self._pex_loop(), name="pex")
-        for url in self.metainfo.web_seeds:
+        for url in self.web_seed_urls:
             self._spawn(self._webseed_loop(url), name=f"webseed-{url[:24]}")
+
+    def add_web_seed(self, url: str) -> bool:
+        """Attach a BEP 19 webseed at runtime (e.g. a magnet's ``ws=``).
+
+        Deduplicated and scheme-checked (untrusted input: only http/https
+        — urllib would happily open file:// or ftp://); if the torrent is
+        already running and pieces are still wanted, the fetch loop
+        starts immediately. True when the URL was newly attached."""
+        from torrent_tpu.session.webseed import allowed_url
+
+        if url in self.web_seed_urls or not allowed_url(url):
+            return False
+        self.web_seed_urls.append(url)
+        if self.state in (TorrentState.DOWNLOADING, TorrentState.SEEDING):
+            self._spawn(self._webseed_loop(url), name=f"webseed-{url[:24]}")
+        return True
 
     def _spawn(self, coro, name=None) -> asyncio.Task:
         """Track a task for teardown; completed tasks self-evict."""

@@ -31,6 +31,18 @@ class WebSeedError(Exception):
     pass
 
 
+def allowed_url(url: str) -> bool:
+    """True for http/https webseed URLs. Both url-list fields (torrent
+    files) and ws= params (magnets) are UNTRUSTED input, and fetch_range
+    feeds the URL to urllib — which happily opens file:// and ftp://.
+    Anything but plain web schemes is refused before a loop ever spawns
+    (SSRF / local-file-read guard)."""
+    try:
+        return urllib.parse.urlsplit(url).scheme in ("http", "https")
+    except ValueError:
+        return False
+
+
 def url_for(base: str, info: InfoDict, path: tuple[str, ...]) -> str:
     """Resolve the GET URL for one file of the torrent (BEP 19 §url-list)."""
     if base.endswith("/"):

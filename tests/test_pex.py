@@ -26,11 +26,12 @@ class TestPexCodec:
         assert msg.added == tuple(added)
         assert msg.dropped == tuple(dropped)
 
-    def test_v6_and_bad_ports_skipped_in_pack(self):
+    def test_bad_ports_skipped_v6_routed_to_added6(self):
         payload = ext.encode_pex([("::1", 6881), ("1.2.3.4", 0), ("5.6.7.8", 70000),
                                   ("9.9.9.9", 9)])
         msg = ext.decode_pex(payload)
-        assert msg.added == (("9.9.9.9", 9),)
+        # invalid ports dropped; the v6 peer now rides added6 (BEP 11)
+        assert set(msg.added) == {("9.9.9.9", 9), ("::1", 6881)}
 
     def test_malformed_total(self):
         assert ext.decode_pex(b"junk") is None
@@ -153,3 +154,159 @@ class TestPexAddressHygiene:
         assert p.snubbed
         p.snubbed_until = _time.monotonic() - 1
         assert not p.snubbed
+
+
+class TestPexIpv6:
+    """BEP 11 added6/dropped6: v6 peers gossip alongside v4."""
+
+    def test_mixed_family_roundtrip(self):
+        from torrent_tpu.net.extension import decode_pex, encode_pex
+
+        added = [("10.0.0.1", 6881), ("2001:db8::7", 51413), ("10.0.0.2", 1)]
+        dropped = [("::1", 9000), ("192.168.0.9", 7000)]
+        msg = decode_pex(encode_pex(added, dropped))
+        assert set(msg.added) == set(added)
+        assert set(msg.dropped) == set(dropped)
+
+    def test_v6_only_payload(self):
+        from torrent_tpu.codec.bencode import bdecode
+        from torrent_tpu.net.extension import decode_pex, encode_pex
+
+        payload = encode_pex([("2001:db8::1", 6881)])
+        d = bdecode(payload)
+        assert d[b"added"] == b""  # v4 field empty
+        assert len(d[b"added6"]) == 18 and d[b"added6.f"] == b"\x00"
+        msg = decode_pex(payload)
+        assert msg.added == (("2001:db8::1", 6881),)
+
+    def test_malformed_v6_blob_truncates_cleanly(self):
+        from torrent_tpu.codec.bencode import bencode
+        from torrent_tpu.net.extension import decode_pex
+
+        # 20 bytes = one full 18-byte entry + 2 stray bytes (dropped)
+        blob = bencode({b"added": b"", b"added6": b"\x20" * 18 + b"xy"})
+        msg = decode_pex(blob)
+        assert len(msg.added) == 1
+
+    def test_v6_gossip_end_to_end(self, tmp_path):
+        """A v6-connected swarm member is gossiped via added6 and the
+        receiver dials it: full loopback over ::1."""
+        import asyncio
+        import hashlib
+        import os
+        import socket
+
+        import numpy as np
+        import pytest as _pytest
+
+        from tests.test_session import run
+        from torrent_tpu.codec.bencode import bencode
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        if not socket.has_ipv6:
+            _pytest.skip("no IPv6")
+
+        async def go():
+            plen = 32768
+            payload = np.random.default_rng(81).integers(
+                0, 256, 3 * plen, dtype=np.uint8
+            ).tobytes()
+            digs = [
+                hashlib.sha1(payload[i : i + plen]).digest()
+                for i in range(0, len(payload), plen)
+            ]
+            meta = bencode(
+                {
+                    b"announce": b"http://127.0.0.1:1/announce",  # dead
+                    b"info": {
+                        b"name": b"p6.bin",
+                        b"piece length": plen,
+                        b"pieces": b"".join(digs),
+                        b"length": len(payload),
+                    },
+                }
+            )
+            m = parse_metainfo(meta)
+            # A seeds over IPv6; B connects to A; C connects to A (v6).
+            # A's PEX gossip must teach B about C (added6) and vice versa.
+            try:
+                a = Client(ClientConfig(port=0, host="::1", enable_upnp=False))
+                await a.start()
+            except OSError:
+                _pytest.skip("IPv6 loopback unavailable")
+            b = Client(ClientConfig(port=0, host="::1", enable_upnp=False))
+            c = Client(ClientConfig(port=0, host="::1", enable_upnp=False))
+            await b.start()
+            await c.start()
+            # fast PEX cadence
+            for cl in (a, b, c):
+                cl.config.torrent.pex_interval = 0.3
+            # A is a PARTIAL seed (first 2 of 3 pieces): B and C can never
+            # complete, so they stay DOWNLOADING — a completed leech
+            # becomes a seed and refuses outbound dials, which would race
+            # the gossip round on this tiny payload
+            sd = str(tmp_path / "p6s")
+            os.makedirs(sd)
+            open(os.path.join(sd, "p6.bin"), "wb").write(payload[: 2 * plen])
+            try:
+                ta = await a.add(m, sd)
+                from torrent_tpu.net.types import AnnouncePeer
+
+                db, dc = str(tmp_path / "p6b"), str(tmp_path / "p6c")
+                os.makedirs(db)
+                os.makedirs(dc)
+                tb = await b.add(m, db)
+                tc = await c.add(m, dc)
+                tb._connect_new_peers([AnnouncePeer(ip="::1", port=a.port)])
+                tc._connect_new_peers([AnnouncePeer(ip="::1", port=a.port)])
+                # B and C discover each other ONLY via A's v6 PEX gossip
+                for _ in range(400):
+                    if len(tb.peers) >= 2 and len(tc.peers) >= 2:
+                        break
+                    await asyncio.sleep(0.05)
+                assert len(tb.peers) >= 2, "added6 gossip never connected B-C"
+                assert len(tc.peers) >= 2
+                # and the gossiped link carries data: both got A's pieces
+                for _ in range(400):
+                    if tb.bitfield.count() == 2 and tc.bitfield.count() == 2:
+                        break
+                    await asyncio.sleep(0.05)
+                assert tb.bitfield.count() == 2 and tc.bitfield.count() == 2
+            finally:
+                await a.close()
+                await b.close()
+                await c.close()
+
+        run(go(), timeout=60)
+
+    def test_port0_v6_padding_dropped(self):
+        """Hostile added6 padding with port-0 entries must be discarded —
+        the shared v6 decoder mirrors the v4 anti-padding rule (each junk
+        entry would otherwise burn a dial slot and a 10 s timeout)."""
+        import socket
+
+        from torrent_tpu.codec.bencode import bencode
+        from torrent_tpu.net.extension import decode_pex
+
+        good = socket.inet_pton(socket.AF_INET6, "2001:db8::1") + (6881).to_bytes(2, "big")
+        pad = socket.inet_pton(socket.AF_INET6, "2001:db8::2") + b"\x00\x00"
+        msg = decode_pex(bencode({b"added": b"", b"added6": pad * 5 + good}))
+        assert msg.added == (("2001:db8::1", 6881),)
+
+    def test_v4_mapped_peer_gossips_as_v4(self):
+        """A dual-stack listener reports v4 peers as ::ffff:a.b.c.d —
+        they must ride the v4 added field, not added6 (BEP 11)."""
+        from torrent_tpu.net.types import normalize_peer_host
+
+        assert normalize_peer_host("::ffff:93.184.216.34") == "93.184.216.34"
+        assert normalize_peer_host("2001:db8::1") == "2001:db8::1"
+        assert normalize_peer_host("10.0.0.1") == "10.0.0.1"
+        assert normalize_peer_host("not-an-ip") == "not-an-ip"
+        from torrent_tpu.codec.bencode import bdecode
+        from torrent_tpu.net.extension import encode_pex
+
+        d = bdecode(encode_pex([(normalize_peer_host("::ffff:9.9.9.9"), 6881)]))
+        assert len(d[b"added"]) == 6 and not d.get(b"added6")

@@ -26,7 +26,11 @@ import math
 from dataclasses import dataclass
 
 from torrent_tpu.codec.bencode import BencodeError, bdecode, bdecode_prefix, bencode
-from torrent_tpu.net.types import unpack_compact_v4 as _unpack_compact_v4
+from torrent_tpu.net.types import (
+    pack_compact_v6 as _pack_compact_v6,
+    unpack_compact_v4 as _unpack_compact_v4,
+    unpack_compact_v6 as _unpack_compact_v6,
+)
 
 # BEP 9: metadata is exchanged in 16 KiB pieces.
 METADATA_PIECE_SIZE = 16 * 1024
@@ -256,24 +260,30 @@ def _pack_compact_v4(addrs) -> bytes:
         try:
             octets = bytes(int(x) for x in ip.split("."))
         except ValueError:
-            continue  # BEP 11's base message is IPv4; v6 needs added6
+            continue  # not dotted-quad: belongs in added6, not here
         if len(octets) == 4 and 0 < port < 65536:
             out += octets + port.to_bytes(2, "big")
     return bytes(out)
 
 
-
-
 def encode_pex(added, dropped=()) -> bytes:
-    """BEP 11 ut_pex payload: compact added/dropped v4 peer deltas."""
+    """BEP 11 ut_pex payload: compact added/dropped peer deltas, v4 in
+    ``added``/``dropped`` and v6 in ``added6``/``dropped6`` (each packer
+    skips the other family, so callers pass mixed sets)."""
     packed_added = _pack_compact_v4(added)
-    return bencode(
-        {
-            b"added": packed_added,
-            b"added.f": bytes(len(packed_added) // 6),  # no flags
-            b"dropped": _pack_compact_v4(dropped),
-        }
-    )
+    packed_added6 = _pack_compact_v6(added)
+    d = {
+        b"added": packed_added,
+        b"added.f": bytes(len(packed_added) // 6),  # no flags
+        b"dropped": _pack_compact_v4(dropped),
+    }
+    if packed_added6:
+        d[b"added6"] = packed_added6
+        d[b"added6.f"] = bytes(len(packed_added6) // 18)
+    dropped6 = _pack_compact_v6(dropped)
+    if dropped6:
+        d[b"dropped6"] = dropped6
+    return bencode(d)
 
 
 @dataclass(frozen=True)
@@ -283,7 +293,8 @@ class PexMessage:
 
 
 def decode_pex(payload: bytes) -> PexMessage | None:
-    """Parse a ut_pex payload; None if malformed (total, never raises)."""
+    """Parse a ut_pex payload (v4 + v6 fields); None if malformed
+    (total, never raises)."""
     try:
         d = bdecode(payload)
     except BencodeError:
@@ -292,11 +303,14 @@ def decode_pex(payload: bytes) -> PexMessage | None:
         return None
     added = d.get(b"added", b"")
     dropped = d.get(b"dropped", b"")
-    if not isinstance(added, bytes) or not isinstance(dropped, bytes):
+    added6 = d.get(b"added6", b"")
+    dropped6 = d.get(b"dropped6", b"")
+    if not all(isinstance(x, bytes) for x in (added, dropped, added6, dropped6)):
         return None
     return PexMessage(
-        added=tuple(_unpack_compact_v4(added)),
-        dropped=tuple(_unpack_compact_v4(dropped)),
+        added=tuple(_unpack_compact_v4(added)) + tuple(_unpack_compact_v6(added6)),
+        dropped=tuple(_unpack_compact_v4(dropped))
+        + tuple(_unpack_compact_v6(dropped6)),
     )
 
 

@@ -205,16 +205,14 @@ def _parse_compact_peers(blob: bytes) -> list[AnnouncePeer]:
 
 def _parse_compact_peers6(blob: bytes) -> list[AnnouncePeer]:
     """18-byte ip6+port entries (BEP 7 ``peers6`` — beyond the reference,
-    which is IPv4-only)."""
-    import socket
+    which is IPv4-only). Framing stays strict (a misaligned blob is a
+    broken tracker); entries decode through the shared v6 codec, which
+    drops undialable port-0 padding."""
+    from torrent_tpu.net.types import unpack_compact_v6
 
     if len(blob) % 18 != 0:
         raise TrackerError("compact peers6 blob not a multiple of 18")
-    peers = []
-    for i in range(0, len(blob), 18):
-        ip = socket.inet_ntop(socket.AF_INET6, blob[i : i + 16])
-        peers.append(AnnouncePeer(ip=ip, port=read_int(blob, 2, i + 16)))
-    return peers
+    return [AnnouncePeer(ip=ip, port=port) for ip, port in unpack_compact_v6(blob)]
 
 
 _FULL_PEER_SHAPE = valid.obj(

@@ -23,6 +23,52 @@ def unpack_compact_v4(blob: bytes) -> list[tuple[str, int]]:
     return out
 
 
+def unpack_compact_v6(blob: bytes) -> list[tuple[str, int]]:
+    """Decode 18-byte compact IPv6 (ip, port) entries (BEP 7 layout).
+
+    The shared v6 sibling of :func:`unpack_compact_v4` — same contract:
+    port-0 entries dropped (undialable padding), junk tail ignored."""
+    import socket
+
+    out = []
+    for i in range(0, len(blob) - len(blob) % 18, 18):
+        port = int.from_bytes(blob[i + 16 : i + 18], "big")
+        if port == 0:
+            continue
+        out.append((socket.inet_ntop(socket.AF_INET6, blob[i : i + 16]), port))
+    return out
+
+
+def pack_compact_v6(addrs) -> bytes:
+    """Encode (ip, port) pairs as 18-byte compact IPv6 entries; non-v6
+    addresses and invalid ports are skipped (callers pass mixed sets)."""
+    import socket
+
+    out = bytearray()
+    for ip, port in addrs:
+        if ":" not in ip or not 0 < port < 65536:
+            continue
+        try:
+            out += socket.inet_pton(socket.AF_INET6, ip) + port.to_bytes(2, "big")
+        except OSError:
+            continue
+    return bytes(out)
+
+
+def normalize_peer_host(host: str) -> str:
+    """Collapse IPv4-mapped IPv6 text (``::ffff:a.b.c.d`` from dual-stack
+    listeners) to the plain dotted quad, so family-specific consumers
+    (compact packers, PEX field routing) classify the peer correctly."""
+    import ipaddress
+
+    try:
+        addr = ipaddress.ip_address(host)
+    except ValueError:
+        return host
+    mapped = getattr(addr, "ipv4_mapped", None)
+    return str(mapped) if mapped is not None else host
+
+
 class AnnounceEvent(str, enum.Enum):
     """Announce event (types.ts:3-15)."""
 

@@ -104,18 +104,10 @@ def _pack_peers_compact(peers) -> bytes:
 
 
 def _pack_peers_compact6(peers) -> bytes:
-    """BEP 7 ``peers6``: 16-byte address + 2-byte port per IPv6 peer."""
-    import socket
+    """BEP 7 ``peers6`` via the shared compact-v6 codec (net/types.py)."""
+    from torrent_tpu.net.types import pack_compact_v6
 
-    out = bytearray()
-    for p in peers:
-        if ":" not in p.ip:
-            continue
-        try:
-            out += socket.inet_pton(socket.AF_INET6, p.ip) + write_int(p.port, 2)
-        except OSError:
-            continue
-    return bytes(out)
+    return pack_compact_v6((p.ip, p.port) for p in peers)
 
 
 @dataclass

@@ -2094,14 +2094,21 @@ class Torrent:
 
         Outbound connections dialed the peer's listen port; inbound ones
         carry an ephemeral source port, so they're only gossipable when
-        the peer advertised its real port via BEP 10's ``p`` key.
+        the peer advertised its real port via BEP 10's ``p`` key. Both
+        families gossip — encode_pex routes v4 to added/dropped and v6
+        to added6/dropped6 (BEP 11).
         """
-        if p.address is None or ":" in p.address[0]:  # base PEX is v4
+        if p.address is None:
             return None
+        # dual-stack listeners report v4 peers as ::ffff:a.b.c.d —
+        # collapse so the compact packers route them to the v4 field
+        from torrent_tpu.net.types import normalize_peer_host
+
+        host = normalize_peer_host(p.address[0])
         if not p.inbound:
-            return p.address
+            return (host, p.address[1])
         if p.ext.listen_port:
-            return (p.address[0], p.ext.listen_port)
+            return (host, p.ext.listen_port)
         return None
 
     async def _pex_round(self) -> None:

@@ -426,3 +426,69 @@ class TestV2Lifecycle:
                 server.close()
 
         run(go(), timeout=60)
+
+    def test_add_hybrid_one_call(self, tmp_path):
+        """Client.add_hybrid registers both identities in one call."""
+        import numpy as np
+
+        from torrent_tpu.models.v2 import build_hybrid
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            fa = np.random.default_rng(93).integers(
+                0, 256, 2 * PLEN + 50, dtype=np.uint8
+            ).tobytes()
+            blob, _ = build_hybrid(
+                [(("h.bin",), fa)], name="hx", piece_length=PLEN, hasher="cpu",
+                announce="http://127.0.0.1:1/announce",
+            )
+            sd = str(tmp_path / "hx")
+            os.makedirs(os.path.join(sd, "hx"))
+            open(os.path.join(sd, "hx", "h.bin"), "wb").write(fa)
+            c = Client(ClientConfig(port=0, enable_upnp=False))
+            await c.start()
+            try:
+                t1, t2 = await c.add_hybrid(blob, sd)
+                assert t1.bitfield.complete and t2.bitfield.complete
+                assert t1.metainfo.info_hash != t2.metainfo.info_hash
+                assert len(c.torrents) == 2
+                with pytest.raises(ValueError, match="hybrid"):
+                    await c.add_hybrid(b"junk", sd)
+            finally:
+                await c.close()
+
+        run(go(), timeout=60)
+
+    def test_add_hybrid_all_or_nothing(self, tmp_path):
+        """If the v2 registration fails, the v1 identity is rolled back."""
+        import numpy as np
+
+        from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+        from torrent_tpu.models.v2 import build_hybrid
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            fa = np.random.default_rng(94).integers(
+                0, 256, PLEN + 10, dtype=np.uint8
+            ).tobytes()
+            blob, _ = build_hybrid(
+                [(("h.bin",), fa)], name="hr", piece_length=PLEN, hasher="cpu",
+                announce="http://127.0.0.1:1/announce",
+            )
+            sd = str(tmp_path / "hr")
+            os.makedirs(os.path.join(sd, "hr"))
+            open(os.path.join(sd, "hr", "h.bin"), "wb").write(fa)
+            c = Client(ClientConfig(port=0, enable_upnp=False))
+            await c.start()
+            try:
+                # pre-register the v2 identity: the hybrid's second add
+                # collides, and the first (v1) must be rolled back
+                await c.add(parse_metainfo_v2(blob), sd)
+                assert len(c.torrents) == 1
+                with pytest.raises(ValueError, match="already added"):
+                    await c.add_hybrid(blob, sd)
+                assert len(c.torrents) == 1  # no half-registered leftover
+            finally:
+                await c.close()
+
+        run(go(), timeout=60)

@@ -27,6 +27,7 @@ from dataclasses import dataclass
 
 from torrent_tpu.codec.bencode import BencodeError, bdecode, bdecode_prefix, bencode
 from torrent_tpu.net.types import (
+    pack_compact_v4 as _pack_compact_v4,
     pack_compact_v6 as _pack_compact_v6,
     unpack_compact_v4 as _unpack_compact_v4,
     unpack_compact_v6 as _unpack_compact_v6,
@@ -252,18 +253,6 @@ class MetadataAssembler:
 
 
 # -------------------------------------------------------------- ut_pex
-
-
-def _pack_compact_v4(addrs) -> bytes:
-    out = bytearray()
-    for ip, port in addrs:
-        try:
-            octets = bytes(int(x) for x in ip.split("."))
-        except ValueError:
-            continue  # not dotted-quad: belongs in added6, not here
-        if len(octets) == 4 and 0 < port < 65536:
-            out += octets + port.to_bytes(2, "big")
-    return bytes(out)
 
 
 def encode_pex(added, dropped=()) -> bytes:

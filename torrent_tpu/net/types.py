@@ -23,11 +23,29 @@ def unpack_compact_v4(blob: bytes) -> list[tuple[str, int]]:
     return out
 
 
+def pack_compact_v4(addrs) -> bytes:
+    """Encode (ip, port) pairs as 6-byte compact IPv4 entries; non-v4
+    addresses (after v4-mapped normalization) and invalid ports are
+    skipped — the shared packer for PEX, tracker responses, and anything
+    else that emits compact-v4."""
+    out = bytearray()
+    for ip, port in addrs:
+        try:
+            octets = bytes(int(x) for x in normalize_peer_host(ip).split("."))
+        except ValueError:
+            continue
+        if len(octets) == 4 and 0 < port < 65536:
+            out += octets + port.to_bytes(2, "big")
+    return bytes(out)
+
+
 def unpack_compact_v6(blob: bytes) -> list[tuple[str, int]]:
     """Decode 18-byte compact IPv6 (ip, port) entries (BEP 7 layout).
 
     The shared v6 sibling of :func:`unpack_compact_v4` — same contract:
-    port-0 entries dropped (undialable padding), junk tail ignored."""
+    port-0 entries dropped (undialable padding), junk tail ignored.
+    v4-mapped entries normalize to dotted quad so dial dedup and family
+    routing see one canonical form everywhere."""
     import socket
 
     out = []
@@ -35,17 +53,20 @@ def unpack_compact_v6(blob: bytes) -> list[tuple[str, int]]:
         port = int.from_bytes(blob[i + 16 : i + 18], "big")
         if port == 0:
             continue
-        out.append((socket.inet_ntop(socket.AF_INET6, blob[i : i + 16]), port))
+        ip = socket.inet_ntop(socket.AF_INET6, blob[i : i + 16])
+        out.append((normalize_peer_host(ip), port))
     return out
 
 
 def pack_compact_v6(addrs) -> bytes:
     """Encode (ip, port) pairs as 18-byte compact IPv6 entries; non-v6
-    addresses and invalid ports are skipped (callers pass mixed sets)."""
+    addresses (v4-mapped ones normalize OUT to the v4 family) and
+    invalid ports are skipped (callers pass mixed sets)."""
     import socket
 
     out = bytearray()
     for ip, port in addrs:
+        ip = normalize_peer_host(ip)
         if ":" not in ip or not 0 < port < 65536:
             continue
         try:

@@ -91,16 +91,12 @@ class ScrapeRequest:
 
 
 def _pack_peers_compact(peers) -> bytes:
-    out = bytearray()
-    for p in peers:
-        try:
-            octets = bytes(int(x) for x in p.ip.split("."))
-        except ValueError:
-            continue  # IPv6 peers ride the peers6 key (BEP 7) instead
-        if len(octets) != 4:
-            continue
-        out += octets + write_int(p.port, 2)
-    return bytes(out)
+    """BEP 23 compact peers via the shared v4 packer: IPv6 peers ride
+    peers6 instead, port-0 (firewalled) announces are never packed (every
+    receiver's decoder drops them anyway), v4-mapped text normalizes."""
+    from torrent_tpu.net.types import pack_compact_v4
+
+    return pack_compact_v4((p.ip, p.port) for p in peers)
 
 
 def _pack_peers_compact6(peers) -> bytes:

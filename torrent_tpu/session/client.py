@@ -252,6 +252,35 @@ class Client:
             self.lsd.register(metainfo.info_hash)  # BEP 27: never private
         return torrent
 
+    async def add_hybrid(
+        self, torrent_bytes: bytes, storage_dir: str
+    ) -> "tuple[Torrent, Torrent]":
+        """Register a BEP 52 hybrid torrent under BOTH its identities —
+        the SHA-1 infohash (v1 swarm) and the truncated SHA-256 (v2
+        swarm) — seeding/downloading the same directory. Returns
+        ``(v1_torrent, v2_torrent)``.
+
+        The v2 view's piece space is file-aligned while v1's is packed,
+        but hybrids carry BEP 47 pad files that make the two byte layouts
+        coincide on disk, so one directory serves both swarms.
+        """
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+
+        m1 = parse_metainfo(torrent_bytes)
+        m2 = parse_metainfo_v2(torrent_bytes)
+        if m1 is None or m2 is None:
+            raise ValueError("not a valid hybrid .torrent (needs both planes)")
+        t1 = await self.add(m1, storage_dir)
+        try:
+            t2 = await self.add(m2, storage_dir)
+        except BaseException:
+            # all-or-nothing: a half-registered hybrid would leave the v1
+            # identity silently announcing with no handle for the caller
+            await self.remove(m1.info_hash)
+            raise
+        return t1, t2
+
     async def add_magnet(
         self, magnet, storage: Storage | StorageMethod | str
     ) -> Torrent:

@@ -197,3 +197,52 @@ class TestInMemoryTrackerUnit:
         assert t.sweep() == 1
         assert info.complete == 0 and info.incomplete == 1
         assert b"s" * 20 not in info.peers
+
+
+class TestIpv6Announces:
+    def test_v6_announcer_returned_via_peers6(self):
+        """A tracker on ::1 records v6 announcers and hands them to the
+        next announcer in the BEP 7 peers6 field (full client+server
+        e2e over real v6 sockets)."""
+        import socket
+
+        import pytest as _pytest
+
+        from torrent_tpu.net.tracker import announce
+        from torrent_tpu.net.types import AnnounceEvent, AnnounceInfo
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+
+        if not socket.has_ipv6:
+            _pytest.skip("no IPv6")
+
+        async def go():
+            try:
+                server, pump = await run_tracker(
+                    ServeOptions(http_port=0, udp_port=None, host="::1", interval=1)
+                )
+            except OSError:
+                _pytest.skip("IPv6 loopback unavailable")
+            url = f"http://[::1]:{server.http_port}/announce"
+            ih = b"\x55" * 20
+            try:
+                await announce(
+                    url,
+                    AnnounceInfo(
+                        info_hash=ih, peer_id=b"-AA0001-000000000001",
+                        port=7001, left=0, event=AnnounceEvent.STARTED,
+                    ),
+                )
+                res = await announce(
+                    url,
+                    AnnounceInfo(
+                        info_hash=ih, peer_id=b"-BB0001-000000000002",
+                        port=7002, left=100, event=AnnounceEvent.STARTED,
+                    ),
+                )
+                assert ("::1", 7001) in [(p.ip, p.port) for p in res.peers]
+            finally:
+                server.close()
+                await asyncio.wait_for(pump, 5)
+
+        run(go())

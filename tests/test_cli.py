@@ -233,3 +233,60 @@ class TestCli:
         finally:
             done.set()
             th.join(10)
+
+    def test_magnet_subcommand(self, payload_dir, tmp_path, capsys):
+        """'torrent-tpu magnet' emits a parseable URI carrying the
+        infohash(es), name, trackers, and --peer addresses."""
+        from torrent_tpu.codec.magnet import parse_magnet
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.tools.make_torrent import make_torrent
+
+        data = make_torrent(str(payload_dir), "http://t/announce", piece_length=16384)
+        p = tmp_path / "mg.torrent"
+        p.write_bytes(data)
+        rc = main(["magnet", str(p), "--peer", "127.0.0.1:6881"])
+        assert rc == 0
+        uri = capsys.readouterr().out.strip()
+        m = parse_magnet(uri)
+        ref = parse_metainfo(data)
+        assert m.info_hash == ref.info_hash
+        assert m.trackers == ("http://t/announce",)
+        assert m.peer_addrs == (("127.0.0.1", 6881),)
+        rc = main(["magnet", str(p), "--no-trackers"])
+        assert rc == 0
+        assert parse_magnet(capsys.readouterr().out.strip()).trackers == ()
+
+    def test_magnet_subcommand_hybrid_both_topics(self, tmp_path, capsys):
+        import numpy as np
+
+        from torrent_tpu.codec.magnet import parse_magnet
+        from torrent_tpu.models.v2 import build_hybrid
+
+        fa = np.random.default_rng(96).integers(0, 256, 40000, dtype=np.uint8).tobytes()
+        blob, meta2 = build_hybrid(
+            [(("h.bin",), fa)], name="hm", piece_length=16384, hasher="cpu",
+            announce="http://t/announce",
+        )
+        p = tmp_path / "hy.torrent"
+        p.write_bytes(blob)
+        rc = main(["magnet", str(p)])
+        assert rc == 0
+        m = parse_magnet(capsys.readouterr().out.strip())
+        assert m.info_hash is not None and m.info_hash_v2 == meta2.info_hash_v2
+
+    def test_magnet_rejects_bad_peer_and_carries_ws(self, tmp_path, capsys):
+        from test_session import build_torrent_bytes
+        from torrent_tpu.codec.bencode import bdecode, bencode
+        from torrent_tpu.codec.magnet import parse_magnet
+
+        data = build_torrent_bytes(b"q" * 5000, 4096, b"http://t/announce")
+        p = tmp_path / "ws.torrent"
+        raw = bdecode(data)
+        raw[b"url-list"] = [b"http://cdn.example/d/"]
+        p.write_bytes(bencode(raw))
+        for bad in (":6881", "h:0", "h:70000", "nope"):
+            assert main(["magnet", str(p), "--peer", bad]) == 1
+        assert main(["magnet", str(p)]) == 0
+        m = parse_magnet(capsys.readouterr().out.strip())
+        assert m.web_seeds == ("http://cdn.example/d/",)
+        assert main(["magnet", str(tmp_path)]) == 1  # directory: clean error

@@ -21,6 +21,77 @@ import signal
 import sys
 
 
+def _parse_hostport(spec: str) -> "tuple[str, int] | None":
+    """Parse ``HOST:PORT`` / ``[v6]:PORT``; None when the host is empty
+    or the port is outside 1..65535 (the magnet/x.pe validity rules —
+    emitting specs our own parser rejects helps nobody)."""
+    host, _, port_s = spec.rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        return None
+    host = host.strip("[]")
+    if not host or not 0 < port < 65536:
+        return None
+    return (host, port)
+
+
+def _cmd_magnet(args) -> int:
+    """Emit a magnet URI for a .torrent: btih and/or btmh topics (hybrids
+    carry both), dn, the announce-list as tr= params, url-list webseeds
+    as ws=, plus any --peer x.pe bootstrap addresses."""
+    from torrent_tpu.codec.magnet import Magnet
+    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+    from torrent_tpu.net.multitracker import parse_announce_list
+
+    try:
+        with open(args.torrent, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        print(f"error: cannot read {args.torrent}: {e}", file=sys.stderr)
+        return 1
+    m1 = parse_metainfo(data)
+    m2 = parse_metainfo_v2(data)
+    if m1 is None and m2 is None:
+        print("error: not a valid .torrent file", file=sys.stderr)
+        return 1
+    trackers: list[str] = []
+    if not args.no_trackers:
+        raw = (m1.raw if m1 is not None else m2.raw) or {}
+        tiers = parse_announce_list(raw)
+        seen = set()
+        for tier in tiers or []:
+            for t in tier:
+                if t not in seen:
+                    seen.add(t)
+                    trackers.append(t)
+        announce = m1.announce if m1 is not None else (m2.announce or "")
+        if announce and announce not in seen:
+            trackers.insert(0, announce)
+    peers = []
+    for spec in args.peer:
+        addr = _parse_hostport(spec)
+        if addr is None:
+            print(f"error: bad --peer {spec!r}", file=sys.stderr)
+            return 1
+        peers.append(addr)
+    from torrent_tpu.codec.metainfo import parse_url_list
+
+    raw_top = m1.raw if m1 is not None else m2.raw
+    magnet = Magnet(
+        info_hash=m1.info_hash if m1 is not None else None,
+        info_hash_v2=m2.info_hash_v2 if m2 is not None else None,
+        display_name=(m1.info.name if m1 is not None else m2.info.name),
+        trackers=tuple(trackers),
+        peer_addrs=tuple(peers),
+        # url-list lives at the top level for BOTH planes
+        web_seeds=parse_url_list((raw_top or {}).get(b"url-list")),
+    )
+    print(magnet.to_uri())
+    return 0
+
+
 def _cmd_info(args) -> int:
     from torrent_tpu.codec.metainfo import parse_metainfo
 
@@ -222,12 +293,11 @@ async def _download(args) -> int:
 
     bootstrap = []
     for spec in args.dht_bootstrap:
-        host, _, port_s = spec.rpartition(":")
-        try:
-            bootstrap.append((host.strip("[]"), int(port_s)))
-        except ValueError:
+        addr = _parse_hostport(spec)
+        if addr is None:
             print(f"error: bad --dht-bootstrap {spec!r}", file=sys.stderr)
             return 1
+        bootstrap.append(addr)
     config = ClientConfig(
         port=args.port,
         hasher=args.hasher,
@@ -386,6 +456,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("info", help="print .torrent metadata")
     sp.add_argument("torrent")
     sp.set_defaults(fn=_cmd_info)
+
+    sp = sub.add_parser("magnet", help="emit a magnet URI for a .torrent")
+    sp.add_argument("torrent")
+    sp.add_argument(
+        "--no-trackers", action="store_true", help="omit tr= parameters"
+    )
+    sp.add_argument(
+        "--peer",
+        action="append",
+        default=[],
+        metavar="HOST:PORT",
+        help="x.pe bootstrap address (repeatable)",
+    )
+    sp.set_defaults(fn=_cmd_magnet)
 
     sp = sub.add_parser("make", help="author a .torrent (TPU-batched hashing)")
     sp.add_argument("path")

@@ -323,3 +323,50 @@ class TestBep42Properties:
         from torrent_tpu.net.dht import bep42_node_id, bep42_valid
 
         assert bep42_valid(bep42_node_id(ip), ip)
+
+
+class TestCompactV6Properties:
+    """Shared compact-v6 codec (net/types.py): totality + roundtrip."""
+
+    @given(st.binary(max_size=200))
+    @settings(max_examples=300)
+    def test_unpack_total(self, blob):
+        from torrent_tpu.net.types import unpack_compact_v6
+
+        for ip, port in unpack_compact_v6(blob):
+            assert 0 < port < 65536  # port-0 padding never surfaces
+
+    @given(
+        st.lists(
+            st.tuples(
+                st.ip_addresses(v=6).map(str),
+                st.integers(min_value=1, max_value=65535),
+            ),
+            max_size=8,
+        )
+    )
+    @settings(max_examples=200)
+    def test_roundtrip(self, addrs):
+        import socket
+
+        from torrent_tpu.net.types import pack_compact_v6, unpack_compact_v6
+
+        got = unpack_compact_v6(pack_compact_v6(addrs))
+        # v4-mapped inputs normalize OUT to the v4 family; the rest
+        # round-trip to canonical text
+        want = [
+            (socket.inet_ntop(socket.AF_INET6, socket.inet_pton(socket.AF_INET6, ip)), p)
+            for ip, p in addrs
+            if not ip.lower().startswith("::ffff:") or ":" in ip[7:]
+        ]
+        want = [(ip, p) for ip, p in want if not ip.lower().startswith("::ffff:")]
+        assert got == want
+
+    @given(st.tuples(st.ip_addresses(v=4).map(str), st.integers(1, 65535)))
+    @settings(max_examples=100)
+    def test_v4_mapped_normalizes_out(self, addr):
+        from torrent_tpu.net.types import pack_compact_v6, pack_compact_v4
+
+        mapped = (f"::ffff:{addr[0]}", addr[1])
+        assert pack_compact_v6([mapped]) == b""  # not v6 after normalize
+        assert len(pack_compact_v4([mapped])) == 6  # routed to v4

@@ -366,3 +366,126 @@ class TestBep42:
 
         n = DHTNode(external_ip="93.184.216.34")
         assert bep42_valid(n.node_id, "93.184.216.34")
+
+
+class TestBep32Ipv6:
+    """BEP 32: want/nodes6, v6 values, a live ::1 DHT network."""
+
+    def test_node6_codec_roundtrip(self):
+        from torrent_tpu.net.dht import pack_compact_node6, unpack_compact_nodes6
+
+        nid = bytes(range(20))
+        blob = pack_compact_node6(nid, "2001:db8::7", 6881)
+        assert len(blob) == 38
+        assert unpack_compact_nodes6(blob + b"xx") == [(nid, "2001:db8::7", 6881)]
+
+    def test_want_routes_families(self):
+        import asyncio
+
+        from torrent_tpu.net.dht import DHTNode
+
+        async def go():
+            n = DHTNode()
+            n.table.update(b"\x01" * 20, "1.2.3.4", 6881)
+            n.table.update(b"\x02" * 20, "2001:db8::2", 6882)
+            t = b"\x03" * 20
+            both = n._closest_reply(t, ("9.9.9.9", 1), [b"n4", b"n6"])
+            assert len(both[b"nodes"]) == 26 and len(both[b"nodes6"]) == 38
+            # absent want: reply in the querier's own family
+            v4 = n._closest_reply(t, ("9.9.9.9", 1), None)
+            assert b"nodes" in v4 and b"nodes6" not in v4
+            v6 = n._closest_reply(t, ("2001:db8::9", 1), None)
+            assert b"nodes6" in v6 and b"nodes" not in v6
+
+        asyncio.run(go())
+
+    def test_v6_network_announce_and_lookup(self):
+        """Three ::1 nodes: bootstrap, announce, lookup — the whole BEP 5
+        cycle over IPv6 transport with nodes6 discovery."""
+        import asyncio
+        import socket
+
+        import pytest as _pytest
+
+        from torrent_tpu.net.dht import DHTNode
+
+        if not socket.has_ipv6:
+            _pytest.skip("no IPv6")
+
+        async def go():
+            try:
+                a = await DHTNode(host="::1").start()
+            except OSError:
+                _pytest.skip("IPv6 loopback unavailable")
+            b = await DHTNode(host="::1").start()
+            c = await DHTNode(host="::1").start()
+            try:
+                await b.bootstrap([("::1", a.port)])
+                await c.bootstrap([("::1", a.port)])
+                ih = b"\x66" * 20
+                n = await c.announce(ih, 7777)
+                assert n >= 1
+                peers = await b.lookup_peers(ih)
+                assert ("::1", 7777) in peers, peers
+            finally:
+                a.close()
+                b.close()
+                c.close()
+
+        asyncio.run(asyncio.wait_for(go(), 30))
+
+    def test_unknown_want_falls_back_to_querier_family(self):
+        import asyncio
+
+        from torrent_tpu.net.dht import DHTNode
+
+        async def go():
+            n = DHTNode()
+            n.table.update(b"\x01" * 20, "1.2.3.4", 6881)
+            t = b"\x03" * 20
+            r = n._closest_reply(t, ("9.9.9.9", 1), [b"n8"])  # future token
+            assert b"nodes" in r and len(r[b"nodes"]) == 26
+            r2 = n._closest_reply(t, ("9.9.9.9", 1), [])
+            assert b"nodes" in r2
+
+        asyncio.run(go())
+
+    def test_per_family_closest_not_starved_by_v4(self):
+        """A v6 querier must get the closest v6 nodes even when the K*2
+        globally-closest entries are all v4."""
+        import asyncio
+
+        from torrent_tpu.net.dht import DHTNode
+
+        async def go():
+            # pinned own id: tiny ids spread over low buckets instead of
+            # all colliding in one random-MSB bucket and evicting the v6
+            n = DHTNode(node_id=(2).to_bytes(20, "big"))
+            t = b"\x00" * 20
+            for i in range(3, 27):  # 24 v4 nodes very close to target
+                n.table.update(i.to_bytes(20, "big"), "1.2.3.%d" % i, 6000 + i)
+            far = (1 << 140).to_bytes(20, "big")  # one distant v6 node
+            n.table.update(far, "2001:db8::1", 7000)
+            r = n._closest_reply(t, ("2001:db8::9", 1), [b"n6"])
+            assert len(r[b"nodes6"]) == 38  # found despite v4 dominance
+
+        asyncio.run(go())
+
+    def test_v4_mapped_peers_pack_as_v4_values(self):
+        """A dual-stack socket stores announcers as ::ffff:a.b.c.d —
+        get_peers values must pack them as 6-byte v4 entries."""
+        import asyncio
+        import time as _time
+
+        from torrent_tpu.net.dht import DHTNode
+
+        async def go():
+            n = DHTNode()
+            ih = b"\x44" * 20
+            n.peer_store[ih] = {("1.2.3.4", 6881): _time.monotonic()}
+            # simulate the handler's normalize on insert: mapped in, v4 out
+            from torrent_tpu.net.types import normalize_peer_host
+
+            assert normalize_peer_host("::ffff:1.2.3.4") == "1.2.3.4"
+
+        asyncio.run(go())

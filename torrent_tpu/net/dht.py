@@ -133,6 +133,36 @@ def unpack_compact_nodes(blob: bytes) -> list[tuple[bytes, str, int]]:
     return out
 
 
+def pack_compact_node6(node_id: bytes, ip: str, port: int) -> bytes:
+    """38-byte BEP 32 node entry, or b"" when the address doesn't pack
+    (scoped link-local, v4-mapped) — a truncated frame would misalign
+    every later entry in the concatenated nodes6 blob."""
+    from torrent_tpu.net.types import pack_compact_v6
+
+    packed = pack_compact_v6([(ip, port)])
+    return node_id + packed if len(packed) == 18 else b""
+
+
+def unpack_compact_nodes6(blob: bytes) -> list[tuple[bytes, str, int]]:
+    from torrent_tpu.net.types import unpack_compact_v6
+
+    out = []
+    for i in range(0, len(blob) - len(blob) % 38, 38):
+        nid = blob[i : i + 20]
+        addr = unpack_compact_v6(blob[i + 20 : i + 38])
+        if addr:
+            out.append((nid, addr[0][0], addr[0][1]))
+    return out
+
+
+def _is_v6(ip: str) -> bool:
+    """Family AFTER v4-mapped normalization: a dual-stack socket reports
+    v4 peers as ::ffff:a.b.c.d, which belong to the v4 family."""
+    from torrent_tpu.net.types import normalize_peer_host
+
+    return ":" in normalize_peer_host(ip)
+
+
 # ------------------------------------------------------------ routing table
 
 
@@ -269,6 +299,16 @@ class DHTNode:
         self.enforce_bep42 = enforce_bep42
         self.host = host
         self.port = port
+        # BEP 32 families THIS socket can reach: requesting (and merging)
+        # candidates of an unreachable family would fill lookup frontiers
+        # with addresses whose sendto fails, burning a full RPC timeout
+        # per candidate. "::"/"" binds dual-stack on this platform.
+        if host in ("::", ""):
+            self._want = [b"n4", b"n6"]
+        elif _is_v6(host):
+            self._want = [b"n6"]
+        else:
+            self._want = [b"n4"]
         self.table = RoutingTable(self.node_id)
         self.tokens = TokenJar()
         # info_hash -> {(ip, port): stored_at}
@@ -307,6 +347,9 @@ class DHTNode:
         nodes whose ids don't derive from their IP stay OUT of the table
         (they can still answer the query that surfaced them — BEP 42
         constrains routing state, not peer traffic)."""
+        from torrent_tpu.net.types import normalize_peer_host
+
+        ip = normalize_peer_host(ip)  # canonical family for compact packing
         if self.enforce_bep42 and not bep42_valid(node_id, ip):
             log.debug("dht: rejecting non-BEP42 node %s at %s", node_id.hex()[:8], ip)
             return
@@ -408,6 +451,35 @@ class DHTNode:
             log.debug("dht query error from %s: %s", addr, e)
             self._error(addr, tid, 203, "protocol error")
 
+    def _closest_reply(self, target: bytes, addr, want) -> dict:
+        """BEP 32 ``nodes``/``nodes6`` for the closest table entries.
+
+        ``want`` is the querier's requested families ([b"n4"], [b"n6"],
+        or both); absent — or containing no token we recognize — BEP 32
+        says reply in the querier's own family. Each family selects its
+        own closest K (filtering one shared pre-truncated list could
+        return an empty nodes6 while reachable v6 entries exist in
+        farther buckets).
+        """
+        fams = set()
+        if isinstance(want, list):
+            fams = {w for w in want if w in (b"n4", b"n6")}
+        if not fams:
+            fams = {b"n6" if _is_v6(addr[0]) else b"n4"}
+        close = self.table.closest(target, count=1 << 30)  # full sorted view
+        out: dict = {}
+        if b"n4" in fams:
+            v4 = [n for n in close if not _is_v6(n.ip)][:K]
+            out[b"nodes"] = b"".join(
+                pack_compact_node(n.node_id, n.ip, n.port) for n in v4
+            )
+        if b"n6" in fams:
+            v6 = [n for n in close if _is_v6(n.ip)][:K]
+            out[b"nodes6"] = b"".join(
+                pack_compact_node6(n.node_id, n.ip, n.port) for n in v6
+            )
+        return out
+
     def _handle_query(self, addr, tid: bytes, q, a: dict) -> None:
         if q == b"ping":
             self._respond(addr, tid, {})
@@ -417,11 +489,9 @@ class DHTNode:
             if not isinstance(target, bytes) or len(target) != 20:
                 self._error(addr, tid, 203, "bad target")
                 return
-            nodes = b"".join(
-                pack_compact_node(n.node_id, n.ip, n.port)
-                for n in self.table.closest(target)
+            self._respond(
+                addr, tid, self._closest_reply(target, addr, a.get(b"want"))
             )
-            self._respond(addr, tid, {b"nodes": nodes})
             return
         if q == b"get_peers":
             info_hash = a.get(b"info_hash")
@@ -431,12 +501,17 @@ class DHTNode:
             r: dict = {b"token": self.tokens.issue(addr[0])}
             peers = self._live_peers(info_hash)
             if peers:
-                r[b"values"] = [pack_compact_peer(ip, port) for ip, port in peers]
+                # BEP 32: values entries are family-sized (6 or 18 bytes)
+                from torrent_tpu.net.types import pack_compact_v6
+
+                r[b"values"] = [
+                    pack_compact_v6([(ip, port)])
+                    if _is_v6(ip)
+                    else pack_compact_peer(ip, port)
+                    for ip, port in peers
+                ]
             else:
-                r[b"nodes"] = b"".join(
-                    pack_compact_node(n.node_id, n.ip, n.port)
-                    for n in self.table.closest(info_hash)
-                )
+                r.update(self._closest_reply(info_hash, addr, a.get(b"want")))
             self._respond(addr, tid, r)
             return
         if q == b"announce_peer":
@@ -454,9 +529,13 @@ class DHTNode:
             if not isinstance(port, int) or not 0 < port < 65536:
                 self._error(addr, tid, 203, "bad port")
                 return
+            from torrent_tpu.net.types import normalize_peer_host
+
             store = self.peer_store.setdefault(info_hash, {})
             if len(store) < MAX_PEERS_PER_HASH:
-                store[(addr[0], port)] = time.monotonic()
+                # canonical family: a dual-stack socket reports v4
+                # announcers as ::ffff:a.b.c.d, which must pack as v4
+                store[(normalize_peer_host(addr[0]), port)] = time.monotonic()
             self._respond(addr, tid, {})
             return
         self._error(addr, tid, 204, "method unknown")
@@ -479,25 +558,45 @@ class DHTNode:
             raise DHTError("ping response missing id")
         return rid
 
+    def _merge_nodes(self, r: dict) -> list[tuple[bytes, str, int]]:
+        """nodes (26 B) + BEP 32 nodes6 (38 B) from one response —
+        ingesting only the families this socket can actually dial."""
+        out: list[tuple[bytes, str, int]] = []
+        nodes_blob = r.get(b"nodes")
+        if b"n4" in self._want and isinstance(nodes_blob, bytes):
+            out.extend(unpack_compact_nodes(nodes_blob))
+        nodes6_blob = r.get(b"nodes6")
+        if b"n6" in self._want and isinstance(nodes6_blob, bytes):
+            out.extend(unpack_compact_nodes6(nodes6_blob))
+        return out
+
     async def find_node(self, addr, target: bytes) -> list[tuple[bytes, str, int]]:
-        r = await self._query(addr, "find_node", {b"target": target})
-        nodes = r.get(b"nodes")
-        return unpack_compact_nodes(nodes) if isinstance(nodes, bytes) else []
+        r = await self._query(
+            addr, "find_node", {b"target": target, b"want": self._want}
+        )
+        return self._merge_nodes(r)
 
     async def get_peers(
         self, addr, info_hash: bytes
     ) -> tuple[list[tuple[str, int]], list[tuple[bytes, str, int]], bytes | None]:
         """→ (peers, closer_nodes, write_token)."""
-        r = await self._query(addr, "get_peers", {b"info_hash": info_hash})
+        from torrent_tpu.net.types import unpack_compact_v6
+
+        r = await self._query(
+            addr, "get_peers", {b"info_hash": info_hash, b"want": self._want}
+        )
         token = r.get(b"token")
         peers: list[tuple[str, int]] = []
         values = r.get(b"values")
         if isinstance(values, list):
             for v in values:
-                if isinstance(v, bytes):
-                    peers.extend(unpack_compact_peers(v))
-        nodes_blob = r.get(b"nodes")
-        nodes = unpack_compact_nodes(nodes_blob) if isinstance(nodes_blob, bytes) else []
+                if not isinstance(v, bytes):
+                    continue
+                # BEP 32: entry size selects the family
+                peers.extend(
+                    unpack_compact_v6(v) if len(v) == 18 else unpack_compact_peers(v)
+                )
+        nodes = self._merge_nodes(r)
         return peers, nodes, token if isinstance(token, bytes) else None
 
     async def announce_peer(self, addr, info_hash: bytes, port: int, token: bytes) -> None:
@@ -513,13 +612,16 @@ class DHTNode:
         """Ping seeds then walk towards our own id to fill the table.
 
         Seed hostnames are resolved first — the routing table must only
-        ever hold numeric IPv4 addresses (compact-node packing needs
-        them, and sendto on a hostname does blocking DNS per packet).
+        ever hold numeric addresses (compact-node packing needs them,
+        and sendto on a hostname does blocking DNS per packet). The
+        resolution family follows our own socket (a v4-bound node can't
+        reach v6 seeds and vice versa).
         """
+        fam = socket.AF_INET6 if _is_v6(self.host) else socket.AF_INET
         loop = asyncio.get_running_loop()
         for addr in addrs:
             try:
-                infos = await loop.getaddrinfo(addr[0], addr[1], family=socket.AF_INET)
+                infos = await loop.getaddrinfo(addr[0], addr[1], family=fam)
                 ip_addr = (infos[0][4][0], addr[1])
             except OSError:
                 continue

@@ -471,21 +471,111 @@ class TestBep32Ipv6:
 
         asyncio.run(go())
 
-    def test_v4_mapped_peers_pack_as_v4_values(self):
-        """A dual-stack socket stores announcers as ::ffff:a.b.c.d —
-        get_peers values must pack them as 6-byte v4 entries."""
+    def test_values_are_family_sized_and_never_empty(self):
+        """get_peers values pack per family (6/18 B); unpackable scoped
+        link-local entries are skipped, not shipped as empty strings —
+        exercised over a real socket round-trip."""
         import asyncio
         import time as _time
 
         from torrent_tpu.net.dht import DHTNode
 
         async def go():
-            n = DHTNode()
-            ih = b"\x44" * 20
-            n.peer_store[ih] = {("1.2.3.4", 6881): _time.monotonic()}
-            # simulate the handler's normalize on insert: mapped in, v4 out
-            from torrent_tpu.net.types import normalize_peer_host
+            b = await DHTNode(host="127.0.0.1").start()
+            a = await DHTNode(host="127.0.0.1").start()
+            try:
+                ih = b"\x44" * 20
+                now = _time.monotonic()
+                b.peer_store[ih] = {
+                    ("1.2.3.4", 6881): now,  # v4 -> 6 bytes
+                    ("2001:db8::5", 6882): now,  # v6 -> 18 bytes
+                    ("fe80::1%eth0", 6883): now,  # unpackable: skipped
+                }
+                peers, _, _ = await a.get_peers(("127.0.0.1", b.port), ih)
+                assert ("1.2.3.4", 6881) in peers
+                assert ("2001:db8::5", 6882) in peers
+                assert all(p[1] != 6883 for p in peers)
+            finally:
+                a.close()
+                b.close()
 
-            assert normalize_peer_host("::ffff:1.2.3.4") == "1.2.3.4"
+        asyncio.run(asyncio.wait_for(go(), 20))
 
-        asyncio.run(go())
+    def test_dual_stack_socket_dials_plain_v4(self):
+        """A '::'-bound node must reach plain-v4 table entries via the
+        ::ffff: mapping in _sendto (a raw v4 string on an AF_INET6
+        socket gaierrors into a silent RPC-timeout stall)."""
+        import asyncio
+        import socket as _socket
+
+        import pytest as _pytest
+
+        from torrent_tpu.net.dht import DHTNode
+
+        if not _socket.has_ipv6:
+            _pytest.skip("no IPv6")
+
+        async def go():
+            v4 = await DHTNode(host="127.0.0.1").start()
+            try:
+                dual = await DHTNode(host="::").start()
+            except OSError:
+                _pytest.skip("dual-stack bind unavailable")
+            try:
+                # table stores the canonical dotted quad; ping must map it
+                rid = await dual.ping(("127.0.0.1", v4.port))
+                assert rid == v4.node_id
+            finally:
+                dual.close()
+                v4.close()
+
+        asyncio.run(asyncio.wait_for(go(), 20))
+
+
+class TestMaintenance:
+    def test_maintain_once_pings_stale_and_sweeps_store(self):
+        import time as _time
+
+        async def go():
+            a = await DHTNode(host="127.0.0.1").start()
+            b = await DHTNode(host="127.0.0.1").start()
+            try:
+                await a.ping(("127.0.0.1", b.port))
+                # age b's entry past the stale threshold
+                entry = next(n for bucket in a.table.buckets for n in bucket)
+                entry.last_seen -= 11 * 60
+                # an expired peer-store entry to sweep
+                ih = b"\x77" * 20
+                a.peer_store[ih] = {("1.2.3.4", 1): _time.monotonic() - 10**6}
+                pinged = await a.maintain_once()
+                assert pinged == 1
+                assert entry.last_seen > _time.monotonic() - 5  # refreshed
+                assert ih not in a.peer_store  # swept
+            finally:
+                a.close()
+                b.close()
+
+        run(go())
+
+    def test_maintain_once_marks_dead_nodes(self):
+        async def go():
+            a = await DHTNode(host="127.0.0.1").start()
+            b = await DHTNode(host="127.0.0.1").start()
+            try:
+                await a.ping(("127.0.0.1", b.port))
+                entry = next(n for bucket in a.table.buckets for n in bucket)
+                entry.last_seen -= 11 * 60
+                b.close()  # now unreachable
+                import torrent_tpu.net.dht as D
+
+                old = D.RPC_TIMEOUT
+                D.RPC_TIMEOUT = 0.3
+                try:
+                    await a.maintain_once()
+                finally:
+                    D.RPC_TIMEOUT = old
+                assert entry.failed >= 1  # timeout recorded
+            finally:
+                a.close()
+
+        run(go())

@@ -326,8 +326,22 @@ class DHTNode:
         self._transport, _ = await loop.create_datagram_endpoint(
             lambda: _Protocol(self), local_addr=(self.host, self.port)
         )
+        sock = self._transport.get_extra_info("socket")
+        self._sock_v6 = bool(sock is not None and sock.family == socket.AF_INET6)
         self.port = self._transport.get_extra_info("sockname")[1]
         return self
+
+    def _sendto(self, data: bytes, addr) -> None:
+        """Family-aware sendto: the table stores canonical dotted-quad
+        text for v4 peers, but an AF_INET6 (dual-stack) socket can only
+        dial them in the ``::ffff:`` mapped form — a plain v4 string
+        raises gaierror, which the transport swallows, which turns every
+        v4 query into a silent full-RPC-timeout stall."""
+        if self._transport is None:
+            return
+        if getattr(self, "_sock_v6", False) and ":" not in addr[0]:
+            addr = ("::ffff:" + addr[0], addr[1])
+        self._transport.sendto(data, addr)
 
     def close(self) -> None:
         if self._transport is not None:
@@ -372,7 +386,7 @@ class DHTNode:
         # only accept the response from that address.
         self._pending[tid] = ((addr[0], addr[1]), fut)
         try:
-            self._transport.sendto(bencode(msg), addr)
+            self._sendto(bencode(msg), addr)
             return await asyncio.wait_for(fut, RPC_TIMEOUT)
         except asyncio.TimeoutError as e:
             raise DHTError(f"{q} to {addr} timed out") from e
@@ -380,20 +394,24 @@ class DHTNode:
             self._pending.pop(tid, None)
 
     def _respond(self, addr, tid: bytes, r: dict) -> None:
-        if self._transport is not None:
-            self._transport.sendto(
-                bencode({b"t": tid, b"y": b"r", b"r": {b"id": self.node_id, **r}}), addr
-            )
+        self._sendto(
+            bencode({b"t": tid, b"y": b"r", b"r": {b"id": self.node_id, **r}}), addr
+        )
 
     def _error(self, addr, tid: bytes, code: int, text: str) -> None:
-        if self._transport is not None:
-            self._transport.sendto(
-                bencode({b"t": tid, b"y": b"e", b"e": [code, text.encode()]}), addr
-            )
+        self._sendto(
+            bencode({b"t": tid, b"y": b"e", b"e": [code, text.encode()]}), addr
+        )
 
     # ------------------------------------------------------------- inbound
 
     def _on_datagram(self, data: bytes, addr) -> None:
+        from torrent_tpu.net.types import normalize_peer_host
+
+        # canonical source address: a dual-stack socket reports v4
+        # senders as ::ffff:a.b.c.d, which must match the dotted-quad
+        # form we queried/stored (pending-response check, tables, tokens)
+        addr = (normalize_peer_host(addr[0]), addr[1])
         try:
             msg = bdecode(data)
         except BencodeError:
@@ -501,15 +519,21 @@ class DHTNode:
             r: dict = {b"token": self.tokens.issue(addr[0])}
             peers = self._live_peers(info_hash)
             if peers:
-                # BEP 32: values entries are family-sized (6 or 18 bytes)
+                # BEP 32: values entries are family-sized (6 or 18 bytes);
+                # unpackable addresses (scoped link-local) are skipped —
+                # an empty-string entry would trip strict remote decoders
                 from torrent_tpu.net.types import pack_compact_v6
 
-                r[b"values"] = [
-                    pack_compact_v6([(ip, port)])
-                    if _is_v6(ip)
-                    else pack_compact_peer(ip, port)
-                    for ip, port in peers
-                ]
+                values = []
+                for ip, port in peers:
+                    v = (
+                        pack_compact_v6([(ip, port)])
+                        if _is_v6(ip)
+                        else pack_compact_peer(ip, port)
+                    )
+                    if v:
+                        values.append(v)
+                r[b"values"] = values
             else:
                 r.update(self._closest_reply(info_hash, addr, a.get(b"want")))
             self._respond(addr, tid, r)
@@ -539,6 +563,60 @@ class DHTNode:
             self._respond(addr, tid, {})
             return
         self._error(addr, tid, 204, "method unknown")
+
+    async def maintain_once(self, stale_after: float = 10 * 60) -> int:
+        """One table-maintenance pass (BEP 5 housekeeping):
+
+        - ping entries not seen for ``stale_after`` (a response refreshes
+          them via the normal path; a timeout marks a failure, and two
+          failures make the entry replaceable);
+        - refresh the table by walking toward a random target (keeps
+          distant buckets populated on a quiet node);
+        - sweep expired peer-store entries.
+
+        Returns the number of stale nodes pinged. Long-running nodes
+        call this periodically via :meth:`maintain`; the session's
+        announce loop gives connected clients the same effect for free.
+        """
+        now = time.monotonic()
+        stale = [
+            n
+            for bucket in self.table.buckets
+            for n in bucket
+            if now - n.last_seen > stale_after and n.failed < 2
+        ]
+
+        async def _refresh(n: NodeInfo) -> None:
+            try:
+                await self.ping(n.addr)
+            except DHTError:
+                self.table.note_failure(n.node_id)
+
+        # bounded concurrency: a mostly-dead table (post-suspend) would
+        # otherwise serialize RPC_TIMEOUT per entry into a minutes-long pass
+        for i in range(0, len(stale), ALPHA * 2):
+            await asyncio.gather(
+                *(_refresh(n) for n in stale[i : i + ALPHA * 2]),
+                return_exceptions=True,
+            )
+        try:
+            await self.lookup_nodes(random_node_id())
+        except DHTError:
+            pass
+        for ih in list(self.peer_store):
+            self._live_peers(ih)  # side effect: expire old entries
+            if not self.peer_store.get(ih):
+                self.peer_store.pop(ih, None)
+        return len(stale)
+
+    async def maintain(self, interval: float = 600.0) -> None:
+        """Run :meth:`maintain_once` forever (cancel to stop)."""
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.maintain_once()
+            except Exception as e:  # a bad pass must not kill the loop
+                log.debug("dht maintenance pass failed: %s", e)
 
     def _live_peers(self, info_hash: bytes) -> list[tuple[str, int]]:
         store = self.peer_store.get(info_hash)
@@ -617,7 +695,15 @@ class DHTNode:
         resolution family follows our own socket (a v4-bound node can't
         reach v6 seeds and vice versa).
         """
-        fam = socket.AF_INET6 if _is_v6(self.host) else socket.AF_INET
+        # dual-stack sockets dial both families (v4 via ::ffff mapping in
+        # _sendto) — resolving single-family there would silently drop
+        # seeds with only an A record and brick the join
+        if self.host in ("::", ""):
+            fam = socket.AF_UNSPEC
+        elif _is_v6(self.host):
+            fam = socket.AF_INET6
+        else:
+            fam = socket.AF_INET
         loop = asyncio.get_running_loop()
         for addr in addrs:
             try:

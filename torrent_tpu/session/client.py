@@ -76,6 +76,7 @@ class Client:
         self.external_ip: str | None = None
         self.port: int | None = None  # assigned by start()
         self.dht = None  # net.dht.DHTNode when enable_dht
+        self._dht_maintenance: asyncio.Task | None = None
         self.upload_bucket = TokenBucket(self.config.max_upload_bps)
         self.download_bucket = TokenBucket(self.config.max_download_bps)
         self.lsd = None  # net.lsd.LocalServiceDiscovery when enable_lsd
@@ -117,6 +118,9 @@ class Client:
             ).start()
             if self.config.dht_bootstrap:
                 await self.dht.bootstrap([tuple(a) for a in self.config.dht_bootstrap])
+            # table housekeeping for quiet nodes: stale pings + bucket
+            # refresh + peer-store expiry (net/dht.py maintain_once)
+            self._dht_maintenance = asyncio.create_task(self.dht.maintain())
         if self.config.enable_lsd:
             try:
                 from torrent_tpu.net.lsd import LocalServiceDiscovery
@@ -154,6 +158,9 @@ class Client:
         if self.utp is not None:
             self.utp.close()
             self.utp = None
+        if self._dht_maintenance is not None:
+            self._dht_maintenance.cancel()
+            self._dht_maintenance = None
         if self.dht is not None:
             self.dht.close()
             self.dht = None

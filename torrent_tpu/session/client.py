@@ -411,11 +411,15 @@ class Client:
                 writer.close()
                 return
             addr = writer.get_extra_info("peername")
+            from torrent_tpu.net.types import normalize_peer_host
+
             await torrent.add_peer(
                 peer_id,
                 reader,
                 writer,
-                address=tuple(addr[:2]) if addr else None,
+                # dual-stack listeners report v4 peers as ::ffff:a.b.c.d;
+                # one canonical form keeps dial dedup and PEX routing sane
+                address=(normalize_peer_host(addr[0]), addr[1]) if addr else None,
                 reserved=reserved,
                 inbound=True,
             )

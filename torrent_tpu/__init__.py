@@ -37,6 +37,7 @@ from torrent_tpu.parallel.verify import verify_pieces
 from torrent_tpu.tools.make_torrent import make_torrent
 from torrent_tpu.codec.magnet import Magnet, parse_magnet
 from torrent_tpu.codec.metainfo_v2 import MetainfoV2, InfoDictV2, V2File, parse_metainfo_v2
+from torrent_tpu.session.v2 import V2SessionMeta, v2_session_meta
 from torrent_tpu.utils.ratelimit import TokenBucket
 
 __all__ = [
@@ -71,6 +72,8 @@ __all__ = [
     "InfoDictV2",
     "V2File",
     "parse_metainfo_v2",
+    "V2SessionMeta",
+    "v2_session_meta",
     "__version__",
 ]
 

@@ -492,3 +492,47 @@ class TestV2Lifecycle:
                 await c.close()
 
         run(go(), timeout=60)
+
+
+class TestV2OverUtp:
+    def test_v2_transfer_over_utp_transport(self, tmp_path):
+        """Composition: a pure-v2 torrent (truncated-sha256 handshake,
+        merkle verify) over the uTP transport (SACK, delayed acks) —
+        the two round-3 planes working through each other."""
+        from torrent_tpu.net.utp import _UtpWriter
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            server, _ = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            ann = f"http://127.0.0.1:{server.http_port}/announce"
+            meta, files = _build(announce=ann, seed=31)
+            sd = _seed_dir(tmp_path, "vu", files)
+            ld = str(tmp_path / "vul")
+            os.makedirs(ld)
+            c1 = Client(ClientConfig(port=0, enable_upnp=False, enable_utp=True))
+            c2 = Client(ClientConfig(port=0, enable_upnp=False, enable_utp=True))
+            await c1.start()
+            await c2.start()
+            try:
+                t1 = await c1.add(meta, sd)
+                assert t1.bitfield.complete
+                t2 = await c2.add(meta, ld)
+                for _ in range(600):
+                    if t2.bitfield.complete:
+                        break
+                    await asyncio.sleep(0.05)
+                assert t2.bitfield.complete, t2.status()
+                fa, fb, fc = files
+                assert open(os.path.join(ld, "d2", "a.bin"), "rb").read() == fa
+                writers = [p.writer for p in t2.peers.values()]
+                assert writers and all(isinstance(w, _UtpWriter) for w in writers)
+            finally:
+                await c1.close()
+                await c2.close()
+                server.close()
+
+        run(go(), timeout=90)

@@ -536,3 +536,59 @@ class TestV2OverUtp:
                 server.close()
 
         run(go(), timeout=90)
+
+    def test_btmh_magnet_with_webseed_only_data(self, tmp_path):
+        """Composition: a v2-only magnet whose DATA comes entirely from a
+        ws= webseed — the only peer serves metadata + piece layers but is
+        paused (uploads nothing). Three round-3 planes at once."""
+        import threading
+        from functools import partial
+
+        from tests.test_webseed import _RangeHandler
+        from http.server import ThreadingHTTPServer
+
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            meta, files = _build(seed=37)
+            fa, fb, fc = files
+            # web server exports the content layout
+            www = tmp_path / "www" / "d2" / "sub"
+            www.mkdir(parents=True)
+            (tmp_path / "www" / "d2" / "a.bin").write_bytes(fa)
+            (tmp_path / "www" / "d2" / "sub" / "b.bin").write_bytes(fb)
+            (tmp_path / "www" / "d2" / "c.bin").write_bytes(fc)
+            httpd = ThreadingHTTPServer(
+                ("127.0.0.1", 0), partial(_RangeHandler, directory=str(tmp_path / "www"))
+            )
+            threading.Thread(target=httpd.serve_forever, daemon=True).start()
+            base = f"http://127.0.0.1:{httpd.server_address[1]}/"
+            sd = _seed_dir(tmp_path, "mw", files)
+            ld = str(tmp_path / "mwl")
+            os.makedirs(ld)
+            c1 = Client(ClientConfig(port=0, enable_upnp=False))
+            c2 = Client(ClientConfig(port=0, enable_upnp=False))
+            await c1.start()
+            await c2.start()
+            try:
+                t1 = await c1.add(meta, sd)
+                await t1.pause()  # metadata + layers yes, data no
+                magnet = Magnet(
+                    info_hash_v2=meta.info_hash_v2,
+                    peer_addrs=(("127.0.0.1", c1.port),),
+                    web_seeds=(base,),
+                )
+                t2 = await asyncio.wait_for(c2.add_magnet(magnet.to_uri(), ld), 60)
+                for _ in range(600):
+                    if t2.bitfield.complete:
+                        break
+                    await asyncio.sleep(0.05)
+                assert t2.bitfield.complete, t2.status()
+                assert open(os.path.join(ld, "d2", "a.bin"), "rb").read() == fa
+                assert t1.uploaded == 0  # every data byte off the webseed
+            finally:
+                await c1.close()
+                await c2.close()
+                httpd.shutdown()
+
+        run(go(), timeout=90)

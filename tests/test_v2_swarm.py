@@ -592,3 +592,55 @@ class TestV2OverUtp:
                 httpd.shutdown()
 
         run(go(), timeout=90)
+
+    def test_v2_super_seeding_swarm(self, tmp_path):
+        """Composition: BEP 16 super-seeding on a pure-v2 torrent — the
+        targeted-Have grant machinery runs on the v2 aligned piece space
+        and the swarm completes with ~1 copy from the seed."""
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            server, _ = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            ann = f"http://127.0.0.1:{server.http_port}/announce"
+            meta, files = _build(announce=ann, seed=41)
+            sd = _seed_dir(tmp_path, "ssv", files)
+            cfg = ClientConfig(port=0, enable_upnp=False)
+            cfg.torrent.super_seed = True
+            seed = Client(cfg)
+            leeches = [
+                Client(ClientConfig(port=0, enable_upnp=False)) for _ in range(2)
+            ]
+            await seed.start()
+            for c in leeches:
+                await c.start()
+            try:
+                ts = await seed.add(meta, sd)
+                assert ts.super_seeding()
+                tls = []
+                for i, c in enumerate(leeches):
+                    d = str(tmp_path / f"ssv{i}")
+                    os.makedirs(d)
+                    tls.append(await c.add(meta, d))
+                for _ in range(800):
+                    if all(t.bitfield.complete for t in tls):
+                        break
+                    await asyncio.sleep(0.05)
+                assert all(t.bitfield.complete for t in tls), [
+                    t.status() for t in tls
+                ]
+                payload_total = meta.info.length
+                assert ts.uploaded <= int(payload_total * 1.8), (
+                    ts.uploaded,
+                    payload_total,
+                )
+            finally:
+                await seed.close()
+                for c in leeches:
+                    await c.close()
+                server.close()
+
+        run(go(), timeout=90)

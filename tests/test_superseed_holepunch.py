@@ -304,3 +304,56 @@ class TestHolepunchRelay:
                 await b.close()
 
         run(go(), timeout=60)
+
+    def test_rendezvous_with_utp_enabled_clients(self, tmp_path):
+        """Composition: the BEP 55 introduction with uTP-enabled clients —
+        the CONNECT-triggered dial races uTP against TCP (happy-eyeballs)
+        and still establishes the B-C link."""
+
+        async def go():
+            plen = 32768
+            payload = np.random.default_rng(12).integers(
+                0, 256, 4 * plen, dtype=np.uint8
+            ).tobytes()
+            m = _make_meta(payload, plen, "http://127.0.0.1:1/announce")
+            sd = str(tmp_path / "hu")
+            os.makedirs(sd)
+            open(os.path.join(sd, "ss.bin"), "wb").write(payload)
+            a = Client(ClientConfig(port=0, enable_upnp=False, enable_utp=True))
+            b = Client(ClientConfig(port=0, enable_upnp=False, enable_utp=True))
+            c = Client(ClientConfig(port=0, enable_upnp=False, enable_utp=True))
+            await a.start()
+            await b.start()
+            await c.start()
+            try:
+                ta = await a.add(m, sd)
+                tb = await b.add(m, str(tmp_path / "hub"))
+                tc = await c.add(m, str(tmp_path / "huc"))
+                from torrent_tpu.net.types import AnnouncePeer
+
+                tb._connect_new_peers([AnnouncePeer(ip="127.0.0.1", port=a.port)])
+                tc._connect_new_peers([AnnouncePeer(ip="127.0.0.1", port=a.port)])
+                for _ in range(200):
+                    if (
+                        len(ta.peers) >= 2
+                        and tb.peers
+                        and tc.peers
+                        and all(p.ext.ut_holepunch_id for p in ta.peers.values())
+                        and all(p.ext.listen_port for p in ta.peers.values())
+                    ):
+                        break
+                    await asyncio.sleep(0.05)
+                assert len(ta.peers) >= 2
+                relay_id = next(iter(tb.peers.values())).peer_id
+                assert await tb.holepunch_rendezvous(relay_id, ("127.0.0.1", c.port))
+                for _ in range(300):
+                    if len(tb.peers) >= 2 and len(tc.peers) >= 2:
+                        break
+                    await asyncio.sleep(0.05)
+                assert len(tb.peers) >= 2 and len(tc.peers) >= 2
+            finally:
+                await a.close()
+                await b.close()
+                await c.close()
+
+        run(go(), timeout=90)

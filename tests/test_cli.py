@@ -290,3 +290,15 @@ class TestCli:
         m = parse_magnet(capsys.readouterr().out.strip())
         assert m.web_seeds == ("http://cdn.example/d/",)
         assert main(["magnet", str(tmp_path)]) == 1  # directory: clean error
+
+    def test_parser_flag_wiring(self):
+        """Flag plumbing sanity for round-3 additions."""
+        from torrent_tpu.tools.cli import build_parser
+
+        p = build_parser()
+        a = p.parse_args(["download", "x.torrent", "d", "--super-seed", "--utp"])
+        assert a.super_seed and a.utp
+        a2 = p.parse_args(["magnet", "x.torrent", "--no-trackers", "--peer", "h:1"])
+        assert a2.no_trackers and a2.peer == ["h:1"]
+        a3 = p.parse_args(["make", "p", "http://t/a", "--v2"])
+        assert a3.v2 and not a3.hybrid

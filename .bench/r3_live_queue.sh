@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-3 live chip queue (tunnel recovered 03:46 UTC 2026-07-31).
+# Strictly serialized, one TPU process at a time, nothing killed.
+# Order: bank the cheap records first (headline 2 GiB, v2 2 GiB), then
+# the 100 GiB cfg4 (relay-RAM hazard, e2e capped), then the sha256 sweep.
+cd /root/repo
+{
+echo "=== r3 live queue start $(date -u)"
+env BENCH_CONFIG=headline BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=1800 python bench.py \
+    > .bench/headline_final.json 2> .bench/headline_final.err
+echo "headline done $(date -u): $(cat .bench/headline_final.json)"
+env BENCH_CONFIG=v2 BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=1800 python bench.py \
+    > .bench/cfgv2c.json 2> .bench/cfgv2c.err
+echo "cfgv2c done $(date -u): $(cat .bench/cfgv2c.json)"
+env BENCH_CONFIG=headline BENCH_PIECE_KB=1024 BENCH_TOTAL_MB=102400 BENCH_BATCH=4096 \
+    BENCH_E2E_MB=16384 BENCH_TPU_WAIT=10800 python bench.py \
+    > .bench/cfg4.json 2> .bench/cfg4.err
+echo "cfg4 done $(date -u): $(cat .bench/cfg4.json)"
+python -m torrent_tpu.tools.tune_sha256 --iters 6 \
+    > .bench/tune_sha256.jsonl 2> .bench/tune_sha256.err
+echo "tune_sha256 done $(date -u): $(tail -1 .bench/tune_sha256.jsonl)"
+echo "=== r3 live queue complete $(date -u)"
+} >> .bench/auto_chain_r3.log 2>&1

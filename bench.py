@@ -64,6 +64,10 @@ import time
 
 import numpy as np
 
+# parent relay patience; the implicit child probes for 60% of it, leaving
+# the rest for the measurement (both read the same default)
+_DEFAULT_TPU_WAIT = "1500"
+
 
 def _env_geometry():
     total_mb = int(os.environ.get("BENCH_TOTAL_MB", "1024"))
@@ -169,6 +173,14 @@ class _PayloadMethod:
 # ------------------------------------------------------ wedge-safe relay
 
 
+def _poll_until(proc, deadline: float):
+    """Poll a never-to-be-killed child until it exits or the monotonic
+    deadline passes; returns its returncode, or None if abandoned."""
+    while proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(2.0)
+    return proc.poll()
+
+
 def _relay_via_child() -> None:
     """Run the real bench as a detached child; never kill it.
 
@@ -181,7 +193,7 @@ def _relay_via_child() -> None:
 
     total_mb, _, config, plen = _env_geometry()
     metric = _metric_name(config, plen, total_mb)
-    wait_s = float(os.environ.get("BENCH_TPU_WAIT", "1500"))
+    wait_s = float(os.environ.get("BENCH_TPU_WAIT", _DEFAULT_TPU_WAIT))
 
     out_fd, out_path = tempfile.mkstemp(prefix="bench_child_", suffix=".out")
     err_fd, err_path = tempfile.mkstemp(prefix="bench_child_", suffix=".err")
@@ -198,12 +210,7 @@ def _relay_via_child() -> None:
             stderr=err_f,
             start_new_session=True,
         )
-    deadline = time.monotonic() + wait_s
-    while time.monotonic() < deadline:
-        if proc.poll() is not None:
-            break
-        time.sleep(2.0)
-    rc = proc.poll()
+    rc = _poll_until(proc, time.monotonic() + wait_s)
     if rc is None:
         print(
             f"# bench child pid={proc.pid} still running after {wait_s:.0f}s "
@@ -211,17 +218,7 @@ def _relay_via_child() -> None:
             f"result, if any, will land in {out_path}",
             file=sys.stderr,
         )
-        print(
-            json.dumps(
-                {
-                    "metric": metric,
-                    "value": None,
-                    "unit": "pieces/s",
-                    "vs_baseline": None,
-                    "status": "tpu_unavailable",
-                }
-            )
-        )
+        print(_unavailable_record(metric))
         return
     with open(out_path) as f:
         body = f.read().strip()
@@ -234,17 +231,7 @@ def _relay_via_child() -> None:
     if rc == 0 and body:
         print(body)
         return
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": None,
-                "unit": "pieces/s",
-                "vs_baseline": None,
-                "status": f"bench_failed_rc_{rc}",
-            }
-        )
-    )
+    print(_unavailable_record(metric, status=f"bench_failed_rc_{rc}"))
     sys.exit(1)
 
 
@@ -754,6 +741,65 @@ def _execute(backend, vp, storage, info, digests, cpu_pps, batch, config, plen, 
     return line
 
 
+def _unavailable_record(metric: str, status: str = "tpu_unavailable") -> str:
+    return json.dumps(
+        {
+            "metric": metric,
+            "value": None,
+            "unit": "pieces/s",
+            "vs_baseline": None,
+            "status": status,
+        }
+    )
+
+
+def _await_device(wait_s: float) -> bool:
+    """Probe (in subprocesses) until the TPU grants a device or the window
+    closes. Returns True when a probe succeeded.
+
+    The device tunnel on this image grants ONE process at a time: a second
+    bench racing an in-flight one gets UNAVAILABLE at init, and silently
+    measuring on the CPU fallback would report a misleading ~0.1x record
+    (observed 2026-07-31 when the driver's snapshot raced the round-3 chip
+    queue). Probing in a child keeps this process's jax un-initialized so
+    a later import binds the real device.
+
+    A probe that blocks (a held-but-healthy grant queues us; a wedged
+    tunnel can hang mid-init) is given the rest of the window, then
+    ABANDONED, never killed — killing a mid-grant process is what wedges
+    the tunnel in the first place.
+    """
+    import subprocess
+
+    probe = (
+        "import jax, jax.numpy as jnp\n"
+        "assert jax.devices()[0].platform != 'cpu'\n"
+        "jnp.zeros(8).block_until_ready()\n"
+    )
+    if os.environ.get("BENCH_TEST_BREAK_PROBE"):
+        probe = "raise SystemExit(1)"  # tests: fail fast, touch no tunnel
+    deadline = time.monotonic() + wait_s
+    while True:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", probe],
+            stdin=subprocess.DEVNULL,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        rc = _poll_until(proc, deadline)
+        if rc == 0:
+            return True
+        # Back off, but always leave ~10s to monitor a final probe — a
+        # probe spawned with no monitoring window left would sit abandoned
+        # on the single-grant tunnel after we've already reported
+        # unavailable. rc here is != 0, or None (probe abandoned).
+        now = time.monotonic()
+        if deadline - now < 5.0:
+            return False
+        time.sleep(min(15.0, max(2.0, deadline - now - 10.0)))
+
+
 def main() -> None:
     total_mb, batch, config, plen = _env_geometry()
     plat = os.environ.get("BENCH_PLATFORM")
@@ -762,6 +808,15 @@ def main() -> None:
         _relay_via_child()
         return
 
+    if not plat:
+        # Child targeting the real device: wait for the tunnel to grant it
+        # rather than falling back to a CPU measurement. Leave ~40% of the
+        # parent's window for the measurement itself.
+        wait_s = float(os.environ.get("BENCH_TPU_WAIT", _DEFAULT_TPU_WAIT)) * 0.6
+        if not _await_device(wait_s):
+            print(_unavailable_record(_metric_name(config, plen, total_mb)))
+            return
+
     import jax
 
     # This image's sitecustomize pins jax_platforms to the device plugin;
@@ -769,6 +824,19 @@ def main() -> None:
     # bench can run where the operator points it.
     if plat:
         jax.config.update("jax_platforms", plat)
+    else:
+        # Probe won the device but this init may lose it (race). With
+        # jax_platforms pinned to the device plugin a lost init RAISES
+        # (observed: "Unable to initialize backend 'axon': UNAVAILABLE");
+        # with fallback registration it resolves to cpu. Either way, never
+        # report a CPU measurement for an implicit-TPU run.
+        try:
+            lost = jax.default_backend() == "cpu"
+        except RuntimeError:
+            lost = True
+        if lost:
+            print(_unavailable_record(_metric_name(config, plen, total_mb)))
+            return
 
     if config == "v2":
         print(json.dumps(_execute_v2(total_mb, plen)))

@@ -82,6 +82,72 @@ def test_relay_timeout_emits_unavailable_marker_without_killing_child():
     assert "leaving it to exit cleanly" in proc.stderr
 
 
+def test_implicit_child_waits_for_device_never_reports_cpu(monkeypatch):
+    """A child targeting the real device (no BENCH_PLATFORM) must wait for
+    the tunnel grant and, if it never comes, emit an explicit
+    tpu_unavailable record — NEVER a silent CPU measurement (observed
+    2026-07-31: a bench racing an in-flight one fell back to CPU and
+    reported 0.13x)."""
+    import bench
+
+    calls = []
+
+    class _Proc:
+        def __init__(self, rc):
+            self._rc = rc
+
+        def poll(self):
+            return self._rc
+
+    def fake_popen(rc):
+        def _f(*a, **k):
+            calls.append(a)
+            return _Proc(rc)
+
+        return _f
+
+    monkeypatch.setattr("subprocess.Popen", fake_popen(1))
+    assert bench._await_device(0.0) is False
+    assert len(calls) == 1  # one probe, then the closed window ends it
+
+    monkeypatch.setattr("subprocess.Popen", fake_popen(0))
+    assert bench._await_device(0.0) is True
+
+    # a probe that never exits is abandoned at the deadline, not killed
+    monkeypatch.setattr("subprocess.Popen", fake_popen(None))
+    assert bench._await_device(0.0) is False
+
+
+def test_implicit_child_emits_unavailable_when_device_never_granted():
+    """End-to-end: BENCH_CHILD=1 with no BENCH_PLATFORM and probes that
+    always fail prints the explicit unavailable record, value null."""
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("BENCH_PLATFORM",)
+    }
+    env.update(
+        BENCH_CHILD="1",
+        BENCH_TPU_WAIT="1",
+        BENCH_TOTAL_MB="4",
+        # poison the probe interpreter so every probe fails fast without
+        # touching any real device tunnel
+        BENCH_TEST_BREAK_PROBE="1",
+    )
+    proc = subprocess.run(
+        [sys.executable, BENCH],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["status"] == "tpu_unavailable"
+    assert rec["value"] is None
+
+
 def test_e2e_cap_marks_record():
     """BENCH_E2E_MB: the transfer-bound pass runs over a sub-range and
     the record carries the honest marker; the plane/baseline fields stay

@@ -74,6 +74,19 @@ def load():
         ctypes.POINTER(ctypes.c_uint8),
         ctypes.POINTER(ctypes.c_int32),
     ]
+    lib.tt_rc4_init.restype = None
+    lib.tt_rc4_init.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int32,
+    ]
+    lib.tt_rc4_crypt.restype = None
+    # buf is mutated in place (keystream xor), hence void* not char*
+    lib.tt_rc4_crypt.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
     return lib
 
 

@@ -381,7 +381,17 @@ class Client:
 
     async def _accept(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         """Inbound handshake: route on info hash before replying
-        (client.ts:85-104)."""
+        (client.ts:85-104).
+
+        MSE/PE auto-detection (net/mse.py): a plaintext BT handshake
+        starts with the 20-byte protocol header; anything else under an
+        encryption-accepting policy is treated as an MSE initiator and
+        answered with the obfuscated handshake, after which the BT
+        handshake proceeds over the (possibly RC4) streams.
+        """
+        from torrent_tpu.net import mse
+
+        policy = self.config.torrent.encryption
         try:
             peername = writer.get_extra_info("peername")
             if (
@@ -391,6 +401,26 @@ class Client:
             ):
                 writer.close()  # blocklisted: drop before reading ANY bytes
                 return
+            head = await asyncio.wait_for(reader.readexactly(20), timeout=15)
+            if head == bytes([len(proto.PROTOCOL_STRING)]) + proto.PROTOCOL_STRING[:19]:
+                if policy == "required":
+                    writer.close()  # plaintext refused on sight
+                    return
+                reader = mse.WrappedReader(reader, None, prefix=head)
+            else:
+                if policy == "disabled":
+                    writer.close()
+                    return
+                reader, writer, _skey, _sel = await asyncio.wait_for(
+                    mse.respond(
+                        reader,
+                        writer,
+                        head,
+                        list(self.torrents.keys()),
+                        allow_plaintext=policy != "required",
+                    ),
+                    timeout=15,
+                )
             info_hash, reserved = await asyncio.wait_for(
                 proto.read_handshake_head(reader), timeout=15
             )
@@ -423,5 +453,12 @@ class Client:
                 reserved=reserved,
                 inbound=True,
             )
-        except (proto.ProtocolError, asyncio.TimeoutError, ConnectionError, OSError):
+        except (
+            proto.ProtocolError,
+            mse.MseError,
+            asyncio.TimeoutError,
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+        ):
             writer.close()

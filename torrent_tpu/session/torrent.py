@@ -117,6 +117,17 @@ class TorrentConfig:
     # initial seed uploads ≈1 copy instead of N partial copies
     super_seed: bool = False
     super_seed_outstanding: int = 2  # unconfirmed pieces per peer
+    # MSE/PE protocol encryption (net/mse.py): 'disabled' = plaintext
+    # only; 'enabled' = accept both inbound, dial plaintext first with an
+    # encrypted retry (interops with encryption-requiring peers);
+    # 'required' = RC4 only, both directions
+    encryption: str = "enabled"
+
+    def __post_init__(self):
+        if self.encryption not in ("disabled", "enabled", "required"):
+            raise ValueError(
+                f"encryption must be disabled|enabled|required, got {self.encryption!r}"
+            )
 
 
 # Piece sizes at or below this run their hash/pread/pwrite INLINE on the
@@ -687,8 +698,8 @@ class Torrent:
             self._dialing.add(addr)
             self._spawn(self._dial(addr, cand.peer_id))
 
-    async def _dial(self, addr: tuple[str, int], expect_peer_id: bytes | None) -> None:
-        """connect/handshake/verify/register (torrent.ts:198-222).
+    async def _open_transport(self, addr: tuple[str, int]):
+        """Connect a transport to ``addr``; returns streams or (None, None).
 
         With uTP enabled (BEP 29) the dial races uTP against TCP,
         happy-eyeballs style: uTP gets a short head start (it is the
@@ -739,27 +750,82 @@ class Torrent:
                 )
             except (OSError, asyncio.TimeoutError):
                 reader = writer = None
-        if reader is None:
-            self._dialing.discard(addr)
-            return
+        return reader, writer
+
+    async def _dial(self, addr: tuple[str, int], expect_peer_id: bytes | None) -> None:
+        """connect/handshake/verify/register (torrent.ts:198-222).
+
+        MSE/PE (net/mse.py): 'enabled' dials plaintext first and retries
+        the whole connection encrypted when the plaintext handshake is
+        refused (an encryption-requiring peer drops it on sight);
+        'required' dials encrypted only.
+        """
+        from torrent_tpu.net import mse
+
+        class _TerminalDial(Exception):
+            """Handshake completed and was rejected on its merits (wrong
+            infohash, self-connect) — retrying encrypted proves nothing."""
+
+        policy = self.config.encryption
+        modes = {
+            "disabled": ("plain",),
+            "enabled": ("plain", "mse"),
+            "required": ("mse",),
+        }[policy]
+        pid = reserved = None
         try:
-            await proto.send_handshake(
-                writer,
-                self.metainfo.info_hash,
-                self.peer_id,
-                proto.merge_reserved(ext.extension_reserved(), proto.fast_reserved()),
-            )
-            ih, reserved = await asyncio.wait_for(proto.read_handshake_head(reader), timeout=10)
-            pid = await asyncio.wait_for(proto.read_handshake_peer_id(reader), timeout=10)
-            if ih != self.metainfo.info_hash or (expect_peer_id and pid != expect_peer_id):
-                raise proto.ProtocolError("handshake mismatch")
-            if pid == self.peer_id:
-                raise proto.ProtocolError("connected to self")
-        except (proto.ProtocolError, asyncio.TimeoutError, OSError):
-            writer.close()
+            for mode in modes:
+                reader, writer = await self._open_transport(addr)
+                if reader is None:
+                    return
+                try:
+                    if mode == "mse":
+                        reader, writer, _sel = await asyncio.wait_for(
+                            mse.initiate(
+                                reader,
+                                writer,
+                                self.metainfo.info_hash,
+                                allow_plaintext=policy != "required",
+                            ),
+                            timeout=15,
+                        )
+                    await proto.send_handshake(
+                        writer,
+                        self.metainfo.info_hash,
+                        self.peer_id,
+                        proto.merge_reserved(
+                            ext.extension_reserved(), proto.fast_reserved()
+                        ),
+                    )
+                    ih, reserved = await asyncio.wait_for(
+                        proto.read_handshake_head(reader), timeout=10
+                    )
+                    pid = await asyncio.wait_for(
+                        proto.read_handshake_peer_id(reader), timeout=10
+                    )
+                    if ih != self.metainfo.info_hash or (
+                        expect_peer_id and pid != expect_peer_id
+                    ):
+                        raise _TerminalDial("handshake mismatch")
+                    if pid == self.peer_id:
+                        raise _TerminalDial("connected to self")
+                    break  # handshake complete on this mode
+                except _TerminalDial:
+                    writer.close()
+                    return
+                except (
+                    mse.MseError,
+                    proto.ProtocolError,
+                    asyncio.TimeoutError,
+                    asyncio.IncompleteReadError,
+                    OSError,
+                ):
+                    writer.close()
+                    pid = None
+            if pid is None:
+                return
+        finally:
             self._dialing.discard(addr)
-            return
-        self._dialing.discard(addr)
         await self.add_peer(pid, reader, writer, address=addr, reserved=reserved)
 
     # ------------------------------------------------------------ peer mgmt

@@ -313,6 +313,8 @@ async def _download(args) -> int:
         config.torrent.sequential = True
     if getattr(args, "super_seed", False):
         config.torrent.super_seed = True
+    if getattr(args, "encryption", None):
+        config.torrent.encryption = args.encryption
     client = Client(config)
     await client.start()
     stop = asyncio.Event()
@@ -508,6 +510,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="BEP 16 super-seeding while complete (reveal pieces one-by-one)",
     )
     sp.add_argument("--no-resume", action="store_true", help="skip fastresume checkpoints")
+    sp.add_argument(
+        "--encryption",
+        choices=("disabled", "enabled", "required"),
+        default="enabled",
+        help="MSE/PE protocol encryption policy (default: enabled)",
+    )
     sp.add_argument(
         "--files",
         metavar="I,J,...",

@@ -242,6 +242,16 @@ class TestWrappers:
 
         run(go())
 
+    def test_read_to_eof_returns_prefix_plus_stream(self):
+        async def go():
+            r = asyncio.StreamReader()
+            r.feed_data(b"stream-rest")
+            r.feed_eof()
+            wr = mse.WrappedReader(r, None, prefix=b"prefix:")
+            assert await wr.read(-1) == b"prefix:stream-rest"
+
+        run(go())
+
     def test_reader_rc4_decrypts_after_prefix(self):
         async def go():
             key = b"\x42" * 20

@@ -48,6 +48,33 @@ PEER_TTL_SECS = 30 * 60
 MAX_PEERS_PER_HASH = 2000
 BOOTSTRAP_TARGET_RETRIES = 2
 
+# BEP 44 storage: bencoded values are capped at 1000 bytes, salts at 64;
+# items expire after 2 h (the BEP's republish horizon) and the store is
+# capped to bound a hostile flood
+ITEM_TTL_SECS = 2 * 3600
+MAX_ITEM_V = 1000
+MAX_ITEM_SALT = 64
+MAX_ITEMS = 2000
+
+
+def item_signature_blob(salt: bytes, seq: int, v_bencoded: bytes) -> bytes:
+    """The byte string a BEP 44 mutable item signs: the bencoded
+    ``salt``(optional)/``seq``/``v`` dict entries without the enclosing
+    dict, e.g. ``3:seqi1e1:v12:Hello World!``."""
+    head = b"4:salt" + bencode(salt) if salt else b""
+    return head + b"3:seq" + bencode(seq) + b"1:v" + v_bencoded
+
+
+@dataclass
+class DhtItem:
+    """A BEP 44 item as fetched: ``k``/``sig``/``seq`` are None for
+    immutable items."""
+
+    value: object
+    k: bytes | None = None
+    sig: bytes | None = None
+    seq: int | None = None
+
 
 def bep42_prefix(ip: str, r: int) -> bytes | None:
     """BEP 42 node-id constraint: the first 21 bits of a node's id must
@@ -267,6 +294,15 @@ class DHTError(Exception):
     pass
 
 
+class DHTRemoteError(DHTError):
+    """The node REPLIED with a KRPC error — it is alive (a 204 from a
+    non-BEP44 node must not count as a routing-table failure)."""
+
+    def __init__(self, text: str, code: int = 0):
+        super().__init__(text)
+        self.code = code
+
+
 class _Protocol(asyncio.DatagramProtocol):
     def __init__(self, node: "DHTNode"):
         self.node = node
@@ -313,6 +349,10 @@ class DHTNode:
         self.tokens = TokenJar()
         # info_hash -> {(ip, port): stored_at}
         self.peer_store: dict[bytes, dict[tuple[str, int], float]] = {}
+        # BEP 44: target -> {v, v_raw, k, sig, seq, ts} (k/sig/seq None
+        # for immutable items)
+        self.item_store: dict[bytes, dict] = {}
+        self._put_tasks: set[asyncio.Task] = set()  # keep verifies alive
         self._transport: asyncio.DatagramTransport | None = None
         # tid -> (queried address, future): responses are only accepted
         # from the address the query went to
@@ -451,8 +491,9 @@ class DHTNode:
                     return
                 if not fut.done():
                     e = msg.get(b"e")
+                    code = e[0] if isinstance(e, list) and e and isinstance(e[0], int) else 0
                     text = e[1].decode("utf-8", "replace") if isinstance(e, list) and len(e) > 1 and isinstance(e[1], bytes) else "remote error"
-                    fut.set_exception(DHTError(text))
+                    fut.set_exception(DHTRemoteError(text, code=code))
             return
         if kind != b"q":
             return
@@ -562,7 +603,174 @@ class DHTNode:
                 store[(normalize_peer_host(addr[0]), port)] = time.monotonic()
             self._respond(addr, tid, {})
             return
+        if q == b"get":
+            self._handle_get(addr, tid, a)
+            return
+        if q == b"put":
+            self._handle_put(addr, tid, a)
+            return
         self._error(addr, tid, 204, "method unknown")
+
+    # --------------------------------------------------- BEP 44 item store
+
+    def _live_item(self, target: bytes) -> dict | None:
+        ent = self.item_store.get(target)
+        if ent is None:
+            return None
+        if time.monotonic() - ent["ts"] > ITEM_TTL_SECS:
+            del self.item_store[target]
+            return None
+        return ent
+
+    def _handle_get(self, addr, tid: bytes, a: dict) -> None:
+        """BEP 44 ``get``: like get_peers but for stored items. Replies
+        always carry a write token and closer nodes; ``v`` (+``k``/
+        ``sig``/``seq`` for mutable items) when we hold the target. A
+        ``seq`` argument suppresses the value when the caller is already
+        current (the update-check fast path)."""
+        target = a.get(b"target")
+        if not isinstance(target, bytes) or len(target) != 20:
+            self._error(addr, tid, 203, "bad target")
+            return
+        r: dict = {b"token": self.tokens.issue(addr[0])}
+        r.update(self._closest_reply(target, addr, a.get(b"want")))
+        ent = self._live_item(target)
+        if ent is not None:
+            if ent["seq"] is not None:
+                r[b"seq"] = ent["seq"]
+                caller_seq = a.get(b"seq")
+                if isinstance(caller_seq, int) and ent["seq"] <= caller_seq:
+                    self._respond(addr, tid, r)
+                    return
+                r[b"k"] = ent["k"]
+                r[b"sig"] = ent["sig"]
+            r[b"v"] = ent["v"]
+        self._respond(addr, tid, r)
+
+    def _handle_put(self, addr, tid: bytes, a: dict) -> None:
+        """BEP 44 ``put``: immutable (target = sha1 of the bencoded
+        value) or mutable (ed25519-signed, target = sha1(k + salt),
+        monotonic ``seq`` with optional compare-and-swap)."""
+        token = a.get(b"token")
+        if not isinstance(token, bytes) or not self.tokens.valid(addr[0], token):
+            self._error(addr, tid, 203, "bad token")
+            return
+        if b"v" not in a:
+            self._error(addr, tid, 203, "missing v")
+            return
+        v = a[b"v"]
+        try:
+            v_raw = bencode(v)
+        except (BencodeError, TypeError, ValueError):
+            self._error(addr, tid, 203, "bad v")
+            return
+        if len(v_raw) > MAX_ITEM_V:
+            self._error(addr, tid, 205, "message (v field) too big")
+            return
+        k = a.get(b"k")
+        if k is None:
+            target = hashlib.sha1(v_raw).digest()
+            if self._store_full(target):
+                self._error(addr, tid, 202, "server error: store full")
+                return
+            self.item_store[target] = {
+                "v": v,
+                "v_raw": v_raw,
+                "k": None,
+                "sig": None,
+                "seq": None,
+                "ts": time.monotonic(),
+            }
+            self._respond(addr, tid, {})
+            return
+
+        from torrent_tpu.utils import ed25519
+
+        sig = a.get(b"sig")
+        seq = a.get(b"seq")
+        salt = a.get(b"salt", b"")
+        if not isinstance(k, bytes) or len(k) != 32:
+            self._error(addr, tid, 203, "bad k")
+            return
+        if not isinstance(salt, bytes):
+            self._error(addr, tid, 203, "bad salt")
+            return
+        if len(salt) > MAX_ITEM_SALT:
+            self._error(addr, tid, 207, "salt too big")
+            return
+        if not isinstance(seq, int) or seq < 0:
+            self._error(addr, tid, 203, "bad seq")
+            return
+        if not isinstance(sig, bytes) or len(sig) != 64:
+            self._error(addr, tid, 206, "invalid signature")
+            return
+        target = hashlib.sha1(k + salt).digest()
+        # every cheap rejection fires BEFORE the ~4 ms signature verify:
+        # replayed/stale puts must not buy an attacker big-int time
+        if not self._check_mutable_slot(addr, tid, target, seq, v_raw, a):
+            return
+        if self._store_full(target):
+            self._error(addr, tid, 202, "server error: store full")
+            return
+
+        async def _finish():
+            # the big-int verify runs in a worker thread so a put flood
+            # cannot stall the event loop (piece traffic, timers, RPCs)
+            ok = await asyncio.get_running_loop().run_in_executor(
+                None,
+                ed25519.verify,
+                k,
+                item_signature_blob(salt, seq, v_raw),
+                sig,
+            )
+            if not ok:
+                self._error(addr, tid, 206, "invalid signature")
+                return
+            # the store may have advanced while we verified: re-check
+            if not self._check_mutable_slot(addr, tid, target, seq, v_raw, a):
+                return
+            if self._store_full(target):
+                self._error(addr, tid, 202, "server error: store full")
+                return
+            self.item_store[target] = {
+                "v": v,
+                "v_raw": v_raw,
+                "k": k,
+                "sig": sig,
+                "seq": seq,
+                "ts": time.monotonic(),
+            }
+            self._respond(addr, tid, {})
+
+        task = asyncio.ensure_future(_finish())
+        self._put_tasks.add(task)
+        task.add_done_callback(self._put_tasks.discard)
+
+    def _check_mutable_slot(
+        self, addr, tid: bytes, target: bytes, seq: int, v_raw: bytes, a: dict
+    ) -> bool:
+        """seq/CAS preconditions vs the live store; sends the KRPC error
+        and returns False on rejection."""
+        old = self._live_item(target)
+        if old is not None and old["seq"] is not None:
+            cas = a.get(b"cas")
+            if isinstance(cas, int) and old["seq"] != cas:
+                self._error(addr, tid, 301, "cas mismatch")
+                return False
+            if seq < old["seq"] or (seq == old["seq"] and old["v_raw"] != v_raw):
+                self._error(addr, tid, 302, "sequence number less than current")
+                return False
+        return True
+
+    def _store_full(self, target: bytes) -> bool:
+        """Cap check that never counts dead weight: at the cap, expired
+        entries are purged before rejecting a new target."""
+        if target in self.item_store or len(self.item_store) < MAX_ITEMS:
+            return False
+        cutoff = time.monotonic() - ITEM_TTL_SECS
+        for t in [t for t, e in self.item_store.items() if e["ts"] < cutoff]:
+            del self.item_store[t]
+        return len(self.item_store) >= MAX_ITEMS
 
     async def maintain_once(self, stale_after: float = 10 * 60) -> int:
         """One table-maintenance pass (BEP 5 housekeeping):
@@ -607,6 +815,8 @@ class DHTNode:
             self._live_peers(ih)  # side effect: expire old entries
             if not self.peer_store.get(ih):
                 self.peer_store.pop(ih, None)
+        for target in list(self.item_store):
+            self._live_item(target)  # side effect: expire BEP 44 items
         return len(stale)
 
     async def maintain(self, interval: float = 600.0) -> None:
@@ -723,13 +933,15 @@ class DHTNode:
             await self.lookup_nodes(self.node_id)
         return len(self.table)
 
-    async def _iterative(self, target: bytes, want_peers: bool):
-        """Kademlia convergence loop shared by node and peer lookups."""
+    async def _iterative(self, target: bytes, mode: str = "nodes"):
+        """Kademlia convergence loop shared by node, peer, and BEP 44
+        item lookups (``mode``: 'nodes' | 'peers' | 'get')."""
         queried: set[tuple[str, int]] = set()
         candidates: dict[tuple[str, int], bytes] = {
             n.addr: n.node_id for n in self.table.closest(target, K * 2)
         }
         found_peers: set[tuple[str, int]] = set()
+        found_items: list[dict] = []
         tokens: dict[tuple[str, int], bytes] = {}
 
         def rank(addr) -> int:
@@ -745,13 +957,24 @@ class DHTNode:
             async def visit(addr):
                 queried.add(addr)
                 try:
-                    if want_peers:
+                    if mode == "peers":
                         peers, nodes, token = await self.get_peers(addr, target)
                         if token:
                             tokens[addr] = token
                         found_peers.update(peers)
                         return nodes
+                    if mode == "get":
+                        item, nodes, token = await self.get_rpc(addr, target)
+                        if token:
+                            tokens[addr] = token
+                        if item is not None:
+                            found_items.append(item)
+                        return nodes
                     return await self.find_node(addr, target)
+                except DHTRemoteError:
+                    # an error reply proves liveness (e.g. 204 from a
+                    # node without BEP 44) — never poison the table
+                    return []
                 except DHTError:
                     self.table.note_failure(candidates[addr])
                     return []
@@ -769,14 +992,14 @@ class DHTNode:
             if not progressed and all(a in queried for a in closest):
                 break
         closest = sorted((a for a in candidates if a in queried), key=rank)[:K]
-        return found_peers, closest, candidates, tokens
+        return found_peers, closest, candidates, tokens, found_items
 
     async def lookup_nodes(self, target: bytes) -> list[tuple[str, int]]:
-        _, closest, _, _ = await self._iterative(target, want_peers=False)
+        _, closest, _, _, _ = await self._iterative(target, "nodes")
         return closest
 
     async def lookup_peers(self, info_hash: bytes) -> list[tuple[str, int]]:
-        peers, _, _, _ = await self._iterative(info_hash, want_peers=True)
+        peers, _, _, _, _ = await self._iterative(info_hash, "peers")
         return sorted(peers)
 
     async def announce(self, info_hash: bytes, port: int) -> int:
@@ -784,7 +1007,7 @@ class DHTNode:
 
         Returns how many nodes accepted the announce.
         """
-        _, closest, candidates, tokens = await self._iterative(info_hash, want_peers=True)
+        _, closest, candidates, tokens, _ = await self._iterative(info_hash, "peers")
         accepted = 0
         for addr in closest:
             token = tokens.get(addr)
@@ -796,3 +1019,115 @@ class DHTNode:
             except DHTError:
                 continue
         return accepted
+
+    # ------------------------------------------------- BEP 44 client side
+
+    async def get_rpc(self, addr, target: bytes):
+        """One ``get`` query → (item fields | None, closer_nodes, token)."""
+        r = await self._query(addr, "get", {b"target": target, b"want": self._want})
+        token = r.get(b"token")
+        item = None
+        if b"v" in r:
+            item = {
+                "v": r[b"v"],
+                "k": r.get(b"k"),
+                "sig": r.get(b"sig"),
+                "seq": r.get(b"seq"),
+            }
+        return item, self._merge_nodes(r), token if isinstance(token, bytes) else None
+
+    async def put_rpc(self, addr, token: bytes, args: dict) -> None:
+        await self._query(addr, "put", {b"token": token, **args})
+
+    async def get_item(self, target: bytes, salt: bytes = b"") -> DhtItem | None:
+        """Iterative BEP 44 fetch + client-side validation.
+
+        Immutable replies must hash back to ``target``; mutable replies
+        must carry a valid signature under a key with
+        ``sha1(k + salt) == target`` (the caller knows the salt out of
+        band, like the key itself). The highest valid ``seq`` wins.
+        """
+        from torrent_tpu.utils import ed25519
+
+        _, _, _, _, items = await self._iterative(target, "get")
+        best: DhtItem | None = None
+        for it in items:
+            try:
+                v_raw = bencode(it["v"])
+            except (BencodeError, TypeError, ValueError):
+                continue
+            k, sig, seq = it["k"], it["sig"], it["seq"]
+            if k is None:
+                if hashlib.sha1(v_raw).digest() == target:
+                    return DhtItem(value=it["v"])  # immutable: first valid wins
+                continue
+            if (
+                not isinstance(k, bytes)
+                or not isinstance(sig, bytes)
+                or not isinstance(seq, int)
+                or hashlib.sha1(k + salt).digest() != target
+                or not ed25519.verify(k, item_signature_blob(salt, seq, v_raw), sig)
+            ):
+                continue
+            if best is None or seq > best.seq:
+                best = DhtItem(value=it["v"], k=k, sig=sig, seq=seq)
+        return best
+
+    async def _put_to_closest(self, target: bytes, args: dict) -> int:
+        _, closest, _, tokens, _ = await self._iterative(target, "get")
+        stored = 0
+        for addr in closest:
+            token = tokens.get(addr)
+            if token is None:
+                continue
+            try:
+                await self.put_rpc(addr, token, args)
+                stored += 1
+            except DHTError:
+                continue
+        return stored
+
+    async def put_immutable(self, value) -> tuple[bytes, int]:
+        """Store a bencodable value; returns (target, nodes_stored)."""
+        v_raw = bencode(value)
+        if len(v_raw) > MAX_ITEM_V:
+            raise ValueError(f"value too big ({len(v_raw)} > {MAX_ITEM_V})")
+        target = hashlib.sha1(v_raw).digest()
+        return target, await self._put_to_closest(target, {b"v": value})
+
+    async def put_mutable(
+        self,
+        secret: bytes,
+        value,
+        seq: int,
+        salt: bytes = b"",
+        cas: int | None = None,
+    ) -> tuple[bytes, int]:
+        """Sign and store a mutable item; returns (target, nodes_stored).
+
+        ``secret`` is a 32-byte ed25519 seed or a 64-byte expanded
+        secret (the form BEP 44's vectors use). ``cas`` forwards the
+        compare-and-swap precondition.
+        """
+        from torrent_tpu.utils import ed25519
+
+        v_raw = bencode(value)
+        if len(v_raw) > MAX_ITEM_V:
+            raise ValueError(f"value too big ({len(v_raw)} > {MAX_ITEM_V})")
+        if len(salt) > MAX_ITEM_SALT:
+            raise ValueError(f"salt too big ({len(salt)} > {MAX_ITEM_SALT})")
+        if len(secret) == 32:
+            k = ed25519.publickey(secret)
+            sig = ed25519.sign(secret, item_signature_blob(salt, seq, v_raw))
+        elif len(secret) == 64:
+            k = ed25519.publickey_expanded(secret)
+            sig = ed25519.sign_expanded(secret, item_signature_blob(salt, seq, v_raw))
+        else:
+            raise ValueError("secret must be a 32-byte seed or 64-byte expanded key")
+        args: dict = {b"v": value, b"k": k, b"sig": sig, b"seq": seq}
+        if salt:
+            args[b"salt"] = salt
+        if cas is not None:
+            args[b"cas"] = cas
+        target = hashlib.sha1(k + salt).digest()
+        return target, await self._put_to_closest(target, args)

@@ -219,13 +219,18 @@ class WrappedReader:
 
     async def read(self, n: int = -1) -> bytes:
         if self._prefix:
-            if n < 0:
-                take = bytes(self._prefix)
-                self._prefix.clear()
-            else:
+            if n >= 0:
                 take = bytes(self._prefix[:n])
                 del self._prefix[: len(take)]
-            return take
+                return take
+            take = bytes(self._prefix)
+            self._prefix.clear()
+            # read(-1) means read-to-EOF: the prefix alone would silently
+            # truncate the stream
+            rest = await self._r.read(-1)
+            if self._rc4 is not None and rest:
+                rest = self._rc4.crypt(rest)
+            return take + rest
         data = await self._r.read(n)
         if self._rc4 is not None and data:
             data = self._rc4.crypt(data)

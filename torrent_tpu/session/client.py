@@ -406,7 +406,10 @@ class Client:
                 if policy == "required":
                     writer.close()  # plaintext refused on sight
                     return
-                reader = mse.WrappedReader(reader, None, prefix=head)
+                # head IS the whole pstrlen+pstr header: finish phase 1
+                # on the raw reader (no wrapper on the plaintext hot path)
+                reserved = await asyncio.wait_for(reader.readexactly(8), timeout=15)
+                info_hash = await asyncio.wait_for(reader.readexactly(20), timeout=15)
             else:
                 if policy == "disabled":
                     writer.close()
@@ -421,9 +424,9 @@ class Client:
                     ),
                     timeout=15,
                 )
-            info_hash, reserved = await asyncio.wait_for(
-                proto.read_handshake_head(reader), timeout=15
-            )
+                info_hash, reserved = await asyncio.wait_for(
+                    proto.read_handshake_head(reader), timeout=15
+                )
             torrent = self.torrents.get(info_hash)
             if torrent is None:
                 writer.close()  # unknown torrent: drop pre-reply

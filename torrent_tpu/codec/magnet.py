@@ -42,6 +42,10 @@ class Magnet:
     select_only: tuple[int, ...] | None = None
     # BEP 9 §"magnet URI format" / BEP 19: ws= webseed URLs
     web_seeds: tuple[str, ...] = ()
+    # BEP 46 mutable pointer: xs=urn:btpk:<ed25519 pubkey hex> (+ s=<salt
+    # hex>) — the infohash is resolved through a BEP 44 mutable item
+    mutable_key: bytes | None = None
+    mutable_salt: bytes = b""
 
     @property
     def wire_hash(self) -> bytes:
@@ -50,7 +54,10 @@ class Magnet:
         a pure-v2 (btmh-only) magnet per BEP 52."""
         if self.info_hash is not None:
             return self.info_hash
-        assert self.info_hash_v2 is not None  # parse_magnet guarantees one
+        if self.info_hash_v2 is None:
+            raise MagnetError(
+                "mutable (btpk) magnet has no wire hash until resolved via BEP 44"
+            )
         return self.info_hash_v2[:20]
 
     def to_uri(self) -> str:
@@ -59,6 +66,10 @@ class Magnet:
             topics.append(f"xt=urn:btih:{self.info_hash.hex()}")
         if self.info_hash_v2 is not None:
             topics.append(f"xt=urn:btmh:1220{self.info_hash_v2.hex()}")
+        if self.mutable_key is not None:
+            topics.append(f"xs=urn:btpk:{self.mutable_key.hex()}")
+            if self.mutable_salt:
+                topics.append(f"s={self.mutable_salt.hex()}")
         if not topics:
             raise MagnetError("magnet needs at least one exact topic")
         parts = ["magnet:?" + topics[0]] + topics[1:]
@@ -86,6 +97,13 @@ class Magnet:
                 i = j + 1
             parts.append("so=" + ",".join(runs))
         return "&".join(parts)
+
+
+def mutable_magnet_uri(pubkey: bytes, salt: bytes = b"") -> str:
+    """BEP 46 shareable URI for a publisher's key (+ optional salt)."""
+    if len(pubkey) != 32:
+        raise MagnetError("btpk public key must be 32 bytes")
+    return Magnet(mutable_key=pubkey, mutable_salt=salt).to_uri()
 
 
 def _decode_btih(value: str) -> bytes:
@@ -126,8 +144,30 @@ def parse_magnet(uri: str) -> Magnet:
                     info_hash_v2 = binascii.unhexlify(mh[4:])
                 except binascii.Error:
                     pass
-    if info_hash is None and info_hash_v2 is None:
-        raise MagnetError("magnet URI has no urn:btih/btmh exact topic")
+    # BEP 46: xs=urn:btpk:<64 hex> names an ed25519 key whose BEP 44
+    # mutable item carries the current infohash; s=<hex> is its salt.
+    # Malformed btpk/s values SKIP the mutable pointer, same policy as
+    # unrecognized btmh shapes above — a magnet with a usable btih/btmh
+    # beside a bad pointer must still join; only a magnet whose SOLE
+    # topic was the (unusable) pointer fails, via the no-topic error.
+    mutable_key = None
+    mutable_salt = b""
+    for xs in params.get("xs", []):
+        if xs.startswith("urn:btpk:") and mutable_key is None:
+            pk_hex = xs[len("urn:btpk:") :]
+            if len(pk_hex) == 64:
+                try:
+                    mutable_key = binascii.unhexlify(pk_hex)
+                except binascii.Error:
+                    pass
+    if mutable_key is not None and params.get("s"):
+        try:
+            mutable_salt = binascii.unhexlify(params["s"][0])
+        except binascii.Error:
+            mutable_key = None  # pointer unusable without its salt
+            mutable_salt = b""
+    if info_hash is None and info_hash_v2 is None and mutable_key is None:
+        raise MagnetError("magnet URI has no urn:btih/btmh/btpk topic")
     peers: list[tuple[str, int]] = []
     for pe in params.get("x.pe", []):
         host, _, port_s = pe.rpartition(":")
@@ -171,4 +211,6 @@ def parse_magnet(uri: str) -> Magnet:
         peer_addrs=tuple(peers),
         select_only=select_only,
         web_seeds=tuple(u for u in params.get("ws", []) if u),
+        mutable_key=mutable_key,
+        mutable_salt=mutable_salt,
     )

@@ -55,6 +55,9 @@ ITEM_TTL_SECS = 2 * 3600
 MAX_ITEM_V = 1000
 MAX_ITEM_SALT = 64
 MAX_ITEMS = 2000
+# concurrent not-yet-verified mutable puts: beyond this the node sheds
+# load with an error instead of queueing unbounded ~4 ms verifies
+MAX_PUT_BACKLOG = 32
 
 
 def item_signature_blob(salt: bytes, seq: int, v_bencoded: bytes) -> bytes:
@@ -712,6 +715,11 @@ class DHTNode:
         if self._store_full(target):
             self._error(addr, tid, 202, "server error: store full")
             return
+        if len(self._put_tasks) >= MAX_PUT_BACKLOG:
+            # shed load: unbounded queued verifies would pin memory and
+            # let completions fall behind every sender's RPC timeout
+            self._error(addr, tid, 202, "server error: busy")
+            return
 
         async def _finish():
             # the big-int verify runs in a worker thread so a put flood
@@ -1066,8 +1074,19 @@ class DHTNode:
                 or not isinstance(sig, bytes)
                 or not isinstance(seq, int)
                 or hashlib.sha1(k + salt).digest() != target
-                or not ed25519.verify(k, item_signature_blob(salt, seq, v_raw), sig)
             ):
+                continue
+            # ~4 ms big-int verify per candidate item (dozens on a popular
+            # key, garbage sigs cost full price): off the event loop, like
+            # the server-side put path
+            ok = await asyncio.get_running_loop().run_in_executor(
+                None,
+                ed25519.verify,
+                k,
+                item_signature_blob(salt, seq, v_raw),
+                sig,
+            )
+            if not ok:
                 continue
             if best is None or seq > best.seq:
                 best = DhtItem(value=it["v"], k=k, sig=sig, seq=seq)

@@ -305,6 +305,14 @@ class Client:
             magnet = parse_magnet(magnet)
         if not isinstance(magnet, Magnet):
             raise TypeError("magnet must be a Magnet or magnet URI string")
+        if (
+            magnet.mutable_key is not None
+            and magnet.info_hash is None
+            and magnet.info_hash_v2 is None
+        ):
+            # BEP 46: resolve the pointer first (no recursion — the
+            # resolved magnet carries a concrete btih)
+            return await self.add_mutable_magnet(magnet, storage)
         if magnet.wire_hash in self.torrents:
             raise ValueError("torrent already added")
         # Throwaway peer id for the metadata connections: if the fetch
@@ -340,6 +348,66 @@ class Client:
                 [AnnouncePeer(ip=h, port=p) for h, p in magnet.peer_addrs]
             )
         return torrent
+
+    # ---------------------------------------------- BEP 46 mutable magnets
+
+    async def resolve_mutable(self, magnet) -> bytes:
+        """Resolve a BEP 46 ``btpk`` magnet to its CURRENT 20-byte
+        infohash via the key's BEP 44 mutable item (``{"ih": <hash>}``).
+
+        Raises ValueError when the magnet isn't mutable, the DHT is off,
+        the item can't be found, or its payload is malformed.
+        """
+        import hashlib as _hashlib
+
+        from torrent_tpu.codec.magnet import Magnet, parse_magnet
+
+        if isinstance(magnet, str):
+            magnet = parse_magnet(magnet)
+        if not isinstance(magnet, Magnet) or magnet.mutable_key is None:
+            raise ValueError("not a mutable (urn:btpk) magnet")
+        if self.dht is None:
+            raise ValueError("mutable magnets need the DHT (enable_dht=True)")
+        target = _hashlib.sha1(magnet.mutable_key + magnet.mutable_salt).digest()
+        item = await self.dht.get_item(target, salt=magnet.mutable_salt)
+        if item is None or item.seq is None:
+            raise ValueError("mutable item not found in the DHT")
+        v = item.value
+        ih = v.get(b"ih") if isinstance(v, dict) else None
+        if not isinstance(ih, bytes) or len(ih) != 20:
+            raise ValueError("mutable item carries no valid 'ih' pointer")
+        return ih
+
+    async def add_mutable_magnet(
+        self, magnet, storage: Storage | StorageMethod | str
+    ) -> Torrent:
+        """BEP 46: resolve the key's current infohash, then join that
+        swarm like any magnet (metadata over ut_metadata, BEP 53/19
+        params preserved)."""
+        from dataclasses import replace
+
+        from torrent_tpu.codec.magnet import Magnet, parse_magnet
+
+        if isinstance(magnet, str):
+            magnet = parse_magnet(magnet)
+        ih = await self.resolve_mutable(magnet)
+        return await self.add_magnet(
+            replace(magnet, info_hash=ih, mutable_key=None, mutable_salt=b""),
+            storage,
+        )
+
+    async def publish_mutable(
+        self, secret: bytes, info_hash: bytes, seq: int, salt: bytes = b""
+    ) -> tuple[bytes, int]:
+        """Publisher side of BEP 46: sign ``{"ih": info_hash}`` as the
+        key's BEP 44 mutable item. Returns (dht_target, nodes_stored);
+        the shareable URI is ``mutable_magnet_uri(publickey, salt)``.
+        Bump ``seq`` on every new revision of the content."""
+        if self.dht is None:
+            raise ValueError("publishing needs the DHT (enable_dht=True)")
+        if len(info_hash) != 20:
+            raise ValueError("info_hash must be 20 bytes")
+        return await self.dht.put_mutable(secret, {b"ih": info_hash}, seq, salt=salt)
 
     def status(self) -> dict:
         """Aggregate client observability: per-torrent status plus

@@ -171,7 +171,7 @@ class TestMultitracker:
     def test_failover_and_promotion(self, monkeypatch):
         calls = []
 
-        async def fake_announce(url, info):
+        async def fake_announce(url, info, proxy=None):
             calls.append(url)
             if "bad" in url:
                 raise TrackerError("down")
@@ -199,7 +199,7 @@ class TestMultitracker:
         assert calls[1] == "http://good/announce"  # tried right after tier 1
 
     def test_all_fail(self, monkeypatch):
-        async def fake_announce(url, info):
+        async def fake_announce(url, info, proxy=None):
             raise TrackerError("nope")
 
         import torrent_tpu.net.multitracker as mt

@@ -38,7 +38,10 @@ def parse_announce_list(raw: dict) -> list[list[str]] | None:
 class TrackerList:
     """Tiered tracker rotation state for one torrent."""
 
-    def __init__(self, announce_url: str, tiers: list[list[str]] | None = None):
+    def __init__(
+        self, announce_url: str, tiers: list[list[str]] | None = None, proxy=None
+    ):
+        self.proxy = proxy  # net.socks.ProxySpec | None, forwarded per call
         if tiers:
             self.tiers = [[u for u in t if u] for t in tiers]
             self.tiers = [t for t in self.tiers if t]
@@ -81,7 +84,9 @@ class TrackerList:
         last_err: Exception | None = None
         for tier, url in self.urls():
             try:
-                res = await asyncio.wait_for(announce(url, info), per_tracker_timeout)
+                res = await asyncio.wait_for(
+                    announce(url, info, proxy=self.proxy), per_tracker_timeout
+                )
             except (TrackerError, OSError, asyncio.TimeoutError) as e:
                 # any single-tracker failure must not abort the rotation
                 log.debug("tracker %s failed: %s", url, e)

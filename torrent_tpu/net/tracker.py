@@ -84,7 +84,7 @@ async def _read_chunked(reader: asyncio.StreamReader) -> bytes:
         await reader.readexactly(2)  # CRLF after each chunk
 
 
-async def _http_get_once(url: str) -> tuple[int, bytes, str | None]:
+async def _http_get_once(url: str, proxy=None) -> tuple[int, bytes, str | None]:
     """One GET hop → (status, body, location). Raw path passed verbatim."""
     parts = urlsplit(url)
     if parts.scheme not in ("http", "https"):
@@ -96,7 +96,12 @@ async def _http_get_once(url: str) -> tuple[int, bytes, str | None]:
         path += "?" + parts.query
     ssl_ctx = ssl_mod.create_default_context() if parts.scheme == "https" else None
 
-    reader, writer = await asyncio.open_connection(host, port, ssl=ssl_ctx)
+    if proxy is not None:
+        from torrent_tpu.net.socks import open_connection as socks_open
+
+        reader, writer = await socks_open(proxy, host, port, ssl=ssl_ctx)
+    else:
+        reader, writer = await asyncio.open_connection(host, port, ssl=ssl_ctx)
     try:
         req = (
             f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
@@ -143,14 +148,14 @@ async def _http_get_once(url: str) -> tuple[int, bytes, str | None]:
             pass
 
 
-async def _http_get(url: str, timeout: float = HTTP_TIMEOUT) -> bytes:
+async def _http_get(url: str, timeout: float = HTTP_TIMEOUT, proxy=None) -> bytes:
     """HTTP/1.1 GET returning the body, following up to HTTP_MAX_REDIRECTS
     3xx hops and decoding chunked transfer-encoding."""
 
     async def go() -> bytes:
         current = url
         for _ in range(HTTP_MAX_REDIRECTS + 1):
-            status, body, location = await _http_get_once(current)
+            status, body, location = await _http_get_once(current, proxy=proxy)
             if status in _REDIRECT_STATUSES:
                 if not location:
                     raise TrackerError(f"HTTP {status} redirect without Location")
@@ -291,9 +296,11 @@ def _parse_http_announce(body: bytes) -> AnnounceResponse:
     )
 
 
-async def _announce_http(url: str, info: AnnounceInfo) -> AnnounceResponse:
+async def _announce_http(url: str, info: AnnounceInfo, proxy=None) -> AnnounceResponse:
     sep = "&" if urlsplit(url).query else "?"
-    return _parse_http_announce(await _http_get(url + sep + _announce_query(info)))
+    return _parse_http_announce(
+        await _http_get(url + sep + _announce_query(info), proxy=proxy)
+    )
 
 
 _SCRAPE_FILE_SHAPE = valid.obj(
@@ -301,10 +308,10 @@ _SCRAPE_FILE_SHAPE = valid.obj(
 )
 
 
-async def _scrape_http(url: str, info_hashes: list[bytes]) -> list[ScrapeEntry]:
+async def _scrape_http(url: str, info_hashes: list[bytes], proxy=None) -> list[ScrapeEntry]:
     sep = "&" if urlsplit(url).query else "?"
     query = "&".join("info_hash=" + encode_binary_data(h) for h in info_hashes)
-    body = await _http_get(url + (sep + query if query else ""))
+    body = await _http_get(url + (sep + query if query else ""), proxy=proxy)
     try:
         data = bdecode(body, strict=False)
     except BencodeError as e:
@@ -552,21 +559,28 @@ async def _scrape_udp(url: str, info_hashes: list[bytes]) -> list[ScrapeEntry]:
 # ================================================================= dispatch
 
 
-async def announce(url: str, info: AnnounceInfo) -> AnnounceResponse:
-    """Announce to a tracker; dispatches on URL scheme (tracker.ts:402-420)."""
+async def announce(url: str, info: AnnounceInfo, proxy=None) -> AnnounceResponse:
+    """Announce to a tracker; dispatches on URL scheme (tracker.ts:402-420).
+
+    With a SOCKS5 ``proxy``, UDP trackers are refused rather than dialed
+    around the tunnel (a CONNECT proxy cannot carry them)."""
     scheme = urlsplit(url).scheme
     if scheme in ("http", "https"):
-        return await _announce_http(url, info)
+        return await _announce_http(url, info, proxy=proxy)
     if scheme == "udp":
+        if proxy is not None:
+            raise TrackerError("udp tracker skipped: SOCKS5 proxy cannot carry UDP")
         return await _announce_udp(url, info)
     raise TrackerError(f"unsupported tracker scheme {scheme!r}")
 
 
-async def scrape(url: str, info_hashes: list[bytes]) -> list[ScrapeEntry]:
+async def scrape(url: str, info_hashes: list[bytes], proxy=None) -> list[ScrapeEntry]:
     """Scrape tracker stats; dispatches on URL scheme (tracker.ts:214-240)."""
     scheme = urlsplit(url).scheme
     if scheme in ("http", "https"):
-        return await _scrape_http(scrape_url_for(url), info_hashes)
+        return await _scrape_http(scrape_url_for(url), info_hashes, proxy=proxy)
     if scheme == "udp":
+        if proxy is not None:
+            raise TrackerError("udp tracker skipped: SOCKS5 proxy cannot carry UDP")
         return await _scrape_udp(url, info_hashes)
     raise TrackerError(f"unsupported tracker scheme {scheme!r}")

@@ -63,6 +63,11 @@ class ClientConfig:
     # CIDR blocklist ("10.0.0.0/8", "2001:db8::/32", single IPs too):
     # matching peers are neither dialed nor accepted
     ip_filter: tuple = ()
+    # SOCKS5 proxy URL ("socks5://[user:pass@]host:port", net/socks.py):
+    # routes TCP peer dials, HTTP(S) trackers, and metadata fetches.
+    # UDP paths can't ride a CONNECT tunnel, so UDP trackers are skipped
+    # and outbound uTP + webseeds are disabled (no leaks around it).
+    proxy: str = ""
 
 
 class Client:
@@ -87,6 +92,25 @@ class Client:
             self.ip_filter = IpFilter(self.config.ip_filter)
         else:
             self.ip_filter = None
+        if self.config.proxy:
+            from torrent_tpu.net.socks import ProxySpec
+
+            self.proxy = ProxySpec.parse(self.config.proxy)  # fails loudly
+            # raw-UDP subsystems would announce the client's real address
+            # around the tunnel; refusing the combination keeps the
+            # no-leak promise explicit instead of silently partial
+            if self.config.enable_dht:
+                raise ValueError(
+                    "enable_dht with a SOCKS5 proxy would announce your real "
+                    "address over raw UDP around the tunnel; disable one"
+                )
+            if self.config.enable_lsd:
+                raise ValueError(
+                    "enable_lsd with a SOCKS5 proxy would multicast your real "
+                    "address on the LAN; disable one"
+                )
+        else:
+            self.proxy = None
 
     # ------------------------------------------------------------- startup
 
@@ -247,6 +271,7 @@ class Client:
             external_ip=self.external_ip,
             utp_dial=self.utp.dial if self.utp is not None else None,
             ip_filter=self.ip_filter,
+            proxy=self.proxy,
         )
         self.torrents[metainfo.info_hash] = torrent
         if wanted_files is not None:
@@ -325,6 +350,7 @@ class Client:
             port=self.port,
             dht=self.dht,
             ip_filter=self.ip_filter,
+            proxy=self.proxy,
         )
         # BEP 53: the magnet's file selection is applied BEFORE the
         # torrent starts (out-of-range indices dropped — the selection

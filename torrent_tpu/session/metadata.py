@@ -94,6 +94,7 @@ async def _fetch_from_peer(
     peer_id: bytes,
     timeout: float,
     v2_hash: bytes | None = None,
+    proxy=None,
 ) -> tuple[bytes, dict | None]:
     """Dial one peer and pull the whole info dict from it.
 
@@ -101,9 +102,16 @@ async def _fetch_from_peer(
     equal the btmh topic) and additionally fetches the piece layers on
     the same connection → ``(blob, layers)``; v1 returns ``(blob, None)``.
     """
-    reader, writer = await asyncio.wait_for(
-        asyncio.open_connection(addr[0], addr[1]), timeout=timeout
-    )
+    if proxy is not None:
+        from torrent_tpu.net.socks import open_connection as socks_open
+
+        reader, writer = await asyncio.wait_for(
+            socks_open(proxy, addr[0], addr[1]), timeout=timeout * 2
+        )
+    else:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(addr[0], addr[1]), timeout=timeout
+        )
     try:
         await proto.send_handshake(writer, info_hash, peer_id, ext.extension_reserved())
         ih, reserved = await asyncio.wait_for(proto.read_handshake_head(reader), timeout=timeout)
@@ -189,6 +197,7 @@ async def fetch_metadata(
     max_concurrent: int = 8,
     dht=None,
     ip_filter=None,  # optional net.ipfilter.IpFilter: candidates never dialed
+    proxy=None,  # optional net.socks.ProxySpec for peer dials + trackers
 ) -> "Metainfo":
     """Resolve a magnet to a full session metainfo using trackers + x.pe
     peers + (when a ``net.dht.DHTNode`` is supplied) mainline-DHT
@@ -224,7 +233,7 @@ async def fetch_metadata(
         )
         for tr in magnet.trackers:
             try:
-                res = await announce(tr, info)
+                res = await announce(tr, info, proxy=proxy)
                 candidates.extend((p.ip, p.port) for p in res.peers)
             except (TrackerError, OSError, asyncio.TimeoutError) as e:
                 log.warning("magnet announce to %s failed: %s", tr, e)
@@ -249,6 +258,7 @@ async def fetch_metadata(
                     peer_id,
                     peer_timeout,
                     v2_hash=magnet.info_hash_v2 if v2_only else None,
+                    proxy=proxy,
                 )
             except (MetadataError, proto.ProtocolError, OSError, asyncio.TimeoutError) as e:
                 errors.append(f"{addr}: {e}")

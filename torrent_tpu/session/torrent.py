@@ -154,6 +154,7 @@ class Torrent:
         external_ip=None,  # our public address, for BEP 40 dial ordering
         utp_dial=None,  # optional BEP 29 dialer: async (host, port) -> streams
         ip_filter=None,  # optional net.ipfilter.IpFilter (client-global)
+        proxy=None,  # optional net.socks.ProxySpec: TCP dials + HTTP trackers
     ):
         from torrent_tpu.net.multitracker import TrackerList, parse_announce_list
 
@@ -169,10 +170,13 @@ class Torrent:
         self.upload_bucket = upload_bucket
         self.download_bucket = download_bucket
         self.external_ip = external_ip
-        self._utp_dial = utp_dial
+        # a CONNECT proxy cannot carry uTP datagrams; racing uTP beside
+        # it would leak the peer address around the tunnel
+        self._utp_dial = utp_dial if proxy is None else None
         self.ip_filter = ip_filter
+        self.proxy = proxy
         self.trackers = TrackerList(
-            metainfo.announce, parse_announce_list(metainfo.raw)
+            metainfo.announce, parse_announce_list(metainfo.raw), proxy=proxy
         )
 
         # BEP 52 pure-v2 torrent (session/v2.py): 32-byte merkle piece
@@ -222,10 +226,18 @@ class Torrent:
 
         # BEP 19 webseed URLs: the metainfo's url-list plus any added at
         # runtime (magnet ws= params arrive after construction). Both
-        # sources are untrusted — only http/https survive.
-        self.web_seed_urls: list[str] = [
-            u for u in metainfo.web_seeds if _ws_allowed(u)
-        ]
+        # sources are untrusted — only http/https survive. Under a SOCKS5
+        # proxy, webseeds are refused wholesale (add_web_seed mirrors
+        # this): their urllib fetches would dial around the tunnel.
+        self.web_seed_urls: list[str] = (
+            [] if proxy is not None
+            else [u for u in metainfo.web_seeds if _ws_allowed(u)]
+        )
+        if proxy is not None and metainfo.web_seeds:
+            log.warning(
+                "%d metainfo webseed(s) disabled: SOCKS5 proxy configured",
+                len(metainfo.web_seeds),
+            )
         # serve-path LRU of whole pieces (dict ordering = recency) and
         # in-flight reads shared by concurrent misses on the same piece
         self._serve_cache: dict[int, bytes] = {}
@@ -408,6 +420,12 @@ class Torrent:
         starts immediately. True when the URL was newly attached."""
         from torrent_tpu.session.webseed import allowed_url
 
+        if self.proxy is not None:
+            # webseed fetches ride urllib, which would dial AROUND the
+            # configured proxy — refuse rather than leak the client's
+            # address to the webseed host
+            log.warning("webseed %s disabled: SOCKS5 proxy configured", url)
+            return False
         if url in self.web_seed_urls or not allowed_url(url):
             return False
         self.web_seed_urls.append(url)
@@ -745,9 +763,16 @@ class Torrent:
                             w.close()  # the losing transport
         else:
             try:
-                reader, writer = await asyncio.wait_for(
-                    asyncio.open_connection(addr[0], addr[1]), timeout=10
-                )
+                if self.proxy is not None:
+                    from torrent_tpu.net.socks import open_connection as socks_open
+
+                    reader, writer = await asyncio.wait_for(
+                        socks_open(self.proxy, addr[0], addr[1]), timeout=20
+                    )
+                else:
+                    reader, writer = await asyncio.wait_for(
+                        asyncio.open_connection(addr[0], addr[1]), timeout=10
+                    )
             except (OSError, asyncio.TimeoutError):
                 reader = writer = None
         return reader, writer

@@ -308,6 +308,7 @@ async def _download(args) -> int:
         max_download_bps=args.max_down * 1024,
         enable_lsd=args.lsd,
         enable_utp=args.utp,
+        proxy=getattr(args, "proxy", "") or "",
     )
     if args.sequential:
         config.torrent.sequential = True
@@ -412,9 +413,19 @@ def _cmd_scrape(args) -> int:
         print("error: need a tracker URL and at least one info hash", file=sys.stderr)
         return 1
 
+    proxy = None
+    if getattr(args, "proxy", ""):
+        from torrent_tpu.net.socks import ProxySpec
+
+        try:
+            proxy = ProxySpec.parse(args.proxy)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+
     async def go():
         try:
-            entries = await scrape(url, hashes)
+            entries = await scrape(url, hashes, proxy=proxy)
         except TrackerError as e:
             print(f"scrape failed: {e}", file=sys.stderr)
             return 1
@@ -517,6 +528,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="MSE/PE protocol encryption policy (default: enabled)",
     )
     sp.add_argument(
+        "--proxy",
+        default="",
+        help="SOCKS5 proxy for TCP peers + HTTP trackers "
+        "(socks5://[user:pass@]host:port; UDP paths are disabled)",
+    )
+    sp.add_argument(
         "--files",
         metavar="I,J,...",
         help="download only these file indices (see `info` for the list)",
@@ -553,6 +570,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=_cmd_download)
 
     sp = sub.add_parser("scrape", help="scrape seeder/leecher stats from a tracker")
+    sp.add_argument(
+        "--proxy",
+        default="",
+        help="SOCKS5 proxy for the scrape (socks5://[user:pass@]host:port)",
+    )
     sp.add_argument("--url", help="tracker announce URL (derived from --torrent if omitted)")
     sp.add_argument("--torrent", help=".torrent whose tracker + hash to scrape")
     sp.add_argument("info_hash", nargs="*", help="40-hex info hashes")

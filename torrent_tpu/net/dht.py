@@ -59,6 +59,11 @@ MAX_ITEMS = 2000
 # load with an error instead of queueing unbounded ~4 ms verifies
 MAX_PUT_BACKLOG = 32
 
+# BEP 51 sampling: cap keeps the reply in one UDP datagram; the
+# interval tells crawlers how often a fresh sample is worth fetching
+SAMPLE_MAX = 64
+SAMPLE_INTERVAL_SECS = 3600
+
 
 def item_signature_blob(salt: bytes, seq: int, v_bencoded: bytes) -> bytes:
     """The byte string a BEP 44 mutable item signs: the bencoded
@@ -77,6 +82,48 @@ class DhtItem:
     k: bytes | None = None
     sig: bytes | None = None
     seq: int | None = None
+
+
+class ScrapeBloom:
+    """BEP 33 2048-bit bloom filter over peer IPs.
+
+    Two 11-bit indices from sha1 of the binary address (v4: 4 bytes,
+    v6: 8 bytes); population is estimated from the zero-bit count, so
+    unioned filters from many nodes de-duplicate peers statistically.
+    """
+
+    SIZE_BITS = 2048
+
+    def __init__(self, data: bytes | None = None):
+        if data is not None and len(data) != self.SIZE_BITS // 8:
+            raise ValueError("BEP 33 bloom must be 256 bytes")
+        self.bits = bytearray(data or self.SIZE_BITS // 8)
+
+    def insert_ip(self, ip: str) -> None:
+        try:
+            packed = ipaddress.ip_address(ip).packed
+        except ValueError:
+            return
+        h = hashlib.sha1(packed[: 8 if len(packed) == 16 else 4]).digest()
+        for i1 in ((h[0] | h[1] << 8) % 2048, (h[2] | h[3] << 8) % 2048):
+            self.bits[i1 // 8] |= 1 << (i1 % 8)
+
+    def union(self, other: "ScrapeBloom") -> None:
+        for i, b in enumerate(other.bits):
+            self.bits[i] |= b
+
+    def estimate(self) -> float:
+        import math
+
+        m = self.SIZE_BITS
+        zero = sum(bin(b ^ 0xFF).count("1") for b in self.bits)
+        set_bits = m - zero
+        if set_bits >= m - 1:
+            set_bits = m - 1  # saturated filter: report the formula's cap
+        return math.log(1 - set_bits / m) / (2 * math.log(1 - 1 / m))
+
+    def __bytes__(self) -> bytes:
+        return bytes(self.bits)
 
 
 def bep42_prefix(ip: str, r: int) -> bytes | None:
@@ -352,6 +399,8 @@ class DHTNode:
         self.tokens = TokenJar()
         # info_hash -> {(ip, port): stored_at}
         self.peer_store: dict[bytes, dict[tuple[str, int], float]] = {}
+        # BEP 33: announcers that declared seed=1 (pruned with the store)
+        self.seed_marks: dict[bytes, set[tuple[str, int]]] = {}
         # BEP 44: target -> {v, v_raw, k, sig, seq, ts} (k/sig/seq None
         # for immutable items)
         self.item_store: dict[bytes, dict] = {}
@@ -562,6 +611,15 @@ class DHTNode:
                 return
             r: dict = {b"token": self.tokens.issue(addr[0])}
             peers = self._live_peers(info_hash)
+            if a.get(b"scrape"):
+                # BEP 33: per-swarm seed/downloader bloom filters so a
+                # scraper can estimate swarm size without collecting IPs
+                marks = self.seed_marks.get(info_hash, set())
+                bf_seed, bf_down = ScrapeBloom(), ScrapeBloom()
+                for key in peers:
+                    (bf_seed if key in marks else bf_down).insert_ip(key[0])
+                r[b"BFsd"] = bytes(bf_seed)
+                r[b"BFpe"] = bytes(bf_down)
             if peers:
                 # BEP 32: values entries are family-sized (6 or 18 bytes);
                 # unpackable addresses (scoped link-local) are skipped —
@@ -600,10 +658,19 @@ class DHTNode:
             from torrent_tpu.net.types import normalize_peer_host
 
             store = self.peer_store.setdefault(info_hash, {})
-            if len(store) < MAX_PEERS_PER_HASH:
+            key = (normalize_peer_host(addr[0]), port)
+            if len(store) < MAX_PEERS_PER_HASH or key in store:
                 # canonical family: a dual-stack socket reports v4
                 # announcers as ::ffff:a.b.c.d, which must pack as v4
-                store[(normalize_peer_host(addr[0]), port)] = time.monotonic()
+                store[key] = time.monotonic()
+                # BEP 33: the last announce's seed flag wins (no empty
+                # set is ever created for flagless announces)
+                if a.get(b"seed"):
+                    self.seed_marks.setdefault(info_hash, set()).add(key)
+                else:
+                    marks = self.seed_marks.get(info_hash)
+                    if marks is not None:
+                        marks.discard(key)
             self._respond(addr, tid, {})
             return
         if q == b"get":
@@ -611,6 +678,26 @@ class DHTNode:
             return
         if q == b"put":
             self._handle_put(addr, tid, a)
+            return
+        if q == b"sample_infohashes":
+            # BEP 51: DHT indexing — hand out a random sample of the
+            # infohashes we store so crawlers need not harvest
+            # get_peers traffic
+            target = a.get(b"target")
+            if not isinstance(target, bytes) or len(target) != 20:
+                self._error(addr, tid, 203, "bad target")
+                return
+            # only swarms we can still serve peers for: expired stores
+            # would waste the crawler's follow-up get_peers round-trips
+            known = [ih for ih in list(self.peer_store) if self._live_peers(ih)]
+            sample = random.sample(known, min(len(known), SAMPLE_MAX))
+            r = {
+                b"interval": SAMPLE_INTERVAL_SECS,
+                b"num": len(known),
+                b"samples": b"".join(sample),
+            }
+            r.update(self._closest_reply(target, addr, a.get(b"want")))
+            self._respond(addr, tid, r)
             return
         self._error(addr, tid, 204, "method unknown")
 
@@ -823,6 +910,7 @@ class DHTNode:
             self._live_peers(ih)  # side effect: expire old entries
             if not self.peer_store.get(ih):
                 self.peer_store.pop(ih, None)
+                self.seed_marks.pop(ih, None)  # never outlives its store
         for target in list(self.item_store):
             self._live_item(target)  # side effect: expire BEP 44 items
         return len(stale)
@@ -839,10 +927,14 @@ class DHTNode:
     def _live_peers(self, info_hash: bytes) -> list[tuple[str, int]]:
         store = self.peer_store.get(info_hash)
         if not store:
+            self.seed_marks.pop(info_hash, None)
             return []
         cutoff = time.monotonic() - PEER_TTL_SECS
-        for key in [k for k, ts in store.items() if ts < cutoff]:
+        expired = [k for k, ts in store.items() if ts < cutoff]
+        for key in expired:
             del store[key]
+        if expired and info_hash in self.seed_marks:
+            self.seed_marks[info_hash] &= store.keys()
         return list(store)
 
     # --------------------------------------------------------- client RPCs
@@ -895,12 +987,18 @@ class DHTNode:
         nodes = self._merge_nodes(r)
         return peers, nodes, token if isinstance(token, bytes) else None
 
-    async def announce_peer(self, addr, info_hash: bytes, port: int, token: bytes) -> None:
-        await self._query(
-            addr,
-            "announce_peer",
-            {b"info_hash": info_hash, b"port": port, b"token": token, b"implied_port": 0},
-        )
+    async def announce_peer(
+        self, addr, info_hash: bytes, port: int, token: bytes, seed: bool = False
+    ) -> None:
+        args = {
+            b"info_hash": info_hash,
+            b"port": port,
+            b"token": token,
+            b"implied_port": 0,
+        }
+        if seed:
+            args[b"seed"] = 1  # BEP 33: lets scrapers split seeds from leeches
+        await self._query(addr, "announce_peer", args)
 
     # ------------------------------------------------------------- lookups
 
@@ -1010,7 +1108,7 @@ class DHTNode:
         peers, _, _, _, _ = await self._iterative(info_hash, "peers")
         return sorted(peers)
 
-    async def announce(self, info_hash: bytes, port: int) -> int:
+    async def announce(self, info_hash: bytes, port: int, seed: bool = False) -> int:
         """get_peers convergence then announce_peer to the closest K.
 
         Returns how many nodes accepted the announce.
@@ -1022,7 +1120,7 @@ class DHTNode:
             if token is None:
                 continue
             try:
-                await self.announce_peer(addr, info_hash, port, token)
+                await self.announce_peer(addr, info_hash, port, token, seed=seed)
                 accepted += 1
             except DHTError:
                 continue
@@ -1150,3 +1248,65 @@ class DHTNode:
             args[b"cas"] = cas
         target = hashlib.sha1(k + salt).digest()
         return target, await self._put_to_closest(target, args)
+
+    # --------------------------------------- BEP 33 scrape / BEP 51 sample
+
+    async def scrape_rpc(self, addr, info_hash: bytes):
+        """One scraping get_peers → (seed bloom, downloader bloom) or
+        (None, None) when the node doesn't implement BEP 33."""
+        r = await self._query(
+            addr,
+            "get_peers",
+            {b"info_hash": info_hash, b"scrape": 1, b"want": self._want},
+        )
+        out = []
+        for field_name in (b"BFsd", b"BFpe"):
+            raw = r.get(field_name)
+            out.append(
+                ScrapeBloom(raw) if isinstance(raw, bytes) and len(raw) == 256 else None
+            )
+        return out[0], out[1]
+
+    async def scrape_swarm(self, info_hash: bytes) -> tuple[float, float]:
+        """BEP 33 swarm-size estimate: converge on the infohash, scrape
+        the closest nodes, union their blooms (statistical de-dup), and
+        return (≈seeds, ≈downloaders)."""
+        _, closest, _, _, _ = await self._iterative(info_hash, "peers")
+        bf_seed, bf_down = ScrapeBloom(), ScrapeBloom()
+
+        async def one(addr):
+            try:
+                return await self.scrape_rpc(addr, info_hash)
+            except DHTError:
+                return None, None
+
+        # concurrent: dead nodes must not serialize RPC_TIMEOUT each
+        for sd, pe in await asyncio.gather(*(one(a) for a in closest)):
+            if sd is not None:
+                bf_seed.union(sd)
+            if pe is not None:
+                bf_down.union(pe)
+        return bf_seed.estimate(), bf_down.estimate()
+
+    async def sample_infohashes(
+        self, addr, target: bytes
+    ) -> tuple[list[bytes], int, int, list[tuple[bytes, str, int]]]:
+        """BEP 51 → (sampled infohashes, total stored, refresh interval,
+        closer nodes) from one node."""
+        r = await self._query(
+            addr, "sample_infohashes", {b"target": target, b"want": self._want}
+        )
+        raw = r.get(b"samples")
+        samples = (
+            [raw[i : i + 20] for i in range(0, len(raw) - len(raw) % 20, 20)]
+            if isinstance(raw, bytes)
+            else []
+        )
+        num = r.get(b"num")
+        interval = r.get(b"interval")
+        return (
+            samples,
+            num if isinstance(num, int) else len(samples),
+            interval if isinstance(interval, int) else SAMPLE_INTERVAL_SECS,
+            self._merge_nodes(r),
+        )

@@ -670,7 +670,9 @@ class Torrent:
         ih = self.metainfo.info_hash
         while not self._stopping:
             try:
-                await self.dht.announce(ih, self.port)
+                # BEP 33: advertise completion so DHT scrapers can count
+                # seeds vs downloaders
+                await self.dht.announce(ih, self.port, seed=self.bitfield.complete)
                 if self.state != TorrentState.SEEDING:
                     peers = await self.dht.lookup_peers(ih)
                     self._connect_new_peers(

@@ -810,6 +810,53 @@ class TestLargeGeometryScaling:
         run(go())
 
 
+class TestPickerCadence:
+    def test_fill_pipeline_runs_per_half_pipeline_not_per_block(self):
+        """The picker is an O(pieces) scan; running it once per ingested
+        block made fast transfers O(n²) (measured ~40% of transfer CPU).
+        With refill hysteresis it must run ~2/depth times per block."""
+
+        async def go():
+            rng = np.random.default_rng(7)
+            payload = rng.integers(0, 256, size=8 * 1024 * 1024, dtype=np.uint8).tobytes()
+            server, pump, announce_url = await start_tracker()
+            m = parse_metainfo(build_torrent_bytes(payload, 65536, announce_url.encode()))
+            seed = Client(ClientConfig(host="127.0.0.1"))
+            leech = Client(ClientConfig(host="127.0.0.1"))
+            seed.config.torrent = fast_config()
+            leech.config.torrent = fast_config()
+            await seed.start()
+            await leech.start()
+            try:
+                ss = Storage(MemoryStorage(), m.info)
+                for off in range(0, len(payload), 65536):
+                    ss.set(off, payload[off : off + 65536])
+                await seed.add(m, ss)
+                leech_storage = Storage(MemoryStorage(), m.info)
+                t_leech = await leech.add(m, leech_storage)
+                calls = 0
+                orig = t_leech._fill_pipeline
+
+                async def counting(peer):
+                    nonlocal calls
+                    calls += 1
+                    await orig(peer)
+
+                t_leech._fill_pipeline = counting
+                await asyncio.wait_for(t_leech.on_complete.wait(), timeout=30)
+                n_blocks = len(payload) // 16384  # 512
+                # per-block refill would be ~n_blocks calls; hysteresis
+                # caps it near 2*n_blocks/depth (+ endgame/unchoke noise)
+                assert calls < n_blocks // 2, (calls, n_blocks)
+            finally:
+                await seed.close()
+                await leech.close()
+                server.close()
+                await asyncio.wait_for(pump, 5)
+
+        run(go())
+
+
 class TestConfigIsolationAndRaces:
     """VERDICT weak #6 + #8: caller-owned configs are never mutated, and
     concurrent delivery paths can't double-count or corrupt."""

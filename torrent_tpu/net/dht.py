@@ -59,9 +59,12 @@ MAX_ITEMS = 2000
 # load with an error instead of queueing unbounded ~4 ms verifies
 MAX_PUT_BACKLOG = 32
 
-# BEP 51 sampling: cap keeps the reply in one UDP datagram; the
-# interval tells crawlers how often a fresh sample is worth fetching
-SAMPLE_MAX = 64
+# BEP 51 sampling: the cap keeps the reply inside one unfragmented UDP
+# datagram even on a dual-stack node (20*20B samples + nodes + nodes6 +
+# KRPC overhead ≈ 1 KB < a 1472-byte Ethernet MTU payload — fragmented
+# UDP is routinely dropped by NATs); the interval tells crawlers how
+# often a fresh sample is worth fetching
+SAMPLE_MAX = 20
 SAMPLE_INTERVAL_SECS = 3600
 
 
@@ -687,13 +690,18 @@ class DHTNode:
             if not isinstance(target, bytes) or len(target) != 20:
                 self._error(addr, tid, 203, "bad target")
                 return
-            # only swarms we can still serve peers for: expired stores
-            # would waste the crawler's follow-up get_peers round-trips
-            known = [ih for ih in list(self.peer_store) if self._live_peers(ih)]
-            sample = random.sample(known, min(len(known), SAMPLE_MAX))
+            # Sample FIRST, then liveness-check only the sampled keys: a
+            # full-store liveness sweep per query would let a tokenless
+            # UDP packet drive O(swarms * peers) work (the periodic
+            # maintenance sweep owns bulk expiry). Oversample 2x so a few
+            # dead hits still fill the reply; ``num`` is the approximate
+            # store size the BEP asks for.
+            keys = list(self.peer_store)
+            candidates = random.sample(keys, min(len(keys), SAMPLE_MAX * 2))
+            sample = [ih for ih in candidates if self._live_peers(ih)][:SAMPLE_MAX]
             r = {
                 b"interval": SAMPLE_INTERVAL_SECS,
-                b"num": len(known),
+                b"num": len(self.peer_store),
                 b"samples": b"".join(sample),
             }
             r.update(self._closest_reply(target, addr, a.get(b"want")))

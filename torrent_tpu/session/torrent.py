@@ -216,6 +216,12 @@ class Torrent:
         # reference downloads everything or nothing). 0 = skip, higher =
         # sooner; derived from per-file priorities via set_file_priorities.
         self._piece_priority = np.ones(self.info.num_pieces, dtype=np.int8)
+        # streaming: pre-boost priority snapshot, active reader windows
+        # (token -> (first_piece, n)), and per-piece completion events
+        # for parked readers (created on demand, popped on set)
+        self._stream_base: np.ndarray | None = None
+        self._stream_positions: dict[object, tuple[int, int]] = {}
+        self._piece_events: dict[int, asyncio.Event] = {}
         # cached count of wanted-but-missing pieces: _fill_pipeline gates
         # on it per block, so it must be O(1) there (the numpy recount
         # runs only on selection changes and recheck/resume)
@@ -342,6 +348,13 @@ class Torrent:
             first, last = start // plen, (start + length - 1) // plen
             np.maximum(prio[first : last + 1], p, out=prio[first : last + 1])
         self._piece_priority = prio
+        # a new selection invalidates the boost snapshot; active reader
+        # windows re-apply over the new mask, and parked readers re-check
+        # (a newly-deselected piece must raise, not hang)
+        self._stream_base = None
+        if self._stream_positions:
+            self._apply_stream_windows()
+        self._wake_all_waiters()
         self._recount_wanted()
         self._rarity_dirty = True
         if (
@@ -378,6 +391,128 @@ class Torrent:
         await self.set_file_priorities(
             {i: (1 if i in want else 0) for i in range(len(ranges))}
         )
+
+    # ------------------------------------------------------------ streaming
+
+    def _notify_piece(self, index: int) -> None:
+        ev = self._piece_events.pop(index, None)
+        if ev is not None:
+            ev.set()
+
+    def _notify_present_pieces(self) -> None:
+        """Wake waiters after a BULK bitfield update (recheck adopting a
+        fresh array, fastresume replacing it wholesale) — per-piece
+        completion goes through _finish_piece → _notify_piece."""
+        for index in [i for i in self._piece_events if self.bitfield.has(i)]:
+            self._notify_piece(index)
+
+    async def wait_piece(self, index: int) -> None:
+        """Block until piece ``index`` is verified on disk (streaming
+        readers park here while the scheduler fetches ahead of them).
+
+        Raises instead of parking forever when the piece became
+        unreachable: RuntimeError once the torrent is stopping,
+        LookupError when the piece is deselected (priority 0) — both
+        re-checked every wake, and stop()/set_file_priorities wake all
+        parked waiters precisely so these fire."""
+        if not 0 <= index < self.info.num_pieces:
+            raise IndexError(f"piece {index} out of range")
+        while not self.bitfield.has(index):
+            if self._stopping:
+                raise RuntimeError("torrent stopped while waiting for a piece")
+            if self._piece_priority[index] <= 0:
+                raise LookupError(f"piece {index} is not scheduled (deselected)")
+            ev = self._piece_events.get(index)
+            if ev is None:
+                ev = self._piece_events[index] = asyncio.Event()
+            await ev.wait()
+
+    def _wake_all_waiters(self) -> None:
+        """Set (and drop) every parked piece event so waiters re-check
+        their abort conditions — completion still only comes from the
+        bitfield check in wait_piece's loop."""
+        events = list(self._piece_events.values())
+        self._piece_events.clear()
+        for ev in events:
+            ev.set()
+
+    def span_servable(self, start: int, length: int) -> bool:
+        """True when every piece of byte span [start, start+length) is
+        on disk already or wanted (priority > 0) — the condition under
+        which a stream reader is guaranteed to eventually be served."""
+        if length <= 0:
+            return False
+        plen = self.info.piece_length
+        first, last = start // plen, (start + length - 1) // plen
+        base = self._stream_base if self._stream_base is not None else self._piece_priority
+        missing = ~self.bitfield.as_numpy()[first : last + 1]
+        return not bool(np.any(missing & (base[first : last + 1] <= 0)))
+
+    def set_stream_window(
+        self, offset: int, window_pieces: int = 8, token: object = "default"
+    ) -> None:
+        """Point the scheduler at a reader position: the next
+        ``window_pieces`` wanted pieces from ``offset`` jump to maximum
+        priority (127), and pieces the reader moved past fall back to
+        their pre-boost priority. Random seeks (HTTP Range requests)
+        re-point the window instantly; deselected (priority-0) pieces
+        are never boosted — streaming doesn't widen the selection.
+
+        ``token`` names the reader: concurrent readers (players open a
+        head and a tail connection at once) each hold a window and the
+        boost is their union, so one reader's chunk cadence can't wipe
+        the other's read-ahead. No-op when the token's window start
+        hasn't moved (the array rewrite is O(pieces)).
+        """
+        plen = self.info.piece_length
+        first = min(max(0, offset // plen), self.info.num_pieces - 1)
+        prev = self._stream_positions.get(token)
+        if prev == (first, window_pieces):
+            return
+        self._stream_positions[token] = (first, window_pieces)
+        if self._stream_base is None or prev is None:
+            self._apply_stream_windows()
+            return
+        # Steady-state window advance: O(window) delta — restore pieces
+        # the window left (unless another reader still covers them),
+        # boost the newly-entered ones. No rarity rebuild: the picker
+        # consults stream windows directly, so priority-array lag only
+        # affects the (eventual) background ordering.
+        old = set(range(prev[0], min(prev[0] + prev[1], self.info.num_pieces)))
+        new = set(range(first, min(first + window_pieces, self.info.num_pieces)))
+        still = set()
+        for f, n in self._stream_positions.values():
+            still.update(range(f, min(f + n, self.info.num_pieces)))
+        for i in old - new - still:
+            self._piece_priority[i] = self._stream_base[i]
+        for i in new - old:
+            if self._stream_base[i] > 0:
+                self._piece_priority[i] = np.int8(127)
+
+    def clear_stream_window(self, token: object = None) -> None:
+        """Drop one reader's window (``token``) or, with None, all."""
+        if token is None:
+            if not self._stream_positions:
+                return
+            self._stream_positions.clear()
+        elif self._stream_positions.pop(token, None) is None:
+            return
+        self._apply_stream_windows()
+
+    def _apply_stream_windows(self) -> None:
+        """Full restore + reapply (token add/remove, selection change) —
+        window ADVANCES take the O(window) delta path in
+        set_stream_window instead."""
+        if self._stream_base is None:
+            self._stream_base = self._piece_priority.copy()
+        else:
+            np.copyto(self._piece_priority, self._stream_base)
+        for first, window_pieces in self._stream_positions.values():
+            window = self._piece_priority[first : first + window_pieces]
+            np.copyto(window, np.where(window > 0, np.int8(127), window))
+        if not self._stream_positions:
+            self._stream_base = None
+        self._rarity_dirty = True
 
     def _wanted_remaining(self) -> int:
         """Count of wanted pieces not yet verified on disk (cached)."""
@@ -475,6 +610,7 @@ class Torrent:
             ):
                 return False
         self.bitfield = bf
+        self._notify_present_pieces()
         self._recount_wanted()
         self._rarity_dirty = True
         self.storage.mark_pieces_written(
@@ -530,6 +666,7 @@ class Torrent:
 
     def _apply_recheck(self, ok) -> None:
         self.bitfield.from_numpy(ok)
+        self._notify_present_pieces()
         self._recount_wanted()
         self.storage.mark_pieces_written(i for i in range(len(ok)) if ok[i])
         log.info(
@@ -538,6 +675,7 @@ class Torrent:
 
     async def stop(self) -> None:
         self._stopping = True
+        self._wake_all_waiters()  # parked stream readers abort, not hang
         tasks = list(self._tasks)
         for t in tasks:
             t.cancel()
@@ -1695,6 +1833,25 @@ class Torrent:
             if peer.bitfield.has(index) and not self.bitfield.has(index) and pickable(index):
                 if take_from(index):
                     break
+        # Active stream windows outrank everything below: a parked HTTP
+        # reader is latency-bound on exactly these pieces. Consulted
+        # directly (not via the priority array) so window advances are
+        # O(window) with no rarity rebuild.
+        if len(wanted) < budget and self._stream_positions:
+            for first, n in sorted(self._stream_positions.values()):
+                for index in range(first, min(first + n, self.info.num_pieces)):
+                    if (
+                        self.bitfield.has(index)
+                        or index in self._partials
+                        or self._piece_priority[index] <= 0
+                        or not peer.bitfield.has(index)
+                        or not pickable(index)
+                    ):
+                        continue
+                    if take_from(index):
+                        break
+                if len(wanted) >= budget:
+                    break
         # BEP 6 suggest-piece hints outrank plain rarest-first: the sender
         # says these are cheap for it to serve (e.g. still in cache)
         if len(wanted) < budget:
@@ -1883,6 +2040,7 @@ class Torrent:
             log.error("failed to persist piece %d: %s", partial.index, e)
             return "io_error"
         self.bitfield.set(partial.index)
+        self._notify_piece(partial.index)
         if self._piece_priority[partial.index] > 0:
             self._wanted_missing = max(0, self._wanted_missing - 1)
         if self.bitfield.count() % 16 == 0:

@@ -362,18 +362,39 @@ async def _download(args) -> int:
                 )
                 await asyncio.sleep(1)
 
+        stream_server = None
+        if getattr(args, "stream_port", None) is not None:
+            from torrent_tpu.tools.stream import StreamServer
+
+            stream_server = await StreamServer(torrent).start(args.stream_port)
+            entries = torrent.info.files or ()
+            names = ["/".join(f.path) for f in entries] or [torrent.info.name]
+            for i, name in enumerate(names):
+                if i < len(entries) and getattr(entries[i], "pad", False):
+                    continue  # BEP 47 pad files are never servable
+                print(
+                    f"streaming http://127.0.0.1:{stream_server.port}/{i}  ({name})",
+                    file=sys.stderr,
+                )
         reporter = asyncio.ensure_future(report())
         done_wait = asyncio.ensure_future(torrent.on_complete.wait())
         stop_wait = asyncio.ensure_future(stop.wait())
         await asyncio.wait({done_wait, stop_wait}, return_when=asyncio.FIRST_COMPLETED)
         if torrent.on_complete.is_set():
             print("\ndownload complete", file=sys.stderr)
-            if args.seed and not stop.is_set():
-                print("seeding (ctrl-c to stop)", file=sys.stderr)
+            if (args.seed or stream_server is not None) and not stop.is_set():
+                print(
+                    "seeding/streaming (ctrl-c to stop)"
+                    if stream_server is not None
+                    else "seeding (ctrl-c to stop)",
+                    file=sys.stderr,
+                )
                 await stop.wait()
         reporter.cancel()
         done_wait.cancel()
         stop_wait.cancel()
+        if stream_server is not None:
+            stream_server.close()
         return 0 if torrent.on_complete.is_set() else 130
     finally:
         await client.close()
@@ -532,6 +553,14 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         help="SOCKS5 proxy for TCP peers + HTTP trackers "
         "(socks5://[user:pass@]host:port; UDP paths are disabled)",
+    )
+    sp.add_argument(
+        "--stream-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve files over HTTP (Range-capable) WHILE downloading; "
+        "the reader position steers piece priority (0 = ephemeral port)",
     )
     sp.add_argument(
         "--files",

@@ -1,0 +1,47 @@
+#!/bin/bash
+# Second-wave recovery: waits for the first live queue (r3_live_queue.sh)
+# to exit, then probes every 10 min. On a REAL recovery (probe computes a
+# round-trip), climbs a small-to-large ladder so a short healthy window
+# still banks a publishable record before the risky big configs:
+#   1. headline 512 MiB   (minutes)  -> .bench/headline_small.json
+#   2. v2       512 MiB   (minutes)  -> .bench/cfgv2_small.json
+#   3. headline 2 GiB               -> .bench/headline_final.json
+#   4. v2       2 GiB               -> .bench/cfgv2c.json
+#   5. cfg4     100 GiB (e2e capped) -> .bench/cfg4.json
+# Strictly serialized; nothing killed; every bench child itself waits for
+# the grant (bench.py _await_device) so a mid-window wedge degrades to an
+# honest null, never a CPU number.
+cd /root/repo
+while pgrep -f "r3_live_queue.sh" >/dev/null 2>&1; do sleep 60; done
+{
+echo "=== r3 recovery2 start $(date -u)"
+for attempt in $(seq 1 60); do
+  python -u -c "
+import json
+import jax, jax.numpy as jnp
+print(json.dumps({'ok': True, 'sum': int(jnp.sum(jax.device_put(jnp.ones(64))))}))
+" > .bench/probe_r3b.log 2>&1
+  if grep -q '"ok": true' .bench/probe_r3b.log; then
+    echo "recovery2: tunnel alive attempt=$attempt $(date -u)"
+    env BENCH_CONFIG=headline BENCH_TOTAL_MB=512 BENCH_TPU_WAIT=900 python bench.py \
+        > .bench/headline_small.json 2> .bench/headline_small.err
+    echo "headline_small done $(date -u): $(cat .bench/headline_small.json)"
+    env BENCH_CONFIG=v2 BENCH_TOTAL_MB=512 BENCH_TPU_WAIT=900 python bench.py \
+        > .bench/cfgv2_small.json 2> .bench/cfgv2_small.err
+    echo "cfgv2_small done $(date -u): $(cat .bench/cfgv2_small.json)"
+    env BENCH_CONFIG=headline BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=1800 python bench.py \
+        > .bench/headline_final.json 2> .bench/headline_final.err
+    echo "headline done $(date -u): $(cat .bench/headline_final.json)"
+    env BENCH_CONFIG=v2 BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=1800 python bench.py \
+        > .bench/cfgv2c.json 2> .bench/cfgv2c.err
+    echo "cfgv2c done $(date -u): $(cat .bench/cfgv2c.json)"
+    env BENCH_CONFIG=headline BENCH_PIECE_KB=1024 BENCH_TOTAL_MB=102400 BENCH_BATCH=4096 \
+        BENCH_E2E_MB=16384 BENCH_TPU_WAIT=10800 python bench.py \
+        > .bench/cfg4.json 2> .bench/cfg4.err
+    echo "cfg4 done $(date -u): $(cat .bench/cfg4.json)"
+    exit 0
+  fi
+  echo "recovery2 attempt=$attempt failed $(date -u)"
+  sleep 600
+done
+} >> .bench/auto_chain_r3.log 2>&1

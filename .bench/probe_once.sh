@@ -1,0 +1,24 @@
+#!/bin/bash
+# Shared tunnel probe: one bounded attempt, never killing a mid-grant
+# process. Usage: probe_once.sh <logfile> [max_wait_s]
+# Exit 0 = tunnel computed a round-trip; 1 = failed or abandoned (a probe
+# that hangs past the window is LEFT RUNNING — killing mid-grant work is
+# what wedges the tunnel — and counted as a failure).
+log="${1:?logfile}"
+max="${2:-300}"
+setsid python -u -c "
+import json
+import jax, jax.numpy as jnp
+print(json.dumps({'ok': True, 'sum': int(jnp.sum(jax.device_put(jnp.ones(64))))}))
+" > "$log" 2>&1 &
+pid=$!
+waited=0
+while kill -0 "$pid" 2>/dev/null && [ "$waited" -lt "$max" ]; do
+  sleep 2
+  waited=$((waited + 2))
+done
+if kill -0 "$pid" 2>/dev/null; then
+  echo "# probe pid=$pid still running after ${max}s — abandoned, not killed" >> "$log"
+  exit 1
+fi
+grep -q '"ok": true' "$log"

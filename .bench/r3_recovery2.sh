@@ -1,47 +1,70 @@
 #!/bin/bash
 # Second-wave recovery: waits for the first live queue (r3_live_queue.sh)
-# to exit, then probes every 10 min. On a REAL recovery (probe computes a
-# round-trip), climbs a small-to-large ladder so a short healthy window
-# still banks a publishable record before the risky big configs:
-#   1. headline 512 MiB   (minutes)  -> .bench/headline_small.json
-#   2. v2       512 MiB   (minutes)  -> .bench/cfgv2_small.json
-#   3. headline 2 GiB               -> .bench/headline_final.json
-#   4. v2       2 GiB               -> .bench/cfgv2c.json
-#   5. cfg4     100 GiB (e2e capped) -> .bench/cfg4.json
-# Strictly serialized; nothing killed; every bench child itself waits for
-# the grant (bench.py _await_device) so a mid-window wedge degrades to an
-# honest null, never a CPU number.
+# to exit, then probes every 10 min (bounded, abandon-don't-kill — see
+# probe_once.sh). On a healthy probe it climbs a small-to-large ladder:
+#   headline 512 MiB -> v2 512 MiB -> headline 2 GiB -> v2 2 GiB -> cfg4
+# Rules learned from the round-2/3 tunnel incidents:
+# - a rung whose output file already holds a non-null value is SKIPPED
+#   (a later wedge must never overwrite a banked record with a null);
+# - the climb only proceeds past the first rung if that rung banked a
+#   value — otherwise the probe loop resumes with its window intact;
+# - strictly serialized; bench children themselves wait for the grant
+#   (bench.py _await_device) and emit honest nulls on failure.
 cd /root/repo
+
+banked() {  # $1 = json path: 0 when it already holds a non-null value
+  [ -s "$1" ] && python - "$1" <<'EOF'
+import json, sys
+try:
+    rec = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if rec.get("value") is not None else 1)
+EOF
+}
+
+rung() {  # $1 out.json, rest = env assignments for bench.py
+  local out="$1"; shift
+  if banked "$out"; then
+    echo "skip $out (already banked): $(cat "$out")"
+    return 0
+  fi
+  env "$@" python bench.py > "$out.tmp" 2> "${out%.json}.err"
+  if banked "$out.tmp"; then
+    mv "$out.tmp" "$out"
+  else
+    # keep the null attempt visible without clobbering anything banked
+    [ -s "$out" ] || mv "$out.tmp" "$out"
+  fi
+  echo "$out attempt done $(date -u): $(cat "$out")"
+}
+
 while pgrep -f "r3_live_queue.sh" >/dev/null 2>&1; do sleep 60; done
 {
 echo "=== r3 recovery2 start $(date -u)"
 for attempt in $(seq 1 60); do
-  python -u -c "
-import json
-import jax, jax.numpy as jnp
-print(json.dumps({'ok': True, 'sum': int(jnp.sum(jax.device_put(jnp.ones(64))))}))
-" > .bench/probe_r3b.log 2>&1
-  if grep -q '"ok": true' .bench/probe_r3b.log; then
+  if bash .bench/probe_once.sh .bench/probe_r3b.log 300; then
     echo "recovery2: tunnel alive attempt=$attempt $(date -u)"
-    env BENCH_CONFIG=headline BENCH_TOTAL_MB=512 BENCH_TPU_WAIT=900 python bench.py \
-        > .bench/headline_small.json 2> .bench/headline_small.err
-    echo "headline_small done $(date -u): $(cat .bench/headline_small.json)"
-    env BENCH_CONFIG=v2 BENCH_TOTAL_MB=512 BENCH_TPU_WAIT=900 python bench.py \
-        > .bench/cfgv2_small.json 2> .bench/cfgv2_small.err
-    echo "cfgv2_small done $(date -u): $(cat .bench/cfgv2_small.json)"
-    env BENCH_CONFIG=headline BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=1800 python bench.py \
-        > .bench/headline_final.json 2> .bench/headline_final.err
-    echo "headline done $(date -u): $(cat .bench/headline_final.json)"
-    env BENCH_CONFIG=v2 BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=1800 python bench.py \
-        > .bench/cfgv2c.json 2> .bench/cfgv2c.err
-    echo "cfgv2c done $(date -u): $(cat .bench/cfgv2c.json)"
-    env BENCH_CONFIG=headline BENCH_PIECE_KB=1024 BENCH_TOTAL_MB=102400 BENCH_BATCH=4096 \
-        BENCH_E2E_MB=16384 BENCH_TPU_WAIT=10800 python bench.py \
-        > .bench/cfg4.json 2> .bench/cfg4.err
-    echo "cfg4 done $(date -u): $(cat .bench/cfg4.json)"
-    exit 0
+    rung .bench/headline_small.json BENCH_CONFIG=headline BENCH_TOTAL_MB=512 BENCH_TPU_WAIT=900
+    if ! banked .bench/headline_small.json; then
+      echo "recovery2: first rung banked nothing — resuming probe loop"
+      sleep 600
+      continue
+    fi
+    rung .bench/cfgv2_small.json BENCH_CONFIG=v2 BENCH_TOTAL_MB=512 BENCH_TPU_WAIT=900
+    rung .bench/headline_final.json BENCH_CONFIG=headline BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=1800
+    rung .bench/cfgv2c.json BENCH_CONFIG=v2 BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=1800
+    rung .bench/cfg4.json BENCH_CONFIG=headline BENCH_PIECE_KB=1024 BENCH_TOTAL_MB=102400 \
+         BENCH_BATCH=4096 BENCH_E2E_MB=16384 BENCH_TPU_WAIT=10800
+    if banked .bench/cfg4.json; then
+      echo "=== r3 recovery2 complete $(date -u)"
+      exit 0
+    fi
+    echo "recovery2: ladder incomplete — resuming probe loop"
+  else
+    echo "recovery2 attempt=$attempt failed $(date -u)"
   fi
-  echo "recovery2 attempt=$attempt failed $(date -u)"
   sleep 600
 done
+echo "=== r3 recovery2 exhausted $(date -u)"
 } >> .bench/auto_chain_r3.log 2>&1

@@ -6,11 +6,14 @@
 # what wedges the tunnel — and counted as a failure).
 log="${1:?logfile}"
 max="${2:-300}"
+# unique file per attempt: an ABANDONED earlier probe still holds its fd
+# and could write a late success into a shared log, fooling the grep
+attempt_log="$log.$$"
 setsid python -u -c "
 import json
 import jax, jax.numpy as jnp
 print(json.dumps({'ok': True, 'sum': int(jnp.sum(jax.device_put(jnp.ones(64))))}))
-" > "$log" 2>&1 &
+" > "$attempt_log" 2>&1 &
 pid=$!
 waited=0
 while kill -0 "$pid" 2>/dev/null && [ "$waited" -lt "$max" ]; do
@@ -18,7 +21,12 @@ while kill -0 "$pid" 2>/dev/null && [ "$waited" -lt "$max" ]; do
   waited=$((waited + 2))
 done
 if kill -0 "$pid" 2>/dev/null; then
-  echo "# probe pid=$pid still running after ${max}s — abandoned, not killed" >> "$log"
+  echo "# probe pid=$pid still running after ${max}s — abandoned, not killed" >> "$attempt_log"
+  cp "$attempt_log" "$log" 2>/dev/null
   exit 1
 fi
-grep -q '"ok": true' "$log"
+cp "$attempt_log" "$log" 2>/dev/null  # latest attempt visible at the stable name
+ok=1
+grep -q '"ok": true' "$attempt_log" && ok=0
+rm -f "$attempt_log"
+exit $ok

@@ -34,7 +34,7 @@ rung() {  # $1 out.json, rest = env assignments for bench.py
     mv "$out.tmp" "$out"
   else
     # keep the null attempt visible without clobbering anything banked
-    [ -s "$out" ] || mv "$out.tmp" "$out"
+    if [ -s "$out" ]; then rm -f "$out.tmp"; else mv "$out.tmp" "$out"; fi
   fi
   echo "$out attempt done $(date -u): $(cat "$out")"
 }

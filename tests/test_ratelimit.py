@@ -129,3 +129,66 @@ class TestSessionWiring:
             assert taken == [16384]
 
         run(go())
+
+
+class TestPerTorrentCaps:
+    def test_both_layers_debited_on_serve_and_ingest(self):
+        async def go():
+            t, payload = make_multifile_torrent([2 * PLEN])
+            await asyncio.to_thread(t.storage.set, 0, payload)
+            for i in range(t.info.num_pieces):
+                t.bitfield.set(i)
+
+            taken = {"global_up": [], "own_up": [], "global_down": [], "own_down": []}
+
+            def spy(key):
+                class _Spy:
+                    unlimited = False
+
+                    async def take(self, n):
+                        taken[key].append(n)
+
+                return _Spy()
+
+            t.upload_bucket = spy("global_up")
+            t.own_upload_bucket = spy("own_up")
+            t.download_bucket = spy("global_down")
+            t.own_download_bucket = spy("own_down")
+            peer = _mk_fast_peer(t)
+            peer.am_choking = False
+            await t._serve_request(peer, 0, 0, 16384)
+            assert taken["global_up"] == [16384] and taken["own_up"] == [16384]
+            t.bitfield = type(t.bitfield)(t.info.num_pieces)  # accept ingest
+            await t._ingest_block(peer, 0, 0, payload[:16384])
+            assert taken["global_down"] == [16384] and taken["own_down"] == [16384]
+
+        run(go())
+
+    def test_config_builds_per_torrent_buckets(self):
+        t, _ = make_multifile_torrent([PLEN], max_upload_bps=777, max_download_bps=0)
+        assert t.own_upload_bucket.rate == 777
+        assert t.own_download_bucket.unlimited
+
+    def test_tighter_layer_wins(self):
+        """With a loose global cap and a tight per-torrent cap, pacing
+        follows the tight one. (The refill clock is fake, but a dry
+        bucket's internal pause is a real ~1 s asyncio.sleep — hence
+        the generous wait_for margin.)"""
+
+        async def go():
+            clock = _FakeClock()
+            loose = TokenBucket(10_000, clock=clock)
+            tight = TokenBucket(1_000, clock=clock)
+
+            async def take_both(n):
+                await loose.take(n)
+                await tight.take(n)
+
+            await take_both(1_000)  # burst capacity of the tight bucket
+            waiter = asyncio.ensure_future(take_both(1_000))
+            await asyncio.sleep(0)
+            assert not waiter.done()  # tight bucket is dry
+            clock.now += 1.0
+            await asyncio.wait_for(waiter, 10)
+
+        run(go())

@@ -21,7 +21,7 @@ from tests.test_session import run
 PLEN = 32768
 
 
-def make_multifile_torrent(file_lens, piece_len=PLEN):
+def make_multifile_torrent(file_lens, piece_len=PLEN, **config_kw):
     rng = np.random.default_rng(11)
     payload = rng.integers(0, 256, sum(file_lens), dtype=np.uint8).tobytes()
     pieces = b"".join(
@@ -48,7 +48,7 @@ def make_multifile_torrent(file_lens, piece_len=PLEN):
         storage=Storage(MemoryStorage(), m.info),
         peer_id=generate_peer_id(),
         port=1234,
-        config=TorrentConfig(),
+        config=TorrentConfig(**config_kw),
     )
     return t, payload
 

@@ -48,6 +48,7 @@ from torrent_tpu.storage.piece import (
 )
 from torrent_tpu.storage.storage import Storage, StorageError
 from torrent_tpu.utils.bitfield import Bitfield
+from torrent_tpu.utils.ratelimit import TokenBucket
 from torrent_tpu.utils.log import get_logger
 
 log = get_logger("session.torrent")
@@ -122,6 +123,11 @@ class TorrentConfig:
     # encrypted retry (interops with encryption-requiring peers);
     # 'required' = RC4 only, both directions
     encryption: str = "enabled"
+    # Per-torrent transfer caps in bytes/s (0 = unlimited), layered
+    # UNDER the client-global buckets: a transfer waits on both, so the
+    # tighter of the two limits wins
+    max_upload_bps: int = 0
+    max_download_bps: int = 0
 
     def __post_init__(self):
         if self.encryption not in ("disabled", "enabled", "required"):
@@ -169,6 +175,9 @@ class Torrent:
         self.dht = dht
         self.upload_bucket = upload_bucket
         self.download_bucket = download_bucket
+        # per-torrent caps layered under the client-global buckets
+        self.own_upload_bucket = TokenBucket(self.config.max_upload_bps)
+        self.own_download_bucket = TokenBucket(self.config.max_download_bps)
         self.external_ip = external_ip
         # a CONNECT proxy cannot carry uTP datagrams; racing uTP beside
         # it would leak the peer address around the tunnel
@@ -1941,7 +1950,7 @@ class Torrent:
         peer.last_block_rx = time.monotonic()
         peer.snubbed_until = 0.0  # delivering redeems
         peer.rejects_since_block = 0
-        if self.download_bucket is not None:
+        if self.download_bucket is not None or not self.own_download_bucket.unlimited:
             # pacing inside the peer loop applies TCP backpressure: the
             # reader stops draining this peer until tokens free up. The
             # ``pacing`` flag exempts the peer from the snub sweep for
@@ -1950,7 +1959,9 @@ class Torrent:
             # a delivering peer's requests there would churn duplicates.
             peer.pacing = True
             try:
-                await self.download_bucket.take(len(block))
+                if self.download_bucket is not None:
+                    await self.download_bucket.take(len(block))
+                await self.own_download_bucket.take(len(block))
             finally:
                 peer.pacing = False
                 peer.last_block_rx = time.monotonic()
@@ -2280,6 +2291,7 @@ class Torrent:
             # client-global upload cap; debited only once the block read
             # succeeded so storage errors don't burn cap budget
             await self.upload_bucket.take(length)
+        await self.own_upload_bucket.take(length)  # per-torrent layer
         await proto.send_message(peer.writer, proto.Piece(index, begin, block))
         peer.bytes_up += length
         self.uploaded += length

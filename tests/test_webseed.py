@@ -197,6 +197,133 @@ class TestWebseedDownload:
         run(go())
 
 
+class _Bep17Handler(SimpleHTTPRequestHandler):
+    """Hoffman-style httpseed: GET ?info_hash=...&piece=N → piece bytes."""
+
+    payload = b""
+    piece_len = 32768
+    expected_hash = b""
+    corrupt_piece = None  # optionally serve garbage for one index
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        from urllib.parse import parse_qs, unquote_to_bytes, urlsplit
+
+        q = urlsplit(self.path).query
+        params = parse_qs(q)
+        ih = unquote_to_bytes(
+            urlsplit(self.path).query.split("info_hash=")[1].split("&")[0]
+        )
+        if ih != self.expected_hash:
+            self.send_error(404, "unknown info_hash")
+            return
+        index = int(params["piece"][0])
+        lo = index * self.piece_len
+        data = self.payload[lo : lo + self.piece_len]
+        if index == self.corrupt_piece:
+            data = bytes(len(data))  # zeros: wrong bytes, right size
+        if not data:
+            self.send_error(404, "no such piece")
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+def _bep17_torrent_bytes(payload, base_url, name=b"hs-test", piece_len=32768):
+    return bencode(
+        {
+            b"announce": b"",
+            b"httpseeds": [base_url.encode()],
+            b"info": {
+                b"name": name,
+                b"piece length": piece_len,
+                b"pieces": b"".join(
+                    hashlib.sha1(payload[i : i + piece_len]).digest()
+                    for i in range(0, len(payload), piece_len)
+                ),
+                b"length": len(payload),
+            },
+        }
+    )
+
+
+class TestBep17HttpSeeds:
+    def _serve(self, payload, info_hash, corrupt_piece=None):
+        handler = type(
+            "_H",
+            (_Bep17Handler,),
+            {
+                "payload": payload,
+                "expected_hash": info_hash,
+                "corrupt_piece": corrupt_piece,
+            },
+        )
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        import threading
+
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, f"http://127.0.0.1:{httpd.server_address[1]}/seed.php"
+
+    def test_httpseed_only_download(self, tmp_path):
+        """BEP 17: no tracker, no peers — whole payload over piece-keyed
+        GETs, verified piece by piece."""
+
+        async def go():
+            rng = np.random.default_rng(171)
+            payload = rng.integers(0, 256, size=150_000, dtype=np.uint8).tobytes()
+            tb = _bep17_torrent_bytes(payload, "http://127.0.0.1:1/x")
+            m = parse_metainfo(tb)
+            httpd, url = self._serve(payload, m.info_hash)
+            tb = _bep17_torrent_bytes(payload, url)
+            m = parse_metainfo(tb)
+            assert m.http_seeds == (url,)
+            client = Client(ClientConfig(host="127.0.0.1"))
+            client.config.torrent = fast_config(webseed_retry=0.5)
+            await client.start()
+            try:
+                t = await client.add(m, Storage(MemoryStorage(), m.info))
+                assert t.http_seed_urls == [url]
+                await asyncio.wait_for(t.on_complete.wait(), timeout=30)
+                assert t.storage.get(0, len(payload)) == payload
+            finally:
+                await client.close()
+                httpd.shutdown()
+
+        run(go())
+
+    def test_corrupt_httpseed_never_pollutes_storage(self, tmp_path):
+        """A BEP 17 seed serving a bad piece is retried/disabled like a
+        BEP 19 one; storage only ever holds verified bytes."""
+
+        async def go():
+            rng = np.random.default_rng(172)
+            payload = rng.integers(0, 256, size=98_304, dtype=np.uint8).tobytes()
+            tb = _bep17_torrent_bytes(payload, "http://127.0.0.1:1/x")
+            m = parse_metainfo(tb)
+            # piece 1 always corrupt from this seed
+            httpd, url = self._serve(payload, m.info_hash, corrupt_piece=1)
+            m = parse_metainfo(_bep17_torrent_bytes(payload, url))
+            client = Client(ClientConfig(host="127.0.0.1"))
+            client.config.torrent = fast_config(
+                webseed_retry=0.1, webseed_max_failures=2
+            )
+            await client.start()
+            try:
+                t = await client.add(m, Storage(MemoryStorage(), m.info))
+                await asyncio.sleep(2.0)  # give the loop time to fail out
+                assert t.bitfield.has(0) and t.bitfield.has(2)
+                assert not t.bitfield.has(1)  # never accepted corrupt bytes
+            finally:
+                await client.close()
+                httpd.shutdown()
+
+        run(go())
+
+
 class TestV2Webseed:
     def test_v2_webseed_only_download(self, tmp_path):
         """BEP 19 against a pure-v2 torrent: the aligned piece space maps

@@ -93,6 +93,13 @@ class Metainfo:
         """BEP 19 ``url-list`` (single string or list of strings)."""
         return parse_url_list(self.raw.get(b"url-list"))
 
+    @property
+    def http_seeds(self) -> tuple[str, ...]:
+        """BEP 17 ``httpseeds`` — the older Hoffman-style HTTP seeding
+        where the server speaks ``?info_hash=...&piece=N`` instead of
+        byte-range file GETs."""
+        return parse_url_list(self.raw.get(b"httpseeds"))
+
 
 _FILE_SHAPE = valid.obj(
     {

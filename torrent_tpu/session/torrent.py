@@ -248,10 +248,16 @@ class Torrent:
             [] if proxy is not None
             else [u for u in metainfo.web_seeds if _ws_allowed(u)]
         )
-        if proxy is not None and metainfo.web_seeds:
+        # BEP 17 httpseeds (piece-keyed GETs) ride the same loop with a
+        # different fetcher; same untrusted-URL and proxy-leak guards
+        self.http_seed_urls: list[str] = (
+            [] if proxy is not None
+            else [u for u in metainfo.http_seeds if _ws_allowed(u)]
+        )
+        if proxy is not None and (metainfo.web_seeds or metainfo.http_seeds):
             log.warning(
-                "%d metainfo webseed(s) disabled: SOCKS5 proxy configured",
-                len(metainfo.web_seeds),
+                "%d metainfo web/http seed(s) disabled: SOCKS5 proxy configured",
+                len(metainfo.web_seeds) + len(metainfo.http_seeds),
             )
         # serve-path LRU of whole pieces (dict ordering = recency) and
         # in-flight reads shared by concurrent misses on the same piece
@@ -378,8 +384,7 @@ class Torrent:
             # interval before discovering anyone to fetch from
             self.state = TorrentState.DOWNLOADING
             self.on_complete.clear()
-            for url in self.web_seed_urls:
-                self._spawn(self._webseed_loop(url), name=f"webseed-{url[:24]}")
+            self._spawn_seed_loops()
             self.request_peers()
         for peer in list(self.peers.values()):
             try:
@@ -552,8 +557,7 @@ class Torrent:
         self._spawn(self._keepalive_loop(), name="keepalive")
         if not self.private:
             self._spawn(self._pex_loop(), name="pex")
-        for url in self.web_seed_urls:
-            self._spawn(self._webseed_loop(url), name=f"webseed-{url[:24]}")
+        self._spawn_seed_loops()
 
     def add_web_seed(self, url: str) -> bool:
         """Attach a BEP 19 webseed at runtime (e.g. a magnet's ``ws=``).
@@ -2438,16 +2442,37 @@ class Torrent:
                 break
         return picked
 
-    async def _webseed_loop(self, url: str) -> None:
-        """BEP 19: fill missing pieces from an HTTP seed; every fetched
-        piece passes the same verify→persist→have path as wire pieces.
+    def _spawn_seed_loops(self) -> None:
+        """Start one fetch loop per BEP 19 webseed and BEP 17 httpseed."""
+        for url in self.web_seed_urls:
+            self._spawn(self._webseed_loop(url), name=f"webseed-{url[:24]}")
+        for url in self.http_seed_urls:
+            self._spawn(
+                self._webseed_loop(url, bep17=True), name=f"httpseed-{url[:24]}"
+            )
+
+    async def _webseed_loop(self, url: str, bep17: bool = False) -> None:
+        """BEP 19 (byte-range) / BEP 17 (piece-keyed) HTTP seeding: fill
+        missing pieces from an HTTP seed; every fetched piece passes the
+        same verify→persist→have path as wire pieces.
 
         A webseed serving corrupt data has no wire contributors for the
         strike system to ban, so the loop tracks consecutive hash
         failures itself: backoff per failure, URL disabled at the
         configured threshold (a hot refetch loop otherwise).
         """
-        from torrent_tpu.session.webseed import WebSeedError, fetch_piece
+        from torrent_tpu.session.webseed import (
+            WebSeedError,
+            fetch_piece,
+            fetch_piece_bep17,
+        )
+
+        if bep17:
+            def fetch(index: int) -> bytes:
+                return fetch_piece_bep17(url, self.metainfo.info_hash, self.info, index)
+        else:
+            def fetch(index: int) -> bytes:
+                return fetch_piece(url, self.storage, self.info, index)
 
         consecutive_failures = 0
         while not self._stopping and self._wanted_remaining():
@@ -2471,10 +2496,7 @@ class Torrent:
                 reserved.append(partial)
             try:
                 datas = await asyncio.gather(
-                    *(
-                        asyncio.to_thread(fetch_piece, url, self.storage, self.info, p.index)
-                        for p in reserved
-                    )
+                    *(asyncio.to_thread(fetch, p.index) for p in reserved)
                 )
             except WebSeedError as e:
                 for p in reserved:

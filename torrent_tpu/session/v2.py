@@ -87,6 +87,13 @@ class V2SessionMeta:
 
         return parse_url_list(self.raw.get(b"url-list"))
 
+    @property
+    def http_seeds(self) -> tuple[str, ...]:
+        """BEP 17 ``httpseeds`` (piece-keyed GETs) — same parsing as v1."""
+        from torrent_tpu.codec.metainfo import parse_url_list
+
+        return parse_url_list(self.raw.get(b"httpseeds"))
+
 
 def _pad_target(length: int) -> int:
     """Leaf-pad target for a file no larger than one piece: the next

@@ -14,6 +14,7 @@ GET per file segment.
 
 from __future__ import annotations
 
+import http.client
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -69,7 +70,7 @@ def fetch_range(url: str, start: int, length: int) -> bytes:
             if resp.status not in (200, 206):
                 raise WebSeedError(f"{url}: HTTP {resp.status}")
             data = resp.read(length + 1)
-    except (urllib.error.URLError, OSError, TimeoutError) as e:
+    except (urllib.error.URLError, http.client.HTTPException, OSError, TimeoutError) as e:
         raise WebSeedError(f"{url}: {e}") from e
     if resp.status == 200:
         # server ignored the Range header; BEP 19 servers shouldn't, and
@@ -92,3 +93,29 @@ def fetch_piece(base: str, storage: Storage, info: InfoDict, index: int) -> byte
             continue
         out += fetch_range(url_for(base, info, path), foff, chunk)
     return bytes(out)
+
+
+def fetch_piece_bep17(url: str, info_hash: bytes, info: InfoDict, index: int) -> bytes:
+    """BEP 17 httpseed GET: ``{url}?info_hash=<%-escaped>&piece=N``.
+
+    The Hoffman protocol serves whole pieces keyed by infohash rather
+    than file byte ranges (BEP 19); the response body IS the piece."""
+    from torrent_tpu.storage.piece import piece_length
+
+    sep = "&" if urllib.parse.urlsplit(url).query else "?"
+    get = (
+        f"{url}{sep}info_hash={urllib.parse.quote_from_bytes(info_hash)}"
+        f"&piece={index}"
+    )
+    plen = piece_length(info, index)
+    req = urllib.request.Request(get, headers={"User-Agent": "torrent-tpu/0.1"})
+    try:
+        with urllib.request.urlopen(req, timeout=FETCH_TIMEOUT) as resp:
+            if resp.status != 200:
+                raise WebSeedError(f"{url}: HTTP {resp.status}")
+            data = resp.read(plen + 1)
+    except (urllib.error.URLError, http.client.HTTPException, OSError, TimeoutError) as e:
+        raise WebSeedError(f"{url}: {e}") from e
+    if len(data) != plen:
+        raise WebSeedError(f"{url}: piece {index} wrong size {len(data)}/{plen}")
+    return data

@@ -302,3 +302,17 @@ class TestCli:
         assert a2.no_trackers and a2.peer == ["h:1"]
         a3 = p.parse_args(["make", "p", "http://t/a", "--v2"])
         assert a3.v2 and not a3.hybrid
+        a4 = p.parse_args(
+            [
+                "download", "x.torrent", "d",
+                "--encryption", "required",
+                "--proxy", "socks5://127.0.0.1:1080",
+                "--stream-port", "0",
+                "--metrics-port", "0",
+            ]
+        )
+        assert a4.encryption == "required"
+        assert a4.proxy == "socks5://127.0.0.1:1080"
+        assert a4.stream_port == 0 and a4.metrics_port == 0
+        a5 = p.parse_args(["scrape", "--proxy", "socks5://h:1", "--torrent", "t"])
+        assert a5.proxy == "socks5://h:1"

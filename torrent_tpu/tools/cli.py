@@ -362,6 +362,15 @@ async def _download(args) -> int:
                 )
                 await asyncio.sleep(1)
 
+        metrics_server = None
+        if getattr(args, "metrics_port", None) is not None:
+            from torrent_tpu.utils.metrics import MetricsServer
+
+            metrics_server = await MetricsServer(client).start(args.metrics_port)
+            print(
+                f"metrics http://127.0.0.1:{metrics_server.port}/metrics",
+                file=sys.stderr,
+            )
         stream_server = None
         if getattr(args, "stream_port", None) is not None:
             from torrent_tpu.tools.stream import StreamServer
@@ -395,6 +404,8 @@ async def _download(args) -> int:
         stop_wait.cancel()
         if stream_server is not None:
             stream_server.close()
+        if metrics_server is not None:
+            metrics_server.close()
         return 0 if torrent.on_complete.is_set() else 130
     finally:
         await client.close()
@@ -561,6 +572,13 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="PORT",
         help="serve files over HTTP (Range-capable) WHILE downloading; "
         "the reader position steers piece priority (0 = ephemeral port)",
+    )
+    sp.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="Prometheus /metrics endpoint for session counters (0 = ephemeral)",
     )
     sp.add_argument(
         "--files",

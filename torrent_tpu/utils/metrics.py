@@ -1,0 +1,137 @@
+"""Prometheus-format metrics endpoint for a running Client.
+
+SURVEY §5's observability row, made scrapeable: ``GET /metrics`` renders
+the session counters (`Client.status()` and per-torrent `status()`) in
+the Prometheus text exposition format, so standard collectors can graph
+swarm health without any custom integration. Read-only, allocation-
+light (one render per scrape), and independent of the bridge sidecar —
+this watches the SESSION, the bridge watches the hash plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from torrent_tpu.utils.log import get_logger
+
+log = get_logger("utils.metrics")
+
+
+def _esc(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render_metrics(client) -> str:
+    """The /metrics payload for one Client (Prometheus text format 0.0.4)."""
+    lines = [
+        "# HELP torrent_tpu_torrents Torrents registered in this client",
+        "# TYPE torrent_tpu_torrents gauge",
+        f"torrent_tpu_torrents {len(client.torrents)}",
+        "# HELP torrent_tpu_peers Connected peers across all torrents",
+        "# TYPE torrent_tpu_peers gauge",
+        f"torrent_tpu_peers {sum(len(t.peers) for t in client.torrents.values())}",
+        "# HELP torrent_tpu_downloaded_bytes_total Payload bytes downloaded",
+        "# TYPE torrent_tpu_downloaded_bytes_total counter",
+        f"torrent_tpu_downloaded_bytes_total {sum(t.downloaded for t in client.torrents.values())}",
+        "# HELP torrent_tpu_uploaded_bytes_total Payload bytes uploaded",
+        "# TYPE torrent_tpu_uploaded_bytes_total counter",
+        f"torrent_tpu_uploaded_bytes_total {sum(t.uploaded for t in client.torrents.values())}",
+    ]
+    per_torrent = [
+        ("torrent_tpu_torrent_peers", "gauge", "Connected peers", lambda t: len(t.peers)),
+        (
+            "torrent_tpu_torrent_pieces_have",
+            "gauge",
+            "Verified pieces on disk",
+            lambda t: t.bitfield.count(),
+        ),
+        (
+            "torrent_tpu_torrent_pieces_total",
+            "gauge",
+            "Pieces in the torrent",
+            lambda t: t.info.num_pieces,
+        ),
+        (
+            "torrent_tpu_torrent_left_bytes",
+            "gauge",
+            "Wanted bytes not yet verified",
+            lambda t: t.left,
+        ),
+        (
+            "torrent_tpu_torrent_downloaded_bytes_total",
+            "counter",
+            "Payload bytes downloaded",
+            lambda t: t.downloaded,
+        ),
+        (
+            "torrent_tpu_torrent_uploaded_bytes_total",
+            "counter",
+            "Payload bytes uploaded",
+            lambda t: t.uploaded,
+        ),
+    ]
+    for name, kind, help_text, get in per_torrent:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        for ih, t in client.torrents.items():
+            labels = f'info_hash="{ih.hex()}",name="{_esc(str(t.info.name))}"'
+            lines.append(f"{name}{{{labels}}} {get(t)}")
+    # state as a labeled 0/1 family (the Prometheus idiom for enums)
+    lines.append("# HELP torrent_tpu_torrent_state Torrent lifecycle state (1 = current)")
+    lines.append("# TYPE torrent_tpu_torrent_state gauge")
+    for ih, t in client.torrents.items():
+        current = t.state.name.lower()
+        for state in ("stopped", "checking", "downloading", "seeding"):
+            lines.append(
+                f'torrent_tpu_torrent_state{{info_hash="{ih.hex()}",state="{state}"}} '
+                f"{1 if state == current else 0}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """``GET /metrics`` for one Client. Anything else is 404."""
+
+    def __init__(self, client, host: str = "127.0.0.1"):
+        self.client = client
+        self.host = host
+        self.port: int | None = None
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self, port: int = 0) -> "MetricsServer":
+        self._server = await asyncio.start_server(self._handle, self.host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            request = await asyncio.wait_for(reader.readline(), timeout=10)
+            while True:
+                line = await asyncio.wait_for(reader.readline(), timeout=10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            parts = request.split()
+            if len(parts) >= 2 and parts[0] == b"GET" and parts[1].split(b"?")[0] == b"/metrics":
+                body = render_metrics(self.client).encode()
+                status = "200 OK"
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
+                body = b"not found\n"
+                status = "404 Not Found"
+                ctype = "text/plain"
+            writer.write(
+                (
+                    f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+                ).encode("latin-1")
+                + body
+            )
+            await writer.drain()
+        except (ConnectionError, asyncio.TimeoutError, asyncio.LimitOverrunError, ValueError, OSError):
+            pass
+        finally:
+            writer.close()

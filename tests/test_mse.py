@@ -310,6 +310,94 @@ async def _transfer(seed_policy: str, leech_policy: str, timeout=30):
         await asyncio.wait_for(pump, 5)
 
 
+class TestMseOverUtp:
+    def test_required_encryption_over_utp_transport(self):
+        """MSE composes with the uTP transport: both sides RC4-only AND
+        uTP-enabled; the winning connection carries RC4 over the
+        reliable-UDP stream."""
+
+        async def go():
+            from torrent_tpu.net.utp import _UtpWriter
+
+            rng = np.random.default_rng(29)
+            payload = rng.integers(0, 256, size=120_000, dtype=np.uint8).tobytes()
+            server, pump, announce_url = await _start_tracker()
+            m = _make_swarm_meta(payload, announce_url)
+            seed = Client(ClientConfig(host="127.0.0.1", enable_utp=True))
+            leech = Client(ClientConfig(host="127.0.0.1", enable_utp=True))
+            seed.config.torrent = fast_config(encryption="required")
+            leech.config.torrent = fast_config(encryption="required")
+            await seed.start()
+            await leech.start()
+            try:
+                ss = Storage(MemoryStorage(), m.info)
+                for off in range(0, len(payload), 65536):
+                    ss.set(off, payload[off : off + 65536])
+                t_seed = await seed.add(m, ss)
+                t_leech = await leech.add(m, Storage(MemoryStorage(), m.info))
+                await asyncio.wait_for(t_leech.on_complete.wait(), timeout=30)
+                assert t_leech.storage.get(0, len(payload)) == payload
+                # at least one side's connection is RC4-wrapped over uTP
+                writers = [
+                    p.writer
+                    for t in (t_seed, t_leech)
+                    for p in t.peers.values()
+                ]
+                assert any(
+                    isinstance(w, mse.WrappedWriter)
+                    and isinstance(w._w, _UtpWriter)
+                    for w in writers
+                ), [type(w).__name__ for w in writers]
+            finally:
+                await seed.close()
+                await leech.close()
+                server.close()
+                await asyncio.wait_for(pump, 5)
+
+        run(go(), timeout=60)
+
+
+class TestInboundGarbage:
+    def test_garbage_floods_never_crash_the_accept_path(self):
+        """Random bytes to the listener (neither BT nor valid MSE) must
+        be dropped without harming later legitimate connections."""
+
+        async def go():
+            rng = np.random.default_rng(31)
+            payload = rng.integers(0, 256, size=65536, dtype=np.uint8).tobytes()
+            server, pump, announce_url = await _start_tracker()
+            m = _make_swarm_meta(payload, announce_url)
+            client = Client(ClientConfig(host="127.0.0.1"))
+            client.config.torrent = fast_config()
+            await client.start()
+            try:
+                st = Storage(MemoryStorage(), m.info)
+                st.set(0, payload)
+                await client.add(m, st)
+                for size in (1, 19, 20, 96, 300, 2000):
+                    r, w = await asyncio.open_connection("127.0.0.1", client.port)
+                    w.write(rng.integers(0, 256, size=size, dtype=np.uint8).tobytes())
+                    try:
+                        await w.drain()
+                        w.close()
+                    except (ConnectionError, OSError):
+                        pass
+                await asyncio.sleep(0.2)
+                # the listener is still healthy: a real MSE join succeeds
+                r, w = await asyncio.open_connection("127.0.0.1", client.port)
+                rr, ww, sel = await asyncio.wait_for(
+                    mse.initiate(r, w, m.info_hash), timeout=10
+                )
+                assert sel == mse.CRYPTO_RC4
+                ww.close()
+            finally:
+                await client.close()
+                server.close()
+                await asyncio.wait_for(pump, 5)
+
+        run(go(), timeout=60)
+
+
 class TestSwarmEncryption:
     def test_required_to_required(self):
         """Both sides RC4-only: every connection is fully encrypted."""

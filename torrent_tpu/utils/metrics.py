@@ -22,20 +22,25 @@ def _esc(value: str) -> str:
 
 
 def render_metrics(client) -> str:
-    """The /metrics payload for one Client (Prometheus text format 0.0.4)."""
+    """The /metrics payload for one Client (Prometheus text format 0.0.4).
+
+    Session-level figures come from ``Client.status()`` — the single
+    aggregation every status surface shares — so /metrics can never
+    silently diverge from it."""
+    status = client.status()
     lines = [
         "# HELP torrent_tpu_torrents Torrents registered in this client",
         "# TYPE torrent_tpu_torrents gauge",
         f"torrent_tpu_torrents {len(client.torrents)}",
         "# HELP torrent_tpu_peers Connected peers across all torrents",
         "# TYPE torrent_tpu_peers gauge",
-        f"torrent_tpu_peers {sum(len(t.peers) for t in client.torrents.values())}",
+        f"torrent_tpu_peers {status['peers']}",
         "# HELP torrent_tpu_downloaded_bytes_total Payload bytes downloaded",
         "# TYPE torrent_tpu_downloaded_bytes_total counter",
-        f"torrent_tpu_downloaded_bytes_total {sum(t.downloaded for t in client.torrents.values())}",
+        f"torrent_tpu_downloaded_bytes_total {status['downloaded']}",
         "# HELP torrent_tpu_uploaded_bytes_total Payload bytes uploaded",
         "# TYPE torrent_tpu_uploaded_bytes_total counter",
-        f"torrent_tpu_uploaded_bytes_total {sum(t.uploaded for t in client.torrents.values())}",
+        f"torrent_tpu_uploaded_bytes_total {status['uploaded']}",
     ]
     per_torrent = [
         ("torrent_tpu_torrent_peers", "gauge", "Connected peers", lambda t: len(t.peers)),
@@ -97,15 +102,24 @@ class MetricsServer:
         self.host = host
         self.port: int | None = None
         self._server: asyncio.AbstractServer | None = None
+        self._handlers: set[asyncio.Task] = set()
 
     async def start(self, port: int = 0) -> "MetricsServer":
-        self._server = await asyncio.start_server(self._handle, self.host, port)
+        self._server = await asyncio.start_server(self._accept, self.host, port)
         self.port = self._server.sockets[0].getsockname()[1]
         return self
+
+    def _accept(self, reader, writer):
+        # tracked so close() can cancel a stalled scraper's handler
+        task = asyncio.ensure_future(self._handle(reader, writer))
+        self._handlers.add(task)
+        task.add_done_callback(self._handlers.discard)
 
     def close(self) -> None:
         if self._server is not None:
             self._server.close()
+        for task in list(self._handlers):
+            task.cancel()
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:

@@ -1,12 +1,17 @@
 """Proof-of-concept CLI tests (reference roadmap README.md:36 — untested
 there; here the make → info → verify → download pipeline runs for real)."""
 
+import asyncio
+import os
 import sys
 
 import numpy as np
 import pytest
 
 from torrent_tpu.tools.cli import main
+from tests.test_session import run
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture
@@ -316,3 +321,80 @@ class TestCli:
         assert a4.stream_port == 0 and a4.metrics_port == 0
         a5 = p.parse_args(["scrape", "--proxy", "socks5://h:1", "--torrent", "t"])
         assert a5.proxy == "socks5://h:1"
+        a6 = p.parse_args(
+            ["seed", "tdir", "ddir", "--metrics-port", "0", "--encryption", "required"]
+        )
+        assert a6.torrents == "tdir" and a6.data == "ddir"
+        assert a6.metrics_port == 0 and a6.encryption == "required"
+
+
+def test_seed_box_serves_directory_of_torrents(tmp_path):
+    """`torrent-tpu seed` as a subprocess: two torrents in one directory,
+    both downloadable by a client pointed at the box."""
+    import re
+    import subprocess
+
+    import numpy as np
+
+    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.server.in_memory import run_tracker
+    from torrent_tpu.server.tracker import ServeOptions
+    from torrent_tpu.session.client import Client, ClientConfig
+    from torrent_tpu.session.torrent import TorrentConfig
+    from torrent_tpu.storage.storage import MemoryStorage, Storage
+    from tests.test_session import build_torrent_bytes, fast_config
+
+    async def go():
+        server, pump = await run_tracker(
+            ServeOptions(http_port=0, udp_port=None, host="127.0.0.1", interval=1)
+        )
+        url = f"http://127.0.0.1:{server.http_port}/announce"
+        tdir = tmp_path / "torrents"
+        ddir = tmp_path / "data"
+        tdir.mkdir()
+        ddir.mkdir()
+        rng = np.random.default_rng(83)
+        metas = []
+        for name in (b"box-a.bin", b"box-b.bin"):
+            payload = rng.integers(0, 256, size=100_000, dtype=np.uint8).tobytes()
+            tb = build_torrent_bytes(payload, 32768, url.encode(), name=name)
+            (tdir / (name.decode() + ".torrent")).write_bytes(tb)
+            (ddir / name.decode()).write_bytes(payload)
+            metas.append((parse_metainfo(tb), payload))
+
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable,
+            "-m",
+            "torrent_tpu.tools.cli",
+            "seed",
+            str(tdir),
+            str(ddir),
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+            env=dict(os.environ, PYTHONPATH=REPO),
+        )
+        try:
+            while True:
+                raw = await asyncio.wait_for(proc.stderr.readline(), 30)
+                assert raw, f"seed box exited early: {await proc.stderr.read()}"
+                line = raw.decode()
+                m = re.search(r"seeding 2 torrent\(s\) on port (\d+)", line)
+                if m:
+                    break
+            leech = Client(ClientConfig(host="127.0.0.1"))
+            leech.config.torrent = fast_config()
+            await leech.start()
+            try:
+                for meta, payload in metas:
+                    t = await leech.add(meta, Storage(MemoryStorage(), meta.info))
+                    await asyncio.wait_for(t.on_complete.wait(), timeout=30)
+                    assert t.storage.get(0, len(payload)) == payload
+            finally:
+                await leech.close()
+        finally:
+            proc.terminate()
+            await proc.wait()
+            server.close()
+            await asyncio.wait_for(pump, 5)
+
+    run(go())

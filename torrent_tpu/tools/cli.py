@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import signal
 import sys
 
@@ -286,6 +287,111 @@ def _cmd_verify(args) -> int:
         print(f"first invalid pieces: {bad[:10]}")
         return 2
     return 0
+
+
+async def _seed_box(args) -> int:
+    """Seed every .torrent in a directory against one data root — the
+    long-running "seeding box" mode (no reference counterpart; its CLI
+    roadmap stopped at a single-torrent proof of concept)."""
+    import glob
+
+    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+    from torrent_tpu.session.client import Client, ClientConfig
+
+    torrent_files = sorted(glob.glob(os.path.join(args.torrents, "*.torrent")))
+    if not torrent_files:
+        print(f"error: no .torrent files in {args.torrents!r}", file=sys.stderr)
+        return 1
+    config = ClientConfig(
+        port=args.port,
+        hasher=args.hasher,
+        max_upload_bps=args.max_up * 1024,
+        enable_lsd=args.lsd,
+        enable_utp=args.utp,
+    )
+    if args.encryption:
+        config.torrent.encryption = args.encryption
+    if args.super_seed:
+        config.torrent.super_seed = True
+    client = Client(config)
+    await client.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover - non-unix
+            pass
+    metrics_server = None
+    try:
+        added = 0
+        for path in torrent_files:
+            if stop.is_set():
+                # ctrl-c during a long recheck pass must not be absorbed
+                # until the whole library has been hashed
+                print("\ninterrupted during startup", file=sys.stderr)
+                return 130
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError as e:
+                print(f"skipping {path}: {e}", file=sys.stderr)
+                continue
+            m = parse_metainfo(data) or parse_metainfo_v2(data)
+            if m is None:
+                print(f"skipping {path}: not a valid .torrent", file=sys.stderr)
+                continue
+            try:
+                t = await client.add(m, args.data)
+            except ValueError as e:  # duplicate infohash etc.
+                print(f"skipping {path}: {e}", file=sys.stderr)
+                continue
+            have = t.bitfield.count()
+            print(
+                f"seeding {os.path.basename(path)}: {have}/{t.info.num_pieces} pieces",
+                file=sys.stderr,
+            )
+            added += 1
+        if not added:
+            print("error: nothing to seed", file=sys.stderr)
+            return 1
+        if args.metrics_port is not None:
+            from torrent_tpu.utils.metrics import MetricsServer
+
+            metrics_server = await MetricsServer(client).start(args.metrics_port)
+            print(
+                f"metrics http://127.0.0.1:{metrics_server.port}/metrics",
+                file=sys.stderr,
+            )
+        print(
+            f"seeding {added} torrent(s) on port {client.port} (ctrl-c to stop)",
+            file=sys.stderr,
+        )
+
+        async def report():
+            while not stop.is_set():
+                s = client.status()
+                print(
+                    f"\rpeers {s['peers']} up {s['uploaded']:,} down {s['downloaded']:,}   ",
+                    end="",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                await asyncio.sleep(2)
+
+        reporter = asyncio.ensure_future(report())
+        await stop.wait()
+        reporter.cancel()
+        return 0
+    finally:
+        if metrics_server is not None:
+            metrics_server.close()
+        await client.close()
+
+
+def _cmd_seed(args) -> int:
+    return asyncio.run(_seed_box(args))
 
 
 async def _download(args) -> int:
@@ -626,6 +732,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--torrent", help=".torrent whose tracker + hash to scrape")
     sp.add_argument("info_hash", nargs="*", help="40-hex info hashes")
     sp.set_defaults(fn=_cmd_scrape)
+
+    sp = sub.add_parser(
+        "seed", help="seed every .torrent in a directory (seeding-box mode)"
+    )
+    sp.add_argument("torrents", help="directory of .torrent files")
+    sp.add_argument("data", help="data root the torrents' content lives under")
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--hasher", choices=("cpu", "tpu"), default="cpu")
+    sp.add_argument("--max-up", type=int, default=0, metavar="KiB/s")
+    sp.add_argument("--lsd", action="store_true", help="BEP 14 local discovery")
+    sp.add_argument("--utp", action="store_true", help="BEP 29 uTP transport")
+    sp.add_argument(
+        "--encryption", choices=("disabled", "enabled", "required"), default=""
+    )
+    sp.add_argument("--super-seed", action="store_true", help="BEP 16 on every torrent")
+    sp.add_argument("--metrics-port", type=int, default=None, metavar="PORT")
+    sp.set_defaults(fn=_cmd_seed)
 
     sp = sub.add_parser("tracker", help="run the in-memory tracker server")
     sp.add_argument("--http-port", type=int, default=8080)

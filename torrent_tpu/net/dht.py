@@ -1084,6 +1084,13 @@ class DHTNode:
                         if item is not None:
                             found_items.append(item)
                         return nodes
+                    if mode == "scrape":
+                        blooms, nodes, token = await self._scrape_visit(addr, target)
+                        if token:
+                            tokens[addr] = token
+                        if blooms != (None, None):
+                            found_items.append(blooms)
+                        return nodes
                     return await self.find_node(addr, target)
                 except DHTRemoteError:
                     # an error reply proves liveness (e.g. 204 from a
@@ -1259,9 +1266,8 @@ class DHTNode:
 
     # --------------------------------------- BEP 33 scrape / BEP 51 sample
 
-    async def scrape_rpc(self, addr, info_hash: bytes):
-        """One scraping get_peers → (seed bloom, downloader bloom) or
-        (None, None) when the node doesn't implement BEP 33."""
+    async def _scrape_visit(self, addr, info_hash: bytes):
+        """One scraping get_peers → ((BFsd, BFpe), closer_nodes, token)."""
         r = await self._query(
             addr,
             "get_peers",
@@ -1273,23 +1279,28 @@ class DHTNode:
             out.append(
                 ScrapeBloom(raw) if isinstance(raw, bytes) and len(raw) == 256 else None
             )
-        return out[0], out[1]
+        token = r.get(b"token")
+        return (
+            (out[0], out[1]),
+            self._merge_nodes(r),
+            token if isinstance(token, bytes) else None,
+        )
+
+    async def scrape_rpc(self, addr, info_hash: bytes):
+        """One scraping get_peers → (seed bloom, downloader bloom) or
+        (None, None) when the node doesn't implement BEP 33."""
+        blooms, _, _ = await self._scrape_visit(addr, info_hash)
+        return blooms
 
     async def scrape_swarm(self, info_hash: bytes) -> tuple[float, float]:
-        """BEP 33 swarm-size estimate: converge on the infohash, scrape
-        the closest nodes, union their blooms (statistical de-dup), and
-        return (≈seeds, ≈downloaders)."""
-        _, closest, _, _, _ = await self._iterative(info_hash, "peers")
+        """BEP 33 swarm-size estimate: one scraping convergence (every
+        get_peers in the walk carries scrape=1, so the closest nodes'
+        blooms arrive with the lookup itself — no second RPC round),
+        blooms unioned for a statistically de-duplicated
+        (≈seeds, ≈downloaders)."""
+        _, _, _, _, bloom_pairs = await self._iterative(info_hash, "scrape")
         bf_seed, bf_down = ScrapeBloom(), ScrapeBloom()
-
-        async def one(addr):
-            try:
-                return await self.scrape_rpc(addr, info_hash)
-            except DHTError:
-                return None, None
-
-        # concurrent: dead nodes must not serialize RPC_TIMEOUT each
-        for sd, pe in await asyncio.gather(*(one(a) for a in closest)):
+        for sd, pe in bloom_pairs:
             if sd is not None:
                 bf_seed.union(sd)
             if pe is not None:

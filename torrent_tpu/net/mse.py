@@ -300,26 +300,47 @@ async def initiate(
 
     # B replies Yb | PadB (plain) then E_b(VC | ...). The encrypted VC is
     # the first 8 post-discard keystream bytes (VC is zeros), a fixed
-    # pattern we can scan for past the unknown-length pad.
+    # pattern we can scan for past the unknown-length pad. Scanning in
+    # chunks (not byte-per-await) keeps the handshake to a few event-loop
+    # round-trips; over-read bytes become the post-handshake prefix.
     sync = dec.crypt(VC)
-    window = await reader.readexactly(len(sync))
-    scanned = 0
-    while window != sync:
-        if scanned >= _MAX_PAD:
+    buf = bytearray(await reader.readexactly(len(sync)))
+    while True:
+        idx = bytes(buf).find(sync)
+        if idx >= 0:
+            del buf[: idx + len(sync)]
+            break
+        if len(buf) > _MAX_PAD + len(sync):
             raise MseError("encrypted VC not found")
-        window = window[1:] + await reader.readexactly(1)
-        scanned += 1
+        chunk = await reader.read(256)
+        if not chunk:
+            raise MseError("connection closed during VC sync")
+        buf += chunk
 
-    select = int.from_bytes(dec.crypt(await reader.readexactly(4)), "big")
-    pad_d = int.from_bytes(dec.crypt(await reader.readexactly(2)), "big")
+    async def take(n: int) -> bytes:
+        while len(buf) < n:
+            buf.extend(await reader.readexactly(n - len(buf)))
+        out = bytes(buf[:n])
+        del buf[:n]
+        return out
+
+    select = int.from_bytes(dec.crypt(await take(4)), "big")
+    pad_d = int.from_bytes(dec.crypt(await take(2)), "big")
     if pad_d > _MAX_PAD:
         raise MseError("oversized PadD")
     if pad_d:
-        dec.crypt(await reader.readexactly(pad_d))
+        dec.crypt(await take(pad_d))
 
+    leftover = bytes(buf)
     if select == CRYPTO_RC4 and allow_rc4:
-        return WrappedReader(reader, dec), WrappedWriter(writer, enc), select
+        return (
+            WrappedReader(reader, dec, prefix=dec.crypt(leftover)),
+            WrappedWriter(writer, enc),
+            select,
+        )
     if select == CRYPTO_PLAIN and allow_plaintext:
+        if leftover:
+            return WrappedReader(reader, None, prefix=leftover), writer, select
         return reader, writer, select
     raise MseError(f"peer selected unsupported method {select:#x}")
 
@@ -353,7 +374,7 @@ async def respond(
     writer.write(pub + _pad())
     await writer.drain()
 
-    # sync on HASH('req1'|S) past PadA
+    # sync on HASH('req1'|S) past PadA — chunked reads, not byte-per-await
     req1 = _sha1(b"req1", s)
     while True:
         idx = bytes(buf).find(req1)
@@ -362,7 +383,10 @@ async def respond(
             break
         if len(buf) > _MAX_PAD + len(req1):
             raise MseError("req1 sync not found")
-        buf += await reader.readexactly(1)
+        chunk = await reader.read(256)
+        if not chunk:
+            raise MseError("connection closed during req1 sync")
+        buf += chunk
 
     async def take(n: int) -> bytes:
         while len(buf) < n:

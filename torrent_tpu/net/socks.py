@@ -56,7 +56,14 @@ def _connect_request(host: str, port: int) -> bytes:
         ip = ipaddress.ip_address(host)
         addr = (b"\x01" if ip.version == 4 else b"\x04") + ip.packed
     except ValueError:
-        raw = host.encode("idna")
+        try:
+            raw = host.encode("idna")
+        except UnicodeError as e:
+            # UnicodeError is a ValueError, not an OSError: it would
+            # escape every caller's dial error handling and kill
+            # announce/dial tasks (the proxyless path fails the same
+            # name as a catchable gaierror)
+            raise ProxyError(f"hostname not encodable for SOCKS5: {host!r}") from e
         if len(raw) > 255:
             raise ProxyError(f"hostname too long for SOCKS5: {host!r}")
         addr = b"\x03" + bytes([len(raw)]) + raw

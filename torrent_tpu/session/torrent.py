@@ -466,11 +466,12 @@ class Torrent:
         self, offset: int, window_pieces: int = 8, token: object = "default"
     ) -> None:
         """Point the scheduler at a reader position: the next
-        ``window_pieces`` wanted pieces from ``offset`` jump to maximum
-        priority (127), and pieces the reader moved past fall back to
-        their pre-boost priority. Random seeks (HTTP Range requests)
-        re-point the window instantly; deselected (priority-0) pieces
-        are never boosted — streaming doesn't widen the selection.
+        ``window_pieces`` pieces from ``offset`` (including any already
+        on disk — the window is positional) jump to maximum priority
+        (127), and pieces the reader moved past fall back to their
+        pre-boost priority. Random seeks (HTTP Range requests) re-point
+        the window instantly; deselected (priority-0) pieces are never
+        boosted — streaming doesn't widen the selection.
 
         ``token`` names the reader: concurrent readers (players open a
         head and a tail connection at once) each hold a window and the

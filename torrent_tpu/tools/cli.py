@@ -422,7 +422,12 @@ async def _download(args) -> int:
         config.torrent.super_seed = True
     if getattr(args, "encryption", None):
         config.torrent.encryption = args.encryption
-    client = Client(config)
+    try:
+        client = Client(config)
+    except ValueError as e:
+        # e.g. --proxy with --dht/--lsd: a clean CLI error, not a traceback
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     await client.start()
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -431,6 +436,7 @@ async def _download(args) -> int:
             loop.add_signal_handler(sig, stop.set)
         except NotImplementedError:  # pragma: no cover - non-unix
             pass
+    stream_server = metrics_server = None
     try:
         if args.source.startswith("magnet:"):
             print("fetching metadata from swarm...", file=sys.stderr)
@@ -468,7 +474,6 @@ async def _download(args) -> int:
                 )
                 await asyncio.sleep(1)
 
-        metrics_server = None
         if getattr(args, "metrics_port", None) is not None:
             from torrent_tpu.utils.metrics import MetricsServer
 
@@ -477,7 +482,6 @@ async def _download(args) -> int:
                 f"metrics http://127.0.0.1:{metrics_server.port}/metrics",
                 file=sys.stderr,
             )
-        stream_server = None
         if getattr(args, "stream_port", None) is not None:
             from torrent_tpu.tools.stream import StreamServer
 
@@ -508,12 +512,13 @@ async def _download(args) -> int:
         reporter.cancel()
         done_wait.cancel()
         stop_wait.cancel()
+        return 0 if torrent.on_complete.is_set() else 130
+    finally:
+        # sidecar servers close on every exit path, not just success
         if stream_server is not None:
             stream_server.close()
         if metrics_server is not None:
             metrics_server.close()
-        return 0 if torrent.on_complete.is_set() else 130
-    finally:
         await client.close()
 
 

@@ -154,6 +154,11 @@ class StreamServer:
         start, length = ranges[file_index]
         if length == 0:
             raise IndexError("empty file")
+        entries = self.torrent.info.files or ()
+        if file_index < len(entries) and getattr(entries[file_index], "pad", False):
+            # BEP 47 pad spans aren't content; the CLI hides them and a
+            # GET must 404, not stream phantom zeros
+            raise IndexError("pad file")
         return start, length
 
 
@@ -198,8 +203,15 @@ class StreamServer:
         try:
             while pos < end:
                 n = min(CHUNK, end - pos)
-                t.set_stream_window(pos, self.window_pieces, token=token)
-                for piece in range(pos // plen, (pos + n - 1) // plen + 1):
+                first, last = pos // plen, (pos + n - 1) // plen
+                # the window must cover every piece this chunk will wait
+                # on — small pieces or unaligned ranges can span more
+                # pieces than the configured read-ahead, and waiting on
+                # an unboosted piece would stall at background priority
+                t.set_stream_window(
+                    pos, max(self.window_pieces, last - first + 2), token=token
+                )
+                for piece in range(first, last + 1):
                     await t.wait_piece(piece)
                 data = await asyncio.to_thread(t.storage.get, pos, n)
                 writer.write(data)

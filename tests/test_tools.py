@@ -93,6 +93,57 @@ class TestMakeTorrent:
         self._write_tree(tmp_path)
         assert collect_files(str(tmp_path)) == collect_files(str(tmp_path))
 
+    def test_pad_files_piece_aligns_every_file(self, tmp_path):
+        """BEP 47 authoring: pad entries align every non-first file to a
+        piece boundary, pieces hash with the zeros, and a seed of the
+        original (pad-less) directory verifies clean."""
+        from torrent_tpu.storage.storage import FsStorage, Storage
+        from torrent_tpu.parallel.verify import verify_pieces
+
+        files = self._write_tree(tmp_path)
+        plen = 65536
+        data = make_torrent(
+            str(tmp_path), "http://t.local/announce", piece_length=plen, pad_files=True
+        )
+        m = parse_metainfo(data)
+        assert m is not None
+        real = [f for f in m.info.files if not f.pad]
+        pads = [f for f in m.info.files if f.pad]
+        assert [f.path for f in real] == [("a.bin",), ("z.bin",), ("sub", "b.bin")]
+        assert pads and all(f.path[0] == ".pad" for f in pads)
+        # every real file starts on a piece boundary
+        offset = 0
+        for f in m.info.files:
+            if not f.pad:
+                assert offset % plen == 0, f.path
+            offset += f.length
+        # the hashed stream = files with zero fill between them
+        concat = bytearray()
+        for f in m.info.files:
+            concat += (
+                bytes(f.length)
+                if f.pad
+                else files[os.path.join(*f.path)]
+            )
+        for i, d in enumerate(m.info.pieces):
+            assert d == hashlib.sha1(bytes(concat[i * plen : (i + 1) * plen])).digest()
+        # the authored directory verifies complete without pad files on
+        # disk (multi-file paths live under the torrent-name dir, so the
+        # storage root is tmp_path's PARENT)
+        ok = verify_pieces(
+            Storage(FsStorage(str(tmp_path.parent)), m.info), m.info, hasher="cpu"
+        )
+        assert all(bool(x) for x in ok), "padded torrent must verify from the bare tree"
+
+    def test_pad_files_noop_for_single_file(self, tmp_path):
+        payload = np.random.default_rng(4).integers(0, 256, size=50_000, dtype=np.uint8).tobytes()
+        (tmp_path / "one.bin").write_bytes(payload)
+        a = make_torrent(str(tmp_path / "one.bin"), "http://t/a", piece_length=32768)
+        b = make_torrent(
+            str(tmp_path / "one.bin"), "http://t/a", piece_length=32768, pad_files=True
+        )
+        assert parse_metainfo(a).info_hash == parse_metainfo(b).info_hash
+
 
 class TestUpnpHelpers:
     def test_soap_envelope(self):

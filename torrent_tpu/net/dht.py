@@ -1055,7 +1055,9 @@ class DHTNode:
             n.addr: n.node_id for n in self.table.closest(target, K * 2)
         }
         found_peers: set[tuple[str, int]] = set()
-        found_items: list[dict] = []
+        # 'get' mode appends item dicts; 'scrape' mode appends
+        # (BFsd, BFpe) ScrapeBloom pairs
+        found_items: list = []
         tokens: dict[tuple[str, int], bytes] = {}
 
         def rank(addr) -> int:

@@ -136,12 +136,19 @@ def _cmd_info(args) -> int:
 
 def _cmd_make(args) -> int:
     if args.v2 or args.hybrid:
+        if getattr(args, "pad_files", False):
+            # hybrid authoring piece-aligns on its own; pure v2 has no
+            # pad concept — a silently ignored flag would mislead
+            print(
+                "note: --pad-files applies to v1 authoring only (v2/hybrid "
+                "are piece-aligned by construction); ignoring",
+                file=sys.stderr,
+            )
         return _make_v2(args)
     from torrent_tpu.tools.make_torrent import make_torrent
 
     def progress(n):
         print(f"\rhashed {n} pieces", end="", file=sys.stderr, flush=True)
-
     data = make_torrent(
         args.path,
         args.tracker,
@@ -152,6 +159,7 @@ def _cmd_make(args) -> int:
         announce_list=[[t] for t in args.also_tracker] or None,
         private=args.private,
         web_seeds=args.web_seed or None,
+        pad_files=getattr(args, "pad_files", False),
     )
     print("", file=sys.stderr)
     out = args.output or (args.path.rstrip("/").rsplit("/", 1)[-1] + ".torrent")
@@ -637,6 +645,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--also-tracker", action="append", default=[],
                     help="extra tracker tier (BEP 12, repeatable)")
     sp.add_argument("--private", action="store_true", help="BEP 27 private flag")
+    sp.add_argument(
+        "--pad-files",
+        action="store_true",
+        help="BEP 47: piece-align every file with pad entries (multi-file)",
+    )
     sp.add_argument("--web-seed", action="append", default=[],
                     help="BEP 19 url-list entry (repeatable)")
     sp.add_argument("--v2", action="store_true",

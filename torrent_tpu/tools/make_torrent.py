@@ -50,11 +50,16 @@ def collect_files(root: str) -> list[tuple[str, int]]:
     return out
 
 
-def _iter_pieces(paths: list[str], piece_len: int) -> Iterator[bytes]:
+def _iter_pieces(paths: list[str], piece_len: int, pad: bool = False) -> Iterator[bytes]:
     """Stream fixed-size pieces across file boundaries (the carry-buffer
-    loop of tools/make_torrent.ts:62-113, as a generator)."""
+    loop of tools/make_torrent.ts:62-113, as a generator).
+
+    With ``pad``, zero bytes fill to the next piece boundary after every
+    file but the last (BEP 47): the zeros are hashed into the piece
+    stream exactly as a downloader's virtual pad spans will replay them.
+    """
     carry = bytearray()
-    for path in paths:
+    for i, path in enumerate(paths):
         with open(path, "rb") as f:
             while True:
                 chunk = f.read(max(piece_len, 1 << 20))
@@ -64,6 +69,11 @@ def _iter_pieces(paths: list[str], piece_len: int) -> Iterator[bytes]:
                 while len(carry) >= piece_len:
                     yield bytes(carry[:piece_len])
                     del carry[:piece_len]
+        if pad and i < len(paths) - 1 and len(carry) % piece_len:
+            carry += bytes(piece_len - len(carry) % piece_len)
+            while len(carry) >= piece_len:
+                yield bytes(carry[:piece_len])
+                del carry[:piece_len]
     if carry:
         yield bytes(carry)
 
@@ -119,13 +129,16 @@ def make_torrent(
     announce_list: list[list[str]] | None = None,
     private: bool = False,
     web_seeds: list[str] | None = None,
+    pad_files: bool = False,
 ) -> bytes:
     """Author a .torrent for a file or directory (tools/make_torrent.ts:115).
 
     Returns the bencoded metainfo bytes; caller writes them where it wants.
     ``announce_list`` adds BEP 12 tiers; ``private`` sets BEP 27's flag
     (changes the infohash — clients then skip DHT/PEX); ``web_seeds``
-    adds a BEP 19 ``url-list``.
+    adds a BEP 19 ``url-list``; ``pad_files`` inserts BEP 47 pad entries
+    so every file after the first starts on a piece boundary (single-GET
+    webseed ranges, per-file piece reuse — multi-file only).
     """
     path = os.fspath(path)
     if not os.path.exists(path):
@@ -144,8 +157,9 @@ def make_torrent(
         abs_paths = [path]
 
     plen = piece_length or choose_piece_length(total)
+    pad = bool(pad_files and is_dir and len(abs_paths) > 1)
     hasher_obj = _Hasher(hasher=hasher, piece_length=plen)
-    digests = hasher_obj.digests(_iter_pieces(abs_paths, plen), progress)
+    digests = hasher_obj.digests(_iter_pieces(abs_paths, plen, pad=pad), progress)
 
     info: dict = {
         b"name": name.encode("utf-8"),
@@ -153,10 +167,23 @@ def make_torrent(
         b"pieces": b"".join(digests),
     }
     if is_dir:
-        info[b"files"] = [
-            {b"length": size, b"path": [c.encode("utf-8") for c in rel.split(os.sep)]}
-            for rel, size in files
-        ]
+        entries = []
+        for i, (rel, size) in enumerate(files):
+            entries.append(
+                {b"length": size, b"path": [c.encode("utf-8") for c in rel.split(os.sep)]}
+            )
+            short = size % plen
+            if pad and i < len(files) - 1 and short:
+                # BEP 47: an attr-p entry downloaders virtualize as zeros
+                pad_len = plen - short
+                entries.append(
+                    {
+                        b"attr": b"p",
+                        b"length": pad_len,
+                        b"path": [b".pad", str(pad_len).encode()],
+                    }
+                )
+        info[b"files"] = entries
     else:
         info[b"length"] = total
 

@@ -810,6 +810,60 @@ class TestLargeGeometryScaling:
         run(go())
 
 
+class TestBroadcastMutationSafety:
+    def test_peer_registering_during_have_broadcast(self, monkeypatch):
+        """The have-broadcast awaits per send; an inbound peer
+        registering mid-iteration mutates self.peers — observed killing
+        the ingesting peer's loop in an 8-leech fanout swarm."""
+
+        async def go():
+            rng = np.random.default_rng(5)
+            payload = rng.integers(0, 256, size=65536, dtype=np.uint8).tobytes()
+            data = build_torrent_bytes(payload, 32768, b"http://127.0.0.1:1/a")
+            m = parse_metainfo(data)
+            t = Torrent(
+                metainfo=m,
+                storage=Storage(MemoryStorage(), m.info),
+                peer_id=generate_peer_id(),
+                port=1234,
+                config=TorrentConfig(),
+            )
+            for i in range(3):
+                p = PeerConnection(
+                    peer_id=bytes([i]) * 20,
+                    reader=object(),
+                    writer=_FakeWriter(),
+                    num_pieces=m.info.num_pieces,
+                )
+                t.peers[p.peer_id] = p
+
+            from torrent_tpu.net import protocol as proto_mod
+
+            orig = proto_mod.send_message
+            injected = {"done": False}
+
+            async def racing_send(writer, msg):
+                if not injected["done"]:
+                    injected["done"] = True
+                    late = PeerConnection(
+                        peer_id=b"Z" * 20,
+                        reader=object(),
+                        writer=_FakeWriter(),
+                        num_pieces=m.info.num_pieces,
+                    )
+                    t.peers[late.peer_id] = late  # mutate mid-broadcast
+                await orig(writer, msg)
+
+            monkeypatch.setattr(proto_mod, "send_message", racing_send)
+            partial = _PartialPiece(index=0, length=32768, buffer=bytearray(payload[:32768]))
+            partial.received.add(0)
+            t._partials[0] = partial
+            # must not raise "dictionary keys changed during iteration"
+            assert await t._finish_piece(partial) == "ok"
+
+        run(go())
+
+
 class TestPickerCadence:
     def test_fill_pipeline_runs_per_half_pipeline_not_per_block(self):
         """The picker is an O(pieces) scan; running it once per ingested

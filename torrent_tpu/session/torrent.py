@@ -1242,8 +1242,24 @@ class Torrent:
                             await proto.send_message(peer.writer, proto.Interested())
                         # _fill_pipeline self-gates on choke state and
                         # allowed-fast grants — a choked fast peer that
-                        # granted this very piece must still be asked
-                        await self._fill_pipeline(peer)
+                        # granted this very piece must still be asked.
+                        # Refill only when this peer's pipeline is idle
+                        # (or endgame): a busy pipeline refills itself on
+                        # the next block via the hysteresis path, and in
+                        # a cross-connected swarm per-Have refills are an
+                        # O(pieces) scan times every completion broadcast
+                        # (measured: ~40% of the seed-fanout CPU). A
+                        # choked fast peer announcing a piece it GRANTED
+                        # still refills immediately — its retained
+                        # pre-choke inflight may never drain (rejects can
+                        # be withheld), and this piece is its explicit
+                        # offer.
+                        if (
+                            not peer.inflight
+                            or self._endgame
+                            or (peer.peer_choking and index in peer.allowed_fast_in)
+                        ):
+                            await self._fill_pipeline(peer)
             case proto.BitfieldMsg(raw):
                 try:
                     new_bf = Bitfield(self.info.num_pieces, raw)
@@ -2007,7 +2023,9 @@ class Torrent:
             await self._fill_pipeline(peer)
 
     async def _cancel_everywhere(self, blk, except_peer) -> None:
-        for p in self.peers.values():
+        # snapshot: the sends await, and a peer registering/leaving
+        # mid-iteration would mutate the dict under us
+        for p in list(self.peers.values()):
             if p is except_peer or blk not in p.inflight:
                 continue
             p.inflight.discard(blk)
@@ -2061,13 +2079,22 @@ class Torrent:
             self._wanted_missing = max(0, self._wanted_missing - 1)
         if self.bitfield.count() % 16 == 0:
             self._checkpoint()  # periodic progress checkpoint
-        for p in self.peers.values():
+        # snapshot: each send awaits, and an inbound peer registering
+        # during the broadcast mutates self.peers (observed as
+        # "dictionary keys changed during iteration" killing the
+        # ingesting peer's loop in an 8-leech fanout swarm)
+        for p in list(self.peers.values()):
+            if self.peers.get(p.peer_id) is not p:
+                continue  # dropped during an earlier send's await
             try:
                 await proto.send_message(p.writer, proto.Have(index=partial.index))
+                if p.am_interested:
+                    await self._update_interest(p)
             except (ConnectionError, OSError):
+                # a dead writer here must not tear down the INGESTING
+                # peer's loop, and interest updates on a dropped peer
+                # would assign inflight blocks nothing will ever release
                 pass
-            if p.am_interested:
-                await self._update_interest(p)
         await self._maybe_completed()
         return "ok"
 

@@ -328,6 +328,42 @@ class TestCli:
         assert a6.metrics_port == 0 and a6.encryption == "required"
 
 
+def test_edit_rewrites_without_touching_infohash(tmp_path, ref_fixtures):
+    """edit swaps trackers/webseeds on a golden reference fixture whose
+    info dict our canonical encoder would NOT reproduce byte-for-byte —
+    the raw-splice requirement, proven on real foreign bytes."""
+    from torrent_tpu.codec.metainfo import parse_metainfo
+
+    src = str(ref_fixtures / "singlefile.torrent")
+    before = parse_metainfo(open(src, "rb").read())
+    out = str(tmp_path / "edited.torrent")
+    rc = main(
+        [
+            "edit", src, "-o", out,
+            "--tracker", "http://new.example/announce",
+            "--tracker", "http://backup.example/announce",
+            "--web-seed", "http://mirror.example/f",
+            "--comment", "relocated",
+        ]
+    )
+    assert rc == 0
+    after = parse_metainfo(open(out, "rb").read())
+    assert after.info_hash == before.info_hash  # the whole point
+    assert after.announce == "http://new.example/announce"
+    assert after.web_seeds == ("http://mirror.example/f",)
+    assert after.raw[b"comment"] == b"relocated"
+    # tiers present for the multi-tracker form
+    assert after.raw[b"announce-list"] == [
+        [b"http://new.example/announce"], [b"http://backup.example/announce"]
+    ]
+    # clearing works and still parses
+    rc = main(["edit", out, "--clear-trackers", "--clear-web-seeds", "--comment", ""])
+    assert rc == 0
+    cleared = parse_metainfo(open(out, "rb").read())
+    assert cleared.info_hash == before.info_hash
+    assert cleared.web_seeds == () and b"comment" not in cleared.raw
+
+
 def test_seed_box_serves_directory_of_torrents(tmp_path):
     """`torrent-tpu seed` as a subprocess: two torrents in one directory,
     both downloadable by a client pointed at the box."""

@@ -402,6 +402,70 @@ def _cmd_seed(args) -> int:
     return asyncio.run(_seed_box(args))
 
 
+def _cmd_edit(args) -> int:
+    """Rewrite a .torrent's top-level fields without touching the info
+    dict: the infohash (and thus the swarm) is preserved byte-for-byte,
+    which re-authoring cannot guarantee."""
+    from torrent_tpu.codec.bencode import bdecode_with_info_span, bencode
+
+    try:
+        with open(args.torrent, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        print(f"error: cannot read {args.torrent!r}: {e}", file=sys.stderr)
+        return 1
+    try:
+        top, span = bdecode_with_info_span(data)
+    except Exception as e:
+        print(f"error: not a valid .torrent: {e}", file=sys.stderr)
+        return 1
+    if not isinstance(top, dict) or span is None:
+        print("error: no info dict found", file=sys.stderr)
+        return 1
+    raw_info = data[span[0] : span[1]]
+
+    if args.tracker:
+        top[b"announce"] = args.tracker[0].encode()
+        if len(args.tracker) > 1 or b"announce-list" in top:
+            top[b"announce-list"] = [[t.encode()] for t in args.tracker]
+    if args.clear_trackers:
+        top.pop(b"announce-list", None)
+        top[b"announce"] = b""  # schema requires the key; empty = trackerless
+    if args.web_seed:
+        top[b"url-list"] = [u.encode() for u in args.web_seed]
+    if args.clear_web_seeds:
+        top.pop(b"url-list", None)
+        top.pop(b"httpseeds", None)
+    if args.comment is not None:
+        if args.comment:
+            top[b"comment"] = args.comment.encode()
+        else:
+            top.pop(b"comment", None)
+
+    # re-encode everything EXCEPT the info dict, which is spliced back
+    # raw so the infohash cannot shift (bencode canonicalization of a
+    # foreign encoder's info dict could change it)
+    head = (
+        b"d"
+        + b"".join(
+            bencode(k) + (raw_info if k == b"info" else bencode(top[k]))
+            for k in sorted(set(top) | {b"info"})
+        )
+        + b"e"
+    )
+    out_path = args.output or args.torrent
+    # atomic: a full disk or interrupt mid-write must never leave the
+    # (possibly only) copy truncated
+    tmp = out_path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(head)
+    os.replace(tmp, out_path)
+    import hashlib as _h
+
+    print(f"wrote {out_path} (infohash {_h.sha1(raw_info).hexdigest()} unchanged)")
+    return 0
+
+
 async def _download(args) -> int:
     from torrent_tpu.session.client import Client, ClientConfig
 
@@ -750,6 +814,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--torrent", help=".torrent whose tracker + hash to scrape")
     sp.add_argument("info_hash", nargs="*", help="40-hex info hashes")
     sp.set_defaults(fn=_cmd_scrape)
+
+    sp = sub.add_parser(
+        "edit", help="rewrite trackers/webseeds/comment without changing the infohash"
+    )
+    sp.add_argument("torrent")
+    sp.add_argument("-o", "--output", help="write here instead of in place")
+    trackers = sp.add_mutually_exclusive_group()
+    trackers.add_argument(
+        "--tracker", action="append", default=[], help="replace announce (+tiers)"
+    )
+    trackers.add_argument("--clear-trackers", action="store_true")
+    sp.add_argument(
+        "--web-seed", action="append", default=[], help="replace BEP 19 url-list"
+    )
+    sp.add_argument("--clear-web-seeds", action="store_true")
+    sp.add_argument("--comment", default=None, help="set ('' removes)")
+    sp.set_defaults(fn=_cmd_edit)
 
     sp = sub.add_parser(
         "seed", help="seed every .torrent in a directory (seeding-box mode)"

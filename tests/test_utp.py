@@ -688,6 +688,17 @@ class TestPathMtu:
                 # the acceptor adopted the probed budget for its own sends
                 srv_conn = list(server._conns.values())[0]
                 assert srv_conn.mtu <= 1280, srv_conn.mtu
+                # the incremental inflight counter survived the ladder's
+                # in-place SYN re-encodes: drained connection == zero
+                # phantom bytes (regression: re-encode leaked the pad
+                # delta forever)
+                for _ in range(100):
+                    if not conn._outstanding:
+                        break
+                    await asyncio.sleep(0.05)
+                assert conn._inflight_data == sum(
+                    len(e[0]) - 20 for e in conn._outstanding.values()
+                )
             finally:
                 client.close()
                 server.close()

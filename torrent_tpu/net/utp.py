@@ -185,6 +185,12 @@ class UtpConnection:
         self._last_ack_seen = -1
         self._last_fast_resend = -1  # seq: one cwnd cut per SACK-detected hole
         self._sacked: dict[int, int] = {}  # seq -> payload len, SACKed not acked
+        # incremental byte counters: summing _outstanding/_sacked per
+        # sent chunk and per ack made the send path O(window²) —
+        # measured as the top CPU cost of a loopback uTP transfer
+        self._inflight_data = 0
+        self._sacked_bytes = 0
+        self._timer_deadline = 0.0  # lazy retransmit-timer re-arm target
         self.mtu = MTU  # payload budget; dial-time SYN probing may lower it
         self._mtu_probe_idx: int | None = None  # ladder position while dialing
         self.retx_count = 0  # retransmitted packets (observability + tests)
@@ -209,8 +215,14 @@ class UtpConnection:
 
     # ------------------------------------------------------------- sending
 
-    def _inflight_bytes(self) -> int:
-        return sum(len(p[0]) - HEADER.size for p in self._outstanding.values())
+    def _out_add(self, seq: int, pkt: bytes) -> None:
+        self._outstanding[seq] = [pkt, time.monotonic(), 0]
+        self._inflight_data += len(pkt) - HEADER.size
+
+    def _out_pop(self, seq: int) -> list:
+        entry = self._outstanding.pop(seq)
+        self._inflight_data -= len(entry[0]) - HEADER.size
+        return entry
 
     def _occupancy(self) -> int:
         """Bytes we hold for this connection: in-order buffer plus the
@@ -244,7 +256,7 @@ class UtpConnection:
         # peer's buffer until cumulatively acked — they must keep
         # consuming advertised-window budget or a compliant sender
         # overruns the receiver after a long SACK run
-        return self._inflight_bytes() + sum(self._sacked.values())
+        return self._inflight_data + self._sacked_bytes
 
     async def send(self, data: bytes) -> None:
         """Chunk ``data`` into ST_DATA packets, honoring the window."""
@@ -274,7 +286,7 @@ class UtpConnection:
                 wnd=self.recv_window(),
                 payload=chunk,
             )
-            self._outstanding[self.seq_nr] = [pkt, time.monotonic(), 0]
+            self._out_add(self.seq_nr, pkt)
             self.endpoint.sendto(pkt, self.addr)
             self._arm_timer()
 
@@ -291,7 +303,7 @@ class UtpConnection:
             ts_diff=self.last_ts_diff,
             wnd=self.recv_window(),
         )
-        self._outstanding[self.seq_nr] = [pkt, time.monotonic(), 0]
+        self._out_add(self.seq_nr, pkt)
         self.endpoint.sendto(pkt, self.addr)
         self._arm_timer()
 
@@ -404,14 +416,14 @@ class UtpConnection:
         ]  # s <= ack in seq space
         if self._sacked:
             for s in [s for s in self._sacked if not _seq_lt(ack, s)]:
-                del self._sacked[s]  # cumulative ack passed it: budget freed
+                self._sacked_bytes -= self._sacked.pop(s)  # budget freed
         n_sacked = self._apply_sack(ack, sack) if sack else 0
         if acked or n_sacked:
             if acked:
                 self._dup_acks = 0
                 self._last_ack_seen = ack
             for s in acked:
-                pkt, sent_at, retx = self._outstanding.pop(s)
+                pkt, sent_at, retx = self._out_pop(s)
                 if retx == 0:  # Karn: only clean samples drive the RTO
                     self._rtt_sample(time.monotonic() - sent_at)
             self._ledbat(ts_diff, len(acked) + n_sacked)
@@ -453,10 +465,12 @@ class UtpConnection:
                     popcount += 1
                     s = (ack + 2 + byte_i * 8 + bit) & 0xFFFF
                     if s in self._outstanding:
-                        pkt = self._outstanding.pop(s)[0]
+                        pkt = self._out_pop(s)[0]
                         # stays in flow-control accounting until the
                         # cumulative ack passes it (see _flow_used)
-                        self._sacked[s] = max(0, len(pkt) - HEADER.size)
+                        size = max(0, len(pkt) - HEADER.size)
+                        self._sacked[s] = size
+                        self._sacked_bytes += size
                         n_sacked += 1
         hole = (ack + 1) & 0xFFFF
         if popcount >= 3 and hole in self._outstanding and self._last_fast_resend != hole:
@@ -490,16 +504,32 @@ class UtpConnection:
     # ----------------------------------------------------------- timers
 
     def _arm_timer(self) -> None:
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
-        if not self._outstanding or self.closed:
+        """Lazy re-arm: push the RTO deadline forward without touching
+        the scheduled TimerHandle (cancel+call_later per packet event
+        was ~15% of a loopback transfer's CPU); the handle fires at its
+        old time and re-schedules itself for the remainder. A deadline
+        moving meaningfully EARLIER (RTO recovered from backoff) does
+        cancel and reschedule — otherwise a fresh loss would wait out
+        the old backed-off timer."""
+        loop = asyncio.get_running_loop()
+        self._timer_deadline = loop.time() + self.rto
+        if self.closed or not self._outstanding:
             return
-        self._timer = asyncio.get_running_loop().call_later(self.rto, self._on_timeout)
+        if self._timer is None:
+            self._timer = loop.call_later(self.rto, self._on_timeout)
+        elif self._timer.when() > self._timer_deadline + 0.05:
+            self._timer.cancel()
+            self._timer = loop.call_later(self.rto, self._on_timeout)
 
     def _on_timeout(self) -> None:
         self._timer = None
         if not self._outstanding or self.closed:
+            return
+        loop = asyncio.get_running_loop()
+        remaining = self._timer_deadline - loop.time()
+        if remaining > 0.001:
+            # deadline moved forward since this handle was scheduled
+            self._timer = loop.call_later(remaining, self._on_timeout)
             return
         self.rto = min(8.0, self.rto * 2)  # backoff (SYN probes un-back-off below)
         # multiplicative decrease, not full collapse: a floor-sized
@@ -530,9 +560,14 @@ class UtpConnection:
                 else 0
             )
             self.mtu = MTU_LADDER[min(self._mtu_probe_idx, len(MTU_LADDER) - 1)]
-            entry[0] = encode_packet(
+            new_pkt = encode_packet(
                 ST_SYN, self.recv_id, oldest, 0, payload=b"\x00" * pad
             )
+            # the only in-place packet mutation: keep the incremental
+            # inflight counter honest or the shrunken pad's bytes leak
+            # as phantom inflight for the connection's lifetime
+            self._inflight_data += len(new_pkt) - len(entry[0])
+            entry[0] = new_pkt
         self._retransmit(oldest)
         self._arm_timer()
 
@@ -601,6 +636,8 @@ class UtpConnection:
             self._delack_timer = None
         self._outstanding.clear()
         self._sacked.clear()
+        self._inflight_data = 0
+        self._sacked_bytes = 0
         self._send_room.set()
         self._rx_closed = True
         self.reader.feed_eof()
@@ -818,7 +855,7 @@ class UtpEndpoint(asyncio.DatagramProtocol):
             conn.mtu = MTU_LADDER[0]
             pad = b"\x00" * MTU_LADDER[0]
         pkt = encode_packet(ST_SYN, recv_id, conn.seq_nr, 0, payload=pad)
-        conn._outstanding[conn.seq_nr] = [pkt, time.monotonic(), 0]
+        conn._out_add(conn.seq_nr, pkt)
         self.sendto(pkt, addr)
         conn._arm_timer()
         try:

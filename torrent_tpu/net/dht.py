@@ -1029,12 +1029,13 @@ class DHTNode:
         else:
             fam = socket.AF_INET
         loop = asyncio.get_running_loop()
-        for addr in addrs:
+
+        async def _join(addr) -> None:
             try:
                 infos = await loop.getaddrinfo(addr[0], addr[1], family=fam)
                 ip_addr = (infos[0][4][0], addr[1])
             except OSError:
-                continue
+                return
             try:
                 # operator-chosen seeds bypass BEP 42 enforcement: the
                 # long-lived public bootstrap nodes predate the BEP, and
@@ -1042,7 +1043,16 @@ class DHTNode:
                 # candidates, no lookups, a bricked join
                 self.table.update(await self.ping(ip_addr), ip_addr[0], ip_addr[1])
             except DHTError:
-                continue
+                return
+
+        # bounded concurrency: a persisted table full of now-dead nodes
+        # would otherwise serialize RPC_TIMEOUT per seed into a
+        # minutes-long start (same reasoning as maintain_once)
+        for i in range(0, len(addrs), ALPHA * 2):
+            await asyncio.gather(
+                *(_join(a) for a in addrs[i : i + ALPHA * 2]),
+                return_exceptions=True,
+            )
         for _ in range(BOOTSTRAP_TARGET_RETRIES):
             await self.lookup_nodes(self.node_id)
         return len(self.table)
@@ -1308,6 +1318,61 @@ class DHTNode:
             if pe is not None:
                 bf_down.union(pe)
         return bf_seed.estimate(), bf_down.estimate()
+
+    # ------------------------------------------------------ state persistence
+
+    def save_state(self, path: str) -> None:
+        """Persist the node id and good routing-table entries so the next
+        start rejoins the DHT without public bootstrap seeds (the
+        standard fast-restart behavior of long-lived clients)."""
+        v4 = b"".join(
+            pack_compact_node(n.node_id, n.ip, n.port)
+            for b in self.table.buckets
+            for n in b
+            if n.good and not _is_v6(n.ip)
+        )
+        v6 = b"".join(
+            pack_compact_node6(n.node_id, n.ip, n.port)
+            for b in self.table.buckets
+            for n in b
+            if n.good and _is_v6(n.ip)
+        )
+        if not v4 and not v6 and os.path.exists(path):
+            # an empty table (e.g. a session started during an outage)
+            # must not overwrite a previously good saved table — for a
+            # seedless fast-restart config that file IS the only way
+            # back into the DHT
+            return
+        blob = bencode({b"id": self.node_id, b"nodes": v4, b"nodes6": v6})
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load_state(path: str):
+        """→ (node_id | None, [(ip, port), ...]) from :meth:`save_state`;
+        (None, []) when absent or malformed (a fresh id + empty table is
+        always a safe fallback)."""
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            state = bdecode(raw)
+        except (OSError, BencodeError):
+            return None, []
+        if not isinstance(state, dict):
+            return None, []
+        node_id = state.get(b"id")
+        if not isinstance(node_id, bytes) or len(node_id) != 20:
+            node_id = None
+        addrs: list[tuple[str, int]] = []
+        nodes = state.get(b"nodes")
+        if isinstance(nodes, bytes):
+            addrs.extend((ip, port) for _, ip, port in unpack_compact_nodes(nodes))
+        nodes6 = state.get(b"nodes6")
+        if isinstance(nodes6, bytes):
+            addrs.extend((ip, port) for _, ip, port in unpack_compact_nodes6(nodes6))
+        return node_id, addrs
 
     async def sample_infohashes(
         self, addr, target: bytes

@@ -49,6 +49,9 @@ class ClientConfig:
     enable_dht: bool = False  # BEP 5 mainline DHT (net/dht.py)
     dht_port: int = 0  # 0 = ephemeral UDP port
     dht_bootstrap: tuple = ()  # ((host, port), ...) seed nodes
+    # Routing-table persistence: node id + good entries saved here on
+    # close and rejoined on start (fast restart without public seeds)
+    dht_state_path: str = ""
     # BEP 42: reject routing-table nodes whose ids don't derive from
     # their IP (id-targeting defense; off by default for compat)
     dht_enforce_bep42: bool = False
@@ -134,14 +137,31 @@ class Client:
         if self.config.enable_dht:
             from torrent_tpu.net.dht import DHTNode
 
+            from torrent_tpu.net.dht import bep42_valid
+
+            saved_id, saved_nodes = (
+                DHTNode.load_state(self.config.dht_state_path)
+                if self.config.dht_state_path
+                else (None, [])
+            )
+            # a persisted id keeps our routing-table position (and other
+            # nodes' entries for us) across restarts; it survives a
+            # learned external IP as long as it is still BEP 42-valid
+            # for it (the common unchanged-IP case), else a compliant id
+            # is minted fresh
+            keep_id = saved_id is not None and (
+                self.external_ip is None or bep42_valid(saved_id, self.external_ip)
+            )
             self.dht = await DHTNode(
+                node_id=saved_id if keep_id else None,
                 port=self.config.dht_port,
                 host=self.config.host,
                 enforce_bep42=self.config.dht_enforce_bep42,
                 external_ip=self.external_ip,
             ).start()
-            if self.config.dht_bootstrap:
-                await self.dht.bootstrap([tuple(a) for a in self.config.dht_bootstrap])
+            seeds = [tuple(a) for a in self.config.dht_bootstrap] + saved_nodes
+            if seeds:
+                await self.dht.bootstrap(seeds)
             # table housekeeping for quiet nodes: stale pings + bucket
             # refresh + peer-store expiry (net/dht.py maintain_once)
             self._dht_maintenance = asyncio.create_task(self.dht.maintain())
@@ -186,6 +206,11 @@ class Client:
             self._dht_maintenance.cancel()
             self._dht_maintenance = None
         if self.dht is not None:
+            if self.config.dht_state_path:
+                try:
+                    self.dht.save_state(self.config.dht_state_path)
+                except OSError as e:
+                    log.warning("dht state save failed: %s", e)
             self.dht.close()
             self.dht = None
         if self._server:

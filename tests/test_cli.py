@@ -326,6 +326,10 @@ class TestCli:
         )
         assert a6.torrents == "tdir" and a6.data == "ddir"
         assert a6.metrics_port == 0 and a6.encryption == "required"
+        a7 = p.parse_args(["download", "x.torrent", "d", "--dht-state", "dht.dat"])
+        assert a7.dht_state == "dht.dat"
+        a8 = p.parse_args(["edit", "t", "--clear-trackers"])
+        assert a8.clear_trackers
 
 
 def test_edit_rewrites_without_touching_infohash(tmp_path, ref_fixtures):

@@ -480,8 +480,9 @@ async def _download(args) -> int:
         port=args.port,
         hasher=args.hasher,
         resume=not args.no_resume,
-        enable_dht=args.dht or bool(bootstrap),
+        enable_dht=args.dht or bool(bootstrap) or bool(getattr(args, "dht_state", "")),
         dht_bootstrap=tuple(bootstrap),
+        dht_state_path=getattr(args, "dht_state", "") or "",
         max_upload_bps=args.max_up * 1024,
         max_download_bps=args.max_down * 1024,
         enable_lsd=args.lsd,
@@ -801,6 +802,13 @@ def build_parser() -> argparse.ArgumentParser:
         default=[],
         metavar="HOST:PORT",
         help="DHT bootstrap node (repeatable; implies --dht)",
+    )
+    sp.add_argument(
+        "--dht-state",
+        default="",
+        metavar="FILE",
+        help="persist the DHT routing table here for seedless fast "
+        "restarts (implies --dht)",
     )
     sp.set_defaults(fn=_cmd_download)
 

@@ -340,6 +340,46 @@ class TestMutableItems:
 
         run(go())
 
+    def test_hostile_query_fuzz_never_kills_the_endpoint(self):
+        """Randomized malformed get/put/sample_infohashes datagrams (the
+        round-3 handlers) must never kill the endpoint or corrupt its
+        stores; a legitimate round trip still works afterwards."""
+        import random as _random
+
+        from torrent_tpu.codec.bencode import bencode
+
+        async def go():
+            a = await DHTNode(host="127.0.0.1").start()
+            b = await DHTNode(host="127.0.0.1").start()
+            await a.ping(("127.0.0.1", b.port))
+            rng = _random.Random(44)
+            junk_values = [
+                b"", b"x", b"\x00" * 20, b"\x00" * 32, b"\x00" * 64,
+                -1, 0, 2**70, [], [b"x"], {}, {b"a": b"b"}, b"\xff" * 1000,
+            ]
+            for i in range(300):
+                q = rng.choice([b"get", b"put", b"sample_infohashes", b"get_peers"])
+                args = {b"id": rng.choice(junk_values)}
+                for key in (b"target", b"v", b"k", b"sig", b"seq", b"salt",
+                            b"cas", b"token", b"info_hash", b"scrape"):
+                    if rng.random() < 0.5:
+                        args[key] = rng.choice(junk_values)
+                pkt = bencode({b"t": i.to_bytes(2, "big"), b"y": b"q", b"q": q, b"a": args})
+                b._on_datagram(pkt, ("127.0.0.1", 40000 + (i % 1000)))
+            await asyncio.sleep(0.1)  # let any scheduled put verifies run
+            # the endpoint survived and a real put/get still round-trips
+            target, stored = await a.put_immutable(b"still alive")
+            assert stored > 0
+            item = await a.get_item(target)
+            assert item is not None and item.value == b"still alive"
+            # no malformed junk leaked into the item store
+            for ent in b.item_store.values():
+                assert isinstance(ent["v_raw"], bytes)
+            a.close()
+            b.close()
+
+        run(go())
+
     def test_routing_table_persists_across_restart(self, tmp_path):
         """save_state/load_state round trip + a Client rejoining via its
         persisted nodes with NO bootstrap seeds configured."""

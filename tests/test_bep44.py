@@ -346,8 +346,6 @@ class TestMutableItems:
         stores; a legitimate round trip still works afterwards."""
         import random as _random
 
-        from torrent_tpu.codec.bencode import bencode
-
         async def go():
             a = await DHTNode(host="127.0.0.1").start()
             b = await DHTNode(host="127.0.0.1").start()

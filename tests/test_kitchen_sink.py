@@ -1,0 +1,153 @@
+"""Whole-framework composition: every major subsystem on at once.
+
+One seeding client and one downloading client with MSE required and uTP
+enabled; three torrents transfer concurrently — a BEP 47 pad-aligned
+multi-file tree, a rate-capped single file, and a streamed file served
+over HTTP mid-download — while Prometheus metrics scrape live. The
+point is cross-feature interference: each feature passes alone in its
+own suite; this asserts they compose.
+"""
+
+import asyncio
+import urllib.request
+
+import numpy as np
+
+from torrent_tpu.codec.metainfo import parse_metainfo
+from torrent_tpu.net import mse
+from torrent_tpu.session.client import Client, ClientConfig
+from torrent_tpu.session.torrent import TorrentState
+from torrent_tpu.storage.storage import MemoryStorage, Storage
+from torrent_tpu.tools.make_torrent import make_torrent
+from torrent_tpu.tools.stream import StreamServer
+from torrent_tpu.utils.metrics import MetricsServer
+
+from test_session import build_torrent_bytes, fast_config, run, start_tracker
+
+
+def test_everything_at_once(tmp_path):
+    async def go():
+        rng = np.random.default_rng(1234)
+        server, pump, announce_url = await start_tracker()
+
+        # torrent A: pad-aligned multi-file tree authored by our own tool
+        tree = tmp_path / "album"
+        (tree / "cd1").mkdir(parents=True)
+        file_a1 = rng.integers(0, 256, size=90_001, dtype=np.uint8).tobytes()
+        file_a2 = rng.integers(0, 256, size=70_007, dtype=np.uint8).tobytes()
+        (tree / "t1.bin").write_bytes(file_a1)
+        (tree / "cd1" / "t2.bin").write_bytes(file_a2)
+        meta_a = parse_metainfo(
+            make_torrent(
+                str(tree), announce_url, piece_length=32768, pad_files=True
+            )
+        )
+        assert any(f.pad for f in meta_a.info.files)
+
+        # torrent B: rate-capped download
+        payload_b = rng.integers(0, 256, size=2 * 1024 * 1024, dtype=np.uint8).tobytes()
+        meta_b = parse_metainfo(
+            build_torrent_bytes(payload_b, 65536, announce_url.encode(), name=b"capped")
+        )
+
+        # torrent C: streamed while downloading
+        payload_c = rng.integers(0, 256, size=3 * 1024 * 1024, dtype=np.uint8).tobytes()
+        meta_c = parse_metainfo(
+            build_torrent_bytes(payload_c, 65536, announce_url.encode(), name=b"movie")
+        )
+
+        seed = Client(ClientConfig(host="127.0.0.1", enable_utp=True))
+        leech = Client(ClientConfig(host="127.0.0.1", enable_utp=True))
+        seed.config.torrent = fast_config(encryption="required")
+        leech.config.torrent = fast_config(encryption="required")
+        await seed.start()
+        await leech.start()
+        metrics = await MetricsServer(leech).start()
+        stream = None
+        try:
+            await seed.add(meta_a, str(tmp_path))  # bare tree, no pads on disk
+            sb = Storage(MemoryStorage(), meta_b.info)
+            for off in range(0, len(payload_b), 65536):
+                sb.set(off, payload_b[off : off + 65536])
+            await seed.add(meta_b, sb)
+            sc = Storage(MemoryStorage(), meta_c.info)
+            for off in range(0, len(payload_c), 65536):
+                sc.set(off, payload_c[off : off + 65536])
+            await seed.add(meta_c, sc)
+            for t in seed.torrents.values():
+                assert t.state == TorrentState.SEEDING
+
+            dl = tmp_path / "dl"
+            dl.mkdir()
+            t_a = await leech.add(meta_a, str(dl))
+            leech.config.torrent = fast_config(
+                encryption="required", max_download_bps=1024 * 1024
+            )
+            t_b = await leech.add(meta_b, Storage(MemoryStorage(), meta_b.info))
+            leech.config.torrent = fast_config(encryption="required")
+            t_c = await leech.add(meta_c, Storage(MemoryStorage(), meta_c.info))
+            stream = await StreamServer(t_c).start()
+
+            # stream a tail range of C while everything else transfers
+            def fetch_tail():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{stream.port}/0",
+                    headers={"Range": f"bytes={len(payload_c) - 300_000}-"},
+                )
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    return r.read()
+
+            tail = await asyncio.to_thread(fetch_tail)
+            assert tail == payload_c[-300_000:]
+
+            await asyncio.wait_for(
+                asyncio.gather(
+                    t_a.on_complete.wait(),
+                    t_b.on_complete.wait(),
+                    t_c.on_complete.wait(),
+                ),
+                timeout=60,
+            )
+            # bit-identical everywhere; pads never hit the leech disk
+            assert (dl / "album" / "t1.bin").read_bytes() == file_a1
+            assert (dl / "album" / "cd1" / "t2.bin").read_bytes() == file_a2
+            assert not (dl / "album" / ".pad").exists()
+            assert t_b.storage.get(0, len(payload_b)) == payload_b
+            assert t_c.storage.get(0, len(payload_c)) == payload_c
+            # per-torrent cap config plumbed through Client.add (the
+            # actual pacing behavior is measured in test_ratelimit)
+            assert t_b.own_download_bucket.rate == 1024 * 1024
+
+            # at least one peer connection is RC4-over-uTP or RC4-over-TCP
+            writers = [p.writer for t in leech.torrents.values() for p in t.peers.values()]
+            assert any(isinstance(w, mse.WrappedWriter) for w in writers)
+
+            # live metrics reflect all three torrents
+            def scrape():
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{metrics.port}/metrics", timeout=10
+                ) as r:
+                    return r.read().decode()
+
+            text = await asyncio.to_thread(scrape)
+            assert "torrent_tpu_torrents 3" in text
+            # downloaded counter covers ALL three payloads (pad spans are
+            # synthesized locally, never downloaded — hence real_bytes)
+            real_bytes = (
+                len(payload_b) + len(payload_c) + len(file_a1) + len(file_a2)
+            )
+            down_line = next(
+                l for l in text.splitlines()
+                if l.startswith("torrent_tpu_downloaded_bytes_total")
+            )
+            assert int(down_line.split()[-1]) >= real_bytes
+        finally:
+            if stream is not None:
+                stream.close()
+            metrics.close()
+            await seed.close()
+            await leech.close()
+            server.close()
+            await asyncio.wait_for(pump, 5)
+
+    run(go(), timeout=120)

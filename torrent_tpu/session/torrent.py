@@ -1945,12 +1945,15 @@ class Torrent:
             # fresh pipeline: restart the snub clock so an idle-but-honest
             # peer isn't condemned for the time it spent choked
             peer.last_block_rx = time.monotonic()
+        # one coalesced write + drain for the whole batch: a drain per
+        # Request yields to the event loop per 16 KiB asked for
         for blk in wanted:
             peer.inflight.add(blk)
             if peer.peer_choking:
                 peer.inflight_choked.add(blk)  # issued under an allowed-fast grant
             self._inflight_count[blk] += 1
-            await proto.send_message(peer.writer, proto.Request(*blk))
+            peer.writer.write(proto.encode_message(proto.Request(*blk)))
+        await peer.writer.drain()
 
     async def _ingest_block(self, peer: PeerConnection, index, begin, block) -> None:
         """(torrent.ts:183-193) + assembly, verification, have broadcast."""

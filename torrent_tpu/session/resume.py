@@ -19,12 +19,17 @@ swarm (we'd serve bad pieces and get banned — worse than rechecking).
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from torrent_tpu.codec.bencode import BencodeError, bdecode, bencode
 from torrent_tpu.utils.bitfield import Bitfield
 
 FORMAT_VERSION = 1
+
+
+# partial-piece persistence caps: the resume file must stay small and a
+# hostile checkpoint must not balloon memory
+MAX_SAVED_PARTIALS = 64
 
 
 @dataclass
@@ -34,18 +39,29 @@ class ResumeData:
     bitfield: bytes
     uploaded: int = 0
     downloaded: int = 0
+    # in-flight pieces at checkpoint time: piece index -> (block bitmap
+    # LSB-first, buffer with received spans filled). Restart re-ingests
+    # them so up to piece_length per partial isn't re-downloaded;
+    # verification still gates persistence when the piece completes.
+    partials: dict = field(default_factory=dict)
 
     def encode(self) -> bytes:
-        return bencode(
-            {
-                b"version": FORMAT_VERSION,
-                b"info_hash": self.info_hash,
-                b"num_pieces": self.num_pieces,
-                b"bitfield": self.bitfield,
-                b"uploaded": self.uploaded,
-                b"downloaded": self.downloaded,
+        top = {
+            b"version": FORMAT_VERSION,
+            b"info_hash": self.info_hash,
+            b"num_pieces": self.num_pieces,
+            b"bitfield": self.bitfield,
+            b"uploaded": self.uploaded,
+            b"downloaded": self.downloaded,
+        }
+        if self.partials:
+            top[b"partials"] = {
+                str(i).encode(): {b"mask": mask, b"data": data}
+                for i, (mask, data) in sorted(self.partials.items())[
+                    :MAX_SAVED_PARTIALS
+                ]  # the single cap point (bounds file size + decode memory)
             }
-        )
+        return bencode(top)
 
     @classmethod
     def decode(cls, raw: bytes) -> "ResumeData | None":
@@ -55,6 +71,19 @@ class ResumeData:
             return None
         if not isinstance(d, dict) or d.get(b"version") != FORMAT_VERSION:
             return None
+        partials: dict = {}
+        saved = d.get(b"partials")
+        if isinstance(saved, dict):
+            for key, ent in list(saved.items())[:MAX_SAVED_PARTIALS]:
+                if not (
+                    isinstance(key, bytes)
+                    and key.isdigit()
+                    and isinstance(ent, dict)
+                    and isinstance(ent.get(b"mask"), bytes)
+                    and isinstance(ent.get(b"data"), bytes)
+                ):
+                    return None  # corrupt partial section → full recheck
+                partials[int(key)] = (ent[b"mask"], ent[b"data"])
         try:
             rd = cls(
                 info_hash=d[b"info_hash"],
@@ -62,6 +91,7 @@ class ResumeData:
                 bitfield=d[b"bitfield"],
                 uploaded=d[b"uploaded"],
                 downloaded=d[b"downloaded"],
+                partials=partials,
             )
         except KeyError:
             return None

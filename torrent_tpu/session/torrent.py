@@ -627,6 +627,40 @@ class Torrent:
         self._notify_present_pieces()
         self._recount_wanted()
         self._rarity_dirty = True
+        # Re-ingest checkpointed in-flight pieces: the scheduler resumes
+        # mid-piece instead of re-downloading up to piece_length per
+        # partial. The data is untrusted-by-construction — verification
+        # still gates persistence when the piece completes, exactly as
+        # for wire blocks.
+        for index, (mask, data) in (rd.partials or {}).items():
+            if (
+                not isinstance(index, int)
+                or not 0 <= index < self.info.num_pieces
+                or bf.has(index)
+                or index in self._partials
+            ):
+                continue
+            plen_i = piece_length(self.info, index)
+            if len(data) != plen_i:
+                continue  # geometry changed or corrupt: drop the partial
+            received = set()
+            for b in range((plen_i + BLOCK_SIZE - 1) // BLOCK_SIZE):
+                if b // 8 < len(mask) and mask[b // 8] & (1 << (b % 8)):
+                    received.add(b * BLOCK_SIZE)
+            if not received:
+                continue
+            partial = _PartialPiece(
+                index=index,
+                length=plen_i,
+                buffer=bytearray(data),
+                received=received,
+            )
+            if partial.complete:
+                # defense against old/foreign checkpoints: a complete
+                # partial has no missing block to trigger _finish_piece —
+                # drop it and let the scheduler re-fetch the piece
+                continue
+            self._partials[index] = partial
         self.storage.mark_pieces_written(
             i for i in range(self.info.num_pieces) if bf.has(i)
         )
@@ -635,11 +669,31 @@ class Torrent:
         log.info("fastresume: %d/%d pieces", bf.count(), self.info.num_pieces)
         return True
 
-    def _checkpoint(self) -> None:
+    def _checkpoint(self, include_partials: bool = False) -> None:
         if self.resume_store is None:
             return
         from torrent_tpu.session.resume import ResumeData
 
+        # Partial buffers ride only the STOP-time checkpoint: serializing
+        # up to piece_length per in-flight piece inside the periodic
+        # 16-piece checkpoint would do megabytes of copy+bencode+write on
+        # the event loop mid-download. Entry-count capping happens once,
+        # in ResumeData.encode.
+        partials = {}
+        if include_partials:
+            for index, p in list(self._partials.items()):
+                if not p.received or p.complete:
+                    # empty webseed reservations carry nothing; COMPLETE
+                    # partials must never persist — a re-ingested complete
+                    # partial has no missing block to trigger
+                    # _finish_piece and would stall the download forever
+                    continue
+                n_blocks = (len(p.buffer) + BLOCK_SIZE - 1) // BLOCK_SIZE
+                mask = bytearray((n_blocks + 7) // 8)
+                for begin in p.received:
+                    b = begin // BLOCK_SIZE
+                    mask[b // 8] |= 1 << (b % 8)
+                partials[index] = (bytes(mask), bytes(p.buffer))
         try:
             self.resume_store.save(
                 ResumeData(
@@ -648,6 +702,7 @@ class Torrent:
                     bitfield=self.bitfield.to_bytes(),
                     uploaded=self.uploaded,
                     downloaded=self.downloaded,
+                    partials=partials,
                 )
             )
         except OSError as e:
@@ -702,7 +757,7 @@ class Torrent:
         for peer in list(self.peers.values()):
             peer.close()
         self.peers.clear()
-        self._checkpoint()
+        self._checkpoint(include_partials=True)  # stop: keep in-flight work
         if self.trackers:
             try:
                 await asyncio.wait_for(

@@ -53,6 +53,137 @@ def make_multifile_torrent(file_lens, piece_len=PLEN, **config_kw):
     return t, payload
 
 
+class TestPartfile:
+    def test_deselected_file_never_appears_on_disk(self, tmp_path):
+        """The boundary piece of a selected file spills bytes belonging
+        to its deselected neighbor; with FsStorage those bytes go to the
+        hidden .parts mirror — no visible stub file — and widening the
+        selection promotes the mirror into place."""
+        import hashlib
+        import os
+
+        from torrent_tpu.codec.bencode import bencode
+        from torrent_tpu.storage.storage import FsStorage
+
+        async def go():
+            rng = np.random.default_rng(77)
+            # f0 = 1.5 pieces, f1 = 1.5 pieces: piece 1 spans both files
+            f0 = rng.integers(0, 256, size=PLEN + PLEN // 2, dtype=np.uint8).tobytes()
+            f1 = rng.integers(0, 256, size=PLEN + PLEN // 2, dtype=np.uint8).tobytes()
+            payload = f0 + f1
+            pieces = b"".join(
+                hashlib.sha1(payload[i : i + PLEN]).digest()
+                for i in range(0, len(payload), PLEN)
+            )
+            data = bencode(
+                {
+                    b"announce": b"http://127.0.0.1:1/announce",
+                    b"info": {
+                        b"name": b"sel",
+                        b"piece length": PLEN,
+                        b"pieces": pieces,
+                        b"files": [
+                            {b"length": len(f0), b"path": [b"keep.bin"]},
+                            {b"length": len(f1), b"path": [b"skip.bin"]},
+                        ],
+                    },
+                }
+            )
+            m = parse_metainfo(data)
+            t = Torrent(
+                metainfo=m,
+                storage=Storage(FsStorage(str(tmp_path)), m.info),
+                peer_id=generate_peer_id(),
+                port=1,
+                config=TorrentConfig(),
+            )
+            await t.select_files([0])
+            # write the pieces covering file 0 (incl. the spanning piece)
+            t.storage.set(0, payload[: 2 * PLEN])
+            real = tmp_path / "sel" / "skip.bin"
+            assert not real.exists(), "deselected file must not appear"
+            parts_dir = tmp_path / ".parts"
+            assert parts_dir.is_dir() and any(parts_dir.iterdir())
+            # the spilled bytes read back from the mirror transparently
+            assert t.storage.get(0, 2 * PLEN) == payload[: 2 * PLEN]
+            # widen: the mirror is promoted into the real location
+            await t.select_files([0, 1])
+            assert real.exists()
+            head = real.read_bytes()[: PLEN // 2]
+            assert head == f1[: PLEN // 2]  # spill preserved
+            # finish the remaining bytes and verify the whole payload
+            t.storage.set(2 * PLEN, payload[2 * PLEN :])
+            assert t.storage.get(0, len(payload)) == payload
+            assert real.read_bytes() == f1
+
+            # deselecting a file with REAL on-disk data keeps its IO in
+            # place — verified bytes stay readable, no mirror split-brain
+            await t.select_files([0])
+            assert t.storage.get(0, len(payload)) == payload
+            t.storage.set(2 * PLEN, payload[2 * PLEN :])
+            assert real.read_bytes() == f1  # wrote through to the real file
+
+        run(go())
+
+    def test_spill_survives_restart_via_reapplied_selection(self, tmp_path):
+        """Fresh process: a new FsStorage knows nothing of the old
+        routing, but re-applying the selection (what Client.add's
+        wanted_files does before start) promotes any spilled mirror of
+        now-wanted files back into place."""
+        import hashlib
+
+        from torrent_tpu.codec.bencode import bencode
+        from torrent_tpu.storage.storage import FsStorage
+
+        async def go():
+            rng = np.random.default_rng(79)
+            f0 = rng.integers(0, 256, size=PLEN + PLEN // 2, dtype=np.uint8).tobytes()
+            f1 = rng.integers(0, 256, size=PLEN + PLEN // 2, dtype=np.uint8).tobytes()
+            payload = f0 + f1
+            pieces = b"".join(
+                hashlib.sha1(payload[i : i + PLEN]).digest()
+                for i in range(0, len(payload), PLEN)
+            )
+            data = bencode(
+                {
+                    b"announce": b"http://127.0.0.1:1/announce",
+                    b"info": {
+                        b"name": b"sel",
+                        b"piece length": PLEN,
+                        b"pieces": pieces,
+                        b"files": [
+                            {b"length": len(f0), b"path": [b"keep.bin"]},
+                            {b"length": len(f1), b"path": [b"skip.bin"]},
+                        ],
+                    },
+                }
+            )
+            m = parse_metainfo(data)
+
+            def mk():
+                return Torrent(
+                    metainfo=m,
+                    storage=Storage(FsStorage(str(tmp_path)), m.info),
+                    peer_id=generate_peer_id(),
+                    port=1,
+                    config=TorrentConfig(),
+                )
+
+            t1 = mk()
+            await t1.select_files([0])
+            t1.storage.set(0, payload[: 2 * PLEN])  # spill lands in mirror
+            assert not (tmp_path / "sel" / "skip.bin").exists()
+
+            # "restart": brand-new storage, selection re-applied wider
+            t2 = mk()
+            await t2.select_files([0, 1])
+            promoted = tmp_path / "sel" / "skip.bin"
+            assert promoted.exists()
+            assert promoted.read_bytes()[: PLEN // 2] == f1[: PLEN // 2]
+
+        run(go())
+
+
 class TestPieceMask:
     def test_file_ranges_and_boundary_pieces(self):
         async def go():

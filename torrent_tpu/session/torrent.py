@@ -354,15 +354,22 @@ class Torrent:
         plen = self.info.piece_length
         entries = self.info.files or ()
         prio = np.zeros(self.info.num_pieces, dtype=np.int8)
+        unwanted_files = set()
         for i, (start, length) in enumerate(ranges):
             if i < len(entries) and getattr(entries[i], "pad", False):
-                continue  # pad spans never drive wanting
+                continue  # pad spans never drive wanting (nor partfiles)
             p = int(priorities.get(i, 1))
+            if p <= 0:
+                unwanted_files.add(i)
             if length == 0 or p <= 0:
                 continue
             first, last = start // plen, (start + length - 1) // plen
             np.maximum(prio[first : last + 1], p, out=prio[first : last + 1])
         self._piece_priority = prio
+        # partfile routing: deselected files' boundary spill goes to the
+        # hidden parts mirror; files (re-)entering the selection are
+        # promoted back into place (no-op for memory backends)
+        self.storage.set_unwanted_files(unwanted_files)
         # a new selection invalidates the boost snapshot; active reader
         # windows re-apply over the new mask, and parked readers re-check
         # (a newly-deselected piece must raise, not hang)

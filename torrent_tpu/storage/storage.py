@@ -18,6 +18,7 @@ and the written map can be rebuilt from a verified bitfield on resume.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 from typing import Iterator, Protocol
@@ -50,6 +51,24 @@ class StorageMethod(Protocol):
 
 class Storage:
     """Maps torrent-global offsets onto the metainfo file table."""
+
+    def set_unwanted_files(self, file_indices) -> None:
+        """Partfile routing for deselected files: their boundary-piece
+        spill goes to a hidden mirror instead of visible stub files.
+        No-op on backends without partfile support (MemoryStorage)."""
+        setter = getattr(self.method, "set_unwanted", None)
+        if setter is None:
+            return
+        unwanted_idx = set(file_indices)
+        paths = set()
+        all_paths = []
+        for i, (path, _, _) in enumerate(self._files):
+            if path is None:
+                continue
+            all_paths.append(path)
+            if i in unwanted_idx:
+                paths.add(path)
+        setter(paths, all_paths)
 
     def __init__(self, method: StorageMethod, info: InfoDict):
         self.method = method
@@ -269,16 +288,67 @@ class FsStorage:
     per call — read_batch hits the same files tens of thousands of times.
     """
 
+    PARTS_DIR = ".parts"
+
     def __init__(self, root: str | os.PathLike):
         self.root = os.fspath(root)
         self._handles: dict[tuple[str, ...], object] = {}
         self._lock = threading.Lock()
+        # deselected files: their boundary-piece spill is routed into a
+        # hidden .parts mirror instead of creating visible stub files
+        # (the partfile behavior of long-lived clients)
+        self._unwanted: set[tuple[str, ...]] = set()
+        self._parts_cache: dict[tuple[str, ...], str] = {}
+
+    def set_unwanted(self, paths, all_paths=()) -> None:
+        """Route these files' IO into the parts mirror; every WANTED path
+        (from ``all_paths``) that has a mirror file is promoted — mirror
+        renamed into place — so spilled bytes survive both a selection
+        widening and a process restart (the selection is re-applied
+        before start, which re-triggers promotion)."""
+        new = {tuple(p) for p in paths}
+        with self._lock:
+            self._unwanted = new
+            # drop (don't close) cached handles: a worker thread may be
+            # mid-pread on one — clearing lets in-flight readers finish
+            # on their own reference while new opens re-route
+            self._handles.clear()
+        for path in {tuple(p) for p in all_paths} - new:
+            self._promote(path)
+
+    def _parts_abspath(self, path: tuple[str, ...]) -> str:
+        cached = self._parts_cache.get(path)
+        if cached is None:
+            tail = path[-1][-40:]
+            key = hashlib.sha1("/".join(path).encode("utf-8")).hexdigest()[:16]
+            cached = os.path.join(self.root, self.PARTS_DIR, f"{key}_{tail}")
+            self._parts_cache[path] = cached
+        return cached
+
+    def _promote(self, path: tuple[str, ...]) -> None:
+        parts = self._parts_abspath(path)
+        if not os.path.exists(parts):
+            return
+        real = os.path.join(self.root, *path)
+        if os.path.exists(real):
+            # both exist (external interference or a pre-seeded file):
+            # the real file wins for IO, but spilled bytes are DATA —
+            # never delete them; the orphaned mirror is inert
+            return
+        os.makedirs(os.path.dirname(real), exist_ok=True)
+        os.replace(parts, real)
 
     def _abspath(self, path: tuple[str, ...]) -> str:
         for part in path:
             if part in ("", ".", "..") or "/" in part or "\\" in part or "\x00" in part:
                 raise StorageError(f"unsafe path component {part!r}")
-        return os.path.join(self.root, *path)
+        real = os.path.join(self.root, *path)
+        if path in self._unwanted and not os.path.exists(real):
+            # mirror only files with NO real presence: a deselected file
+            # that already holds verified data keeps reading/writing in
+            # place (no visible-artifact problem — it already exists)
+            return self._parts_abspath(path)
+        return real
 
     def _open_read(self, path: tuple[str, ...]):
         with self._lock:

@@ -151,6 +151,52 @@ class TestWebseedDownload:
 
         run(go())
 
+    def test_resumed_partial_does_not_wedge_webseed_only_session(self, tmp_path):
+        """A stale partial (resumed from checkpoint, no peers to finish
+        it) must be fair game for the webseed picker — without that a
+        webseed-only session sits one piece short forever."""
+        from torrent_tpu.session.torrent import _PartialPiece
+
+        async def go():
+            rng = np.random.default_rng(93)
+            payload = rng.integers(0, 256, size=98_304, dtype=np.uint8).tobytes()
+            (tmp_path / "ws-stale").write_bytes(payload)
+            httpd, base = serve_dir(tmp_path)
+            client = Client(ClientConfig(host="127.0.0.1"))
+            client.config.torrent = fast_config(webseed_retry=0.3)
+            await client.start()
+            try:
+                tb = bencode(
+                    {
+                        b"announce": b"",
+                        b"url-list": [base.encode()],
+                        b"info": {
+                            b"name": b"ws-stale",
+                            b"piece length": 32768,
+                            b"pieces": b"".join(
+                                hashlib.sha1(payload[i : i + 32768]).digest()
+                                for i in range(0, len(payload), 32768)
+                            ),
+                            b"length": len(payload),
+                        },
+                    }
+                )
+                m = parse_metainfo(tb)
+                t = await client.add(m, Storage(MemoryStorage(), m.info))
+                # inject a stale resumed partial for piece 1: one block
+                # received, nothing in flight, no peers exist
+                stale = _PartialPiece(index=1, length=32768, buffer=bytearray(32768))
+                stale.buffer[0:16384] = payload[32768 : 32768 + 16384]
+                stale.received.add(0)
+                t._partials[1] = stale
+                await asyncio.wait_for(t.on_complete.wait(), timeout=30)
+                assert t.storage.get(0, len(payload)) == payload
+            finally:
+                await client.close()
+                httpd.shutdown()
+
+        run(go())
+
     def test_corrupt_webseed_rejected(self, tmp_path):
         """A webseed serving wrong bytes never pollutes storage."""
 

@@ -231,6 +231,9 @@ class Torrent:
         self._stream_base: np.ndarray | None = None
         self._stream_positions: dict[object, tuple[int, int]] = {}
         self._piece_events: dict[int, asyncio.Event] = {}
+        # last persisted partial set (serialized form) — carried forward
+        # by periodic checkpoints until the pieces complete
+        self._saved_partials: dict[int, tuple[bytes, bytes]] = {}
         # cached count of wanted-but-missing pieces: _fill_pipeline gates
         # on it per block, so it must be O(1) there (the numpy recount
         # runs only on selection changes and recheck/resume)
@@ -368,8 +371,9 @@ class Torrent:
         self._piece_priority = prio
         # partfile routing: deselected files' boundary spill goes to the
         # hidden parts mirror; files (re-)entering the selection are
-        # promoted back into place (no-op for memory backends)
-        self.storage.set_unwanted_files(unwanted_files)
+        # promoted back into place (no-op for memory backends). Off the
+        # event loop: the promote sweep stats every file once.
+        await asyncio.to_thread(self.storage.set_unwanted_files, unwanted_files)
         # a new selection invalidates the boost snapshot; active reader
         # windows re-apply over the new mask, and parked readers re-check
         # (a newly-deselected piece must raise, not hang)
@@ -668,6 +672,9 @@ class Torrent:
                 # drop it and let the scheduler re-fetch the piece
                 continue
             self._partials[index] = partial
+            # periodic checkpoints keep carrying this partial until the
+            # piece completes (an unclean death must not lose it)
+            self._saved_partials[index] = (mask, data)
         self.storage.mark_pieces_written(
             i for i in range(self.info.num_pieces) if bf.has(i)
         )
@@ -686,8 +693,8 @@ class Torrent:
         # 16-piece checkpoint would do megabytes of copy+bencode+write on
         # the event loop mid-download. Entry-count capping happens once,
         # in ResumeData.encode.
-        partials = {}
         if include_partials:
+            partials = {}
             for index, p in list(self._partials.items()):
                 if not p.received or p.complete:
                     # empty webseed reservations carry nothing; COMPLETE
@@ -701,6 +708,17 @@ class Torrent:
                     b = begin // BLOCK_SIZE
                     mask[b // 8] |= 1 << (b % 8)
                 partials[index] = (bytes(mask), bytes(p.buffer))
+            self._saved_partials = partials
+        else:
+            # the periodic checkpoint carries FORWARD previously saved
+            # partials (already-serialized bytes, no buffer copying) for
+            # pieces still incomplete — an unclean death between a
+            # resume and the next stop must not lose them
+            partials = {
+                i: sp
+                for i, sp in self._saved_partials.items()
+                if not self.bitfield.has(i)
+            }
         try:
             self.resume_store.save(
                 ResumeData(
@@ -1922,7 +1940,13 @@ class Torrent:
         for index, partial in list(self._partials.items()):
             if partial.webseed:
                 continue
-            if peer.bitfield.has(index) and not self.bitfield.has(index) and pickable(index):
+            if (
+                peer.bitfield.has(index)
+                and not self.bitfield.has(index)
+                and self._piece_priority[index] > 0  # deselected partials
+                # (e.g. resumed then deselected) must not outrank wanted
+                and pickable(index)
+            ):
                 if take_from(index):
                     break
         # Active stream windows outrank everything below: a parked HTTP
@@ -2423,7 +2447,13 @@ class Torrent:
         if released_any:
             for p in list(self.peers.values()):
                 if not p.snubbed and not p.peer_choking and p.am_interested:
-                    await self._fill_pipeline(p)
+                    try:
+                        await self._fill_pipeline(p)
+                    except (ConnectionError, OSError):
+                        # a reset socket whose peer-loop hasn't noticed
+                        # yet must not kill the CHOKE loop for the
+                        # torrent's remaining lifetime
+                        continue
 
     async def _choke_loop(self) -> None:
         """Unchoke top reciprocators + one optimistic random (BEP 3).
@@ -2521,18 +2551,46 @@ class Torrent:
     # ------------------------------------------------------------ webseeds
 
     def _pick_webseed_pieces(self, n: int) -> list[int]:
-        """Missing pieces nobody is working on, rarest (in the swarm)
-        first — the webseed complements peers instead of racing them."""
+        """Missing pieces nobody is working on, stream windows first,
+        then rarest (in the swarm) — the webseed complements peers
+        instead of racing them.
+
+        A STALE partial (blocks received but none in flight — typically
+        a resumed checkpoint with no peer holding the piece) is fair
+        game: without this, a webseed-only session could never finish a
+        resumed partial and would sit short of completion forever. The
+        HTTP fetch re-downloads the whole piece; the reserve/handback
+        logic in the loop already covers racing late wire blocks.
+        """
         if self._rarity_dirty:
             self._rebuild_rarity()
         busy = {blk[0] for blk, c in self._inflight_count.items() if c > 0}
         picked = []
+
+        def eligible(index: int) -> bool:
+            if self.bitfield.has(index) or index in busy:
+                return False
+            if self._piece_priority[index] <= 0:
+                return False
+            p = self._partials.get(index)
+            if p is not None and (p.webseed or not p.received):
+                return False  # reserved by another webseed loop
+            return True
+
+        # stream readers are latency-bound on exactly these pieces — the
+        # same priority the wire picker gives them (the delta-path window
+        # advance never rebuilds the rarity order, so consult directly)
+        for first, count in sorted(self._stream_positions.values()):
+            for index in range(first, min(first + count, self.info.num_pieces)):
+                if eligible(index) and index not in picked:
+                    picked.append(index)
+                    if len(picked) >= n:
+                        return picked
         for index in self._rarity_order:
-            if self.bitfield.has(index) or index in self._partials or index in busy:
-                continue
-            picked.append(index)
-            if len(picked) >= n:
-                break
+            if eligible(index) and index not in picked:
+                picked.append(index)
+                if len(picked) >= n:
+                    break
         return picked
 
     def _spawn_seed_loops(self) -> None:

@@ -326,17 +326,23 @@ class FsStorage:
         return cached
 
     def _promote(self, path: tuple[str, ...]) -> None:
-        parts = self._parts_abspath(path)
-        if not os.path.exists(parts):
-            return
-        real = os.path.join(self.root, *path)
-        if os.path.exists(real):
-            # both exist (external interference or a pre-seeded file):
-            # the real file wins for IO, but spilled bytes are DATA —
-            # never delete them; the orphaned mirror is inert
-            return
-        os.makedirs(os.path.dirname(real), exist_ok=True)
-        os.replace(parts, real)
+        # under the lock: set() resolves-and-opens under the same lock,
+        # so a threaded writer either opens the mirror BEFORE the rename
+        # (its fd follows the inode — the write lands in the promoted
+        # real file) or resolves the real path after; never a freshly
+        # recreated mirror the rename already left behind
+        with self._lock:
+            parts = self._parts_abspath(path)
+            if not os.path.exists(parts):
+                return
+            real = os.path.join(self.root, *path)
+            if os.path.exists(real):
+                # both exist (external interference or a pre-seeded
+                # file): the real file wins for IO, but spilled bytes
+                # are DATA — never delete them; the orphan is inert
+                return
+            os.makedirs(os.path.dirname(real), exist_ok=True)
+            os.replace(parts, real)
 
     def _abspath(self, path: tuple[str, ...]) -> str:
         for part in path:
@@ -376,11 +382,16 @@ class FsStorage:
         return data
 
     def set(self, path: tuple[str, ...], offset: int, data: bytes) -> None:
-        abspath = self._abspath(path)
         try:
-            os.makedirs(os.path.dirname(abspath), exist_ok=True)
-            # Open for in-place update without truncating (storage.ts:174-196).
-            fd = os.open(abspath, os.O_WRONLY | os.O_CREAT, 0o644)
+            # resolve+open under the lock (see _promote): routing and the
+            # rename can't interleave with this open. The pwrite itself
+            # runs unlocked — it follows the fd's inode wherever a
+            # concurrent promote renamed it.
+            with self._lock:
+                abspath = self._abspath(path)
+                os.makedirs(os.path.dirname(abspath), exist_ok=True)
+                # in-place update without truncating (storage.ts:174-196)
+                fd = os.open(abspath, os.O_WRONLY | os.O_CREAT, 0o644)
             try:
                 os.pwrite(fd, data, offset)
             finally:

@@ -234,6 +234,9 @@ class Torrent:
         # last persisted partial set (serialized form) — carried forward
         # by periodic checkpoints until the pieces complete
         self._saved_partials: dict[int, tuple[bytes, bytes]] = {}
+        # selection updates serialize (they suspend for the partfile
+        # sweep; interleaving would desync priorities from routing)
+        self._selection_lock = asyncio.Lock()
         # cached count of wanted-but-missing pieces: _fill_pipeline gates
         # on it per block, so it must be O(1) there (the numpy recount
         # runs only on selection changes and recheck/resume)
@@ -354,6 +357,13 @@ class Torrent:
                 raise IndexError(f"no file #{idx} (torrent has {len(ranges)})")
             if not 0 <= int(p) <= 127:
                 raise ValueError(f"priority {p} for file #{idx}: must be 0..127")
+        # Serialized: the body suspends (partfile sweep in a thread), and
+        # interleaved calls could otherwise leave the priority array from
+        # one selection with the storage routing of another.
+        async with self._selection_lock:
+            await self._apply_file_priorities(priorities, ranges)
+
+    async def _apply_file_priorities(self, priorities: dict[int, int], ranges) -> None:
         plen = self.info.piece_length
         entries = self.info.files or ()
         prio = np.zeros(self.info.num_pieces, dtype=np.int8)
@@ -713,12 +723,15 @@ class Torrent:
             # the periodic checkpoint carries FORWARD previously saved
             # partials (already-serialized bytes, no buffer copying) for
             # pieces still incomplete — an unclean death between a
-            # resume and the next stop must not lose them
+            # resume and the next stop must not lose them. Re-assigning
+            # the filtered dict also releases completed pieces' buffers
+            # instead of pinning them in RAM for the session's lifetime.
             partials = {
                 i: sp
                 for i, sp in self._saved_partials.items()
                 if not self.bitfield.has(i)
             }
+            self._saved_partials = partials
         try:
             self.resume_store.save(
                 ResumeData(
@@ -2637,6 +2650,16 @@ class Torrent:
             # reserve so peers/other webseeds skip these pieces meanwhile
             reserved = []
             for index in picked:
+                existing = self._partials.get(index)
+                if existing is not None:
+                    # ADOPT a stale wire partial in place (resumed, or a
+                    # dropped peer's leftovers): its received blocks and
+                    # their downloaded-bytes accounting survive — on
+                    # failure the handback returns them to the block
+                    # scheduler, on success `already` subtracts them
+                    existing.webseed = True
+                    reserved.append(existing)
+                    continue
                 partial = _PartialPiece(
                     index=index,
                     length=piece_length(self.info, index),
@@ -2713,6 +2736,13 @@ class Torrent:
 
     # ------------------------------------------------------------- status
 
+    def _count_encrypted_peers(self) -> int:
+        from torrent_tpu.net.mse import WrappedWriter
+
+        return sum(
+            1 for p in self.peers.values() if isinstance(p.writer, WrappedWriter)
+        )
+
     def status(self) -> dict:
         return {
             "state": self.state.value,
@@ -2729,4 +2759,10 @@ class Torrent:
             "download_rate": round(
                 sum(p.download_rate() for p in self.peers.values()), 1
             ),
+            "encryption": self.config.encryption,
+            "encrypted_peers": self._count_encrypted_peers(),
+            "stream_readers": len(self._stream_positions),
+            "partials": len(self._partials),
+            "max_upload_bps": self.config.max_upload_bps,
+            "max_download_bps": self.config.max_download_bps,
         }

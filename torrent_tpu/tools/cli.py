@@ -124,13 +124,33 @@ def _cmd_info(args) -> int:
     print(f"total size:   {info.length:,} bytes")
     print(f"piece length: {info.piece_length:,}")
     print(f"pieces:       {info.num_pieces:,}")
+    if m.raw.get(b"info", {}).get(b"private") == 1:
+        print("private:      yes (BEP 27)")
+    if m.web_seeds:
+        print(f"web seeds:    {len(m.web_seeds)} (BEP 19)")
+        for u in m.web_seeds[:5]:
+            print(f"  - {u}")
+    if m.http_seeds:
+        print(f"http seeds:   {len(m.http_seeds)} (BEP 17)")
+        for u in m.http_seeds[:5]:
+            print(f"  - {u}")
     if info.files is not None:
-        print(f"files:        {len(info.files)}")
+        pads = sum(1 for fe in info.files if getattr(fe, "pad", False))
+        print(
+            f"files:        {len(info.files) - pads}"
+            + (f" (+{pads} BEP 47 pad files)" if pads else "")
+        )
         # indices are the handles `download --files I,J` takes
-        for i, fe in enumerate(info.files[:20]):
+        shown = 0
+        for i, fe in enumerate(info.files):
+            if getattr(fe, "pad", False):
+                continue
             print(f"  [{i}] {'/'.join(fe.path)}  ({fe.length:,} bytes)")
-        if len(info.files) > 20:
-            print(f"  ... and {len(info.files) - 20} more")
+            shown += 1
+            if shown >= 20:
+                break
+        if len(info.files) - pads > 20:
+            print(f"  ... and {len(info.files) - pads - 20} more")
     return 0
 
 

@@ -43,7 +43,6 @@ from torrent_tpu.ops.sha1_jax import make_sha1_fn
 from torrent_tpu.parallel.mesh import (
     batch_sharding,
     make_mesh,
-    replicated_sharding,
     round_up_to_multiple,
 )
 from torrent_tpu.parallel.verify import VerifyResult

@@ -35,7 +35,7 @@ from dataclasses import dataclass, field
 from torrent_tpu.net.priority import crc32c
 
 from torrent_tpu.codec.bencode import BencodeError, bdecode, bencode
-from torrent_tpu.utils.bytesio import read_int, write_int
+from torrent_tpu.utils.bytesio import write_int
 from torrent_tpu.utils.log import get_logger
 
 log = get_logger("net.dht")

@@ -39,7 +39,6 @@ MAX_NUM_WANT = 500  # bounds compact responses well under one UDP datagram
 from torrent_tpu.net.types import (
     UDP_CODE_EVENT,
     AnnounceEvent,
-    AnnouncePeer,
     UdpTrackerAction,
 )
 from torrent_tpu.utils.bytesio import decode_binary_data, read_int, write_int

@@ -810,6 +810,47 @@ class TestLargeGeometryScaling:
         run(go())
 
 
+class TestIpv6Session:
+    def test_v6_loopback_swarm_with_encryption(self):
+        """The session layer end to end over IPv6 (::1): v6 tracker
+        announce (peers6), v6 TCP accept/dial, MSE required — closing
+        the gap between the tracker/DHT v6 e2es and the session."""
+
+        async def go():
+            rng = np.random.default_rng(66)
+            payload = rng.integers(0, 256, size=120_000, dtype=np.uint8).tobytes()
+            server, pump = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, host="::1", interval=1)
+            )
+            url = f"http://[::1]:{server.http_port}/announce"
+            m = parse_metainfo(build_torrent_bytes(payload, 32768, url.encode()))
+            seed = Client(ClientConfig(host="::1"))
+            leech = Client(ClientConfig(host="::1"))
+            seed.config.torrent = fast_config(encryption="required")
+            leech.config.torrent = fast_config(encryption="required")
+            await seed.start()
+            await leech.start()
+            try:
+                ss = Storage(MemoryStorage(), m.info)
+                ss.set(0, payload)
+                t_seed = await seed.add(m, ss)
+                assert t_seed.state == TorrentState.SEEDING
+                t = await leech.add(m, Storage(MemoryStorage(), m.info))
+                await asyncio.wait_for(t.on_complete.wait(), timeout=30)
+                assert t.storage.get(0, len(payload)) == payload
+                assert (
+                    t.status()["encrypted_peers"] >= 1
+                    or t_seed.status()["encrypted_peers"] >= 1
+                )
+            finally:
+                await seed.close()
+                await leech.close()
+                server.close()
+                await asyncio.wait_for(pump, 5)
+
+        run(go())
+
+
 class TestBroadcastMutationSafety:
     def test_peer_registering_during_have_broadcast(self, monkeypatch):
         """The have-broadcast awaits per send; an inbound peer

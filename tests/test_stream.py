@@ -240,6 +240,49 @@ class TestStreamServerE2E:
 
         run(go(), timeout=90)
 
+    def test_index_lists_files_with_streamability(self):
+        import json
+
+        async def go():
+            payload, server, pump, seed, leech, t = await self._swarm()()
+            stream = await StreamServer(t).start()
+            try:
+                status, headers, body = await asyncio.to_thread(
+                    _http_get, f"http://127.0.0.1:{stream.port}/"
+                )
+                assert status == 200
+                assert headers["Content-Type"].startswith("application/json")
+                idx = json.loads(body)
+                assert idx["files"] == [
+                    {
+                        "index": 0,
+                        "path": "swarm-test",
+                        "length": len(payload),
+                        "streamable": True,
+                    }
+                ]
+                # deselection flips streamability — on a torrent with NO
+                # data yet (a completed torrent stays streamable: every
+                # piece is on disk)
+                t_bare, _ = make_torrent()
+                await t_bare.set_file_priorities({0: 0})
+                stream2 = await StreamServer(t_bare).start()
+                try:
+                    _, _, body2 = await asyncio.to_thread(
+                        _http_get, f"http://127.0.0.1:{stream2.port}/index.json"
+                    )
+                    assert json.loads(body2)["files"][0]["streamable"] is False
+                finally:
+                    stream2.close()
+            finally:
+                stream.close()
+                await seed.close()
+                await leech.close()
+                server.close()
+                await asyncio.wait_for(pump, 5)
+
+        run(go(), timeout=60)
+
     def test_deselected_file_is_409_not_a_hang(self):
         """GET for a file excluded from the selection answers immediately
         instead of parking on pieces that will never be scheduled."""

@@ -579,11 +579,9 @@ async def _download(args) -> int:
             from torrent_tpu.tools.stream import StreamServer
 
             stream_server = await StreamServer(torrent).start(args.stream_port)
-            entries = torrent.info.files or ()
-            names = ["/".join(f.path) for f in entries] or [torrent.info.name]
-            for i, name in enumerate(names):
-                if i < len(entries) and getattr(entries[i], "pad", False):
-                    continue  # BEP 47 pad files are never servable
+            from torrent_tpu.tools.stream import content_files
+
+            for i, name, _, _ in content_files(torrent):
                 print(
                     f"streaming http://127.0.0.1:{stream_server.port}/{i}  ({name})",
                     file=sys.stderr,

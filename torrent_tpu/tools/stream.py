@@ -32,9 +32,23 @@ def _http_date() -> str:
     return formatdate(usegmt=True)
 
 
+def content_files(torrent):
+    """(index, display_path, start, length) for every non-pad file —
+    the single source for what the streamer and the CLI announce."""
+    entries = torrent.info.files or ()
+    for i, (start, length) in enumerate(torrent.file_ranges()):
+        fe = entries[i] if i < len(entries) else None
+        if fe is not None and getattr(fe, "pad", False):
+            continue  # BEP 47 pads aren't content
+        name = "/".join(fe.path) if fe is not None else torrent.info.name
+        yield i, name, start, length
+
+
 class StreamServer:
-    """One-torrent HTTP streamer: ``GET /<file_index>`` (or ``/``) with
-    Range support, backed by the torrent's verified storage."""
+    """One-torrent HTTP streamer: ``GET /<file_index>`` with Range
+    support, backed by the torrent's verified storage. ``GET /`` (or
+    ``/index.json``) returns a JSON file index — players and scripts
+    discover indices there rather than guessing."""
 
     def __init__(self, torrent, host: str = "127.0.0.1", window_pieces: int = 16):
         self.torrent = torrent
@@ -73,6 +87,7 @@ class StreamServer:
                 await self._plain(writer, 405, b"method not allowed")
                 return
             method, path = parts[0], parts[1].decode("latin-1", "replace")
+            path = path.split("?", 1)[0]  # queries never change routing
             rng = None
             while True:
                 line = await asyncio.wait_for(reader.readline(), timeout=30)
@@ -80,6 +95,10 @@ class StreamServer:
                     break
                 if line.lower().startswith(b"range:"):
                     rng = line.split(b":", 1)[1].strip().decode("latin-1", "replace")
+            if path in ("/", "/index.json"):
+                # discovery: players/users can't guess file indices
+                await self._index(writer, method)
+                return
             try:
                 file_index = int(path.lstrip("/") or "0")
                 if file_index < 0:
@@ -135,6 +154,31 @@ class StreamServer:
             pass
         finally:
             writer.close()
+
+    async def _index(self, writer, method: bytes) -> None:
+        """JSON file index: [{index, path, length, streamable}]."""
+        import json
+
+        t = self.torrent
+        out = [
+            {
+                "index": i,
+                "path": name,
+                "length": length,
+                "streamable": length > 0 and t.span_servable(start, length),
+            }
+            for i, name, start, length in content_files(t)
+        ]
+        body = json.dumps({"name": t.info.name, "files": out}).encode()
+        writer.write(
+            (
+                "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+            ).encode("latin-1")
+        )
+        if method != b"HEAD":
+            writer.write(body)
+        await writer.drain()
 
     async def _plain(self, writer, status: int, body: bytes, extra: str = ""):
         writer.write(

@@ -45,6 +45,10 @@ class ClientConfig:
     hasher: str = "cpu"  # 'cpu' | 'tpu' piece verification (BASELINE API)
     torrent: TorrentConfig = field(default_factory=TorrentConfig)
     enable_upnp: bool = False  # optional, off by default (SURVEY §7.8)
+    # NAT-PMP (RFC 6886): lighter port mapping many gateways speak when
+    # they don't do UPnP IGD; also used as a fallback when enable_upnp
+    # finds no gateway. Renewed at half-lifetime while running.
+    enable_natpmp: bool = False
     resume: bool = True  # fastresume checkpoints for path-based storage
     enable_dht: bool = False  # BEP 5 mainline DHT (net/dht.py)
     dht_port: int = 0  # 0 = ephemeral UDP port
@@ -89,6 +93,13 @@ class Client:
         self.download_bucket = TokenBucket(self.config.max_download_bps)
         self.lsd = None  # net.lsd.LocalServiceDiscovery when enable_lsd
         self.utp = None  # net.utp.UtpEndpoint when enable_utp
+        self._natpmp_task: asyncio.Task | None = None
+        # test seams: a fake gateway address/port instead of the route table
+        self._natpmp_gateway: str | None = None
+        self._natpmp_port: int = 5351
+        # the port the gateway actually forwards (differs from self.port
+        # when the NAT-PMP suggestion wasn't honored); announces use it
+        self.external_port: int | None = None
         if self.config.ip_filter:
             from torrent_tpu.net.ipfilter import IpFilter
 
@@ -134,6 +145,15 @@ class Client:
                 self.external_ip = ips.external_ip
             except Exception as e:  # UPnP is best-effort
                 log.warning("UPnP setup failed: %s", e)
+        if self.config.enable_natpmp and self.external_ip is None:
+            # explicit: worth blocking start briefly — the learned
+            # external IP lets the DHT mint a BEP 42 id below
+            await self._try_natpmp()
+        elif self.config.enable_upnp and self.external_ip is None:
+            # fallback after a failed UPnP probe: run in the background —
+            # a gateway speaking NEITHER protocol would otherwise add the
+            # whole retry ladder (~8 s) to every start
+            self._natpmp_task = asyncio.create_task(self._try_natpmp())
         if self.config.enable_dht:
             from torrent_tpu.net.dht import DHTNode
 
@@ -184,6 +204,73 @@ class Client:
                 self.config.host, self.port, on_accept=self._accept
             )
 
+    async def _try_natpmp(self) -> None:
+        """Best-effort NAT-PMP mapping + external IP, renewed at half of
+        each GRANTED lifetime (gateways may shorten grants over time)."""
+        from torrent_tpu.net import natpmp
+
+        gateway = self._natpmp_gateway or natpmp.default_gateway()
+        if gateway is None:
+            log.warning("NAT-PMP: no default gateway found")
+            return
+        try:
+            self.external_ip = await natpmp.external_address(
+                gateway, port=self._natpmp_port
+            )
+            granted, lifetime = await natpmp.map_port(
+                gateway, self.port, tcp=True, port=self._natpmp_port
+            )
+            await natpmp.map_port(
+                gateway, self.port, external_port=granted, tcp=False,
+                port=self._natpmp_port,
+            )  # uTP/DHT share the port number over UDP
+        except (natpmp.NatPmpError, OSError) as e:
+            log.warning("NAT-PMP setup failed: %s", e)
+            return
+        if granted != self.port:
+            # the suggestion is only a hint — announces must advertise
+            # the port the gateway actually forwards
+            self.external_port = granted
+        self._natpmp_gateway = gateway
+        log.info(
+            "NAT-PMP: external %s, port %d -> %d", self.external_ip, self.port, granted
+        )
+
+        async def renew():
+            life = lifetime
+            ext = granted
+            while True:
+                await asyncio.sleep(min(3600, max(30, life // 2)))
+                try:
+                    ext, life = await natpmp.map_port(
+                        gateway, self.port, external_port=ext, tcp=True,
+                        port=self._natpmp_port,
+                    )
+                    await natpmp.map_port(
+                        gateway, self.port, external_port=ext, tcp=False,
+                        port=self._natpmp_port,
+                    )
+                except (natpmp.NatPmpError, OSError) as e:
+                    log.warning("NAT-PMP renewal failed: %s", e)
+
+        self._natpmp_task = asyncio.create_task(renew())
+
+    async def _natpmp_unmap(self) -> None:
+        """Delete our mappings (RFC 6886 §3.4): the gateway must not keep
+        forwarding to a dead socket for the rest of the lease."""
+        from torrent_tpu.net import natpmp
+
+        if self._natpmp_gateway is None or self.port is None:
+            return
+        for tcp in (True, False):
+            try:
+                await natpmp.map_port(
+                    self._natpmp_gateway, self.port, lifetime=0, tcp=tcp,
+                    port=self._natpmp_port,
+                )
+            except (natpmp.NatPmpError, OSError):
+                pass
+
     def _on_lsd_peer(self, info_hash: bytes, addr: tuple[str, int]) -> None:
         """BEP 14 callback: a local client announced this swarm."""
         torrent = self.torrents.get(info_hash)
@@ -205,6 +292,10 @@ class Client:
         if self._dht_maintenance is not None:
             self._dht_maintenance.cancel()
             self._dht_maintenance = None
+        if self._natpmp_task is not None:
+            self._natpmp_task.cancel()
+            self._natpmp_task = None
+            await self._natpmp_unmap()
         if self.dht is not None:
             if self.config.dht_state_path:
                 try:
@@ -282,7 +373,7 @@ class Client:
             metainfo=metainfo,
             storage=storage,
             peer_id=self.config.peer_id,
-            port=self.port,
+            port=self.external_port or self.port,
             config=torrent_config,
             # the shared TPUVerifier is the SHA-1 plane — v2 pieces verify
             # against merkle roots instead (session/torrent.py v2 branch)
@@ -372,7 +463,7 @@ class Client:
         metainfo = await fetch_metadata(
             magnet,
             peer_id=generate_peer_id(),
-            port=self.port,
+            port=self.external_port or self.port,
             dht=self.dht,
             ip_filter=self.ip_filter,
             proxy=self.proxy,

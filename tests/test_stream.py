@@ -7,6 +7,7 @@ not-yet-downloaded regions, suffix ranges, HEAD, and 416s.
 """
 
 import asyncio
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -278,6 +279,73 @@ class TestStreamServerE2E:
                 stream.close()
                 await seed.close()
                 await leech.close()
+                server.close()
+                await asyncio.wait_for(pump, 5)
+
+        run(go(), timeout=60)
+
+    def test_box_server_streams_many_torrents(self):
+        """BoxStreamServer: torrent discovery at /, per-torrent file
+        indices, Range streaming routed by infohash."""
+        import json
+
+        from torrent_tpu.tools.stream import BoxStreamServer
+
+        async def go():
+            rng = np.random.default_rng(65)
+            server, pump, announce_url = await start_tracker()
+            seed = Client(ClientConfig(host="127.0.0.1"))
+            seed.config.torrent = fast_config()
+            await seed.start()
+            box = None
+            try:
+                metas = []
+                for name in (b"alpha.bin", b"beta.bin"):
+                    payload = rng.integers(0, 256, size=150_000, dtype=np.uint8).tobytes()
+                    m = parse_metainfo(
+                        build_torrent_bytes(payload, 32768, announce_url.encode(), name=name)
+                    )
+                    st = Storage(MemoryStorage(), m.info)
+                    st.set(0, payload)
+                    await seed.add(m, st)
+                    metas.append((m, payload))
+                box = await BoxStreamServer(seed).start()
+                status, headers, body = await asyncio.to_thread(
+                    _http_get, f"http://127.0.0.1:{box.port}/"
+                )
+                listing = json.loads(body)
+                assert {t["name"] for t in listing["torrents"]} == {
+                    "alpha.bin", "beta.bin",
+                }
+                assert all(t["complete"] for t in listing["torrents"])
+                for m, payload in metas:
+                    ih = m.info_hash.hex()
+                    _, _, idx_body = await asyncio.to_thread(
+                        _http_get, f"http://127.0.0.1:{box.port}/{ih}/"
+                    )
+                    files = json.loads(idx_body)["files"]
+                    assert files[0]["length"] == len(payload)
+                    status, _, got = await asyncio.to_thread(
+                        _http_get,
+                        f"http://127.0.0.1:{box.port}/{ih}/0",
+                        {"Range": "bytes=100-4195"},
+                    )
+                    assert status == 206 and got == payload[100:4196]
+                # unknown torrent → 404
+                def missing():
+                    try:
+                        with urllib.request.urlopen(
+                            f"http://127.0.0.1:{box.port}/{'00' * 20}/0", timeout=10
+                        ) as r:
+                            return r.status
+                    except urllib.error.HTTPError as e:
+                        return e.code
+
+                assert await asyncio.to_thread(missing) == 404
+            finally:
+                if box is not None:
+                    box.close()
+                await seed.close()
                 server.close()
                 await asyncio.wait_for(pump, 5)
 

@@ -351,7 +351,7 @@ async def _seed_box(args) -> int:
             loop.add_signal_handler(sig, stop.set)
         except NotImplementedError:  # pragma: no cover - non-unix
             pass
-    metrics_server = None
+    metrics_server = box_stream = None
     try:
         added = 0
         for path in torrent_files:
@@ -392,6 +392,15 @@ async def _seed_box(args) -> int:
                 f"metrics http://127.0.0.1:{metrics_server.port}/metrics",
                 file=sys.stderr,
             )
+        if getattr(args, "stream_port", None) is not None:
+            from torrent_tpu.tools.stream import BoxStreamServer
+
+            box_stream = await BoxStreamServer(client).start(args.stream_port)
+            print(
+                f"streaming http://127.0.0.1:{box_stream.port}/ "
+                "(/{infohash}/{file})",
+                file=sys.stderr,
+            )
         print(
             f"seeding {added} torrent(s) on port {client.port} (ctrl-c to stop)",
             file=sys.stderr,
@@ -415,6 +424,8 @@ async def _seed_box(args) -> int:
     finally:
         if metrics_server is not None:
             metrics_server.close()
+        if box_stream is not None:
+            box_stream.close()
         await client.close()
 
 
@@ -873,6 +884,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("--super-seed", action="store_true", help="BEP 16 on every torrent")
     sp.add_argument("--metrics-port", type=int, default=None, metavar="PORT")
+    sp.add_argument(
+        "--stream-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="HTTP media server over every torrent: / lists torrents, "
+        "/<infohash>/<file> streams (0 = ephemeral)",
+    )
     sp.set_defaults(fn=_cmd_seed)
 
     sp = sub.add_parser("tracker", help="run the in-memory tracker server")

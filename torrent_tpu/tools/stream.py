@@ -26,10 +26,138 @@ log = get_logger("tools.stream")
 CHUNK = 256 * 1024  # read/serve granularity; also the window advance step
 
 
+class BoxStreamServer:
+    """Whole-client HTTP streamer (the seeding-box media server):
+    ``GET /`` lists torrents, ``GET /<infohash-hex>/`` lists a torrent's
+    files, ``GET /<infohash-hex>/<index>`` streams one (Range-capable,
+    verified bytes only). Reuses the one-torrent StreamServer per
+    registered torrent, routed by infohash."""
+
+    def __init__(self, client, host: str = "127.0.0.1"):
+        self.client = client
+        self.host = host
+        self.port: int | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._handlers: set[asyncio.Task] = set()
+        self._per_torrent: dict[bytes, StreamServer] = {}
+
+    async def start(self, port: int = 0) -> "BoxStreamServer":
+        self._server = await asyncio.start_server(self._accept, self.host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    def _accept(self, reader, writer):
+        task = asyncio.ensure_future(self._handle(reader, writer))
+        self._handlers.add(task)
+        task.add_done_callback(self._handlers.discard)
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for task in list(self._handlers):
+            task.cancel()
+        for sub in self._per_torrent.values():
+            sub.torrent.clear_stream_window()
+
+    def _sub(self, torrent) -> "StreamServer":
+        key = torrent.metainfo.info_hash
+        sub = self._per_torrent.get(key)
+        if sub is None or sub.torrent is not torrent:
+            # identity check: a removed-and-re-added torrent is a NEW
+            # object; serving the cached dead one would park forever
+            sub = self._per_torrent[key] = StreamServer(torrent, host=self.host)
+        return sub
+
+    async def _handle(self, reader, writer):
+        try:
+            parsed = await _parse_http_head(reader)
+            if parsed is None:
+                await _plain_response(writer, 405, b"method not allowed")
+                return
+            method, path, rng = parsed
+            segs = [s for s in path.split("/") if s]
+            if not segs:
+                import json
+
+                out = [
+                    {
+                        "info_hash": ih.hex(),
+                        "name": t.info.name,
+                        "files": sum(1 for _ in content_files(t)),
+                        "complete": t.bitfield.complete,
+                    }
+                    for ih, t in self.client.torrents.items()
+                ]
+                body = json.dumps({"torrents": out}).encode()
+                writer.write(
+                    (
+                        "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                        f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+                    ).encode("latin-1")
+                    + (body if method != b"HEAD" else b"")
+                )
+                await writer.drain()
+                return
+            try:
+                torrent = self.client.torrents.get(bytes.fromhex(segs[0]))
+            except ValueError:
+                torrent = None
+            if torrent is None:
+                await _plain_response(writer, 404, b"no such torrent")
+                return
+            # delegate to the per-torrent server with the subpath
+            sub = self._sub(torrent)
+            subpath = "/" + "/".join(segs[1:]) if len(segs) > 1 else "/"
+            await sub.serve_parsed(writer, method, subpath, rng)
+        except (
+            ConnectionError,
+            asyncio.TimeoutError,
+            asyncio.LimitOverrunError,
+            ValueError,
+            OSError,
+            RuntimeError,
+            LookupError,
+            StorageError,
+        ):
+            pass
+        finally:
+            writer.close()
+
+
 def _http_date() -> str:
     from email.utils import formatdate
 
     return formatdate(usegmt=True)
+
+
+async def _parse_http_head(reader):
+    """→ (method, path-without-query, range-header | None), or None for
+    a non-GET/HEAD request line. One parser for both stream servers."""
+    request = await asyncio.wait_for(reader.readline(), timeout=30)
+    parts = request.split()
+    if len(parts) < 2 or parts[0] not in (b"GET", b"HEAD"):
+        return None
+    method = parts[0]
+    path = parts[1].decode("latin-1", "replace").split("?", 1)[0]
+    rng = None
+    while True:
+        line = await asyncio.wait_for(reader.readline(), timeout=30)
+        if line in (b"\r\n", b"\n", b""):
+            break
+        if line.lower().startswith(b"range:"):
+            rng = line.split(b":", 1)[1].strip().decode("latin-1", "replace")
+    return method, path, rng
+
+
+async def _plain_response(writer, status: int, body: bytes, extra: str = "") -> None:
+    writer.write(
+        (
+            f"HTTP/1.1 {status} x\r\nContent-Length: {len(body)}\r\n"
+            f"{extra}Connection: close\r\n\r\n"
+        ).encode("latin-1")
+        + body
+    )
+    await writer.drain()
 
 
 def content_files(torrent):
@@ -81,66 +209,11 @@ class StreamServer:
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
-            request = await asyncio.wait_for(reader.readline(), timeout=30)
-            parts = request.split()
-            if len(parts) < 2 or parts[0] not in (b"GET", b"HEAD"):
+            parsed = await _parse_http_head(reader)
+            if parsed is None:
                 await self._plain(writer, 405, b"method not allowed")
                 return
-            method, path = parts[0], parts[1].decode("latin-1", "replace")
-            path = path.split("?", 1)[0]  # queries never change routing
-            rng = None
-            while True:
-                line = await asyncio.wait_for(reader.readline(), timeout=30)
-                if line in (b"\r\n", b"\n", b""):
-                    break
-                if line.lower().startswith(b"range:"):
-                    rng = line.split(b":", 1)[1].strip().decode("latin-1", "replace")
-            if path in ("/", "/index.json"):
-                # discovery: players/users can't guess file indices
-                await self._index(writer, method)
-                return
-            try:
-                file_index = int(path.lstrip("/") or "0")
-                if file_index < 0:
-                    raise IndexError("negative index")  # no wrap-around files
-                start, length = self._file_span(file_index)
-            except (ValueError, IndexError):
-                await self._plain(writer, 404, b"no such file")
-                return
-            if not self.torrent.span_servable(start, length):
-                # a deselected file's pieces will never be scheduled —
-                # parking the reader would hang the connection forever
-                await self._plain(writer, 409, b"file not selected for download")
-                return
-            lo, hi = 0, length - 1
-            status = 200
-            if rng is not None:
-                parsed = self._parse_range(rng, length)
-                if parsed is None:
-                    await self._plain(
-                        writer,
-                        416,
-                        b"bad range",
-                        extra=f"Content-Range: bytes */{length}\r\n",
-                    )
-                    return
-                lo, hi = parsed
-                status = 206
-            headers = [
-                f"HTTP/1.1 {status} {'Partial Content' if status == 206 else 'OK'}",
-                f"Date: {_http_date()}",
-                "Accept-Ranges: bytes",
-                "Content-Type: application/octet-stream",
-                f"Content-Length: {hi - lo + 1}",
-                "Connection: close",
-            ]
-            if status == 206:
-                headers.append(f"Content-Range: bytes {lo}-{hi}/{length}")
-            writer.write(("\r\n".join(headers) + "\r\n\r\n").encode("latin-1"))
-            await writer.drain()
-            if method == b"HEAD":
-                return
-            await self._serve_span(writer, start + lo, hi - lo + 1)
+            await self.serve_parsed(writer, *parsed)
         except (
             ConnectionError,
             asyncio.TimeoutError,
@@ -154,6 +227,57 @@ class StreamServer:
             pass
         finally:
             writer.close()
+
+    async def serve_parsed(self, writer, method: bytes, path: str, rng) -> None:
+        """Serve one already-parsed request (also the BoxStreamServer's
+        delegation point; caller owns closing the writer and catching
+        stream-abort exceptions)."""
+        if path in ("/", "/index.json"):
+            # discovery: players/users can't guess file indices
+            await self._index(writer, method)
+            return
+        try:
+            file_index = int(path.lstrip("/") or "0")
+            if file_index < 0:
+                raise IndexError("negative index")  # no wrap-around files
+            start, length = self._file_span(file_index)
+        except (ValueError, IndexError):
+            await self._plain(writer, 404, b"no such file")
+            return
+        if not self.torrent.span_servable(start, length):
+            # a deselected file's pieces will never be scheduled —
+            # parking the reader would hang the connection forever
+            await self._plain(writer, 409, b"file not selected for download")
+            return
+        lo, hi = 0, length - 1
+        status = 200
+        if rng is not None:
+            parsed = self._parse_range(rng, length)
+            if parsed is None:
+                await self._plain(
+                    writer,
+                    416,
+                    b"bad range",
+                    extra=f"Content-Range: bytes */{length}\r\n",
+                )
+                return
+            lo, hi = parsed
+            status = 206
+        headers = [
+            f"HTTP/1.1 {status} {'Partial Content' if status == 206 else 'OK'}",
+            f"Date: {_http_date()}",
+            "Accept-Ranges: bytes",
+            "Content-Type: application/octet-stream",
+            f"Content-Length: {hi - lo + 1}",
+            "Connection: close",
+        ]
+        if status == 206:
+            headers.append(f"Content-Range: bytes {lo}-{hi}/{length}")
+        writer.write(("\r\n".join(headers) + "\r\n\r\n").encode("latin-1"))
+        await writer.drain()
+        if method == b"HEAD":
+            return
+        await self._serve_span(writer, start + lo, hi - lo + 1)
 
     async def _index(self, writer, method: bytes) -> None:
         """JSON file index: [{index, path, length, streamable}]."""
@@ -181,14 +305,7 @@ class StreamServer:
         await writer.drain()
 
     async def _plain(self, writer, status: int, body: bytes, extra: str = ""):
-        writer.write(
-            (
-                f"HTTP/1.1 {status} x\r\nContent-Length: {len(body)}\r\n"
-                f"{extra}Connection: close\r\n\r\n"
-            ).encode("latin-1")
-            + body
-        )
-        await writer.drain()
+        await _plain_response(writer, status, body, extra)
 
     # ------------------------------------------------------------- plumbing
 

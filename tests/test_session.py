@@ -810,6 +810,21 @@ class TestLargeGeometryScaling:
         run(go())
 
 
+class TestClientContextManager:
+    def test_async_with_starts_and_closes(self):
+        async def go():
+            async with Client(ClientConfig(host="127.0.0.1")) as c:
+                assert c.port is not None
+                port = c.port
+                r, w = await asyncio.open_connection("127.0.0.1", port)
+                w.close()
+            # closed on exit: the listener is gone
+            with pytest.raises(OSError):
+                await asyncio.open_connection("127.0.0.1", port)
+
+        run(go())
+
+
 class TestIpv6Session:
     def test_v6_loopback_swarm_with_encryption(self):
         """The session layer end to end over IPv6 (::1): v6 tracker

@@ -126,6 +126,13 @@ class Client:
         else:
             self.proxy = None
 
+    async def __aenter__(self) -> "Client":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
     # ------------------------------------------------------------- startup
 
     async def start(self) -> None:

@@ -356,7 +356,17 @@ def encode_message(msg: PeerMsg) -> bytes:
     raise ProtocolError(f"cannot encode {msg!r}")
 
 
+def raise_if_closing(writer) -> None:
+    """Writes into a closing transport are silently dropped by asyncio
+    (with a logged "socket.send() raised exception." per call) — turn
+    them into the ConnectionResetError every caller already handles."""
+    closing = getattr(writer, "is_closing", None)  # test fakes lack it
+    if closing is not None and closing():
+        raise ConnectionResetError("peer connection is closing")
+
+
 async def send_message(writer: asyncio.StreamWriter, msg: PeerMsg) -> None:
+    raise_if_closing(writer)
     writer.write(encode_message(msg))
     await writer.drain()
 

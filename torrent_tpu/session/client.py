@@ -127,7 +127,13 @@ class Client:
             self.proxy = None
 
     async def __aenter__(self) -> "Client":
-        await self.start()
+        try:
+            await self.start()
+        except BaseException:
+            # __aexit__ never runs when __aenter__ raises: release the
+            # listener/mappings a partial start() may have acquired
+            await self.close()
+            raise
         return self
 
     async def __aexit__(self, *exc) -> None:

@@ -2046,6 +2046,7 @@ class Torrent:
             peer.last_block_rx = time.monotonic()
         # one coalesced write + drain for the whole batch: a drain per
         # Request yields to the event loop per 16 KiB asked for
+        proto.raise_if_closing(peer.writer)
         for blk in wanted:
             peer.inflight.add(blk)
             if peer.peer_choking:

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Waits for the abandoned-but-healthy measurement child (pid $1, output $2)
+# to exit, then banks its record into $3 if non-null. Never touches the
+# process itself.
+pid=$1; out=$2; dest=$3
+while kill -0 "$pid" 2>/dev/null; do sleep 10; done
+sleep 2
+if [ -s "$out" ] && python - "$out" <<'PY'
+import json, sys
+rec = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+sys.exit(0 if rec.get("value") is not None else 1)
+PY
+then
+  tail -1 "$out" > "$dest"
+  echo "banked $(date -u): $(cat "$dest")" >> .bench/auto_chain_r3.log
+else
+  echo "child $pid exited with no bankable record $(date -u)" >> .bench/auto_chain_r3.log
+fi

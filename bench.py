@@ -65,8 +65,11 @@ import time
 import numpy as np
 
 # parent relay patience; the implicit child probes for 60% of it, leaving
-# the rest for the measurement (both read the same default)
-_DEFAULT_TPU_WAIT = "1500"
+# the rest for the measurement (both read the same default). 3600 (was
+# 1500): on 2026-07-31 the tunnel granted the device but moved bytes at
+# ~10 MiB/s — a healthy 512 MiB headline run took >15 min end to end, so
+# a 1500 s parent abandoned children that were measuring fine.
+_DEFAULT_TPU_WAIT = "3600"
 
 
 def _env_geometry():

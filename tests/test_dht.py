@@ -579,3 +579,38 @@ class TestMaintenance:
                 a.close()
 
         run(go())
+
+
+class TestBep43ReadOnly:
+    """BEP 43: read-only nodes stay out of routing tables and answer
+    no queries."""
+
+    def test_ro_querier_not_tabled_but_served(self):
+        async def go():
+            ro = await DHTNode(host="127.0.0.1", read_only=True).start()
+            srv = await DHTNode(host="127.0.0.1").start()
+            try:
+                rid = await ro.ping(("127.0.0.1", srv.port))
+                assert rid == srv.node_id  # query IS answered...
+                assert len(srv.table) == 0  # ...but the sender not tabled
+                assert len(ro.table) == 1  # ro still learns from responses
+            finally:
+                ro.close()
+                srv.close()
+
+        run(go())
+
+    def test_read_only_node_answers_nothing(self):
+        async def go():
+            ro = await DHTNode(host="127.0.0.1", read_only=True).start()
+            other = await DHTNode(host="127.0.0.1").start()
+            try:
+                from torrent_tpu.net.dht import DHTError
+
+                with pytest.raises(DHTError):
+                    await other.ping(("127.0.0.1", ro.port))
+            finally:
+                ro.close()
+                other.close()
+
+        run(go())

@@ -406,7 +406,7 @@ class TestReviewRegressions:
                     pass
 
                 def is_closing(self):
-                    return True
+                    return False
 
                 def write(self, data):
                     pass

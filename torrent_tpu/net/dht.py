@@ -377,15 +377,23 @@ class DHTNode:
         host: str = "0.0.0.0",
         enforce_bep42: bool = False,
         external_ip: str | None = None,
+        read_only: bool = False,
     ):
         """``enforce_bep42`` keeps nodes whose ids violate BEP 42's
         IP-derived constraint out of the routing table (defense against
         id-targeting attacks; off by default — plenty of live nodes
-        predate the BEP). ``external_ip`` mints our own id compliant."""
+        predate the BEP). ``external_ip`` mints our own id compliant.
+
+        ``read_only`` is BEP 43: a node that can't (NAT'd, firewalled)
+        or won't serve queries marks every outgoing query ``ro=1`` so
+        responders keep it out of their routing tables, and silently
+        drops inbound queries instead of answering with a node others
+        would then try — and fail — to reach."""
         if node_id is None and external_ip is not None:
             node_id = bep42_node_id(external_ip)
         self.node_id = node_id or random_node_id()
         self.enforce_bep42 = enforce_bep42
+        self.read_only = read_only
         self.host = host
         self.port = port
         # BEP 32 families THIS socket can reach: requesting (and merging)
@@ -476,6 +484,8 @@ class DHTNode:
             raise DHTError("node not started")
         tid = self._next_tid()
         msg = {b"t": tid, b"y": b"q", b"q": q.encode(), b"a": {b"id": self.node_id, **args}}
+        if self.read_only:
+            msg[b"ro"] = 1  # BEP 43: top-level, queries only
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         # The 16-bit tid alone is guessable: remember who we queried and
         # only accept the response from that address.
@@ -552,12 +562,16 @@ class DHTNode:
             return
         if kind != b"q":
             return
+        if self.read_only:
+            return  # BEP 43: a read-only node answers no queries
         q = msg.get(b"q")
         a = msg.get(b"a")
         if not isinstance(a, dict):
             return
         qid = a.get(b"id")
-        if isinstance(qid, bytes) and len(qid) == 20:
+        # BEP 43: a querier marked ro=1 must stay out of the routing
+        # table — it will never answer the queries a table entry invites
+        if msg.get(b"ro") != 1 and isinstance(qid, bytes) and len(qid) == 20:
             self._table_update(qid, addr[0], addr[1])
         try:
             self._handle_query(addr, tid, q, a)

@@ -45,7 +45,8 @@ MAX_METADATA_SIZE = 64 * 1024 * 1024
 UT_METADATA = b"ut_metadata"
 UT_PEX = b"ut_pex"
 UT_HOLEPUNCH = b"ut_holepunch"
-LOCAL_EXT_IDS = {UT_METADATA: 1, UT_PEX: 2, UT_HOLEPUNCH: 3}
+LT_DONTHAVE = b"lt_donthave"
+LOCAL_EXT_IDS = {UT_METADATA: 1, UT_PEX: 2, UT_HOLEPUNCH: 3, LT_DONTHAVE: 4}
 
 # Reserved-byte mask: bit 20 counting from the MSB of the 8-byte field,
 # i.e. byte 5, value 0x10 (BEP 10).
@@ -81,6 +82,7 @@ class ExtensionState:
     metadata_size: int = 0  # peer-advertised info-dict size in bytes
     ut_pex_id: int = 0  # peer's id for ut_pex (BEP 11; 0 = unsupported)
     ut_holepunch_id: int = 0  # peer's id for ut_holepunch (BEP 55)
+    lt_donthave_id: int = 0  # peer's id for lt_donthave (BEP 54)
     listen_port: int = 0  # peer-advertised 'p' — its real dialable port
 
 
@@ -134,6 +136,9 @@ def decode_extended_handshake(payload: bytes, state: ExtensionState) -> None:
         hid = m.get(UT_HOLEPUNCH)
         if isinstance(hid, int) and 0 < hid < 256:
             state.ut_holepunch_id = hid
+        did = m.get(LT_DONTHAVE)
+        if isinstance(did, int) and 0 < did < 256:
+            state.lt_donthave_id = did
     size = d.get(b"metadata_size")
     if isinstance(size, int) and 0 < size <= MAX_METADATA_SIZE:
         state.metadata_size = size
@@ -391,3 +396,23 @@ def decode_holepunch(payload: bytes) -> HolepunchMessage | None:
             return None
         err = int.from_bytes(payload[4 + alen : 8 + alen], "big")
     return HolepunchMessage(msg_type=msg_type, addr=(host, port), err_code=err)
+
+
+# ------------------------------------------------------------ lt_donthave
+
+
+def encode_donthave(index: int) -> bytes:
+    """BEP 54 payload: the piece index we no longer have, 4 bytes BE.
+
+    The inverse of a Have — BEP 3 has no way to retract an announced
+    piece, so a seed that loses data (disk error under an announced
+    piece) can only mislead peers without this.
+    """
+    return index.to_bytes(4, "big")
+
+
+def decode_donthave(payload: bytes) -> int | None:
+    """Parse a lt_donthave payload; None if malformed (never raises)."""
+    if len(payload) != 4:
+        return None
+    return int.from_bytes(payload, "big")

@@ -59,6 +59,9 @@ class ClientConfig:
     # BEP 42: reject routing-table nodes whose ids don't derive from
     # their IP (id-targeting defense; off by default for compat)
     dht_enforce_bep42: bool = False
+    # BEP 43: mark our queries ro=1 and answer none — for nodes that
+    # can't serve (NAT'd/firewalled) and shouldn't pollute peers' tables
+    dht_read_only: bool = False
     # Client-global transfer caps in bytes/s (0 = unlimited): one token
     # bucket per direction shared by every torrent (utils/ratelimit.py)
     max_upload_bps: int = 0
@@ -191,6 +194,7 @@ class Client:
                 host=self.config.host,
                 enforce_bep42=self.config.dht_enforce_bep42,
                 external_ip=self.external_ip,
+                read_only=self.config.dht_read_only,
             ).start()
             seeds = [tuple(a) for a in self.config.dht_bootstrap] + saved_nodes
             if seeds:

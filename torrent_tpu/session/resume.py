@@ -44,6 +44,14 @@ class ResumeData:
     # them so up to piece_length per partial isn't re-downloaded;
     # verification still gates persistence when the piece completes.
     partials: dict = field(default_factory=dict)
+    # BEP 3 `completed` bookkeeping across restarts: ``completed_reported``
+    # latches that the event was ever queued (a piece lost via BEP 54 and
+    # re-fetched later must not announce a second completion);
+    # ``completed_owed`` survives a crash between queuing the event and
+    # the tracker actually receiving it, so the restarted session still
+    # delivers the snatch.
+    completed_reported: bool = False
+    completed_owed: bool = False
 
     def encode(self) -> bytes:
         top = {
@@ -54,6 +62,10 @@ class ResumeData:
             b"uploaded": self.uploaded,
             b"downloaded": self.downloaded,
         }
+        if self.completed_reported:
+            top[b"completed"] = 1
+        if self.completed_owed:
+            top[b"completed_owed"] = 1
         if self.partials:
             top[b"partials"] = {
                 str(i).encode(): {b"mask": mask, b"data": data}
@@ -92,6 +104,8 @@ class ResumeData:
                 uploaded=d[b"uploaded"],
                 downloaded=d[b"downloaded"],
                 partials=partials,
+                completed_reported=d.get(b"completed", 0) == 1,
+                completed_owed=d.get(b"completed_owed", 0) == 1,
             )
         except KeyError:
             return None

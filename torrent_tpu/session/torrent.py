@@ -203,10 +203,14 @@ class Torrent:
         self._verify_pending: list = []
         self._verify_flushing = False
         self._tasks: set[asyncio.Task] = set()
+        # one live fetch loop per webseed/httpseed URL (see
+        # _spawn_seed_loops re-entrancy)
+        self._seed_loop_tasks: dict[str, asyncio.Task] = {}
         self._wake = asyncio.Event()
         self._stopping = False
         self._endgame = False
         self._pending_completed = False  # BEP 3 `completed` owed to tracker
+        self._completed_reported = False  # latch: `completed` sent at most once
         self._dialing: set[tuple[str, int]] = set()
         # Failure detection: corruption strikes accumulate per IP (so a
         # poisoner can't evade by cycling connections) and decay when a
@@ -567,6 +571,10 @@ class Torrent:
         self.state = TorrentState.SEEDING if self.bitfield.complete else TorrentState.DOWNLOADING
         if self.bitfield.complete:
             self.on_complete.set()
+            # already complete at start: either a prior session sent the
+            # tracker its `completed` or this was never a download at all
+            # — a later piece-loss/re-fetch cycle must not send one
+            self._completed_reported = True
         self._stopping = False
         if self.trackers:
             self._spawn(self._announce_loop(), name="announce")
@@ -690,6 +698,11 @@ class Torrent:
         )
         self.uploaded = rd.uploaded
         self.downloaded = rd.downloaded
+        # a restart mid-heal (incomplete bitfield) must still remember
+        # that `completed` already went to the tracker — and a crash
+        # between queuing the event and the announce leaves it owed
+        self._completed_reported = self._completed_reported or rd.completed_reported
+        self._pending_completed = self._pending_completed or rd.completed_owed
         log.info("fastresume: %d/%d pieces", bf.count(), self.info.num_pieces)
         return True
 
@@ -741,6 +754,8 @@ class Torrent:
                     uploaded=self.uploaded,
                     downloaded=self.downloaded,
                     partials=partials,
+                    completed_reported=self._completed_reported,
+                    completed_owed=self._pending_completed,
                 )
             )
         except OSError as e:
@@ -838,6 +853,10 @@ class Torrent:
                     started_sent = True
                 elif event == AnnounceEvent.COMPLETED:
                     self._pending_completed = False
+                    # persist delivery NOW: dying before the next periodic
+                    # checkpoint would leave `completed` owed on disk and
+                    # the restarted session would announce it twice
+                    self._checkpoint()
                 interval = max(5, res.interval)
                 if res.external_ip:
                     # BEP 24: learn our public address from the tracker —
@@ -1637,6 +1656,29 @@ class Torrent:
         if ext_id == ext.LOCAL_EXT_IDS[ext.UT_HOLEPUNCH]:
             await self._handle_holepunch(peer, payload)
             return
+        if ext_id == ext.LOCAL_EXT_IDS[ext.LT_DONTHAVE]:
+            # BEP 54: the peer retracts an announced piece — the inverse
+            # of Have. Interest can flip OFF here, so the full vector
+            # recheck runs (unlike the O(1) Have fast path).
+            idx = ext.decode_donthave(payload)
+            if idx is None or not (0 <= idx < self.info.num_pieces):
+                return
+            if peer.bitfield.has(idx):
+                peer.bitfield.set(idx, False)
+                self._avail[idx] -= 1
+                self._rarity_dirty = True
+                # The peer can no longer deliver blocks of this piece:
+                # release them for other peers (the Choke/RejectRequest
+                # treatment) — a BEP 54 peer without the fast extension
+                # sends no rejects, so held blocks would stall until the
+                # snub sweep otherwise.
+                for blk in [b for b in peer.inflight if b[0] == idx]:
+                    if self._inflight_count[blk] > 0:
+                        self._inflight_count[blk] -= 1
+                    peer.inflight.discard(blk)
+                    peer.inflight_choked.discard(blk)
+                await self._update_interest(peer)
+            return
         if ext_id == ext.LOCAL_EXT_IDS[ext.UT_METADATA]:
             msg = ext.decode_metadata_message(payload)
             if msg is None or peer.ext.ut_metadata_id == 0:
@@ -2216,7 +2258,12 @@ class Torrent:
             return
         self.state = TorrentState.SEEDING
         self._endgame = False
-        self._pending_completed = True
+        if not self._completed_reported:
+            # BEP 3: `completed` at most once per download — a piece
+            # lost (BEP 54) and re-fetched, or a selection widened and
+            # re-satisfied, must not inflate tracker snatch counts
+            self._pending_completed = True
+            self._completed_reported = True
         self._checkpoint()
         self.on_complete.set()
         self.request_peers()  # announce `completed` promptly
@@ -2329,6 +2376,49 @@ class Torrent:
 
     # ------------------------------------------------------------- seeding
 
+    async def _piece_lost(self, index: int) -> None:
+        """BEP 54 self-healing: an announced piece turned unreadable.
+
+        BEP 3 cannot retract a Have, so without this a seed with a bad
+        sector serves refusals forever while peers keep asking. Instead:
+        drop the piece from our bitfield (the picker re-wants it and the
+        swarm re-supplies it), fall back from SEEDING if needed, tell
+        lt_donthave-capable peers the truth, and re-evaluate interest —
+        we may need to fetch again from peers we'd gone not-interested on.
+        """
+        if not self.bitfield.has(index):
+            return
+        log.warning("piece %d lost (read failure under an announced piece)", index)
+        self.bitfield.set(index, False)
+        self._serve_cache.pop(index, None)
+        # without this the re-downloaded piece verifies in memory but
+        # every block write is suppressed as a duplicate and the disk
+        # keeps the bad bytes
+        self.storage.unmark_piece_written(index)
+        self._rarity_dirty = True
+        self._recount_wanted()
+        if self.state == TorrentState.SEEDING and self._wanted_missing:
+            self.state = TorrentState.DOWNLOADING
+            self.on_complete.clear()
+            self._spawn_seed_loops()
+            self.request_peers()
+        self._checkpoint()
+        payload = ext.encode_donthave(index)
+        for p in list(self.peers.values()):
+            if self.peers.get(p.peer_id) is not p:
+                continue  # dropped during an earlier send's await: an
+                # interest update on it would assign inflight blocks
+                # nothing will ever release (same hazard as the Have
+                # broadcast in _finish_piece)
+            try:
+                if p.ext.enabled and p.ext.lt_donthave_id:
+                    await proto.send_message(
+                        p.writer, proto.Extended(p.ext.lt_donthave_id, payload)
+                    )
+                await self._update_interest(p)
+            except (ConnectionError, OSError):
+                continue
+
     async def _serve_request(self, peer: PeerConnection, index, begin, length) -> None:
         """request handler (torrent.ts:158-176), gated on our choke state.
 
@@ -2380,6 +2470,8 @@ class Torrent:
                 )
             except StorageError as e:
                 log.error("serving piece %d failed: %s", index, e)
+                await self._piece_lost(index)
+                await refuse()
                 return
         elif self.info.piece_length <= INLINE_IO_MAX:
             # small pieces: a synchronous pread is cheaper than the
@@ -2390,6 +2482,8 @@ class Torrent:
                     piece = self.storage.read_piece(index)
                 except StorageError as e:
                     log.error("serving piece %d failed: %s", index, e)
+                    await self._piece_lost(index)
+                    await refuse()
                     return
             self._serve_cache[index] = piece  # insert/LRU-refresh at tail
             while len(self._serve_cache) > self.config.serve_cache_pieces:
@@ -2411,6 +2505,8 @@ class Torrent:
                     piece = await asyncio.shield(task)
                 except StorageError as e:
                     log.error("serving piece %d failed: %s", index, e)
+                    await self._piece_lost(index)
+                    await refuse()
                     return
                 self._serve_cache[index] = piece
                 while len(self._serve_cache) > self.config.serve_cache_pieces:
@@ -2608,13 +2704,28 @@ class Torrent:
         return picked
 
     def _spawn_seed_loops(self) -> None:
-        """Start one fetch loop per BEP 19 webseed and BEP 17 httpseed."""
+        """Start one fetch loop per BEP 19 webseed and BEP 17 httpseed.
+
+        Re-entrant: callers re-open a finished download (selection
+        widening, BEP 54 piece loss) without knowing whether the old
+        loops already exited — a URL whose loop is still alive (mid-fetch
+        or in a backoff sleep when the re-open happened) is skipped, or
+        every lost/heal cycle would stack another loop per URL.
+        """
         for url in self.web_seed_urls:
-            self._spawn(self._webseed_loop(url), name=f"webseed-{url[:24]}")
+            self._spawn_seed_loop_once(url, bep17=False)
         for url in self.http_seed_urls:
-            self._spawn(
-                self._webseed_loop(url, bep17=True), name=f"httpseed-{url[:24]}"
-            )
+            self._spawn_seed_loop_once(url, bep17=True)
+
+    def _spawn_seed_loop_once(self, url: str, bep17: bool) -> None:
+        key = ("h" if bep17 else "w") + url
+        task = self._seed_loop_tasks.get(key)
+        if task is not None and not task.done():
+            return
+        self._seed_loop_tasks[key] = self._spawn(
+            self._webseed_loop(url, bep17=bep17),
+            name=f"{'httpseed' if bep17 else 'webseed'}-{url[:24]}",
+        )
 
     async def _webseed_loop(self, url: str, bep17: bool = False) -> None:
         """BEP 19 (byte-range) / BEP 17 (piece-keyed) HTTP seeding: fill

@@ -178,6 +178,19 @@ class Storage:
                 for boff in range(0, plen, BLOCK_SIZE):
                     self._written.add(base + boff)
 
+    def unmark_piece_written(self, index: int) -> None:
+        """Drop duplicate-write suppression for one piece.
+
+        The piece-loss path (BEP 54 self-healing) re-downloads a piece
+        whose blocks are already in the written map — without this the
+        replacement bytes verify in memory, ``set`` returns False for
+        every block, and the disk keeps the corrupt/missing data."""
+        with self._lock:
+            plen = piece_length(self.info, index)
+            base = index * self.info.piece_length
+            for boff in range(0, plen, BLOCK_SIZE):
+                self._written.discard(base + boff)
+
     # ------------------------------------------------------------ batch IO
 
     def read_piece(self, index: int) -> bytes:

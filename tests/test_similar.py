@@ -1,0 +1,255 @@
+"""BEP 38 torrent-file hints: ``similar`` infohashes and ``collections``.
+
+The reference has no cross-torrent data reuse (each torrent's storage is
+an island, storage.ts:41-48). BEP 38 lets a re-published dataset name its
+predecessor so a downloader reuses the unchanged files it already has —
+here implemented as a pre-start copy from related torrents' verified
+spans, gated by the normal recheck.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from torrent_tpu.codec.metainfo import parse_metainfo
+from torrent_tpu.session.client import Client, ClientConfig
+from torrent_tpu.tools.make_torrent import make_torrent
+
+from tests.test_session import fast_config
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+ANNOUNCE = "http://127.0.0.1:1/announce"
+
+
+class TestAuthoringAndParse:
+    def test_hints_round_trip_inside_info(self, tmp_path):
+        (tmp_path / "a.bin").write_bytes(b"x" * 1000)
+        sim = bytes(range(20))
+        data = make_torrent(
+            str(tmp_path / "a.bin"),
+            ANNOUNCE,
+            piece_length=16384,
+            similar=[sim],
+            collections=["dataset-v1", "mirrors"],
+        )
+        m = parse_metainfo(data)
+        assert m.similar == (sim,)
+        assert m.collections == ("dataset-v1", "mirrors")
+
+    def test_hints_change_the_infohash(self, tmp_path):
+        (tmp_path / "a.bin").write_bytes(b"x" * 1000)
+        plain = parse_metainfo(
+            make_torrent(str(tmp_path / "a.bin"), ANNOUNCE, piece_length=16384)
+        )
+        hinted = parse_metainfo(
+            make_torrent(
+                str(tmp_path / "a.bin"),
+                ANNOUNCE,
+                piece_length=16384,
+                collections=["c"],
+            )
+        )
+        # info-bound hints are part of the identity (can't be stripped
+        # by a middleman without changing the infohash)
+        assert plain.info_hash != hinted.info_hash
+
+    def test_top_level_hints_merge(self, tmp_path):
+        from torrent_tpu.codec.bencode import bdecode, bencode
+
+        (tmp_path / "a.bin").write_bytes(b"x" * 1000)
+        sim_info, sim_top = b"\x01" * 20, b"\x02" * 20
+        data = make_torrent(
+            str(tmp_path / "a.bin"), ANNOUNCE, piece_length=16384, similar=[sim_info]
+        )
+        top = bdecode(data)
+        top[b"similar"] = [sim_top, sim_info]  # downstream publisher adds one
+        top[b"collections"] = [b"added-later"]
+        m = parse_metainfo(bencode(top))
+        assert m.similar == (sim_info, sim_top)  # deduped, info first
+        assert m.collections == ("added-later",)
+
+    def test_bad_similar_rejected(self, tmp_path):
+        (tmp_path / "a.bin").write_bytes(b"x" * 100)
+        with pytest.raises(ValueError):
+            make_torrent(
+                str(tmp_path / "a.bin"), ANNOUNCE, similar=[b"short"]
+            )
+
+
+def _build_dataset(tmp_path, rng):
+    """Torrent A: a lone 80 KiB file; torrent B: same file + a new one,
+    authored with similar=[A]. 16 KiB pieces → the shared file is B's
+    pieces 0-4 exactly (no boundary spill)."""
+    common = rng.integers(0, 256, size=80 * 1024, dtype=np.uint8).tobytes()
+    extra = rng.integers(0, 256, size=40 * 1024, dtype=np.uint8).tobytes()
+
+    dir_a = tmp_path / "a"
+    dir_a.mkdir()
+    (dir_a / "common.bin").write_bytes(common)
+    meta_a = parse_metainfo(
+        make_torrent(str(dir_a / "common.bin"), ANNOUNCE, piece_length=16384)
+    )
+
+    src_b = tmp_path / "src_b"
+    src_b.mkdir()
+    (src_b / "common.bin").write_bytes(common)
+    (src_b / "extra.bin").write_bytes(extra)
+    meta_b = parse_metainfo(
+        make_torrent(
+            str(src_b),
+            ANNOUNCE,
+            piece_length=16384,
+            similar=[meta_a.info_hash],
+        )
+    )
+    names = [fe.path[-1] for fe in meta_b.info.files]
+    assert names == ["common.bin", "extra.bin"], names
+    return meta_a, dir_a, meta_b, common
+
+
+class TestLocalAdoption:
+    def test_shared_file_is_reused_not_redownloaded(self, tmp_path):
+        async def go():
+            rng = np.random.default_rng(38)
+            meta_a, dir_a, meta_b, common = _build_dataset(tmp_path, rng)
+
+            c = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            c.config.torrent = fast_config()
+            await c.start()
+            try:
+                ta = await c.add(meta_a, str(tmp_path / "a"))
+                assert ta.bitfield.complete
+
+                dl = tmp_path / "dl_b"
+                dl.mkdir()
+                tb = await c.add(meta_b, str(dl))
+                # the shared file's pieces came from A's verified copy...
+                assert all(tb.bitfield.has(i) for i in range(5)), tb.bitfield
+                # ...and landed on disk byte-identical
+                assert (dl / meta_b.info.name / "common.bin").read_bytes() == common
+                # the new file still needs the swarm
+                assert not tb.bitfield.has(5)
+            finally:
+                await c.close()
+
+        run(go())
+
+    def test_collections_match_without_similar(self, tmp_path):
+        async def go():
+            rng = np.random.default_rng(39)
+            shared = rng.integers(0, 256, size=64 * 1024, dtype=np.uint8).tobytes()
+            for d in ("a", "src_b", "dl"):
+                (tmp_path / d).mkdir()
+            (tmp_path / "a" / "data.bin").write_bytes(shared)
+            (tmp_path / "src_b" / "data.bin").write_bytes(shared)
+            meta_a = parse_metainfo(
+                make_torrent(
+                    str(tmp_path / "a" / "data.bin"),
+                    ANNOUNCE,
+                    piece_length=16384,
+                    collections=["dataset"],
+                )
+            )
+            meta_b = parse_metainfo(
+                make_torrent(
+                    str(tmp_path / "src_b" / "data.bin"),
+                    ANNOUNCE,
+                    piece_length=16384,
+                    comment="republished",  # distinct infohash, same bytes
+                    collections=["dataset", "other"],
+                )
+            )
+            assert meta_a.info_hash != meta_b.info_hash
+
+            c = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            c.config.torrent = fast_config()
+            await c.start()
+            try:
+                ta = await c.add(meta_a, str(tmp_path / "a"))
+                assert ta.bitfield.complete
+                tb = await c.add(meta_b, str(tmp_path / "dl"))
+                assert tb.bitfield.complete  # whole torrent adopted
+            finally:
+                await c.close()
+
+        run(go())
+
+    def test_incomplete_donor_is_not_copied(self, tmp_path):
+        async def go():
+            rng = np.random.default_rng(40)
+            meta_a, _, meta_b, _ = _build_dataset(tmp_path, rng)
+
+            c = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            c.config.torrent = fast_config()
+            await c.start()
+            try:
+                empty_a = tmp_path / "empty_a"
+                empty_a.mkdir()
+                ta = await c.add(meta_a, str(empty_a))  # donor has nothing
+                assert ta.bitfield.count() == 0
+                dl = tmp_path / "dl_b2"
+                dl.mkdir()
+                tb = await c.add(meta_b, str(dl))
+                assert tb.bitfield.count() == 0  # nothing to adopt
+            finally:
+                await c.close()
+
+        run(go())
+
+
+class TestSelectionAwareAdoption:
+    def test_deselected_shared_file_is_not_copied(self, tmp_path):
+        """A shared file the user excluded via wanted_files must not be
+        pulled from the donor (its pieces aren't wanted); the selected
+        file's span still adopts."""
+
+        async def go():
+            rng = np.random.default_rng(41)
+            common = rng.integers(0, 256, size=80 * 1024, dtype=np.uint8).tobytes()
+            extra = rng.integers(0, 256, size=48 * 1024, dtype=np.uint8).tobytes()
+            for d in ("a2", "src2", "dl2"):
+                (tmp_path / d).mkdir()
+            (tmp_path / "a2" / "common.bin").write_bytes(common)
+            (tmp_path / "a2" / "extra.bin").write_bytes(extra)
+            (tmp_path / "src2" / "common.bin").write_bytes(common)
+            (tmp_path / "src2" / "extra.bin").write_bytes(extra)
+            meta_a = parse_metainfo(
+                make_torrent(str(tmp_path / "a2"), ANNOUNCE, piece_length=16384)
+            )
+            meta_b = parse_metainfo(
+                make_torrent(
+                    str(tmp_path / "src2"),
+                    ANNOUNCE,
+                    piece_length=16384,
+                    comment="republished",
+                    similar=[meta_a.info_hash],
+                )
+            )
+            names = [fe.path[-1] for fe in meta_b.info.files]
+            assert names == ["common.bin", "extra.bin"]
+
+            c = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            c.config.torrent = fast_config()
+            await c.start()
+            try:
+                # directory torrent: the storage root is the PARENT of a2/
+                ta = await c.add(meta_a, str(tmp_path))
+                assert ta.bitfield.complete
+                # want only extra.bin (file 1); common.bin deselected
+                tb = await c.add(meta_b, str(tmp_path / "dl2"), wanted_files=[1])
+                # extra.bin fully adopted (80 KiB is piece-aligned, so
+                # extra's pieces 5..7 are donor-clean)
+                assert all(tb.bitfield.has(i) for i in range(5, 8))
+                # the deselected file's body never landed on disk
+                assert not (
+                    tmp_path / "dl2" / meta_b.info.name / "common.bin"
+                ).exists()
+            finally:
+                await c.close()
+
+        run(go())

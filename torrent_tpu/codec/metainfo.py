@@ -100,6 +100,38 @@ class Metainfo:
         byte-range file GETs."""
         return parse_url_list(self.raw.get(b"httpseeds"))
 
+    @property
+    def similar(self) -> tuple[bytes, ...]:
+        """BEP 38 ``similar``: infohashes of torrents likely to share
+        identical files with this one. Read from the info dict (where an
+        author binds them into the infohash) and the top level (where a
+        downstream publisher may add more); order-preserving union."""
+        out: list[bytes] = []
+        info = self.raw.get(b"info")
+        for src in ((info if isinstance(info, dict) else {}), self.raw):
+            v = src.get(b"similar")
+            if isinstance(v, list):
+                for h in v:
+                    if isinstance(h, bytes) and len(h) in (20, 32) and h not in out:
+                        out.append(h)
+        return tuple(out)
+
+    @property
+    def collections(self) -> tuple[str, ...]:
+        """BEP 38 ``collections``: publisher-chosen group names; torrents
+        sharing a collection are candidates for local-file reuse."""
+        out: list[str] = []
+        info = self.raw.get(b"info")
+        for src in ((info if isinstance(info, dict) else {}), self.raw):
+            v = src.get(b"collections")
+            if isinstance(v, list):
+                for c in v:
+                    if isinstance(c, bytes):
+                        s = c.decode("utf-8", "replace")
+                        if s and s not in out:
+                            out.append(s)
+        return tuple(out)
+
 
 _FILE_SHAPE = valid.obj(
     {

@@ -412,10 +412,138 @@ class Client:
             await torrent.select_files(
                 [i for i in wanted_files if 0 <= i < n_files]
             )
+        await self._adopt_similar(torrent)
         await torrent.start()
         if self.lsd is not None and not torrent.private:
             self.lsd.register(metainfo.info_hash)  # BEP 27: never private
         return torrent
+
+    async def _adopt_similar(self, torrent: Torrent) -> None:
+        """BEP 38 local-data reuse: pre-fill the new torrent's storage
+        from identical files of already-registered torrents.
+
+        Torrents are related when either names the other in ``similar``
+        or they share a ``collections`` entry. Files match on (basename,
+        size) — BEP 38's v1 criterion — and only fully-verified donor
+        spans are copied, BEFORE ``start()`` so the normal recheck adopts
+        the bytes (boundary pieces spanning non-shared neighbours simply
+        fail the hash and download as usual). Writes go through the
+        storage method directly: ``Storage.set``'s duplicate-write marks
+        must stay clear so the swarm can overwrite an adopted span whose
+        piece hash didn't pan out.
+        """
+        meta = torrent.metainfo
+        # session-meta wrappers (pure-v2) may not carry the BEP 38
+        # surface; they can still be adopted INTO when a donor names them
+        hints = set(getattr(meta, "similar", ()) or ())
+        cols = set(getattr(meta, "collections", ()) or ())
+        donors = []
+        for d in self.torrents.values():
+            if d is torrent:
+                continue
+            dm = d.metainfo
+            related = (
+                dm.info_hash in hints
+                or meta.info_hash in (getattr(dm, "similar", ()) or ())
+                or (cols and cols.intersection(getattr(dm, "collections", ()) or ()))
+            )
+            if related:
+                donors.append(d)
+        if not donors:
+            return
+
+        def files_of(t):
+            if t.info.files is None:
+                off, ln = t.file_ranges()[0]
+                return [(t.info.name, off, ln)]
+            out = []
+            for fe, (off, ln) in zip(t.info.files, t.file_ranges()):
+                if getattr(fe, "pad", False) or ln == 0:
+                    continue
+                out.append((fe.path[-1], off, ln))
+            return out
+
+        # donor file index; first fully-verified donor span per key wins
+        index: dict[tuple[str, int], tuple[Torrent, int]] = {}
+        for d in donors:
+            plen = d.info.piece_length
+            have = d.bitfield.as_numpy()
+            for name, off, ln in files_of(d):
+                key = (name, ln)
+                if key in index:
+                    continue
+                lo, hi = off // plen, -(-(off + ln) // plen)
+                if have[lo:hi].all():
+                    index[key] = (d, off)
+
+        jobs = []  # (donor_storage, donor_off, our_off, length)
+        plen_t = torrent.info.piece_length
+        prio = torrent._piece_priority
+        for name, off, ln in files_of(torrent):
+            hit = index.get((name, ln))
+            if hit is None:
+                continue
+            donor, d_off = hit
+            # Copy only spans under WANTED pieces: a file the user
+            # deselected contributes just the boundary bytes a wanted
+            # neighbour's piece needs, not its full (possibly huge) body.
+            lo, hi = off // plen_t, -(-(off + ln) // plen_t)
+            run_start = None
+            prev = None
+
+            def flush(a, b):
+                start = max(off, (lo + a) * plen_t)
+                end = min(off + ln, (lo + b + 1) * plen_t)
+                if end > start:
+                    jobs.append(
+                        (donor.storage, d_off + (start - off), start, end - start)
+                    )
+
+            for w in range(hi - lo):
+                if prio[lo + w] <= 0:
+                    continue
+                if run_start is None:
+                    run_start = w
+                elif w != prev + 1:
+                    flush(run_start, prev)
+                    run_start = w
+                prev = w
+            if run_start is not None:
+                flush(run_start, prev)
+        if not jobs:
+            return
+
+        def copy_spans():
+            copied = 0
+            for donor_storage, d_off, t_off, length in jobs:
+                try:
+                    pos = 0
+                    while pos < length:
+                        n = min(1 << 20, length - pos)
+                        data = donor_storage.get(d_off + pos, n)
+                        p = 0
+                        for path, foff, chunk in torrent.storage.segments(
+                            t_off + pos, len(data)
+                        ):
+                            if path is not None:
+                                torrent.storage.method.set(
+                                    path, foff, data[p : p + chunk]
+                                )
+                            p += chunk
+                        pos += n
+                    copied += length
+                except Exception as e:  # best-effort: recheck is the gate
+                    log.warning("BEP 38 adoption failed mid-file: %s", e)
+            return copied
+
+        copied = await asyncio.to_thread(copy_spans)
+        if copied:
+            log.info(
+                "BEP 38: adopted %d bytes across %d files from %d related torrents",
+                copied,
+                len(jobs),
+                len(donors),
+            )
 
     async def add_hybrid(
         self, torrent_bytes: bytes, storage_dir: str

@@ -155,6 +155,9 @@ def _cmd_info(args) -> int:
 
 
 def _cmd_make(args) -> int:
+    similar = _parse_similar_args(args)
+    if similar is None:
+        return 2
     if args.v2 or args.hybrid:
         if getattr(args, "pad_files", False):
             # hybrid authoring piece-aligns on its own; pure v2 has no
@@ -180,6 +183,8 @@ def _cmd_make(args) -> int:
         private=args.private,
         web_seeds=args.web_seed or None,
         pad_files=getattr(args, "pad_files", False),
+        similar=similar or None,
+        collections=args.collection or None,
     )
     print("", file=sys.stderr)
     out = args.output or (args.path.rstrip("/").rsplit("/", 1)[-1] + ".torrent")
@@ -236,6 +241,21 @@ def _make_v2(args) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    similar = _parse_similar_args(args)
+    if similar is None:
+        return 2
+    if similar or args.collection:
+        # BEP 38 hints for v2/hybrid go in the ROOT dict (the BEP's
+        # mutable placement): the v2 info-dict builders don't carry
+        # them, and top-level keys leave the infohash untouched
+        from torrent_tpu.codec.bencode import bdecode, bencode
+
+        top = bdecode(data)
+        if similar:
+            top[b"similar"] = similar
+        if args.collection:
+            top[b"collections"] = [c.encode("utf-8") for c in args.collection]
+        data = bencode(top, sort_keys=False)
     out = args.output or (name + ".torrent")
     with open(out, "wb") as f:
         f.write(data)
@@ -244,6 +264,26 @@ def _make_v2(args) -> int:
         f"infohash {meta.info_hash_v2.hex()[:16]}...)"
     )
     return 0
+
+
+def _parse_similar_args(args) -> list[bytes] | None:
+    """``--similar`` hex strings → infohash bytes; None after printing a
+    CLI-style error on malformed input (a traceback is not an error
+    message)."""
+    out = []
+    for h in getattr(args, "similar", []):
+        try:
+            raw = bytes.fromhex(h)
+        except ValueError:
+            raw = b""
+        if len(raw) not in (20, 32):
+            print(
+                f"error: --similar {h!r} is not a 40- or 64-digit hex infohash",
+                file=sys.stderr,
+            )
+            return None
+        out.append(raw)
+    return out
 
 
 def _verify_v2(v2, args) -> int:
@@ -746,6 +786,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("--web-seed", action="append", default=[],
                     help="BEP 19 url-list entry (repeatable)")
+    sp.add_argument("--similar", action="append", default=[],
+                    help="BEP 38: hex infohash of a torrent sharing files (repeatable)")
+    sp.add_argument("--collection", action="append", default=[],
+                    help="BEP 38: collection name grouping related torrents (repeatable)")
     sp.add_argument("--v2", action="store_true",
                     help="author a BitTorrent v2 (BEP 52) torrent: SHA-256 merkle file tree")
     sp.add_argument("--hybrid", action="store_true",

@@ -130,6 +130,8 @@ def make_torrent(
     private: bool = False,
     web_seeds: list[str] | None = None,
     pad_files: bool = False,
+    similar: list[bytes] | None = None,
+    collections: list[str] | None = None,
 ) -> bytes:
     """Author a .torrent for a file or directory (tools/make_torrent.ts:115).
 
@@ -138,7 +140,10 @@ def make_torrent(
     (changes the infohash — clients then skip DHT/PEX); ``web_seeds``
     adds a BEP 19 ``url-list``; ``pad_files`` inserts BEP 47 pad entries
     so every file after the first starts on a piece boundary (single-GET
-    webseed ranges, per-file piece reuse — multi-file only).
+    webseed ranges, per-file piece reuse — multi-file only); ``similar``
+    (infohashes) and ``collections`` (group names) are BEP 38 hints that
+    let downloaders reuse identical local files from related torrents —
+    written INSIDE the info dict so the hints are infohash-bound.
     """
     path = os.fspath(path)
     if not os.path.exists(path):
@@ -189,6 +194,13 @@ def make_torrent(
 
     if private:
         info[b"private"] = 1  # BEP 27 — inside info: part of the infohash
+    if similar:
+        for h in similar:
+            if not isinstance(h, bytes) or len(h) not in (20, 32):
+                raise ValueError("similar entries must be 20- or 32-byte infohashes")
+        info[b"similar"] = list(similar)  # BEP 38
+    if collections:
+        info[b"collections"] = [c.encode("utf-8") for c in collections]  # BEP 38
 
     top: dict = {b"announce": tracker.encode("utf-8"), b"info": info}
     if announce_list:

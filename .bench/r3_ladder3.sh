@@ -1,0 +1,70 @@
+#!/bin/bash
+# Third-wave ladder (session 3): waits for the frozen measurement child
+# (pid arg $1) to exit on its own — NEVER killed — lets the watcher bank
+# its record, then probes (abandon-don't-kill) and climbs small-to-large.
+# Rungs with an already-banked non-null record are skipped; the first
+# unbanked rung failing sends us back to the probe loop with the window
+# intact. BENCH_NBATCH=2 on the small rungs keeps staging ~2 GiB so a
+# short healthy window can bank a record.
+cd /root/repo
+old_pid="${1:-911}"
+
+banked() {
+  [ -s "$1" ] && python - "$1" <<'PY'
+import json, sys
+try:
+    rec = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if rec.get("value") is not None else 1)
+PY
+}
+
+rung() {
+  local out="$1"; shift
+  if banked "$out"; then
+    echo "skip $out (already banked)"
+    return 0
+  fi
+  env "$@" python bench.py > "$out.tmp" 2> "${out%.json}.err"
+  if banked "$out.tmp"; then
+    mv "$out.tmp" "$out"
+  else
+    if [ -s "$out" ]; then rm -f "$out.tmp"; else mv "$out.tmp" "$out"; fi
+  fi
+  echo "$out attempt done $(date -u): $(cat "$out")"
+}
+
+{
+echo "=== r3 ladder3 start $(date -u), waiting on pid $old_pid"
+while kill -0 "$old_pid" 2>/dev/null; do sleep 15; done
+echo "old child exited $(date -u)"
+sleep 20  # let the watcher bank its output first
+for attempt in $(seq 1 80); do
+  if bash .bench/probe_once.sh .bench/probe_r3c.log 300; then
+    echo "ladder3: tunnel alive attempt=$attempt $(date -u)"
+    rung .bench/headline_small.json BENCH_CONFIG=headline BENCH_TOTAL_MB=512 \
+         BENCH_NBATCH=2 BENCH_TPU_WAIT=2700
+    if ! banked .bench/headline_small.json; then
+      echo "ladder3: first rung banked nothing — back to probing"
+      sleep 600
+      continue
+    fi
+    rung .bench/cfgv2_small.json BENCH_CONFIG=v2 BENCH_TOTAL_MB=512 BENCH_TPU_WAIT=2700
+    rung .bench/headline_final.json BENCH_CONFIG=headline BENCH_TOTAL_MB=2048 \
+         BENCH_TPU_WAIT=3600
+    rung .bench/cfgv2c.json BENCH_CONFIG=v2 BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=3600
+    rung .bench/cfg4.json BENCH_CONFIG=headline BENCH_PIECE_KB=1024 \
+         BENCH_TOTAL_MB=102400 BENCH_BATCH=4096 BENCH_E2E_MB=16384 BENCH_TPU_WAIT=10800
+    if banked .bench/cfg4.json; then
+      echo "=== r3 ladder3 complete $(date -u)"
+      exit 0
+    fi
+    echo "ladder3: ladder incomplete — back to probing"
+  else
+    echo "ladder3 attempt=$attempt probe failed $(date -u)"
+  fi
+  sleep 600
+done
+echo "=== r3 ladder3 exhausted $(date -u)"
+} >> .bench/auto_chain_r3.log 2>&1

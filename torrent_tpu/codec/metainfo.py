@@ -106,31 +106,64 @@ class Metainfo:
         identical files with this one. Read from the info dict (where an
         author binds them into the infohash) and the top level (where a
         downstream publisher may add more); order-preserving union."""
-        out: list[bytes] = []
-        info = self.raw.get(b"info")
-        for src in ((info if isinstance(info, dict) else {}), self.raw):
-            v = src.get(b"similar")
-            if isinstance(v, list):
-                for h in v:
-                    if isinstance(h, bytes) and len(h) in (20, 32) and h not in out:
-                        out.append(h)
-        return tuple(out)
+        return parse_similar(self.raw)
+
+    @property
+    def update_url(self) -> str | None:
+        """BEP 39 ``update-url``: where an updated version of this
+        torrent can be fetched. Info-dict placement wins (infohash-bound
+        — a middleman can't redirect updates without changing the
+        identity); top-level accepted as the mutable fallback."""
+        return parse_update_url(self.raw)
 
     @property
     def collections(self) -> tuple[str, ...]:
         """BEP 38 ``collections``: publisher-chosen group names; torrents
         sharing a collection are candidates for local-file reuse."""
-        out: list[str] = []
-        info = self.raw.get(b"info")
-        for src in ((info if isinstance(info, dict) else {}), self.raw):
-            v = src.get(b"collections")
-            if isinstance(v, list):
-                for c in v:
-                    if isinstance(c, bytes):
-                        s = c.decode("utf-8", "replace")
-                        if s and s not in out:
-                            out.append(s)
-        return tuple(out)
+        return parse_collections(self.raw)
+
+
+def _hint_sources(raw: dict):
+    info = raw.get(b"info")
+    return ((info if isinstance(info, dict) else {}), raw)
+
+
+def parse_similar(raw: dict) -> tuple[bytes, ...]:
+    """BEP 38 ``similar`` from a decoded top-level dict (shared by the v1
+    ``Metainfo`` and the v2 session wrapper): info placement first, then
+    top level, deduped in order."""
+    out: list[bytes] = []
+    for src in _hint_sources(raw):
+        v = src.get(b"similar")
+        if isinstance(v, list):
+            for h in v:
+                if isinstance(h, bytes) and len(h) in (20, 32) and h not in out:
+                    out.append(h)
+    return tuple(out)
+
+
+def parse_collections(raw: dict) -> tuple[str, ...]:
+    """BEP 38 ``collections`` from a decoded top-level dict."""
+    out: list[str] = []
+    for src in _hint_sources(raw):
+        v = src.get(b"collections")
+        if isinstance(v, list):
+            for c in v:
+                if isinstance(c, bytes):
+                    s = c.decode("utf-8", "replace")
+                    if s and s not in out:
+                        out.append(s)
+    return tuple(out)
+
+
+def parse_update_url(raw: dict) -> str | None:
+    """BEP 39 ``update-url`` from a decoded top-level dict; info-dict
+    placement wins over top level."""
+    for src in _hint_sources(raw):
+        v = src.get(b"update-url")
+        if isinstance(v, bytes) and v:
+            return v.decode("utf-8", "replace")
+    return None
 
 
 _FILE_SHAPE = valid.obj(

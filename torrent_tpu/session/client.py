@@ -347,6 +347,7 @@ class Client:
         metainfo: Metainfo,
         storage: Storage | StorageMethod | str,
         wanted_files: list[int] | None = None,
+        _adopt_from: tuple[bytes, ...] = (),
     ) -> Torrent:
         """Register + start a torrent (client.ts:53-67).
 
@@ -412,13 +413,15 @@ class Client:
             await torrent.select_files(
                 [i for i in wanted_files if 0 <= i < n_files]
             )
-        await self._adopt_similar(torrent)
+        await self._adopt_similar(torrent, extra_donors=frozenset(_adopt_from))
         await torrent.start()
         if self.lsd is not None and not torrent.private:
             self.lsd.register(metainfo.info_hash)  # BEP 27: never private
         return torrent
 
-    async def _adopt_similar(self, torrent: Torrent) -> None:
+    async def _adopt_similar(
+        self, torrent: Torrent, extra_donors: frozenset[bytes] = frozenset()
+    ) -> None:
         """BEP 38 local-data reuse: pre-fill the new torrent's storage
         from identical files of already-registered torrents.
 
@@ -444,6 +447,7 @@ class Client:
             dm = d.metainfo
             related = (
                 dm.info_hash in hints
+                or dm.info_hash in extra_donors  # BEP 39 update predecessor
                 or meta.info_hash in (getattr(dm, "similar", ()) or ())
                 or (cols and cols.intersection(getattr(dm, "collections", ()) or ()))
             )
@@ -484,6 +488,9 @@ class Client:
             if hit is None:
                 continue
             donor, d_off = hit
+            if self._same_backing_file(donor.storage, d_off, torrent.storage, off):
+                continue  # in-place update: the bytes are already there;
+                # the recheck adopts them without a self-copy
             # Copy only spans under WANTED pieces: a file the user
             # deselected contributes just the boundary bytes a wanted
             # neighbour's piece needs, not its full (possibly huge) body.
@@ -544,6 +551,135 @@ class Client:
                 len(jobs),
                 len(donors),
             )
+
+    @staticmethod
+    def _same_backing_file(
+        donor_storage: Storage, d_off: int, storage: Storage, t_off: int
+    ) -> bool:
+        """True when both offsets resolve to the same on-disk file (an
+        in-place BEP 39 update over the old torrent's directory) — a
+        copy would just rewrite the file onto itself."""
+        try:
+            d_seg = next(iter(donor_storage.segments(d_off, 1)))
+            t_seg = next(iter(storage.segments(t_off, 1)))
+        except StopIteration:
+            return False
+        if d_seg[0] is None or t_seg[0] is None:
+            return False  # BEP 47 pad span: nothing on disk to compare
+        dm, tm = donor_storage.method, storage.method
+        if dm is tm and d_seg[0] == t_seg[0]:
+            return True
+        if isinstance(dm, FsStorage) and isinstance(tm, FsStorage):
+            try:
+                import os
+
+                return os.path.samefile(
+                    dm._abspath(d_seg[0]), tm._abspath(t_seg[0])
+                )
+            except OSError:
+                return False
+        return False
+
+    async def check_for_update(self, torrent: Torrent):
+        """BEP 39: fetch the torrent's ``update-url``; a parsed metainfo
+        with a DIFFERENT infohash means an update exists (None = current
+        version, or no update-url). http/https only — the URL is
+        untrusted metainfo content (same SSRF stance as webseeds) — and
+        the fetch rides the tracker HTTP client, so it honors the
+        configured proxy instead of leaking the real IP to whoever the
+        metainfo names. Returns a ``Metainfo`` or (for a v2 successor) a
+        ``MetainfoV2``; both feed straight into ``add``/``apply_update``.
+        """
+        url = getattr(torrent.metainfo, "update_url", None)
+        if not url:
+            return None
+        import urllib.parse
+
+        if urllib.parse.urlsplit(url).scheme not in ("http", "https"):
+            raise ValueError(f"refusing non-http(s) update-url {url!r}")
+        from torrent_tpu.net.tracker import _http_get
+
+        raw = await _http_get(url, timeout=30, proxy=self.proxy)
+        if len(raw) > (16 << 20):
+            raise ValueError("update-url served an implausibly large .torrent")
+        from torrent_tpu.codec.metainfo import parse_metainfo
+
+        new_meta = parse_metainfo(raw)
+        if new_meta is not None:
+            new_hash = new_meta.info_hash
+        else:
+            from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+
+            v2 = parse_metainfo_v2(raw)
+            if v2 is None:
+                raise ValueError("update-url did not serve a valid .torrent")
+            new_meta, new_hash = v2, v2.truncated_info_hash
+        if new_hash == torrent.metainfo.info_hash:
+            return None
+        return new_meta
+
+    @staticmethod
+    def _carry_selection(old: Torrent, new_meta) -> list[int] | None:
+        """Map the old torrent's file selection onto the successor by
+        relative path: a file the user deselected stays deselected if it
+        reappears; new files default to wanted. None = no selection to
+        carry (everything was wanted)."""
+        if not any(p <= 0 for p in old.file_priorities.values()):
+            return None
+
+        def paths(info):
+            if getattr(info, "files", None) is None:
+                return [(info.name,)]
+            return [tuple(fe.path) for fe in info.files]
+
+        old_unwanted = {
+            p
+            for i, p in enumerate(paths(old.info))
+            if old.file_priorities.get(i, 1) <= 0
+        }
+        new_info = getattr(new_meta, "info", new_meta)
+        return [
+            i for i, p in enumerate(paths(new_info)) if p not in old_unwanted
+        ]
+
+    async def apply_update(
+        self,
+        torrent: Torrent,
+        new_meta: Metainfo | None = None,
+        storage: Storage | StorageMethod | str | None = None,
+        wanted_files: list[int] | None = None,
+    ) -> Torrent | None:
+        """BEP 39: switch to the updated torrent. Fetches the update when
+        ``new_meta`` is None (returning None if already current), adds it
+        with the old torrent as a BEP 38 adoption donor — unchanged files
+        carry over without touching the swarm — then removes the old one.
+        ``storage`` defaults to the old torrent's directory (in-place
+        update) when it lives on the filesystem. The old torrent's file
+        selection carries over by relative path (a deselected 100 GB file
+        must not start downloading because the dataset was re-published);
+        pass ``wanted_files`` to override."""
+        if new_meta is None:
+            new_meta = await self.check_for_update(torrent)
+            if new_meta is None:
+                return None
+        if storage is None:
+            method = torrent.storage.method
+            if isinstance(method, FsStorage):
+                storage = method.root
+            else:
+                raise ValueError(
+                    "apply_update needs an explicit storage for non-filesystem torrents"
+                )
+        if wanted_files is None:
+            wanted_files = self._carry_selection(torrent, new_meta)
+        new_torrent = await self.add(
+            new_meta,
+            storage,
+            wanted_files=wanted_files,
+            _adopt_from=(torrent.metainfo.info_hash,),
+        )
+        await self.remove(torrent.metainfo.info_hash)
+        return new_torrent
 
     async def add_hybrid(
         self, torrent_bytes: bytes, storage_dir: str

@@ -229,6 +229,9 @@ class Torrent:
         # reference downloads everything or nothing). 0 = skip, higher =
         # sooner; derived from per-file priorities via set_file_priorities.
         self._piece_priority = np.ones(self.info.num_pieces, dtype=np.int8)
+        # effective per-file priorities (empty until a selection is set:
+        # everything wanted at the default 1)
+        self.file_priorities: dict[int, int] = {}
         # streaming: pre-boost priority snapshot, active reader windows
         # (token -> (first_piece, n)), and per-piece completion events
         # for parked readers (created on demand, popped on set)
@@ -368,6 +371,12 @@ class Torrent:
             await self._apply_file_priorities(priorities, ranges)
 
     async def _apply_file_priorities(self, priorities: dict[int, int], ranges) -> None:
+        # the effective full mapping (unnamed files reset to 1 — this is
+        # a whole-selection replacement API); BEP 39 apply_update reads
+        # it to carry a selection across to the successor torrent
+        self.file_priorities = {
+            i: int(priorities.get(i, 1)) for i in range(len(ranges))
+        }
         plen = self.info.piece_length
         entries = self.info.files or ()
         prio = np.zeros(self.info.num_pieces, dtype=np.int8)

@@ -94,6 +94,26 @@ class V2SessionMeta:
 
         return parse_url_list(self.raw.get(b"httpseeds"))
 
+    @property
+    def similar(self) -> tuple[bytes, ...]:
+        """BEP 38 hints (the CLI writes them at the top level for v2)."""
+        from torrent_tpu.codec.metainfo import parse_similar
+
+        return parse_similar(self.raw)
+
+    @property
+    def collections(self) -> tuple[str, ...]:
+        from torrent_tpu.codec.metainfo import parse_collections
+
+        return parse_collections(self.raw)
+
+    @property
+    def update_url(self) -> str | None:
+        """BEP 39 — so ``check_for_update`` works for v2 torrents too."""
+        from torrent_tpu.codec.metainfo import parse_update_url
+
+        return parse_update_url(self.raw)
+
 
 def _pad_target(length: int) -> int:
     """Leaf-pad target for a file no larger than one piece: the next

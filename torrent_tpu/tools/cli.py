@@ -134,6 +134,14 @@ def _cmd_info(args) -> int:
         print(f"http seeds:   {len(m.http_seeds)} (BEP 17)")
         for u in m.http_seeds[:5]:
             print(f"  - {u}")
+    if m.similar:
+        print(f"similar:      {len(m.similar)} torrents (BEP 38)")
+        for h in m.similar[:5]:
+            print(f"  - {h.hex()}")
+    if m.collections:
+        print(f"collections:  {', '.join(m.collections)} (BEP 38)")
+    if m.update_url:
+        print(f"update url:   {m.update_url} (BEP 39)")
     if info.files is not None:
         pads = sum(1 for fe in info.files if getattr(fe, "pad", False))
         print(
@@ -185,6 +193,7 @@ def _cmd_make(args) -> int:
         pad_files=getattr(args, "pad_files", False),
         similar=similar or None,
         collections=args.collection or None,
+        update_url=args.update_url,
     )
     print("", file=sys.stderr)
     out = args.output or (args.path.rstrip("/").rsplit("/", 1)[-1] + ".torrent")
@@ -244,8 +253,8 @@ def _make_v2(args) -> int:
     similar = _parse_similar_args(args)
     if similar is None:
         return 2
-    if similar or args.collection:
-        # BEP 38 hints for v2/hybrid go in the ROOT dict (the BEP's
+    if similar or args.collection or args.update_url:
+        # BEP 38/39 hints for v2/hybrid go in the ROOT dict (the BEPs'
         # mutable placement): the v2 info-dict builders don't carry
         # them, and top-level keys leave the infohash untouched
         from torrent_tpu.codec.bencode import bdecode, bencode
@@ -255,6 +264,8 @@ def _make_v2(args) -> int:
             top[b"similar"] = similar
         if args.collection:
             top[b"collections"] = [c.encode("utf-8") for c in args.collection]
+        if args.update_url:
+            top[b"update-url"] = args.update_url.encode("utf-8")
         data = bencode(top, sort_keys=False)
     out = args.output or (name + ".torrent")
     with open(out, "wb") as f:
@@ -790,6 +801,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="BEP 38: hex infohash of a torrent sharing files (repeatable)")
     sp.add_argument("--collection", action="append", default=[],
                     help="BEP 38: collection name grouping related torrents (repeatable)")
+    sp.add_argument("--update-url",
+                    help="BEP 39: URL where updated versions of this torrent appear")
     sp.add_argument("--v2", action="store_true",
                     help="author a BitTorrent v2 (BEP 52) torrent: SHA-256 merkle file tree")
     sp.add_argument("--hybrid", action="store_true",

@@ -132,6 +132,7 @@ def make_torrent(
     pad_files: bool = False,
     similar: list[bytes] | None = None,
     collections: list[str] | None = None,
+    update_url: str | None = None,
 ) -> bytes:
     """Author a .torrent for a file or directory (tools/make_torrent.ts:115).
 
@@ -201,6 +202,8 @@ def make_torrent(
         info[b"similar"] = list(similar)  # BEP 38
     if collections:
         info[b"collections"] = [c.encode("utf-8") for c in collections]  # BEP 38
+    if update_url:
+        info[b"update-url"] = update_url.encode("utf-8")  # BEP 39
 
     top: dict = {b"announce": tracker.encode("utf-8"), b"info": info}
     if announce_list:

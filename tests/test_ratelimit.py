@@ -94,6 +94,8 @@ class TestSessionWiring:
             taken = []
 
             class _Spy:
+                unlimited = False  # the serve path skips unlimited buckets
+
                 async def take(self, n):
                     taken.append(n)
 

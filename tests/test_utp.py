@@ -582,7 +582,12 @@ class TestSack:
 
         async def transfer_with(sack_on: bool) -> int:
             old = utp.SACK_ENABLED
+            old_ladder = utp.MTU_LADDER_LOOPBACK
             utp.SACK_ENABLED = sack_on
+            # this test measures SACK at real-network packet sizes; the
+            # loopback jumbo rung would fit the whole payload in ~4
+            # packets and degenerate the loss pattern
+            utp.MTU_LADDER_LOOPBACK = utp.MTU_LADDER
             try:
                 received = bytearray()
                 done = asyncio.Event()
@@ -622,6 +627,7 @@ class TestSack:
                     server.close()
             finally:
                 utp.SACK_ENABLED = old
+                utp.MTU_LADDER_LOOPBACK = old_ladder
 
         async def go():
             # single lossy runs have scheduling jitter: retry the
@@ -706,13 +712,18 @@ class TestPathMtu:
         run(go(), timeout=120)
 
     def test_unclamped_dial_keeps_full_mtu(self):
+        """An unclamped LOOPBACK dial adopts the jumbo first rung (local
+        paths carry ~64 KiB datagrams); the standard ladder's top is what
+        non-loopback dials see (covered by the clamped-link tests, whose
+        relays force the step-down)."""
+
         async def go():
             server = await _echo_pair()
             try:
                 reader, writer = await utp.open_utp_connection(
                     "127.0.0.1", server.port, timeout=5
                 )
-                assert writer._conn.mtu == utp.MTU_LADDER[0]
+                assert writer._conn.mtu == utp.JUMBO_MTU
                 writer.close()
             finally:
                 server.close()

@@ -60,9 +60,12 @@ HTTP_MAX_REDIRECTS = 5
 _REDIRECT_STATUSES = frozenset({301, 302, 303, 307, 308})
 
 
-async def _read_chunked(reader: asyncio.StreamReader) -> bytes:
+async def _read_chunked(
+    reader: asyncio.StreamReader, max_bytes: int | None = None
+) -> bytes:
     """Decode a Transfer-Encoding: chunked body (RFC 9112 §7.1)."""
     chunks = []
+    total = 0
     while True:
         size_line = await reader.readline()
         if not size_line:
@@ -80,11 +83,16 @@ async def _read_chunked(reader: asyncio.StreamReader) -> bytes:
                 if line in (b"\r\n", b"\n", b""):
                     break
             return b"".join(chunks)
+        total += size
+        if max_bytes is not None and total > max_bytes:
+            raise TrackerError(f"HTTP body exceeds {max_bytes} bytes")
         chunks.append(await reader.readexactly(size))
         await reader.readexactly(2)  # CRLF after each chunk
 
 
-async def _http_get_once(url: str, proxy=None) -> tuple[int, bytes, str | None]:
+async def _http_get_once(
+    url: str, proxy=None, max_bytes: int | None = None
+) -> tuple[int, bytes, str | None]:
     """One GET hop → (status, body, location). Raw path passed verbatim."""
     parts = urlsplit(url)
     if parts.scheme not in ("http", "https"):
@@ -134,11 +142,26 @@ async def _http_get_once(url: str, proxy=None) -> tuple[int, bytes, str | None]:
         if chunked:
             # Chunked wins over Content-Length (RFC 9112 §6.3); the
             # reference got both framings free from fetch (tracker.ts:26-31).
-            body = await _read_chunked(reader)
+            body = await _read_chunked(reader, max_bytes)
         elif content_length is not None:
+            if max_bytes is not None and content_length > max_bytes:
+                raise TrackerError(f"HTTP body exceeds {max_bytes} bytes")
             body = await reader.readexactly(content_length)
         else:
-            body = await reader.read()  # Connection: close → EOF delimits
+            # Connection: close → EOF delimits; cap DURING the read — the
+            # body is attacker-paced and buffering it all before a size
+            # check would be the memory DoS the cap exists to stop
+            parts_ = []
+            got = 0
+            while True:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    break
+                got += len(chunk)
+                if max_bytes is not None and got > max_bytes:
+                    raise TrackerError(f"HTTP body exceeds {max_bytes} bytes")
+                parts_.append(chunk)
+            body = b"".join(parts_)
         return status, body, location
     finally:
         writer.close()
@@ -148,14 +171,23 @@ async def _http_get_once(url: str, proxy=None) -> tuple[int, bytes, str | None]:
             pass
 
 
-async def _http_get(url: str, timeout: float = HTTP_TIMEOUT, proxy=None) -> bytes:
+async def _http_get(
+    url: str,
+    timeout: float = HTTP_TIMEOUT,
+    proxy=None,
+    max_bytes: int | None = 32 << 20,
+) -> bytes:
     """HTTP/1.1 GET returning the body, following up to HTTP_MAX_REDIRECTS
-    3xx hops and decoding chunked transfer-encoding."""
+    3xx hops and decoding chunked transfer-encoding. ``max_bytes``
+    bounds the body AS IT STREAMS (no tracker or update-url response
+    has business being this large; the peer is untrusted)."""
 
     async def go() -> bytes:
         current = url
         for _ in range(HTTP_MAX_REDIRECTS + 1):
-            status, body, location = await _http_get_once(current, proxy=proxy)
+            status, body, location = await _http_get_once(
+                current, proxy=proxy, max_bytes=max_bytes
+            )
             if status in _REDIRECT_STATUSES:
                 if not location:
                     raise TrackerError(f"HTTP {status} redirect without Location")

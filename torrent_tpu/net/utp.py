@@ -64,6 +64,12 @@ VERSION = 1
 HEADER = struct.Struct(">BBHIIIHH")
 MTU = 1400  # default payload budget per ST_DATA (vs 1500-byte eth MTU)
 MTU_LADDER = (1400, 1280, 1152, 576)  # SYN-probe step-down candidates
+# Loopback/localhost paths carry ~64 KiB datagrams: starting the probe
+# ladder there cuts per-packet Python/syscall overhead ~45x for local
+# transfers (seedbox-to-player moves, tests). Non-loopback dials never
+# see this rung, so nothing changes on real networks.
+JUMBO_MTU = 62 * 1024
+MTU_LADDER_LOOPBACK = (JUMBO_MTU,) + MTU_LADDER
 SACK_ENABLED = True  # module toggle so tests can measure SACK's effect
 SACK_MAX_BYTES = 8  # bitmask covers ack_nr+2 .. ack_nr+1+64
 TARGET_DELAY_US = 100_000  # LEDBAT one-way-delay target
@@ -139,6 +145,17 @@ def _seq_lt(a: int, b: int) -> bool:
     return ((b - a) & 0xFFFF) < 0x8000 and a != b
 
 
+def _is_loopback_addr(host: str) -> bool:
+    """True for 127/8, ::1, and the v4-mapped form a dual-stack socket
+    reports (``::ffff:127.0.0.1`` is NOT ``is_loopback`` in ipaddress)."""
+    try:
+        ip = ipaddress.ip_address(host.split("%")[0])
+    except ValueError:
+        return False
+    mapped = getattr(ip, "ipv4_mapped", None)
+    return (mapped or ip).is_loopback
+
+
 class _UtpReader(asyncio.StreamReader):
     """StreamReader that reports consumption back to the connection so
     window-update STATEs go out when the application drains the buffer
@@ -192,6 +209,7 @@ class UtpConnection:
         self._sacked_bytes = 0
         self._timer_deadline = 0.0  # lazy retransmit-timer re-arm target
         self.mtu = MTU  # payload budget; dial-time SYN probing may lower it
+        self._mtu_ladder = MTU_LADDER  # dial() swaps in the loopback ladder
         self._mtu_probe_idx: int | None = None  # ladder position while dialing
         self.retx_count = 0  # retransmitted packets (observability + tests)
         self.retx_bytes = 0
@@ -248,7 +266,7 @@ class UtpConnection:
     def _window(self) -> int:
         # cwnd has an MTU floor; the PEER's advertised window does not —
         # zero from the peer means pause (flow control, not congestion)
-        cwnd = max(MTU, min(int(self.cwnd), MAX_CWND_PKTS * MTU))
+        cwnd = max(self.mtu, min(int(self.cwnd), MAX_CWND_PKTS * self.mtu))
         return min(cwnd, self.peer_wnd)
 
     def _flow_used(self) -> int:
@@ -411,9 +429,17 @@ class UtpConnection:
                 self._die(reset=False)
 
     def _handle_ack(self, ptype: int, ack: int, ts_diff: int, sack: bytes | None = None) -> None:
-        acked = [
-            s for s in self._outstanding if not _seq_lt(ack, s)
-        ]  # s <= ack in seq space
+        # _outstanding iterates in send order (== seq order mod 2^16:
+        # _retransmit mutates in place, SACK pops preserve relative
+        # order), so the cumulatively-acked set is a PREFIX — walk it and
+        # break at the first newer seq instead of scanning the whole
+        # window per ack (the scan was ~25% of a loopback transfer's
+        # sender-side CPU at 16-packet windows).
+        acked = []
+        for s in self._outstanding:  # s <= ack in seq space
+            if ((s - ack) & 0xFFFF) < 0x8000 and s != ack:
+                break  # ack < s: everything after is newer still
+            acked.append(s)
         if self._sacked:
             for s in [s for s in self._sacked if not _seq_lt(ack, s)]:
                 self._sacked_bytes -= self._sacked.pop(s)  # budget freed
@@ -446,7 +472,7 @@ class UtpConnection:
             need = min(3, max(2, len(self._outstanding) - 1))
             if self._dup_acks >= need:
                 self._dup_acks = 0
-                self.cwnd = max(MIN_CWND_PKTS * MTU, self.cwnd * 0.5)
+                self.cwnd = max(MIN_CWND_PKTS * self.mtu, self.cwnd * 0.5)
                 oldest = min(self._outstanding, key=lambda s: (s - ack) & 0xFFFF)
                 self._retransmit(oldest)
 
@@ -479,7 +505,7 @@ class UtpConnection:
             # of waiting out an RTO (mask repeats each STATE, so cut
             # cwnd only once per distinct hole)
             self._last_fast_resend = hole
-            self.cwnd = max(MIN_CWND_PKTS * MTU, self.cwnd * 0.5)
+            self.cwnd = max(MIN_CWND_PKTS * self.mtu, self.cwnd * 0.5)
             self._retransmit(hole)
         return n_sacked
 
@@ -498,8 +524,12 @@ class UtpConnection:
             return  # no usable delay sample
         off_target = (TARGET_DELAY_US - ts_diff_us) / TARGET_DELAY_US
         # full-target gain: one MTU per RTT when delay is zero
-        self.cwnd += off_target * MTU * acked_pkts * MTU / max(self.cwnd, MTU)
-        self.cwnd = max(MIN_CWND_PKTS * MTU, min(self.cwnd, MAX_CWND_PKTS * MTU))
+        self.cwnd += (
+            off_target * self.mtu * acked_pkts * self.mtu / max(self.cwnd, self.mtu)
+        )
+        self.cwnd = max(
+            MIN_CWND_PKTS * self.mtu, min(self.cwnd, MAX_CWND_PKTS * self.mtu)
+        )
 
     # ----------------------------------------------------------- timers
 
@@ -535,7 +565,7 @@ class UtpConnection:
         # multiplicative decrease, not full collapse: a floor-sized
         # window can't generate the dup acks that drive fast resend,
         # turning every subsequent loss into another full RTO
-        self.cwnd = max(MIN_CWND_PKTS * MTU, self.cwnd * 0.5)
+        self.cwnd = max(MIN_CWND_PKTS * self.mtu, self.cwnd * 0.5)
         oldest = min(
             self._outstanding, key=lambda s: self._outstanding[s][1]
         )
@@ -555,11 +585,13 @@ class UtpConnection:
             self.rto = DEFAULT_RTO
             self._mtu_probe_idx += 1
             pad = (
-                MTU_LADDER[self._mtu_probe_idx]
-                if self._mtu_probe_idx < len(MTU_LADDER)
+                self._mtu_ladder[self._mtu_probe_idx]
+                if self._mtu_probe_idx < len(self._mtu_ladder)
                 else 0
             )
-            self.mtu = MTU_LADDER[min(self._mtu_probe_idx, len(MTU_LADDER) - 1)]
+            self.mtu = self._mtu_ladder[
+                min(self._mtu_probe_idx, len(self._mtu_ladder) - 1)
+            ]
             new_pkt = encode_packet(
                 ST_SYN, self.recv_id, oldest, 0, payload=b"\x00" * pad
             )
@@ -788,8 +820,13 @@ class UtpEndpoint(asyncio.DatagramProtocol):
             if payload:
                 # SYN padding is the dialer's MTU probe; a symmetric path
                 # passed len(payload)+20 bytes our way, so adopt it as our
-                # own send budget too (bare SYN ⇒ keep the default)
-                conn.mtu = max(MTU_LADDER[-1], min(MTU, len(payload)))
+                # own send budget too (bare SYN ⇒ keep the default). The
+                # jumbo bound is LOOPBACK-ONLY on this side as well: a WAN
+                # SYN arrives reassembled from fragments, and adopting
+                # 62 KiB sends onto a 1500-byte path would fragment every
+                # ST_DATA ~44 ways (one lost fragment = whole packet).
+                cap = JUMBO_MTU if _is_loopback_addr(addr[0]) else MTU
+                conn.mtu = max(MTU_LADDER[-1], min(cap, len(payload)))
             conn.ack_nr = seq
             conn.connected.set()
             self._conns[(addr, conn.recv_id)] = conn
@@ -851,9 +888,13 @@ class UtpEndpoint(asyncio.DatagramProtocol):
         # SYN carries recv_id and consumes seq 1
         pad = b""
         if probe_mtu:
+            if _is_loopback_addr(addr[0]):
+                # local paths move ~64 KiB datagrams: probe jumbo first
+                # (a non-loopback dial never sees this rung)
+                conn._mtu_ladder = MTU_LADDER_LOOPBACK
             conn._mtu_probe_idx = 0
-            conn.mtu = MTU_LADDER[0]
-            pad = b"\x00" * MTU_LADDER[0]
+            conn.mtu = conn._mtu_ladder[0]
+            pad = b"\x00" * conn._mtu_ladder[0]
         pkt = encode_packet(ST_SYN, recv_id, conn.seq_nr, 0, payload=pad)
         conn._out_add(conn.seq_nr, pkt)
         self.sendto(pkt, addr)

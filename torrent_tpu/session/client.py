@@ -347,7 +347,7 @@ class Client:
         metainfo: Metainfo,
         storage: Storage | StorageMethod | str,
         wanted_files: list[int] | None = None,
-        _adopt_from: tuple[bytes, ...] = (),
+        _adopt_from: tuple = (),  # Torrent donors (BEP 39 predecessor)
     ) -> Torrent:
         """Register + start a torrent (client.ts:53-67).
 
@@ -413,14 +413,16 @@ class Client:
             await torrent.select_files(
                 [i for i in wanted_files if 0 <= i < n_files]
             )
-        await self._adopt_similar(torrent, extra_donors=frozenset(_adopt_from))
+        await self._adopt_similar(torrent, donor_torrents=tuple(_adopt_from))
         await torrent.start()
         if self.lsd is not None and not torrent.private:
             self.lsd.register(metainfo.info_hash)  # BEP 27: never private
         return torrent
 
     async def _adopt_similar(
-        self, torrent: Torrent, extra_donors: frozenset[bytes] = frozenset()
+        self,
+        torrent: Torrent,
+        donor_torrents: tuple[Torrent, ...] = (),
     ) -> None:
         """BEP 38 local-data reuse: pre-fill the new torrent's storage
         from identical files of already-registered torrents.
@@ -440,14 +442,16 @@ class Client:
         # surface; they can still be adopted INTO when a donor names them
         hints = set(getattr(meta, "similar", ()) or ())
         cols = set(getattr(meta, "collections", ()) or ())
-        donors = []
+        # explicit donors (BEP 39: the already-STOPPED predecessor — it
+        # must not be registered/serving while the successor overwrites
+        # shared files, so it can't be found via self.torrents)
+        donors = list(donor_torrents)
         for d in self.torrents.values():
             if d is torrent:
                 continue
             dm = d.metainfo
             related = (
                 dm.info_hash in hints
-                or dm.info_hash in extra_donors  # BEP 39 update predecessor
                 or meta.info_hash in (getattr(dm, "similar", ()) or ())
                 or (cols and cols.intersection(getattr(dm, "collections", ()) or ()))
             )
@@ -599,9 +603,9 @@ class Client:
             raise ValueError(f"refusing non-http(s) update-url {url!r}")
         from torrent_tpu.net.tracker import _http_get
 
-        raw = await _http_get(url, timeout=30, proxy=self.proxy)
-        if len(raw) > (16 << 20):
-            raise ValueError("update-url served an implausibly large .torrent")
+        # cap enforced DURING the read (a hostile server can otherwise
+        # stream GBs into RAM before any post-hoc length check runs)
+        raw = await _http_get(url, timeout=30, proxy=self.proxy, max_bytes=16 << 20)
         from torrent_tpu.codec.metainfo import parse_metainfo
 
         new_meta = parse_metainfo(raw)
@@ -672,13 +676,24 @@ class Client:
                 )
         if wanted_files is None:
             wanted_files = self._carry_selection(torrent, new_meta)
-        new_torrent = await self.add(
-            new_meta,
-            storage,
-            wanted_files=wanted_files,
-            _adopt_from=(torrent.metainfo.info_hash,),
-        )
+        # Deregister + stop the predecessor BEFORE the successor starts:
+        # the two share files in an in-place update, and a still-serving
+        # old seed would hand out offsets the new download is rewriting
+        # (peers would hash-fail those pieces and strike us). It stays
+        # available as an adoption donor by reference; on a failed add it
+        # is re-registered and restarted.
         await self.remove(torrent.metainfo.info_hash)
+        try:
+            new_torrent = await self.add(
+                new_meta,
+                storage,
+                wanted_files=wanted_files,
+                _adopt_from=(torrent,),
+            )
+        except BaseException:
+            self.torrents[torrent.metainfo.info_hash] = torrent
+            await torrent.start()
+            raise
         return new_torrent
 
     async def add_hybrid(

@@ -2527,11 +2527,12 @@ class Torrent:
         if len(block) != length:
             log.error("serving piece %d: short read", index)
             return
-        if self.upload_bucket is not None:
+        if self.upload_bucket is not None and not self.upload_bucket.unlimited:
             # client-global upload cap; debited only once the block read
             # succeeded so storage errors don't burn cap budget
             await self.upload_bucket.take(length)
-        await self.own_upload_bucket.take(length)  # per-torrent layer
+        if not self.own_upload_bucket.unlimited:
+            await self.own_upload_bucket.take(length)  # per-torrent layer
         await proto.send_message(peer.writer, proto.Piece(index, begin, block))
         peer.bytes_up += length
         self.uploaded += length

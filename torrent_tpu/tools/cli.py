@@ -114,6 +114,21 @@ def _cmd_info(args) -> int:
                 print(f"  [{i}] {'/'.join(fe.path)}  ({fe.length:,} bytes)")
             if len(v2.info.files) > 20:
                 print(f"  ... and {len(v2.info.files) - 20} more")
+            from torrent_tpu.codec.metainfo import (
+                parse_collections,
+                parse_similar,
+                parse_update_url,
+            )
+
+            raw = getattr(v2, "raw", {}) or {}
+            if similar := parse_similar(raw):
+                print(f"similar:      {len(similar)} torrents (BEP 38)")
+                for h in similar[:5]:
+                    print(f"  - {h.hex()}")
+            if cols := parse_collections(raw):
+                print(f"collections:  {', '.join(cols)} (BEP 38)")
+            if upd := parse_update_url(raw):
+                print(f"update url:   {upd} (BEP 39)")
             return 0
         print("error: not a valid .torrent file", file=sys.stderr)
         return 1

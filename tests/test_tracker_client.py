@@ -565,3 +565,73 @@ class TestHttpRobustness:
                     await announce(f"http://127.0.0.1:{srv.port}/announce", make_info())
 
         run(go())
+
+
+class TestHttpBodyCap:
+    """The body-size cap is enforced WHILE streaming: a hostile server
+    must not balloon RAM for the whole timeout before a length check."""
+
+    def test_content_length_over_cap_rejected_before_read(self):
+        async def go():
+            from torrent_tpu.net.tracker import _http_get
+
+            hdr = b"HTTP/1.1 200 OK\r\nContent-Length: 99999999\r\n\r\n"
+            srv = ScriptedHttpServer([hdr + b"x" * 1024])
+            async with srv:
+                with pytest.raises(TrackerError, match="exceeds"):
+                    await _http_get(
+                        f"http://127.0.0.1:{srv.port}/t", max_bytes=65536
+                    )
+
+        run(go())
+
+    def test_eof_delimited_body_capped_mid_stream(self):
+        async def go():
+            from torrent_tpu.net.tracker import _http_get
+
+            # no Content-Length: EOF delimits; body exceeds the cap
+            srv = ScriptedHttpServer(
+                [b"HTTP/1.1 200 OK\r\n\r\n" + b"y" * (256 * 1024)]
+            )
+            async with srv:
+                with pytest.raises(TrackerError, match="exceeds"):
+                    await _http_get(
+                        f"http://127.0.0.1:{srv.port}/t", max_bytes=65536
+                    )
+
+        run(go())
+
+    def test_chunked_body_capped_mid_stream(self):
+        async def go():
+            from torrent_tpu.net.tracker import _http_get
+
+            chunk = b"10000\r\n" + b"z" * 65536 + b"\r\n"
+            srv = ScriptedHttpServer(
+                [
+                    b"HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n\r\n"
+                    + chunk * 3
+                    + b"0\r\n\r\n"
+                ]
+            )
+            async with srv:
+                with pytest.raises(TrackerError, match="exceeds"):
+                    await _http_get(
+                        f"http://127.0.0.1:{srv.port}/t", max_bytes=100_000
+                    )
+
+        run(go())
+
+    def test_under_cap_passes(self):
+        async def go():
+            from torrent_tpu.net.tracker import _http_get
+
+            srv = ScriptedHttpServer(
+                [b"HTTP/1.1 200 OK\r\nContent-Length: 5\r\n\r\nhello"]
+            )
+            async with srv:
+                body = await _http_get(
+                    f"http://127.0.0.1:{srv.port}/t", max_bytes=65536
+                )
+                assert body == b"hello"
+
+        run(go())

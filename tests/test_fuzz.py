@@ -370,3 +370,43 @@ class TestCompactV6Properties:
         mapped = (f"::ffff:{addr[0]}", addr[1])
         assert pack_compact_v6([mapped]) == b""  # not v6 after normalize
         assert len(pack_compact_v4([mapped])) == 6  # routed to v4
+
+
+class TestBep38HintParsers:
+    """parse_similar/parse_collections/parse_update_url accept raw
+    attacker-bencoded dicts: anything decodes to SOMETHING, never raises,
+    and only well-shaped entries survive."""
+
+    hostile_value = st.recursive(
+        st.one_of(st.binary(max_size=40), st.integers(), st.none()),
+        lambda inner: st.one_of(
+            st.lists(inner, max_size=5),
+            st.dictionaries(st.binary(max_size=8), inner, max_size=4),
+        ),
+        max_leaves=10,
+    )
+
+    @given(hostile_value, hostile_value)
+    @settings(max_examples=200, deadline=None)
+    def test_never_raise_and_shape_check(self, sim_v, col_v):
+        from torrent_tpu.codec.metainfo import (
+            parse_collections,
+            parse_similar,
+            parse_update_url,
+        )
+
+        raw = {b"info": {b"similar": sim_v, b"update-url": col_v}, b"collections": col_v}
+        sims = parse_similar(raw)
+        assert all(isinstance(h, bytes) and len(h) in (20, 32) for h in sims)
+        assert len(set(sims)) == len(sims)  # deduped
+        cols = parse_collections(raw)
+        assert all(isinstance(c, str) and c for c in cols)
+        url = parse_update_url(raw)
+        assert url is None or isinstance(url, str)
+
+    @given(st.one_of(st.binary(max_size=60), st.integers(), st.lists(st.binary(max_size=4))))
+    @settings(max_examples=100, deadline=None)
+    def test_non_dict_info_tolerated(self, bad_info):
+        from torrent_tpu.codec.metainfo import parse_similar
+
+        assert isinstance(parse_similar({b"info": bad_info}), tuple)

@@ -231,3 +231,84 @@ class TestSelectionCarriesOver:
                 shutdown()
 
         run(go())
+
+
+class TestCliUpdate:
+    def test_cli_update_writes_successor(self, tmp_path):
+        """Real subprocess drive of `torrent-tpu update`."""
+        import subprocess
+        import sys as _sys
+
+        rng = np.random.default_rng(55)
+        payload = rng.integers(0, 256, size=40000, dtype=np.uint8).tobytes()
+        (tmp_path / "d.bin").write_bytes(payload)
+        v1 = make_torrent(str(tmp_path / "d.bin"), ANNOUNCE, piece_length=16384)
+        v2 = make_torrent(
+            str(tmp_path / "d.bin"), ANNOUNCE, piece_length=32768
+        )  # different info dict
+        url, shutdown = _serve_bytes(v2)
+        try:
+            from torrent_tpu.codec.bencode import bdecode, bencode
+
+            top = bdecode(v1)
+            top[b"update-url"] = url.encode()
+            tfile = tmp_path / "d.torrent"
+            tfile.write_bytes(bencode(top))
+
+            r = subprocess.run(
+                [_sys.executable, "-m", "torrent_tpu.tools.cli", "update", str(tfile)],
+                capture_output=True,
+                text=True,
+                cwd="/root/repo",
+                timeout=60,
+            )
+            assert r.returncode == 0, r.stderr
+            out = tmp_path / "d.updated.torrent"
+            assert out.exists()
+            assert parse_metainfo(out.read_bytes()).info.piece_length == 32768
+
+            # --check mode writes nothing
+            out.unlink()
+            r = subprocess.run(
+                [
+                    _sys.executable,
+                    "-m",
+                    "torrent_tpu.tools.cli",
+                    "update",
+                    str(tfile),
+                    "--check",
+                ],
+                capture_output=True,
+                text=True,
+                cwd="/root/repo",
+                timeout=60,
+            )
+            assert r.returncode == 0 and "update available" in r.stdout
+            assert not out.exists()
+        finally:
+            shutdown()
+
+    def test_cli_update_reports_current(self, tmp_path):
+        import subprocess
+        import sys as _sys
+
+        (tmp_path / "e.bin").write_bytes(b"e" * 9000)
+        v1 = make_torrent(str(tmp_path / "e.bin"), ANNOUNCE, piece_length=16384)
+        url, shutdown = _serve_bytes(v1)  # serves the SAME torrent
+        try:
+            from torrent_tpu.codec.bencode import bdecode, bencode
+
+            top = bdecode(v1)
+            top[b"update-url"] = url.encode()
+            tfile = tmp_path / "e.torrent"
+            tfile.write_bytes(bencode(top))
+            r = subprocess.run(
+                [_sys.executable, "-m", "torrent_tpu.tools.cli", "update", str(tfile)],
+                capture_output=True,
+                text=True,
+                cwd="/root/repo",
+                timeout=60,
+            )
+            assert r.returncode == 0 and "current" in r.stdout, r.stdout + r.stderr
+        finally:
+            shutdown()

@@ -585,42 +585,11 @@ class Client:
         return False
 
     async def check_for_update(self, torrent: Torrent):
-        """BEP 39: fetch the torrent's ``update-url``; a parsed metainfo
-        with a DIFFERENT infohash means an update exists (None = current
-        version, or no update-url). http/https only — the URL is
-        untrusted metainfo content (same SSRF stance as webseeds) — and
-        the fetch rides the tracker HTTP client, so it honors the
-        configured proxy instead of leaking the real IP to whoever the
-        metainfo names. Returns a ``Metainfo`` or (for a v2 successor) a
-        ``MetainfoV2``; both feed straight into ``add``/``apply_update``.
-        """
-        url = getattr(torrent.metainfo, "update_url", None)
-        if not url:
-            return None
-        import urllib.parse
-
-        if urllib.parse.urlsplit(url).scheme not in ("http", "https"):
-            raise ValueError(f"refusing non-http(s) update-url {url!r}")
-        from torrent_tpu.net.tracker import _http_get
-
-        # cap enforced DURING the read (a hostile server can otherwise
-        # stream GBs into RAM before any post-hoc length check runs)
-        raw = await _http_get(url, timeout=30, proxy=self.proxy, max_bytes=16 << 20)
-        from torrent_tpu.codec.metainfo import parse_metainfo
-
-        new_meta = parse_metainfo(raw)
-        if new_meta is not None:
-            new_hash = new_meta.info_hash
-        else:
-            from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
-
-            v2 = parse_metainfo_v2(raw)
-            if v2 is None:
-                raise ValueError("update-url did not serve a valid .torrent")
-            new_meta, new_hash = v2, v2.truncated_info_hash
-        if new_hash == torrent.metainfo.info_hash:
-            return None
-        return new_meta
+        """BEP 39: fetch the torrent's ``update-url``; a metainfo with a
+        DIFFERENT infohash means an update exists (None = current, or no
+        update-url). Delegates to module-level :func:`fetch_update` with
+        the client's proxy so the poll never leaks the real IP."""
+        return await fetch_update(torrent.metainfo, proxy=self.proxy)
 
     @staticmethod
     def _carry_selection(old: Torrent, new_meta) -> list[int] | None:
@@ -971,3 +940,43 @@ class Client:
             OSError,
         ):
             writer.close()
+
+
+async def fetch_update(metainfo, proxy=None, raw_bytes_out: list | None = None):
+    """BEP 39 poll, usable without a running Client (the CLI's `update`).
+
+    Fetches ``metainfo.update_url`` (http/https only — the URL is
+    untrusted metainfo content, same SSRF stance as webseeds; the body
+    size-caps WHILE streaming) and returns the successor's parsed
+    metainfo — ``Metainfo`` or ``MetainfoV2`` — or None when there is no
+    update-url or the served torrent has the same infohash. Passing
+    ``raw_bytes_out`` collects the fetched .torrent bytes (so a caller
+    can write the successor to disk verbatim).
+    """
+    url = getattr(metainfo, "update_url", None)
+    if not url:
+        return None
+    import urllib.parse
+
+    if urllib.parse.urlsplit(url).scheme not in ("http", "https"):
+        raise ValueError(f"refusing non-http(s) update-url {url!r}")
+    from torrent_tpu.net.tracker import _http_get
+
+    raw = await _http_get(url, timeout=30, proxy=proxy, max_bytes=16 << 20)
+    from torrent_tpu.codec.metainfo import parse_metainfo
+
+    new_meta = parse_metainfo(raw)
+    if new_meta is not None:
+        new_hash = new_meta.info_hash
+    else:
+        from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+
+        v2 = parse_metainfo_v2(raw)
+        if v2 is None:
+            raise ValueError("update-url did not serve a valid .torrent")
+        new_meta, new_hash = v2, v2.truncated_info_hash
+    if new_hash == metainfo.info_hash:
+        return None
+    if raw_bytes_out is not None:
+        raw_bytes_out.append(raw)
+    return new_meta

@@ -177,6 +177,69 @@ def _cmd_info(args) -> int:
     return 0
 
 
+def _cmd_update(args) -> int:
+    """BEP 39 from the command line: fetch the update-url and write the
+    successor verbatim (no session needed — just the poll)."""
+    import asyncio
+
+    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.session.client import fetch_update
+
+    with open(args.torrent, "rb") as f:
+        data = f.read()
+    meta = parse_metainfo(data)
+    if meta is None:
+        from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+
+        v2 = parse_metainfo_v2(data)
+        if v2 is None:
+            print("error: not a valid .torrent file", file=sys.stderr)
+            return 1
+        # the session wrapper carries update_url + the truncated-SHA-256
+        # identity fetch_update compares against
+        from torrent_tpu.session.v2 import v2_session_meta
+
+        meta = v2_session_meta(v2)
+    url = getattr(meta, "update_url", None)
+    if not url:
+        print("no update-url in this torrent (BEP 39 key absent)")
+        return 1
+    proxy = None
+    if args.proxy:
+        from torrent_tpu.net.socks import ProxySpec
+
+        try:
+            proxy = ProxySpec.parse(args.proxy)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    raw_out: list = []
+    try:
+        new_meta = asyncio.run(
+            fetch_update(meta, proxy=proxy, raw_bytes_out=raw_out)
+        )
+    except Exception as e:
+        print(f"error: update fetch failed: {e}", file=sys.stderr)
+        return 1
+    if new_meta is None:
+        print(f"current: {url} serves the same torrent")
+        return 0
+    name = getattr(getattr(new_meta, "info", None), "name", "updated")
+    if args.check:
+        print(f"update available: {name!r} at {url}")
+        return 0
+    base = (
+        args.torrent[: -len(".torrent")]
+        if args.torrent.endswith(".torrent")
+        else args.torrent
+    )
+    out = args.output or (base + ".updated.torrent")
+    with open(out, "wb") as f:
+        f.write(raw_out[0])
+    print(f"update available: wrote {out} ({len(raw_out[0]):,} bytes)")
+    return 0
+
+
 def _cmd_make(args) -> int:
     similar = _parse_similar_args(args)
     if similar is None:
@@ -830,6 +893,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hasher", choices=("cpu", "tpu"), default="cpu")
     sp.add_argument("--batch", type=int, default=256)
     sp.set_defaults(fn=_cmd_verify)
+
+    sp = sub.add_parser(
+        "update", help="BEP 39: poll a torrent's update-url for a successor"
+    )
+    sp.add_argument("torrent")
+    sp.add_argument("-o", "--output",
+                    help="where to write the successor .torrent "
+                         "(default: alongside the original as NAME.updated.torrent)")
+    sp.add_argument("--check", action="store_true",
+                    help="only report whether an update exists (write nothing)")
+    sp.add_argument("--proxy", help="SOCKS5 proxy URL for the fetch")
+    sp.set_defaults(fn=_cmd_update)
 
     sp = sub.add_parser("download", help="download a .torrent file or magnet URI")
     sp.add_argument("source", help=".torrent path or magnet:?xt=urn:btih:... URI")

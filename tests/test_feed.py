@@ -1,0 +1,344 @@
+"""BEP 36 torrent RSS/Atom feeds: parse + poll + auto-add.
+
+The subscription loop long-running seeds use to track a publisher: poll
+the feed, fetch each new .torrent, add it. Parsing treats the XML as
+hostile (DOCTYPE refused, non-http(s)/magnet URLs dropped).
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from torrent_tpu.codec.metainfo import parse_metainfo
+from torrent_tpu.session.client import Client, ClientConfig
+from torrent_tpu.tools.feed import FeedError, FeedPoller, parse_feed
+from torrent_tpu.tools.make_torrent import make_torrent
+
+from tests.test_session import build_torrent_bytes, fast_config, start_tracker
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+RSS = """<?xml version="1.0"?>
+<rss version="2.0"><channel>
+  <title>releases</title>
+  <item>
+    <title>dataset v2</title>
+    <enclosure url="http://example.org/v2.torrent" type="application/x-bittorrent"/>
+  </item>
+  <item>
+    <title>dataset v1</title>
+    <link>http://example.org/v1.torrent</link>
+  </item>
+  <item>
+    <title>evil</title>
+    <enclosure url="file:///etc/passwd"/>
+    <link>javascript:alert(1)</link>
+  </item>
+</channel></rss>
+"""
+
+ATOM = """<?xml version="1.0"?>
+<feed xmlns="http://www.w3.org/2005/Atom">
+  <title>releases</title>
+  <entry>
+    <title>nightly</title>
+    <link rel="alternate" href="http://example.org/page"/>
+    <link rel="enclosure" href="http://example.org/nightly.torrent"/>
+  </entry>
+  <entry>
+    <title>magnet drop</title>
+    <link href="magnet:?xt=urn:btih:aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa"/>
+  </entry>
+</feed>
+"""
+
+
+class TestParse:
+    def test_rss_enclosure_and_link_fallback(self):
+        items = parse_feed(RSS.encode())
+        assert [i.url for i in items] == [
+            "http://example.org/v2.torrent",
+            "http://example.org/v1.torrent",
+        ]
+        assert items[0].title == "dataset v2"
+
+    def test_atom_prefers_enclosure_rel(self):
+        items = parse_feed(ATOM.encode())
+        assert items[0].url == "http://example.org/nightly.torrent"
+        assert items[1].url.startswith("magnet:?xt=urn:btih:")
+
+    def test_doctype_refused(self):
+        bomb = b'<?xml version="1.0"?><!DOCTYPE x [<!ENTITY a "b">]><rss/>'
+        with pytest.raises(FeedError, match="DOCTYPE"):
+            parse_feed(bomb)
+
+    def test_malformed_xml_raises(self):
+        with pytest.raises(FeedError, match="well-formed"):
+            parse_feed(b"<rss><channel><item></rss>")
+
+    def test_empty_feed_ok(self):
+        assert parse_feed(b"<rss><channel></channel></rss>") == []
+
+
+def _serve_routes(routes: dict):
+    """Local HTTP server mapping path -> callable returning bytes."""
+    import http.server
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = routes.get(self.path)
+            if body is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            payload = body() if callable(body) else body
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return f"http://127.0.0.1:{srv.server_port}", srv.shutdown
+
+
+class TestLivePolling:
+    def test_feed_entry_downloads_through_a_real_swarm(self, tmp_path):
+        """Seed publishes a torrent + feed over HTTP; the subscriber's
+        poll adds it and the download completes from the seed. A second
+        poll and a rotated-URL duplicate add nothing."""
+
+        async def go():
+            rng = np.random.default_rng(36)
+            payload = rng.integers(0, 256, size=128 * 1024, dtype=np.uint8).tobytes()
+            server, pump, announce_url = await start_tracker()
+            meta_bytes = build_torrent_bytes(
+                payload, 32768, announce_url.encode(), name=b"drop.bin"
+            )
+            meta = parse_metainfo(meta_bytes)
+
+            feed_xml = None  # set per phase
+
+            base, shutdown = _serve_routes(
+                {
+                    "/feed.xml": lambda: feed_xml,
+                    "/drop.torrent": meta_bytes,
+                    "/rotated.torrent": meta_bytes,  # same content, new URL
+                }
+            )
+            feed_xml = (
+                f'<rss version="2.0"><channel><item><title>drop</title>'
+                f'<enclosure url="{base}/drop.torrent"/></item></channel></rss>'
+            ).encode()
+
+            seed = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            sub = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            seed.config.torrent = fast_config()
+            sub.config.torrent = fast_config()
+            await seed.start()
+            await sub.start()
+            try:
+                (tmp_path / "seed").mkdir()
+                (tmp_path / "seed" / "drop.bin").write_bytes(payload)
+                ts = await seed.add(meta, str(tmp_path / "seed"))
+                assert ts.bitfield.complete
+
+                (tmp_path / "dl").mkdir()
+                poller = FeedPoller(sub, f"{base}/feed.xml", str(tmp_path / "dl"))
+                added = await poller.poll_once()
+                assert len(added) == 1
+                await asyncio.wait_for(added[0].on_complete.wait(), 60)
+                assert (tmp_path / "dl" / "drop.bin").read_bytes() == payload
+
+                assert await poller.poll_once() == []  # same URL: seen
+                feed_xml = (
+                    f'<rss version="2.0"><channel><item><title>again</title>'
+                    f'<enclosure url="{base}/rotated.torrent"/></item></channel></rss>'
+                ).encode()
+                # rotated URL, same infohash: fetched but not re-added
+                assert await poller.poll_once() == []
+            finally:
+                await seed.close()
+                await sub.close()
+                server.close()
+                pump.cancel()
+                shutdown()
+
+        run(go())
+
+    def test_cli_feed_once(self, tmp_path):
+        """Real subprocess drive of `torrent-tpu feed --once --seen`."""
+        import subprocess
+        import sys as _sys
+
+        async def prep():
+            rng = np.random.default_rng(37)
+            payload = rng.integers(0, 256, size=64 * 1024, dtype=np.uint8).tobytes()
+            server, pump, announce_url = await start_tracker()
+            meta_bytes = build_torrent_bytes(
+                payload, 32768, announce_url.encode(), name=b"cli.bin"
+            )
+            meta = parse_metainfo(meta_bytes)
+            base, shutdown = _serve_routes(
+                {
+                    "/feed.xml": (
+                        '<rss version="2.0"><channel><item><title>cli</title>'
+                        f'<enclosure url="PLACEHOLDER/cli.torrent"/></item>'
+                        "</channel></rss>"
+                    ).encode(),
+                    "/cli.torrent": meta_bytes,
+                }
+            )
+            return server, pump, base, shutdown, meta, payload
+
+        async def go():
+            server, pump, base, shutdown, meta, payload = await prep()
+            # rebuild the feed with the real base URL
+            routes_base = base
+
+            base2, shutdown2 = _serve_routes(
+                {
+                    "/feed.xml": (
+                        '<rss version="2.0"><channel><item><title>cli</title>'
+                        f'<enclosure url="{routes_base}/cli.torrent"/></item>'
+                        "</channel></rss>"
+                    ).encode(),
+                }
+            )
+            seed = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            seed.config.torrent = fast_config()
+            await seed.start()
+            try:
+                (tmp_path / "s2").mkdir()
+                (tmp_path / "s2" / "cli.bin").write_bytes(payload)
+                ts = await seed.add(meta, str(tmp_path / "s2"))
+                assert ts.bitfield.complete
+                (tmp_path / "d2").mkdir()
+                seen_file = tmp_path / "seen.txt"
+                r = await asyncio.to_thread(
+                    subprocess.run,
+                    [
+                        _sys.executable,
+                        "-m",
+                        "torrent_tpu.tools.cli",
+                        "feed",
+                        f"{base2}/feed.xml",
+                        str(tmp_path / "d2"),
+                        "--once",
+                        "--seen",
+                        str(seen_file),
+                    ],
+                    capture_output=True,
+                    text=True,
+                    cwd="/root/repo",
+                    timeout=90,
+                )
+                assert r.returncode == 0, r.stderr
+                assert "added: cli.bin" in r.stdout, r.stdout
+                assert "cli.torrent" in seen_file.read_text()
+            finally:
+                await seed.close()
+                server.close()
+                pump.cancel()
+                shutdown()
+                shutdown2()
+
+        run(go(), timeout=120)
+
+
+class TestDedupAndRetrySemantics:
+    def test_failed_add_is_retried_next_poll(self, tmp_path):
+        """A transiently-failing download URL must not be burned into the
+        seen set (it would be dropped forever, across --seen restarts)."""
+
+        async def go():
+            attempts = []
+            meta_bytes_holder = []
+
+            def torrent_route():
+                attempts.append(1)
+                if len(attempts) == 1:
+                    return b"not a torrent"  # first fetch: garbage (=failure)
+                return meta_bytes_holder[0]
+
+            base, shutdown = _serve_routes(
+                {
+                    "/feed.xml": lambda: (
+                        '<rss version="2.0"><channel><item><title>x</title>'
+                        f'<enclosure url="{base_holder[0]}/flaky.torrent"/></item>'
+                        "</channel></rss>"
+                    ).encode(),
+                    "/flaky.torrent": torrent_route,
+                }
+            )
+            base_holder = [base]
+            rng = np.random.default_rng(44)
+            payload = rng.integers(0, 256, size=16384, dtype=np.uint8).tobytes()
+            meta_bytes_holder.append(
+                build_torrent_bytes(payload, 16384, b"http://127.0.0.1:1/a", name=b"f.bin")
+            )
+
+            c = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            c.config.torrent = fast_config()
+            await c.start()
+            try:
+                (tmp_path / "dl").mkdir()
+                poller = FeedPoller(c, f"{base}/feed.xml", str(tmp_path / "dl"))
+                assert await poller.poll_once() == []  # garbage: add fails
+                assert f"{base}/flaky.torrent" not in poller.seen  # retryable
+                added = await poller.poll_once()  # server healthy now
+                assert len(added) == 1
+            finally:
+                await c.close()
+                shutdown()
+
+        run(go())
+
+    def test_rotated_url_survives_restart_via_seen_hashes(self, tmp_path):
+        """Infohashes persist in the seen set as ih:<hex>, so a fresh
+        process with a rotated entry URL cannot re-add the content."""
+
+        async def go():
+            rng = np.random.default_rng(45)
+            payload = rng.integers(0, 256, size=16384, dtype=np.uint8).tobytes()
+            meta_bytes = build_torrent_bytes(
+                payload, 16384, b"http://127.0.0.1:1/a", name=b"r.bin"
+            )
+            base, shutdown = _serve_routes(
+                {
+                    "/feed.xml": lambda: (
+                        '<rss version="2.0"><channel><item><title>r</title>'
+                        f'<enclosure url="{base_holder[0]}/rot2.torrent"/></item>'
+                        "</channel></rss>"
+                    ).encode(),
+                    "/rot2.torrent": meta_bytes,
+                }
+            )
+            base_holder = [base]
+
+            c = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            c.config.torrent = fast_config()
+            await c.start()
+            try:
+                (tmp_path / "dl2").mkdir()
+                ih = parse_metainfo(meta_bytes).info_hash
+                # "previous run" added the content under a different URL
+                carried = {f"{base}/rot1.torrent", "ih:" + ih.hex()}
+                poller = FeedPoller(
+                    c, f"{base}/feed.xml", str(tmp_path / "dl2"), seen=carried
+                )
+                assert await poller.poll_once() == []  # hash known: no re-add
+                assert ih not in c.torrents
+            finally:
+                await c.close()
+                shutdown()
+
+        run(go())

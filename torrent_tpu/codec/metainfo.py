@@ -123,6 +123,23 @@ class Metainfo:
         return parse_collections(self.raw)
 
 
+def parse_any_metainfo(data: bytes):
+    """``(meta, session_info_hash)`` for a v1 OR pure-v2 .torrent; None
+    when neither format parses. The hash is each format's session
+    identity — SHA-1, or BEP 52's truncated SHA-256 — i.e. what
+    ``Client.add`` keys torrents by. One helper so the fetch-and-identify
+    dance (BEP 39 update-url, BEP 36 feeds, CLI) can't drift apart."""
+    m = parse_metainfo(data)
+    if m is not None:
+        return m, m.info_hash
+    from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+
+    v2 = parse_metainfo_v2(data)
+    if v2 is None:
+        return None
+    return v2, v2.truncated_info_hash
+
+
 def _hint_sources(raw: dict):
     info = raw.get(b"info")
     return ((info if isinstance(info, dict) else {}), raw)

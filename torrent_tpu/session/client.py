@@ -963,18 +963,12 @@ async def fetch_update(metainfo, proxy=None, raw_bytes_out: list | None = None):
     from torrent_tpu.net.tracker import _http_get
 
     raw = await _http_get(url, timeout=30, proxy=proxy, max_bytes=16 << 20)
-    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.codec.metainfo import parse_any_metainfo
 
-    new_meta = parse_metainfo(raw)
-    if new_meta is not None:
-        new_hash = new_meta.info_hash
-    else:
-        from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
-
-        v2 = parse_metainfo_v2(raw)
-        if v2 is None:
-            raise ValueError("update-url did not serve a valid .torrent")
-        new_meta, new_hash = v2, v2.truncated_info_hash
+    parsed = parse_any_metainfo(raw)
+    if parsed is None:
+        raise ValueError("update-url did not serve a valid .torrent")
+    new_meta, new_hash = parsed
     if new_hash == metainfo.info_hash:
         return None
     if raw_bytes_out is not None:

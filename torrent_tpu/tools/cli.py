@@ -177,29 +177,100 @@ def _cmd_info(args) -> int:
     return 0
 
 
+def _cmd_feed(args) -> int:
+    return asyncio.run(_feed_loop(args))
+
+
+async def _feed_loop(args) -> int:
+    """BEP 36 subscription: poll the feed, add new entries, seed what
+    completes — until interrupted (or once with --once)."""
+    from torrent_tpu.session.client import Client, ClientConfig
+    from torrent_tpu.tools.feed import FeedPoller
+
+    config = ClientConfig(port=args.port)
+    if args.proxy:
+        config.proxy = args.proxy
+    try:
+        client = Client(config)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    poller = None
+
+    def save_seen() -> None:
+        if args.seen and poller is not None:
+            with open(args.seen, "w") as f:
+                f.write("\n".join(sorted(poller.seen)) + "\n")
+
+    # everything after construction lives under the finally: an
+    # unreadable --seen file or a failed start must still close the
+    # client (and report cleanly, not as a traceback)
+    try:
+        await client.start()
+        seen: set[str] = set()
+        if args.seen and os.path.exists(args.seen):
+            with open(args.seen) as f:
+                seen = {line.strip() for line in f if line.strip()}
+        poller = FeedPoller(
+            client, args.url, args.dir, interval=args.interval, seen=seen
+        )
+        added = await poller.poll_once()
+        save_seen()
+        for t in added:
+            print(f"added: {t.info.name} ({t.metainfo.info_hash.hex()[:16]}...)")
+        if not added:
+            print("no new entries")
+        if args.once:
+            return 0
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # pragma: no cover - non-unix
+                pass
+        print(f"polling {args.url} every {args.interval:.0f}s (ctrl-c to stop)")
+        while not stop.is_set():
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=args.interval)
+            except asyncio.TimeoutError:
+                pass
+            if stop.is_set():
+                break
+            try:
+                added = await poller.poll_once()
+                save_seen()
+                for t in added:
+                    print(f"added: {t.info.name}")
+            except Exception as e:
+                print(f"poll failed (will retry): {e}", file=sys.stderr)
+        return 0
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await client.close()
+
+
 def _cmd_update(args) -> int:
     """BEP 39 from the command line: fetch the update-url and write the
     successor verbatim (no session needed — just the poll)."""
-    import asyncio
-
-    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.codec.metainfo import Metainfo, parse_any_metainfo
     from torrent_tpu.session.client import fetch_update
 
     with open(args.torrent, "rb") as f:
         data = f.read()
-    meta = parse_metainfo(data)
-    if meta is None:
-        from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
-
-        v2 = parse_metainfo_v2(data)
-        if v2 is None:
-            print("error: not a valid .torrent file", file=sys.stderr)
-            return 1
-        # the session wrapper carries update_url + the truncated-SHA-256
-        # identity fetch_update compares against
+    parsed = parse_any_metainfo(data)
+    if parsed is None:
+        print("error: not a valid .torrent file", file=sys.stderr)
+        return 1
+    meta = parsed[0]
+    if not isinstance(meta, Metainfo):
+        # pure v2: the session wrapper carries update_url + the
+        # truncated-SHA-256 identity fetch_update compares against
         from torrent_tpu.session.v2 import v2_session_meta
 
-        meta = v2_session_meta(v2)
+        meta = v2_session_meta(meta)
     url = getattr(meta, "update_url", None)
     if not url:
         print("no update-url in this torrent (BEP 39 key absent)")
@@ -893,6 +964,22 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hasher", choices=("cpu", "tpu"), default="cpu")
     sp.add_argument("--batch", type=int, default=256)
     sp.set_defaults(fn=_cmd_verify)
+
+    sp = sub.add_parser(
+        "feed", help="BEP 36: subscribe to a torrent RSS/Atom feed"
+    )
+    sp.add_argument("url", help="feed URL (RSS 2.0 or Atom)")
+    sp.add_argument("dir", help="download directory for added torrents")
+    sp.add_argument("--interval", type=float, default=300,
+                    help="poll interval in seconds (default 300)")
+    sp.add_argument("--once", action="store_true",
+                    help="poll once, print what was added, exit")
+    sp.add_argument("--seen",
+                    help="file remembering added entry URLs across runs "
+                         "(one per line; created if missing)")
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--proxy", help="SOCKS5 proxy URL")
+    sp.set_defaults(fn=_cmd_feed)
 
     sp = sub.add_parser(
         "update", help="BEP 39: poll a torrent's update-url for a successor"

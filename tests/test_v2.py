@@ -452,3 +452,44 @@ class TestBatchedReductions:
         # levels: piece grid (lpp=2 -> 1 level) + file layer (padded 4 ->
         # 2 levels) = 3 total across ALL 16 files
         assert len(calls) <= 4, calls
+
+
+class TestFusedMerkleReduce:
+    """The accelerator path fuses every pair level into one dispatch;
+    CI runs on CPU (where merkle_root takes the per-level loop), so the
+    fused program gets its own explicit equivalence check here."""
+
+    def test_fused_matches_hashlib(self):
+        import hashlib
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from torrent_tpu.models.merkle import (
+            _merkle_reduce_fused,
+            digests_to_words32,
+            words32_to_digests,
+        )
+
+        rng = np.random.default_rng(9)
+        for b, levels in ((1, 1), (3, 2), (5, 4)):
+            l = 1 << levels
+            leaf_digests = [
+                [rng.bytes(32) for _ in range(l)] for _ in range(b)
+            ]
+            words = np.stack(
+                [digests_to_words32(d) for d in leaf_digests]
+            )  # [b, l, 8]
+            got = words32_to_digests(
+                np.asarray(_merkle_reduce_fused(jnp.asarray(words), levels))
+            )
+            want = []
+            for d in leaf_digests:
+                level = list(d)
+                while len(level) > 1:
+                    level = [
+                        hashlib.sha256(level[i] + level[i + 1]).digest()
+                        for i in range(0, len(level), 2)
+                    ]
+                want.append(level[0])
+            assert got == want, (b, levels)

@@ -46,6 +46,22 @@ def sha256_pairs(words: jax.Array) -> jax.Array:
     return jnp.stack(state, axis=1)
 
 
+@functools.partial(jax.jit, static_argnames=("levels",))
+def _merkle_reduce_fused(words: jax.Array, levels: int) -> jax.Array:
+    """``u32[B, 2**levels, 8]`` → roots ``u32[B, 8]``: EVERY pair level
+    in one dispatch. The per-level host wrapper (``merkle_level``) paid
+    a device round-trip per level — log2(L) dispatches and transfers per
+    reduction, which on the relay-tunneled chip is log2(L) × ~55 ms of
+    fixed cost. Here intermediates never leave the device (round-2
+    verdict #3's "fuse levels" option)."""
+    for _ in range(levels):
+        b, m, _ = words.shape
+        pairs = words.reshape(b * (m // 2), 16)
+        # nested jit traces inline: still ONE dispatch for all levels
+        words = sha256_pairs(pairs).reshape(b, m // 2, 8)
+    return words[:, 0, :]
+
+
 def merkle_level(words: np.ndarray) -> np.ndarray:
     """Host wrapper: ``u32[..., M, 8]`` → ``u32[..., M/2, 8]``.
 
@@ -61,13 +77,27 @@ def merkle_level(words: np.ndarray) -> np.ndarray:
 
 
 def merkle_root(words: np.ndarray) -> np.ndarray:
-    """``u32[..., L, 8]`` (L a power of two) → root ``u32[..., 8]``."""
-    *_, l, _ = words.shape
+    """``u32[..., L, 8]`` (L a power of two) → root ``u32[..., 8]``.
+
+    Backend-keyed: on an accelerator all levels fuse into ONE dispatch
+    (each per-level host hop costs ~55 ms of fixed relay/dispatch
+    overhead — log2(L) of them per reduction); on the CPU backend the
+    per-level loop wins instead, because dispatch is free there and the
+    fused program's levels×-larger XLA graph makes compile time dominate
+    real work (measured 2× on the v2 suite)."""
+    *lead, l, _ = words.shape
     if l & (l - 1):
         raise ValueError("leaf count must be a power of two")
-    while words.shape[-2] > 1:
-        words = merkle_level(words)
-    return words[..., 0, :]
+    if l == 1:
+        return np.asarray(words)[..., 0, :]
+    if jax.default_backend() == "cpu":
+        out = words
+        while out.shape[-2] > 1:
+            out = merkle_level(out)
+        return out[..., 0, :]
+    flat = np.ascontiguousarray(words).reshape(-1, l, 8)
+    out = np.asarray(_merkle_reduce_fused(jnp.asarray(flat), l.bit_length() - 1))
+    return out.reshape(*lead, 8)
 
 
 @functools.lru_cache(maxsize=None)

@@ -342,3 +342,101 @@ class TestDedupAndRetrySemantics:
                 shutdown()
 
         run(go())
+
+
+class TestPublisherLifecycle:
+    def test_feed_subscribe_then_update_with_reuse(self, tmp_path):
+        """The whole publisher story in one flow: a subscriber picks v1
+        up from the feed and downloads it from the swarm; the publisher
+        later ships v2 (one file changed) named by v1's update-url; the
+        subscriber applies the update in place and only the changed file
+        is wanted again."""
+
+        async def go():
+            rng = np.random.default_rng(77)
+            keep = rng.integers(0, 256, size=64 * 1024, dtype=np.uint8).tobytes()
+            old_b = rng.integers(0, 256, size=32 * 1024, dtype=np.uint8).tobytes()
+            new_b = rng.integers(0, 256, size=32 * 1024, dtype=np.uint8).tobytes()
+
+            server, pump, announce_url = await start_tracker()
+            pub_v1 = tmp_path / "pub1" / "ds"
+            pub_v1.mkdir(parents=True)
+            (pub_v1 / "keep.bin").write_bytes(keep)
+            (pub_v1 / "change.bin").write_bytes(old_b)
+
+            base_holder = []
+            v2_bytes_holder = []
+
+            routes = {}
+            base, shutdown = _serve_routes(routes)
+            base_holder.append(base)
+
+            from torrent_tpu.tools.make_torrent import make_torrent as mk
+            from torrent_tpu.codec.bencode import bdecode, bencode
+
+            raw_v1 = mk(str(pub_v1), announce_url, piece_length=16384)
+            top = bdecode(raw_v1)
+            top[b"update-url"] = f"{base}/ds.torrent".encode()
+            raw_v1 = bencode(top)
+            routes["/feed.xml"] = (
+                '<rss version="2.0"><channel><item><title>ds</title>'
+                f'<enclosure url="{base}/ds.torrent"/></item></channel></rss>'
+            ).encode()
+            routes["/ds.torrent"] = lambda: (
+                v2_bytes_holder[0] if v2_bytes_holder else raw_v1
+            )
+
+            pub = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            sub = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            pub.config.torrent = fast_config()
+            sub.config.torrent = fast_config()
+            await pub.start()
+            await sub.start()
+            try:
+                tp = await pub.add(parse_metainfo(raw_v1), str(tmp_path / "pub1"))
+                assert tp.bitfield.complete
+
+                (tmp_path / "subdl").mkdir()
+                poller = FeedPoller(sub, f"{base}/feed.xml", str(tmp_path / "subdl"))
+                added = await poller.poll_once()
+                assert len(added) == 1
+                t1 = added[0]
+                await asyncio.wait_for(t1.on_complete.wait(), 60)
+
+                # publisher ships v2: change.bin differs, update-url serves it
+                pub_v2 = tmp_path / "pub2" / "ds"
+                pub_v2.mkdir(parents=True)
+                (pub_v2 / "keep.bin").write_bytes(keep)
+                (pub_v2 / "change.bin").write_bytes(new_b)
+                v2_bytes_holder.append(
+                    mk(str(pub_v2), announce_url, piece_length=16384)
+                )
+
+                t2 = await sub.apply_update(t1)
+                assert t2 is not None
+                # keep.bin (pieces 2-5 after change.bin's 0-1) adopted in
+                # place; change.bin re-wanted
+                assert not t2.bitfield.complete
+                assert not t2.bitfield.has(0)
+                assert all(t2.bitfield.has(i) for i in (2, 3, 4, 5))
+
+                # publisher seeds v2 too: subscriber converges
+                tp2 = await pub.add(
+                    parse_metainfo(v2_bytes_holder[0]), str(tmp_path / "pub2")
+                )
+                assert tp2.bitfield.complete
+                await asyncio.wait_for(t2.on_complete.wait(), 60)
+                assert (
+                    tmp_path / "subdl" / "ds" / "change.bin"
+                ).read_bytes() == new_b
+                assert (
+                    tmp_path / "subdl" / "ds" / "keep.bin"
+                ).read_bytes() == keep
+            finally:
+                await pub.close()
+                await sub.close()
+                server.close()
+                pump.cancel()
+                shutdown()
+
+        run(go(), timeout=120)

@@ -1,0 +1,57 @@
+#!/bin/bash
+# Post-ladder chain: once r3_ladder3.sh exits (complete or exhausted),
+# run the SHA-256 leaf-kernel sweep and ONE tuned v2 rung. Same rules:
+# probe abandon-don't-kill, never overwrite a banked record, serialized.
+cd /root/repo
+
+banked() {
+  [ -s "$1" ] && python - "$1" <<'PY'
+import json, sys
+try:
+    rec = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if rec.get("value") is not None else 1)
+PY
+}
+
+{
+echo "=== r3 after-ladder start $(date -u)"
+while pgrep -f "r3_ladder3.sh" >/dev/null 2>&1; do sleep 60; done
+echo "ladder3 exited $(date -u)"
+for attempt in $(seq 1 24); do
+  if bash .bench/probe_once.sh .bench/probe_r3d.log 300; then
+    echo "after-ladder: tunnel alive attempt=$attempt $(date -u)"
+    timeout_free_run() { env "$@"; }  # no timeouts around TPU children
+    python -m torrent_tpu.tools.tune_sha256 --iters 6 \
+        > .bench/tune_sha256.jsonl 2> .bench/tune_sha256.err
+    best=$(tail -1 .bench/tune_sha256.jsonl)
+    echo "tune_sha256 done $(date -u): $best"
+    ts=$(python - <<'PY'
+import json, sys
+try:
+    rec = json.loads(open(".bench/tune_sha256.jsonl").read().strip().splitlines()[-1])
+    b = rec["best"]
+    print(f"{b['tile_sub']} {b['unroll']}")
+except Exception:
+    print("")
+PY
+)
+    if [ -n "$ts" ]; then
+      set -- $ts
+      if ! banked .bench/cfgv2d.json; then
+        env TORRENT_TPU_SHA256_TILE_SUB="$1" TORRENT_TPU_SHA256_UNROLL="$2" \
+            BENCH_CONFIG=v2 BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=3600 \
+            python bench.py > .bench/cfgv2d.json.tmp 2> .bench/cfgv2d.err
+        if banked .bench/cfgv2d.json.tmp; then mv .bench/cfgv2d.json.tmp .bench/cfgv2d.json; \
+        else mv .bench/cfgv2d.json.tmp .bench/cfgv2d.json; fi
+        echo "cfgv2d done $(date -u): $(cat .bench/cfgv2d.json)"
+      fi
+    fi
+    exit 0
+  fi
+  echo "after-ladder attempt=$attempt probe failed $(date -u)"
+  sleep 600
+done
+echo "=== r3 after-ladder exhausted $(date -u)"
+} >> .bench/auto_chain_r3.log 2>&1

@@ -278,6 +278,7 @@ class Torrent:
         self._serve_pending: dict[int, asyncio.Future] = {}
         self._rarity_dirty = True
         self._inflight_count: Counter = Counter()
+        self._piece_inflight: Counter = Counter()  # per-piece mirror
 
         # Serialized info dict for BEP 9 metadata serving — byte-exact
         # re-encode of the decoded dict (decode preserves key order, so
@@ -1257,10 +1258,24 @@ class Torrent:
             peer.ss_unconfirmed.clear()
         self._release_inflight(peer)
 
+    def _inflight_add(self, blk) -> None:
+        if self._inflight_count[blk] == 0:
+            # the mirror counts DISTINCT requested blocks per piece (not
+            # request multiplicity): endgame duplication must not inflate
+            # it, or the picker's saturation skip would starve a piece
+            # with one duplicated and one unrequested block
+            self._piece_inflight[blk[0]] += 1
+        self._inflight_count[blk] += 1
+
+    def _inflight_release(self, blk) -> None:
+        if self._inflight_count[blk] > 0:
+            self._inflight_count[blk] -= 1
+            if self._inflight_count[blk] == 0:
+                self._piece_inflight[blk[0]] -= 1
+
     def _release_inflight(self, peer: PeerConnection) -> None:
         for blk in peer.inflight:
-            if self._inflight_count[blk] > 0:
-                self._inflight_count[blk] -= 1
+            self._inflight_release(blk)
         peer.inflight.clear()
         peer.inflight_choked.clear()
 
@@ -1425,8 +1440,7 @@ class Torrent:
                 blk = (index, begin, length)
                 if blk in peer.inflight:
                     peer.inflight.discard(blk)
-                    if self._inflight_count[blk] > 0:
-                        self._inflight_count[blk] -= 1
+                    self._inflight_release(blk)
                     # Rejecting a request that was *issued under the grant*
                     # (i.e. while choked) withdraws it — otherwise the
                     # choked pipeline re-requests it forever. Rejects of
@@ -1682,8 +1696,7 @@ class Torrent:
                 # sends no rejects, so held blocks would stall until the
                 # snub sweep otherwise.
                 for blk in [b for b in peer.inflight if b[0] == idx]:
-                    if self._inflight_count[blk] > 0:
-                        self._inflight_count[blk] -= 1
+                    self._inflight_release(blk)
                     peer.inflight.discard(blk)
                     peer.inflight_choked.discard(blk)
                 await self._update_interest(peer)
@@ -1983,12 +1996,34 @@ class Torrent:
         budget = self.config.pipeline_depth - len(peer.inflight)
         if budget <= 0:
             return
+        # direct bool-array views for the scan loops: Bitfield.has() is a
+        # bounds-checked method call, and a deep rarity scan makes tens of
+        # millions of them per fanout transfer (measured ~20% of seed-side
+        # CPU). The picking phase below is await-free, so the snapshots
+        # cannot go stale mid-scan.
+        have_arr = self.bitfield.as_numpy()
+        peer_arr = peer.bitfield.as_numpy()
         wanted: list[tuple[int, int, int]] = []
 
         def pickable(index: int) -> bool:
             return not peer.peer_choking or index in peer.allowed_fast_in
 
         def take_from(index: int) -> bool:
+            # Saturated-piece fast path, exact for partial-less pieces:
+            # the mirror counts distinct requested blocks, and a fresh
+            # piece has no received-but-still-counted blocks, so mirror
+            # == n_blocks means literally every block is requested. Under
+            # fanout MOST deep-scanned pieces are in this state. Pieces
+            # with a partial keep the full block iteration — their
+            # received set can overlap stale outstanding requests, and a
+            # count-based skip there can starve the one unrequested block
+            # until a snub timeout.
+            if index not in self._partials:
+                n_blocks = (
+                    piece_length(self.info, index) + BLOCK_SIZE - 1
+                ) // BLOCK_SIZE
+                if self._piece_inflight[index] >= n_blocks:
+                    return False
             for blk in self._missing_blocks(index):
                 if self._inflight_count[blk] > 0 or blk in peer.inflight:
                     continue
@@ -2005,8 +2040,8 @@ class Torrent:
             if partial.webseed:
                 continue
             if (
-                peer.bitfield.has(index)
-                and not self.bitfield.has(index)
+                peer_arr[index]
+                and not have_arr[index]
                 and self._piece_priority[index] > 0  # deselected partials
                 # (e.g. resumed then deselected) must not outrank wanted
                 and pickable(index)
@@ -2021,10 +2056,10 @@ class Torrent:
             for first, n in sorted(self._stream_positions.values()):
                 for index in range(first, min(first + n, self.info.num_pieces)):
                     if (
-                        self.bitfield.has(index)
+                        have_arr[index]
                         or index in self._partials
                         or self._piece_priority[index] <= 0
-                        or not peer.bitfield.has(index)
+                        or not peer_arr[index]
                         or not pickable(index)
                     ):
                         continue
@@ -2037,9 +2072,9 @@ class Torrent:
         if len(wanted) < budget:
             for index in peer.suggested:
                 if (
-                    self.bitfield.has(index)
+                    have_arr[index]
                     or index in self._partials
-                    or not peer.bitfield.has(index)
+                    or not peer_arr[index]
                     or not pickable(index)
                 ):
                     continue
@@ -2050,12 +2085,12 @@ class Torrent:
                 self._rebuild_rarity()
             done_prefix = 0
             for index in self._rarity_order:
-                if self.bitfield.has(index):
+                if have_arr[index]:
                     done_prefix += 1
                     continue
                 if (
                     index in self._partials
-                    or not peer.bitfield.has(index)
+                    or not peer_arr[index]
                     or not pickable(index)
                 ):
                     continue
@@ -2079,7 +2114,7 @@ class Torrent:
             remaining = [
                 blk
                 for i in self.bitfield.missing()
-                if peer.bitfield.has(i)
+                if peer_arr[i]
                 and pickable(i)
                 and self._piece_priority[i] > 0
                 for blk in self._missing_blocks(i)
@@ -2102,7 +2137,7 @@ class Torrent:
             peer.inflight.add(blk)
             if peer.peer_choking:
                 peer.inflight_choked.add(blk)  # issued under an allowed-fast grant
-            self._inflight_count[blk] += 1
+            self._inflight_add(blk)
             peer.writer.write(proto.encode_message(proto.Request(*blk)))
         await peer.writer.drain()
 
@@ -2119,8 +2154,7 @@ class Torrent:
         if blk in peer.inflight:
             peer.inflight.discard(blk)
             peer.inflight_choked.discard(blk)
-            if self._inflight_count[blk] > 0:
-                self._inflight_count[blk] -= 1
+            self._inflight_release(blk)
         peer.bytes_down += len(block)
         peer.last_block_rx = time.monotonic()
         peer.snubbed_until = 0.0  # delivering redeems
@@ -2184,8 +2218,7 @@ class Torrent:
                 continue
             p.inflight.discard(blk)
             p.inflight_choked.discard(blk)
-            if self._inflight_count[blk] > 0:
-                self._inflight_count[blk] -= 1
+            self._inflight_release(blk)
             try:
                 await proto.send_message(p.writer, proto.Cancel(*blk))
             except (ConnectionError, OSError):
@@ -2684,7 +2717,10 @@ class Torrent:
         """
         if self._rarity_dirty:
             self._rebuild_rarity()
-        busy = {blk[0] for blk, c in self._inflight_count.items() if c > 0}
+        # per-piece mirror answers this in O(pieces-with-requests); the
+        # old per-block Counter walk grew to every block key ever
+        # requested over a download (entries never prune at zero)
+        busy = {i for i, c in self._piece_inflight.items() if c > 0}
         picked = []
 
         def eligible(index: int) -> bool:

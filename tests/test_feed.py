@@ -440,3 +440,34 @@ class TestPublisherLifecycle:
                 shutdown()
 
         run(go(), timeout=120)
+
+
+class TestNetbenchTool:
+    def test_netbench_single_smoke(self):
+        """Tiny end-to-end drive of the reproducible swarm bench tool."""
+        import subprocess
+        import sys as _sys
+        import json as _json
+
+        r = subprocess.run(
+            [
+                _sys.executable,
+                "-m",
+                "torrent_tpu.tools.netbench",
+                "--mode",
+                "single",
+                "--mb",
+                "8",
+                "--piece-kb",
+                "64",
+                "--json",
+            ],
+            capture_output=True,
+            text=True,
+            cwd="/root/repo",
+            timeout=120,
+        )
+        assert r.returncode == 0, r.stderr[-1500:]
+        rec = _json.loads(r.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "swarm_tcp_1leech_mib_s"
+        assert rec["value"] > 0

@@ -1,0 +1,118 @@
+#!/bin/bash
+# Round-4 ladder — strategy change per VERDICT r3 next-#1: the FIRST rung
+# is a micro-rung (~200 MiB total staging, CPU baseline trivial, h2d
+# probe shrunk) that banks a non-null platform:tpu record inside a 2-3
+# minute healthy window; only then climb. Rules unchanged: never kill a
+# TPU-touching process (probes are abandoned), never overwrite a banked
+# non-null record, strictly serialized. Every successful rung ALSO
+# auto-banks to .bench/live/<metric>.json (bench.py does this itself),
+# which arms the driver-visible replay path for BENCH_r04.json.
+cd /root/repo
+CACHE=/root/repo/.bench/cpu_baseline.json
+
+banked() {
+  [ -s "$1" ] && python - "$1" <<'PY'
+import json, sys
+try:
+    rec = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if rec.get("value") is not None else 1)
+PY
+}
+
+rung() {
+  local out="$1"; shift
+  if banked "$out"; then
+    echo "skip $out (already banked)"
+    return 0
+  fi
+  env BENCH_NO_REPLAY=1 BENCH_BASELINE_CACHE="$CACHE" "$@" \
+      python bench.py > "$out.tmp" 2> "${out%.json}.err"
+  if banked "$out.tmp"; then
+    mv "$out.tmp" "$out"
+  else
+    if [ -s "$out" ]; then rm -f "$out.tmp"; else mv "$out.tmp" "$out"; fi
+  fi
+  echo "$out attempt done $(date -u): $(cat "$out")"
+}
+
+{
+echo "=== r4 ladder start $(date -u)"
+for attempt in $(seq 1 200); do
+  if bash .bench/probe_once.sh .bench/probe_r4.log 300; then
+    echo "r4 ladder: tunnel alive attempt=$attempt $(date -u)"
+    # rung 0 — micro: ONE 128 MiB staged batch, 24 salted dispatches,
+    # e2e capped 32 MiB, h2d probe 16 MiB. Fits the shortest window seen.
+    rung .bench/r4_micro.json BENCH_CONFIG=headline BENCH_TOTAL_MB=128 \
+         BENCH_BATCH=512 BENCH_NBATCH=1 BENCH_DISPATCHES=24 \
+         BENCH_E2E_MB=32 BENCH_H2D_MB=16 BENCH_TPU_WAIT=1500
+    if ! banked .bench/r4_micro.json; then
+      echo "r4 ladder: micro-rung banked nothing — back to probing"
+      sleep 300
+      continue
+    fi
+    # rung 1 — small: one 1.07 GiB batch at the full 4096 dispatch width
+    rung .bench/r4_small.json BENCH_CONFIG=headline BENCH_TOTAL_MB=512 \
+         BENCH_BATCH=4096 BENCH_NBATCH=1 BENCH_DISPATCHES=8 \
+         BENCH_E2E_MB=64 BENCH_H2D_MB=32 BENCH_TPU_WAIT=2700
+    # rung 2 — flagship re-bank under the median-of-N contract (verdict
+    # next-#4): 2 batches x 8192, 12 salted dispatches per run
+    rung .bench/headline_final.json BENCH_CONFIG=headline \
+         BENCH_TOTAL_MB=2048 BENCH_NBATCH=2 BENCH_DISPATCHES=12 \
+         BENCH_TPU_WAIT=3600
+    # rung 3 — v2 proof-of-life at small leaf batches (640 MiB staged)
+    rung .bench/cfgv2_small.json BENCH_CONFIG=v2 BENCH_TOTAL_MB=512 \
+         TORRENT_TPU_LEAF_BATCH=8192 BENCH_V2_NRES=5 BENCH_TPU_WAIT=2700
+    # rung 4 — v2 at full leaf width (verdict next-#3)
+    rung .bench/cfgv2c.json BENCH_CONFIG=v2 BENCH_TOTAL_MB=2048 \
+         BENCH_TPU_WAIT=3600
+    # rung 5 — config 4: 100 GiB / 1 MiB pieces, baseline from cache,
+    # e2e leg capped per the relay-RAM hazard (verdict next-#2)
+    rung .bench/cfg4.json BENCH_CONFIG=headline BENCH_PIECE_KB=1024 \
+         BENCH_TOTAL_MB=102400 BENCH_BATCH=4096 BENCH_NBATCH=2 \
+         BENCH_DISPATCHES=6 BENCH_E2E_MB=2048 BENCH_TPU_WAIT=7200
+    if banked .bench/cfg4.json && banked .bench/cfgv2c.json \
+       && banked .bench/headline_final.json; then
+      echo "=== r4 ladder complete $(date -u)"
+      break
+    fi
+    echo "r4 ladder: incomplete — back to probing"
+  else
+    echo "r4 ladder attempt=$attempt probe failed $(date -u)"
+  fi
+  sleep 300
+done
+# after-phase: SHA-256 leaf-kernel sweep + one tuned v2 rung (next-#3)
+for attempt in $(seq 1 48); do
+  if banked .bench/cfgv2d.json; then break; fi
+  if bash .bench/probe_once.sh .bench/probe_r4b.log 300; then
+    echo "r4 after: tunnel alive attempt=$attempt $(date -u)"
+    if [ ! -s .bench/tune_sha256.jsonl ] || ! grep -q best .bench/tune_sha256.jsonl; then
+      python -m torrent_tpu.tools.tune_sha256 --iters 6 \
+          > .bench/tune_sha256.jsonl 2> .bench/tune_sha256.err
+      echo "tune_sha256 done $(date -u): $(tail -1 .bench/tune_sha256.jsonl)"
+    fi
+    ts=$(python - <<'PY'
+import json
+try:
+    rec = json.loads(open(".bench/tune_sha256.jsonl").read().strip().splitlines()[-1])
+    b = rec["best"]
+    print(f"{b['tile_sub']} {b['unroll']}")
+except Exception:
+    print("")
+PY
+)
+    if [ -n "$ts" ]; then
+      set -- $ts
+      rung .bench/cfgv2d.json TORRENT_TPU_SHA256_TILE_SUB="$1" \
+           TORRENT_TPU_SHA256_UNROLL="$2" BENCH_CONFIG=v2 \
+           BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=3600
+    fi
+  else
+    echo "r4 after attempt=$attempt probe failed $(date -u)"
+  fi
+  sleep 300
+done
+echo "=== r4 chain done $(date -u)"
+} >> .bench/auto_chain_r4.log 2>&1

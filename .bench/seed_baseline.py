@@ -1,0 +1,41 @@
+"""Seed the CPU-baseline cache OUTSIDE any device-grant window.
+
+Round-3 verdict weak #2: the ladder re-hashed the CPU baseline inside
+scarce tunnel windows. The hashlib sha1 rate at a piece length is a host
+property; measure it once here, full-scale (100 GiB for the 1 MiB-piece
+config 4 population — the real thing, not an extrapolation), and let
+bench.py load it via BENCH_BASELINE_CACHE.
+"""
+
+import hashlib
+import os
+import sys
+import time
+
+os.environ.setdefault(
+    "BENCH_BASELINE_CACHE", "/root/repo/.bench/cpu_baseline.json"
+)
+sys.path.insert(0, "/root/repo")
+import bench
+
+GEOMS = [
+    (256 * 1024, 2048),  # headline piece length, 2 GiB population
+    (1024 * 1024, 102400),  # config 4: full 100 GiB population
+]
+
+for plen, total_mb in GEOMS:
+    n_pieces = total_mb * (1 << 20) // plen
+    vp = bench._VirtualPayload(n_pieces, plen)
+    hash_secs = 0.0
+    for i in range(n_pieces):
+        data = vp.piece(i)
+        t0 = time.perf_counter()
+        hashlib.sha1(data).digest()
+        hash_secs += time.perf_counter() - t0
+    pps = n_pieces / hash_secs
+    bench._baseline_cache_save(plen, pps, total_mb)
+    print(
+        f"seeded sha1:{plen}: {pps:.1f} p/s "
+        f"({pps * plen / 2**30:.2f} GiB/s) over {total_mb} MB",
+        flush=True,
+    )

@@ -21,11 +21,37 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import contextlib
 import pathlib
+import signal
 
 import pytest
 
 REFERENCE_FIXTURES = pathlib.Path("/root/reference/test_data")
+
+
+@contextlib.contextmanager
+def hard_deadline(seconds: int):
+    """SIGALRM wall-clock bound for soak-style tests.
+
+    ``asyncio.wait_for`` can only fire while the event loop is running; a
+    SYNC-blocked loop (a hung pread, a native call that never returns)
+    sails past it and hangs CI forever. pytest-timeout is not installed
+    in this image, so this is the real guard: the alarm interrupts the
+    main thread wherever it is and raises. Main-thread only (a POSIX
+    signal constraint), which is where pytest runs tests.
+    """
+
+    def on_alarm(signum, frame):
+        raise TimeoutError(f"hard deadline of {seconds}s exceeded")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture

@@ -94,6 +94,33 @@ class TestCli:
         assert rc == 0
         assert "pieces valid (v2)" in capsys.readouterr().out
 
+    def test_make_v2_with_root_hints_stays_canonical(self, tmp_path, capsys):
+        """BEP 38/39 keys are appended to the decoded root dict AFTER the
+        builder sorted it; the emitted bencode must still have sorted
+        top-level keys or strict decoders reject the file (advisor r3)."""
+        rng = np.random.default_rng(34)
+        payload = tmp_path / "c.bin"
+        payload.write_bytes(
+            rng.integers(0, 256, size=70_000, dtype=np.uint8).tobytes()
+        )
+        out = str(tmp_path / "c.torrent")
+        rc = main(
+            ["make", str(payload), "http://127.0.0.1:1/announce", "-o", out,
+             "--piece-length", "16384", "--v2",
+             "--collection", "ds", "--update-url", "http://u/x"]
+        )
+        assert rc == 0
+        capsys.readouterr()
+        data = (tmp_path / "c.torrent").read_bytes()
+
+        from torrent_tpu.codec.bencode import bdecode, bencode
+
+        top = bdecode(data)
+        assert b"collections" in top and b"update-url" in top
+        assert list(top) == sorted(top)
+        # fully canonical: re-encoding with sorted keys is byte-identical
+        assert data == bencode(top)
+
     def test_make_hybrid_roundtrip(self, payload_dir, tmp_path, capsys):
         """--hybrid authors one blob both parsers read; verify routes via
         the v2 path (pad files never exist on disk)."""

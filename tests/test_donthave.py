@@ -8,6 +8,7 @@ re-downloads it instead of refusing requests forever.
 """
 
 import asyncio
+import errno
 
 import pytest
 
@@ -166,6 +167,94 @@ class TestPieceLossSelfHealing:
                 isinstance(f, proto.Extended)
                 for f in _messages(bytes(legacy.writer.data))
             )
+
+        run(go())
+
+    def test_transient_serve_error_retries_without_piece_loss(self):
+        """fd exhaustion under fanout (EMFILE) is not piece loss: the
+        serve path retries once and the piece survives (advisor r3)."""
+
+        async def go():
+            t, m, _ = make_torrent_with_store(None)
+            await t.recheck()
+            t.state = TorrentState.SEEDING
+            t.on_complete.set()
+            peer = make_peer(m.info.num_pieces)
+            peer.am_choking = False
+            peer.fast = True
+            t.peers[peer.peer_id] = peer
+
+            real = t.storage.read_piece
+            calls = []
+
+            def flaky(index):
+                calls.append(index)
+                if len(calls) == 1:
+                    try:
+                        raise OSError(errno.EMFILE, "too many open files")
+                    except OSError as e:
+                        raise StorageError("read failed") from e
+                return real(index)
+
+            t.storage.read_piece = flaky
+            await t._serve_request(peer, 1, 0, 16384)
+
+            assert calls == [1, 1]  # exactly one retry
+            assert t.bitfield.has(1)  # NOT retracted
+            assert t.state == TorrentState.SEEDING
+            sent = _messages(bytes(peer.writer.data))
+            assert any(isinstance(f, proto.Piece) for f in sent), sent
+
+        run(go())
+
+    def test_persistent_error_still_self_heals_after_one_retry(self):
+        async def go():
+            t, m, _ = make_torrent_with_store(None)
+            await t.recheck()
+            t.state = TorrentState.SEEDING
+            peer = make_peer(m.info.num_pieces)
+            peer.am_choking = False
+            peer.fast = True
+            t.peers[peer.peer_id] = peer
+            calls = []
+
+            def always_bad(index):
+                calls.append(index)
+                try:
+                    raise OSError(errno.EIO, "i/o error")
+                except OSError as e:
+                    raise StorageError("read failed") from e
+
+            t.storage.read_piece = always_bad
+            await t._serve_request(peer, 1, 0, 16384)
+            assert calls == [1, 1]  # retried, then gave up
+            assert not t.bitfield.has(1)
+            assert t.state == TorrentState.DOWNLOADING
+
+        run(go())
+
+    def test_missing_file_is_permanent_no_retry(self):
+        async def go():
+            t, m, _ = make_torrent_with_store(None)
+            await t.recheck()
+            t.state = TorrentState.SEEDING
+            peer = make_peer(m.info.num_pieces)
+            peer.am_choking = False
+            peer.fast = True
+            t.peers[peer.peer_id] = peer
+            calls = []
+
+            def gone(index):
+                calls.append(index)
+                try:
+                    raise OSError(errno.ENOENT, "no such file")
+                except OSError as e:
+                    raise StorageError("no such file") from e
+
+            t.storage.read_piece = gone
+            await t._serve_request(peer, 1, 0, 16384)
+            assert calls == [1]  # structural: no retry
+            assert not t.bitfield.has(1)
 
         run(go())
 

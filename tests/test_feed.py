@@ -6,6 +6,7 @@ hostile (DOCTYPE refused, non-http(s)/magnet URLs dropped).
 """
 
 import asyncio
+import os
 import threading
 
 import numpy as np
@@ -244,6 +245,8 @@ class TestLivePolling:
                 assert r.returncode == 0, r.stderr
                 assert "added: cli.bin" in r.stdout, r.stdout
                 assert "cli.torrent" in seen_file.read_text()
+                # atomic save: the temp file was replaced, not left behind
+                assert not os.path.exists(str(seen_file) + ".tmp")
             finally:
                 await seed.close()
                 server.close()

@@ -26,6 +26,7 @@ import time
 import numpy as np
 import pytest
 
+from tests.conftest import hard_deadline
 from tests.test_session import run
 from torrent_tpu.codec.bencode import bencode
 from torrent_tpu.codec.metainfo import parse_metainfo
@@ -40,7 +41,6 @@ PLEN = 4096  # one 4 KiB block per piece: piece COUNT is the stressor
 FLEN = PIECES_PER_FILE * PLEN  # 2 MiB per file, piece-aligned
 
 
-@pytest.mark.timeout(150)
 def test_soak_10k_pieces_20_peers(tmp_path):
     async def go():
         payload = np.random.default_rng(123).integers(
@@ -138,4 +138,20 @@ def test_soak_10k_pieces_20_peers(tmp_path):
                 await c.close()
             server.close()
 
-    run(go(), timeout=145)
+    # 150 s wall-clock bound that catches even a sync-blocked event loop
+    # (the old pytest.mark.timeout was inert: no timeout plugin in this
+    # image, so a hung soak would hang CI indefinitely — r3 verdict #6);
+    # the inner wait_for(145) still gives async stalls a clean report.
+    with hard_deadline(150):
+        run(go(), timeout=145)
+
+
+def test_hard_deadline_catches_sync_hang():
+    """The guard itself: a deliberately sync-hung body fails fast instead
+    of hanging forever (with a short alarm — same mechanism, scaled)."""
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        with hard_deadline(1):
+            while True:
+                time.sleep(0.05)  # sync-blocked: wait_for could never fire
+    assert time.monotonic() - t0 < 10

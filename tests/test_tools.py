@@ -19,6 +19,34 @@ def run(coro, timeout=60):
     return asyncio.run(asyncio.wait_for(coro, timeout))
 
 
+def test_netbench_runs_from_any_cwd(tmp_path):
+    """netbench resolves its test-harness imports relative to its own
+    file, so the documented `python -m torrent_tpu.tools.netbench` works
+    from any working directory (advisor r3)."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import asyncio, json; "
+            "from torrent_tpu.tools.netbench import _swarm; "
+            "print(json.dumps(asyncio.run(_swarm(65536, 16384, 1, False))))",
+        ],
+        cwd=str(tmp_path),
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "PYTHONPATH": repo},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec
+
+
 class TestPieceLengthHeuristic:
     def test_bounds_and_target(self):
         # power of 2, 32 KiB ≤ len ≤ 1 MiB, ~size/1000

@@ -7,6 +7,7 @@ BEP 38 adoption path with the predecessor as donor.
 """
 
 import asyncio
+import os
 import threading
 
 import numpy as np
@@ -181,6 +182,95 @@ class TestApplyUpdate:
             finally:
                 await c.close()
                 shutdown()
+
+        run(go())
+
+
+class TestUpdateLifecycleHygiene:
+    """Advisor r3: a failed apply_update must restore the predecessor's
+    LSD announcement; a successful one must drop its stale .resume file."""
+
+    class _FakeLsd:
+        def __init__(self):
+            self.registered: list[bytes] = []
+            self.unregistered: list[bytes] = []
+
+        def register(self, ih):
+            self.registered.append(ih)
+
+        def unregister(self, ih):
+            self.unregistered.append(ih)
+
+        def close(self):
+            pass
+
+    def _seeded_client_and_torrent(self, tmp_path):
+        async def build():
+            rng = np.random.default_rng(41)
+            payload = rng.integers(0, 256, size=48 * 1024, dtype=np.uint8).tobytes()
+            src = tmp_path / "ds"
+            src.mkdir()
+            (src / "a.bin").write_bytes(payload)
+            meta = parse_metainfo(
+                make_torrent(str(src), ANNOUNCE, piece_length=16384)
+            )
+            c = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            c.config.torrent = fast_config()
+            await c.start()
+            t = await c.add(meta, str(tmp_path))
+            assert t.bitfield.complete
+            return c, t, meta
+
+        return build
+
+    def test_failed_update_restores_lsd_registration(self, tmp_path):
+        async def go():
+            c, t1, meta_v1 = await self._seeded_client_and_torrent(tmp_path)()
+            # any successor works — the add is forced to fail anyway
+            data_v2 = make_torrent(
+                str(tmp_path / "ds"), ANNOUNCE, piece_length=32768
+            )
+            meta_v2 = parse_metainfo(data_v2)
+            fake = self._FakeLsd()
+            c.lsd = fake
+            real_add = c.add
+
+            async def failing_add(*a, **k):
+                raise RuntimeError("simulated add failure")
+
+            c.add = failing_add
+            try:
+                with pytest.raises(RuntimeError):
+                    await c.apply_update(t1, meta_v2)
+            finally:
+                c.add = real_add
+            # rolled back: predecessor re-registered everywhere
+            assert meta_v1.info_hash in c.torrents
+            assert fake.unregistered == [meta_v1.info_hash]
+            assert fake.registered == [meta_v1.info_hash]
+            await c.close()
+
+        run(go())
+
+    def test_successful_update_deletes_stale_resume(self, tmp_path):
+        async def go():
+            c, t1, meta_v1 = await self._seeded_client_and_torrent(tmp_path)()
+            assert t1.resume_store is not None
+            src2 = tmp_path / "v2src" / "ds"
+            src2.mkdir(parents=True)
+            rng = np.random.default_rng(42)
+            (src2 / "a.bin").write_bytes(
+                rng.integers(0, 256, size=48 * 1024, dtype=np.uint8).tobytes()
+            )
+            meta_v2 = parse_metainfo(
+                make_torrent(str(src2), ANNOUNCE, piece_length=16384)
+            )
+            resume_path = t1.resume_store._path(meta_v1.info_hash)
+            t2 = await c.apply_update(t1, meta_v2)
+            assert t2.metainfo.info_hash in c.torrents
+            # the predecessor's checkpoint (written by its stop()) is gone
+            assert not os.path.exists(resume_path)
+            await c.close()
 
         run(go())
 

@@ -711,6 +711,147 @@ class TestPathMtu:
 
         run(go(), timeout=120)
 
+    def test_mtu_raises_after_link_unclamps_mid_transfer(self):
+        """r3 verdict #7 (DPLPMTUD-style raise probing): a connection
+        whose SYN ladder settled at 1280 behind a transient clamp climbs
+        back up — all the way to the loopback jumbo rung — once the link
+        un-clamps, within a few round trips of padded-DATA probes."""
+
+        async def go():
+            import time as _time
+
+            done = asyncio.Event()
+            got = bytearray()
+
+            async def consume(reader, writer):
+                while True:
+                    data = await reader.read(1 << 20)
+                    if not data:
+                        break
+                    got.extend(data)
+                    if len(got) >= 2 << 20:
+                        done.set()
+
+            loop = asyncio.get_running_loop()
+            _, server = await loop.create_datagram_endpoint(
+                lambda: _ClampedEndpoint(consume), local_addr=("127.0.0.1", 0)
+            )
+            _, client = await loop.create_datagram_endpoint(
+                _ClampedEndpoint, local_addr=("127.0.0.1", 0)
+            )
+            try:
+                reader, writer = await client.dial("127.0.0.1", server.port, timeout=15)
+                conn = writer._conn
+                assert conn.mtu <= 1280, conn.mtu
+                assert conn._mtu_raise_at > 0  # probing armed
+                # un-clamp the path and make probes eligible immediately
+                client.clamp = 1 << 30
+                server.clamp = 1 << 30
+                conn._mtu_raise_interval = 0.05
+                conn._mtu_raise_at = _time.monotonic()
+                payload = random.Random(17).randbytes(2 << 20)
+                writer.write(payload)
+                sent = [payload]
+                deadline = _time.monotonic() + 45
+                while (
+                    conn.mtu < conn._mtu_ladder[0]
+                    and _time.monotonic() < deadline
+                ):
+                    # keep full-budget chunks flowing: the jumbo probe is
+                    # admitted only once cwnd has grown to carry it
+                    extra = random.Random(len(sent)).randbytes(256 * 1024)
+                    writer.write(extra)
+                    sent.append(extra)
+                    await writer.drain()
+                    await asyncio.sleep(0.02)
+                assert conn.mtu == conn._mtu_ladder[0], conn.mtu  # jumbo
+                await writer.drain()
+                whole = b"".join(sent)
+                deadline = _time.monotonic() + 30
+                while len(got) < len(whole) and _time.monotonic() < deadline:
+                    await asyncio.sleep(0.05)
+                assert bytes(got) == whole  # stream intact through probes
+            finally:
+                client.close()
+                server.close()
+
+        run(go(), timeout=90)
+
+    def test_failed_raise_probe_backs_off_and_stream_survives(self):
+        """A probe that vanishes (link still clamped) is retransmitted
+        WITHOUT the pad — identical stream bytes — and the probe cadence
+        backs off instead of hammering the black hole."""
+
+        async def go():
+            import time as _time
+
+            done = asyncio.Event()
+            got = bytearray()
+            total = 256 * 1024
+
+            async def consume(reader, writer):
+                while len(got) < total:
+                    data = await reader.read(1 << 20)
+                    if not data:
+                        break
+                    got.extend(data)
+                done.set()
+
+            loop = asyncio.get_running_loop()
+            _, server = await loop.create_datagram_endpoint(
+                lambda: _ClampedEndpoint(consume), local_addr=("127.0.0.1", 0)
+            )
+            _, client = await loop.create_datagram_endpoint(
+                _ClampedEndpoint, local_addr=("127.0.0.1", 0)
+            )
+            try:
+                reader, writer = await client.dial("127.0.0.1", server.port, timeout=15)
+                conn = writer._conn
+                assert conn.mtu <= 1280, conn.mtu
+                conn._mtu_raise_interval = 0.05
+                conn._mtu_raise_at = _time.monotonic()
+                payload = random.Random(19).randbytes(total)
+                writer.write(payload)
+                await writer.drain()
+                await asyncio.wait_for(done.wait(), 60)
+                assert bytes(got) == payload  # bare retransmit: no corruption
+                # still clamped: budget unchanged, cadence backed off
+                assert conn.mtu <= 1280, conn.mtu
+                assert conn._mtu_raise_interval > 0.05
+            finally:
+                client.close()
+                server.close()
+
+        run(go(), timeout=120)
+
+    def test_duplicate_syn_tighten_rearms_raise_probing(self):
+        """A stale duplicate SYN with a smaller pad tightens an existing
+        connection's budget — that clamp must re-arm upward probing (and
+        the loopback acceptor's raise ladder tops at the jumbo rung), or
+        the connection is pinned low forever."""
+
+        async def go():
+            server = await _echo_pair()
+            try:
+                reader, writer = await utp.open_utp_connection(
+                    "127.0.0.1", server.port, timeout=5
+                )
+                (addr, rid), srv_conn = next(iter(server._conns.items()))
+                assert srv_conn.mtu == utp.JUMBO_MTU
+                assert srv_conn._mtu_ladder[0] == utp.JUMBO_MTU  # loopback
+                assert srv_conn._mtu_raise_at == 0  # at the top: off
+                dup_syn = utp.encode_packet(
+                    utp.ST_SYN, (rid - 1) & 0xFFFF, 1, 0, payload=b"\x00" * 1400
+                )
+                server.datagram_received(dup_syn, addr)
+                assert srv_conn.mtu == 1400  # tightened
+                assert srv_conn._mtu_raise_at > 0  # ...and re-armed
+                writer.close()
+            finally:
+                server.close()
+
+        run(go())
+
     def test_unclamped_dial_keeps_full_mtu(self):
         """An unclamped LOOPBACK dial adopts the jumbo first rung (local
         paths carry ~64 KiB datagrams); the standard ladder's top is what

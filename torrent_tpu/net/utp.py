@@ -70,6 +70,16 @@ MTU_LADDER = (1400, 1280, 1152, 576)  # SYN-probe step-down candidates
 # see this rung, so nothing changes on real networks.
 JUMBO_MTU = 62 * 1024
 MTU_LADDER_LOOPBACK = (JUMBO_MTU,) + MTU_LADDER
+# Upward path-MTU probing (DPLPMTUD-flavored): a dial whose SYN ladder
+# settled low — a transient clamp, a lossy burst during the handshake —
+# must not pin a long-lived connection at 576 forever. Full-budget DATA
+# packets are periodically inflated to the next ladder rung with a
+# padding EXTENSION (id PAD_EXT below; length-prefixed, so compliant
+# decoders skip it and the STREAM bytes are unchanged — which is what
+# makes the probe safe to retransmit bare if it vanishes).
+PAD_EXT = 0x7A
+MTU_RAISE_INTERVAL = 5.0  # first upward probe / post-success cadence
+MTU_RAISE_BACKOFF_MAX = 120.0  # failed probes back off exponentially to this
 SACK_ENABLED = True  # module toggle so tests can measure SACK's effect
 SACK_MAX_BYTES = 8  # bitmask covers ack_nr+2 .. ack_nr+1+64
 TARGET_DELAY_US = 100_000  # LEDBAT one-way-delay target
@@ -95,12 +105,21 @@ def encode_packet(
     wnd: int = RECV_WINDOW,
     payload: bytes = b"",
     sack: bytes | None = None,
+    pad: int = 0,
 ) -> bytes:
-    ext_blob = b""
-    first_ext = 0
+    exts = []
     if sack:
-        first_ext = 1  # extension 1 = selective ack (BEP 29)
-        ext_blob = bytes((0, len(sack))) + sack
+        exts.append((1, sack))  # extension 1 = selective ack (BEP 29)
+    n = pad
+    while n > 0:  # PAD_EXT entries are ≤255 bytes each; chain as needed
+        k = min(255, n)
+        exts.append((PAD_EXT, b"\x00" * k))
+        n -= k
+    ext_blob = b""
+    first_ext = exts[0][0] if exts else 0
+    for i, (_eid, data) in enumerate(exts):
+        nxt = exts[i + 1][0] if i + 1 < len(exts) else 0
+        ext_blob += bytes((nxt, len(data))) + data
     return (
         HEADER.pack(
             (ptype << 4) | VERSION,
@@ -211,6 +230,12 @@ class UtpConnection:
         self.mtu = MTU  # payload budget; dial-time SYN probing may lower it
         self._mtu_ladder = MTU_LADDER  # dial() swaps in the loopback ladder
         self._mtu_probe_idx: int | None = None  # ladder position while dialing
+        # upward (raise) probing state — see PAD_EXT block at module top
+        self._mtu_raise_at = 0.0  # monotonic: next probe eligibility (0 = off)
+        self._mtu_raise_interval = MTU_RAISE_INTERVAL
+        self._mtu_probe_seq: int | None = None  # in-flight padded-DATA probe
+        self._mtu_probe_target = 0  # rung the in-flight probe validates
+        self._mtu_probe_bare: bytes | None = None  # pad-stripped retransmit form
         self.retx_count = 0  # retransmitted packets (observability + tests)
         self.retx_bytes = 0
         self._srtt: float | None = None
@@ -276,13 +301,58 @@ class UtpConnection:
         # overruns the receiver after a long SACK run
         return self._inflight_data + self._sacked_bytes
 
+    def _arm_mtu_raise(self) -> None:
+        """Start upward path-MTU probing when the budget settled below
+        the ladder top (transient clamp during the SYN exchange, an
+        acceptor adopting a stepped-down dialer's pad, ...)."""
+        if self.mtu < self._mtu_ladder[0]:
+            self._mtu_raise_at = time.monotonic() + self._mtu_raise_interval
+
+    def _mtu_probe_pad(self, chunk_len: int) -> int:
+        """Padding bytes that turn this DATA packet into an upward path
+        probe, or 0. Only full-budget chunks probe (a short tail says
+        nothing about the path), one probe in flight at a time. The probe
+        wire size slightly EXCEEDS a normal target-rung packet (2 bytes
+        per 255-byte pad entry) — conservative in the right direction."""
+        if (
+            self._mtu_probe_seq is not None
+            or not self._mtu_raise_at
+            or chunk_len < self.mtu
+            or time.monotonic() < self._mtu_raise_at
+        ):
+            return 0
+        bigger = [r for r in self._mtu_ladder if r > self.mtu]
+        if not bigger:
+            self._mtu_raise_at = 0.0  # at the top: probing done
+            return 0
+        self._mtu_probe_target = min(bigger)
+        return self._mtu_probe_target - chunk_len
+
+    def _mtu_probe_acked(self, seq: int) -> None:
+        """The padded probe survived the path: adopt the rung it proved,
+        and keep climbing (next eligible chunk) until the ladder top —
+        recovery from a transient clamp completes within a few RTTs."""
+        if seq != self._mtu_probe_seq:
+            return
+        self.mtu = self._mtu_probe_target
+        self._mtu_probe_seq = None
+        self._mtu_probe_bare = None
+        self._mtu_raise_interval = MTU_RAISE_INTERVAL
+        self._mtu_raise_at = (
+            time.monotonic() if self.mtu < self._mtu_ladder[0] else 0.0
+        )
+
     async def send(self, data: bytes) -> None:
         """Chunk ``data`` into ST_DATA packets, honoring the window."""
         if self.closed or self._reset:
             raise ConnectionResetError("utp connection closed")
-        step = self.mtu
-        for off in range(0, len(data), step):
-            chunk = data[off : off + step]
+        off = 0
+        while off < len(data):
+            # re-read the budget per chunk: a raise probe acked mid-send
+            # grows it, and the REST of this send must cut full-budget
+            # chunks or the next rung's probe never finds one to ride
+            chunk = data[off : off + self.mtu]
+            off += len(chunk)
             while self._flow_used() + len(chunk) > self._window():
                 self._send_room.clear()
                 try:
@@ -295,6 +365,17 @@ class UtpConnection:
                 if self.closed or self._reset:
                     raise ConnectionResetError("utp connection closed")
             self.seq_nr = (self.seq_nr + 1) & 0xFFFF
+            pad = self._mtu_probe_pad(len(chunk))
+            if pad and pad > self._window():
+                # Bound the probe's congestion overshoot: the pad bytes
+                # are NOT admitted by the window check above, so cap them
+                # at one window's worth of extra traffic (also: probing a
+                # rung larger than the sustainable window is pointless —
+                # wait for cwnd to earn it). The pad never occupies the
+                # RECEIVER's buffer — extensions are stripped at decode —
+                # so the peer's advertised window only ever governs the
+                # stream bytes, which the admission loop already checked.
+                pad = 0
             pkt = encode_packet(
                 ST_DATA,
                 self.send_id,
@@ -303,7 +384,22 @@ class UtpConnection:
                 ts_diff=self.last_ts_diff,
                 wnd=self.recv_window(),
                 payload=chunk,
+                pad=pad,
             )
+            if pad:
+                # keep the pad-stripped form ready: if the probe vanishes
+                # the pad may be exactly why, and the retransmit must not
+                # repeat the oversize (the STREAM bytes are identical)
+                self._mtu_probe_seq = self.seq_nr
+                self._mtu_probe_bare = encode_packet(
+                    ST_DATA,
+                    self.send_id,
+                    self.seq_nr,
+                    self.ack_nr,
+                    ts_diff=self.last_ts_diff,
+                    wnd=self.recv_window(),
+                    payload=chunk,
+                )
             self._out_add(self.seq_nr, pkt)
             self.endpoint.sendto(pkt, self.addr)
             self._arm_timer()
@@ -358,6 +454,7 @@ class UtpConnection:
                 # must start at seq.
                 self.ack_nr = seq
                 self.connected.set()
+                self._arm_mtu_raise()  # dial settled low? probe upward
                 # data that raced ahead of the SYN-ACK sits in the
                 # out-of-order buffer; deliver whatever now lines up —
                 # including a buffered FIN, which must close us here just
@@ -450,6 +547,7 @@ class UtpConnection:
                 self._last_ack_seen = ack
             for s in acked:
                 pkt, sent_at, retx = self._out_pop(s)
+                self._mtu_probe_acked(s)
                 if retx == 0:  # Karn: only clean samples drive the RTO
                     self._rtt_sample(time.monotonic() - sent_at)
             self._ledbat(ts_diff, len(acked) + n_sacked)
@@ -492,6 +590,7 @@ class UtpConnection:
                     s = (ack + 2 + byte_i * 8 + bit) & 0xFFFF
                     if s in self._outstanding:
                         pkt = self._out_pop(s)[0]
+                        self._mtu_probe_acked(s)
                         # stays in flow-control accounting until the
                         # cumulative ack passes it (see _flow_used)
                         size = max(0, len(pkt) - HEADER.size)
@@ -607,6 +706,18 @@ class UtpConnection:
         entry = self._outstanding.get(seq)
         if entry is None:
             return
+        if seq == self._mtu_probe_seq and self._mtu_probe_bare is not None:
+            # probe failed: the pad may be exactly why it vanished —
+            # resend the pad-stripped form (identical stream bytes) and
+            # back the probe cadence off exponentially
+            self._inflight_data += len(self._mtu_probe_bare) - len(entry[0])
+            entry[0] = self._mtu_probe_bare
+            self._mtu_probe_seq = None
+            self._mtu_probe_bare = None
+            self._mtu_raise_interval = min(
+                MTU_RAISE_BACKOFF_MAX, self._mtu_raise_interval * 2
+            )
+            self._mtu_raise_at = time.monotonic() + self._mtu_raise_interval
         entry[1] = time.monotonic()
         entry[2] += 1
         self.retx_count += 1
@@ -804,10 +915,13 @@ class UtpEndpoint(asyncio.DatagramProtocol):
             if existing is not None:
                 if payload:
                     # re-probe: only ever TIGHTEN (a stale larger first
-                    # SYN can arrive after a smaller successful one)
+                    # SYN can arrive after a smaller successful one) —
+                    # but a tightened budget must arm raise probing, or a
+                    # stale duplicate SYN pins the connection low forever
                     existing.mtu = min(
                         existing.mtu, max(MTU_LADDER[-1], len(payload))
                     )
+                    existing._arm_mtu_raise()
                 existing._send_state()  # retransmitted SYN: re-ack, no new conn
                 return
             if self.on_accept is None:
@@ -829,6 +943,11 @@ class UtpEndpoint(asyncio.DatagramProtocol):
                 conn.mtu = max(MTU_LADDER[-1], min(cap, len(payload)))
             conn.ack_nr = seq
             conn.connected.set()
+            if _is_loopback_addr(addr[0]):
+                # raise probes may climb to the jumbo rung here, exactly
+                # like the dial side — WAN accepts keep the 1400-top ladder
+                conn._mtu_ladder = MTU_LADDER_LOOPBACK
+            conn._arm_mtu_raise()  # adopted a stepped-down budget? probe up
             self._conns[(addr, conn.recv_id)] = conn
             self._by_send[(addr, conn.send_id)] = conn
             conn._send_state()  # SYN-ACK

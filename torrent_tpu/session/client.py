@@ -661,8 +661,16 @@ class Client:
             )
         except BaseException:
             self.torrents[torrent.metainfo.info_hash] = torrent
+            # remove() unregistered the predecessor from local-service
+            # discovery; a rollback must restore that announcement too
+            if self.lsd is not None and not torrent.private:
+                self.lsd.register(torrent.metainfo.info_hash)
             await torrent.start()
             raise
+        # successful switch: the predecessor's fastresume checkpoint is
+        # stale forever (its info hash will never be added again here)
+        if torrent.resume_store is not None:
+            torrent.resume_store.delete(torrent.metainfo.info_hash)
         return new_torrent
 
     async def add_hybrid(

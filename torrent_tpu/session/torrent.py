@@ -24,6 +24,7 @@ This is the completed design:
 from __future__ import annotations
 
 import asyncio
+import errno
 import hashlib
 import random
 import time
@@ -2461,6 +2462,30 @@ class Torrent:
             except (ConnectionError, OSError):
                 continue
 
+    async def _serve_read_retry(self, make_read):
+        """Serve-path read with ONE retry for transient failures.
+
+        A momentary failure (fd exhaustion under connection fanout, EIO
+        from a busy disk, an interrupted syscall) is not piece loss:
+        treating it as permanent retracts the piece, demotes a seed to
+        DOWNLOADING, and re-downloads from the swarm. Only an error that
+        persists across the retry — or one that is structurally permanent
+        (missing file, short read) — reaches the ``_piece_lost``
+        self-heal path.
+        """
+        try:
+            return await make_read()
+        except StorageError as e:
+            cause = e.__cause__
+            # no OSError cause = the storage layer's own no-such-file /
+            # short-read diagnosis: retrying cannot change the file's
+            # length. ENOENT is likewise structural.
+            if not isinstance(cause, OSError) or cause.errno == errno.ENOENT:
+                raise
+            log.warning("serve read transient error, retrying once: %s", e)
+            await asyncio.sleep(0.05)
+            return await make_read()
+
     async def _serve_request(self, peer: PeerConnection, index, begin, length) -> None:
         """request handler (torrent.ts:158-176), gated on our choke state.
 
@@ -2507,8 +2532,12 @@ class Torrent:
         # the cache (whole-piece reads would amplify one-block fetches).
         if self.info.piece_length > self.config.serve_cache_max_piece:
             try:
-                block = await asyncio.to_thread(
-                    self.storage.get, index * self.info.piece_length + begin, length
+                block = await self._serve_read_retry(
+                    lambda: asyncio.to_thread(
+                        self.storage.get,
+                        index * self.info.piece_length + begin,
+                        length,
+                    )
                 )
             except StorageError as e:
                 log.error("serving piece %d failed: %s", index, e)
@@ -2520,8 +2549,14 @@ class Torrent:
             # thread hop the whole-piece cache path would pay
             piece = self._serve_cache.pop(index, None)
             if piece is None:
+
+                async def _read_small():
+                    # stays on the event loop: a sync pread here is
+                    # cheaper than the thread hop (see branch comment)
+                    return self.storage.read_piece(index)
+
                 try:
-                    piece = self.storage.read_piece(index)
+                    piece = await self._serve_read_retry(_read_small)
                 except StorageError as e:
                     log.error("serving piece %d failed: %s", index, e)
                     await self._piece_lost(index)
@@ -2534,17 +2569,23 @@ class Torrent:
         else:
             piece = self._serve_cache.get(index)
             if piece is None:
-                task = self._serve_pending.get(index)
-                if task is None:
-                    task = asyncio.ensure_future(
-                        asyncio.to_thread(self.storage.read_piece, index)
-                    )
-                    self._serve_pending[index] = task
-                    task.add_done_callback(
-                        lambda _t, i=index: self._serve_pending.pop(i, None)
-                    )
+
+                def _shared_read():
+                    # a retry lands AFTER the failed task's done-callback
+                    # popped it, so it installs (or joins) a fresh one
+                    task = self._serve_pending.get(index)
+                    if task is None:
+                        task = asyncio.ensure_future(
+                            asyncio.to_thread(self.storage.read_piece, index)
+                        )
+                        self._serve_pending[index] = task
+                        task.add_done_callback(
+                            lambda _t, i=index: self._serve_pending.pop(i, None)
+                        )
+                    return asyncio.shield(task)
+
                 try:
-                    piece = await asyncio.shield(task)
+                    piece = await self._serve_read_retry(_shared_read)
                 except StorageError as e:
                     log.error("serving piece %d failed: %s", index, e)
                     await self._piece_lost(index)

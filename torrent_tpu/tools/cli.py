@@ -198,9 +198,14 @@ async def _feed_loop(args) -> int:
     poller = None
 
     def save_seen() -> None:
+        # atomic replace: a crash mid-write must not truncate the
+        # subscription memory (a lost --seen file re-adds the whole feed
+        # history on the next run) — same pattern as FsResumeStore
         if args.seen and poller is not None:
-            with open(args.seen, "w") as f:
+            tmp = args.seen + ".tmp"
+            with open(tmp, "w") as f:
                 f.write("\n".join(sorted(poller.seen)) + "\n")
+            os.replace(tmp, args.seen)
 
     # everything after construction lives under the finally: an
     # unreadable --seen file or a failed start must still close the
@@ -415,6 +420,11 @@ def _make_v2(args) -> int:
             top[b"collections"] = [c.encode("utf-8") for c in args.collection]
         if args.update_url:
             top[b"update-url"] = args.update_url.encode("utf-8")
+        # canonical bencode wants sorted dict keys; the appended keys land
+        # at the end of the decoded order, so shallow-sort the TOP level
+        # only (the info value's bytes — and thus the infohash — are
+        # untouched; sort_keys=False keeps nested dicts verbatim)
+        top = {k: top[k] for k in sorted(top)}
         data = bencode(top, sort_keys=False)
     out = args.output or (name + ".torrent")
     with open(out, "wb") as f:

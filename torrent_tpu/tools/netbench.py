@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 import time
 
@@ -34,7 +35,12 @@ async def _swarm(total: int, piece: int, n_leech: int, utp: bool) -> dict:
 
     # the test harness's tracker + torrent builders are intentionally
     # reused: the bench must measure the same stack the suite proves
-    sys.path.insert(0, "tests")
+    # (resolved relative to this file so `python -m
+    # torrent_tpu.tools.netbench` works from any working directory)
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "tests"),
+    )
     from test_session import build_torrent_bytes, fast_config, start_tracker
 
     rng = np.random.default_rng(7)

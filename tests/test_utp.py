@@ -37,6 +37,38 @@ class TestCodec:
         assert not utp._seq_lt(2, 0xFFFE)
         assert not utp._seq_lt(5, 5)
 
+    def test_pad_extension_roundtrip_and_chains(self):
+        """Raise probes pad packets with chained PAD_EXT entries; the
+        decoder must skip them (payload and sack unchanged) at any pad
+        size, including multi-entry chains alongside a SACK."""
+        for pad in (1, 255, 256, 600, 62 * 1024):
+            pkt = utp.encode_packet(
+                utp.ST_DATA, 1, 2, 3, payload=b"data", pad=pad
+            )
+            out = utp.decode_packet(pkt)
+            assert out is not None
+            assert out[7] == b"data" and out[8] is None
+        pkt = utp.encode_packet(
+            utp.ST_STATE, 1, 2, 3, sack=b"\x01\x00\x00\x00", pad=300
+        )
+        out = utp.decode_packet(pkt)
+        assert out[7] == b"" and out[8] == b"\x01\x00\x00\x00"
+
+    def test_decode_survives_hostile_extension_chains(self):
+        """Truncated/cyclic/oversized extension chains must return None
+        or parse cleanly — never raise (hostile-datagram surface)."""
+        import random as _r
+
+        base = utp.encode_packet(utp.ST_DATA, 1, 2, 3, payload=b"x", pad=600)
+        rng = _r.Random(99)
+        for _ in range(2000):
+            buf = bytearray(base)
+            for _ in range(rng.randrange(1, 6)):
+                i = rng.randrange(len(buf))
+                buf[i] = rng.randrange(256)
+            cut = rng.randrange(len(buf) + 1)
+            utp.decode_packet(bytes(buf[:cut]))  # must not raise
+
 
 async def _echo_pair():
     """Acceptor echoes everything it reads back to the sender."""

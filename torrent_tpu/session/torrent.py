@@ -2363,6 +2363,32 @@ class Torrent:
             from torrent_tpu.models.merkle import piece_root_cpu
 
             pad = self.info.piece_pad_leaves[index]
+            if (
+                self.config.hasher == "tpu"
+                and len(data) == self.info.piece_length
+                and pad == self.info.piece_length // 16384
+            ):
+                # Full-subtree piece: batch onto the device leaf plane
+                # with every other concurrent finisher — the same
+                # micro-batch machinery as v1 (_flush_verify_batch routes
+                # on self.v2); tail pieces (short data / oversized pad)
+                # fold on the CPU below.
+                #
+                # Crossover, measured (BASELINE.md environment): hashlib
+                # SHA-256 sustains ~1.9 GiB/s on this host (~0.55 ms per
+                # 1 MiB piece) while a device dispatch costs ~55 ms
+                # through this image's relay tunnel — the batch wins at
+                # ≳100 concurrently-finishing 1 MiB pieces here, but on a
+                # co-located TPU host (sub-ms dispatch) at ≲2. Either
+                # way the verify leaves the event loop, which is what
+                # ingest latency cares about; a device failure falls back
+                # to hashlib inside the flush.
+                fut: asyncio.Future = asyncio.get_running_loop().create_future()
+                self._verify_pending.append((index, data, expected, fut))
+                if not self._verify_flushing:
+                    self._verify_flushing = True
+                    self._spawn(self._flush_verify_batch(), name="verify-batch")
+                return await fut
             if len(data) <= INLINE_IO_MAX:
                 return piece_root_cpu(data, pad) == expected
             root = await asyncio.to_thread(piece_root_cpu, data, pad)
@@ -2389,16 +2415,30 @@ class Torrent:
                 del self._verify_pending[: len(batch)]
                 pieces = [b[1] for b in batch]
                 expected = [b[2] for b in batch]
+                device_fn = (
+                    self._verify_batch_device_v2 if self.v2 else self._verify_batch_device
+                )
                 try:
-                    ok = await asyncio.to_thread(self._verify_batch_device, pieces, expected)
+                    ok = await asyncio.to_thread(device_fn, pieces, expected)
                 except Exception as e:  # device trouble: fail safe to hashlib
                     log.warning("tpu ingest verify failed (%s); hashlib fallback", e)
-                    ok = await asyncio.to_thread(
-                        lambda: [
-                            hashlib.sha1(p).digest() == e2
-                            for p, e2 in zip(pieces, expected)
-                        ]
-                    )
+                    if self.v2:
+                        from torrent_tpu.models.merkle import piece_root_cpu
+
+                        lpp = self.info.piece_length // 16384
+                        ok = await asyncio.to_thread(
+                            lambda: [
+                                piece_root_cpu(p, lpp) == e2
+                                for p, e2 in zip(pieces, expected)
+                            ]
+                        )
+                    else:
+                        ok = await asyncio.to_thread(
+                            lambda: [
+                                hashlib.sha1(p).digest() == e2
+                                for p, e2 in zip(pieces, expected)
+                            ]
+                        )
                 for (_, _, _, fut), good in zip(batch, ok):
                     if not fut.done():
                         fut.set_result(bool(good))
@@ -2416,6 +2456,27 @@ class Torrent:
         want = digests_to_words(expected)
         got = digests_to_words(digests)
         return (got == want).all(axis=1)
+
+    def _verify_batch_device_v2(self, pieces: list[bytes], expected: list[bytes]):
+        """Batched BEP 52 ingest verify: ONE leaf-plane dispatch plus the
+        fused merkle pair reduction for every concurrently-finishing
+        full-subtree piece (only those are queued — _verify_piece_data
+        folds tails on the CPU, where the pad geometry is per-piece)."""
+        from torrent_tpu.models.merkle import (
+            piece_roots_from_leaves,
+            words32_to_digests,
+        )
+        from torrent_tpu.models.v2 import _leaf_words_from_chunks
+
+        lpp = self.info.piece_length // 16384
+        # each full piece IS a block-aligned chunk: feed them straight to
+        # the leaf plane instead of joining into a second copy of the
+        # whole batch (256 x 1 MiB pieces would duplicate ~256 MiB)
+        leaves = _leaf_words_from_chunks(
+            iter(pieces), sum(len(p) for p in pieces), "auto"
+        )
+        roots = words32_to_digests(piece_roots_from_leaves(leaves, lpp))
+        return [r == e for r, e in zip(roots, expected)]
 
     # ------------------------------------------------------------- seeding
 

@@ -67,6 +67,13 @@ for attempt in $(seq 1 200); do
     # rung 4 — v2 at full leaf width (verdict next-#3)
     rung .bench/cfgv2c.json BENCH_CONFIG=v2 BENCH_TOTAL_MB=2048 \
          BENCH_TPU_WAIT=3600
+    # rung 5a — config-4 regime at HALF the staging (one resident 4.3 GiB
+    # batch, salted dispatches): banks the 1 MiB-piece kernel metric in a
+    # shorter window; full population/e2e proof stays rung 5's job
+    rung .bench/cfg4_small.json BENCH_CONFIG=headline BENCH_PIECE_KB=1024 \
+         BENCH_TOTAL_MB=8192 BENCH_BATCH=4096 BENCH_NBATCH=1 \
+         BENCH_DISPATCHES=8 BENCH_E2E_MB=512 BENCH_H2D_MB=32 \
+         BENCH_TPU_WAIT=3600
     # rung 5 — config 4: 100 GiB / 1 MiB pieces, baseline from cache,
     # e2e leg capped per the relay-RAM hazard (verdict next-#2)
     rung .bench/cfg4.json BENCH_CONFIG=headline BENCH_PIECE_KB=1024 \

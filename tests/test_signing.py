@@ -1,0 +1,271 @@
+"""BEP 35 torrent signing: Ed25519 over the raw info-dict span.
+
+No reference counterpart (rclarey/torrent implements no BEP 35); the
+scheme choice (raw Ed25519 keys in ``certificate``, the BEP 46 key
+format) is documented in codec/signing.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from torrent_tpu.codec import signing
+from torrent_tpu.codec.bencode import bdecode, bencode
+from torrent_tpu.codec.metainfo import parse_metainfo
+from torrent_tpu.tools.make_torrent import make_torrent
+from torrent_tpu.utils import ed25519
+
+ANNOUNCE = "http://127.0.0.1:1/announce"
+SEED_A = bytes(range(32))
+SEED_B = bytes(range(32, 64))
+
+
+@pytest.fixture
+def torrent_bytes(tmp_path):
+    payload = np.random.default_rng(21).integers(
+        0, 256, 50_000, dtype=np.uint8
+    ).tobytes()
+    (tmp_path / "p.bin").write_bytes(payload)
+    return make_torrent(str(tmp_path / "p.bin"), ANNOUNCE, piece_length=16384)
+
+
+class TestSignVerify:
+    def test_roundtrip_and_infohash_preserved(self, torrent_bytes):
+        signed = signing.sign_torrent(torrent_bytes, SEED_A, "alice")
+        assert signing.list_signers(signed) == ["alice"]
+        assert signing.verify_torrent(signed, "alice")  # embedded cert
+        assert signing.verify_torrent(
+            signed, "alice", ed25519.publickey(SEED_A)
+        )
+        # root-level signing: same info bytes, same swarm
+        assert (
+            parse_metainfo(signed).info_hash
+            == parse_metainfo(torrent_bytes).info_hash
+        )
+        # canonical output: strict re-encode is byte-identical
+        assert signed == bencode(bdecode(signed))
+
+    def test_tampered_info_fails(self, torrent_bytes):
+        signed = signing.sign_torrent(torrent_bytes, SEED_A, "alice")
+        top = bdecode(signed)
+        top[b"info"][b"name"] = b"evil.bin"
+        tampered = bencode(top)
+        assert not signing.verify_torrent(tampered, "alice")
+
+    def test_wrong_key_and_unknown_signer(self, torrent_bytes):
+        signed = signing.sign_torrent(torrent_bytes, SEED_A, "alice")
+        assert not signing.verify_torrent(
+            signed, "alice", ed25519.publickey(SEED_B)
+        )
+        assert not signing.verify_torrent(signed, "bob")
+
+    def test_cert_substitution_attack_fails_against_trusted_key(
+        self, torrent_bytes
+    ):
+        """An attacker re-signing with their own key (valid embedded
+        cert!) must not pass a verifier holding the real public key."""
+        signed = signing.sign_torrent(torrent_bytes, SEED_A, "alice")
+        top = bdecode(signed)
+        top[b"info"][b"name"] = b"evil.bin"
+        resigned = signing.sign_torrent(bencode(top), SEED_B, "alice")
+        assert signing.verify_torrent(resigned, "alice")  # embedded: "valid"
+        assert not signing.verify_torrent(
+            resigned, "alice", ed25519.publickey(SEED_A)
+        )  # trusted key: caught
+
+    def test_multiple_signers_coexist(self, torrent_bytes):
+        signed = signing.sign_torrent(torrent_bytes, SEED_A, "alice")
+        signed = signing.sign_torrent(signed, SEED_B, "bob")
+        assert sorted(signing.list_signers(signed)) == ["alice", "bob"]
+        assert signing.verify_torrent(signed, "alice", ed25519.publickey(SEED_A))
+        assert signing.verify_torrent(signed, "bob", ed25519.publickey(SEED_B))
+
+    def test_extension_info_is_covered(self, torrent_bytes):
+        signed = signing.sign_torrent(
+            torrent_bytes, SEED_A, "alice", info_ext={b"expires": 123}
+        )
+        assert signing.verify_torrent(signed, "alice")
+        top = bdecode(signed)
+        top[b"signatures"][b"alice"][b"info"][b"expires"] = 999
+        assert not signing.verify_torrent(bencode(top), "alice")
+
+    def test_non_ed25519_certificate_refused(self, torrent_bytes):
+        signed = signing.sign_torrent(torrent_bytes, SEED_A, "alice")
+        top = bdecode(signed)
+        top[b"signatures"][b"alice"][b"certificate"] = b"\x30\x82" + b"x" * 500
+        assert not signing.verify_torrent(bencode(top), "alice")
+
+    def test_non_canonical_input_keeps_info_bytes(self, torrent_bytes):
+        """Wild torrents with unsorted info keys must keep their exact
+        info span (and thus infohash) through signing — splice, never
+        re-encode."""
+        top = bdecode(torrent_bytes)
+        info = top[b"info"]
+        scrambled = dict(reversed(list(info.items())))  # unsorted on wire
+        wild = bencode({**top, b"info": scrambled}, sort_keys=False)
+        from torrent_tpu.codec.bencode import bdecode_with_info_span
+
+        _, span0 = bdecode_with_info_span(wild)
+        raw0 = wild[span0[0] : span0[1]]
+        signed = signing.sign_torrent(wild, SEED_A, "alice")
+        _, span1 = bdecode_with_info_span(signed)
+        assert signed[span1[0] : span1[1]] == raw0  # byte-identical
+        assert signing.verify_torrent(signed, "alice")
+
+    def test_foreign_non_canonical_ext_verifies_and_survives_resigning(
+        self, torrent_bytes
+    ):
+        """A foreign signer's entry whose ext dict is NOT canonically
+        sorted must verify over its wire bytes as written, and must
+        survive our re-signing byte-for-byte."""
+        from torrent_tpu.codec.bencode import bdecode_with_info_span
+
+        _, span = bdecode_with_info_span(torrent_bytes)
+        raw_info = torrent_bytes[span[0] : span[1]]
+        # hand-build the entry with unsorted ext keys (z before a)
+        ext_wire = b"d1:zi1e1:ai2ee"
+        sig = ed25519.sign(SEED_B, raw_info + ext_wire)
+        entry_wire = (
+            b"d11:certificate32:" + ed25519.publickey(SEED_B)
+            + b"4:info" + ext_wire
+            + b"9:signature64:" + sig + b"e"
+        )
+        top = bdecode(torrent_bytes)
+        body = bencode(top)  # canonical, no signatures yet
+        # splice a signatures dict manually at the end of the top dict
+        assert body[-1:] == b"e"
+        foreign = (
+            body[:-1]
+            + b"10:signaturesd7:foreign" + entry_wire + b"e"
+            + b"e"
+        )
+        assert signing.verify_torrent(foreign, "foreign")
+        resigned = signing.sign_torrent(foreign, SEED_A, "alice")
+        assert sorted(signing.list_signers(resigned)) == ["alice", "foreign"]
+        assert signing.verify_torrent(resigned, "alice")
+        assert signing.verify_torrent(
+            resigned, "foreign", ed25519.publickey(SEED_B)
+        )
+        assert entry_wire in resigned  # foreign entry preserved verbatim
+
+    def test_garbage_inputs(self):
+        assert signing.list_signers(b"not bencode") == []
+        assert not signing.verify_torrent(b"not bencode", "x")
+        with pytest.raises(ValueError):
+            signing.sign_torrent(b"de", SEED_A, "x")
+        with pytest.raises(ValueError):
+            signing.sign_torrent(b"de", b"short", "x")
+
+
+class TestCliSign:
+    def test_keygen_sign_info_check_tamper(self, tmp_path, capsys):
+        from torrent_tpu.tools.cli import main
+
+        payload = np.random.default_rng(22).integers(
+            0, 256, 40_000, dtype=np.uint8
+        ).tobytes()
+        (tmp_path / "d.bin").write_bytes(payload)
+        tf = str(tmp_path / "d.torrent")
+        assert main(["make", str(tmp_path / "d.bin"), ANNOUNCE, "-o", tf,
+                     "--piece-length", "16384"]) == 0
+        capsys.readouterr()
+
+        key = str(tmp_path / "signer.key")
+        assert main(["sign", "--keygen", "--key", key]) == 0
+        out = capsys.readouterr().out
+        pub_hex = out.strip().splitlines()[-1].split()[-1]
+        assert len(pub_hex) == 64
+        assert oct(os.stat(key).st_mode & 0o777) == "0o600"
+        # refuses to clobber an existing key
+        assert main(["sign", "--keygen", "--key", key]) == 2
+        capsys.readouterr()
+
+        assert main(["sign", tf, "--key", key, "--signer", "alice"]) == 0
+        assert "signed by: alice" in capsys.readouterr().out
+
+        assert main(["info", tf]) == 0
+        assert "signed by:    alice (BEP 35" in capsys.readouterr().out
+
+        assert main(["sign", tf, "--check", "alice", "--pub", pub_hex]) == 0
+        assert "VALID" in capsys.readouterr().out
+
+        # wrong-length trusted key is a usage error, never "INVALID"
+        assert main(["sign", tf, "--check", "alice", "--pub", pub_hex[:-2]]) == 2
+        err = capsys.readouterr().err
+        assert "64 hex chars" in err
+
+        data = bytearray(open(tf, "rb").read())
+        i = data.index(b"4:name")
+        data[i + 7] ^= 0x01  # flip a byte inside the signed info span
+        open(tf, "wb").write(bytes(data))
+        assert main(["sign", tf, "--check", "alice", "--pub", pub_hex]) == 2
+        assert "INVALID" in capsys.readouterr().out
+
+    def test_download_require_signed_gate(self, tmp_path, capsys):
+        """`download --require-signed SIGNER=PUBHEX` refuses unsigned or
+        wrong-key torrents before touching the swarm; magnets are
+        refused outright (BEP 9 metadata cannot carry root signatures)."""
+        from torrent_tpu.tools.cli import main
+
+        (tmp_path / "g.bin").write_bytes(b"\x11" * 20_000)
+        tf = str(tmp_path / "g.torrent")
+        assert main(["make", str(tmp_path / "g.bin"), ANNOUNCE, "-o", tf,
+                     "--piece-length", "16384"]) == 0
+        capsys.readouterr()
+        pub = ed25519.publickey(SEED_A).hex()
+        dl = str(tmp_path / "dl")
+        os.makedirs(dl)
+
+        # unsigned: refused before any network activity
+        assert main(["download", tf, dl,
+                     f"--require-signed=publisher={pub}"]) == 2
+        assert "no valid BEP 35 signature" in capsys.readouterr().err
+        # wrong key: refused
+        signed = signing.sign_torrent(open(tf, "rb").read(), SEED_B, "publisher")
+        open(tf, "wb").write(signed)
+        assert main(["download", tf, dl,
+                     f"--require-signed=publisher={pub}"]) == 2
+        capsys.readouterr()
+        # malformed spec: usage error
+        assert main(["download", tf, dl, "--require-signed=publisher=zz"]) == 2
+        assert "SIGNER=PUBHEX" in capsys.readouterr().err
+        # magnets can never satisfy the gate
+        assert main(["download", "magnet:?xt=urn:btih:" + "0" * 40, dl,
+                     f"--require-signed=publisher={pub}"]) == 2
+        assert "magnet" in capsys.readouterr().err
+
+    def test_info_distinguishes_out_of_band_keys(self, tmp_path, capsys):
+        """An entry without an embedded certificate is 'unverifiable
+        without a trusted key', not 'DOES NOT verify'."""
+        from torrent_tpu.codec.bencode import bdecode, bencode
+        from torrent_tpu.tools.cli import main
+
+        (tmp_path / "e.bin").write_bytes(b"\x5a" * 30_000)
+        tf = str(tmp_path / "e.torrent")
+        assert main(["make", str(tmp_path / "e.bin"), ANNOUNCE, "-o", tf,
+                     "--piece-length", "16384"]) == 0
+        signed = signing.sign_torrent(open(tf, "rb").read(), SEED_A, "oob")
+        top = bdecode(signed)
+        del top[b"signatures"][b"oob"][b"certificate"]
+        open(tf, "wb").write(bencode(top))
+        capsys.readouterr()
+        assert main(["info", tf]) == 0
+        out = capsys.readouterr().out
+        assert "no embedded certificate" in out
+        assert "DOES NOT verify" not in out
+        # --check without --pub: UNVERIFIABLE, never INVALID
+        assert main(["sign", tf, "--check", "oob"]) == 2
+        out = capsys.readouterr().out
+        assert "UNVERIFIABLE" in out and "INVALID" not in out
+        # --check WITH the right key verifies despite the missing cert
+        pub = ed25519.publickey(SEED_A).hex()
+        assert main(["sign", tf, "--check", "oob", "--pub", pub]) == 0
+        assert "VALID" in capsys.readouterr().out
+
+    def test_cli_write_errors_are_clean(self, tmp_path, capsys):
+        from torrent_tpu.tools.cli import main
+
+        assert main(["sign", "--keygen", "--key",
+                     str(tmp_path / "no" / "dir" / "k.hex")]) == 1
+        assert "cannot write key file" in capsys.readouterr().err

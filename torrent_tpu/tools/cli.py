@@ -98,6 +98,33 @@ def _cmd_info(args) -> int:
 
     with open(args.torrent, "rb") as f:
         data = f.read()
+
+    def print_signers() -> None:
+        from torrent_tpu.codec import signing
+        from torrent_tpu.codec.bencode import BencodeError, bdecode as _bdecode
+
+        try:
+            sig_entries = _bdecode(data, strict=False).get(b"signatures")
+        except BencodeError:
+            sig_entries = None
+        if not isinstance(sig_entries, dict):
+            sig_entries = {}
+        for name in signing.list_signers(data):
+            entry = sig_entries.get(name.encode())
+            has_cert = isinstance(entry, dict) and b"certificate" in entry
+            if not has_cert:
+                # BEP 35 allows out-of-band keys: unverifiable is not bad
+                print(
+                    f"signed by:    {name} (BEP 35, no embedded certificate"
+                    f" — check with `sign --check {name} --pub KEY`)"
+                )
+                continue
+            ok = signing.verify_torrent(data, name)
+            print(
+                f"signed by:    {name} (BEP 35, embedded key "
+                f"{'verifies' if ok else 'DOES NOT verify'})"
+            )
+
     m = parse_metainfo(data)
     if m is None:
         from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
@@ -129,6 +156,7 @@ def _cmd_info(args) -> int:
                 print(f"collections:  {', '.join(cols)} (BEP 38)")
             if upd := parse_update_url(raw):
                 print(f"update url:   {upd} (BEP 39)")
+            print_signers()
             return 0
         print("error: not a valid .torrent file", file=sys.stderr)
         return 1
@@ -157,6 +185,7 @@ def _cmd_info(args) -> int:
         print(f"collections:  {', '.join(m.collections)} (BEP 38)")
     if m.update_url:
         print(f"update url:   {m.update_url} (BEP 39)")
+    print_signers()
     if info.files is not None:
         pads = sum(1 for fe in info.files if getattr(fe, "pad", False))
         print(
@@ -643,6 +672,137 @@ def _cmd_seed(args) -> int:
     return asyncio.run(_seed_box(args))
 
 
+def _read_seed_file(path: str) -> bytes | None:
+    """32-byte Ed25519 seed from a key file: 64 hex chars or raw bytes."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        print(f"error: cannot read key file {path!r}: {e}", file=sys.stderr)
+        return None
+    text = raw.strip()
+    if len(text) == 64:
+        try:
+            return bytes.fromhex(text.decode("ascii"))
+        except (ValueError, UnicodeDecodeError):
+            pass
+    if len(raw) == 32:
+        return raw
+    print(f"error: {path!r} is not a 32-byte seed (raw or 64 hex chars)",
+          file=sys.stderr)
+    return None
+
+
+def _cmd_sign(args) -> int:
+    """BEP 35 torrent signing (Ed25519 — the BEP 46 key format).
+
+    ``--keygen`` mints a key pair; ``--signer NAME --key FILE`` signs;
+    ``--check NAME [--pub HEX]`` verifies (exit 0 valid / 2 invalid).
+    Signing is root-level only: the infohash never changes.
+    """
+    from torrent_tpu.codec import signing
+
+    if args.keygen:
+        if not args.key:
+            print("error: --keygen needs --key FILE to write", file=sys.stderr)
+            return 2
+        if os.path.exists(args.key):
+            print(f"error: {args.key!r} exists; refusing to overwrite a key",
+                  file=sys.stderr)
+            return 2
+        from torrent_tpu.utils import ed25519
+
+        seed = os.urandom(32)
+        try:
+            fd = os.open(args.key, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(seed.hex() + "\n")
+        except OSError as e:
+            print(f"error: cannot write key file {args.key!r}: {e}",
+                  file=sys.stderr)
+            return 1
+        print(f"wrote {args.key} (keep it secret)")
+        print(f"public key: {ed25519.publickey(seed).hex()}")
+        return 0
+
+    if not args.torrent:
+        print("error: missing .torrent argument", file=sys.stderr)
+        return 2
+    try:
+        with open(args.torrent, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        print(f"error: cannot read {args.torrent!r}: {e}", file=sys.stderr)
+        return 1
+
+    if args.check is not None:
+        pub = None
+        if args.pub:
+            try:
+                pub = bytes.fromhex(args.pub)
+            except ValueError:
+                print("error: --pub must be hex", file=sys.stderr)
+                return 2
+            if len(pub) != 32:
+                # a wrong-length key is a usage error, not an invalid
+                # signature — misreporting it as INVALID misdiagnoses
+                # a perfectly good torrent as tampered
+                print(
+                    f"error: --pub must be 32 bytes (64 hex chars), got "
+                    f"{len(pub)}",
+                    file=sys.stderr,
+                )
+                return 2
+        if pub is None:
+            # no trusted key given: a certificate-less entry is
+            # UNVERIFIABLE, not invalid — don't misdiagnose an
+            # out-of-band-key torrent as tampered
+            from torrent_tpu.codec.bencode import BencodeError, bdecode
+
+            try:
+                entry = bdecode(data, strict=False).get(b"signatures", {}).get(
+                    args.check.encode()
+                )
+            except (BencodeError, AttributeError):
+                entry = None
+            if isinstance(entry, dict) and b"certificate" not in entry:
+                print(
+                    f"signature by {args.check!r}: UNVERIFIABLE "
+                    f"(no embedded certificate — provide --pub KEY)"
+                )
+                return 2
+        ok = signing.verify_torrent(data, args.check, pub)
+        where = "trusted key" if pub is not None else "embedded certificate"
+        print(f"signature by {args.check!r}: "
+              f"{'VALID' if ok else 'INVALID'} ({where})")
+        return 0 if ok else 2
+
+    if not args.key or not args.signer:
+        print("error: signing needs --key FILE and --signer NAME",
+              file=sys.stderr)
+        return 2
+    seed = _read_seed_file(args.key)
+    if seed is None:
+        return 1
+    try:
+        signed = signing.sign_torrent(data, seed, args.signer)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    out = args.output or args.torrent
+    tmp = out + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(signed)
+        os.replace(tmp, out)
+    except OSError as e:
+        print(f"error: cannot write {out!r}: {e}", file=sys.stderr)
+        return 1
+    names = ", ".join(signing.list_signers(signed))
+    print(f"wrote {out} ({len(signed):,} bytes; signed by: {names})")
+    return 0
+
+
 def _cmd_edit(args) -> int:
     """Rewrite a .torrent's top-level fields without touching the info
     dict: the infohash (and thus the swarm) is preserved byte-for-byte,
@@ -753,6 +913,16 @@ async def _download(args) -> int:
     stream_server = metrics_server = None
     try:
         if args.source.startswith("magnet:"):
+            if getattr(args, "require_signed", None):
+                # BEP 35 signatures live at the torrent's ROOT — swarm
+                # metadata (BEP 9) carries only the info dict, so a
+                # magnet can never satisfy the gate; refuse honestly
+                print(
+                    "error: --require-signed needs a .torrent file "
+                    "(magnet metadata cannot carry BEP 35 signatures)",
+                    file=sys.stderr,
+                )
+                return 2
             print("fetching metadata from swarm...", file=sys.stderr)
             torrent = await client.add_magnet(args.source, args.dir)
         else:
@@ -761,6 +931,29 @@ async def _download(args) -> int:
 
             with open(args.source, "rb") as f:
                 data = f.read()
+            req = getattr(args, "require_signed", None)
+            if req:
+                from torrent_tpu.codec import signing
+
+                signer, _, pub_hex = req.partition("=")
+                try:
+                    pub = bytes.fromhex(pub_hex)
+                except ValueError:
+                    pub = b""
+                if len(pub) != 32 or not signer:
+                    print(
+                        "error: --require-signed wants SIGNER=PUBHEX "
+                        "(64 hex chars)",
+                        file=sys.stderr,
+                    )
+                    return 2
+                if not signing.verify_torrent(data, signer, pub):
+                    print(
+                        f"error: refusing {args.source!r}: no valid BEP 35 "
+                        f"signature by {signer!r} under the trusted key",
+                        file=sys.stderr,
+                    )
+                    return 2
             m = parse_metainfo(data) or parse_metainfo_v2(data)
             if m is None:
                 print("error: not a valid .torrent file", file=sys.stderr)
@@ -968,6 +1161,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="author a hybrid v1+v2 torrent (BEP 52 upgrade path, BEP 47 pad files)")
     sp.set_defaults(fn=_cmd_make)
 
+    sp = sub.add_parser(
+        "sign", help="BEP 35: sign a .torrent / verify signatures / keygen"
+    )
+    sp.add_argument("torrent", nargs="?", help=".torrent file")
+    sp.add_argument("--key", help="Ed25519 seed file (64 hex chars or raw 32B)")
+    sp.add_argument("--signer", help="identity string for the signature entry")
+    sp.add_argument("-o", "--output", help="write here instead of in place")
+    sp.add_argument("--check", metavar="SIGNER",
+                    help="verify SIGNER's signature instead of signing")
+    sp.add_argument("--pub", help="trusted public key (hex) for --check")
+    sp.add_argument("--keygen", action="store_true",
+                    help="generate a new key pair into --key")
+    sp.set_defaults(fn=_cmd_sign)
+
     sp = sub.add_parser("verify", help="recheck downloaded data against a .torrent")
     sp.add_argument("torrent")
     sp.add_argument("dir")
@@ -1006,6 +1213,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("download", help="download a .torrent file or magnet URI")
     sp.add_argument("source", help=".torrent path or magnet:?xt=urn:btih:... URI")
     sp.add_argument("dir")
+    sp.add_argument(
+        "--require-signed",
+        metavar="SIGNER=PUBHEX",
+        help="refuse the .torrent unless it carries a valid BEP 35 "
+        "signature by SIGNER under this trusted Ed25519 key",
+    )
     sp.add_argument("--port", type=int, default=0)
     sp.add_argument("--hasher", choices=("cpu", "tpu"), default="cpu")
     sp.add_argument("--seed", action="store_true", help="keep seeding after completion")

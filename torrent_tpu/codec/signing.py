@@ -149,6 +149,23 @@ def list_signers(data: bytes) -> list[str]:
     return out
 
 
+def has_embedded_certificate(data: bytes, signer: str) -> bool:
+    """True when ``signer``'s entry carries a ``certificate`` field.
+
+    The CLI uses this to distinguish "unverifiable without a trusted key
+    (BEP 35 allows out-of-band keys)" from "the embedded key does not
+    verify" — one classification, shared by every command."""
+    try:
+        decoded, _ = bdecode_with_info_span(data)
+    except BencodeError:
+        return False
+    sigs = decoded.get(b"signatures")
+    if not isinstance(sigs, dict):
+        return False
+    entry = sigs.get(signer.encode("utf-8"))
+    return isinstance(entry, dict) and b"certificate" in entry
+
+
 def verify_torrent(data: bytes, signer: str, pub: bytes | None = None) -> bool:
     """True iff ``signer``'s signature verifies over this torrent.
 

@@ -14,11 +14,21 @@ from __future__ import annotations
 
 import asyncio
 import enum
+import struct
 from dataclasses import dataclass
 
 from torrent_tpu.net.constants import PROTOCOL_STRING
 from torrent_tpu.utils.bitfield import Bitfield
 from torrent_tpu.utils.bytesio import read_int, write_int
+
+# Pre-compiled packers for the bulk-transfer hot path: Piece/Request
+# dominate a fast swarm (one of each per 16 KiB block), and the profile
+# showed the generic read_int/write_int pairs as measurable per-message
+# cost at 100+ MiB/s. Cold messages keep the readable generic forms.
+_II = struct.Struct(">II")
+_III = struct.Struct(">III")
+_PIECE_HDR = struct.Struct(">IBII")  # frame len | id | index | begin
+_REQ_FRAME = struct.Struct(">IBIII")  # frame len | id | index | begin | length
 
 
 class ProtocolError(Exception):
@@ -327,9 +337,10 @@ def encode_message(msg: PeerMsg) -> bytes:
         case BitfieldMsg(raw):
             return _frame(MsgId.BITFIELD, raw)
         case Request(index, begin, length):
-            return _frame(MsgId.REQUEST, write_int(index, 4) + write_int(begin, 4) + write_int(length, 4))
+            return _REQ_FRAME.pack(13, MsgId.REQUEST, index, begin, length)
         case Piece(index, begin, block):
-            return _frame(MsgId.PIECE, write_int(index, 4) + write_int(begin, 4) + block)
+            # one-shot header pack + a single concat copy of the block
+            return _PIECE_HDR.pack(9 + len(block), MsgId.PIECE, index, begin) + block
         case Cancel(index, begin, length):
             return _frame(MsgId.CANCEL, write_int(index, 4) + write_int(begin, 4) + write_int(length, 4))
         case SuggestPiece(index):
@@ -382,6 +393,12 @@ def send_bitfield(writer: asyncio.StreamWriter, bitfield: Bitfield) -> None:
 
 def decode_message(msg_id: int, payload: bytes) -> PeerMsg | None:
     """Payload → message; None for unknown ids (caller skips)."""
+    # hot path first: Piece/Request dominate a bulk transfer
+    if msg_id == MsgId.PIECE and len(payload) >= 8:
+        index, begin = _II.unpack_from(payload)
+        return Piece(index, begin, payload[8:])
+    if msg_id == MsgId.REQUEST and len(payload) == 12:
+        return Request(*_III.unpack(payload))
     if msg_id == MsgId.CHOKE and not payload:
         return Choke()
     if msg_id == MsgId.UNCHOKE and not payload:
@@ -394,12 +411,8 @@ def decode_message(msg_id: int, payload: bytes) -> PeerMsg | None:
         return Have(index=read_int(payload, 4))
     if msg_id == MsgId.BITFIELD:
         return BitfieldMsg(raw=payload)
-    if msg_id == MsgId.REQUEST and len(payload) == 12:
-        return Request(read_int(payload, 4, 0), read_int(payload, 4, 4), read_int(payload, 4, 8))
-    if msg_id == MsgId.PIECE and len(payload) >= 8:
-        return Piece(read_int(payload, 4, 0), read_int(payload, 4, 4), payload[8:])
     if msg_id == MsgId.CANCEL and len(payload) == 12:
-        return Cancel(read_int(payload, 4, 0), read_int(payload, 4, 4), read_int(payload, 4, 8))
+        return Cancel(*_III.unpack(payload))
     if msg_id == MsgId.SUGGEST_PIECE and len(payload) == 4:
         return SuggestPiece(index=read_int(payload, 4))
     if msg_id == MsgId.HAVE_ALL and not payload:
@@ -431,7 +444,7 @@ async def read_message(reader: asyncio.StreamReader) -> PeerMsg | None:
     """
     while True:
         try:
-            length = read_int(await reader.readexactly(4), 4)
+            length = int.from_bytes(await reader.readexactly(4), "big")
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             return None
         if length == 0:
@@ -442,6 +455,12 @@ async def read_message(reader: asyncio.StreamReader) -> PeerMsg | None:
             body = await reader.readexactly(length)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             return None
+        if body[0] == MsgId.PIECE and length >= 9:
+            # slice the block ONCE out of the frame: the generic path
+            # (payload = body[1:], block = payload[8:]) memcpys every
+            # 16 KiB block twice — measurable at 100+ MiB/s
+            index, begin = _II.unpack_from(body, 1)
+            return Piece(index, begin, body[9:])
         msg = decode_message(body[0], body[1:])
         if msg is not None:
             return msg

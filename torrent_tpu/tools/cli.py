@@ -101,18 +101,9 @@ def _cmd_info(args) -> int:
 
     def print_signers() -> None:
         from torrent_tpu.codec import signing
-        from torrent_tpu.codec.bencode import BencodeError, bdecode as _bdecode
 
-        try:
-            sig_entries = _bdecode(data, strict=False).get(b"signatures")
-        except BencodeError:
-            sig_entries = None
-        if not isinstance(sig_entries, dict):
-            sig_entries = {}
         for name in signing.list_signers(data):
-            entry = sig_entries.get(name.encode())
-            has_cert = isinstance(entry, dict) and b"certificate" in entry
-            if not has_cert:
+            if not signing.has_embedded_certificate(data, name):
                 # BEP 35 allows out-of-band keys: unverifiable is not bad
                 print(
                     f"signed by:    {name} (BEP 35, no embedded certificate"
@@ -683,9 +674,13 @@ def _read_seed_file(path: str) -> bytes | None:
     text = raw.strip()
     if len(text) == 64:
         try:
-            return bytes.fromhex(text.decode("ascii"))
+            seed = bytes.fromhex(text.decode("ascii"))
         except (ValueError, UnicodeDecodeError):
-            pass
+            seed = b""
+        # fromhex ignores internal whitespace, so 64 chars can still
+        # yield a short seed — diagnose HERE, naming the file
+        if len(seed) == 32:
+            return seed
     if len(raw) == 32:
         return raw
     print(f"error: {path!r} is not a 32-byte seed (raw or 64 hex chars)",
@@ -757,15 +752,9 @@ def _cmd_sign(args) -> int:
             # no trusted key given: a certificate-less entry is
             # UNVERIFIABLE, not invalid — don't misdiagnose an
             # out-of-band-key torrent as tampered
-            from torrent_tpu.codec.bencode import BencodeError, bdecode
-
-            try:
-                entry = bdecode(data, strict=False).get(b"signatures", {}).get(
-                    args.check.encode()
-                )
-            except (BencodeError, AttributeError):
-                entry = None
-            if isinstance(entry, dict) and b"certificate" not in entry:
+            if args.check in signing.list_signers(
+                data
+            ) and not signing.has_embedded_certificate(data, args.check):
                 print(
                     f"signature by {args.check!r}: UNVERIFIABLE "
                     f"(no embedded certificate — provide --pub KEY)"

@@ -378,6 +378,60 @@ class TestCliUpdate:
         finally:
             shutdown()
 
+    def test_cli_update_require_signed_gates_successor(self, tmp_path, capsys):
+        """BEP 39 + BEP 35: `update --require-signed` refuses an unsigned
+        (or wrongly-signed) successor — an update-url takeover cannot
+        push a replacement — and accepts a properly signed one."""
+        from torrent_tpu.codec import signing
+        from torrent_tpu.codec.bencode import bdecode, bencode
+        from torrent_tpu.tools.cli import main
+        from torrent_tpu.utils import ed25519
+
+        seed = bytes(range(32))
+        pub = ed25519.publickey(seed).hex()
+        rng = np.random.default_rng(56)
+        (tmp_path / "d.bin").write_bytes(
+            rng.integers(0, 256, size=40000, dtype=np.uint8).tobytes()
+        )
+        v1 = make_torrent(str(tmp_path / "d.bin"), ANNOUNCE, piece_length=16384)
+        v2 = make_torrent(str(tmp_path / "d.bin"), ANNOUNCE, piece_length=32768)
+
+        def gated_update(successor_bytes) -> tuple[int, str, bool]:
+            url, shutdown = _serve_bytes(successor_bytes)
+            try:
+                top = bdecode(v1)
+                top[b"update-url"] = url.encode()
+                tfile = tmp_path / "d.torrent"
+                tfile.write_bytes(bencode(top))
+                out = tmp_path / "d.updated.torrent"
+                out.unlink(missing_ok=True)
+                rc = main(["update", str(tfile),
+                           f"--require-signed=publisher={pub}"])
+                captured = capsys.readouterr()
+                return rc, captured.err, out.exists()
+            finally:
+                shutdown()
+
+        # unsigned successor: refused, nothing written
+        rc, err, wrote = gated_update(v2)
+        assert rc == 2 and "no valid BEP 35 signature" in err and not wrote
+        # wrong-key successor: refused
+        rc, err, wrote = gated_update(
+            signing.sign_torrent(v2, bytes(range(32, 64)), "publisher")
+        )
+        assert rc == 2 and not wrote
+        # properly signed successor: written
+        rc, err, wrote = gated_update(
+            signing.sign_torrent(v2, seed, "publisher")
+        )
+        assert rc == 0 and wrote
+        # a typo'd key fails BEFORE any fetch (the server above is gone,
+        # yet the diagnosis is the spec error, not a network error)
+        rc = main(["update", str(tmp_path / "d.torrent"),
+                   "--require-signed=publisher=zz"])
+        assert rc == 2
+        assert "SIGNER=PUBHEX" in capsys.readouterr().err
+
     def test_cli_update_reports_current(self, tmp_path):
         import subprocess
         import sys as _sys

@@ -277,6 +277,22 @@ async def _feed_loop(args) -> int:
         await client.close()
 
 
+def _parse_require_signed(spec: str) -> tuple[str, bytes] | None:
+    """``SIGNER=PUBHEX`` → (signer, 32-byte key), or None + stderr."""
+    signer, _, pub_hex = spec.partition("=")
+    try:
+        pub = bytes.fromhex(pub_hex)
+    except ValueError:
+        pub = b""
+    if len(pub) != 32 or not signer:
+        print(
+            "error: --require-signed wants SIGNER=PUBHEX (64 hex chars)",
+            file=sys.stderr,
+        )
+        return None
+    return signer, pub
+
+
 def _cmd_update(args) -> int:
     """BEP 39 from the command line: fetch the update-url and write the
     successor verbatim (no session needed — just the poll)."""
@@ -309,6 +325,14 @@ def _cmd_update(args) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+    # validate the gate spec BEFORE the fetch: a typo'd key must fail
+    # deterministically, not lie dormant until the first real update
+    req = getattr(args, "require_signed", None)
+    parsed_req = None
+    if req:
+        parsed_req = _parse_require_signed(req)
+        if parsed_req is None:
+            return 2
     raw_out: list = []
     try:
         new_meta = asyncio.run(
@@ -321,6 +345,20 @@ def _cmd_update(args) -> int:
         print(f"current: {url} serves the same torrent")
         return 0
     name = getattr(getattr(new_meta, "info", None), "name", "updated")
+    if parsed_req is not None:
+        # BEP 39 + BEP 35: a secure publishing pipeline. The SUCCESSOR
+        # must carry a valid signature under the trusted key — an
+        # update-url takeover cannot push an unsigned replacement.
+        from torrent_tpu.codec import signing
+
+        signer, pub = parsed_req
+        if not signing.verify_torrent(raw_out[0], signer, pub):
+            print(
+                f"error: refusing update from {url}: successor carries no "
+                f"valid BEP 35 signature by {signer!r} under the trusted key",
+                file=sys.stderr,
+            )
+            return 2
     if args.check:
         print(f"update available: {name!r} at {url}")
         return 0
@@ -924,18 +962,10 @@ async def _download(args) -> int:
             if req:
                 from torrent_tpu.codec import signing
 
-                signer, _, pub_hex = req.partition("=")
-                try:
-                    pub = bytes.fromhex(pub_hex)
-                except ValueError:
-                    pub = b""
-                if len(pub) != 32 or not signer:
-                    print(
-                        "error: --require-signed wants SIGNER=PUBHEX "
-                        "(64 hex chars)",
-                        file=sys.stderr,
-                    )
+                parsed_req = _parse_require_signed(req)
+                if parsed_req is None:
                     return 2
+                signer, pub = parsed_req
                 if not signing.verify_torrent(data, signer, pub):
                     print(
                         f"error: refusing {args.source!r}: no valid BEP 35 "
@@ -1197,6 +1227,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--check", action="store_true",
                     help="only report whether an update exists (write nothing)")
     sp.add_argument("--proxy", help="SOCKS5 proxy URL for the fetch")
+    sp.add_argument(
+        "--require-signed",
+        metavar="SIGNER=PUBHEX",
+        help="refuse the successor unless it carries a valid BEP 35 "
+        "signature by SIGNER under this trusted Ed25519 key "
+        "(an update-url takeover cannot push an unsigned replacement)",
+    )
     sp.set_defaults(fn=_cmd_update)
 
     sp = sub.add_parser("download", help="download a .torrent file or magnet URI")

@@ -305,6 +305,71 @@ class TestDedupAndRetrySemantics:
 
         run(go())
 
+    def test_require_signed_gate_filters_feed_entries(self, tmp_path):
+        """BEP 36 + BEP 35: under the signature gate only entries whose
+        .torrent verifies under the trusted key are added; unsigned,
+        wrong-key, and magnet entries are refused — and NOT burned into
+        the seen set (the publisher may sign them later)."""
+
+        async def go():
+            from torrent_tpu.codec import signing
+            from torrent_tpu.utils import ed25519
+
+            seed = bytes(range(32))
+            rng = np.random.default_rng(45)
+            pa = rng.integers(0, 256, size=16384, dtype=np.uint8).tobytes()
+            pb = rng.integers(0, 256, size=16384, dtype=np.uint8).tobytes()
+            good = signing.sign_torrent(
+                build_torrent_bytes(pa, 16384, b"http://127.0.0.1:1/a", name=b"good.bin"),
+                seed, "publisher",
+            )
+            bad = build_torrent_bytes(
+                pb, 16384, b"http://127.0.0.1:1/a", name=b"bad.bin"
+            )  # unsigned
+            base, shutdown = _serve_routes(
+                {
+                    "/feed.xml": lambda: (
+                        '<rss version="2.0"><channel>'
+                        "<item><title>g</title>"
+                        f'<enclosure url="{base_holder[0]}/good.torrent"/></item>'
+                        "<item><title>b</title>"
+                        f'<enclosure url="{base_holder[0]}/bad.torrent"/></item>'
+                        "<item><title>m</title>"
+                        '<enclosure url="magnet:?xt=urn:btih:'
+                        + "11" * 20
+                        + '"/></item>'
+                        "</channel></rss>"
+                    ).encode(),
+                    "/good.torrent": lambda: good,
+                    "/bad.torrent": lambda: bad,
+                }
+            )
+            base_holder = [base]
+            c = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            c.config.torrent = fast_config()
+            await c.start()
+            try:
+                (tmp_path / "dl").mkdir()
+                poller = FeedPoller(
+                    c,
+                    f"{base}/feed.xml",
+                    str(tmp_path / "dl"),
+                    require_signed=("publisher", ed25519.publickey(seed)),
+                )
+                added = await poller.poll_once()
+                assert [t.info.name for t in added] == ["good.bin"]
+                assert f"{base}/good.torrent" in poller.seen
+                # an unsigned .torrent stays retryable (may be signed
+                # later); a magnet can NEVER pass → marked seen so it
+                # isn't re-refused every poll forever
+                assert f"{base}/bad.torrent" not in poller.seen
+                assert any(s.startswith("magnet:") for s in poller.seen)
+            finally:
+                await c.close()
+                shutdown()
+
+        run(go())
+
     def test_rotated_url_survives_restart_via_seen_hashes(self, tmp_path):
         """Infohashes persist in the seen set as ih:<hex>, so a fresh
         process with a rotated entry URL cannot re-add the content."""

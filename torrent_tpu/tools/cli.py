@@ -207,6 +207,14 @@ async def _feed_loop(args) -> int:
     from torrent_tpu.session.client import Client, ClientConfig
     from torrent_tpu.tools.feed import FeedPoller
 
+    # gate spec parses before anything is constructed: a typo'd key is a
+    # deterministic usage error, never a partially-started session
+    require_signed = None
+    if getattr(args, "require_signed", None):
+        require_signed = _parse_require_signed(args.require_signed)
+        if require_signed is None:
+            return 2
+
     config = ClientConfig(port=args.port)
     if args.proxy:
         config.proxy = args.proxy
@@ -237,7 +245,12 @@ async def _feed_loop(args) -> int:
             with open(args.seen) as f:
                 seen = {line.strip() for line in f if line.strip()}
         poller = FeedPoller(
-            client, args.url, args.dir, interval=args.interval, seen=seen
+            client,
+            args.url,
+            args.dir,
+            interval=args.interval,
+            seen=seen,
+            require_signed=require_signed,
         )
         added = await poller.poll_once()
         save_seen()
@@ -1215,6 +1228,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "(one per line; created if missing)")
     sp.add_argument("--port", type=int, default=0)
     sp.add_argument("--proxy", help="SOCKS5 proxy URL")
+    sp.add_argument(
+        "--require-signed",
+        metavar="SIGNER=PUBHEX",
+        help="only add feed entries whose .torrent carries a valid "
+        "BEP 35 signature by SIGNER under this trusted Ed25519 key "
+        "(magnet entries are refused under the gate)",
+    )
     sp.set_defaults(fn=_cmd_feed)
 
     sp = sub.add_parser(

@@ -30,6 +30,13 @@ MAX_FEED_BYTES = 4 << 20  # a feed document is text; 4 MiB is generous
 MAX_TORRENT_BYTES = 16 << 20
 
 
+class FeedPermanentRefusal(Exception):
+    """An entry that can NEVER be accepted (e.g. a magnet under the
+    signature gate — BEP 35 signatures live at the torrent root, so no
+    future publisher action can make the same magnet pass). poll_once
+    marks these seen: re-refusing them every poll is pure churn."""
+
+
 class FeedError(Exception):
     pass
 
@@ -127,11 +134,18 @@ class FeedPoller:
         download_dir: str,
         interval: float = 300.0,
         seen: set[str] | None = None,
+        require_signed: tuple[str, bytes] | None = None,
     ):
         self.client = client
         self.url = url
         self.download_dir = download_dir
         self.interval = interval
+        # (signer, 32B Ed25519 key): every fetched .torrent must carry a
+        # valid BEP 35 signature or it is skipped — the feed auto-add is
+        # the highest-risk ingestion path (whatever XML says, we fetch
+        # and run). Magnet entries are refused under the gate: BEP 9
+        # metadata cannot carry root signatures.
+        self.require_signed = require_signed
         self.seen: set[str] = seen if seen is not None else set()
         # infohashes ride the same persisted set as "ih:<hex>" entries,
         # so a publisher rotating entry URLs (signed/expiring links)
@@ -161,9 +175,18 @@ class FeedPoller:
                 continue
             try:
                 t = await self._add_item(item)
+            except FeedPermanentRefusal as e:
+                # marked seen: this entry can never be accepted, so one
+                # warning is all it gets (not one per poll forever)
+                log.warning("feed %s: %r refused permanently: %s",
+                            self.url, item.title, e)
+                self.seen.add(item.url)
+                continue
             except Exception as e:
                 # NOT marked seen: a transiently-503ing download URL gets
-                # retried on the next poll instead of being dropped forever
+                # retried on the next poll instead of being dropped
+                # forever (an unsigned .torrent may also be SIGNED later
+                # — root signatures don't change its URL or infohash)
                 log.warning("feed %s: adding %r failed: %s", self.url, item.title, e)
                 continue
             self.seen.add(item.url)
@@ -178,6 +201,11 @@ class FeedPoller:
 
     async def _add_item(self, item: FeedItem):
         if item.url.startswith("magnet:"):
+            if self.require_signed is not None:
+                raise FeedPermanentRefusal(
+                    f"{item.url!r}: magnet entries cannot satisfy the "
+                    f"signature gate (no root signatures in BEP 9 metadata)"
+                )
             return await self.client.add_magnet(item.url, self.download_dir)
         from torrent_tpu.net.tracker import _http_get
 
@@ -187,6 +215,15 @@ class FeedPoller:
             proxy=self.client.proxy,
             max_bytes=MAX_TORRENT_BYTES,
         )
+        if self.require_signed is not None:
+            from torrent_tpu.codec import signing
+
+            signer, pub = self.require_signed
+            if not signing.verify_torrent(raw, signer, pub):
+                raise FeedError(
+                    f"{item.url} refused: no valid BEP 35 signature by "
+                    f"{signer!r} under the trusted key"
+                )
         from torrent_tpu.codec.metainfo import parse_any_metainfo
 
         parsed = parse_any_metainfo(raw)

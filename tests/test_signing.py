@@ -158,6 +158,39 @@ class TestSignVerify:
             signing.sign_torrent(b"de", b"short", "x")
 
 
+class TestSessionGate:
+    def test_add_torrent_bytes_gate_and_autodetect(self, tmp_path):
+        """Client.add_torrent_bytes: the library-level BEP 35 gate plus
+        v1 auto-detection — refused bytes register nothing."""
+        import asyncio
+
+        from torrent_tpu.session.client import Client, ClientConfig
+
+        async def go():
+            payload = np.random.default_rng(23).integers(
+                0, 256, 40_000, dtype=np.uint8
+            ).tobytes()
+            (tmp_path / "s.bin").write_bytes(payload)
+            data = make_torrent(
+                str(tmp_path / "s.bin"), ANNOUNCE, piece_length=16384
+            )
+            c = Client(ClientConfig(host="127.0.0.1", enable_upnp=False))
+            await c.start()
+            try:
+                gate = ("publisher", ed25519.publickey(SEED_A))
+                with pytest.raises(ValueError, match="BEP 35"):
+                    await c.add_torrent_bytes(data, str(tmp_path), gate)
+                assert not c.torrents  # nothing registered on refusal
+                signed = signing.sign_torrent(data, SEED_A, "publisher")
+                t = await c.add_torrent_bytes(signed, str(tmp_path), gate)
+                assert t.metainfo.info_hash in c.torrents
+                assert t.bitfield.complete  # payload on disk: full recheck
+            finally:
+                await c.close()
+
+        asyncio.run(asyncio.wait_for(go(), 60))
+
+
 class TestCliSign:
     def test_keygen_sign_info_check_tamper(self, tmp_path, capsys):
         from torrent_tpu.tools.cli import main

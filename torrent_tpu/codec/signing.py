@@ -149,6 +149,23 @@ def list_signers(data: bytes) -> list[str]:
     return out
 
 
+def ensure_signed(data: bytes, signer: str, pub: bytes) -> None:
+    """THE gate: raise ValueError unless ``signer``'s signature verifies
+    under the 32-byte trusted key. Every require-signed surface (library
+    ``add_torrent_bytes``, CLI download/update, feed auto-add) funnels
+    through here so the check — and its failure message — cannot drift.
+
+    ``pub`` is mandatory and validated: a missing/short key must never
+    silently degrade the gate to trusting the attacker-supplied embedded
+    certificate."""
+    if not isinstance(pub, bytes) or len(pub) != ED25519_PUB_LEN:
+        raise ValueError("trusted key must be 32 bytes (Ed25519 public key)")
+    if not verify_torrent(data, signer, pub):
+        raise ValueError(
+            f"no valid BEP 35 signature by {signer!r} under the trusted key"
+        )
+
+
 def has_embedded_certificate(data: bytes, signer: str) -> bool:
     """True when ``signer``'s entry carries a ``certificate`` field.
 

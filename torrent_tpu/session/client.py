@@ -673,6 +673,33 @@ class Client:
             torrent.resume_store.delete(torrent.metainfo.info_hash)
         return new_torrent
 
+    async def add_torrent_bytes(
+        self,
+        data: bytes,
+        storage: "Storage | StorageMethod | str",
+        require_signed: "tuple[str, bytes] | None" = None,
+        wanted_files: "list[int] | None" = None,
+    ) -> "Torrent":
+        """Parse raw .torrent bytes (v1 OR pure v2) and ``add`` them —
+        the library-level twin of the CLI's auto-detecting load path.
+
+        ``require_signed = (signer, trusted_pub)`` applies the BEP 35
+        gate on the RAW bytes before any parse result is trusted (the
+        same check ``download/update/feed --require-signed`` run);
+        refusal raises ValueError and nothing is registered.
+        """
+        if require_signed is not None:
+            from torrent_tpu.codec import signing
+
+            signer, pub = require_signed
+            signing.ensure_signed(data, signer, pub)
+        from torrent_tpu.codec.metainfo import parse_any_metainfo
+
+        parsed = parse_any_metainfo(data)
+        if parsed is None:
+            raise ValueError("not a valid .torrent (neither v1 nor v2)")
+        return await self.add(parsed[0], storage, wanted_files=wanted_files)
+
     async def add_hybrid(
         self, torrent_bytes: bytes, storage_dir: str
     ) -> "tuple[Torrent, Torrent]":

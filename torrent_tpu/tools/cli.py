@@ -364,13 +364,10 @@ def _cmd_update(args) -> int:
         # update-url takeover cannot push an unsigned replacement.
         from torrent_tpu.codec import signing
 
-        signer, pub = parsed_req
-        if not signing.verify_torrent(raw_out[0], signer, pub):
-            print(
-                f"error: refusing update from {url}: successor carries no "
-                f"valid BEP 35 signature by {signer!r} under the trusted key",
-                file=sys.stderr,
-            )
+        try:
+            signing.ensure_signed(raw_out[0], *parsed_req)
+        except ValueError as e:
+            print(f"error: refusing update from {url}: {e}", file=sys.stderr)
             return 2
     if args.check:
         print(f"update available: {name!r} at {url}")
@@ -966,9 +963,6 @@ async def _download(args) -> int:
             print("fetching metadata from swarm...", file=sys.stderr)
             torrent = await client.add_magnet(args.source, args.dir)
         else:
-            from torrent_tpu.codec.metainfo import parse_metainfo
-            from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
-
             with open(args.source, "rb") as f:
                 data = f.read()
             req = getattr(args, "require_signed", None)
@@ -978,19 +972,17 @@ async def _download(args) -> int:
                 parsed_req = _parse_require_signed(req)
                 if parsed_req is None:
                     return 2
-                signer, pub = parsed_req
-                if not signing.verify_torrent(data, signer, pub):
-                    print(
-                        f"error: refusing {args.source!r}: no valid BEP 35 "
-                        f"signature by {signer!r} under the trusted key",
-                        file=sys.stderr,
-                    )
+                try:
+                    signing.ensure_signed(data, *parsed_req)
+                except ValueError as e:
+                    print(f"error: refusing {args.source!r}: {e}",
+                          file=sys.stderr)
                     return 2
-            m = parse_metainfo(data) or parse_metainfo_v2(data)
-            if m is None:
-                print("error: not a valid .torrent file", file=sys.stderr)
+            try:
+                torrent = await client.add_torrent_bytes(data, args.dir)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
                 return 1
-            torrent = await client.add(m, args.dir)
         if args.files:
             try:
                 wanted = sorted({int(x) for x in args.files.split(",")})

@@ -218,12 +218,10 @@ class FeedPoller:
         if self.require_signed is not None:
             from torrent_tpu.codec import signing
 
-            signer, pub = self.require_signed
-            if not signing.verify_torrent(raw, signer, pub):
-                raise FeedError(
-                    f"{item.url} refused: no valid BEP 35 signature by "
-                    f"{signer!r} under the trusted key"
-                )
+            try:
+                signing.ensure_signed(raw, *self.require_signed)
+            except ValueError as e:
+                raise FeedError(f"{item.url} refused: {e}") from e
         from torrent_tpu.codec.metainfo import parse_any_metainfo
 
         parsed = parse_any_metainfo(raw)

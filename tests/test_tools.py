@@ -19,6 +19,21 @@ def run(coro, timeout=60):
     return asyncio.run(asyncio.wait_for(coro, timeout))
 
 
+def test_doctor_passes_on_this_host(capsys):
+    """`torrent-tpu doctor --skip-swarm`: deps, kernels, native engine,
+    and bridge all healthy in the test environment (the swarm smoke is
+    the sibling e2e suites' job; device probe may WARN on CPU)."""
+    from torrent_tpu.tools.doctor import main
+
+    rc = main(["--device-wait", "10", "--skip-swarm"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "[PASS]  sha1 plane" in out
+    assert "[PASS]  sha256 plane" in out
+    assert "[PASS]  bridge" in out
+    assert "0 FAIL" in out
+
+
 def test_netbench_runs_from_any_cwd(tmp_path):
     """netbench resolves its test-harness imports relative to its own
     file, so the documented `python -m torrent_tpu.tools.netbench` works

@@ -840,6 +840,15 @@ def _cmd_sign(args) -> int:
     return 0
 
 
+def _cmd_doctor(args) -> int:
+    from torrent_tpu.tools.doctor import main as doctor_main
+
+    argv = ["--device-wait", str(args.device_wait)]
+    if args.skip_swarm:
+        argv.append("--skip-swarm")
+    return doctor_main(argv)
+
+
 def _cmd_edit(args) -> int:
     """Rewrite a .torrent's top-level fields without touching the info
     dict: the infohash (and thus the swarm) is preserved byte-for-byte,
@@ -1388,6 +1397,13 @@ def build_parser() -> argparse.ArgumentParser:
         "/<infohash>/<file> streams (0 = ephemeral)",
     )
     sp.set_defaults(fn=_cmd_seed)
+
+    sp = sub.add_parser(
+        "doctor", help="environment triage: deps, device, kernels, swarm smoke"
+    )
+    sp.add_argument("--device-wait", type=float, default=20.0)
+    sp.add_argument("--skip-swarm", action="store_true")
+    sp.set_defaults(fn=_cmd_doctor)
 
     sp = sub.add_parser("tracker", help="run the in-memory tracker server")
     sp.add_argument("--http-port", type=int, default=8080)

@@ -258,6 +258,41 @@ class TestPieceLossSelfHealing:
 
         run(go())
 
+    def test_endgame_enters_only_at_the_tail(self):
+        """Mid-download contention (every peer-visible block requested
+        ELSEWHERE) must not trip endgame — that floods the swarm with a
+        cancel broadcast per block; at a genuine tail it must."""
+
+        async def go():
+            t, m, _ = make_torrent_with_store(
+                None, payload_len=32768 * 24, piece_len=32768,
+                write_payload=False,
+            )
+            peer = make_peer(m.info.num_pieces)
+            peer.peer_choking = False
+            for i in range(m.info.num_pieces):
+                peer.bitfield.set(i)
+            t.peers[peer.peer_id] = peer
+            # every block is in flight on some OTHER connection
+            for i in range(m.info.num_pieces):
+                for blk in t._blocks_of(i):
+                    t._inflight_add(blk)
+            await t._fill_pipeline(peer)
+            assert not t._endgame  # 24 wanted pieces: contention, not tail
+            assert not peer.inflight
+            assert peer.fill_starved
+
+            # now a genuine tail: all but 2 pieces verified
+            for i in range(m.info.num_pieces - 2):
+                t.bitfield.set(i)
+            t._recount_wanted()
+            peer.fill_starved = False
+            await t._fill_pipeline(peer)
+            assert t._endgame  # duplication kicks in
+            assert peer.inflight  # duplicated requests issued
+
+        run(go())
+
     def test_lost_piece_is_idempotent(self):
         async def go():
             t, m, _ = make_torrent_with_store(None)

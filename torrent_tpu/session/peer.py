@@ -30,6 +30,12 @@ class PeerConnection:
     fast: bool = False
     # pieces we granted this peer (it may request them while we choke it)
     allowed_fast_out: set[int] = field(default_factory=set)
+    # _fill_pipeline contention memo: True when the last full pick scan
+    # could not fill this peer's budget; with a non-empty pipeline the
+    # next scan is then deferred up to 50 ms (see the gate in
+    # _fill_pipeline) instead of re-running per received block
+    fill_starved: bool = False
+    last_fill_at: float = 0.0
     # pieces the peer granted us (requestable while it chokes us)
     allowed_fast_in: set[int] = field(default_factory=set)
     # subset of ``inflight`` that was requested while choked (under an

@@ -570,9 +570,28 @@ class Torrent:
         return self._wanted_missing
 
     def _recount_wanted(self) -> None:
+        prev = getattr(self, "_wanted_missing", 0)
         self._wanted_missing = int(
             ((~self.bitfield.as_numpy()) & (self._piece_priority > 0)).sum()
         )
+        if (
+            self._endgame
+            and self._wanted_missing > prev
+            and self._wanted_missing > self._tail_threshold()
+        ):
+            # wants GREW mid-endgame (piece lost, selection widened):
+            # this is no longer a tail — duplication would flood.
+            # Outstanding duplicates still cancel on arrival: the cancel
+            # broadcast keys on remaining in-flight copies, not on the
+            # endgame flag.
+            self._endgame = False
+
+    def _tail_threshold(self) -> int:
+        """Wanted-piece count at or below which endgame duplication is
+        worth its cancel traffic — shared by the entry (_fill_pipeline)
+        and exit (_recount_wanted) gates so they cannot drift apart and
+        flap."""
+        return max(8, 2 * len(self.peers))
 
     async def start(self) -> None:
         """Resume from checkpoint or recheck existing data, then join."""
@@ -1997,6 +2016,21 @@ class Torrent:
         budget = self.config.pipeline_depth - len(peer.inflight)
         if budget <= 0:
             return
+        if (
+            not self._endgame
+            and peer.fill_starved
+            and peer.inflight
+            and time.monotonic() - peer.last_fill_at < 0.05
+        ):
+            # The last full scan could NOT fill this peer's budget (the
+            # swarm is contended around it) and it ran <50 ms ago with
+            # the pipeline still non-empty: skip the O(pieces) rescan.
+            # In an 8-leech mesh the per-block hysteresis otherwise
+            # re-runs a ~150 us scan at line rate for ~1-block yields —
+            # measured as the top CPU cost of a fanout. Uncontended
+            # peers (full-budget picks) and empty pipelines never wait.
+            return
+        peer.last_fill_at = time.monotonic()
         # direct bool-array views for the scan loops: Bitfield.has() is a
         # bounds-checked method call, and a deep rarity scan makes tens of
         # millions of them per fanout transfer (measured ~20% of seed-side
@@ -2109,6 +2143,21 @@ class Torrent:
                 # The choked-fast path must never trip global endgame:
                 # "every granted piece is busy elsewhere" says nothing
                 # about the swarm as a whole.
+                peer.fill_starved = True
+                return
+            if self._wanted_remaining() > self._tail_threshold():
+                # Everything THIS peer can see is requested somewhere,
+                # but the download is nowhere near its tail — that is
+                # CONTENTION, not endgame. Entering endgame here floods
+                # the swarm: every received block then broadcasts
+                # cancels and re-runs eager refills (measured in an
+                # 8-leech mesh: mid-download endgame entry put a cancel
+                # broadcast plus an O(pieces) scan behind every block).
+                # Mark starved; the 50 ms gate paces the rescans.
+                # (Checked BEFORE building `remaining` — the contended
+                # path must not pay the O(missing x blocks) comprehension
+                # it is about to discard.)
+                peer.fill_starved = True
                 return
             # Endgame: everything missing is in flight somewhere — duplicate
             # requests so one slow peer can't stall completion.
@@ -2122,11 +2171,13 @@ class Torrent:
                 if blk not in peer.inflight
             ]
             if not remaining:
+                peer.fill_starved = True
                 return
             self._endgame = True
             random.shuffle(remaining)
             wanted = remaining[:budget]
 
+        peer.fill_starved = len(wanted) < budget
         if not peer.inflight:
             # fresh pipeline: restart the snub clock so an idle-but-honest
             # peer isn't condemned for the time it spent choked
@@ -2193,8 +2244,15 @@ class Torrent:
         )
         self.downloaded += len(block)
 
-        if self._endgame:
-            await self._cancel_everywhere((index, begin, len(block)), except_peer=peer)
+        blk_key = (index, begin, len(block))
+        if self._endgame or self._inflight_count[blk_key] > 0:
+            # other copies of this block are still in flight (endgame
+            # duplication — possibly from an endgame that has since been
+            # exited): cancel them on arrival. Keyed on the live
+            # duplicate count, not the flag, so no copy is ever
+            # downloaded redundantly to completion; outside endgame the
+            # count is 0 and this costs one dict lookup.
+            await self._cancel_everywhere(blk_key, except_peer=peer)
 
         if partial.complete:
             await self._finish_piece(partial)

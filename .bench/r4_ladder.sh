@@ -88,7 +88,7 @@ for attempt in $(seq 1 200); do
   else
     echo "r4 ladder attempt=$attempt probe failed $(date -u)"
   fi
-  sleep 300
+  sleep 150
 done
 # after-phase: SHA-256 leaf-kernel sweep + one tuned v2 rung (next-#3)
 for attempt in $(seq 1 48); do

@@ -39,9 +39,18 @@ class TrackerList:
     """Tiered tracker rotation state for one torrent."""
 
     def __init__(
-        self, announce_url: str, tiers: list[list[str]] | None = None, proxy=None
+        self,
+        announce_url: str,
+        tiers: list[list[str]] | None = None,
+        proxy=None,
+        dns_prefs=None,
     ):
         self.proxy = proxy  # net.socks.ProxySpec | None, forwarded per call
+        # BEP 34 (net/dnsprefs.TrackerPrefs | None): when set, each URL is
+        # expanded through the host's published DNS tracker preferences
+        # right before the announce attempt (deny = skip; no record =
+        # announce as written; resolver trouble fails open)
+        self.dns_prefs = dns_prefs
         if tiers:
             self.tiers = [[u for u in t if u] for t in tiers]
             self.tiers = [t for t in self.tiers if t]
@@ -83,15 +92,23 @@ class TrackerList:
         """
         last_err: Exception | None = None
         for tier, url in self.urls():
-            try:
-                res = await asyncio.wait_for(
-                    announce(url, info, proxy=self.proxy), per_tracker_timeout
-                )
-            except (TrackerError, OSError, asyncio.TimeoutError) as e:
-                # any single-tracker failure must not abort the rotation
-                log.debug("tracker %s failed: %s", url, e)
-                last_err = e
-                continue
-            self.promote(tier, url)
-            return res
+            candidates = [url]
+            if self.dns_prefs is not None:
+                candidates = await self.dns_prefs.apply(url)
+                if not candidates:
+                    log.debug("tracker %s skipped (BEP 34 deny)", url)
+                    continue
+            for target in candidates:
+                try:
+                    res = await asyncio.wait_for(
+                        announce(target, info, proxy=self.proxy),
+                        per_tracker_timeout,
+                    )
+                except (TrackerError, OSError, asyncio.TimeoutError) as e:
+                    # any single-tracker failure must not abort the rotation
+                    log.debug("tracker %s failed: %s", target, e)
+                    last_err = e
+                    continue
+                self.promote(tier, url)
+                return res
         raise TrackerError(f"all trackers failed; last error: {last_err}")

@@ -67,6 +67,10 @@ class ClientConfig:
     max_upload_bps: int = 0
     max_download_bps: int = 0
     enable_lsd: bool = False  # BEP 14 local service discovery (net/lsd.py)
+    # BEP 34 DNS tracker preferences: expand each announce URL through
+    # the host's published TXT record (deny/port/protocol hints) before
+    # announcing; resolver trouble fails open. Off by default.
+    dns_tracker_prefs: bool = False
     # BEP 29 uTP transport (net/utp.py): accept uTP peers on the same
     # port (UDP) and prefer uTP for outbound dials, TCP fallback
     enable_utp: bool = False
@@ -128,6 +132,23 @@ class Client:
                 )
         else:
             self.proxy = None
+        self.dns_prefs = None  # net.dnsprefs.TrackerPrefs when enabled
+        if self.config.dns_tracker_prefs:
+            if self.proxy is not None:
+                # the TXT lookup is raw UDP from THIS host: under a SOCKS
+                # proxy it would leak tracker hostnames around the tunnel
+                # the user configured for exactly that traffic — and a
+                # UDP-only preference record would route announces onto a
+                # transport the proxy cannot carry. Fail safe: disabled.
+                log.warning(
+                    "dns_tracker_prefs disabled: BEP 34 lookups would "
+                    "bypass the SOCKS proxy"
+                )
+            else:
+                from torrent_tpu.net.dnsprefs import TrackerPrefs
+
+                # one shared cache for every torrent's tracker rotation
+                self.dns_prefs = TrackerPrefs()
 
     async def __aenter__(self) -> "Client":
         try:
@@ -406,6 +427,7 @@ class Client:
             utp_dial=self.utp.dial if self.utp is not None else None,
             ip_filter=self.ip_filter,
             proxy=self.proxy,
+            dns_prefs=self.dns_prefs,
         )
         self.torrents[metainfo.info_hash] = torrent
         if wanted_files is not None:

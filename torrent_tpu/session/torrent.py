@@ -162,6 +162,7 @@ class Torrent:
         utp_dial=None,  # optional BEP 29 dialer: async (host, port) -> streams
         ip_filter=None,  # optional net.ipfilter.IpFilter (client-global)
         proxy=None,  # optional net.socks.ProxySpec: TCP dials + HTTP trackers
+        dns_prefs=None,  # optional net.dnsprefs.TrackerPrefs (BEP 34)
     ):
         from torrent_tpu.net.multitracker import TrackerList, parse_announce_list
 
@@ -186,7 +187,10 @@ class Torrent:
         self.ip_filter = ip_filter
         self.proxy = proxy
         self.trackers = TrackerList(
-            metainfo.announce, parse_announce_list(metainfo.raw), proxy=proxy
+            metainfo.announce,
+            parse_announce_list(metainfo.raw),
+            proxy=proxy,
+            dns_prefs=dns_prefs,
         )
 
         # BEP 52 pure-v2 torrent (session/v2.py): 32-byte merkle piece

@@ -79,6 +79,18 @@ for attempt in $(seq 1 200); do
     rung .bench/cfg4.json BENCH_CONFIG=headline BENCH_PIECE_KB=1024 \
          BENCH_TOTAL_MB=102400 BENCH_BATCH=4096 BENCH_NBATCH=2 \
          BENCH_DISPATCHES=6 BENCH_E2E_MB=2048 BENCH_TPU_WAIT=7200
+    # rungs 6-8 — the remaining BASELINE configs, re-banked under the
+    # median-of-N contract (they only run once everything above banked,
+    # and skip forever once banked themselves)
+    if banked .bench/cfg4.json; then
+      rung .bench/cfg2_final.json BENCH_CONFIG=multifile BENCH_TOTAL_MB=1024 \
+           BENCH_NBATCH=2 BENCH_DISPATCHES=8 BENCH_TPU_WAIT=3600
+      rung .bench/cfg3_final.json BENCH_CONFIG=author BENCH_TOTAL_MB=1024 \
+           BENCH_NBATCH=2 BENCH_DISPATCHES=8 BENCH_TPU_WAIT=3600
+      rung .bench/cfg5_final.json BENCH_CONFIG=bulk BENCH_BULK_N=8 \
+           BENCH_TOTAL_MB=512 BENCH_NBATCH=2 BENCH_DISPATCHES=8 \
+           BENCH_TPU_WAIT=3600
+    fi
     if banked .bench/cfg4.json && banked .bench/cfgv2c.json \
        && banked .bench/headline_final.json; then
       echo "=== r4 ladder complete $(date -u)"

@@ -92,7 +92,8 @@ for attempt in $(seq 1 200); do
            BENCH_TPU_WAIT=3600
     fi
     if banked .bench/cfg4.json && banked .bench/cfgv2c.json \
-       && banked .bench/headline_final.json; then
+       && banked .bench/headline_final.json && banked .bench/cfg2_final.json \
+       && banked .bench/cfg3_final.json && banked .bench/cfg5_final.json; then
       echo "=== r4 ladder complete $(date -u)"
       break
     fi
@@ -117,7 +118,7 @@ import json
 try:
     rec = json.loads(open(".bench/tune_sha256.jsonl").read().strip().splitlines()[-1])
     b = rec["best"]
-    print(f"{b['tile_sub']} {b['unroll']}")
+    print(f"{b['tile_sub']} {b['unroll']} {1 if b.get('full_unroll') else 0}")
 except Exception:
     print("")
 PY
@@ -125,7 +126,8 @@ PY
     if [ -n "$ts" ]; then
       set -- $ts
       rung .bench/cfgv2d.json TORRENT_TPU_SHA256_TILE_SUB="$1" \
-           TORRENT_TPU_SHA256_UNROLL="$2" BENCH_CONFIG=v2 \
+           TORRENT_TPU_SHA256_UNROLL="$2" \
+           TORRENT_TPU_SHA256_FULL_UNROLL="$3" BENCH_CONFIG=v2 \
            BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=3600
     fi
   else

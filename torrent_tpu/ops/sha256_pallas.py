@@ -41,7 +41,16 @@ from torrent_tpu.utils.env import env_int
 # otherwise on the real chip.
 TILE_SUB = env_int("TORRENT_TPU_SHA256_TILE_SUB", _SHA1_TILE_SUB)
 UNROLL = env_int("TORRENT_TPU_SHA256_UNROLL", _SHA1_UNROLL)
-_check_tiling(TILE_SUB, UNROLL)
+# Straight-line 64-round body (the SHA-1 kernel's shape) instead of the
+# fori_loop-over-groups one. OFF by default: the unrolled graph hangs
+# the XLA *CPU* compiler's algebraic simplifier (measured: >300 s, the
+# documented circular-rewrite trap), so it cannot run — or be validated
+# — in interpret mode; Mosaic compiles through a different pipeline
+# where straight-line code is exactly what the SHA-1 kernel already
+# ships. tools/tune_sha256 A/B-tests it on the real chip (golden-checked
+# there); interpret mode always falls back to the loop body.
+FULL_UNROLL = bool(env_int("TORRENT_TPU_SHA256_FULL_UNROLL", 0))
+_check_tiling(TILE_SUB, UNROLL)  # bad env knobs fail at import, not mid-bench
 
 
 def _one_block256(state, w, kc_ref):
@@ -71,7 +80,25 @@ def _one_block256(state, w, kc_ref):
     return tuple(s + n for s, n in zip(state, new))
 
 
-def _sha256_kernel(words_ref, nblocks_ref, kc_ref, state_ref, *, unroll: int, tile_sub: int):
+def _one_block256_unrolled(state, w):
+    """Straight-line 64-round compression with immediate K constants —
+    no loop-carried 24-vreg tuple, no SMEM K loads, full cross-round
+    scheduling freedom for Mosaic. NEVER reached under interpret (see
+    FULL_UNROLL above)."""
+    vars8 = state
+    for t in range(64):
+        if t < 16:
+            wt = w[t]
+        else:
+            wt = _schedule_step(w, t % 16)
+            w[t % 16] = wt
+        vars8 = _round(vars8, wt, np.uint32(_K256[t]))
+    return tuple(s + n for s, n in zip(state, vars8))
+
+
+def _sha256_kernel(
+    words_ref, nblocks_ref, kc_ref, state_ref, *, unroll: int, tile_sub: int, full: bool
+):
     k = pl.program_id(1)
 
     @pl.when(k == 0)
@@ -83,7 +110,10 @@ def _sha256_kernel(words_ref, nblocks_ref, kc_ref, state_ref, *, unroll: int, ti
 
     def body(j, state):
         w = [words_ref[0, j, t] for t in range(16)]
-        new = _one_block256(state, w, kc_ref)
+        if full:
+            new = _one_block256_unrolled(state, w)
+        else:
+            new = _one_block256(state, w, kc_ref)
         keep = k * unroll + j < nblocks
         return tuple(jnp.where(keep, n, o) for n, o in zip(new, state))
 
@@ -96,8 +126,10 @@ def _sha256_kernel(words_ref, nblocks_ref, kc_ref, state_ref, *, unroll: int, ti
         state_ref[0, i] = state[i]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "tile_sub", "unroll"))
-def _sha256_pallas_aligned(data, nblocks, interpret, tile_sub, unroll):
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "tile_sub", "unroll", "full_unroll")
+)
+def _sha256_pallas_aligned(data, nblocks, interpret, tile_sub, unroll, full_unroll):
     tile = tile_sub * TILE_LANE
     b = data.shape[0]
     if data.dtype == jnp.uint32:
@@ -116,7 +148,14 @@ def _sha256_pallas_aligned(data, nblocks, interpret, tile_sub, unroll):
     kc = jnp.asarray(np.array(_K256[16:], dtype=np.uint32).reshape(3, 16))
 
     call = pl.pallas_call(
-        functools.partial(_sha256_kernel, unroll=unroll, tile_sub=tile_sub),
+        functools.partial(
+            _sha256_kernel,
+            unroll=unroll,
+            tile_sub=tile_sub,
+            # interpret lowers through XLA CPU, whose simplifier hangs on
+            # the straight-line body — the loop body is mandatory there
+            full=bool(full_unroll) and not interpret,
+        ),
         grid=(1, nblk // unroll),
         in_specs=[
             pl.BlockSpec(
@@ -153,6 +192,7 @@ def sha256_pieces_pallas(
     interpret: bool | None = None,
     tile_sub: int | None = None,
     unroll: int | None = None,
+    full_unroll: bool | None = None,
 ) -> jax.Array:
     """Batched SHA-256 via Pallas; pads the batch to a tile multiple."""
     from torrent_tpu.ops.sha1_pallas import _auto_interpret
@@ -161,6 +201,7 @@ def sha256_pieces_pallas(
         interpret = _auto_interpret()
     ts = TILE_SUB if tile_sub is None else tile_sub
     un = UNROLL if unroll is None else unroll
+    fu = FULL_UNROLL if full_unroll is None else full_unroll
     _check_tiling(ts, un)
     tile = ts * TILE_LANE
     b = data.shape[0]
@@ -168,5 +209,5 @@ def sha256_pieces_pallas(
     if bp != b:
         data = jnp.pad(data, ((0, bp - b), (0, 0)))
         nblocks = jnp.pad(nblocks, (0, bp - b))
-    out = _sha256_pallas_aligned(data, nblocks, interpret, ts, un)
+    out = _sha256_pallas_aligned(data, nblocks, interpret, ts, un, fu)
     return out[:b]

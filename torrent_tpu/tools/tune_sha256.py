@@ -96,6 +96,11 @@ def run_sweep(
     nblocks = jnp.full((batch,), nblk, dtype=jnp.int32)
 
     results = []
+    # the straight-line 64-round body (full_unroll) can only compile on
+    # real Mosaic — interpret mode would hang the XLA CPU simplifier —
+    # and has no off-chip validation, so it is swept as an EXTRA
+    # candidate with golden mismatches recorded, never fatal
+    variants = [False] if interpret else [False, True]
     for tile_sub, unroll in grid:
         if batch % (tile_sub * 128):
             print(
@@ -104,56 +109,65 @@ def run_sweep(
                 file=sys.stderr,
             )
             continue
+      # fall through to the per-variant loop below
 
-        @jax.jit
-        def hash_salted(r, t, nb, salt, _ts=tile_sub, _un=unroll):
-            data = jnp.concatenate(
-                [r ^ salt, jnp.broadcast_to(t, (batch, t.shape[0]))], axis=1
-            )
-            return sp.sha256_pieces_pallas(
-                data, nb, interpret=interpret, tile_sub=_ts, unroll=_un
-            )
+        for full in variants:
 
-        reduce_sum = jax.jit(lambda s: jnp.sum(s, dtype=jnp.uint32))
+            @jax.jit
+            def hash_salted(r, t, nb, salt, _ts=tile_sub, _un=unroll, _fu=full):
+                data = jnp.concatenate(
+                    [r ^ salt, jnp.broadcast_to(t, (batch, t.shape[0]))], axis=1
+                )
+                return sp.sha256_pieces_pallas(
+                    data, nb, interpret=interpret, tile_sub=_ts, unroll=_un,
+                    full_unroll=_fu,
+                )
 
-        try:
+            reduce_sum = jax.jit(lambda s: jnp.sum(s, dtype=jnp.uint32))
+            tag = {"tile_sub": tile_sub, "unroll": unroll, "full_unroll": full}
+
+            try:
+                t0 = time.perf_counter()
+                state0 = hash_salted(rand, tail_dev, nblocks, jnp.uint32(0))
+                got = np.asarray(state0[np.array([0, batch - 1])])
+                compile_s = time.perf_counter() - t0
+            except Exception as e:  # Mosaic can reject a tiling outright
+                print(json.dumps({**tag, "error": repr(e)[:200]}))
+                continue
+            bad = False
+            for row, idx in ((0, 0), (1, batch - 1)):
+                want = np.frombuffer(golden[idx], dtype=">u4").astype(np.uint32)
+                if not np.array_equal(got[row], want):
+                    if full:
+                        # the experimental body failed its on-chip golden:
+                        # record and move on — never poison the sweep
+                        print(json.dumps({**tag, "error": "golden mismatch"}))
+                        bad = True
+                        break
+                    raise SystemExit(
+                        f"golden mismatch at {tile_sub}x{unroll} row {idx}: "
+                        f"{got[row]} != {want}"
+                    )
+            if bad:
+                continue
+            _ = int(reduce_sum(state0))  # warm the completion-forcing reduction
+
             t0 = time.perf_counter()
-            state0 = hash_salted(rand, tail_dev, nblocks, jnp.uint32(0))
-            got = np.asarray(state0[np.array([0, batch - 1])])
-            compile_s = time.perf_counter() - t0
-        except Exception as e:  # Mosaic can reject a tiling outright
-            print(
-                json.dumps(
-                    {"tile_sub": tile_sub, "unroll": unroll, "error": repr(e)[:200]}
-                )
-            )
-            continue
-        for row, idx in ((0, 0), (1, batch - 1)):
-            want = np.frombuffer(golden[idx], dtype=">u4").astype(np.uint32)
-            if not np.array_equal(got[row], want):
-                raise SystemExit(
-                    f"golden mismatch at {tile_sub}x{unroll} row {idx}: "
-                    f"{got[row]} != {want}"
-                )
-        _ = int(reduce_sum(state0))  # warm the completion-forcing reduction
-
-        t0 = time.perf_counter()
-        outs = [
-            hash_salted(rand, tail_dev, nblocks, jnp.uint32(s))
-            for s in range(1, iters + 1)
-        ]
-        _ = int(reduce_sum(outs[-1]))
-        secs = time.perf_counter() - t0
-        bps = iters * batch / secs
-        line = {
-            "tile_sub": tile_sub,
-            "unroll": unroll,
-            "blocks_per_sec": round(bps, 1),
-            "gib_per_sec": round(bps * mlen / 2**30, 2),
-            "compile_s": round(compile_s, 1),
-        }
-        results.append(line)
-        print(json.dumps(line), flush=True)
+            outs = [
+                hash_salted(rand, tail_dev, nblocks, jnp.uint32(s))
+                for s in range(1, iters + 1)
+            ]
+            _ = int(reduce_sum(outs[-1]))
+            secs = time.perf_counter() - t0
+            bps = iters * batch / secs
+            line = {
+                **tag,
+                "blocks_per_sec": round(bps, 1),
+                "gib_per_sec": round(bps * mlen / 2**30, 2),
+                "compile_s": round(compile_s, 1),
+            }
+            results.append(line)
+            print(json.dumps(line), flush=True)
 
     if results:
         best = max(results, key=lambda r: r["blocks_per_sec"])

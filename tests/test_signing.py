@@ -149,6 +149,35 @@ class TestSignVerify:
         )
         assert entry_wire in resigned  # foreign entry preserved verbatim
 
+    def test_hybrid_torrent_signs_and_keeps_both_identities(self, tmp_path):
+        """Signing a BEP 52 hybrid (v1+v2 in one info dict) preserves
+        both parsed identities byte-for-byte and verifies."""
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+
+        rng = np.random.default_rng(27)
+        src = tmp_path / "h"
+        src.mkdir()
+        (src / "a.bin").write_bytes(
+            rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
+        )
+        from torrent_tpu.models.v2 import build_hybrid
+
+        data, _ = build_hybrid(
+            [(("a.bin",), (src / "a.bin").read_bytes())],
+            name="h",
+            piece_length=16384,
+            hasher="cpu",
+            announce=ANNOUNCE,
+        )
+        m1, m2 = parse_metainfo(data), parse_metainfo_v2(data)
+        assert m1 is not None and m2 is not None
+        signed = signing.sign_torrent(data, SEED_A, "publisher")
+        assert signing.verify_torrent(signed, "publisher")
+        s1, s2 = parse_metainfo(signed), parse_metainfo_v2(signed)
+        assert s1.info_hash == m1.info_hash
+        assert s2.info_hash_v2 == m2.info_hash_v2
+
     def test_garbage_inputs(self):
         assert signing.list_signers(b"not bencode") == []
         assert not signing.verify_torrent(b"not bencode", "x")

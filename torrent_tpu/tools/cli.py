@@ -846,6 +846,8 @@ def _cmd_doctor(args) -> int:
     argv = ["--device-wait", str(args.device_wait)]
     if args.skip_swarm:
         argv.append("--skip-swarm")
+    if getattr(args, "json", False):
+        argv.append("--json")
     return doctor_main(argv)
 
 
@@ -1403,6 +1405,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("--device-wait", type=float, default=20.0)
     sp.add_argument("--skip-swarm", action="store_true")
+    sp.add_argument("--json", action="store_true",
+                    help="emit a machine-readable JSON summary line")
     sp.set_defaults(fn=_cmd_doctor)
 
     sp = sub.add_parser("tracker", help="run the in-memory tracker server")

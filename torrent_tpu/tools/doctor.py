@@ -280,11 +280,38 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--skip-swarm", action="store_true", help="skip the loopback swarm smoke"
     )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="emit one JSON object after the checks (machine-readable)",
+    )
     args = ap.parse_args(argv)
+
+    def emit_json() -> None:
+        if not args.json:
+            return
+        import json
+
+        fails = sum(1 for s, _, _ in _RESULTS if s == "FAIL")
+        warns = sum(1 for s, _, _ in _RESULTS if s == "WARN")
+        print(
+            json.dumps(
+                {
+                    "ok": fails == 0,
+                    "fails": fails,
+                    "warns": warns,
+                    "checks": [
+                        {"status": s, "name": n, "detail": d}
+                        for s, n, d in _RESULTS
+                    ],
+                }
+            )
+        )
 
     _RESULTS.clear()  # main() may run more than once per process (tests)
     if not _check_deps():
         print("\n1 FAIL — core dependencies missing")
+        emit_json()  # the broken-environment case is where JSON matters most
         return 1
     _check_device(args.device_wait)
     _check_kernels()
@@ -305,6 +332,7 @@ def main(argv=None) -> int:
     fails = sum(1 for s, _, _ in _RESULTS if s == "FAIL")
     warns = sum(1 for s, _, _ in _RESULTS if s == "WARN")
     print(f"\n{len(_RESULTS)} checks: {fails} FAIL, {warns} WARN")
+    emit_json()
     return 1 if fails else 0
 
 

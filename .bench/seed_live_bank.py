@@ -1,0 +1,83 @@
+"""Seed `.bench/live/` with the best REAL on-device records already in
+the repo, provenance-labeled.
+
+Round-4 verdict next #1: four consecutive driver snapshots were
+`value: null, status: tpu_unavailable` because the bank-and-replay
+machinery (bench.py `_bank`/`_maybe_replay`) can only be fed by a
+post-contract on-device run — and the tunnel granted zero windows in
+rounds 3-4. Meanwhile four genuine `platform: tpu` records measured
+2026-07-30 (round 2, commits 558eeac/bbdba8c) sit in `.bench/` unread
+by the driver. A provenance-labeled replay of a real measurement is
+strictly more honest than a null, so: copy those records into the bank
+with explicit fields —
+
+  provenance: r2_banked_record       (surfaces in the replay status)
+  measured_at_utc: 2026-07-30T..Z    (the on-device commit time)
+  pre_median_contract: true          (no batch/n_runs/runs_pps/spread)
+
+The `_bank` best-record rule keys on (batch, value); seeded records
+carry no `batch` (pre-contract), so the FIRST post-contract on-device
+run of any metric replaces its seed at the stable name automatically.
+Failure markers are untouched: `_maybe_replay` only ever fires on
+`status: tpu_unavailable`, never on a bench that failed ON the device.
+
+Idempotent; safe to re-run. Run from anywhere:
+    python .bench/seed_live_bank.py
+"""
+
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+# BENCH_BANK_DIR: same override bench.py honors, so tests can seed a
+# hermetic tmp bank instead of the round's real one
+LIVE = os.environ.get("BENCH_BANK_DIR") or os.path.join(HERE, "live")
+
+# source file -> on-device measurement time (the commit that recorded it)
+SOURCES = {
+    "headline_r2c.json": "2026-07-30T07:10:51Z",
+    "cfg2.json": "2026-07-30T08:05:10Z",
+    "cfg3.json": "2026-07-30T08:05:10Z",
+    "cfg5.json": "2026-07-30T08:05:10Z",
+    "cfgv2b.json": "2026-07-30T08:05:10Z",
+}
+
+
+def main() -> None:
+    os.makedirs(LIVE, exist_ok=True)
+    for name, measured in SOURCES.items():
+        path = os.path.join(HERE, name)
+        if not os.path.exists(path):
+            print(f"# skip {name}: missing")
+            continue
+        with open(path) as f:
+            rec = json.load(f)
+        if rec.get("platform") != "tpu" or not rec.get("value"):
+            print(f"# skip {name}: not a real on-device record")
+            continue
+        metric = rec["metric"]
+        stable = os.path.join(LIVE, f"{metric}.json")
+        if os.path.exists(stable):
+            with open(stable) as f:
+                prev = json.load(f)
+            # never clobber anything already banked by a live run (seeds
+            # have no `batch`; any post-contract record carries one)
+            if (prev.get("batch") or 0, prev.get("value") or 0) >= (
+                rec.get("batch") or 0,
+                rec.get("value") or 0,
+            ):
+                print(f"# keep existing bank for {metric}")
+                continue
+        rec["provenance"] = "r2_banked_record"
+        rec["banked_at_utc"] = measured
+        rec["pre_median_contract"] = True
+        rec["source_file"] = f".bench/{name}"
+        tmp = stable + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, stable)
+        print(f"seeded {metric} <- {name} ({rec['value']} {rec['unit']})")
+
+
+if __name__ == "__main__":
+    main()

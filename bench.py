@@ -53,10 +53,14 @@ Bank-and-replay: every successful on-device record is banked to
 `.bench/live/<metric>.json` (best value kept, timestamped audit copies
 alongside). When the device is unavailable the wedge-safe parent, before
 printing its null marker, replays a banked live record for the same
-metric — clearly labeled ``status: replay_of_banked_live_record`` with
-both timestamps — so a snapshot taken while the tunnel is wedged still
-carries the real measurement made when it was not. BENCH_NO_REPLAY=1
-disables the replay (tests, strict-live runs).
+metric — labeled ``replayed: true`` plus a ``status`` naming the bank
+source (``replay_of_banked_live_record`` for same-session banks,
+``replay_of_<provenance>`` — e.g. ``replay_of_r2_banked_record`` — for
+records seeded by `.bench/seed_live_bank.py`) with both timestamps — so
+a snapshot taken while the tunnel is wedged still carries the real
+measurement made when it was not. Consumers wanting only same-snapshot
+measurements filter on ``replayed`` or set BENCH_NO_REPLAY=1 (tests,
+strict-live runs), which disables the replay entirely.
 
 BENCH_CONFIG selects the measured workload (BASELINE.md configs; every
 mode prints one JSON line):
@@ -977,8 +981,11 @@ def _maybe_replay(line: str, metric: str) -> str:
     return the banked record labeled as a replay; otherwise `line`.
 
     The replay keeps value/vs_baseline non-null (they ARE real on-device
-    measurements from this round) and carries both timestamps plus an
-    explicit status so no reader can mistake it for a fresh run.
+    measurements — banked either in this session or seeded from an
+    earlier round's records, as `provenance`/`measured_at_utc`/
+    `pre_median_contract` state) and carries both timestamps plus an
+    explicit status and `replayed: true` so no reader can mistake it
+    for a fresh run.
     """
     if os.environ.get("BENCH_NO_REPLAY"):
         return line
@@ -1005,7 +1012,17 @@ def _maybe_replay(line: str, metric: str) -> str:
         return line
     banked["measured_at_utc"] = banked.pop("banked_at_utc", None)
     banked["replayed_at_utc"] = _utcnow()
-    banked["status"] = "replay_of_banked_live_record"
+    # `replayed` is the machine-checkable marker (advisor r4 #4): any
+    # consumer that wants only same-snapshot measurements filters on it
+    # (or sets BENCH_NO_REPLAY=1) instead of having to parse `status`
+    banked["replayed"] = True
+    # a seeded record (e.g. `.bench/seed_live_bank.py` banking round-2's
+    # on-device measurements) carries its provenance into the status so
+    # the artifact says WHICH real measurement it is replaying
+    prov = banked.get("provenance")
+    banked["status"] = (
+        f"replay_of_{prov}" if prov else "replay_of_banked_live_record"
+    )
     banked["live_status"] = rec.get("status", "tpu_unavailable")
     banked["note_replay"] = (
         "live on-device measurement banked at measured_at_utc; the device "

@@ -302,6 +302,61 @@ def test_relay_timeout_replays_banked_record(tmp_path):
     assert rec["measured_at_utc"] == "2026-07-31T00:00:00Z"
 
 
+def test_seeded_r2_bank_replays_with_provenance(tmp_path, monkeypatch):
+    """`.bench/seed_live_bank.py` banks round-2's real on-device records
+    so the driver snapshot is non-null even when the tunnel never grants
+    (round-4 verdict next #1). The replay must carry the provenance in
+    its status plus the machine-checkable `replayed`/`pre_median_contract`
+    markers, and a post-contract live record must displace the seed."""
+    import bench
+
+    monkeypatch.setenv("BENCH_BANK_DIR", str(tmp_path))
+    monkeypatch.delenv("BENCH_NO_REPLAY", raising=False)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, ".bench", "seed_live_bank.py")],
+        env=dict(os.environ, BENCH_BANK_DIR=str(tmp_path)),
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    metric = "sha1_recheck_256KiB_pieces_per_sec"
+    null_line = bench._unavailable_record(metric)
+    out = json.loads(bench._maybe_replay(null_line, metric))
+    assert out["value"] == 137804.6 and out["vs_baseline"] == 24.11
+    assert out["status"] == "replay_of_r2_banked_record"
+    assert out["platform"] == "tpu"
+    assert out["replayed"] is True
+    assert out["pre_median_contract"] is True
+    assert out["measured_at_utc"] == "2026-07-30T07:10:51Z"
+    # all five BASELINE metrics seeded
+    assert len(list(tmp_path.glob("*.json"))) == 5
+    # re-seeding never clobbers (idempotent)...
+    proc2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, ".bench", "seed_live_bank.py")],
+        env=dict(os.environ, BENCH_BANK_DIR=str(tmp_path)),
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc2.returncode == 0 and "keep existing" in proc2.stdout
+    # ...and a post-contract on-device record (carries `batch`) displaces
+    # the seed at the stable name
+    bench._bank(
+        {
+            "metric": metric,
+            "value": 140000.0,
+            "unit": "pieces/s",
+            "vs_baseline": 24.5,
+            "platform": "tpu",
+            "batch": 8192,
+        }
+    )
+    out2 = json.loads(bench._maybe_replay(null_line, metric))
+    assert out2["value"] == 140000.0
+    assert out2["status"] == "replay_of_banked_live_record"
+
+
 def test_v2_record_carries_median_of_n_fields():
     proc = _run_bench(
         {

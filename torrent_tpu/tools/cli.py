@@ -841,14 +841,18 @@ def _cmd_sign(args) -> int:
 
 
 def _cmd_doctor(args) -> int:
-    from torrent_tpu.tools.doctor import main as doctor_main
+    # run_cli, not main: the triage tool must not run its checks inside
+    # an interpreter wired to the device plugin it is triaging — it
+    # re-execs with the axon pool var stripped (the bounded device-probe
+    # subprocess gets it back). See tools/doctor.py module docstring.
+    from torrent_tpu.tools.doctor import run_cli as doctor_cli
 
     argv = ["--device-wait", str(args.device_wait)]
     if args.skip_swarm:
         argv.append("--skip-swarm")
     if getattr(args, "json", False):
         argv.append("--json")
-    return doctor_main(argv)
+    return doctor_cli(argv)
 
 
 def _cmd_edit(args) -> int:

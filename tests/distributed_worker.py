@@ -1,0 +1,76 @@
+"""One process of the 2-process DCN verify test — NOT a pytest file.
+
+Spawned by tests/test_distributed.py: joins a real
+``jax.distributed`` cluster on the CPU platform (virtual devices per
+process), builds the process-aligned ``(hosts, dp)`` mesh, rechecks a
+shared on-disk torrent via ``verify_storage_distributed`` — every
+process feeding only its local shard rows through the one shared jitted
+step — and prints a single JSON line the parent compares across
+processes and against hashlib.
+
+argv: coordinator nproc pid ndev workdir torrent_path
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    coordinator, nproc, pid, ndev, workdir, torrent_path = sys.argv[1:7]
+    nproc, pid, ndev = int(nproc), int(pid), int(ndev)
+
+    import jax
+
+    # CPU platform + per-process virtual devices BEFORE backend init;
+    # then the distributed handshake (which finalizes device topology).
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", ndev)
+
+    from torrent_tpu.parallel import distributed as dist
+
+    dist.initialize(coordinator, nproc, pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == nproc * ndev, jax.devices()
+
+    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.parallel.mesh import HOST_AXIS, make_mesh
+    from torrent_tpu.storage.storage import FsStorage, Storage
+
+    # the default mesh must come out process-aligned on its hosts axis
+    mesh = make_mesh()
+    assert mesh.shape[HOST_AXIS] == nproc, mesh.shape
+    for p in range(nproc):
+        assert all(d.process_index == p for d in mesh.devices[p]), (
+            "mesh host row %d is not process-aligned" % p
+        )
+
+    with open(torrent_path, "rb") as f:
+        meta = parse_metainfo(f.read())
+    storage = Storage(FsStorage(workdir), meta.info)
+    bitfield, n_valid = dist.verify_storage_distributed(
+        storage, meta.info, batch_size=8, backend="jax", mesh=mesh
+    )
+
+    # the public API entry point must route to the same DCN path
+    from torrent_tpu.parallel.verify import verify_pieces
+
+    via_public = verify_pieces(
+        storage, meta.info, hasher="tpu", batch_size=8, backend="jax", mesh=mesh
+    )
+    assert (via_public == bitfield).all(), "verify_pieces DCN routing diverged"
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "process_count": jax.process_count(),
+                "devices": len(jax.devices()),
+                "bitfield": "".join("1" if b else "0" for b in bitfield),
+                "n_valid": int(n_valid),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

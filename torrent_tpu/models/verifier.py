@@ -172,6 +172,15 @@ class TPUVerifier:
         self._upload_must_copy = (
             next(iter(self.mesh.devices.flat)).platform == "cpu"
         )
+        self._shard = shard
+        # A mesh spanning >1 process (parallel/distributed.py) cannot be
+        # fed global numpy arrays — each process only holds its
+        # addressable shard. verify/digest then take this process's
+        # LOCAL rows (batch_size / process_count of them) and convert
+        # via make_array_from_process_local_data.
+        self._mesh_processes = len(
+            {d.process_index for d in self.mesh.devices.flat}
+        )
 
     def _use_flat(self, padded: np.ndarray) -> bool:
         return (
@@ -205,23 +214,60 @@ class TPUVerifier:
 
     # ------------------------------------------------------------ raw steps
 
+    def _put_global(self, padded, nblocks, expected_words=None):
+        """Multi-process input path: build global batch-sharded Arrays
+        from this process's local rows (parallel/distributed.py)."""
+        from torrent_tpu.parallel.distributed import global_batch
+
+        args = [global_batch(self._shard, np.asarray(padded)),
+                global_batch(self._shard, np.asarray(nblocks))]
+        if expected_words is not None:
+            args.append(global_batch(self._shard, np.asarray(expected_words)))
+        return args
+
+    def verify_batch_global(
+        self, padded: np.ndarray, nblocks: np.ndarray, expected_words: np.ndarray
+    ):
+        """Multi-process verify: inputs are this process's LOCAL rows
+        (``batch_size / process_count`` of them); returns
+        ``(ok_local, ok_global)`` — the local bool rows plus the global
+        sharded device array for collective stats (psum_valid_count)."""
+        from torrent_tpu.parallel.distributed import local_values
+
+        ok_global = self._verify_step(
+            *self._put_global(padded, nblocks, expected_words)
+        )
+        return local_values(ok_global), ok_global
+
     def verify_batch(
         self, padded: np.ndarray, nblocks: np.ndarray, expected_words: np.ndarray
     ) -> np.ndarray:
-        """bool[B]: does each padded row hash to its expected digest words."""
+        """bool[B]: does each padded row hash to its expected digest words.
+
+        On a multi-process mesh the inputs are this process's local rows
+        and the returned bools are for those rows only."""
         from torrent_tpu.utils.trace import maybe_profile_batch
 
         with maybe_profile_batch("sha1_verify_batch"):
+            if self._mesh_processes > 1:
+                return self.verify_batch_global(padded, nblocks, expected_words)[0]
             if self._use_flat(padded):
                 chunks = self._put_flat(padded)
                 return np.asarray(self._verify_step_flat(chunks, nblocks, expected_words))
             return np.asarray(self._verify_step(padded, nblocks, expected_words))
 
     def digest_batch(self, padded: np.ndarray, nblocks: np.ndarray) -> np.ndarray:
-        """uint32[B, 5] big-endian digest words for each row."""
+        """uint32[B, 5] big-endian digest words for each row (local rows
+        on a multi-process mesh, as in verify_batch)."""
         from torrent_tpu.utils.trace import maybe_profile_batch
 
         with maybe_profile_batch("sha1_digest_batch"):
+            if self._mesh_processes > 1:
+                from torrent_tpu.parallel.distributed import local_values
+
+                return local_values(
+                    self._digest_step(*self._put_global(padded, nblocks))
+                )
             if self._use_flat(padded):
                 chunks = self._put_flat(padded)
                 return np.asarray(self._digest_step_flat(chunks, nblocks))

@@ -1,6 +1,10 @@
 from torrent_tpu.parallel.mesh import make_mesh, batch_sharding, replicated_sharding
 from torrent_tpu.parallel.verify import verify_pieces, VerifyResult
 from torrent_tpu.parallel.bulk import verify_library, LibraryResult
+from torrent_tpu.parallel.distributed import (
+    initialize as init_distributed,
+    verify_storage_distributed,
+)
 
 __all__ = [
     "make_mesh",
@@ -10,4 +14,6 @@ __all__ = [
     "VerifyResult",
     "verify_library",
     "LibraryResult",
+    "init_distributed",
+    "verify_storage_distributed",
 ]

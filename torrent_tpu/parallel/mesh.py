@@ -8,7 +8,11 @@ The reference's only parallelism is async concurrency on one event loop
 - axis ``"hosts"`` — multi-host fan-out over DCN for pod-scale bulk
   verification (BASELINE config 5); piece batches shard over
   ``hosts × dp`` so collectives ride ICI within a host and only the final
-  few-byte bitfield reductions cross DCN.
+  few-byte bitfield reductions cross DCN. On a real multi-process
+  cluster (``jax.distributed``) the host rows are process-aligned and
+  inputs enter as per-process local shards — see
+  ``parallel/distributed.py``; the live 2-process path is exercised by
+  ``tests/test_distributed.py``.
 
 SHA1's block chain is inherently serial *within* a piece, so there is no
 tensor/sequence-parallel axis to shard — all scale-out is across pieces,
@@ -35,9 +39,27 @@ def make_mesh(devices=None, n_hosts: int | None = None) -> Mesh:
     """
     if devices is None:
         devices = jax.devices()
-    devices = np.asarray(devices)
     if n_hosts is None:
         n_hosts = jax.process_count()
+    if jax.process_count() > 1 and n_hosts == jax.process_count():
+        # Real multi-process mesh (parallel/distributed.py): row p MUST
+        # be process p's local devices, so the batch rows a process
+        # feeds via make_array_from_process_local_data are the rows its
+        # own devices hold — piece bytes stay on-host, only bitfield /
+        # stats reductions cross DCN. jax.devices() order is not a
+        # contract; group explicitly.
+        rows = [
+            [d for d in devices if d.process_index == p]
+            for p in range(n_hosts)
+        ]
+        width = len(rows[0])
+        if width == 0 or any(len(r) != width for r in rows):
+            raise ValueError(
+                "devices are not evenly spread over processes: "
+                + str([len(r) for r in rows])
+            )
+        return Mesh(np.array(rows, dtype=object), (HOST_AXIS, DP_AXIS))
+    devices = np.asarray(devices)
     if devices.size % n_hosts != 0:
         raise ValueError(f"{devices.size} devices not divisible by {n_hosts} hosts")
     grid = devices.reshape(n_hosts, devices.size // n_hosts)

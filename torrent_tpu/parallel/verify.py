@@ -87,7 +87,42 @@ def verify_pieces_tpu(
     progress_cb: ProgressCb | None = None,
     io_threads: int = 4,
 ) -> np.ndarray:
-    """Batched device recheck; overlaps disk reads with device hashing."""
+    """Batched device recheck; overlaps disk reads with device hashing.
+
+    On a multi-process (``jax.distributed``) cluster this routes to the
+    DCN path automatically: every process verifies its shard of each
+    global batch and all return the identical global bitfield
+    (parallel/distributed.py; proven by tests/test_distributed.py).
+    """
+    import jax
+
+    # Route on the MESH's process span, not bare process_count(): a
+    # caller on a multi-process cluster may pass a local-only mesh
+    # (make_mesh(jax.local_devices(), n_hosts=1)) for a per-host
+    # recheck, which must take the ordinary single-controller path.
+    if jax.process_count() > 1:
+        span_mesh = mesh
+        if span_mesh is None:
+            from torrent_tpu.parallel.mesh import make_mesh
+
+            span_mesh = make_mesh()
+        if len({d.process_index for d in span_mesh.devices.flat}) > 1:
+            from torrent_tpu.parallel.distributed import (
+                verify_storage_distributed,
+            )
+
+            bitfield, _ = verify_storage_distributed(
+                storage,
+                info,
+                batch_size=batch_size,
+                backend=backend,
+                mesh=span_mesh,
+                progress_cb=progress_cb,
+                io_threads=io_threads,
+            )
+            return bitfield
+        mesh = span_mesh
+
     from torrent_tpu.models.verifier import TPUVerifier
 
     verifier = TPUVerifier(

@@ -356,6 +356,16 @@ class TestCliSign:
         assert main(["sign", tf, "--check", "alice", "--pub", pub_hex]) == 0
         assert "VALID" in capsys.readouterr().out
 
+        # bare --check (no --pub) verifies against the attacker-
+        # controlled embedded certificate: a tampered torrent whose
+        # cert+signature were replaced together would pass, so the
+        # scriptable exit code must be non-zero and the output must not
+        # claim validity (advisor r4)
+        assert main(["sign", tf, "--check", "alice"]) == 2
+        out = capsys.readouterr().out
+        assert "SELF-CONSISTENT" in out and "UNTRUSTED" in out
+        assert "VALID" not in out
+
         # wrong-length trusted key is a usage error, never "INVALID"
         assert main(["sign", tf, "--check", "alice", "--pub", pub_hex[:-2]]) == 2
         err = capsys.readouterr().err

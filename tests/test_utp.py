@@ -1154,3 +1154,50 @@ class TestDelayedAcks:
             assert conn.closed and not conn._reset  # graceful completion
 
         run(go())
+
+
+class TestRaiseProbeGating:
+    """Advisor r4: PAD_EXT is a non-standard extension id — raise
+    probing needs a global kill-switch and must only arm against peers
+    that demonstrated extension tolerance."""
+
+    class _Ep:
+        def sendto(self, data, addr):
+            pass
+
+        def _forget(self, conn):
+            pass
+
+    def test_kill_switch_and_extension_tolerance(self, monkeypatch):
+        async def go():
+            # loopback peer: our own stack, tolerant by construction
+            lo = utp.UtpConnection(self._Ep(), ("127.0.0.1", 1), 1, 2)
+            lo.mtu = 576
+            lo._arm_mtu_raise()
+            assert lo._mtu_raise_at > 0
+
+            # global kill-switch wins even on loopback
+            monkeypatch.setattr(utp, "MTU_RAISE_ENABLED", False)
+            off = utp.UtpConnection(self._Ep(), ("127.0.0.1", 1), 1, 2)
+            off.mtu = 576
+            off._arm_mtu_raise()
+            assert off._mtu_raise_at == 0
+            monkeypatch.setattr(utp, "MTU_RAISE_ENABLED", True)
+
+            # WAN peer: never probed until tolerance is demonstrated...
+            wan = utp.UtpConnection(self._Ep(), ("203.0.113.5", 1), 1, 2)
+            wan.mtu = 576
+            assert not wan._ext_tolerant
+            wan._arm_mtu_raise()
+            assert wan._mtu_raise_at == 0
+            # ...and a peer that itself sends a BEP 29 extension (SACK)
+            # proves its decoder walks the extension framing — arm now
+            wan.connected.set()
+            wan.ack_nr = 100
+            wan.on_packet(
+                utp.ST_STATE, 0, 0, 1 << 20, 101, wan.seq_nr, b"",
+                sack=b"\x00\x00\x00\x00",
+            )
+            assert wan._ext_tolerant and wan._mtu_raise_at > 0
+
+        run(go())

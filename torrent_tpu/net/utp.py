@@ -78,6 +78,15 @@ MTU_LADDER_LOOPBACK = (JUMBO_MTU,) + MTU_LADDER
 # decoders skip it and the STREAM bytes are unchanged — which is what
 # makes the probe safe to retransmit bare if it vanishes).
 PAD_EXT = 0x7A
+# Kill-switch (like SACK_ENABLED): PAD_EXT is a non-standard extension
+# id, and while BEP 29's framing obliges decoders to skip unknown
+# extensions, a third-party peer that resets on one would lose the
+# connection on every probe. Raise probing therefore (a) can be turned
+# off globally here, and (b) only arms per-connection once the peer has
+# DEMONSTRATED extension tolerance: loopback peers (our own stack), or
+# a peer that itself sent a BEP 29 extension (its encoder implies the
+# framing loop). See UtpConnection._ext_tolerant.
+MTU_RAISE_ENABLED = True
 MTU_RAISE_INTERVAL = 5.0  # first upward probe / post-success cadence
 MTU_RAISE_BACKOFF_MAX = 120.0  # failed probes back off exponentially to this
 SACK_ENABLED = True  # module toggle so tests can measure SACK's effect
@@ -229,6 +238,11 @@ class UtpConnection:
         self._timer_deadline = 0.0  # lazy retransmit-timer re-arm target
         self.mtu = MTU  # payload budget; dial-time SYN probing may lower it
         self._mtu_ladder = MTU_LADDER  # dial() swaps in the loopback ladder
+        # Raise probes send the non-standard PAD_EXT; only arm once the
+        # peer demonstrated extension tolerance (loopback = our stack;
+        # else flipped when the peer sends a SACK — the one extension
+        # decode_packet surfaces — proving its framing loop, on_packet)
+        self._ext_tolerant = _is_loopback_addr(addr[0])
         self._mtu_probe_idx: int | None = None  # ladder position while dialing
         # upward (raise) probing state — see PAD_EXT block at module top
         self._mtu_raise_at = 0.0  # monotonic: next probe eligibility (0 = off)
@@ -304,7 +318,11 @@ class UtpConnection:
     def _arm_mtu_raise(self) -> None:
         """Start upward path-MTU probing when the budget settled below
         the ladder top (transient clamp during the SYN exchange, an
-        acceptor adopting a stepped-down dialer's pad, ...)."""
+        acceptor adopting a stepped-down dialer's pad, ...). No-op
+        unless enabled globally AND the peer is extension-tolerant
+        (see MTU_RAISE_ENABLED)."""
+        if not MTU_RAISE_ENABLED or not self._ext_tolerant:
+            return
         if self.mtu < self._mtu_ladder[0]:
             self._mtu_raise_at = time.monotonic() + self._mtu_raise_interval
 
@@ -353,7 +371,20 @@ class UtpConnection:
             # chunks or the next rung's probe never finds one to ride
             chunk = data[off : off + self.mtu]
             off += len(chunk)
-            while self._flow_used() + len(chunk) > self._window():
+            # Admit chunk+pad TOGETHER: a raise probe must never exceed
+            # LEDBAT's admitted inflight, even momentarily. If the
+            # window can't fit the padded size, the probe is dropped
+            # (never stalls stream progress waiting for probe room —
+            # probing a rung larger than the sustainable window is
+            # pointless anyway; a later full-budget chunk retries). The
+            # pad never occupies the RECEIVER's buffer — extensions are
+            # stripped at decode — so the peer's advertised window only
+            # governs the stream bytes.
+            pad = self._mtu_probe_pad(len(chunk))
+            while self._flow_used() + len(chunk) + pad > self._window():
+                if pad:
+                    pad = 0
+                    continue
                 self._send_room.clear()
                 try:
                     # bounded wait: a zero/shrunken peer window reopens
@@ -365,17 +396,6 @@ class UtpConnection:
                 if self.closed or self._reset:
                     raise ConnectionResetError("utp connection closed")
             self.seq_nr = (self.seq_nr + 1) & 0xFFFF
-            pad = self._mtu_probe_pad(len(chunk))
-            if pad and pad > self._window():
-                # Bound the probe's congestion overshoot: the pad bytes
-                # are NOT admitted by the window check above, so cap them
-                # at one window's worth of extra traffic (also: probing a
-                # rung larger than the sustainable window is pointless —
-                # wait for cwnd to earn it). The pad never occupies the
-                # RECEIVER's buffer — extensions are stripped at decode —
-                # so the peer's advertised window only ever governs the
-                # stream bytes, which the admission loop already checked.
-                pad = 0
             pkt = encode_packet(
                 ST_DATA,
                 self.send_id,
@@ -445,6 +465,12 @@ class UtpConnection:
         if ptype == ST_RESET:
             self._die(reset=True)
             return
+        if sack is not None and not self._ext_tolerant:
+            # the peer's own encoder emits BEP 29 extensions, so its
+            # decoder implements the framing loop — PAD_EXT is safe now;
+            # arm the raise probe it was denied at connection setup
+            self._ext_tolerant = True
+            self._arm_mtu_raise()
         self._handle_ack(ptype, ack, ts_diff, sack)
         if ptype == ST_STATE:
             if not self.connected.is_set():

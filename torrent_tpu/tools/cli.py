@@ -740,7 +740,11 @@ def _cmd_sign(args) -> int:
     """BEP 35 torrent signing (Ed25519 — the BEP 46 key format).
 
     ``--keygen`` mints a key pair; ``--signer NAME --key FILE`` signs;
-    ``--check NAME [--pub HEX]`` verifies (exit 0 valid / 2 invalid).
+    ``--check NAME --pub HEX`` verifies against the trusted key (exit 0
+    valid / 2 invalid). ``--check NAME`` alone can only test
+    self-consistency against the attacker-controlled embedded
+    certificate, so it ALWAYS exits 2 (SELF-CONSISTENT/UNTRUSTED or
+    INVALID) — exit 0 is reachable only with ``--pub``.
     Signing is root-level only: the infohash never changes.
     """
     from torrent_tpu.codec import signing
@@ -809,10 +813,24 @@ def _cmd_sign(args) -> int:
                 )
                 return 2
         ok = signing.verify_torrent(data, args.check, pub)
-        where = "trusted key" if pub is not None else "embedded certificate"
-        print(f"signature by {args.check!r}: "
-              f"{'VALID' if ok else 'INVALID'} ({where})")
-        return 0 if ok else 2
+        if pub is not None:
+            print(f"signature by {args.check!r}: "
+                  f"{'VALID' if ok else 'INVALID'} (trusted key)")
+            return 0 if ok else 2
+        # Embedded-certificate-only: self-consistency, NOT trust. A
+        # tampered torrent whose cert+signature were replaced together
+        # passes this check, so the bare --check form must never be a
+        # scriptable exit-0 "valid" (advisor r4): report loudly and
+        # exit non-zero either way.
+        if ok:
+            print(
+                f"signature by {args.check!r}: SELF-CONSISTENT "
+                f"(embedded certificate — UNTRUSTED: anyone can re-sign "
+                f"with a fresh key; pass --pub KEY for a trusted verdict)"
+            )
+        else:
+            print(f"signature by {args.check!r}: INVALID (embedded certificate)")
+        return 2
 
     if not args.key or not args.signer:
         print("error: signing needs --key FILE and --signer NAME",

@@ -1,0 +1,84 @@
+"""Measure the v2 ingest crossover (round-4 verdict next #7).
+
+The in-code claim (session/torrent.py near _verify_batch_device_v2):
+per-piece CPU merkle root costs ~0.55 ms/MiB while a device dispatch
+costs ~55 ms through this image's relay tunnel, so batching onto the
+device wins at ≳100 concurrently-finishing 1 MiB pieces here and ≲2 on
+a co-located host. This script turns the CPU side into a RECORDED
+measurement and composes the crossover table with the banked round-2
+device numbers (dispatch ~55 ms, v2 plane 11.9 GiB/s — the device side
+is re-measured when a grant window opens).
+
+Crossover N* solves: N*t_cpu == t_dispatch + N*t_device.
+
+Run outside any grant window (pure host work):
+    python .bench/measure_v2_crossover.py  ->  .bench/v2_crossover.json
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from torrent_tpu.models.merkle import piece_root_cpu  # noqa: E402
+
+# Banked round-2 device-side constants (BASELINE.md measured table;
+# re-measured on-device when the tunnel grants).
+DISPATCH_MS_RELAY = 55.0  # fixed per-dispatch cost through the relay
+DISPATCH_MS_COLOCATED = 1.0  # conservative co-located PJRT dispatch
+V2_PLANE_GIB_S = 11.9  # banked .bench/cfgv2b.json plane rate
+
+
+def measure_cpu(piece_len: int, reps: int) -> float:
+    """Median seconds per piece_root_cpu call at this piece length."""
+    pad = piece_len // 16384
+    data = os.urandom(piece_len)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        piece_root_cpu(data, pad)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def main() -> None:
+    rows = []
+    for plen, reps in ((262144, 40), (524288, 30), (1048576, 20)):
+        t_cpu = measure_cpu(plen, reps)
+        t_dev = plen / (V2_PLANE_GIB_S * 2**30)
+        rows.append(
+            {
+                "piece_len": plen,
+                "cpu_ms_per_piece": round(t_cpu * 1e3, 3),
+                "cpu_gib_s": round(plen / t_cpu / 2**30, 2),
+                "device_ms_per_piece_banked": round(t_dev * 1e3, 3),
+                "crossover_pieces_relay": (
+                    None
+                    if t_cpu <= t_dev
+                    else int(DISPATCH_MS_RELAY / 1e3 / (t_cpu - t_dev)) + 1
+                ),
+                "crossover_pieces_colocated": (
+                    None
+                    if t_cpu <= t_dev
+                    else int(DISPATCH_MS_COLOCATED / 1e3 / (t_cpu - t_dev)) + 1
+                ),
+            }
+        )
+    out = {
+        "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "dispatch_ms_relay_banked_r2": DISPATCH_MS_RELAY,
+        "dispatch_ms_colocated_assumed": DISPATCH_MS_COLOCATED,
+        "v2_plane_gib_s_banked_r2": V2_PLANE_GIB_S,
+        "rows": rows,
+    }
+    dest = os.path.join(os.path.dirname(os.path.abspath(__file__)), "v2_crossover.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

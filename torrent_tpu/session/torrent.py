@@ -2436,12 +2436,14 @@ class Torrent:
                 # on self.v2); tail pieces (short data / oversized pad)
                 # fold on the CPU below.
                 #
-                # Crossover, measured (BASELINE.md environment): hashlib
-                # SHA-256 sustains ~1.9 GiB/s on this host (~0.55 ms per
-                # 1 MiB piece) while a device dispatch costs ~55 ms
-                # through this image's relay tunnel — the batch wins at
-                # ≳100 concurrently-finishing 1 MiB pieces here, but on a
-                # co-located TPU host (sub-ms dispatch) at ≲2. Either
+                # Crossover, RECORDED in .bench/v2_crossover.json
+                # (2026-08-01, this host): piece_root_cpu sustains
+                # 1.24-1.36 GiB/s (0.72 ms per 1 MiB piece incl. tree
+                # reduction) vs the banked 11.9 GiB/s plane + ~55 ms
+                # relay dispatch — the batch wins at ≥87
+                # concurrently-finishing 1 MiB pieces here (312 at
+                # 256 KiB), but on a co-located TPU host (~1 ms
+                # dispatch) at ≤2 (≤6 at 256 KiB). Either
                 # way the verify leaves the event loop, which is what
                 # ingest latency cares about; a device failure falls back
                 # to hashlib inside the flush.

@@ -8,15 +8,35 @@ process feeding only its local shard rows through the one shared jitted
 step — and prints a single JSON line the parent compares across
 processes and against hashlib.
 
-argv: coordinator nproc pid ndev workdir torrent_path
+argv: coordinator nproc pid ndev workdir torrent_path [mode]
+mode: "storage" (default) — verify_storage_distributed of one torrent;
+      "library" — verify_library_distributed over every *.torrent in
+      workdir (torrent-level DCN sharding, per-host local mesh).
 """
 
+import glob
 import json
+import os
 import sys
 
 
+
+
+def _emit(workdir: str, pid: int, payload: dict) -> None:
+    """Write the result where stdout races can't garble it: the Gloo
+    transport logs to stdout from C++ concurrently with Python prints,
+    and an interleaved line breaks any parse of captured output. The
+    parent test reads result_<pid>.json; the print stays for humans."""
+    payload = dict(payload, pid=pid)
+    path = os.path.join(workdir, f"result_{pid}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(payload, f)
+    os.replace(path + ".tmp", path)
+    print(json.dumps(payload), flush=True)
+
 def main() -> None:
     coordinator, nproc, pid, ndev, workdir, torrent_path = sys.argv[1:7]
+    mode = sys.argv[7] if len(sys.argv) > 7 else "storage"
     nproc, pid, ndev = int(nproc), int(pid), int(ndev)
 
     import jax
@@ -33,8 +53,38 @@ def main() -> None:
     assert len(jax.devices()) == nproc * ndev, jax.devices()
 
     from torrent_tpu.codec.metainfo import parse_metainfo
-    from torrent_tpu.parallel.mesh import HOST_AXIS, make_mesh
     from torrent_tpu.storage.storage import FsStorage, Storage
+
+    if mode == "library":
+        # library mode never touches the global mesh:
+        # verify_library_distributed builds its own LOCAL mesh per host
+        items = []
+        for tf in sorted(glob.glob(os.path.join(workdir, "*.torrent"))):
+            with open(tf, "rb") as f:
+                meta = parse_metainfo(f.read())
+            root = os.path.join(
+                workdir, os.path.splitext(os.path.basename(tf))[0]
+            )
+            items.append((Storage(FsStorage(root), meta.info), meta.info))
+        bitfields, n_valid = dist.verify_library_distributed(
+            items, batch_size=8, backend="jax"
+        )
+        _emit(
+            workdir,
+            pid,
+            {
+                "process_count": jax.process_count(),
+                "devices": len(jax.devices()),
+                "bitfields": [
+                    "".join("1" if b else "0" for b in bf)
+                    for bf in bitfields
+                ],
+                "n_valid": int(n_valid),
+            },
+        )
+        return
+
+    from torrent_tpu.parallel.mesh import HOST_AXIS, make_mesh
 
     # the default mesh must come out process-aligned on its hosts axis
     mesh = make_mesh()
@@ -58,17 +108,15 @@ def main() -> None:
         storage, meta.info, hasher="tpu", batch_size=8, backend="jax", mesh=mesh
     )
     assert (via_public == bitfield).all(), "verify_pieces DCN routing diverged"
-    print(
-        json.dumps(
-            {
-                "pid": pid,
-                "process_count": jax.process_count(),
-                "devices": len(jax.devices()),
-                "bitfield": "".join("1" if b else "0" for b in bitfield),
-                "n_valid": int(n_valid),
-            }
-        ),
-        flush=True,
+    _emit(
+        workdir,
+        pid,
+        {
+            "process_count": jax.process_count(),
+            "devices": len(jax.devices()),
+            "bitfield": "".join("1" if b else "0" for b in bitfield),
+            "n_valid": int(n_valid),
+        },
     )
 
 

@@ -110,10 +110,12 @@ def test_two_process_dcn_verify(tmp_path):
         for pid in range(2)
     ]
     outs = []
-    for w in workers:
-        out, err = w.communicate(timeout=540)
+    for pid, w in enumerate(workers):
+        _, err = w.communicate(timeout=540)
         assert w.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
+        # results come via file, not stdout: Gloo's C++ transport logs
+        # to stdout concurrently and can interleave mid-line
+        outs.append(json.loads((workdir / f"result_{pid}.json").read_text()))
 
     for rec in outs:
         assert rec["process_count"] == 2
@@ -122,4 +124,97 @@ def test_two_process_dcn_verify(tmp_path):
         assert rec["n_valid"] == n - 1
     # the DCN contract: every process computed the identical global view
     assert outs[0]["bitfield"] == outs[1]["bitfield"]
+    assert outs[0]["n_valid"] == outs[1]["n_valid"]
+
+
+def test_two_process_dcn_library(tmp_path):
+    """Torrent-level DCN sharding (BASELINE config 5's pod story,
+    `parallel/bulk.py` docstring): each process bulk-validates its
+    round-robin shard of a 3-torrent library on its LOCAL device mesh,
+    the packed bitfield allgather assembles the global view, and both
+    processes must agree with each other and hashlib. Bounded by
+    communicate(timeout); CPU-only workers are safe to kill."""
+    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.tools.make_torrent import make_torrent
+
+    plen = 16384
+    rng = np.random.default_rng(11)
+    workdir = tmp_path / "lib"
+    workdir.mkdir()
+    n_pieces_per = [5, 9, 6]
+    metas = []
+    for t, npcs in enumerate(n_pieces_per):
+        root = workdir / f"t{t}"
+        root.mkdir()
+        size = (npcs - 1) * plen + plen // 2  # ragged last piece
+        (root / f"payload{t}.bin").write_bytes(
+            rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        )
+        tf = workdir / f"t{t}.torrent"
+        tf.write_bytes(
+            make_torrent(
+                str(root / f"payload{t}.bin"),
+                "http://t.invalid/announce",
+                piece_length=plen,
+            )
+        )
+        metas.append(parse_metainfo(tf.read_bytes()))
+
+    # corrupt piece 4 of torrent 1 (a torrent process 1 owns under
+    # round-robin: indices 1 of 3)
+    f1 = workdir / "t1" / "payload1.bin"
+    buf = bytearray(f1.read_bytes())
+    buf[4 * plen + 9] ^= 0xFF
+    f1.write_bytes(bytes(buf))
+
+    expected = []
+    for t, meta in enumerate(metas):
+        blob = (workdir / f"t{t}" / f"payload{t}.bin").read_bytes()
+        expected.append(
+            "".join(
+                "1"
+                if hashlib.sha1(blob[i * plen : (i + 1) * plen]).digest()
+                == meta.info.pieces[i]
+                else "0"
+                for i in range(meta.info.num_pieces)
+            )
+        )
+    assert expected[1][4] == "0" and expected[1].count("0") == 1
+
+    coordinator = f"localhost:{_free_port()}"
+    env = _worker_env()
+    workers = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                os.path.join(REPO, "tests", "distributed_worker.py"),
+                coordinator,
+                "2",
+                str(pid),
+                "4",
+                str(workdir),
+                "-",
+                "library",
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for pid, w in enumerate(workers):
+        _, err = w.communicate(timeout=540)
+        assert w.returncode == 0, f"library worker failed:\n{err[-3000:]}"
+        # results come via file, not stdout: Gloo's C++ transport logs
+        # to stdout concurrently and can interleave mid-line
+        outs.append(json.loads((workdir / f"result_{pid}.json").read_text()))
+
+    total = sum(n_pieces_per)
+    for rec in outs:
+        assert rec["bitfields"] == expected
+        assert rec["n_valid"] == total - 1
+    # identical global view on every process (pid aside)
+    assert outs[0]["bitfields"] == outs[1]["bitfields"]
     assert outs[0]["n_valid"] == outs[1]["n_valid"]

@@ -225,6 +225,18 @@ class TPUVerifier:
             args.append(global_batch(self._shard, np.asarray(expected_words)))
         return args
 
+    def _put_local_sharded(self, *arrays):
+        """On a multi-process CLUSTER even a fully-addressable local
+        mesh can't take numpy args through a jit with non-trivial
+        in_shardings ("Passing non-trivial shardings for numpy inputs
+        is not allowed") — e.g. each pod host bulk-validating its
+        library shard on its own devices (verify_library_distributed).
+        Put them explicitly with the batch sharding; a no-op wrapper on
+        single-process runs."""
+        if jax.process_count() == 1:
+            return arrays
+        return tuple(jax.device_put(a, self._shard) for a in arrays)
+
     def verify_batch_global(
         self, padded: np.ndarray, nblocks: np.ndarray, expected_words: np.ndarray
     ):
@@ -254,7 +266,11 @@ class TPUVerifier:
             if self._use_flat(padded):
                 chunks = self._put_flat(padded)
                 return np.asarray(self._verify_step_flat(chunks, nblocks, expected_words))
-            return np.asarray(self._verify_step(padded, nblocks, expected_words))
+            return np.asarray(
+                self._verify_step(
+                    *self._put_local_sharded(padded, nblocks, expected_words)
+                )
+            )
 
     def digest_batch(self, padded: np.ndarray, nblocks: np.ndarray) -> np.ndarray:
         """uint32[B, 5] big-endian digest words for each row (local rows
@@ -271,7 +287,9 @@ class TPUVerifier:
             if self._use_flat(padded):
                 chunks = self._put_flat(padded)
                 return np.asarray(self._digest_step_flat(chunks, nblocks))
-            return np.asarray(self._digest_step(padded, nblocks))
+            return np.asarray(
+                self._digest_step(*self._put_local_sharded(padded, nblocks))
+            )
 
     # ------------------------------------------------------------ authoring
 

@@ -3,6 +3,7 @@ from torrent_tpu.parallel.verify import verify_pieces, VerifyResult
 from torrent_tpu.parallel.bulk import verify_library, LibraryResult
 from torrent_tpu.parallel.distributed import (
     initialize as init_distributed,
+    verify_library_distributed,
     verify_storage_distributed,
 )
 
@@ -15,5 +16,6 @@ __all__ = [
     "verify_library",
     "LibraryResult",
     "init_distributed",
+    "verify_library_distributed",
     "verify_storage_distributed",
 ]

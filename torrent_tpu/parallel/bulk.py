@@ -8,8 +8,9 @@ full — pieces from different torrents ride the same launch — and only
 the library's final batch is ragged.
 
 On a multi-host pod each host runs verify_library over its shard of the
-library (torrent-level DCN parallelism; no cross-host data movement),
-while each batch shards ``(hosts, dp)`` over the local mesh.
+library (torrent-level DCN parallelism; no cross-host piece movement) —
+implemented by ``parallel/distributed.verify_library_distributed`` and
+proven with two real processes in ``tests/test_distributed.py``.
 """
 
 from __future__ import annotations

@@ -258,3 +258,64 @@ def verify_storage_distributed(
             io_pool.shutdown(wait=False)
     bitfield = allgather_bitfield(local_contrib)
     return bitfield, n_valid
+
+
+def verify_library_distributed(
+    items,
+    batch_size: int = 1024,
+    backend: str = "jax",
+    io_threads: int = 4,
+    progress_cb=None,
+):
+    """Pod-scale bulk library validation (BASELINE config 5): the
+    torrent-level DCN parallelism `parallel/bulk.py` documents — each
+    host runs :func:`verify_library` over its round-robin shard of the
+    library on its LOCAL device mesh (no cross-host piece movement),
+    then the per-torrent bitfields are assembled over one packed DCN
+    allgather. Returns ``(bitfields, n_valid)``, identical on every
+    process; ``n_valid`` counts valid pieces library-wide.
+
+    ``items``: ``list[(Storage, InfoDict)]`` — the SAME list, in the
+    same order, on every process (each host opens its own storage
+    handles; only the round-robin slice is actually read).
+
+    ``progress_cb`` reports THIS process's shard progress —
+    ``(pieces_done_local, shard_pieces_total)`` — not library-wide
+    progress: hosts advance independently and cross-host progress
+    would cost a collective per batch. Only the RETURN values are
+    identical on every process.
+    """
+    import jax
+
+    from torrent_tpu.parallel.bulk import verify_library
+    from torrent_tpu.parallel.mesh import make_mesh
+
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    # round-robin, not contiguous: libraries are often sorted by size,
+    # and striding spreads the big torrents evenly across hosts
+    mine = list(range(pid, len(items), nproc))
+    local_mesh = make_mesh(jax.local_devices(), n_hosts=1)
+    result = verify_library(
+        [items[i] for i in mine],
+        hasher="tpu",
+        batch_size=batch_size,
+        backend=backend,
+        mesh=local_mesh,
+        io_threads=io_threads,
+        progress_cb=progress_cb,
+    )
+    # pack every torrent's bitfield into one flat disjoint-contribution
+    # vector: this process fills only its torrents' spans, the OR-
+    # allgather assembles the global view on every host
+    offsets = np.zeros(len(items) + 1, dtype=np.int64)
+    for i, (_, info) in enumerate(items):
+        offsets[i + 1] = offsets[i] + info.num_pieces
+    flat = np.zeros(int(offsets[-1]), dtype=bool)
+    for j, i in enumerate(mine):
+        flat[offsets[i] : offsets[i + 1]] = result.bitfields[j]
+    flat = allgather_bitfield(flat)
+    bitfields = [
+        flat[offsets[i] : offsets[i + 1]].copy() for i in range(len(items))
+    ]
+    return bitfields, int(flat.sum())

@@ -66,3 +66,30 @@ class TestPallasKernel:
         # 32 sublanes x 128 lanes: the tuned default (see the sweep table
         # in ops/sha1_pallas.py); env knobs can still override it
         assert TILE == 4096
+
+    def test_interleave2_variant_matches_hashlib(self):
+        """The 2-way round-chain interleave (BASELINE.md roofline knob,
+        opt-in via tune_sha1 grid '32x16i' / TORRENT_TPU_SHA1_INTERLEAVE2)
+        is bit-identical to the straight kernel on ragged multi-block
+        batches, and rejects tilings whose halves are not vreg-aligned."""
+        rng = np.random.default_rng(23)
+        pieces = [
+            rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+            for n in (200, 64, 129, 500, 448, 1, 320, 200)
+        ]
+        padded, nblocks = pad_pieces(pieces)
+        want = [hashlib.sha1(p).digest() for p in pieces]
+        words = np.asarray(
+            sha1_pieces_pallas(
+                padded, nblocks, interpret=True, tile_sub=16, interleave2=True
+            )
+        )
+        got = [
+            b"".join(int(w).to_bytes(4, "big") for w in words[i])
+            for i in range(len(pieces))
+        ]
+        assert got == want
+        with pytest.raises(ValueError, match="interleave2"):
+            sha1_pieces_pallas(
+                padded, nblocks, interpret=True, tile_sub=8, interleave2=True
+            )

@@ -59,6 +59,10 @@ TILE_SUB = env_int("TORRENT_TPU_SHA1_TILE_SUB", 32)
 # Python unrolling — 640 rounds in one basic block sends the backend
 # compiler superlinear).
 UNROLL = env_int("TORRENT_TPU_SHA1_UNROLL", 16)
+# 2-way round-chain interleave (BASELINE.md roofline's named knob):
+# OFF by default — only an on-device A/B (tools/tune_sha1.py) should
+# ever turn it on, exactly like the sha256 FULL_UNROLL variant.
+INTERLEAVE2 = bool(env_int("TORRENT_TPU_SHA1_INTERLEAVE2", 0))
 
 
 def _check_tiling(tile_sub: int, unroll: int) -> None:
@@ -78,46 +82,82 @@ _check_tiling(TILE_SUB, UNROLL)
 TILE = TILE_SUB * TILE_LANE  # default tile (rows per program instance)
 
 
+def _round_t(t, a, b, c, d, e, w):
+    """Round ``t`` of the SHA1 compression on one state tuple; ``w`` is
+    the 16-entry rolling schedule window (mutated in place)."""
+    if t < 16:
+        wt = w[t]
+    else:
+        wt = _rotl(w[(t - 3) % 16] ^ w[(t - 8) % 16] ^ w[(t - 14) % 16] ^ w[t % 16], 1)
+        w[t % 16] = wt
+    if t < 20:
+        # ch(b,c,d) = (b&c)|(~b&d), 4 ops naively; the mux form needs 3
+        f = d ^ (b & (c ^ d))
+        kc = _K[0]
+    elif t < 40:
+        f = b ^ c ^ d
+        kc = _K[1]
+    elif t < 60:
+        # maj(b,c,d) = (b&c)|(b&d)|(c&d), 5 ops naively; 4 via the
+        # b^c factoring (identical truth table)
+        f = (b & c) | (d & (b ^ c))
+        kc = _K[2]
+    else:
+        f = b ^ c ^ d
+        kc = _K[3]
+    tmp = _rotl(a, 5) + f + e + np.uint32(kc) + wt
+    return tmp, a, _rotl(b, 30), c, d
+
+
 def _one_block(state, w):
     """One 80-round SHA1 compression. state: 5-tuple of u32 vregs; w: 16 words.
 
     The 80-word schedule is a 16-entry rolling window so only 16 vectors
     are live at a time. Returns the chained (not yet masked) new state.
     """
-    a, b, c, d, e = state
+    r = state
     for t in range(80):
-        if t < 16:
-            wt = w[t]
-        else:
-            wt = _rotl(w[(t - 3) % 16] ^ w[(t - 8) % 16] ^ w[(t - 14) % 16] ^ w[t % 16], 1)
-            w[t % 16] = wt
-        if t < 20:
-            # ch(b,c,d) = (b&c)|(~b&d), 4 ops naively; the mux form needs 3
-            f = d ^ (b & (c ^ d))
-            kc = _K[0]
-        elif t < 40:
-            f = b ^ c ^ d
-            kc = _K[1]
-        elif t < 60:
-            # maj(b,c,d) = (b&c)|(b&d)|(c&d), 5 ops naively; 4 via the
-            # b^c factoring (identical truth table)
-            f = (b & c) | (d & (b ^ c))
-            kc = _K[2]
-        else:
-            f = b ^ c ^ d
-            kc = _K[3]
-        tmp = _rotl(a, 5) + f + e + np.uint32(kc) + wt
-        e, d, c, b, a = d, c, _rotl(b, 30), a, tmp
-    return (state[0] + a, state[1] + b, state[2] + c, state[3] + d, state[4] + e)
+        r = _round_t(t, *r, w)
+    return tuple(s + x for s, x in zip(state, r))
 
 
-def _sha1_kernel(words_ref, nblocks_ref, state_ref, *, unroll: int, tile_sub: int):
+def _one_block_x2(state_a, wa, state_b, wb):
+    """One compression over TWO independent half-tiles with their round
+    chains interleaved in program order (the roofline's named knob,
+    BASELINE.md): each round's rotl→add critical path is ~5 dependent
+    op-levels deep, so alternating rounds of two independent chains
+    hands the backend a ready instruction from the other chain while one
+    chain's adds are in flight. Whether Mosaic's scheduler benefits
+    beyond what tile_sub-level vreg independence already gives is
+    EMPIRICAL — this variant is opt-in and A/B'd on-chip by
+    tools/tune_sha1.py, never a default."""
+    ra, rb = state_a, state_b
+    for t in range(80):
+        ra = _round_t(t, *ra, wa)
+        rb = _round_t(t, *rb, wb)
+    return (
+        tuple(s + x for s, x in zip(state_a, ra)),
+        tuple(s + x for s, x in zip(state_b, rb)),
+    )
+
+
+def _sha1_kernel(
+    words_ref,
+    nblocks_ref,
+    state_ref,
+    *,
+    unroll: int,
+    tile_sub: int,
+    interleave2: bool = False,
+):
     """``unroll`` chained SHA1 block steps for one ``tile_sub*128``-piece tile.
 
     words_ref:   u32[1, unroll, 16, tile_sub, 128] — this step's schedule words
     nblocks_ref: i32[1, tile_sub, 128]             — per-piece chain lengths
     state_ref:   u32[1, 5, tile_sub, 128]          — running digest state
                  (revisited across the k grid axis; read once, written once)
+    ``interleave2``: split the tile's sublanes in half and advance the
+    two halves' round chains alternately (see _one_block_x2).
     """
     k = pl.program_id(1)
 
@@ -127,11 +167,20 @@ def _sha1_kernel(words_ref, nblocks_ref, state_ref, *, unroll: int, tile_sub: in
             state_ref[0, i] = jnp.full((tile_sub, TILE_LANE), v, dtype=jnp.uint32)
 
     nblocks = nblocks_ref[0]
+    half = tile_sub // 2
 
     def body(j, state):
         # Dynamic index on a leading (untiled) VMEM axis — one contiguous slab.
         w = [words_ref[0, j, t] for t in range(16)]
-        new = _one_block(state, w)
+        if interleave2:
+            sa = tuple(s[:half] for s in state)
+            sb = tuple(s[half:] for s in state)
+            na, nb = _one_block_x2(sa, [x[:half] for x in w], sb, [x[half:] for x in w])
+            new = tuple(
+                jnp.concatenate([x, y], axis=0) for x, y in zip(na, nb)
+            )
+        else:
+            new = _one_block(state, w)
         keep = k * unroll + j < nblocks
         return tuple(jnp.where(keep, n, o) for n, o in zip(new, state))
 
@@ -151,8 +200,10 @@ def _swizzle_tile(tile_words_u32: jax.Array, nblk: int, tile_sub: int) -> jax.Ar
     return jnp.transpose(words, (0, 3, 4, 1, 2))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "tile_sub", "unroll"))
-def _sha1_pallas_aligned(data, nblocks, interpret, tile_sub, unroll):
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "tile_sub", "unroll", "interleave2")
+)
+def _sha1_pallas_aligned(data, nblocks, interpret, tile_sub, unroll, interleave2=False):
     """Tile-aligned batch → digest words. ``data`` is u8[B, padded] or
     (fast path) u32[B, padded//4]; B must be a ``tile_sub*128`` multiple.
 
@@ -183,7 +234,12 @@ def _sha1_pallas_aligned(data, nblocks, interpret, tile_sub, unroll):
     nb = nblocks.astype(jnp.int32).reshape(b // tile, tile_sub, TILE_LANE)
 
     call = pl.pallas_call(
-        functools.partial(_sha1_kernel, unroll=unroll, tile_sub=tile_sub),
+        functools.partial(
+            _sha1_kernel,
+            unroll=unroll,
+            tile_sub=tile_sub,
+            interleave2=interleave2,
+        ),
         grid=(1, nblk // unroll),
         in_specs=[
             pl.BlockSpec(
@@ -226,24 +282,33 @@ def sha1_pieces_pallas(
     interpret: bool | None = None,
     tile_sub: int | None = None,
     unroll: int | None = None,
+    interleave2: bool | None = None,
 ) -> jax.Array:
     """Batched SHA1 via the Pallas kernel; pads the batch to a tile multiple.
 
     ``data`` is ``uint8[B, padded]`` or host-order ``uint32[B, padded//4]``
     (fast path — see module docstring). Rows added by padding get
     ``nblocks=0`` (their chain never runs) and are sliced off the result.
-    ``tile_sub``/``unroll`` default to the env-tunable module constants.
+    ``tile_sub``/``unroll`` default to the env-tunable module constants;
+    ``interleave2`` (env ``TORRENT_TPU_SHA1_INTERLEAVE2``, default off)
+    selects the 2-way round-chain interleave variant — opt-in until an
+    on-device A/B says it wins (tools/tune_sha1.py).
     """
     if interpret is None:
         interpret = _auto_interpret()
     ts = TILE_SUB if tile_sub is None else tile_sub
     un = UNROLL if unroll is None else unroll
+    il2 = INTERLEAVE2 if interleave2 is None else interleave2
     _check_tiling(ts, un)
+    if il2 and (ts < 16 or (ts // 2) % 8):
+        raise ValueError(
+            f"interleave2 needs tile_sub >= 16 with 8-sublane halves, got {ts}"
+        )
     tile = ts * TILE_LANE
     b = data.shape[0]
     bp = ((b + tile - 1) // tile) * tile
     if bp != b:
         data = jnp.pad(data, ((0, bp - b), (0, 0)))
         nblocks = jnp.pad(nblocks, (0, bp - b))
-    out = _sha1_pallas_aligned(data, nblocks, interpret, ts, un)
+    out = _sha1_pallas_aligned(data, nblocks, interpret, ts, un, il2)
     return out[:b]

@@ -45,11 +45,16 @@ import time
 import numpy as np
 
 
-def _parse_grid(spec: str) -> list[tuple[int, int]]:
+def _parse_grid(spec: str) -> list[tuple[int, int, bool]]:
+    """``32x16`` → (32, 16, False); a trailing ``i`` (``32x16i``)
+    selects the 2-way round-chain interleave variant (sha1_pallas
+    ``interleave2`` — the BASELINE.md roofline knob, off by default in
+    production until this sweep says it wins)."""
     out = []
     for part in spec.split(","):
         ts, un = part.lower().split("x")
-        out.append((int(ts), int(un)))
+        il2 = un.endswith("i")
+        out.append((int(ts), int(un.rstrip("i")), il2))
     return out
 
 
@@ -112,22 +117,28 @@ def run_sweep(
     nblocks = jnp.full((batch,), nblk, dtype=jnp.int32)
 
     results = []
-    for tile_sub, unroll in grid:
+    for tile_sub, unroll, il2 in grid:
+        name = f"{tile_sub}x{unroll}{'i' if il2 else ''}"
         if batch % (tile_sub * 128):
             print(
-                f"# skip {tile_sub}x{unroll}: batch {batch} not a multiple of "
+                f"# skip {name}: batch {batch} not a multiple of "
                 f"tile {tile_sub * 128}",
                 file=sys.stderr,
             )
             continue
 
         @jax.jit
-        def hash_salted(r, t, nb, salt, _ts=tile_sub, _un=unroll):
+        def hash_salted(r, t, nb, salt, _ts=tile_sub, _un=unroll, _il2=il2):
             data = jnp.concatenate(
                 [r ^ salt, jnp.broadcast_to(t, (batch, t.shape[0]))], axis=1
             )
             return sp.sha1_pieces_pallas(
-                data, nb, interpret=interpret, tile_sub=_ts, unroll=_un
+                data,
+                nb,
+                interpret=interpret,
+                tile_sub=_ts,
+                unroll=_un,
+                interleave2=_il2,
             )
 
         reduce_sum = jax.jit(lambda s: jnp.sum(s, dtype=jnp.uint32))
@@ -140,7 +151,12 @@ def run_sweep(
         except Exception as e:  # Mosaic can reject a tiling outright
             print(
                 json.dumps(
-                    {"tile_sub": tile_sub, "unroll": unroll, "error": repr(e)[:200]}
+                    {
+                        "tile_sub": tile_sub,
+                        "unroll": unroll,
+                        "interleave2": il2,
+                        "error": repr(e)[:200],
+                    }
                 )
             )
             continue
@@ -148,7 +164,7 @@ def run_sweep(
             want = np.frombuffer(golden[idx], dtype=">u4").astype(np.uint32)
             if not np.array_equal(got[row], want):
                 raise SystemExit(
-                    f"golden mismatch at {tile_sub}x{unroll} row {idx}: "
+                    f"golden mismatch at {name} row {idx}: "
                     f"{got[row]} != {want}"
                 )
         _ = int(reduce_sum(state0))  # warm the completion-forcing reduction
@@ -164,6 +180,7 @@ def run_sweep(
         line = {
             "tile_sub": tile_sub,
             "unroll": unroll,
+            "interleave2": il2,
             "pieces_per_sec": round(pps, 1),
             "gib_per_sec": round(pps * plen / 2**30, 2),
             "compile_s": round(compile_s, 1),
@@ -181,7 +198,7 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--piece-kb", type=int, default=256)
     ap.add_argument("--batch", type=int, default=4096)
-    ap.add_argument("--grid", default="8x16,16x16,32x8,32x16")
+    ap.add_argument("--grid", default="8x16,16x16,32x8,32x16,32x16i,16x16i")
     ap.add_argument("--iters", type=int, default=8)
     ap.add_argument(
         "--interpret",

@@ -73,6 +73,39 @@ PY
         echo "r5 watch: straight kernel still best ($1x$2) — no re-bank needed"
       fi
     fi
+    # v2: if the (r4 after-phase's) tune_sha256 sweep — which now A/Bs
+    # interleave2 too — picked an interleaved best, bank a v2 rung with
+    # the full tuned env (the r4 ladder's cfgv2d rung predates the knob)
+    v2=$(python - <<'PY'
+import json
+try:
+    rec = json.loads(
+        open(".bench/tune_sha256.jsonl").read().strip().splitlines()[-1]
+    )
+    b = rec["best"]
+    print(
+        f"{b['tile_sub']} {b['unroll']} "
+        f"{1 if b.get('full_unroll') else 0} "
+        f"{1 if b.get('interleave2') else 0}"
+    )
+except Exception:
+    print("")
+PY
+)
+    if [ -n "$v2" ]; then
+      set -- $v2
+      if [ "$4" = "1" ]; then
+        rung .bench/cfgv2e.json TORRENT_TPU_SHA256_TILE_SUB="$1" \
+             TORRENT_TPU_SHA256_UNROLL="$2" \
+             TORRENT_TPU_SHA256_FULL_UNROLL="$3" \
+             TORRENT_TPU_SHA256_INTERLEAVE2=1 BENCH_CONFIG=v2 \
+             BENCH_TOTAL_MB=2048 BENCH_TPU_WAIT=3600
+      else
+        echo "r5 watch: sha256 best is non-interleaved ($1x$2 full=$3) — no cfgv2e rung"
+      fi
+    else
+      echo "r5 watch: no parseable tune_sha256 best (sweep not run or pre-knob jsonl)"
+    fi
     break
   fi
   sleep 900

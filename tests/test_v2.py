@@ -99,6 +99,32 @@ class TestSha256Kernels:
             got = b"".join(int(w).to_bytes(4, "big") for w in words[i])
             assert got == hashlib.sha256(m).digest(), f"msg {i}"
 
+    def test_interleave2_variant_matches_hashlib(self):
+        """SHA-256's 2-way round-chain interleave (the same roofline
+        knob as SHA-1's; composes with FULL_UNROLL on-chip, loop form
+        here) is bit-identical to the straight kernel, and rejects
+        tilings whose halves are not vreg-aligned."""
+        from torrent_tpu.ops.sha256_pallas import sha256_pieces_pallas
+
+        rng = np.random.default_rng(29)
+        msgs = [
+            rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+            for n in (200, 64, 129, 500, 448, 1, 320, 200)
+        ]
+        padded, nblocks = pad_pieces(msgs)
+        words = np.asarray(
+            sha256_pieces_pallas(
+                padded, nblocks, interpret=True, tile_sub=16, interleave2=True
+            )
+        )
+        for i, m in enumerate(msgs):
+            got = b"".join(int(w).to_bytes(4, "big") for w in words[i])
+            assert got == hashlib.sha256(m).digest(), f"msg {i}"
+        with pytest.raises(ValueError, match="interleave2"):
+            sha256_pieces_pallas(
+                padded, nblocks, interpret=True, tile_sub=8, interleave2=True
+            )
+
     def test_pairs_matches_hashlib(self):
         rng = np.random.default_rng(3)
         kids = [rng.bytes(32) for _ in range(64)]

@@ -50,7 +50,19 @@ UNROLL = env_int("TORRENT_TPU_SHA256_UNROLL", _SHA1_UNROLL)
 # ships. tools/tune_sha256 A/B-tests it on the real chip (golden-checked
 # there); interpret mode always falls back to the loop body.
 FULL_UNROLL = bool(env_int("TORRENT_TPU_SHA256_FULL_UNROLL", 0))
+# 2-way round-chain interleave — same roofline knob as the SHA-1
+# kernel's (see ops/sha1_pallas.py _one_block_x2 / BASELINE.md): split
+# the tile's sublanes in half, alternate the halves' rounds in program
+# order. OFF by default; tools/tune_sha256 A/Bs it on-chip. Composes
+# with FULL_UNROLL (straight-line alternation) and with the loop body
+# (interpret-safe alternation inside the group fori_loop).
+INTERLEAVE2 = bool(env_int("TORRENT_TPU_SHA256_INTERLEAVE2", 0))
 _check_tiling(TILE_SUB, UNROLL)  # bad env knobs fail at import, not mid-bench
+if INTERLEAVE2 and (TILE_SUB < 16 or (TILE_SUB // 2) % 8):
+    raise ValueError(
+        "TORRENT_TPU_SHA256_INTERLEAVE2 needs TILE_SUB >= 16 with "
+        f"8-sublane halves, got {TILE_SUB}"
+    )
 
 
 def _one_block256(state, w, kc_ref):
@@ -96,8 +108,68 @@ def _one_block256_unrolled(state, w):
     return tuple(s + n for s, n in zip(state, vars8))
 
 
+def _one_block256_x2(state_a, wa, state_b, wb, kc_ref):
+    """Loop-body compression over TWO independent half-tiles, rounds
+    alternated in program order (interpret-safe: same fori_loop-over-
+    groups shape as _one_block256, carrying both halves)."""
+    va, vb = state_a, state_b
+    for t in range(16):
+        va = _round(va, wa[t], np.uint32(_K256[t]))
+        vb = _round(vb, wb[t], np.uint32(_K256[t]))
+
+    def group(g, carry):
+        va, wa, vb, wb = carry
+        wa, wb = list(wa), list(wb)
+        for i in range(16):
+            wta = _schedule_step(wa, i)
+            wa[i] = wta
+            va = _round(va, wta, kc_ref[g, i])
+            wtb = _schedule_step(wb, i)
+            wb[i] = wtb
+            vb = _round(vb, wtb, kc_ref[g, i])
+        return (va, tuple(wa), vb, tuple(wb))
+
+    va, _, vb, _ = jax.lax.fori_loop(
+        0, 3, group, (va, tuple(wa), vb, tuple(wb))
+    )
+    return (
+        tuple(s + n for s, n in zip(state_a, va)),
+        tuple(s + n for s, n in zip(state_b, vb)),
+    )
+
+
+def _one_block256_x2_unrolled(state_a, wa, state_b, wb):
+    """Straight-line alternation of two half-tiles' 64-round chains —
+    FULL_UNROLL's scheduling freedom plus explicit cross-chain
+    independence. NEVER reached under interpret (same XLA-CPU
+    simplifier trap as _one_block256_unrolled)."""
+    va, vb = state_a, state_b
+    for t in range(64):
+        if t < 16:
+            wta, wtb = wa[t], wb[t]
+        else:
+            wta = _schedule_step(wa, t % 16)
+            wa[t % 16] = wta
+            wtb = _schedule_step(wb, t % 16)
+            wb[t % 16] = wtb
+        va = _round(va, wta, np.uint32(_K256[t]))
+        vb = _round(vb, wtb, np.uint32(_K256[t]))
+    return (
+        tuple(s + n for s, n in zip(state_a, va)),
+        tuple(s + n for s, n in zip(state_b, vb)),
+    )
+
+
 def _sha256_kernel(
-    words_ref, nblocks_ref, kc_ref, state_ref, *, unroll: int, tile_sub: int, full: bool
+    words_ref,
+    nblocks_ref,
+    kc_ref,
+    state_ref,
+    *,
+    unroll: int,
+    tile_sub: int,
+    full: bool,
+    interleave2: bool = False,
 ):
     k = pl.program_id(1)
 
@@ -107,10 +179,23 @@ def _sha256_kernel(
             state_ref[0, i] = jnp.full((tile_sub, TILE_LANE), v, dtype=jnp.uint32)
 
     nblocks = nblocks_ref[0]
+    half = tile_sub // 2
 
     def body(j, state):
         w = [words_ref[0, j, t] for t in range(16)]
-        if full:
+        if interleave2:
+            sa = tuple(s[:half] for s in state)
+            sb = tuple(s[half:] for s in state)
+            wa = [x[:half] for x in w]
+            wb = [x[half:] for x in w]
+            if full:
+                na, nb = _one_block256_x2_unrolled(sa, wa, sb, wb)
+            else:
+                na, nb = _one_block256_x2(sa, wa, sb, wb, kc_ref)
+            new = tuple(
+                jnp.concatenate([x, y], axis=0) for x, y in zip(na, nb)
+            )
+        elif full:
             new = _one_block256_unrolled(state, w)
         else:
             new = _one_block256(state, w, kc_ref)
@@ -127,9 +212,12 @@ def _sha256_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("interpret", "tile_sub", "unroll", "full_unroll")
+    jax.jit,
+    static_argnames=("interpret", "tile_sub", "unroll", "full_unroll", "interleave2"),
 )
-def _sha256_pallas_aligned(data, nblocks, interpret, tile_sub, unroll, full_unroll):
+def _sha256_pallas_aligned(
+    data, nblocks, interpret, tile_sub, unroll, full_unroll, interleave2=False
+):
     tile = tile_sub * TILE_LANE
     b = data.shape[0]
     if data.dtype == jnp.uint32:
@@ -155,6 +243,7 @@ def _sha256_pallas_aligned(data, nblocks, interpret, tile_sub, unroll, full_unro
             # interpret lowers through XLA CPU, whose simplifier hangs on
             # the straight-line body — the loop body is mandatory there
             full=bool(full_unroll) and not interpret,
+            interleave2=interleave2,
         ),
         grid=(1, nblk // unroll),
         in_specs=[
@@ -193,8 +282,13 @@ def sha256_pieces_pallas(
     tile_sub: int | None = None,
     unroll: int | None = None,
     full_unroll: bool | None = None,
+    interleave2: bool | None = None,
 ) -> jax.Array:
-    """Batched SHA-256 via Pallas; pads the batch to a tile multiple."""
+    """Batched SHA-256 via Pallas; pads the batch to a tile multiple.
+
+    ``interleave2`` (env ``TORRENT_TPU_SHA256_INTERLEAVE2``, default
+    off) alternates two half-tiles' round chains — see the SHA-1
+    kernel's variant; composes with ``full_unroll``."""
     from torrent_tpu.ops.sha1_pallas import _auto_interpret
 
     if interpret is None:
@@ -202,12 +296,17 @@ def sha256_pieces_pallas(
     ts = TILE_SUB if tile_sub is None else tile_sub
     un = UNROLL if unroll is None else unroll
     fu = FULL_UNROLL if full_unroll is None else full_unroll
+    il2 = INTERLEAVE2 if interleave2 is None else interleave2
     _check_tiling(ts, un)
+    if il2 and (ts < 16 or (ts // 2) % 8):
+        raise ValueError(
+            f"interleave2 needs tile_sub >= 16 with 8-sublane halves, got {ts}"
+        )
     tile = ts * TILE_LANE
     b = data.shape[0]
     bp = ((b + tile - 1) // tile) * tile
     if bp != b:
         data = jnp.pad(data, ((0, bp - b), (0, 0)))
         nblocks = jnp.pad(nblocks, (0, bp - b))
-    out = _sha256_pallas_aligned(data, nblocks, interpret, ts, un, fu)
+    out = _sha256_pallas_aligned(data, nblocks, interpret, ts, un, fu, il2)
     return out[:b]

@@ -100,7 +100,13 @@ def run_sweep(
     # real Mosaic — interpret mode would hang the XLA CPU simplifier —
     # and has no off-chip validation, so it is swept as an EXTRA
     # candidate with golden mismatches recorded, never fatal
-    variants = [False] if interpret else [False, True]
+    # (full_unroll, interleave2) combos: straight-line only on Mosaic;
+    # the loop-form interleave IS interpret-safe, so smoke covers it
+    variants = (
+        [(False, False), (False, True)]
+        if interpret
+        else [(False, False), (True, False), (False, True), (True, True)]
+    )
     for tile_sub, unroll in grid:
         if batch % (tile_sub * 128):
             print(
@@ -111,20 +117,29 @@ def run_sweep(
             continue
       # fall through to the per-variant loop below
 
-        for full in variants:
+        for full, il2 in variants:
+            if il2 and (tile_sub < 16 or (tile_sub // 2) % 8):
+                continue  # halves must be whole vregs
 
             @jax.jit
-            def hash_salted(r, t, nb, salt, _ts=tile_sub, _un=unroll, _fu=full):
+            def hash_salted(
+                r, t, nb, salt, _ts=tile_sub, _un=unroll, _fu=full, _il2=il2
+            ):
                 data = jnp.concatenate(
                     [r ^ salt, jnp.broadcast_to(t, (batch, t.shape[0]))], axis=1
                 )
                 return sp.sha256_pieces_pallas(
                     data, nb, interpret=interpret, tile_sub=_ts, unroll=_un,
-                    full_unroll=_fu,
+                    full_unroll=_fu, interleave2=_il2,
                 )
 
             reduce_sum = jax.jit(lambda s: jnp.sum(s, dtype=jnp.uint32))
-            tag = {"tile_sub": tile_sub, "unroll": unroll, "full_unroll": full}
+            tag = {
+                "tile_sub": tile_sub,
+                "unroll": unroll,
+                "full_unroll": full,
+                "interleave2": il2,
+            }
 
             try:
                 t0 = time.perf_counter()
@@ -138,9 +153,11 @@ def run_sweep(
             for row, idx in ((0, 0), (1, batch - 1)):
                 want = np.frombuffer(golden[idx], dtype=">u4").astype(np.uint32)
                 if not np.array_equal(got[row], want):
-                    if full:
-                        # the experimental body failed its on-chip golden:
-                        # record and move on — never poison the sweep
+                    if full or il2:
+                        # an experimental on-chip body (straight-line or
+                        # interleaved — both invisible to CPU-interpret
+                        # smoke) failed its golden: record and move on —
+                        # never poison the sweep
                         print(json.dumps({**tag, "error": "golden mismatch"}))
                         bad = True
                         break

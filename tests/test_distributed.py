@@ -43,6 +43,51 @@ def _worker_env() -> dict:
     return env
 
 
+def _run_workers(workdir, nproc: int, ndev: int, torrent, mode=None) -> list:
+    """Spawn `nproc` distributed_worker.py processes and return their
+    result_<pid>.json payloads. One worker failing leaves its peers
+    blocked inside a collective forever, so ALL handles are killed on
+    any error path (CPU-only workers hold no device grant — killing is
+    safe here, unlike TPU-touching processes)."""
+    coordinator = f"localhost:{_free_port()}"
+    env = _worker_env()
+    argv_tail = [str(workdir), str(torrent)] + ([mode] if mode else [])
+    workers = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                os.path.join(REPO, "tests", "distributed_worker.py"),
+                coordinator,
+                str(nproc),
+                str(pid),
+                str(ndev),
+                *argv_tail,
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in range(nproc)
+    ]
+    outs = []
+    try:
+        for pid, w in enumerate(workers):
+            _, err = w.communicate(timeout=540)
+            assert w.returncode == 0, f"worker {pid} failed:\n{err[-3000:]}"
+            # results come via file, not stdout: Gloo's C++ transport
+            # logs to stdout concurrently and can interleave mid-line
+            outs.append(
+                json.loads((workdir / f"result_{pid}.json").read_text())
+            )
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.communicate()
+    return outs
+
+
 def test_two_process_dcn_verify(tmp_path):
     # bounded by communicate(timeout=540); CPU-only workers are safe to
     # kill on overrun (no device grant is ever held)
@@ -88,34 +133,7 @@ def test_two_process_dcn_verify(tmp_path):
     ]
     assert expected.count(False) == 1 and not expected[corrupt_idx]
 
-    coordinator = f"localhost:{_free_port()}"
-    env = _worker_env()
-    workers = [
-        subprocess.Popen(
-            [
-                sys.executable,
-                os.path.join(REPO, "tests", "distributed_worker.py"),
-                coordinator,
-                "2",
-                str(pid),
-                "4",
-                str(workdir),
-                str(torrent),
-            ],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-        )
-        for pid in range(2)
-    ]
-    outs = []
-    for pid, w in enumerate(workers):
-        _, err = w.communicate(timeout=540)
-        assert w.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        # results come via file, not stdout: Gloo's C++ transport logs
-        # to stdout concurrently and can interleave mid-line
-        outs.append(json.loads((workdir / f"result_{pid}.json").read_text()))
+    outs = _run_workers(workdir, 2, 4, torrent)
 
     for rec in outs:
         assert rec["process_count"] == 2
@@ -181,35 +199,7 @@ def test_two_process_dcn_library(tmp_path):
         )
     assert expected[1][4] == "0" and expected[1].count("0") == 1
 
-    coordinator = f"localhost:{_free_port()}"
-    env = _worker_env()
-    workers = [
-        subprocess.Popen(
-            [
-                sys.executable,
-                os.path.join(REPO, "tests", "distributed_worker.py"),
-                coordinator,
-                "2",
-                str(pid),
-                "4",
-                str(workdir),
-                "-",
-                "library",
-            ],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-        )
-        for pid in range(2)
-    ]
-    outs = []
-    for pid, w in enumerate(workers):
-        _, err = w.communicate(timeout=540)
-        assert w.returncode == 0, f"library worker failed:\n{err[-3000:]}"
-        # results come via file, not stdout: Gloo's C++ transport logs
-        # to stdout concurrently and can interleave mid-line
-        outs.append(json.loads((workdir / f"result_{pid}.json").read_text()))
+    outs = _run_workers(workdir, 2, 4, "-", mode="library")
 
     total = sum(n_pieces_per)
     for rec in outs:
@@ -218,3 +208,51 @@ def test_two_process_dcn_library(tmp_path):
     # identical global view on every process (pid aside)
     assert outs[0]["bitfields"] == outs[1]["bitfields"]
     assert outs[0]["n_valid"] == outs[1]["n_valid"]
+
+
+def test_three_process_dcn_verify(tmp_path):
+    """Odd process count: 3 processes x 2 virtual devices each — the
+    (hosts=3, dp=2) mesh, a final global batch where some processes'
+    slices are entirely out of range, and a 3-way allgather must still
+    produce the identical hashlib-true view everywhere."""
+    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.tools.make_torrent import make_torrent
+
+    plen = 16384
+    rng = np.random.default_rng(17)
+    workdir = tmp_path / "data3"
+    payload_dir = workdir / "p3"
+    payload_dir.mkdir(parents=True)
+    # 13 pieces: the worker's batch_size=8 rounds UP to the mesh-size
+    # multiple B=12 (TPUVerifier round_up), so the final global batch
+    # covers pieces 12..23 — process 0 holds the single real piece 12
+    # and processes 1-2 hold entirely out-of-range slices (k=0)
+    size = 12 * plen + plen // 3
+    (payload_dir / "f.bin").write_bytes(
+        rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+    )
+    torrent = tmp_path / "p3.torrent"
+    torrent.write_bytes(
+        make_torrent(
+            str(payload_dir), "http://t.invalid/announce", piece_length=plen
+        )
+    )
+    meta = parse_metainfo(torrent.read_bytes())
+    n = meta.info.num_pieces
+    assert n == 13
+
+    blob = (payload_dir / "f.bin").read_bytes()
+    expected = "".join(
+        "1"
+        if hashlib.sha1(blob[i * plen : (i + 1) * plen]).digest()
+        == meta.info.pieces[i]
+        else "0"
+        for i in range(n)
+    )
+    assert expected == "1" * n
+
+    outs = _run_workers(workdir, 3, 2, torrent)
+    for rec in outs:
+        assert rec["process_count"] == 3 and rec["devices"] == 6
+        assert rec["bitfield"] == expected
+        assert rec["n_valid"] == n

@@ -1419,3 +1419,55 @@ class TestClientPauseAll:
                 server.close()
 
         run(go())
+
+
+class TestIdleSweep:
+    def test_idle_peer_dropped_by_sweep_not_per_message_timer(self):
+        """Dead-peer protection moved from a per-message wait_for (one
+        timer handle per 16 KiB block — a measured top-5 event-loop cost
+        at full rate) to one idle sweep per torrent: a connected peer
+        whose last_rx goes stale is closed by the sweep and torn down by
+        the ordinary drop path, while an active peer survives."""
+
+        async def go():
+            rng = np.random.default_rng(91)
+            payload = rng.integers(0, 256, size=120_000, dtype=np.uint8).tobytes()
+            server, pump = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            url = f"http://127.0.0.1:{server.http_port}/announce"
+            m = parse_metainfo(build_torrent_bytes(payload, 32768, url.encode()))
+            seed = Client(ClientConfig())
+            leech = Client(ClientConfig())
+            # sweep interval floors at 1 s (peer_timeout/4 would be
+            # 0.5 s) → worst-case drop ~3 s here; keepalives are far
+            # apart so nothing refreshes last_rx once the swarm idles
+            seed.config.torrent = fast_config(peer_timeout=2.0, keepalive_interval=300.0)
+            leech.config.torrent = fast_config(peer_timeout=2.0, keepalive_interval=300.0)
+            await seed.start()
+            await leech.start()
+            try:
+                ss = Storage(MemoryStorage(), m.info)
+                ss.set(0, payload)
+                t_seed = await seed.add(m, ss)
+                t = await leech.add(m, Storage(MemoryStorage(), m.info))
+                await asyncio.wait_for(t.on_complete.wait(), timeout=30)
+                assert len(t.peers) >= 1
+                # freeze every peer's clock into the stale past; both
+                # sides' sweeps must close + drop within ~1.25x timeout
+                import time as _time
+
+                for p in list(t.peers.values()):
+                    p.last_rx = _time.monotonic() - 10.0
+                for _ in range(100):
+                    if not t.peers:
+                        break
+                    await asyncio.sleep(0.1)
+                assert not t.peers, "idle peer not dropped by the sweep"
+            finally:
+                await seed.close()
+                await leech.close()
+                server.close()
+                await asyncio.wait_for(pump, 5)
+
+        run(go())

@@ -21,6 +21,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -86,6 +87,22 @@ def _run_workers(workdir, nproc: int, ndev: int, torrent, mode=None) -> list:
                 w.kill()
                 w.communicate()
     return outs
+
+
+def test_make_mesh_rejects_uneven_process_spread(monkeypatch):
+    """On a real multi-process cluster the host rows must be whole and
+    equal; a device list unevenly spread over processes is a config
+    error, not a silent misalignment."""
+    import types
+
+    import jax
+
+    from torrent_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    fake = [types.SimpleNamespace(process_index=p) for p in (0, 0, 1)]
+    with pytest.raises(ValueError, match="evenly"):
+        make_mesh(devices=fake, n_hosts=2)
 
 
 def test_two_process_dcn_verify(tmp_path):

@@ -11,7 +11,10 @@ processes and against hashlib.
 argv: coordinator nproc pid ndev workdir torrent_path [mode]
 mode: "storage" (default) — verify_storage_distributed of one torrent;
       "library" — verify_library_distributed over every *.torrent in
-      workdir (torrent-level DCN sharding, per-host local mesh).
+      workdir (torrent-level DCN sharding, per-host local mesh);
+      "v2" — BEP 52 recheck via verify_pieces(hasher="tpu") auto-route
+      (per-process piece stride through the per-host merkle plane,
+      bitfield assembled over one allgather).
 """
 
 import glob
@@ -54,6 +57,29 @@ def main() -> None:
 
     from torrent_tpu.codec.metainfo import parse_metainfo
     from torrent_tpu.storage.storage import FsStorage, Storage
+
+    if mode == "v2":
+        # BEP 52: each process takes its stride of the piece space
+        # through the per-host merkle plane; allgather assembles
+        from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+        from torrent_tpu.parallel.verify import verify_pieces
+        from torrent_tpu.session.v2 import v2_session_meta
+
+        with open(torrent_path, "rb") as f:
+            vmeta = v2_session_meta(parse_metainfo_v2(f.read()))
+        storage = Storage(FsStorage(workdir), vmeta.info)
+        bitfield = verify_pieces(storage, vmeta.info, hasher="tpu")
+        _emit(
+            workdir,
+            pid,
+            {
+                "process_count": jax.process_count(),
+                "devices": len(jax.devices()),
+                "bitfield": "".join("1" if b else "0" for b in bitfield),
+                "n_valid": int(bitfield.sum()),
+            },
+        )
+        return
 
     if mode == "library":
         # library mode never touches the global mesh:

@@ -273,3 +273,48 @@ def test_three_process_dcn_verify(tmp_path):
         assert rec["process_count"] == 3 and rec["devices"] == 6
         assert rec["bitfield"] == expected
         assert rec["n_valid"] == n
+
+
+def test_two_process_dcn_v2_verify(tmp_path):
+    """BEP 52 over DCN: pieces are independent merkle trees, so each
+    process rechecks its round-robin stride through the per-host leaf
+    plane and one allgather assembles the bitfield — both processes
+    must agree with each other and with the CPU merkle oracle."""
+    from torrent_tpu.codec.metainfo_v2 import encode_metainfo_v2
+    from torrent_tpu.models.v2 import build_v2
+    from torrent_tpu.parallel.verify import verify_pieces
+    from torrent_tpu.session.v2 import v2_session_meta
+    from torrent_tpu.storage.storage import FsStorage, Storage
+
+    plen = 16384
+    rng = np.random.default_rng(41)
+    workdir = tmp_path / "v2data"
+    workdir.mkdir()
+    payload = rng.integers(
+        0, 256, 11 * plen + plen // 2, dtype=np.uint8
+    ).tobytes()
+    src = workdir / "vp.bin"
+    src.write_bytes(payload)
+    meta = build_v2([(("vp.bin",), str(src))], "vp.bin", plen, hasher="cpu")
+    torrent = tmp_path / "vp.torrent"
+    torrent.write_bytes(encode_metainfo_v2(meta.info, meta.piece_layers))
+
+    # corrupt one mid-file piece on disk
+    buf = bytearray(payload)
+    buf[7 * plen + 5] ^= 0xFF
+    src.write_bytes(bytes(buf))
+
+    vmeta = v2_session_meta(meta)
+    n = vmeta.info.num_pieces
+    oracle = verify_pieces(
+        Storage(FsStorage(str(workdir)), vmeta.info), vmeta.info, hasher="cpu"
+    )
+    expected = "".join("1" if b else "0" for b in oracle)
+    assert expected.count("0") == 1 and expected[7] == "0"
+
+    outs = _run_workers(workdir, 2, 4, torrent, mode="v2")
+    for rec in outs:
+        assert rec["process_count"] == 2
+        assert rec["bitfield"] == expected
+        assert rec["n_valid"] == n - 1
+    assert outs[0]["bitfield"] == outs[1]["bitfield"]

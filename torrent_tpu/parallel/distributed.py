@@ -260,6 +260,41 @@ def verify_storage_distributed(
     return bitfield, n_valid
 
 
+def verify_pieces_v2_distributed(
+    storage,
+    info,
+    batch_size: int = 256,
+    progress_cb=None,
+) -> np.ndarray:
+    """Pod-scale BEP 52 (merkle) recheck: pieces are verified
+    independently, so each process takes its round-robin stride of the
+    piece index space through the ordinary per-host v2 device plane
+    (leaf hashing + fused pair reduction on LOCAL devices — v2 batches
+    are pad-grouped and never need a global mesh), and the disjoint
+    bitfield contributions are OR-assembled over one DCN allgather.
+    Returns the identical full bitfield on every process.
+
+    SPMD contract: every process must call this collectively on the
+    same torrent (the allgather blocks until all arrive). For a
+    host-local-only recheck on a cluster call
+    ``verify_pieces_v2_tpu`` directly.
+    """
+    import jax
+
+    from torrent_tpu.parallel.verify import verify_pieces_v2_tpu
+
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    local = verify_pieces_v2_tpu(
+        storage,
+        info,
+        batch_size=batch_size,
+        progress_cb=progress_cb,
+        indices=range(pid, info.num_pieces, nproc),
+    )
+    return allgather_bitfield(local)
+
+
 def verify_library_distributed(
     items,
     batch_size: int = 1024,

@@ -166,11 +166,17 @@ def verify_pieces_v2_tpu(
     info,
     batch_size: int = 256,
     progress_cb: ProgressCb | None = None,
+    indices=None,
     **_ignored,
 ) -> np.ndarray:
     """Batched device merkle recheck: SHA-256 16 KiB leaves on the hash
     plane, then one batched pair-reduction per tree level across the
-    whole piece batch (models/merkle.py)."""
+    whole piece batch (models/merkle.py).
+
+    ``indices``: optional subset of piece indices to recheck (the
+    multi-host path gives each process its stride); the returned
+    bitfield is always full length, False outside the subset.
+    """
     from torrent_tpu.codec.metainfo_v2 import BLOCK
     from torrent_tpu.models.merkle import merkle_root, words32_to_digests
     from torrent_tpu.models.v2 import _make_leaf_fn
@@ -182,18 +188,20 @@ def verify_pieces_v2_tpu(
     bitfield = np.zeros(n, dtype=bool)
     if n == 0:
         return bitfield
+    todo = range(n) if indices is None else indices
     # group pieces by leaf-pad target: multi-piece files all share
     # blocks-per-piece, single-piece files use their own pow2 count
     by_pad: dict[int, list[int]] = {}
-    for idx in range(n):
+    for idx in todo:
         by_pad.setdefault(info.piece_pad_leaves[idx], []).append(idx)
+    n_todo = sum(len(v) for v in by_pad.values())
     leaf_rows = 1024  # device rows per leaf dispatch (pow2-bucketed fn)
     fn = _make_leaf_fn(leaf_rows, "auto")
     padded, view = alloc_padded(leaf_rows, BLOCK)
     done = 0
-    for pad, indices in by_pad.items():
-        for bstart in range(0, len(indices), batch_size):
-            batch = indices[bstart : bstart + batch_size]
+    for pad, group in by_pad.items():
+        for bstart in range(0, len(group), batch_size):
+            batch = group[bstart : bstart + batch_size]
             buf, lengths = storage.read_batch(batch)
             ok_len = np.array(
                 [lengths[i] == info.piece_sizes[p] for i, p in enumerate(batch)]
@@ -225,7 +233,7 @@ def verify_pieces_v2_tpu(
                 bitfield[p] = bool(ok_len[i]) and roots[i] == info.pieces[p]
             done += m
             if progress_cb:
-                progress_cb(done, n)
+                progress_cb(done, n_todo)
     return bitfield
 
 
@@ -251,6 +259,32 @@ def verify_pieces(
         fn = verify_pieces_v2_cpu if v2 else verify_pieces_cpu
         return fn(storage, info, progress_cb)
     if hasher == "tpu":
-        fn = verify_pieces_v2_tpu if v2 else verify_pieces_tpu
-        return fn(storage, info, progress_cb=progress_cb, **tpu_kwargs)
+        if v2:
+            import jax
+
+            # v2 batches are pad-grouped per host (no global mesh), so
+            # the DCN route keys on process_count alone: on a cluster
+            # every process calls collectively and gets the identical
+            # bitfield; for host-local-only semantics call
+            # verify_pieces_v2_tpu directly. An explicit caller subset
+            # (indices=...) is host-local by definition — the
+            # distributed stride would silently override it, so it
+            # always takes the local path.
+            if jax.process_count() > 1 and "indices" not in tpu_kwargs:
+                from torrent_tpu.parallel.distributed import (
+                    verify_pieces_v2_distributed,
+                )
+
+                return verify_pieces_v2_distributed(
+                    storage,
+                    info,
+                    batch_size=tpu_kwargs.get("batch_size", 256),
+                    progress_cb=progress_cb,
+                )
+            return verify_pieces_v2_tpu(
+                storage, info, progress_cb=progress_cb, **tpu_kwargs
+            )
+        return verify_pieces_tpu(
+            storage, info, progress_cb=progress_cb, **tpu_kwargs
+        )
     raise ValueError(f"unknown hasher {hasher!r}")

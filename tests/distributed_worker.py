@@ -14,7 +14,10 @@ mode: "storage" (default) — verify_storage_distributed of one torrent;
       workdir (torrent-level DCN sharding, per-host local mesh);
       "v2" — BEP 52 recheck via verify_pieces(hasher="tpu") auto-route
       (per-process piece stride through the per-host merkle plane,
-      bitfield assembled over one allgather).
+      bitfield assembled over one allgather);
+      "kernel" — the PALLAS kernel (shard_map over the global mesh, the
+      production pod configuration) fed per-process local rows through
+      verify_batch_global; interpret mode on CPU, tiny pieces.
 """
 
 import glob
@@ -57,6 +60,60 @@ def main() -> None:
 
     from torrent_tpu.codec.metainfo import parse_metainfo
     from torrent_tpu.storage.storage import FsStorage, Storage
+
+    if mode == "kernel":
+        import hashlib
+
+        import numpy as np
+
+        # small tile BEFORE the kernel module import (read at import
+        # time): interpret mode simulates every lane, and the default
+        # 32-sublane tile would pad the batch to 32k rows. Assigned
+        # unconditionally — this worker is a dedicated subprocess, and
+        # an ambient tuning knob must not change the test's geometry
+        os.environ["TORRENT_TPU_SHA1_TILE_SUB"] = "8"
+
+        from torrent_tpu.models.verifier import TPUVerifier
+        from torrent_tpu.ops.padding import digests_to_words, pad_pieces
+        from torrent_tpu.parallel.distributed import psum_valid_count
+        from torrent_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        verifier = TPUVerifier(
+            piece_length=192, batch_size=mesh.size, backend="pallas", mesh=mesh
+        )
+        B = verifier.batch_size
+        L = B // nproc
+        rng = np.random.default_rng(7)  # same seed on every process
+        pieces = [
+            rng.integers(0, 256, 192, dtype=np.uint8).tobytes()
+            for _ in range(B)
+        ]
+        padded, nblocks = pad_pieces(pieces)
+        expected = digests_to_words(
+            [hashlib.sha1(p).digest() for p in pieces]
+        )
+        # corrupt one global row owned by the LAST process
+        bad = (nproc - 1) * L
+        padded = padded.copy()
+        padded[bad, 0] ^= 0xFF
+        lo = pid * L
+        ok_local, ok_global = verifier.verify_batch_global(
+            padded[lo : lo + L], nblocks[lo : lo + L], expected[lo : lo + L]
+        )
+        total = psum_valid_count(verifier.mesh, ok_global)
+        _emit(
+            workdir,
+            pid,
+            {
+                "process_count": jax.process_count(),
+                "devices": len(jax.devices()),
+                "ok_local": [bool(b) for b in ok_local],
+                "psum_total": int(total),
+                "tile_sub": verifier.tile_sub,
+            },
+        )
+        return
 
     if mode == "v2":
         # BEP 52: each process takes its stride of the piece space

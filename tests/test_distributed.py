@@ -318,3 +318,24 @@ def test_two_process_dcn_v2_verify(tmp_path):
         assert rec["bitfield"] == expected
         assert rec["n_valid"] == n - 1
     assert outs[0]["bitfield"] == outs[1]["bitfield"]
+
+
+def test_two_process_dcn_pallas_kernel(tmp_path):
+    """The PALLAS kernel across a real process boundary — the exact
+    production pod configuration: shard_map over the global (hosts, dp)
+    mesh inside jit, per-process local rows in, per-process bools out,
+    stats psum'd over DCN. A corrupted row owned by process 1 must flip
+    exactly there, and both processes' psum totals must agree."""
+    outs = _run_workers(tmp_path, 2, 4, "-", mode="kernel")
+    B = None
+    for rec in outs:
+        assert rec["process_count"] == 2 and rec["devices"] == 8
+        assert rec["tile_sub"] == 8
+        L = len(rec["ok_local"])
+        B = 2 * L
+        assert rec["psum_total"] == B - 1
+    # process 0's rows are all valid; process 1's first row is the
+    # corrupted one
+    assert all(outs[0]["ok_local"])
+    assert not outs[1]["ok_local"][0]
+    assert all(outs[1]["ok_local"][1:])

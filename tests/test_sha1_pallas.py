@@ -93,3 +93,28 @@ class TestPallasKernel:
             sha1_pieces_pallas(
                 padded, nblocks, interpret=True, tile_sub=8, interleave2=True
             )
+
+    def test_experimental_knobs_default_off(self, monkeypatch):
+        """Regression: ``bool(env_int(name, 0))`` silently returned True
+        because env_int clamps to minimum=1 — which had flipped every
+        'off by default' experimental kernel body ON (caught by the
+        2-process pallas-kernel test tripping the interleave guard).
+        The boolean knobs must parse through env_bool and default OFF."""
+        from torrent_tpu.ops import sha1_pallas as s1
+        from torrent_tpu.ops import sha256_pallas as s2
+        from torrent_tpu.utils.env import env_bool
+
+        assert s1.INTERLEAVE2 is False
+        assert s2.INTERLEAVE2 is False
+        assert s2.FULL_UNROLL is False
+        monkeypatch.delenv("X_KNOB", raising=False)
+        assert env_bool("X_KNOB") is False
+        assert env_bool("X_KNOB", default=True) is True
+        for truthy in ("1", "true", "YES", "on"):
+            monkeypatch.setenv("X_KNOB", truthy)
+            assert env_bool("X_KNOB") is True
+        for falsy in ("0", "false", "No", "off", ""):
+            monkeypatch.setenv("X_KNOB", falsy)
+            assert env_bool("X_KNOB", default=True) is False
+        monkeypatch.setenv("X_KNOB", "banana")
+        assert env_bool("X_KNOB") is False

@@ -47,7 +47,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from torrent_tpu.ops.sha1_jax import _IV, _K, _bswap32, _rotl
-from torrent_tpu.utils.env import env_int
+from torrent_tpu.utils.env import env_bool, env_int
 
 TILE_LANE = 128
 # Default pieces-per-program sublane rows; see the sweep table above.
@@ -62,7 +62,7 @@ UNROLL = env_int("TORRENT_TPU_SHA1_UNROLL", 16)
 # 2-way round-chain interleave (BASELINE.md roofline's named knob):
 # OFF by default — only an on-device A/B (tools/tune_sha1.py) should
 # ever turn it on, exactly like the sha256 FULL_UNROLL variant.
-INTERLEAVE2 = bool(env_int("TORRENT_TPU_SHA1_INTERLEAVE2", 0))
+INTERLEAVE2 = env_bool("TORRENT_TPU_SHA1_INTERLEAVE2")
 
 
 def _check_tiling(tile_sub: int, unroll: int) -> None:

@@ -33,7 +33,7 @@ from torrent_tpu.ops.sha1_pallas import (
     _swizzle_tile,
 )
 from torrent_tpu.ops.sha256_jax import _IV256, _K256, _round, _schedule_step
-from torrent_tpu.utils.env import env_int
+from torrent_tpu.utils.env import env_bool, env_int
 
 # SHA-256's sweet spot need not match SHA-1's (different rounds/registers
 # per block and the leaf plane's 16 KiB rows vs 256 KiB pieces) — own
@@ -49,14 +49,14 @@ UNROLL = env_int("TORRENT_TPU_SHA256_UNROLL", _SHA1_UNROLL)
 # where straight-line code is exactly what the SHA-1 kernel already
 # ships. tools/tune_sha256 A/B-tests it on the real chip (golden-checked
 # there); interpret mode always falls back to the loop body.
-FULL_UNROLL = bool(env_int("TORRENT_TPU_SHA256_FULL_UNROLL", 0))
+FULL_UNROLL = env_bool("TORRENT_TPU_SHA256_FULL_UNROLL")
 # 2-way round-chain interleave — same roofline knob as the SHA-1
 # kernel's (see ops/sha1_pallas.py _one_block_x2 / BASELINE.md): split
 # the tile's sublanes in half, alternate the halves' rounds in program
 # order. OFF by default; tools/tune_sha256 A/Bs it on-chip. Composes
 # with FULL_UNROLL (straight-line alternation) and with the loop body
 # (interpret-safe alternation inside the group fori_loop).
-INTERLEAVE2 = bool(env_int("TORRENT_TPU_SHA256_INTERLEAVE2", 0))
+INTERLEAVE2 = env_bool("TORRENT_TPU_SHA256_INTERLEAVE2")
 _check_tiling(TILE_SUB, UNROLL)  # bad env knobs fail at import, not mid-bench
 if INTERLEAVE2 and (TILE_SUB < 16 or (TILE_SUB // 2) % 8):
     raise ValueError(

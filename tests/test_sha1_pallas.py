@@ -118,3 +118,50 @@ class TestPallasKernel:
             assert env_bool("X_KNOB", default=True) is False
         monkeypatch.setenv("X_KNOB", "banana")
         assert env_bool("X_KNOB") is False
+
+    def test_adaptive_tile_sub_for_big_pieces(self, monkeypatch):
+        """BASELINE config 4's host-side regime (models/verifier.py):
+        big pieces shrink the per-program sublane count so one tile slab
+        stays inside TORRENT_TPU_TILE_BYTES, stepping by 8s; the batch
+        rounds to the adapted tile multiple. Then a real (interpret)
+        verify runs through an adapted tile to prove the geometry end
+        to end."""
+        from torrent_tpu.models.verifier import TPUVerifier
+        from torrent_tpu.ops.padding import digests_to_words
+
+        # the production budget knob must not leak in from a bench host
+        monkeypatch.delenv("TORRENT_TPU_TILE_BYTES", raising=False)
+        # 1 MiB pieces at the production 1.25 GiB budget: 32 sublanes
+        # would need 32*128*1048704 B ≈ 4.3 GiB → floor at 8
+        # (8*128*1 MiB ≈ 1.07 GiB/slab)
+        v = TPUVerifier(piece_length=1 << 20, batch_size=1, backend="pallas")
+        assert v.tile_sub == 8
+        assert v.batch_size % (v.tile_sub * 128) == 0
+        # 512 KiB lands on the intermediate 16 (32→24 still >1.25 GiB)
+        vm = TPUVerifier(piece_length=524288, batch_size=1, backend="pallas")
+        assert vm.tile_sub == 16
+        # small pieces keep the tuned default
+        v2 = TPUVerifier(piece_length=262144, batch_size=1, backend="pallas")
+        assert v2.tile_sub == 32
+        # a tiny explicit budget forces the floor of 8
+        monkeypatch.setenv("TORRENT_TPU_TILE_BYTES", str(1 << 20))
+        v3 = TPUVerifier(piece_length=32768, batch_size=1, backend="pallas")
+        assert v3.tile_sub == 8
+
+        # drive the adapted geometry for real: verify a ragged batch of
+        # 16 KiB-class pieces through the tile_sub=8 kernel (interpret)
+        monkeypatch.setenv("TORRENT_TPU_TILE_BYTES", str(600_000))
+        vv = TPUVerifier(piece_length=16384, batch_size=1, backend="pallas")
+        assert vv.tile_sub == 8
+        pieces = [b"\xa7" * 16384, b"\x31" * 10000]
+        padded, nblocks = pad_pieces(pieces)
+        assert padded.shape[1] == vv.padded_len
+        expected = digests_to_words([hashlib.sha1(p).digest() for p in pieces])
+        full_p = np.zeros((vv.batch_size, padded.shape[1]), dtype=np.uint8)
+        full_p[: len(pieces)] = padded
+        full_n = np.zeros(vv.batch_size, dtype=nblocks.dtype)
+        full_n[: len(pieces)] = nblocks
+        full_e = np.zeros((vv.batch_size, 5), dtype=np.uint32)
+        full_e[: len(pieces)] = expected
+        ok = vv.verify_batch(full_p, full_n, full_e)
+        assert ok[0] and ok[1] and not ok[2:].any()

@@ -47,8 +47,9 @@ def test_doctor_cli_reexec_strips_axon_registration(monkeypatch, capsys):
     assert env["JAX_PLATFORMS"] == "cpu"
     assert env["TORRENT_TPU_DOCTOR_AXON_IPS"] == "127.0.0.1"
     # the watchdog printed BEFORE the re-exec: if registration ever
-    # blocks again, the wedge location is named on stdout
-    assert "doctor alive" in capsys.readouterr().out
+    # blocks again, the wedge location is named (on stderr here, since
+    # --json reserves stdout for the one JSON object)
+    assert "doctor alive" in capsys.readouterr().err
 
 
 def test_doctor_env_isolation_roundtrip(monkeypatch):
@@ -111,8 +112,9 @@ def test_doctor_cli_no_reexec_without_pool_var(tmp_path):
         cwd=str(tmp_path),
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
-    assert proc.stdout.count("doctor alive") == 1
-    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert proc.stderr.count("doctor alive") == 1
+    # --json contract: stdout is EXACTLY one JSON object (pipe to jq)
+    summary = json.loads(proc.stdout)
     assert summary["ok"] is True
 
 

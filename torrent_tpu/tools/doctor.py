@@ -14,7 +14,9 @@ one-line remedy on failure:
    real tracker + two Clients
 6. bridge smoke: /v1/digests round-trip on an ephemeral port
 
-Exit code: 0 all PASS/WARN, 1 any FAIL. The reference ships no
+Exit code: 0 all PASS/WARN, 1 any FAIL. With ``--json``, stdout carries
+exactly one JSON object (``doctor --json | jq .`` works); human check
+lines and the watchdog move to stderr. The reference ships no
 equivalent; this exists because a TPU-backed stack has strictly more
 environment to go wrong (plugins, tunnels, kernels, native engine).
 
@@ -52,6 +54,16 @@ import tempfile
 import time
 
 _RESULTS: list[tuple[str, str, str]] = []  # (status, name, detail)
+
+# With --json, stdout must carry exactly one JSON object so
+# `doctor --json | jq .` works; all human/watchdog lines move to stderr
+# (still line-buffered and flushed, so the wedge-location property the
+# watchdog exists for is preserved on either stream).
+_JSON_MODE = False
+
+
+def _say(line: str) -> None:
+    print(line, flush=True, file=sys.stderr if _JSON_MODE else sys.stdout)
 
 # Env vars the CLI re-exec moves the axon pool config into, so the
 # parent interpreter can never trigger plugin registration while the
@@ -110,14 +122,15 @@ def run_cli(argv=None) -> int:
     var is set). Device contact stays in `_check_device`'s bounded
     subprocess, which gets the original env back via `_probe_env`."""
     args = list(sys.argv[1:] if argv is None else argv)
+    global _JSON_MODE
+    _JSON_MODE = "--json" in args  # pre-argparse: keep stdout clean NOW
     # the watchdog line: if nothing else ever prints, this names the
     # wedge location (interpreter started, re-exec about to happen)
-    print(f"doctor alive pid={os.getpid()} — checking environment", flush=True)
+    _say(f"doctor alive pid={os.getpid()} — checking environment")
     if os.environ.get(_AXON_VAR):
-        print(
+        _say(
             f"doctor: re-exec with {_AXON_VAR} stripped so the parent "
-            "skips device-plugin registration (device probe keeps it)",
-            flush=True,
+            "skips device-plugin registration (device probe keeps it)"
         )
         os.execve(
             sys.executable,
@@ -133,7 +146,7 @@ def _report(status: str, name: str, detail: str = "") -> None:
     line = f"[{status}]{pad}{name}"
     if detail:
         line += f" — {detail}"
-    print(line, flush=True)
+    _say(line)
 
 
 def _check_deps() -> bool:
@@ -375,7 +388,12 @@ async def _bridge_smoke() -> None:
 def main(argv=None) -> int:
     import argparse
 
-    ap = argparse.ArgumentParser(prog="torrent-tpu doctor", description=__doc__)
+    # allow_abbrev=False keeps argparse in agreement with run_cli's
+    # pre-argparse exact `"--json" in args` scan (an abbreviated `--js`
+    # would otherwise enable JSON output without the stdout/stderr split)
+    ap = argparse.ArgumentParser(
+        prog="torrent-tpu doctor", description=__doc__, allow_abbrev=False
+    )
     ap.add_argument(
         "--device-wait",
         type=float,
@@ -391,6 +409,8 @@ def main(argv=None) -> int:
         help="emit one JSON object after the checks (machine-readable)",
     )
     args = ap.parse_args(argv)
+    global _JSON_MODE
+    _JSON_MODE = args.json  # direct main() callers (tests, embedding)
 
     def emit_json() -> None:
         if not args.json:
@@ -416,9 +436,9 @@ def main(argv=None) -> int:
     _RESULTS.clear()  # main() may run more than once per process (tests)
     # watchdog before the first import that could block: numpy/jax
     # imports are where a mis-wired plugin environment can stall
-    print("doctor: checking deps…", flush=True)
+    _say("doctor: checking deps…")
     if not _check_deps():
-        print("\n1 FAIL — core dependencies missing")
+        _say("\n1 FAIL — core dependencies missing")
         emit_json()  # the broken-environment case is where JSON matters most
         return 1
     _check_device(args.device_wait)
@@ -439,7 +459,7 @@ def main(argv=None) -> int:
 
     fails = sum(1 for s, _, _ in _RESULTS if s == "FAIL")
     warns = sum(1 for s, _, _ in _RESULTS if s == "WARN")
-    print(f"\n{len(_RESULTS)} checks: {fails} FAIL, {warns} WARN")
+    _say(f"\n{len(_RESULTS)} checks: {fails} FAIL, {warns} WARN")
     emit_json()
     return 1 if fails else 0
 

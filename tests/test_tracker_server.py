@@ -95,6 +95,30 @@ class TestHttpIntegration:
 
         run(with_tracker(go))
 
+    def test_scrape_empty_returns_all(self):
+        # an empty scrape lists every tracked torrent
+        # (in_memory_tracker.ts:149-152)
+        async def go(server, tracker):
+            url = f"http://127.0.0.1:{server.http_port}/announce"
+            await announce(url, make_info(event=AnnounceEvent.STARTED, left=0))
+            await announce(
+                url,
+                AnnounceInfo(
+                    info_hash=H2,
+                    peer_id=b"-TT0001-bbbbbbbbbbbb",
+                    port=7002,
+                    event=AnnounceEvent.STARTED,
+                    left=5,
+                ),
+            )
+            res = await scrape(url, [])
+            by_hash = {e.info_hash: e for e in res}
+            assert set(by_hash) == {H1, H2}
+            assert by_hash[H1].complete == 1
+            assert by_hash[H2].incomplete == 1
+
+        run(with_tracker(go))
+
     def test_invalid_params_failure_reason(self):
         async def go(server, tracker):
             url = f"http://127.0.0.1:{server.http_port}/announce"

@@ -119,9 +119,13 @@ class InMemoryTracker:
     # ------------------------------------------------------------ scrape
 
     async def handle_scrape(self, req: ScrapeRequest) -> None:
-        """(in_memory_tracker.ts:145-164); unknown hashes scrape as zeros."""
+        """(in_memory_tracker.ts:145-164); an empty request scrapes every
+        tracked torrent (ts:149-152). Unknown hashes scrape as zeros
+        rather than rejecting the whole request (deliberate divergence:
+        one stale hash in a batched scrape shouldn't void the rest)."""
+        hashes = req.info_hashes or list(self.files.keys())
         files = []
-        for h in req.info_hashes:
+        for h in hashes:
             info = self.files.get(h)
             if info is None:
                 files.append((h, 0, 0, 0))

@@ -1201,3 +1201,43 @@ class TestRaiseProbeGating:
             assert wan._ext_tolerant and wan._mtu_raise_at > 0
 
         run(go())
+
+
+class TestTransportTeardown:
+    def test_closed_transport_silences_timers(self):
+        """A retransmit timer that outlives the UDP socket must not
+        raise from inside the event loop: closing the *transport*
+        directly (not endpoint.close()) kills the connections via
+        connection_lost, and a straggler sendto is a no-op."""
+
+        async def go():
+            got = asyncio.Event()
+
+            async def consume(r, w):
+                await r.read(1 << 16)
+                got.set()
+
+            server = await utp.create_utp_endpoint(
+                "127.0.0.1", 0, on_accept=consume
+            )
+            try:
+                reader, writer = await utp.open_utp_connection(
+                    "127.0.0.1", server.port, timeout=5
+                )
+                writer.write(b"x" * 4096)
+                await writer.drain()
+                await asyncio.wait_for(got.wait(), 5)
+                conn = writer._conn
+                ep = conn.endpoint
+                # close the raw transport out from under the endpoint
+                ep.transport.close()
+                await asyncio.sleep(0)  # let connection_lost run
+                assert ep.transport is None
+                assert conn.closed
+                # a late timer firing through the dead endpoint: no-op,
+                # no AttributeError from asyncio's fatal-error path
+                ep.sendto(b"stray", ("127.0.0.1", server.port))
+            finally:
+                server.close()
+
+        run(go())

@@ -911,8 +911,19 @@ class UtpEndpoint(asyncio.DatagramProtocol):
         self.transport = transport
         self.port = transport.get_extra_info("sockname")[1]
 
+    def connection_lost(self, exc):
+        # the UDP socket died under us (or a caller closed the transport
+        # directly instead of endpoint.close()): kill every connection so
+        # retransmit/delack timers stop firing into a dead socket
+        for conn in list(self._conns.values()):
+            conn._die(reset=True)
+        self.transport = None
+
     def sendto(self, data: bytes, addr) -> None:
-        if self.transport is not None:
+        # is_closing() too: a retransmit timer can outlive the socket,
+        # and asyncio's DatagramTransport.sendto on a closed transport
+        # raises from deep inside the event loop's fatal-error path
+        if self.transport is not None and not self.transport.is_closing():
             self.transport.sendto(data, addr)
 
     def datagram_received(self, data, addr):

@@ -36,7 +36,7 @@ Env knobs: BENCH_TOTAL_MB (default 1024), BENCH_BATCH (default:
 auto-sized to ~2 GiB of staging per dispatch — 8192 rows at 256 KiB
 pieces, halving as pieces grow; dispatch size dominates throughput on
 this image, see BASELINE.md), BENCH_BACKEND (jax|pallas, default best
-available), BENCH_PLATFORM, BENCH_TPU_WAIT (default 1500 s),
+available), BENCH_PLATFORM, BENCH_TPU_WAIT (default 2700 s),
 BENCH_PIECE_KB (default 256), BENCH_E2E_MB (cap the transfer-bound
 e2e pass of huge configs; plane + baseline stay full-scale).
 

@@ -96,6 +96,8 @@ class _Hasher:
                 out.append(hashlib.sha1(p).digest())
                 if progress and (i + 1) % 64 == 0:
                     progress(i + 1)
+            if progress and out:
+                progress(len(out))  # final count (not a multiple of 64)
             return out
         if self.hasher == "tpu":
             from torrent_tpu.models.verifier import TPUVerifier
@@ -115,6 +117,8 @@ class _Hasher:
                         progress(len(out))
             if batch:
                 out.extend(self._verifier.hash_pieces(batch))
+            if progress and out:
+                progress(len(out))
             return out
         raise ValueError(f"unknown hasher {self.hasher!r}")
 

@@ -1,0 +1,83 @@
+#!/bin/bash
+# Round-5 window sentinel. The ladder probes every ~7.5 min, but the
+# grant windows seen in round 2 lasted only 2-3 min — a window can open
+# and close entirely between ladder probes. Every abandoned probe keeps
+# running though, and writes '"ok": true' into its per-pid log the
+# moment the tunnel heals. This sentinel polls those files every 10 s
+# (pure grep, no device contact) and on first detection immediately
+# runs the ladder's first rungs (micro -> small -> flagship-median),
+# same rules as every ladder: never kill a TPU-touching process, never
+# overwrite a banked non-null record, strictly one bench at a time.
+# The main ladder's own next probe then continues the climb (its rung
+# helper skips whatever this sentinel already banked).
+cd /root/repo
+CACHE=/root/repo/.bench/cpu_baseline.json
+
+banked() {
+  [ -s "$1" ] && python - "$1" <<'PY'
+import json, sys
+try:
+    rec = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if rec.get("value") is not None else 1)
+PY
+}
+
+rung() {
+  local out="$1"; shift
+  if banked "$out"; then
+    echo "skip $out (already banked)"
+    return 0
+  fi
+  env BENCH_NO_REPLAY=1 BENCH_BASELINE_CACHE="$CACHE" "$@" \
+      python bench.py > "$out.tmp" 2> "${out%.json}.err"
+  if banked "$out.tmp"; then
+    mv "$out.tmp" "$out"
+  else
+    if [ -s "$out" ]; then rm -f "$out.tmp"; else mv "$out.tmp" "$out"; fi
+  fi
+  echo "$out attempt done $(date -u): $(cat "$out")"
+}
+
+{
+echo "=== r5 sentinel start $(date -u)"
+for i in $(seq 1 43200); do
+  sleep 10
+  if banked .bench/r5_micro.json || banked .bench/r4_micro.json; then
+    echo "r5 sentinel: micro already banked — standing down $(date -u)"
+    break
+  fi
+  # any late success from an abandoned probe = the tunnel just healed
+  if grep -l '"ok": true' .bench/probe_r4.log.* .bench/probe_r4.log \
+       2>/dev/null | head -1 | grep -q .; then
+    echo "r5 sentinel: WINDOW DETECTED $(date -u)"
+    # clear the evidence first so a closed-then-reopened window
+    # retriggers cleanly rather than instantly re-firing on stale files
+    for f in .bench/probe_r4.log.*; do
+      [ -f "$f" ] && grep -q '"ok": true' "$f" 2>/dev/null && rm -f "$f"
+    done
+    grep -q '"ok": true' .bench/probe_r4.log 2>/dev/null \
+      && sed -i 's/"ok": true/"ok": consumed/' .bench/probe_r4.log
+    # rung 0 — micro: sized for the 2-3 min windows round 2 saw
+    rung .bench/r5_micro.json BENCH_CONFIG=headline BENCH_TOTAL_MB=128 \
+         BENCH_BATCH=512 BENCH_NBATCH=1 BENCH_DISPATCHES=24 \
+         BENCH_E2E_MB=32 BENCH_H2D_MB=16 BENCH_TPU_WAIT=900
+    if ! banked .bench/r5_micro.json; then
+      echo "r5 sentinel: micro banked nothing — back to watching"
+      continue
+    fi
+    # window is real: go straight for the two chip-gated headline items
+    rung .bench/r4_small.json BENCH_CONFIG=headline BENCH_TOTAL_MB=512 \
+         BENCH_BATCH=4096 BENCH_NBATCH=1 BENCH_DISPATCHES=8 \
+         BENCH_E2E_MB=64 BENCH_H2D_MB=32 BENCH_TPU_WAIT=1800
+    # identical invocation to the ladder's rung 2 (median-of-N contract)
+    rung .bench/headline_final.json BENCH_CONFIG=headline \
+         BENCH_TOTAL_MB=2048 BENCH_NBATCH=2 BENCH_DISPATCHES=12 \
+         BENCH_TPU_WAIT=3600
+    echo "r5 sentinel: climb done (micro=$(cat .bench/r5_micro.json 2>/dev/null | head -c 120))"
+    break
+  fi
+done
+echo "=== r5 sentinel exit $(date -u)"
+} >> .bench/r5_sentinel.log 2>&1

@@ -96,6 +96,33 @@ import numpy as np
 _DEFAULT_TPU_WAIT = "2700"
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache for the measuring process.
+
+    Grant windows on this image last 2-3 minutes and a cold compile of
+    the hash plane costs 20-40 s of that. With the cache on disk, a
+    window that closes mid-rung still banks its compile work: the next
+    window (or the next rung at the same shapes) skips straight to
+    execution. Keyed by platform/topology, so CPU smoke runs never
+    pollute TPU entries. Best-effort — a cache failure must never stop
+    a measurement."""
+    try:
+        import jax
+
+        cache_dir = os.environ.get(
+            "BENCH_XLA_CACHE",
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), ".bench", "xla_cache"
+            ),
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # pragma: no cover - version drift diagnostics
+        print(f"# compile cache unavailable: {e!r}", file=sys.stderr)
+
+
 def _env_geometry():
     total_mb = int(os.environ.get("BENCH_TOTAL_MB", "1024"))
     # Dispatch size dominates the hash plane: a ~55 ms fixed per-dispatch
@@ -1096,6 +1123,8 @@ def main() -> None:
             return
 
     import jax
+
+    _enable_compile_cache()
 
     # This image's sitecustomize pins jax_platforms to the device plugin;
     # honor an explicit platform request (e.g. BENCH_PLATFORM=cpu) so the

@@ -39,6 +39,7 @@ import argparse
 import functools
 import hashlib
 import json
+import os
 import sys
 import time
 
@@ -82,6 +83,21 @@ def run_sweep(
         # sitecustomize pins jax_platforms to the device plugin, so the
         # env var alone is not enough)
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # a sweep compiles every grid config — persist the compiles so a
+        # re-sweep (or the bench rung that follows with the winning
+        # knobs) skips straight to execution inside a scarce window
+        try:
+            cache = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+                ".bench",
+                "xla_cache",
+            )
+            os.makedirs(cache, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass
     import jax.numpy as jnp
 
     from torrent_tpu.ops import sha1_pallas as sp

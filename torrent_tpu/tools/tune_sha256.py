@@ -29,6 +29,7 @@ import argparse
 import functools
 import hashlib
 import json
+import os
 import sys
 import time
 
@@ -65,6 +66,19 @@ def run_sweep(
 
     if interpret:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # persist sweep compiles across processes (see tune_sha1.py)
+        try:
+            cache = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+                ".bench",
+                "xla_cache",
+            )
+            os.makedirs(cache, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass
     import jax.numpy as jnp
 
     from torrent_tpu.ops import sha256_pallas as sp

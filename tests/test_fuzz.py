@@ -410,3 +410,81 @@ class TestBep38HintParsers:
         from torrent_tpu.codec.metainfo import parse_similar
 
         assert isinstance(parse_similar({b"info": bad_info}), tuple)
+
+
+class TestMutationCorpusFuzz:
+    """Structure-aware mutation fuzz: take VALID artifacts (the golden
+    reference .torrent fixtures, encoded wire messages, uTP packets) and
+    hit every untrusted-input decoder with byte flips / inserts /
+    deletes / truncations. Complements the hypothesis generators above:
+    mutations of valid inputs reach much deeper into the parsers than
+    grammar-free random bytes. Deterministic (fixed seed), ~4k decoder
+    calls in a few seconds."""
+
+    def test_all_decoders_survive_mutated_corpus(self, ref_fixtures):
+        import random
+
+        from torrent_tpu.codec.bencode import BencodeError, bdecode, bencode
+        from torrent_tpu.codec.magnet import MagnetError, parse_magnet
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.codec.metainfo_v2 import parse_metainfo_v2
+        from torrent_tpu.net import utp
+        from torrent_tpu.net.protocol import ProtocolError, decode_message
+        from torrent_tpu.net.types import unpack_compact_v4, unpack_compact_v6
+
+        rng = random.Random(20260801)
+        corpus = [
+            (ref_fixtures / "singlefile.torrent").read_bytes(),
+            (ref_fixtures / "multifile.torrent").read_bytes(),
+            bencode({b"a": [1, 2, b"x"], b"d": {b"k": 0}}),
+            b"\x06" + b"\x00" * 12,  # request wire message (id + payload)
+            utp.encode_packet(utp.ST_DATA, 7, 1, 0, payload=b"hi"),
+        ]
+
+        def mutate(b: bytes) -> bytes:
+            b = bytearray(b)
+            for _ in range(rng.randint(1, 8)):
+                if not b:
+                    break
+                op = rng.randrange(4)
+                if op == 0:
+                    b[rng.randrange(len(b))] = rng.randrange(256)
+                elif op == 1:
+                    del b[rng.randrange(len(b))]
+                elif op == 2:
+                    b.insert(rng.randrange(len(b) + 1), rng.randrange(256))
+                else:
+                    b = b[: rng.randrange(len(b) + 1)]
+            return bytes(b)
+
+        def gen() -> bytes:
+            if rng.random() < 0.5:
+                return mutate(rng.choice(corpus))
+            return bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 200)))
+
+        for _ in range(500):
+            data = gen()
+            try:
+                bdecode(data)
+            except BencodeError:
+                pass
+            assert parse_metainfo(data) is None or True  # None-or-parse, never raise
+            parse_metainfo_v2(data)
+            if data:
+                try:
+                    decode_message(data[0], data[1:])
+                except ProtocolError:
+                    pass
+            utp.decode_packet(data)  # None on garbage, never raises
+            try:
+                parse_magnet("magnet:?" + data.decode("utf-8", "replace"))
+            except MagnetError:
+                pass
+            try:
+                unpack_compact_v4(data)
+            except ValueError:
+                pass
+            try:
+                unpack_compact_v6(data)
+            except ValueError:
+                pass

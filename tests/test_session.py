@@ -1471,3 +1471,100 @@ class TestIdleSweep:
                 await asyncio.wait_for(pump, 5)
 
         run(go())
+
+
+class TestPeerSlotRecycling:
+    def test_full_peer_list_rotates_instead_of_starving(self, tmp_path):
+        """A swarm larger than max_peers must rotate through the slots.
+
+        Found by a 4x-scale soak (80 disjoint-selection leeches against
+        one seed): with max_peers=50 the first 50 leeches finished their
+        files, went NotInterested, and sat on their slots forever; the
+        other 30 were refused on every redial and the swarm plateaued at
+        exactly 50 leeches' worth of pieces. add_peer now recycles the
+        slot of a mutually-uninterested idle peer (past evict_grace)
+        for a fresh connection. Miniature here: max_peers=2, three
+        leeches each selecting a disjoint file — the third can only
+        ever complete through an eviction."""
+
+        async def go():
+            import os
+
+            rng = np.random.default_rng(77)
+            plen = 16384
+            per_file = 4 * plen  # 4 pieces per file
+            payload = rng.integers(
+                0, 256, size=3 * per_file, dtype=np.uint8
+            ).tobytes()
+            digs = b"".join(
+                hashlib.sha1(payload[i : i + plen]).digest()
+                for i in range(0, len(payload), plen)
+            )
+            server, pump = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            meta = bencode(
+                {
+                    b"announce": b"http://127.0.0.1:%d/announce"
+                    % server.http_port,
+                    b"info": {
+                        b"name": b"rotate",
+                        b"piece length": plen,
+                        b"pieces": digs,
+                        b"files": [
+                            {b"length": per_file, b"path": [b"f%d.bin" % i]}
+                            for i in range(3)
+                        ],
+                    },
+                }
+            )
+            m = parse_metainfo(meta)
+            sd = str(tmp_path / "seed")
+            os.makedirs(os.path.join(sd, "rotate"))
+            for i in range(3):
+                open(os.path.join(sd, "rotate", "f%d.bin" % i), "wb").write(
+                    payload[i * per_file : (i + 1) * per_file]
+                )
+            cfg = dict(max_peers=2, evict_grace=0.3, peer_timeout=60.0)
+            seed = Client(ClientConfig(port=0, enable_upnp=False, resume=False))
+            seed.config.torrent = fast_config(**cfg)
+            leeches = [
+                Client(ClientConfig(port=0, enable_upnp=False, resume=False))
+                for _ in range(3)
+            ]
+            for c in leeches:
+                c.config.torrent = fast_config(**cfg)
+            await seed.start()
+            for c in leeches:
+                await c.start()
+            try:
+                t_seed = await seed.add(m, sd)
+                tls = []
+                for i, c in enumerate(leeches):
+                    d = str(tmp_path / f"l{i}")
+                    os.makedirs(d)
+                    t = await c.add(m, d)
+                    await t.select_files([i])
+                    tls.append(t)
+                for _ in range(600):  # 60 s budget
+                    if all(t.status()["wanted_left"] == 0 for t in tls):
+                        break
+                    await asyncio.sleep(0.1)
+                assert all(
+                    t.status()["wanted_left"] == 0 for t in tls
+                ), [t.status()["wanted_left"] for t in tls]
+                # the cap itself held the whole time
+                assert len(t_seed.peers) <= 2
+                for i in range(3):
+                    got = open(
+                        str(tmp_path / f"l{i}" / "rotate" / f"f{i}.bin"), "rb"
+                    ).read()
+                    assert got == payload[i * per_file : (i + 1) * per_file]
+            finally:
+                await seed.close()
+                for c in leeches:
+                    await c.close()
+                server.close()
+                pump.cancel()
+
+        run(go(), timeout=90)

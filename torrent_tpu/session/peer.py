@@ -81,6 +81,9 @@ class PeerConnection:
 
     last_rx: float = field(default_factory=time.monotonic)
     last_tx: float = field(default_factory=time.monotonic)
+    # registration time: slot recycling must not evict a connection so
+    # fresh it hasn't had a chance to express interest yet
+    connected_at: float = field(default_factory=time.monotonic)
     # last time a *piece block* arrived (anti-snubbing; last_rx counts any
     # message, keepalives included, so it can't detect a data stall)
     last_block_rx: float = field(default_factory=time.monotonic)

@@ -96,6 +96,13 @@ class TorrentConfig:
     snub_timeout: float = 30.0  # no block for this long → free its requests
     keepalive_interval: float = 100.0
     peer_timeout: float = 240.0
+    # Slot recycling: when the peer list is full, a NEW connection may
+    # evict a mutually-uninterested idle peer (nothing in flight either
+    # way) that has been connected at least this long — a swarm larger
+    # than max_peers must rotate through the slots, not starve. The
+    # grace keeps fresh connections from being evicted before they can
+    # express interest (and bounds eviction thrash).
+    evict_grace: float = 15.0
     announce_retry: float = 30.0
     hasher: str = "cpu"  # 'cpu' | 'tpu' — resume-recheck + batch verify
     verify_batch_size: int = 256
@@ -1156,6 +1163,23 @@ class Torrent:
 
     # ------------------------------------------------------------ peer mgmt
 
+    def _evictable_peer(self):
+        """Pick a peer whose slot can be recycled for a fresh
+        connection: mutually uninterested, nothing in flight either
+        way, past the interest grace period (``config.evict_grace``) —
+        longest-idle first. None when every slot is doing (or may yet
+        do) something."""
+        now = time.monotonic()
+        best = None
+        for p in self.peers.values():
+            if p.peer_interested or p.am_interested or p.inflight:
+                continue
+            if now - p.connected_at < self.config.evict_grace:
+                continue
+            if best is None or p.last_rx < best.last_rx:
+                best = p
+        return best
+
     async def add_peer(
         self,
         peer_id,
@@ -1187,8 +1211,22 @@ class Torrent:
                 return
             self._drop_peer(existing)  # replaced by the agreed survivor
         if len(self.peers) >= self.config.max_peers:
-            writer.close()
-            return
+            # Slot recycling: a full peer list must not be a permanent
+            # wall. A swarm larger than max_peers otherwise starves —
+            # peers that already got what they wanted (not interested,
+            # nothing in flight either way) sit on their slot forever
+            # and the excess peers are refused on every retry (observed:
+            # an 80-leech disjoint-selection soak plateaued at exactly
+            # 50 leeches' worth of pieces). Real clients evict an idle
+            # uninterested peer to admit a fresh one; so do we.
+            victim = self._evictable_peer()
+            if victim is None:
+                writer.close()
+                return
+            log.debug(
+                "peer list full — recycling idle slot %r", victim.peer_id[:8]
+            )
+            self._drop_peer(victim)
         if address and address[0] in self._banned:
             writer.close()  # banned peers don't get back in by reconnecting
             return

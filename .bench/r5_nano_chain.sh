@@ -1,0 +1,70 @@
+#!/bin/bash
+# Round-5 nano chain. The 2026-08-02 window proved the pattern: a
+# micro-scale rung (~100-300 MiB staged, dispatch-amortized, e2e/h2d
+# legs capped) banks a real median-of-3 record inside a 2-3 minute
+# window, where the GiB-scale rungs wedge mid-staging. This chain
+# queues micro-scale versions of the four configs that still lack a
+# same-round on-device record, strictly serialized, each parent given a
+# 12 h device wait so the chain itself is the sentinel: the first bench
+# parks on the relay and runs the moment a grant arrives; the rest
+# follow while the window is (hopefully) still open.
+#
+# Order = value: v2 first (fresh SHA-256 plane record), then the 1 MiB
+# piece regime (BASELINE config 4's kernel path, never yet run under
+# real Mosaic — VERDICT r4 Missing #2), then author / multifile / bulk.
+# Ladder rules apply: never kill a TPU-touching process, never
+# overwrite a banked non-null record (rungs skip once banked).
+cd /root/repo
+CACHE=/root/repo/.bench/cpu_baseline.json
+
+banked() {
+  [ -s "$1" ] && python - "$1" <<'PY'
+import json, sys
+try:
+    rec = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if rec.get("value") is not None else 1)
+PY
+}
+
+rung() {
+  local out="$1"; shift
+  if banked "$out"; then
+    echo "skip $out (already banked)"
+    return 0
+  fi
+  env BENCH_NO_REPLAY=1 BENCH_BASELINE_CACHE="$CACHE" BENCH_TPU_WAIT=43200 \
+      "$@" python bench.py > "$out.tmp" 2> "${out%.json}.err"
+  if banked "$out.tmp"; then
+    mv "$out.tmp" "$out"
+  else
+    if [ -s "$out" ]; then rm -f "$out.tmp"; else mv "$out.tmp" "$out"; fi
+  fi
+  echo "$out attempt done $(date -u): $(cat "$out")"
+}
+
+{
+echo "=== r5 nano chain start $(date -u)"
+# v2 micro: 256 MiB of 256 KiB pieces through the full BEP 52 plane
+rung .bench/nano_v2.json BENCH_CONFIG=v2 BENCH_TOTAL_MB=256 \
+     BENCH_V2_NRES=3 BENCH_E2E_MB=16 BENCH_H2D_MB=8
+# config-4 regime micro: 1 MiB pieces -> adaptive tile_sub + per-tile
+# swizzle under real Mosaic for the first time (256 MiB staged)
+rung .bench/nano_cfg4.json BENCH_CONFIG=headline BENCH_PIECE_KB=1024 \
+     BENCH_TOTAL_MB=256 BENCH_BATCH=256 BENCH_NBATCH=1 \
+     BENCH_DISPATCHES=24 BENCH_E2E_MB=16 BENCH_H2D_MB=8
+# author micro (metainfo.ts:141-143 / make_torrent.ts:29-31 analogue)
+rung .bench/nano_author.json BENCH_CONFIG=author BENCH_TOTAL_MB=128 \
+     BENCH_BATCH=512 BENCH_NBATCH=1 BENCH_DISPATCHES=24 \
+     BENCH_E2E_MB=16 BENCH_H2D_MB=8
+# multifile micro at the seed's 512 KiB piece size
+rung .bench/nano_multifile.json BENCH_CONFIG=multifile \
+     BENCH_PIECE_KB=512 BENCH_TOTAL_MB=128 BENCH_BATCH=256 \
+     BENCH_NBATCH=1 BENCH_DISPATCHES=24 BENCH_E2E_MB=16 BENCH_H2D_MB=8
+# bulk micro: 8 libraries x 64 MB (own metric name, extra evidence)
+rung .bench/nano_bulk.json BENCH_CONFIG=bulk BENCH_BULK_N=8 \
+     BENCH_TOTAL_MB=64 BENCH_NBATCH=1 BENCH_DISPATCHES=12 \
+     BENCH_E2E_MB=16 BENCH_H2D_MB=8
+echo "=== r5 nano chain done $(date -u)"
+} >> .bench/nano_chain_r5.log 2>&1

@@ -1,0 +1,53 @@
+#!/bin/bash
+# Round-5 nano chain, phase 2: escalating headline widths. The banked
+# micro record (B=512) and the armed flagship rung (B=8192) differ in
+# dispatch shape; these intermediate widths (B=2048 -> 512 MiB staged,
+# B=4096 -> 1 GiB) map the batch-width effect so the official number's
+# shape sensitivity is measured, not argued about. Waits for phase 1
+# (r5_nano_chain.sh) to finish so the chains stay serialized with each
+# other. rung() here always replaces an un-banked (null) record with
+# the newest attempt's output — phase 1's version could log a stale
+# null under a fresh timestamp (review finding); fixed form below.
+cd /root/repo
+CACHE=/root/repo/.bench/cpu_baseline.json
+
+banked() {
+  [ -s "$1" ] && python - "$1" <<'PY'
+import json, sys
+try:
+    rec = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if rec.get("value") is not None else 1)
+PY
+}
+
+rung() {
+  local out="$1"; shift
+  if banked "$out"; then
+    echo "skip $out (already banked)"
+    return 0
+  fi
+  env BENCH_NO_REPLAY=1 BENCH_BASELINE_CACHE="$CACHE" BENCH_TPU_WAIT=43200 \
+      "$@" python bench.py > "$out.tmp" 2> "${out%.json}.err"
+  # newest attempt always wins while the record is un-banked; a banked
+  # non-null record is protected by the check above
+  mv "$out.tmp" "$out"
+  echo "$out attempt done $(date -u): $(cat "$out")"
+}
+
+{
+echo "=== r5 nano phase 2 start $(date -u)"
+for i in $(seq 1 720); do
+  grep -q "nano chain done" .bench/nano_chain_r5.log 2>/dev/null && break
+  sleep 60
+done
+echo "phase 1 done -> escalating widths $(date -u)"
+rung .bench/nano_h2048.json BENCH_CONFIG=headline BENCH_TOTAL_MB=512 \
+     BENCH_BATCH=2048 BENCH_NBATCH=1 BENCH_DISPATCHES=16 \
+     BENCH_E2E_MB=16 BENCH_H2D_MB=8
+rung .bench/nano_h4096.json BENCH_CONFIG=headline BENCH_TOTAL_MB=1024 \
+     BENCH_BATCH=4096 BENCH_NBATCH=1 BENCH_DISPATCHES=12 \
+     BENCH_E2E_MB=16 BENCH_H2D_MB=8
+echo "=== r5 nano phase 2 done $(date -u)"
+} >> .bench/nano_chain_r5.log 2>&1

@@ -1,0 +1,117 @@
+#!/bin/bash
+# Round-5 nano chain, phase 3: the kernel-variant A/Bs. tune_sha1 /
+# tune_sha256 generate their batches with the device PRNG (only two
+# golden rows cross the tunnel), so unlike the staged benches they are
+# compile-bound, not relay-bound — they can land in windows where even
+# micro staging wedges. This phase answers the BASELINE.md roofline
+# question (does 2-way round-chain interleaving beat the straight
+# kernel?) with on-device data for both hash planes, then — only if a
+# variant wins — banks micro flagship/v2 records with the winning env
+# so the evidence and the record land together. Serialized after
+# phase 2; same ladder rules (skip-once-banked, abandon-never-kill).
+cd /root/repo
+CACHE=/root/repo/.bench/cpu_baseline.json
+
+banked() {
+  [ -s "$1" ] && python - "$1" <<'PY'
+import json, sys
+try:
+    rec = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if rec.get("value") is not None else 1)
+PY
+}
+
+rung() {
+  local out="$1"; shift
+  if banked "$out"; then
+    echo "skip $out (already banked)"
+    return 0
+  fi
+  env BENCH_NO_REPLAY=1 BENCH_BASELINE_CACHE="$CACHE" BENCH_TPU_WAIT=43200 \
+      "$@" python bench.py > "$out.tmp" 2> "${out%.json}.err"
+  mv "$out.tmp" "$out"
+  echo "$out attempt done $(date -u): $(cat "$out")"
+}
+
+{
+echo "=== r5 nano phase 3 start $(date -u)"
+for i in $(seq 1 720); do
+  grep -q "nano phase 2 done" .bench/nano_chain_r5.log 2>/dev/null && break
+  sleep 60
+done
+echo "phase 2 done -> kernel A/Bs $(date -u)"
+
+# SHA-1 interleave A/B at micro batch (2 compiles, device-resident data)
+if [ ! -s .bench/tune_sha1_nano.jsonl ] \
+   || ! grep -q best .bench/tune_sha1_nano.jsonl; then
+  python -m torrent_tpu.tools.tune_sha1 --batch 1024 --iters 4 \
+      --grid 32x16,32x16i \
+      > .bench/tune_sha1_nano.jsonl 2> .bench/tune_sha1_nano.err
+  echo "tune_sha1 nano done $(date -u): $(tail -1 .bench/tune_sha1_nano.jsonl)"
+fi
+
+# SHA-256 variant A/B at micro batch (straight loop vs straight-line
+# unroll vs interleave — the armed-but-never-measured Mosaic bodies)
+if [ ! -s .bench/tune_sha256_nano.jsonl ] \
+   || ! grep -q best .bench/tune_sha256_nano.jsonl; then
+  python -m torrent_tpu.tools.tune_sha256 --batch 4096 --iters 4 \
+      --grid 32x16 \
+      > .bench/tune_sha256_nano.jsonl 2> .bench/tune_sha256_nano.err
+  echo "tune_sha256 nano done $(date -u): $(tail -1 .bench/tune_sha256_nano.jsonl)"
+fi
+
+# bank tuned micro records only where a non-default variant won
+il=$(python - <<'PY'
+import json
+try:
+    rec = json.loads(
+        open(".bench/tune_sha1_nano.jsonl").read().strip().splitlines()[-1]
+    )
+    b = rec["best"]
+    print(f"{b['tile_sub']} {b['unroll']} {1 if b.get('interleave2') else 0}")
+except Exception:
+    print("")
+PY
+)
+if [ -n "$il" ]; then
+  set -- $il
+  if [ "$3" = "1" ]; then
+    rung .bench/nano_h512_il2.json BENCH_CONFIG=headline \
+         BENCH_TOTAL_MB=128 BENCH_BATCH=512 BENCH_NBATCH=1 \
+         BENCH_DISPATCHES=24 BENCH_E2E_MB=16 BENCH_H2D_MB=8 \
+         TORRENT_TPU_SHA1_TILE_SUB="$1" TORRENT_TPU_SHA1_UNROLL="$2" \
+         TORRENT_TPU_SHA1_INTERLEAVE2=1
+  else
+    echo "r5 nano: straight sha1 kernel still best ($1x$2)"
+  fi
+fi
+v2=$(python - <<'PY'
+import json
+try:
+    rec = json.loads(
+        open(".bench/tune_sha256_nano.jsonl").read().strip().splitlines()[-1]
+    )
+    b = rec["best"]
+    print(
+        f"{b['tile_sub']} {b['unroll']} "
+        f"{1 if b.get('full_unroll') else 0} {1 if b.get('interleave2') else 0}"
+    )
+except Exception:
+    print("")
+PY
+)
+if [ -n "$v2" ]; then
+  set -- $v2
+  if [ "$3" = "1" ] || [ "$4" = "1" ]; then
+    rung .bench/nano_v2_tuned.json BENCH_CONFIG=v2 BENCH_TOTAL_MB=256 \
+         BENCH_V2_NRES=3 BENCH_E2E_MB=16 BENCH_H2D_MB=8 \
+         TORRENT_TPU_SHA256_TILE_SUB="$1" TORRENT_TPU_SHA256_UNROLL="$2" \
+         TORRENT_TPU_SHA256_FULL_UNROLL="$3" TORRENT_TPU_SHA256_INTERLEAVE2="$4"
+  else
+    echo "r5 nano: default sha256 body still best ($1x$2)"
+  fi
+fi
+echo "=== r5 nano phase 3 done $(date -u)"
+} >> .bench/nano_chain_r5.log 2>&1

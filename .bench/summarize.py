@@ -1,0 +1,63 @@
+"""Print the current best banked record per metric as a markdown table.
+
+Walks `.bench/live/<metric>.json` (the stable best-record names the
+driver's replay reads) plus the loose `.bench/*.json` rung artifacts,
+and prints one row per metric with value, vs_baseline, measurement
+shape, platform, and when/where it was measured — so a reviewer can
+check every performance claim against its artifact in one look.
+
+Usage: python .bench/summarize.py [--all]   (--all lists rung
+artifacts too, not just the stable live bank)
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+BENCH = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except Exception:
+        return None
+    return rec if isinstance(rec, dict) and rec.get("metric") else None
+
+
+def main() -> None:
+    rows = []
+    for path in sorted(glob.glob(os.path.join(BENCH, "live", "*.json"))):
+        name = os.path.basename(path)
+        # skip timestamped audit copies: metric.<stamp>.json
+        if name.count(".") > 1:
+            continue
+        rec = _load(path)
+        if rec:
+            rows.append((rec, "live/" + name))
+    if "--all" in sys.argv:
+        for path in sorted(glob.glob(os.path.join(BENCH, "*.json"))):
+            rec = _load(path)
+            if rec and rec.get("value") is not None:
+                rows.append((rec, os.path.basename(path)))
+    print("| metric | value | vs_baseline | batch | platform | measured | artifact |")
+    print("|---|---|---|---|---|---|---|")
+    for rec, src in rows:
+        when = (
+            rec.get("banked_at_utc")
+            or rec.get("measured_at_utc")
+            or rec.get("provenance", "")
+        )
+        print(
+            f"| {rec['metric']} | {rec.get('value')} {rec.get('unit', '')} "
+            f"| {rec.get('vs_baseline')} | {rec.get('batch', '—')} "
+            f"| {rec.get('platform', '?')} | {when} | {src} |"
+        )
+
+
+if __name__ == "__main__":
+    main()

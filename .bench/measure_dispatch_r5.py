@@ -1,0 +1,110 @@
+"""Re-measure the v2 crossover's device-side constants on a real grant.
+
+Measures the fixed per-dispatch cost through this image's relay (the
+~55 ms round-2 constant) the same way the tune tools measure kernels:
+device-PRNG input (nothing but the timing results cross the tunnel),
+salted so the remote backend cannot dedup dispatches, completion forced
+by fetching an on-device reduction. A batch of 64 x 256 KiB pieces
+keeps plane time ~1-2 ms, so the median dispatch wall time IS the
+fixed cost to first order; the plane rate itself comes from the banked
+nano_v2 record. Writes `.bench/v2_crossover_device.json` and, if a
+fresh v2 plane record is banked, recomputes the crossover table from
+fresh constants (CPU side re-read from `.bench/v2_crossover.json`).
+
+Run only inside a grant window (phase 4 of the nano chain).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from torrent_tpu.ops.sha256_pallas import sha256_pieces_pallas
+
+    dev = jax.devices()[0]
+    batch = int(os.environ.get("DISPATCH_BATCH", "64"))
+    plen = int(os.environ.get("DISPATCH_PIECE_KB", "256")) * 1024
+    padded = ((plen + 8) // 64 + 1) * 64
+    words = padded // 4
+    nblocks = jnp.full((batch,), padded // 64, dtype=jnp.int32)
+
+    # one jitted program = one dispatch: generate (device PRNG, salted
+    # so the remote backend can't dedup), hash, reduce. The timed wall
+    # time is therefore fixed-dispatch-cost + plane time, and at this
+    # batch the plane term is ~1-2 ms (bounded below in the record).
+    @jax.jit
+    def one_dispatch(salt):
+        key = jax.random.key(20260802)
+        base = jax.random.bits(key, (batch, words), jnp.uint32)
+        d = sha256_pieces_pallas(base ^ salt, nblocks)
+        return jnp.sum(d, dtype=jnp.uint32)
+
+    def one(salt):
+        return one_dispatch(jnp.uint32(salt)).block_until_ready()
+
+    reps = int(os.environ.get("DISPATCH_REPS", "32"))
+    one(0)  # warm compiles
+    times = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        one(i + 1)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    med_ms = times[len(times) // 2] * 1e3
+    plane_ms = batch * plen / (11.9 * (1 << 30)) * 1e3  # upper bound
+    rec = {
+        "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "device": str(dev),
+        "batch": batch,
+        "piece_kb": plen // 1024,
+        "dispatch_ms_median": round(med_ms, 2),
+        "dispatch_ms_p10": round(times[max(0, len(times) // 10)] * 1e3, 2),
+        "dispatch_ms_p90": round(times[-1 - max(0, len(times) // 10)] * 1e3, 2),
+        "plane_ms_upper_bound_in_measurement": round(plane_ms, 2),
+        "n": len(times),
+    }
+    # recompute the crossover table with fresh constants where available
+    try:
+        base = json.load(open(".bench/v2_crossover.json"))
+        plane_gib_s = 11.9
+        try:
+            nano = json.load(open(".bench/nano_v2.json"))
+            if nano.get("value"):
+                plane_gib_s = nano["value"] * 256 * 1024 / (1 << 30)
+                rec["plane_gib_s_source"] = "nano_v2.json"
+        except Exception:
+            pass
+        rows = []
+        for row in base.get("rows", []):
+            plen_i = row["piece_len"]
+            t_cpu = row["cpu_ms_per_piece"]
+            t_dev = plen_i / (plane_gib_s * (1 << 30)) * 1e3
+            denom = t_cpu - t_dev
+            rows.append(
+                {
+                    "piece_len": plen_i,
+                    "cpu_ms_per_piece": t_cpu,
+                    "device_ms_per_piece": round(t_dev, 3),
+                    "crossover_n_relay": (
+                        round(med_ms / denom + 0.5) if denom > 0 else None
+                    ),
+                }
+            )
+        rec["crossover_fresh"] = rows
+        rec["plane_gib_s"] = round(plane_gib_s, 2)
+    except Exception as e:
+        rec["crossover_note"] = f"base table unavailable: {e!r}"
+    with open(".bench/v2_crossover_device.json", "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

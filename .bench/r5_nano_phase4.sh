@@ -1,0 +1,22 @@
+#!/bin/bash
+# Round-5 nano chain, phase 4 (optional tail): re-measure the v2
+# ingest crossover's device-side constants (VERDICT r4 next #7's
+# second half) once the main chains are done. Device-PRNG input, so
+# relay-immune like the tune sweeps. Failure here affects nothing else
+# — the chain artifacts above it are already banked.
+cd /root/repo
+{
+echo "=== r5 nano phase 4 start $(date -u)"
+for i in $(seq 1 720); do
+  grep -q "nano phase 3 done" .bench/nano_chain_r5.log 2>/dev/null && break
+  sleep 60
+done
+echo "phase 3 done -> dispatch-cost re-measure $(date -u)"
+if [ ! -s .bench/v2_crossover_device.json ]; then
+  python .bench/measure_dispatch_r5.py \
+      > .bench/v2_crossover_device.out 2> .bench/v2_crossover_device.err \
+    && echo "dispatch re-measure done $(date -u): $(cat .bench/v2_crossover_device.json)" \
+    || echo "dispatch re-measure failed rc=$? $(date -u)"
+fi
+echo "=== r5 nano phase 4 done $(date -u)"
+} >> .bench/nano_chain_r5.log 2>&1

@@ -7,12 +7,29 @@
 cd /root/repo
 {
 echo "=== r5 nano phase 4 start $(date -u)"
+# single-instance lock (two concurrent phase-4 starts were observed in
+# the log before this guard existed)
+if ! mkdir .bench/phase4.lock 2>/dev/null; then
+  echo "phase 4 already running — exiting $(date -u)"
+  exit 0
+fi
+trap 'rmdir .bench/phase4.lock 2>/dev/null' EXIT
+done_marker=0
 for i in $(seq 1 720); do
-  grep -q "nano phase 3 done" .bench/nano_chain_r5.log 2>/dev/null && break
+  if grep -q "nano phase 3 done" .bench/nano_chain_r5.log 2>/dev/null; then
+    done_marker=1
+    break
+  fi
   sleep 60
 done
+if [ "$done_marker" != 1 ]; then
+  echo "phase 3 never finished within 12 h — phase 4 NOT run $(date -u)"
+  exit 0
+fi
 echo "phase 3 done -> dispatch-cost re-measure $(date -u)"
 if [ ! -s .bench/v2_crossover_device.json ]; then
+  # the script writes its JSON via tmp+rename, so a kill mid-write
+  # can't leave a truncated file that this -s gate would trust
   python .bench/measure_dispatch_r5.py \
       > .bench/v2_crossover_device.out 2> .bench/v2_crossover_device.err \
     && echo "dispatch re-measure done $(date -u): $(cat .bench/v2_crossover_device.json)" \

@@ -72,33 +72,50 @@ def main() -> None:
         times.append(time.perf_counter() - t0)
     times.sort()
     med_ms = times[len(times) // 2] * 1e3
+    # Banked plane rate loaded FIRST so the recorded lower bound and the
+    # crossover rows below agree on the same constant (ADVICE r5: the
+    # bound used the hardcoded 11.9 even when a fresh nano_v2 rate fed
+    # the crossover table).
+    plane_gib_s = 11.9
+    plane_src = "r2_constant"
+    try:
+        nano = json.load(open(".bench/nano_v2.json"))
+        if nano.get("value"):
+            plane_gib_s = nano["value"] * 256 * 1024 / (1 << 30)
+            plane_src = "nano_v2.json"
+    except Exception:
+        pass
     # plane time included in each measured dispatch, AT the banked best
     # rate — a degraded window runs the plane slower, so this is a
     # LOWER bound on the plane term and med_ms - plane_ms_at_banked_rate
     # is an UPPER bound on the fixed dispatch cost
-    plane_ms = batch * plen / (11.9 * (1 << 30)) * 1e3
+    plane_ms = batch * plen / (plane_gib_s * (1 << 30)) * 1e3
+    # percentile guard: below 10 reps a //10 index degenerates (p90
+    # silently reads as the max); report min/max and say so
+    if len(times) >= 10:
+        p10_ms = times[len(times) // 10] * 1e3
+        p90_ms = times[-1 - len(times) // 10] * 1e3
+    else:
+        p10_ms = times[0] * 1e3
+        p90_ms = times[-1] * 1e3
     rec = {
         "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "device": str(dev),
         "batch": batch,
         "piece_kb": plen // 1024,
         "dispatch_ms_median": round(med_ms, 2),
-        "dispatch_ms_p10": round(times[max(0, len(times) // 10)] * 1e3, 2),
-        "dispatch_ms_p90": round(times[-1 - max(0, len(times) // 10)] * 1e3, 2),
+        "dispatch_ms_p10": round(p10_ms, 2),
+        "dispatch_ms_p90": round(p90_ms, 2),
         "plane_ms_at_banked_rate_lower_bound": round(plane_ms, 2),
+        "plane_gib_s": round(plane_gib_s, 2),
+        "plane_gib_s_source": plane_src,
         "n": len(times),
     }
-    # recompute the crossover table with fresh constants where available
+    if len(times) < 10:
+        rec["percentile_note"] = "n<10: p10/p90 reported as min/max"
+    # recompute the crossover table with the same fresh constants
     try:
         base = json.load(open(".bench/v2_crossover.json"))
-        plane_gib_s = 11.9
-        try:
-            nano = json.load(open(".bench/nano_v2.json"))
-            if nano.get("value"):
-                plane_gib_s = nano["value"] * 256 * 1024 / (1 << 30)
-                rec["plane_gib_s_source"] = "nano_v2.json"
-        except Exception:
-            pass
         # same arithmetic as measure_v2_crossover.py (strictly-greater
         # N via int()+1) so the two artifacts agree row-for-row
         disp_colocated = base.get("dispatch_ms_colocated_assumed", 1.0)
@@ -122,7 +139,6 @@ def main() -> None:
                 }
             )
         rec["crossover_fresh"] = rows
-        rec["plane_gib_s"] = round(plane_gib_s, 2)
     except Exception as e:
         rec["crossover_note"] = f"base table unavailable: {e!r}"
     # tmp+rename so a kill mid-write can't leave a truncated file the

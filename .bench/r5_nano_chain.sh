@@ -36,11 +36,11 @@ rung() {
   fi
   env BENCH_NO_REPLAY=1 BENCH_BASELINE_CACHE="$CACHE" BENCH_TPU_WAIT=43200 \
       "$@" python bench.py > "$out.tmp" 2> "${out%.json}.err"
-  if banked "$out.tmp"; then
-    mv "$out.tmp" "$out"
-  else
-    if [ -s "$out" ]; then rm -f "$out.tmp"; else mv "$out.tmp" "$out"; fi
-  fi
+  # newest attempt always wins while the record is un-banked (phase-2
+  # form); a banked non-null record is protected by the check above.
+  # The old keep-the-stale-file branch logged stale content under a
+  # fresh timestamp (ADVICE r5).
+  mv "$out.tmp" "$out"
   echo "$out attempt done $(date -u): $(cat "$out")"
 }
 

@@ -37,7 +37,9 @@ def main() -> None:
         if name.count(".") > 1:
             continue
         rec = _load(path)
-        if rec:
+        # same null filter as the --all branch: a null/tpu_unavailable
+        # record landing in live/ must never print as the current best
+        if rec and rec.get("value") is not None:
             rows.append((rec, "live/" + name))
     if "--all" in sys.argv:
         for path in sorted(glob.glob(os.path.join(BENCH, "*.json"))):

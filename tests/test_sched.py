@@ -1,0 +1,796 @@
+"""Continuous-batching hash-plane scheduler tests (torrent_tpu/sched).
+
+The multi-tenant verify queue is deterministic on CPU: every test here
+runs with the hashlib plane (or the XLA-CPU device plane for parity)
+and proves the ISSUE acceptance criteria without a TPU —
+cross-request coalescing to ≥0.9 batch fill, deadline flush for lone
+small requests, DRR fairness under a greedy + trickle tenant pair,
+typed load-shed mapped to HTTP 429 at the bridge, and CPU-path parity.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import time
+
+import numpy as np
+import pytest
+
+from torrent_tpu.codec.bencode import bdecode, bencode
+from torrent_tpu.sched import HashPlaneScheduler, SchedRejected, SchedulerConfig
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _pieces(n: int, plen: int = 1024, salt: int = 0) -> list[bytes]:
+    return [bytes([(i + salt) % 251]) * plen for i in range(n)]
+
+
+class _StallPlane:
+    """Test plane that blocks until released — pins queue bytes so
+    admission-control behaviour is deterministic, no timing involved."""
+
+    def __init__(self):
+        import threading
+
+        self.release = threading.Event()
+
+    def run(self, payloads):
+        self.release.wait(timeout=30)
+        return [hashlib.sha1(p).digest() for p in payloads]
+
+
+class TestTenantCardinality:
+    def test_idle_auto_tenants_are_evicted(self):
+        """Fresh tenant names per request (attacker-controlled X-Tenant)
+        must not grow per-tenant state without bound: idle auto-registered
+        tenants beyond max_idle_tenants are evicted, pinned ones kept."""
+
+        async def go():
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=4, flush_deadline=0.01, max_idle_tenants=8
+                ),
+                hasher="cpu",
+            )
+            try:
+                sched.register_tenant("pinned", weight=0.5)
+                for j in range(50):
+                    got = await sched.submit(f"rnd{j}", _pieces(1, 256, salt=j))
+                    assert got == [hashlib.sha1(p).digest() for p in _pieces(1, 256, salt=j)]
+                snap = sched.metrics_snapshot()
+                assert len(snap["tenants"]) <= 8 + 1, len(snap["tenants"])
+                assert "pinned" in snap["tenants"]
+                evicted = snap["evicted"]
+                assert evicted["tenants"] >= 40
+                # served totals stay monotonic across eviction
+                live_pieces = sum(
+                    t["served_pieces"] for t in snap["tenants"].values()
+                )
+                assert live_pieces + evicted["served_pieces"] == 50
+                # rotation/queues shrink with the tenants
+                for lane in sched._lanes.values():
+                    assert len(lane.rotation) == len(lane.queues) <= 9
+            finally:
+                await sched.close()
+
+        run(go())
+
+
+class TestStagingReuse:
+    def test_reused_slots_zero_stale_tails(self):
+        """The SHA-1 device plane reuses staging slots across launches;
+        pad_in_place needs zeroed tails, so a long-piece launch followed
+        by shorter pieces in the same slot must still hash correctly
+        (stale-tail zeroing, the classic staging-reuse corruption)."""
+
+        async def go():
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=4, flush_deadline=0.01),
+                hasher="tpu",  # the device plane (XLA CPU here) w/ slots
+            )
+            try:
+                # launch 1: full-bucket pieces dirty the whole slot rows
+                long = [bytes([i]) * 4096 for i in range(4)]
+                got = await sched.submit("t", long, piece_length=4096)
+                assert got == [hashlib.sha1(p).digest() for p in long]
+                # launch 2, same lane: much shorter pieces — stale bytes
+                # beyond each message must not leak into the hash
+                short = [bytes([0x55 + i]) * 100 for i in range(4)]
+                got = await sched.submit("t", short, piece_length=4096)
+                assert got == [hashlib.sha1(p).digest() for p in short]
+                # launch 3: ragged mix, including empty-ish rows
+                mix = [b"x", b"y" * 2000, b"", b"z" * 4096]
+                got = await sched.submit("t", mix, piece_length=4096)
+                assert got == [hashlib.sha1(p).digest() for p in mix]
+            finally:
+                await sched.close()
+
+        run(go())
+
+    def test_pipelined_launches_stay_correct(self):
+        """pipeline_depth=2 runs launches concurrently in worker threads;
+        many batches of distinct payloads through one lane must demux to
+        the right submitters."""
+
+        async def go():
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=8, flush_deadline=0.01, pipeline_depth=2
+                ),
+                hasher="tpu",
+            )
+            try:
+                outs = await asyncio.gather(
+                    *(
+                        sched.submit("t", _pieces(8, 512, salt=j), piece_length=512)
+                        for j in range(12)
+                    )
+                )
+                for j, got in enumerate(outs):
+                    assert got == [
+                        hashlib.sha1(p).digest() for p in _pieces(8, 512, salt=j)
+                    ], f"submission {j} demuxed wrong"
+            finally:
+                await sched.close()
+
+        run(go())
+
+
+class TestParity:
+    @pytest.mark.parametrize("hasher", ["cpu", "tpu"])
+    def test_digests_match_hashlib(self, hasher):
+        """CPU-path fallback parity: same results from the hashlib plane
+        and the device plane (XLA-CPU here), both vs hashlib."""
+
+        async def go():
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=16, flush_deadline=0.01), hasher=hasher
+            )
+            try:
+                pieces = _pieces(23, 700)  # ragged: crosses batch_target
+                got = await sched.submit("t", pieces, algo="sha1")
+                assert got == [hashlib.sha1(p).digest() for p in pieces]
+            finally:
+                await sched.close()
+
+        run(go())
+
+    @pytest.mark.parametrize("hasher", ["cpu", "tpu"])
+    def test_verify_mode_flags(self, hasher):
+        async def go():
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=8, flush_deadline=0.01), hasher=hasher
+            )
+            try:
+                pieces = _pieces(10, 300)
+                expected = [hashlib.sha1(p).digest() for p in pieces]
+                expected[4] = b"\x00" * 20
+                ok = await sched.submit("t", pieces, expected=expected)
+                assert isinstance(ok, bytes) and len(ok) == 10
+                assert ok[4] == 0 and all(ok[i] == 1 for i in range(10) if i != 4)
+            finally:
+                await sched.close()
+
+        run(go())
+
+    def test_sha256_lane(self):
+        async def go():
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=8, flush_deadline=0.01), hasher="cpu"
+            )
+            try:
+                pieces = _pieces(5, 200)
+                got = await sched.submit("t", pieces, algo="sha256")
+                assert got == [hashlib.sha256(p).digest() for p in pieces]
+            finally:
+                await sched.close()
+
+        run(go())
+
+    def test_empty_submission(self):
+        async def go():
+            sched = HashPlaneScheduler(hasher="cpu")
+            try:
+                assert await sched.submit("t", []) == []
+                assert await sched.submit("t", [], expected=[]) == b""
+            finally:
+                await sched.close()
+
+        run(go())
+
+
+class TestAssembler:
+    def test_deadline_flush_for_lone_small_request(self):
+        """A lone 4-piece request must never be stranded behind a big
+        batch target: the deadline timer flushes it."""
+
+        async def go():
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=256, flush_deadline=0.05), hasher="cpu"
+            )
+            try:
+                t0 = time.monotonic()
+                pieces = _pieces(4)
+                got = await asyncio.wait_for(sched.submit("lone", pieces), 5)
+                elapsed = time.monotonic() - t0
+                assert got == [hashlib.sha1(p).digest() for p in pieces]
+                snap = sched.metrics_snapshot()
+                assert snap["flush_reasons"]["deadline"] == 1
+                assert snap["flush_reasons"]["full"] == 0
+                assert elapsed < 3.0
+            finally:
+                await sched.close()
+
+        run(go())
+
+    def test_cross_request_coalescing_fills_batches(self):
+        """≥8 concurrent submitters of small piece counts reach a mean
+        batch-fill ratio ≥0.9 of the configured target."""
+
+        async def go():
+            target = 64
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=target, flush_deadline=0.5), hasher="cpu"
+            )
+            try:
+                # 8 tenants × 32 pieces = 4 exactly-full launches
+                outs = await asyncio.gather(
+                    *(
+                        sched.submit(f"client{j}", _pieces(32, salt=j))
+                        for j in range(8)
+                    )
+                )
+                for j, got in enumerate(outs):
+                    want = [hashlib.sha1(p).digest() for p in _pieces(32, salt=j)]
+                    assert got == want
+                snap = sched.metrics_snapshot()
+                assert snap["launches"] >= 1
+                assert snap["mean_fill"] >= 0.9, snap
+                assert snap["flush_reasons"]["full"] >= 1
+            finally:
+                await sched.close()
+
+        run(go())
+
+    def test_shutdown_flushes_pending(self):
+        """close() launches what's queued (reason 'shutdown') instead of
+        dropping it."""
+
+        async def go():
+            sched = HashPlaneScheduler(
+                # deadline far beyond the test: only shutdown can flush
+                SchedulerConfig(batch_target=1024, flush_deadline=60.0),
+                hasher="cpu",
+            )
+            pieces = _pieces(3)
+            fut = await sched.enqueue("t", pieces)
+            await sched.close()
+            got = await asyncio.wait_for(fut, 5)
+            assert got == [hashlib.sha1(p).digest() for p in pieces]
+            assert sched.metrics_snapshot()["flush_reasons"]["shutdown"] == 1
+
+        run(go())
+
+    def test_geometry_lanes_are_separate(self):
+        """Different piece-length buckets get their own lanes (the
+        geometry-grouped compile cache), same algo."""
+
+        async def go():
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=4, flush_deadline=0.01), hasher="cpu"
+            )
+            try:
+                a = await sched.submit("t", _pieces(4, 512))
+                b = await sched.submit("t", _pieces(4, 100_000))
+                assert a and b
+                assert sched.metrics_snapshot()["lanes"] == 2
+            finally:
+                await sched.close()
+
+        run(go())
+
+
+class TestFairnessAndBackpressure:
+    def test_greedy_plus_trickle_tenant(self):
+        """ISSUE acceptance: under a saturating tenant plus a trickle
+        tenant, the trickle tenant completes without timeout and the
+        greedy tenant observes backpressure (shed) — deterministic, no
+        TPU, no sleeps in the assertion path."""
+
+        async def go():
+            stall = _StallPlane()
+            cfg = SchedulerConfig(
+                batch_target=8,
+                flush_deadline=0.02,
+                max_queue_bytes=64 << 10,
+                max_tenant_bytes=16 << 10,
+                drr_quantum=2048,  # small quantum → per-pass interleave
+                plane_factory=lambda a, b, t: stall,
+            )
+            sched = HashPlaneScheduler(cfg, hasher="cpu")
+            try:
+                # greedy saturates: keeps submitting until admission
+                # control sheds it (its queue bound is 16 KiB)
+                greedy_futs = []
+                shed = 0
+                for i in range(64):
+                    try:
+                        greedy_futs.append(
+                            await sched.enqueue("greedy", _pieces(4, 1024, salt=i))
+                        )
+                    except SchedRejected as e:
+                        shed += 1
+                        assert e.tenant == "greedy"
+                        assert e.reason == "queue full"
+                assert shed > 0, "greedy tenant never saw backpressure"
+                assert sched.metrics_snapshot()["shed_total"] == shed
+
+                # trickle submits one small request AFTER the greedy
+                # backlog exists; DRR must serve it from an early batch
+                trickle_fut = await sched.enqueue("trickle", _pieces(2, 512))
+                stall.release.set()  # let launches run
+                got = await asyncio.wait_for(trickle_fut, 10)
+                assert got == [hashlib.sha1(p).digest() for p in _pieces(2, 512)]
+                # the greedy backlog still drains correctly afterwards
+                for i, fut in enumerate(greedy_futs):
+                    res = await asyncio.wait_for(fut, 10)
+                    assert res == [
+                        hashlib.sha1(p).digest() for p in _pieces(4, 1024, salt=i)
+                    ]
+                snap = sched.metrics_snapshot()
+                assert snap["tenants"]["trickle"]["served_pieces"] == 2
+                assert snap["tenants"]["greedy"]["shed"] == shed
+            finally:
+                stall.release.set()
+                await sched.close()
+
+        run(go())
+
+    def test_drr_serves_trickle_before_greedy_tail(self):
+        """Byte-fair DRR: with a deep greedy backlog queued first, a
+        later trickle piece is still served in the FIRST post-backlog
+        launch round, not after the whole backlog."""
+
+        async def go():
+            order: list[str] = []
+
+            class _RecordingPlane:
+                def run(self, payloads):
+                    order.append(f"launch:{len(payloads)}")
+                    return [hashlib.sha1(p).digest() for p in payloads]
+
+            stall = _StallPlane()
+            first = [True]
+
+            class _GatePlane:
+                # first launch stalls (pins the queue while we enqueue),
+                # later launches record
+                def run(self, payloads):
+                    if first[0]:
+                        first[0] = False
+                        stall.release.wait(timeout=30)
+                    return _RecordingPlane().run(payloads)
+
+            cfg = SchedulerConfig(
+                batch_target=8,
+                flush_deadline=0.02,
+                drr_quantum=1024,
+                plane_factory=lambda a, b, t: _GatePlane(),
+            )
+            sched = HashPlaneScheduler(cfg, hasher="cpu")
+            try:
+                # prime: one piece launches immediately and stalls the lane
+                prime = await sched.enqueue("greedy", _pieces(1, 64))
+                await asyncio.sleep(0.1)  # let the stalled launch start
+                # deep greedy backlog + one trickle piece behind it
+                greedy = [
+                    await sched.enqueue("greedy", _pieces(8, 1024, salt=i))
+                    for i in range(8)
+                ]
+                trickle = await sched.enqueue("trickle", _pieces(1, 1024))
+                done_at = {}
+                counter = [0]
+
+                def mark(name):
+                    def cb(_fut):
+                        counter[0] += 1
+                        done_at[name] = counter[0]
+
+                    return cb
+
+                trickle.add_done_callback(mark("trickle"))
+                greedy[-1].add_done_callback(mark("greedy_tail"))
+                stall.release.set()
+                await asyncio.wait_for(
+                    asyncio.gather(prime, trickle, *greedy), 15
+                )
+                # trickle resolved before the last greedy submission
+                assert done_at["trickle"] < done_at["greedy_tail"], done_at
+            finally:
+                stall.release.set()
+                await sched.close()
+
+        run(go())
+
+    def test_blocking_submit_waits_instead_of_shedding(self):
+        """wait=True is the streaming-backpressure path: over-budget
+        submits delay until a launch frees bytes, then succeed."""
+
+        async def go():
+            stall = _StallPlane()
+            cfg = SchedulerConfig(
+                batch_target=4,
+                flush_deadline=0.01,
+                max_queue_bytes=8 << 10,
+                plane_factory=lambda a, b, t: stall,
+            )
+            sched = HashPlaneScheduler(cfg, hasher="cpu")
+            try:
+                first = await sched.enqueue("s", _pieces(8, 1024))  # fills budget
+                waited = asyncio.ensure_future(
+                    sched.submit("s", _pieces(2, 1024), wait=True)
+                )
+                await asyncio.sleep(0.1)
+                assert not waited.done(), "blocking submit did not block"
+                stall.release.set()
+                got = await asyncio.wait_for(waited, 10)
+                assert got == [hashlib.sha1(p).digest() for p in _pieces(2, 1024)]
+                await asyncio.wait_for(first, 10)
+            finally:
+                stall.release.set()
+                await sched.close()
+
+        run(go())
+
+    def test_oversize_submission_sheds_on_idle_queue(self):
+        """A single submission bigger than the budget must shed on the
+        non-blocking path even when the queue is empty — the empty-queue
+        escape exists only for wait=True (livelock avoidance), else one
+        giant request blows past both bounds and 429s everyone behind it."""
+
+        async def go():
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=4,
+                    flush_deadline=0.01,
+                    max_queue_bytes=4096,
+                    max_tenant_bytes=4096,
+                ),
+                hasher="cpu",
+            )
+            try:
+                with pytest.raises(SchedRejected) as ei:
+                    await sched.enqueue("t", _pieces(8, 1024))  # 8 KiB > 4 KiB
+                assert ei.value.reason == "queue full"
+                # the blocking path still admits the oversize lone
+                # submission once the queue is empty (can never fit, so
+                # waiting would livelock)
+                got = await sched.submit("t", _pieces(8, 1024), wait=True)
+                assert got == [hashlib.sha1(p).digest() for p in _pieces(8, 1024)]
+            finally:
+                await sched.close()
+
+        run(go())
+
+    def test_typed_rejection_fields(self):
+        async def go():
+            stall = _StallPlane()
+            cfg = SchedulerConfig(
+                max_queue_bytes=2048, plane_factory=lambda a, b, t: stall
+            )
+            sched = HashPlaneScheduler(cfg, hasher="cpu")
+            try:
+                await sched.enqueue("t", _pieces(2, 1024))  # fills the budget
+                with pytest.raises(SchedRejected) as ei:
+                    await sched.enqueue("t", _pieces(1, 1024))
+                assert ei.value.reason == "queue full"
+                assert ei.value.tenant == "t"
+                assert ei.value.limit_bytes == 2048
+                assert ei.value.queued_bytes == 2048
+            finally:
+                stall.release.set()
+                await sched.close()
+
+        run(go())
+
+
+# ----------------------------------------------------------- sessions
+
+
+def _build_torrent(length, piece_len, seed=0, name="s"):
+    from torrent_tpu.codec.metainfo import InfoDict
+    from torrent_tpu.storage.storage import MemoryStorage, Storage
+
+    rng = np.random.default_rng(seed)
+    payload = rng.integers(0, 256, size=length, dtype=np.uint8).tobytes()
+    pieces = tuple(
+        hashlib.sha1(payload[i : i + piece_len]).digest()
+        for i in range(0, length, piece_len)
+    )
+    info = InfoDict(
+        name=name, piece_length=piece_len, pieces=pieces, length=length, files=None
+    )
+    storage = Storage(MemoryStorage(), info)
+    for off in range(0, length, 1 << 20):
+        storage.set(off, payload[off : off + (1 << 20)])
+    return info, storage
+
+
+class TestSchedulerSessions:
+    def test_verify_pieces_sched_matches_cpu(self):
+        from torrent_tpu.parallel.verify import verify_pieces, verify_pieces_sched
+
+        async def go():
+            info, storage = _build_torrent(300_000, 16384, seed=3)
+            storage.method.set(("s",), 33_000, b"XX")  # corrupt piece 2
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=8, flush_deadline=0.05), hasher="cpu"
+            )
+            try:
+                bf = await verify_pieces_sched(storage, info, sched, tenant="cli")
+            finally:
+                await sched.close()
+            want = verify_pieces(storage, info, hasher="cpu")
+            assert (bf == want).all()
+            assert not bf[2] and bf[0]
+
+        run(go())
+
+    def test_verify_library_sched_coalesces_across_torrents(self):
+        """Cross-torrent coalescing: 6 torrents × 24 pieces at one
+        geometry = 144 pieces = 3 full launches of 48 — the per-torrent
+        ragged tails ride shared launches instead of flushing alone."""
+        from torrent_tpu.parallel.bulk import verify_library_sched
+
+        async def go():
+            items = [
+                (storage, info)
+                for info, storage in (
+                    _build_torrent(24 * 4096, 4096, seed=i, name=f"t{i}")
+                    for i in range(6)
+                )
+            ]
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=48, flush_deadline=0.5), hasher="cpu"
+            )
+            try:
+                res = await verify_library_sched(items, sched, tenant="bulk")
+                snap = sched.metrics_snapshot()
+            finally:
+                await sched.close()
+            assert all(bf.all() for bf in res.bitfields)
+            assert res.n_pieces == 144
+            assert snap["mean_fill"] >= 0.9, snap
+            # 144 pieces at target 48: exactly 3 launches, all full
+            assert snap["launches"] == 3
+            assert snap["flush_reasons"]["full"] == 3
+
+        run(go())
+
+    def test_session_recheck_rides_scheduler_as_selfheal(self):
+        """session/torrent.py resume recheck uses the shared queue as the
+        low-priority 'selfheal' tenant when a scheduler is configured."""
+
+        async def go():
+            import dataclasses
+
+            from torrent_tpu.session.torrent import Torrent, TorrentConfig
+
+            info, storage = _build_torrent(200_000, 16384, seed=7, name="heal")
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=8, flush_deadline=0.05), hasher="cpu"
+            )
+
+            from torrent_tpu.codec.metainfo import Metainfo
+
+            meta = Metainfo(
+                announce="",
+                info=info,
+                info_hash=hashlib.sha1(b"heal").digest(),
+                raw={},
+            )
+            torrent = Torrent(
+                metainfo=meta,
+                storage=storage,
+                peer_id=b"-TT0001-xxxxxxxxxxxx",
+                port=0,
+                config=dataclasses.replace(
+                    TorrentConfig(), scheduler=sched, selfheal_weight=0.25
+                ),
+            )
+            try:
+                await torrent.recheck()
+                assert torrent.bitfield.complete
+                snap = sched.metrics_snapshot()
+                assert snap["tenants"]["selfheal"]["served_pieces"] == info.num_pieces
+                assert snap["tenants"]["selfheal"]["weight"] == 0.25
+            finally:
+                await sched.close()
+
+        run(go())
+
+
+# ------------------------------------------------------------- bridge
+
+
+async def _post(port, path, headers, body):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    head = [f"POST {path} HTTP/1.1", "Host: x", f"Content-Length: {len(body)}"]
+    for k, v in headers.items():
+        head.append(f"{k}: {v}")
+    writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + body)
+    await writer.drain()
+    status = int((await reader.readline()).split()[1])
+    clen = 0
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b""):
+            break
+        if line.lower().startswith(b"content-length:"):
+            clen = int(line.split(b":", 1)[1])
+    resp = await reader.readexactly(clen)
+    writer.close()
+    return status, resp
+
+
+async def _get(port, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    status = int((await reader.readline()).split()[1])
+    clen = 0
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b""):
+            break
+        if line.lower().startswith(b"content-length:"):
+            clen = int(line.split(b":", 1)[1])
+    resp = await reader.readexactly(clen)
+    writer.close()
+    return status, resp
+
+
+class TestBridgeScheduler:
+    def test_concurrent_bridge_clients_coalesce(self):
+        """ISSUE acceptance: ≥8 concurrent bridge clients each submitting
+        small piece counts achieve mean batch fill ≥0.9 of the target,
+        with flush-reason and batch-fill metrics visible in /metrics."""
+        from torrent_tpu.bridge.service import BridgeServer
+
+        async def go():
+            server = await BridgeServer(
+                port=0, hasher="cpu", batch_target=64, flush_deadline_ms=500
+            ).start()
+            try:
+                async def client(j):
+                    pieces = _pieces(16, 2048, salt=j)
+                    status, resp = await _post(
+                        server.port,
+                        "/v1/digests",
+                        {"X-Tenant": f"client{j}"},
+                        bencode({b"pieces": pieces}),
+                    )
+                    assert status == 200
+                    got = bdecode(resp)[b"digests"]
+                    assert got == [hashlib.sha1(p).digest() for p in pieces]
+
+                # 12 clients × 16 pieces = 192 = 3 full 64-piece launches
+                await asyncio.gather(*(client(j) for j in range(12)))
+                snap = server.sched.metrics_snapshot()
+                assert snap["mean_fill"] >= 0.9, snap
+                status, resp = await _get(server.port, "/metrics")
+                assert status == 200
+                text = resp.decode()
+                assert "torrent_tpu_sched_batch_fill_ratio" in text
+                assert 'torrent_tpu_sched_flush_total{reason="full"}' in text
+                assert 'tenant="client0"' in text
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+    def test_queue_full_maps_to_429(self):
+        """Typed SchedRejected surfaces as HTTP 429 through the bridge."""
+        from torrent_tpu.bridge.service import BridgeServer
+
+        async def go():
+            server = await BridgeServer(
+                port=0, hasher="cpu", max_queue_mb=1, tenant_max_mb=1
+            ).start()
+            try:
+                stall = _StallPlane()
+                server.sched.config.plane_factory = lambda a, b, t: stall
+                # first request fills the 1 MiB budget and stalls in-plane
+                big = asyncio.ensure_future(
+                    _post(
+                        server.port,
+                        "/v1/digests",
+                        {},
+                        bencode({b"pieces": [b"z" * (1 << 20)]}),
+                    )
+                )
+                # wait until the scheduler holds the bytes
+                for _ in range(200):
+                    if server.sched.metrics_snapshot()["queue_bytes"] > 0:
+                        break
+                    await asyncio.sleep(0.01)
+                status, resp = await _post(
+                    server.port,
+                    "/v1/digests",
+                    {},
+                    bencode({b"pieces": [b"y" * (512 << 10)]}),
+                )
+                assert status == 429, (status, resp)
+                assert b"queue full" in resp
+                assert server.sched.metrics_snapshot()["shed_total"] == 1
+                stall.release.set()
+                status, _ = await asyncio.wait_for(big, 10)
+                assert status == 200
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+    def test_stream_flushes_on_byte_budget(self):
+        """A streaming connection's pre-flush batch is per-connection
+        memory the admission budget can't see: big-piece streams must
+        hand bytes to the scheduler before the piece-count chunk fills."""
+        from torrent_tpu.bridge.service import STREAM_FLUSH_BYTES, BridgeServer
+
+        async def go():
+            server = await BridgeServer(
+                port=0, hasher="cpu", batch_target=4096, flush_deadline_ms=50
+            ).start()
+            try:
+                calls: list[int] = []
+                orig = server.sched.enqueue
+
+                async def spy(tenant, pieces, **kw):
+                    calls.append(sum(len(p) for p in pieces))
+                    return await orig(tenant, pieces, **kw)
+
+                server.sched.enqueue = spy
+                plen = 1 << 20
+                pieces = [bytes([i + 1]) * plen for i in range(6)]
+                body = b"".join(len(p).to_bytes(4, "big") + p for p in pieces)
+                status, resp = await _post(
+                    server.port,
+                    "/v1/stream/digests",
+                    {"X-Piece-Length": str(plen)},
+                    body,
+                )
+                assert status == 200
+                assert bdecode(resp)[b"digests"] == [
+                    hashlib.sha1(p).digest() for p in pieces
+                ]
+                # 6 MiB of 1 MiB pieces with a 4 MiB cap: must have
+                # flushed mid-stream, never holding more than cap + one
+                # piece locally
+                assert len(calls) >= 2, calls
+                assert max(calls) <= STREAM_FLUSH_BYTES + plen, calls
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+    def test_info_reports_batch_target(self):
+        from torrent_tpu.bridge.service import BridgeServer
+
+        async def go():
+            server = await BridgeServer(port=0, hasher="cpu", batch_target=99).start()
+            try:
+                status, resp = await _get(server.port, "/v1/info")
+                assert status == 200
+                assert bdecode(resp)[b"batch"] == 99
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())

@@ -10,14 +10,26 @@ codec every BitTorrent client already has:
   POST /v1/verify    body {pieces: [bytes, ...], expected: [20B, ...]}
                      → {ok: bytes}            (one 0x00/0x01 per piece)
   GET  /v1/info      → {backend, devices, batch} (capability probe)
+  GET  /metrics      → scheduler queue/fill/shed counters (Prometheus)
+
+Every route submits into the shared hash-plane scheduler
+(``torrent_tpu/sched``) instead of owning staging buffers: pieces from
+many concurrent clients coalesce into full device batches (one ~55 ms
+dispatch serves everyone), per-tenant deficit round-robin keeps a greedy
+client from starving a trickle one, and admission control bounds queue
+memory. Clients name themselves with an ``X-Tenant`` header (default
+``"default"``). When the queue is over budget a buffered request is shed
+with **429** (retry later); a streaming ingest is *delayed* instead —
+the blocking submit propagates backpressure to the TCP socket.
 
 Streaming ingest (the north-star topology: a Deno client pushing a
-100 GiB recheck must not need 100 GiB — or even 1 GiB — resident in the
-sidecar). The client declares the torrent's piece length in an
-``X-Piece-Length`` header and streams length-prefixed frames; the
-sidecar consumes them straight into the verifier's staging buffers,
-flushing a device batch every ``batch_size`` pieces. Resident memory is
-two staging buffers (~2 × batch × padded_len), independent of body size.
+100 GiB recheck must not need 100 GiB resident in the sidecar). The
+client declares the torrent's piece length in an ``X-Piece-Length``
+header and streams length-prefixed frames; the sidecar chunks them into
+scheduler submissions sized to one device launch (flushed early past a
+per-connection byte cap). Resident memory is bounded by the scheduler's
+admission budget plus one small staging buffer per connection,
+independent of body size.
 Bodies may be Content-Length or chunked transfer-encoding (what a Deno
 ``fetch`` with a ReadableStream body produces).
 
@@ -30,29 +42,33 @@ An ``X-Hash-Algo: sha256`` header switches the stream routes to the v2
 hash plane (BEP 52 leaf/merkle hashing feeds on 32-byte digests); the
 default is sha1. Digest/expected width follows the algorithm.
 
-Hand-rolled asyncio HTTP — no web framework needed for five routes.
+Hand-rolled asyncio HTTP — no web framework needed for six routes.
 """
 
 from __future__ import annotations
 
 import asyncio
-import threading
 
 from torrent_tpu.codec.bencode import BencodeError, bdecode, bencode
+from torrent_tpu.sched import HashPlaneScheduler, SchedRejected, SchedulerConfig
 from torrent_tpu.utils.log import get_logger
 
 log = get_logger("bridge")
 
 MAX_BODY = 1 << 30  # 1 GiB of piece data per buffered (non-stream) request
 # Cap on one streamed frame. 16 MiB is the practical BitTorrent piece-size
-# ceiling, and it keeps the staging-budget invariant honest even after
-# TPUVerifier rounds batch_size up to the mesh size: worst case is
-# 2 slots × max(batch, mesh) rows × ~16 MiB = 256 MiB on an 8-device mesh.
+# ceiling, and it keeps the scheduler's staging-budget rule honest: the
+# biggest lane bucket a client can force is 16 MiB.
 MAX_PIECE = 16 << 20
 # An endless frame stream must not grow the result lists without bound:
 # 4M frames ≈ 80 MB of digests ≈ a 1 TiB torrent at 256 KiB pieces.
 MAX_STREAM_FRAMES = 1 << 22
 FRAME_TIMEOUT = 60.0  # idle seconds between frame reads before dropping
+# Per-connection pre-flush staging cap: frames accumulate locally until
+# handed to the scheduler, and those bytes are invisible to its admission
+# budget — without this bound N streaming connections of 16 MiB pieces
+# hold N × chunk × 16 MiB resident before the first enqueue.
+STREAM_FLUSH_BYTES = 4 << 20
 
 
 class _BodyReader:
@@ -124,19 +140,32 @@ class _BodyReader:
 
 
 class BridgeServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, hasher: str = "tpu"):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        hasher: str = "tpu",
+        batch_target: int = 256,
+        flush_deadline_ms: float = 20.0,
+        max_queue_mb: int = 256,
+        tenant_max_mb: int = 128,
+    ):
         self.host = host
         self.port = port
         self.hasher = hasher
         self._server: asyncio.AbstractServer | None = None
-        self._verifiers: dict[int, object] = {}
-        self._verifiers_lock = threading.Lock()
-        self._stream_gate: asyncio.Semaphore | None = None
+        self.sched: HashPlaneScheduler | None = None
+        self._sched_config = SchedulerConfig(
+            batch_target=batch_target,
+            flush_deadline=flush_deadline_ms / 1e3,
+            max_queue_bytes=max_queue_mb << 20,
+            max_tenant_bytes=tenant_max_mb << 20,
+        )
 
     async def start(self) -> "BridgeServer":
-        # at most 4 concurrent streaming ingests hold staging buffers;
-        # further streams wait instead of multiplying resident memory
-        self._stream_gate = asyncio.Semaphore(4)
+        self.sched = await HashPlaneScheduler(
+            self._sched_config, hasher=self.hasher
+        ).start()
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
         log.info("bridge listening on %s:%d", self.host, self.port)
@@ -149,58 +178,23 @@ class BridgeServer:
     async def wait_closed(self) -> None:
         if self._server:
             await self._server.wait_closed()
-
-    # ------------------------------------------------------------ hashing
-
-    def _digests(self, pieces: list[bytes]) -> list[bytes]:
-        if self.hasher == "cpu":
-            import hashlib
-
-            return [hashlib.sha1(p).digest() for p in pieces]
-        cap = max((len(p) for p in pieces), default=64)
-        return self._stream_verifier(cap).hash_pieces(pieces)
-
-    # ~128 MiB per staging buffer regardless of piece size; the batch
-    # shrinks as pieces grow so a hostile X-Piece-Length can't OOM the
-    # sidecar (2 slots × budget ≈ 256 MiB peak, worst case one 64 MiB row
-    # per slot).
-    STAGING_BUDGET = 128 << 20
-
-    def _bucket_and_batch(self, plen: int) -> tuple[int, int]:
-        """Pow-2 piece-length bucket + the batch the staging budget affords."""
-        from torrent_tpu.ops.padding import padded_len_for
-
-        bucket = 1 << (plen - 1).bit_length() if plen > 1 else 1
-        batch = max(1, min(256, self.STAGING_BUDGET // padded_len_for(bucket)))
-        return bucket, batch
-
-    def _stream_verifier(self, plen: int):
-        """Verifier for the given piece length — pow-2 bucketed so a
-        handful of executables serve any geometry (shared by the buffered
-        and streaming routes)."""
-        from torrent_tpu.models.verifier import TPUVerifier
-
-        bucket, batch = self._bucket_and_batch(plen)
-        # callers run on both the event loop and to_thread workers; the
-        # lock keeps a bucket from being built (and compiled) twice
-        with self._verifiers_lock:
-            verifier = self._verifiers.get(bucket)
-            if verifier is None:
-                verifier = TPUVerifier(piece_length=bucket, batch_size=batch)
-                self._verifiers[bucket] = verifier
-        return verifier
+        if self.sched is not None:
+            await self.sched.close()
 
     # ----------------------------------------------------------- streaming
 
-    async def _route_stream(self, writer, target: str, headers, body: _BodyReader):
-        """Length-prefixed frame ingest with bounded resident memory.
+    @staticmethod
+    def _tenant_of(headers) -> str:
+        return (headers or {}).get(b"x-tenant", b"default").decode("latin-1")[:64]
 
-        Frames land directly in the verifier's staging buffers; a device
-        batch is flushed every ``batch_size`` pieces on a worker thread
-        while the event loop keeps ingesting into the other buffer
-        (``verify_batch``/``digest_batch`` return only after the staging
-        buffer is fully uploaded, so reuse after the flush future resolves
-        is safe). Peak memory ≈ 2 staging buffers, independent of body size.
+    async def _route_stream(self, writer, target: str, headers, body: _BodyReader):
+        """Length-prefixed frame ingest through the scheduler.
+
+        Frames are chunked into scheduler submissions sized to one device
+        launch; the queue's admission budget bounds resident memory while
+        launches overlap further ingest. A full queue *delays* the read
+        loop (blocking submit) — backpressure reaches the client's TCP
+        socket instead of buffering without bound.
         """
         mode = target.rsplit("/", 1)[-1]
         if mode not in ("digests", "verify"):
@@ -214,11 +208,7 @@ class BridgeServer:
         algo = headers.get(b"x-hash-algo", b"sha1").decode("latin-1").lower()
         if algo not in ("sha1", "sha256"):
             return await self._reply(writer, 400, b"X-Hash-Algo must be sha1 or sha256")
-
-        if self.hasher == "cpu":
-            return await self._stream_cpu(writer, mode, plen, body, algo)
-        async with self._stream_gate:
-            await self._stream_tpu(writer, mode, plen, body, algo)
+        await self._stream_sched(writer, mode, plen, body, algo, self._tenant_of(headers))
 
     @staticmethod
     async def _read_idle_bounded(body: _BodyReader, n: int) -> bytes:
@@ -241,8 +231,8 @@ class BridgeServer:
     ):
         """One ``len | piece [| expected]`` frame, or None at clean EOF.
 
-        Reads are idle-bounded so a silent client can't pin staging
-        buffers forever. Raises ValueError on an oversized frame.
+        Reads are idle-bounded so a silent client can't pin queue bytes
+        forever. Raises ValueError on an oversized frame.
         """
         if await asyncio.wait_for(body.at_eof(), FRAME_TIMEOUT):
             return None
@@ -255,177 +245,33 @@ class BridgeServer:
         )
         return data, expected
 
-    def _stream_plane256(self, plen: int):
-        """Minimal SHA-256 batch plane for the stream routes (v2 digests
-        use 32-byte words; the sha1 TPUVerifier's on-device compare and
-        flat-upload machinery don't apply — digest words come back host-
-        side and compare there, [B, 8] u32 per batch is tiny)."""
-        from torrent_tpu.ops.sha256_jax import make_sha256_fn
-
-        bucket, batch = self._bucket_and_batch(plen)
-        key = ("sha256", bucket)
-        with self._verifiers_lock:
-            plane = self._verifiers.get(key)
-            if plane is None:
-                import jax
-
-                # always the scan backend: sha256_pieces_pallas pads every
-                # launch to a tile_sub*128-row multiple (>=1024), which
-                # would blow the staging budget this batch size exists to
-                # enforce (a 16 MiB bucket would balloon on device)
-                fn = make_sha256_fn("jax")
-
-                class _Plane:
-                    piece_length = bucket
-                    batch_size = batch
-
-                    @staticmethod
-                    def digest_words(padded, nblocks):
-                        import numpy as np
-
-                        return np.asarray(fn(jax.numpy.asarray(padded), jax.numpy.asarray(nblocks)))
-
-                plane = _Plane()
-                self._verifiers[key] = plane
-        return plane
-
-    async def _stream_tpu(self, writer, mode: str, plen: int, body: _BodyReader, algo: str):
-        import concurrent.futures
-
-        import numpy as np
-
-        from torrent_tpu.models.merkle import digests_to_words32, words32_to_digests
-        from torrent_tpu.ops.padding import (
-            alloc_padded,
-            digests_to_words,
-            pad_in_place,
-            words_to_digests,
-        )
-
-        # verifier construction (JAX init, jit setup) and the ~128 MiB slot
-        # memsets run off the event loop so health probes and other
-        # connections stay live through them
-        if algo == "sha256":
-            verifier = await asyncio.to_thread(self._stream_plane256, plen)
-            dlen, words_dim = 32, 8
-            to_words = lambda d: digests_to_words32([d])[0]
-        else:
-            verifier = await asyncio.to_thread(self._stream_verifier, plen)
-            dlen, words_dim = 20, 5
-            to_words = lambda d: digests_to_words([d])[0]
-        b = verifier.batch_size
-        slots: list[dict] = []  # allocated lazily on the first frame
-
-        def make_slot():
-            padded, view = alloc_padded(b, verifier.piece_length)
-            return {
-                "padded": padded,
-                "view": view,
-                "lengths": np.zeros(b, dtype=np.int64),
-                "expected": np.zeros((b, words_dim), dtype=np.uint32),
-            }
-
-        loop = asyncio.get_running_loop()
-        flusher = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-        pending: list = []  # in-order flush futures
-        digests: list[bytes] = []
-        ok_flags = bytearray()
-
-        def flush(slot, k):
-            nblocks = pad_in_place(slot["padded"], slot["lengths"])
-            nblocks[k:] = 0
-            if algo == "sha256":
-                words = verifier.digest_words(slot["padded"], nblocks)
-                if mode == "digests":
-                    return words32_to_digests(words[:k])
-                ok = (words[:k] == slot["expected"][:k]).all(axis=1)
-                return bytes(ok.astype(np.uint8))
-            if mode == "digests":
-                words = verifier.digest_batch(slot["padded"], nblocks)
-                return words_to_digests(words[:k])
-            ok = verifier.verify_batch(slot["padded"], nblocks, slot["expected"])
-            return bytes(ok[:k].astype(np.uint8))
-
-        def collect(res):
-            if mode == "digests":
-                digests.extend(res)
-            else:
-                ok_flags.extend(res)
-
-        try:
-            slot_idx, k, n_frames = 0, 0, 0
-            while True:
-                frame = await self._read_frame(body, plen, mode == "verify", digest_len=dlen)
-                if frame is None:
-                    break
-                n_frames += 1
-                if n_frames > MAX_STREAM_FRAMES:
-                    return await self._reply(writer, 413, b"too many frames")
-                data, exp = frame
-                if not slots:
-                    slots = await asyncio.to_thread(lambda: [make_slot(), make_slot()])
-                slot = slots[slot_idx]
-                ln = len(data)
-                slot["padded"][k, ln:] = 0  # clear stale pad bytes from last use
-                slot["view"][k, :ln] = np.frombuffer(data, dtype=np.uint8)
-                slot["lengths"][k] = ln
-                if exp is not None:
-                    slot["expected"][k] = to_words(exp)
-                k += 1
-                if k == b:
-                    pending.append(loop.run_in_executor(flusher, flush, slot, k))
-                    slot_idx, k = 1 - slot_idx, 0
-                    if len(pending) == 2:
-                        collect(await pending.pop(0))
-            if k:
-                pending.append(loop.run_in_executor(flusher, flush, slots[slot_idx], k))
-            for fut in pending:
-                collect(await fut)
-            if mode == "digests":
-                payload = bencode({b"digests": digests})
-            else:
-                payload = bencode({b"ok": bytes(ok_flags), b"valid": sum(ok_flags)})
-            await self._reply(writer, 200, payload)
-        except ValueError as e:
-            await self._reply(writer, 400, str(e).encode())
-        finally:
-            flusher.shutdown(wait=False)
-
-    async def _stream_cpu(self, writer, mode: str, plen: int, body: _BodyReader, algo: str = "sha1"):
-        """hashlib fallback for ``hasher='cpu'``.
-
-        Frames are hashed off the event loop in batches (≤64 frames or
-        8 MiB) so neither thread-hop overhead per small piece nor a long
-        inline hash of a big piece stalls concurrent connections.
-        """
-        import hashlib
-
-        digests: list[bytes] = []
-        ok_flags = bytearray()
+    async def _stream_sched(
+        self, writer, mode: str, plen: int, body: _BodyReader, algo: str, tenant: str
+    ):
+        dlen = 32 if algo == "sha256" else 20
+        chunk = self.sched.chunk_for(plen)
+        futs: list[asyncio.Future] = []
         batch: list[bytes] = []
         batch_exp: list[bytes] = []
         batch_bytes = 0
         n_frames = 0
 
-        hfn = hashlib.sha256 if algo == "sha256" else hashlib.sha1
-
-        async def do_flush():
+        async def flush():
             nonlocal batch, batch_exp, batch_bytes
-            ds = await asyncio.to_thread(
-                lambda ps: [hfn(p).digest() for p in ps], batch
+            fut = await self.sched.enqueue(
+                tenant,
+                batch,
+                expected=batch_exp if mode == "verify" else None,
+                algo=algo,
+                piece_length=plen,
+                wait=True,  # streaming backpressure, not load-shed
             )
-            if mode == "digests":
-                digests.extend(ds)
-            else:
-                ok_flags.extend(1 if d == e else 0 for d, e in zip(ds, batch_exp))
+            futs.append(fut)
             batch, batch_exp, batch_bytes = [], [], 0
 
         try:
             while True:
-                frame = await self._read_frame(
-                    body, plen, mode == "verify",
-                    digest_len=32 if algo == "sha256" else 20,
-                )
+                frame = await self._read_frame(body, plen, mode == "verify", digest_len=dlen)
                 if frame is None:
                     break
                 n_frames += 1
@@ -436,17 +282,32 @@ class BridgeServer:
                 batch_bytes += len(data)
                 if exp is not None:
                     batch_exp.append(exp)
-                if len(batch) >= 64 or batch_bytes >= (8 << 20):
-                    await do_flush()
+                # flush on byte budget too, not just piece count: the
+                # pre-flush batch is per-CONNECTION memory the admission
+                # budget can't see, so big-piece streams must hand bytes
+                # to the scheduler (where wait=True bounds them) early —
+                # N connections otherwise hold N × chunk × plen resident
+                if len(batch) >= chunk or batch_bytes >= STREAM_FLUSH_BYTES:
+                    await flush()
             if batch:
-                await do_flush()
+                await flush()
+            digests: list[bytes] = []
+            ok_flags = bytearray()
+            for fut in futs:
+                res = await fut
+                if mode == "digests":
+                    digests.extend(res)
+                else:
+                    ok_flags.extend(res)
+            if mode == "digests":
+                payload = bencode({b"digests": digests})
+            else:
+                payload = bencode({b"ok": bytes(ok_flags), b"valid": sum(ok_flags)})
+            await self._reply(writer, 200, payload)
         except ValueError as e:
-            return await self._reply(writer, 400, str(e).encode())
-        if mode == "digests":
-            payload = bencode({b"digests": digests})
-        else:
-            payload = bencode({b"ok": bytes(ok_flags), b"valid": sum(ok_flags)})
-        await self._reply(writer, 200, payload)
+            await self._reply(writer, 400, str(e).encode())
+        except SchedRejected as e:
+            await self._reply(writer, 429, str(e).encode())
 
     # --------------------------------------------------------------- http
 
@@ -493,10 +354,17 @@ class BridgeServer:
                 {
                     b"backend": self.hasher.encode(),
                     b"devices": len(jax.devices()),
+                    b"batch": self.sched.config.batch_target,
                     b"version": b"torrent-tpu/0.1",
                 }
             )
             return await self._reply(writer, 200, payload)
+        if method == "GET" and target.split("?")[0] == "/metrics":
+            from torrent_tpu.utils.metrics import render_sched_metrics
+
+            return await self._reply(
+                writer, 200, render_sched_metrics(self.sched).encode()
+            )
         if method != "POST":
             return await self._reply(writer, 405, b"method not allowed")
         # the buffered hash routes are sha1-only; a sha256 request must
@@ -517,12 +385,16 @@ class BridgeServer:
         if not all(isinstance(p, bytes) for p in pieces):
             return await self._reply(writer, 400, b"pieces must be bytestrings")
         if any(len(p) > MAX_PIECE for p in pieces):
-            # same cap as the stream routes: an oversized piece would build
-            # (and cache) a verifier bucket far beyond the staging budget
+            # same cap as the stream routes: an oversized piece would open
+            # (and cache) a scheduler lane far beyond the staging budget
             return await self._reply(writer, 413, b"piece exceeds 16MiB cap")
+        tenant = self._tenant_of(headers)
 
         if target == "/v1/digests":
-            digests = await asyncio.to_thread(self._digests, pieces)
+            try:
+                digests = await self.sched.submit(tenant, pieces, algo="sha1")
+            except SchedRejected as e:
+                return await self._reply(writer, 429, str(e).encode())
             return await self._reply(writer, 200, bencode({b"digests": digests}))
         if target == "/v1/verify":
             expected = req.get(b"expected")
@@ -532,10 +404,12 @@ class BridgeServer:
                 or not all(isinstance(e, bytes) and len(e) == 20 for e in expected)
             ):
                 return await self._reply(writer, 400, b"expected must be 20-byte hashes")
-            digests = await asyncio.to_thread(self._digests, pieces)
-            ok = bytes(
-                1 if d == e else 0 for d, e in zip(digests, expected)
-            )
+            try:
+                ok = await self.sched.submit(
+                    tenant, pieces, expected=expected, algo="sha1"
+                )
+            except SchedRejected as e:
+                return await self._reply(writer, 429, str(e).encode())
             return await self._reply(writer, 200, bencode({b"ok": ok}))
         await self._reply(writer, 404, b"not found")
 
@@ -553,8 +427,10 @@ class BridgeServer:
             writer.close()
 
 
-async def serve_bridge(host: str = "127.0.0.1", port: int = 8421, hasher: str = "tpu") -> BridgeServer:
-    return await BridgeServer(host, port, hasher).start()
+async def serve_bridge(
+    host: str = "127.0.0.1", port: int = 8421, hasher: str = "tpu", **sched_kwargs
+) -> BridgeServer:
+    return await BridgeServer(host, port, hasher, **sched_kwargs).start()
 
 
 def main(argv=None):  # pragma: no cover - manual entrypoint
@@ -564,10 +440,34 @@ def main(argv=None):  # pragma: no cover - manual entrypoint
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8421)
     parser.add_argument("--hasher", choices=("cpu", "tpu"), default="tpu")
+    parser.add_argument(
+        "--batch-target", type=int, default=256,
+        help="pieces per device launch the scheduler aims to fill",
+    )
+    parser.add_argument(
+        "--flush-deadline-ms", type=float, default=20.0,
+        help="max ms a lone queued piece waits before a partial flush",
+    )
+    parser.add_argument(
+        "--max-queue-mb", type=int, default=256,
+        help="global admission bound on queued piece bytes (429 beyond)",
+    )
+    parser.add_argument(
+        "--tenant-max-mb", type=int, default=128,
+        help="per-tenant admission bound on queued piece bytes",
+    )
     args = parser.parse_args(argv)
 
     async def go():
-        server = await serve_bridge(args.host, args.port, args.hasher)
+        server = await serve_bridge(
+            args.host,
+            args.port,
+            args.hasher,
+            batch_target=args.batch_target,
+            flush_deadline_ms=args.flush_deadline_ms,
+            max_queue_mb=args.max_queue_mb,
+            tenant_max_mb=args.tenant_max_mb,
+        )
         print(f"bridge listening on {args.host}:{server.port}")
         await server.wait_closed()
 

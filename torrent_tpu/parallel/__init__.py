@@ -1,6 +1,6 @@
 from torrent_tpu.parallel.mesh import make_mesh, batch_sharding, replicated_sharding
-from torrent_tpu.parallel.verify import verify_pieces, VerifyResult
-from torrent_tpu.parallel.bulk import verify_library, LibraryResult
+from torrent_tpu.parallel.verify import verify_pieces, verify_pieces_sched, VerifyResult
+from torrent_tpu.parallel.bulk import verify_library, verify_library_sched, LibraryResult
 from torrent_tpu.parallel.distributed import (
     initialize as init_distributed,
     verify_library_distributed,
@@ -12,8 +12,10 @@ __all__ = [
     "batch_sharding",
     "replicated_sharding",
     "verify_pieces",
+    "verify_pieces_sched",
     "VerifyResult",
     "verify_library",
+    "verify_library_sched",
     "LibraryResult",
     "init_distributed",
     "verify_library_distributed",

@@ -170,3 +170,46 @@ def verify_library(
                 io_pool.shutdown(wait=False)
 
     return LibraryResult(bitfields, total_pieces, total_bytes, time.perf_counter() - t0)
+
+
+async def verify_library_sched(
+    items: list[tuple[Storage, InfoDict]],
+    scheduler,
+    tenant: str = "bulk",
+    progress_cb=None,
+) -> LibraryResult:
+    """Bulk validation as a scheduler session.
+
+    The sync ``verify_library`` owns its own batch loop; this variant
+    submits every torrent's pieces to the shared hash-plane scheduler
+    (``torrent_tpu.sched``) instead. Cross-torrent coalescing then falls
+    out of the queue itself — the tail of one torrent and the head of
+    the next ride the same device launch, and pieces from *other*
+    concurrent callers (bridge clients, CLI verifies) fill the batch
+    too, with the scheduler's DRR keeping them fair. Geometry grouping
+    is the scheduler's lane map, so the compile cache is shared with
+    every other consumer rather than per-call.
+    """
+    from torrent_tpu.parallel.verify import enqueue_torrent_sched
+
+    t0 = time.perf_counter()
+    bitfields = [np.zeros(info.num_pieces, dtype=bool) for _, info in items]
+    total_pieces = sum(info.num_pieces for _, info in items)
+    total_bytes = sum(info.length for _, info in items)
+
+    # enqueue the WHOLE library before awaiting any result: the ragged
+    # tail of torrent i is still queued when torrent i+1's head arrives,
+    # so they share a launch instead of each paying a deadline flush
+    pending: list[tuple] = []
+    for ti, (storage, info) in enumerate(items):
+        for fut, keep in await enqueue_torrent_sched(storage, info, scheduler, tenant):
+            pending.append((fut, ti, keep))
+    done = 0
+    for fut, ti, keep in pending:
+        ok = await fut
+        for j, pi in enumerate(keep):
+            bitfields[ti][pi] = bool(ok[j])
+        done += len(keep)
+        if progress_cb:
+            progress_cb(min(done, total_pieces), total_pieces)
+    return LibraryResult(bitfields, total_pieces, total_bytes, time.perf_counter() - t0)

@@ -237,6 +237,100 @@ def verify_pieces_v2_tpu(
     return bitfield
 
 
+async def enqueue_torrent_sched(
+    storage: Storage,
+    info: InfoDict,
+    scheduler,
+    tenant: str,
+    chunk_pieces: int | None = None,
+) -> list[tuple]:
+    """Read a torrent's pieces off-thread and enqueue them on the shared
+    hash-plane scheduler WITHOUT awaiting results.
+
+    Returns ``[(future, keep_indices), ...]`` — each future resolves to
+    ok-bytes for the pieces in ``keep_indices`` (rows that failed to read
+    or were short are skipped and stay False in the caller's bitfield).
+    Submissions use blocking admission (``wait=True``): a full queue
+    pauses the disk read loop instead of buffering without bound. Shared
+    by ``verify_pieces_sched`` and ``verify_library_sched`` so the read /
+    filter / keep-demux contract lives in one place.
+    """
+    import asyncio
+
+    chunk = chunk_pieces or scheduler.chunk_for(info.piece_length)
+
+    def read_chunk(idxs: list[int]):
+        payloads, exps, keep = [], [], []
+        for i in idxs:
+            try:
+                data = storage.read_piece(i)
+            except StorageError:
+                continue
+            if len(data) != piece_length(info, i):
+                continue
+            payloads.append(data)
+            exps.append(info.pieces[i])
+            keep.append(i)
+        return payloads, exps, keep
+
+    futs: list[tuple] = []
+    for start in range(0, info.num_pieces, chunk):
+        idxs = list(range(start, min(start + chunk, info.num_pieces)))
+        payloads, exps, keep = await asyncio.to_thread(read_chunk, idxs)
+        if not payloads:
+            continue
+        fut = await scheduler.enqueue(
+            tenant,
+            payloads,
+            expected=exps,
+            algo="sha1",
+            piece_length=info.piece_length,
+            wait=True,
+        )
+        futs.append((fut, keep))
+    return futs
+
+
+async def verify_pieces_sched(
+    storage: Storage,
+    info: InfoDict,
+    scheduler,
+    tenant: str = "verify",
+    chunk_pieces: int | None = None,
+    progress_cb: ProgressCb | None = None,
+) -> np.ndarray:
+    """Recheck through the shared hash-plane scheduler (v1/sha1 infos).
+
+    Instead of owning a private ``TPUVerifier`` batch loop, pieces are
+    read off-thread and submitted to ``scheduler``
+    (``torrent_tpu.sched.HashPlaneScheduler``): the scheduler coalesces
+    them with every other caller's traffic into full device launches and
+    keeps the geometry-grouped compile cache across sessions. Reads
+    pipeline against launches — submissions are enqueued with blocking
+    admission (``wait=True``), so a full queue pauses the disk read
+    loop instead of buffering without bound.
+
+    v2 (merkle) infos don't map onto the flat digest plane; use
+    ``verify_pieces`` for those.
+    """
+    if getattr(info, "v2", False):
+        raise ValueError("scheduler sessions are sha1/v1-only; use verify_pieces")
+    n = info.num_pieces
+    bitfield = np.zeros(n, dtype=bool)
+    if n == 0:
+        return bitfield
+    futs = await enqueue_torrent_sched(storage, info, scheduler, tenant, chunk_pieces)
+    done = 0
+    for fut, keep in futs:
+        ok = await fut
+        for j, i in enumerate(keep):
+            bitfield[i] = bool(ok[j])
+        done += len(keep)
+        if progress_cb:
+            progress_cb(min(done, n), n)
+    return bitfield
+
+
 def verify_pieces(
     storage: Storage,
     info: InfoDict,

@@ -1,0 +1,17 @@
+"""torrent_tpu.sched — the continuous-batching hash-plane scheduler.
+
+All hash-plane dispatch flows through one :class:`HashPlaneScheduler`
+per process: the bridge's unary and streaming routes, the
+``parallel/verify.py`` and ``parallel/bulk.py`` scheduler sessions, and
+session self-heal rechecks submit into a shared multi-tenant queue with
+admission control, deadline-aware batch assembly, deficit-round-robin
+fairness, and per-launch result demux. See scheduler.py for the design.
+"""
+
+from torrent_tpu.sched.scheduler import (
+    HashPlaneScheduler,
+    SchedRejected,
+    SchedulerConfig,
+)
+
+__all__ = ["HashPlaneScheduler", "SchedRejected", "SchedulerConfig"]

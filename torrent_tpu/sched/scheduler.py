@@ -1,0 +1,691 @@
+"""Continuous-batching scheduler for the hash plane — the multi-tenant
+verify queue that turns a fast single-caller plane into a servable one.
+
+Every entry point used to dispatch its own device batches in isolation
+(bridge routes, parallel/verify.py, parallel/bulk.py, session
+rechecks), so concurrent small callers each paid the fixed ~55 ms
+dispatch cost on mostly-empty launches (BASELINE.md: batch fill is the
+dominant throughput knob — 4096-piece dispatches cap at ~67k p/s,
+8192 reaches 169k). This subsystem owns all dispatch instead:
+
+    submit ──► admission control ──► per-tenant queues ──► DRR
+               (bounded bytes,        (one deque per       assembler
+                shed = typed 429)      tenant per lane)       │
+                                                              ▼
+    awaiting callers ◄── per-launch demux ◄── device launch (full batch
+                         (futures resolve      OR deadline flush, so a
+                          per submission)      lone 4-piece request is
+                                               never stranded)
+
+Work items are grouped into **lanes** keyed ``(algo, piece-length
+bucket)`` — the same pow-2 bucketing the bridge used, so a handful of
+compiled executables serve any geometry and the compile cache survives
+across callers. Each lane runs one assembler task: it flushes a launch
+when the batch fills to the lane target **or** when the oldest queued
+item's deadline expires (flush reasons: full / deadline / shutdown).
+
+Fairness is deficit round-robin over queued *bytes*: each tenant's
+deficit grows by ``drr_quantum × weight`` per assembly pass, so a greedy
+bulk tenant cannot starve a trickle CLI verify, and low-priority tenants
+(session self-heal rechecks, ``weight < 1``) yield to foreground
+traffic without ever being starved.
+
+Admission control bounds queue memory globally and per tenant. A
+non-blocking submit over the bound sheds with :class:`SchedRejected`
+(the bridge maps it to HTTP 429); a blocking submit waits for space —
+that wait is the backpressure a streaming ingest propagates to its TCP
+socket. Queue depth, batch-fill ratio, flush reasons, per-tenant served
+bytes, and shed counts are exported via ``utils/metrics.py``
+(``render_sched_metrics``); device launches are annotated in the
+profiler timeline via ``utils/trace.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable
+
+from torrent_tpu.utils.log import get_logger
+
+log = get_logger("sched")
+
+DIGEST_LEN = {"sha1": 20, "sha256": 32}
+
+
+class SchedRejected(Exception):
+    """Typed admission-control rejection (load shed).
+
+    Carries enough structure for callers to surface a useful 429: the
+    reason, the tenant, and the observed/limit byte figures.
+    """
+
+    def __init__(self, reason: str, tenant: str, queued_bytes: int = 0, limit_bytes: int = 0):
+        super().__init__(
+            f"{reason} (tenant={tenant} queued={queued_bytes}B limit={limit_bytes}B)"
+        )
+        self.reason = reason
+        self.tenant = tenant
+        self.queued_bytes = queued_bytes
+        self.limit_bytes = limit_bytes
+
+
+@dataclass
+class SchedulerConfig:
+    # pieces per device launch the assembler aims to fill (per-lane
+    # targets shrink for big-piece buckets so staging stays bounded)
+    batch_target: int = 256
+    # seconds the oldest queued item may wait before a partial flush
+    flush_deadline: float = 0.02
+    # global admission bound: queued + in-flight payload bytes
+    max_queue_bytes: int = 256 << 20
+    # per-tenant admission bound (a single tenant can't fill the queue)
+    max_tenant_bytes: int = 128 << 20
+    # DRR byte quantum added to each tenant's deficit per assembly pass
+    drr_quantum: int = 1 << 20
+    # per-lane staging budget: device batch ≈ budget / padded_len, like
+    # the bridge's old staging rule, so a 16 MiB bucket can't OOM
+    staging_budget: int = 128 << 20
+    # launches allowed in flight per lane: 2 = double-buffer (the next
+    # batch assembles and stages while the previous one runs on device,
+    # matching the old stream gate's pending depth); 1 = strictly serial
+    pipeline_depth: int = 2
+    # auto-registered tenants beyond this bound are evicted once idle
+    # (explicitly registered tenants are pinned) — bounds the state an
+    # attacker can create with fresh X-Tenant values per request
+    max_idle_tenants: int = 1024
+    # test/extension hook: (algo, bucket, batch) -> plane with
+    # .run(payloads) -> list[digest]; None = built-in planes
+    plane_factory: Callable | None = None
+
+
+class _Tenant:
+    __slots__ = (
+        "name", "weight", "max_bytes", "queued_bytes", "served_bytes",
+        "served_pieces", "shed", "deficit", "pinned",
+    )
+
+    def __init__(self, name: str, weight: float = 1.0, max_bytes: int | None = None):
+        self.name = name
+        self.weight = weight
+        self.max_bytes = max_bytes
+        self.queued_bytes = 0
+        self.served_bytes = 0
+        self.served_pieces = 0
+        self.shed = 0
+        self.deficit = 0
+        self.pinned = False  # register_tenant pins; auto-registered may be evicted
+
+
+class _Submission:
+    """One caller request of N pieces; resolves when all N demuxed."""
+
+    __slots__ = ("mode", "results", "remaining", "future")
+
+    def __init__(self, n: int, mode: str, loop: asyncio.AbstractEventLoop):
+        self.mode = mode  # 'digest' | 'verify'
+        self.results: list = [None] * n
+        self.remaining = n
+        self.future: asyncio.Future = loop.create_future()
+
+    def deliver(self, idx: int, value) -> None:
+        self.results[idx] = value
+        self.remaining -= 1
+        if self.remaining == 0 and not self.future.done():
+            if self.mode == "verify":
+                self.future.set_result(bytes(self.results))
+            else:
+                self.future.set_result(self.results)
+
+
+class _Ticket:
+    """One piece in the queue: (submission, index, payload, expected)."""
+
+    __slots__ = ("sub", "idx", "payload", "expected", "tenant", "nbytes", "ts")
+
+    def __init__(self, sub, idx, payload, expected, tenant, ts):
+        self.sub = sub
+        self.idx = idx
+        self.payload = payload
+        self.expected = expected
+        self.tenant = tenant
+        self.nbytes = len(payload)
+        self.ts = ts
+
+
+class _Lane:
+    """Assembler state for one (algo, piece-length bucket) geometry."""
+
+    __slots__ = (
+        "algo", "bucket", "target", "queues", "rotation", "pending_pieces",
+        "event", "task", "plane", "build_lock", "sem", "inflight",
+    )
+
+    def __init__(self, algo: str, bucket: int, target: int, pipeline_depth: int):
+        self.algo = algo
+        self.bucket = bucket
+        self.target = target
+        self.queues: dict[str, deque] = {}
+        self.rotation: list[str] = []
+        self.pending_pieces = 0
+        self.event = asyncio.Event()
+        self.task: asyncio.Task | None = None
+        self.plane = None  # built lazily off the event loop
+        # pipelined launches run _run_plane in concurrent worker threads,
+        # so first-use plane construction needs a real lock
+        self.build_lock = threading.Lock()
+        self.sem = asyncio.Semaphore(max(1, pipeline_depth))
+        self.inflight: set[asyncio.Task] = set()
+
+    def oldest_ts(self) -> float:
+        return min(q[0].ts for q in self.queues.values() if q)
+
+
+# --------------------------------------------------------------- planes
+
+
+class _CpuPlane:
+    """hashlib fallback plane — the CPU-path parity backend."""
+
+    def __init__(self, algo: str):
+        self._h = hashlib.sha256 if algo == "sha256" else hashlib.sha1
+
+    def run(self, payloads: list[bytes]) -> list[bytes]:
+        h = self._h
+        return [h(p).digest() for p in payloads]
+
+
+class _Sha1DevicePlane:
+    """SHA-1 device plane: one compiled TPUVerifier per bucket (the
+    geometry-grouped compile cache the bulk/verify loops relied on).
+
+    Stages into reusable per-plane slots instead of ``hash_pieces`` (which
+    allocates + zeroes a fresh ``batch × padded_len`` buffer every launch
+    — tens of MiB of memset on the hot path). ``pad_in_place`` requires
+    everything past each message to be zero, so each slot remembers its
+    per-row content extent from the previous launch and zeroes only the
+    stale tail. Slot checkout is locked: pipelined launches run in
+    concurrent worker threads."""
+
+    def __init__(self, bucket: int, batch: int):
+        from torrent_tpu.models.verifier import TPUVerifier
+
+        self._verifier = TPUVerifier(piece_length=bucket, batch_size=batch)
+        self._slots: list[tuple] = []  # (padded, view, ends) free list
+        self._slot_lock = threading.Lock()
+
+    def _checkout(self):
+        import numpy as np
+
+        from torrent_tpu.ops.padding import alloc_padded
+
+        with self._slot_lock:
+            if self._slots:
+                return self._slots.pop()
+        v = self._verifier
+        padded, view = alloc_padded(v.batch_size, v.piece_length)
+        return padded, view, np.zeros(v.batch_size, dtype=np.int64)
+
+    def run(self, payloads: list[bytes]) -> list[bytes]:
+        import numpy as np
+
+        from torrent_tpu.ops.padding import pad_in_place, words_to_digests
+
+        v = self._verifier
+        b = v.batch_size
+        if any(len(p) > v.piece_length for p in payloads):
+            raise ValueError("piece longer than plane piece_length")
+        out: list[bytes] = []
+        for start in range(0, len(payloads), b):
+            chunk = payloads[start : start + b]
+            padded, view, ends = self._checkout()
+            try:
+                lengths = np.zeros(b, dtype=np.int64)
+                for i in range(b):
+                    n = len(chunk[i]) if i < len(chunk) else 0
+                    stale = int(ends[i])
+                    if stale > n:
+                        padded[i, n:stale] = 0
+                    if n:
+                        view[i, :n] = np.frombuffer(chunk[i], dtype=np.uint8)
+                        lengths[i] = n
+                nblocks = pad_in_place(padded, lengths)
+                # content extent (message + padding) per row, for the next
+                # reuse's tail zeroing — recorded before sentinels clear
+                ends[:] = nblocks.astype(np.int64) * 64
+                nblocks[len(chunk) :] = 0  # sentinel rows: skip entirely
+                words = v.digest_batch(padded, nblocks)
+                out.extend(words_to_digests(words[: len(chunk)]))
+            finally:
+                with self._slot_lock:
+                    self._slots.append((padded, view, ends))
+        return out
+
+
+class _Sha256DevicePlane:
+    """SHA-256 (BEP 52) device plane. Always the scan backend: the
+    pallas kernel pads every launch to a tile multiple (>=1024 rows),
+    which would blow the staging budget the lane batch enforces."""
+
+    def __init__(self, bucket: int, batch: int):
+        from torrent_tpu.ops.sha256_jax import make_sha256_fn
+
+        self._fn = make_sha256_fn("jax")
+        self._bucket = bucket
+        self._batch = batch
+
+    def run(self, payloads: list[bytes]) -> list[bytes]:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from torrent_tpu.models.merkle import words32_to_digests
+        from torrent_tpu.ops.padding import alloc_padded, pad_in_place
+
+        out: list[bytes] = []
+        b = self._batch
+        for start in range(0, len(payloads), b):
+            chunk = payloads[start : start + b]
+            padded, view = alloc_padded(b, self._bucket)
+            lengths = np.zeros(b, dtype=np.int64)
+            for i, p in enumerate(chunk):
+                view[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+                lengths[i] = len(p)
+            nblocks = pad_in_place(padded, lengths)
+            nblocks[len(chunk) :] = 0
+            words = np.asarray(self._fn(jnp.asarray(padded), jnp.asarray(nblocks)))
+            out.extend(words32_to_digests(words[: len(chunk)]))
+        return out
+
+
+# ------------------------------------------------------------ scheduler
+
+
+class HashPlaneScheduler:
+    """The shared verify queue. One instance serves every consumer of a
+    process's hash plane; see the module docstring for the data flow."""
+
+    def __init__(self, config: SchedulerConfig | None = None, hasher: str = "tpu"):
+        self.config = config or SchedulerConfig()
+        self.hasher = hasher
+        self._tenants: dict[str, _Tenant] = {}
+        self._lanes: dict[tuple[str, int], _Lane] = {}
+        self._queued_bytes = 0  # queued + in-flight payload bytes
+        self._closing = False
+        self._space = asyncio.Event()  # pulsed on every byte release
+        # metrics
+        self._launches = 0
+        self._fill_sum = 0.0
+        self._flush_reasons = {"full": 0, "deadline": 0, "shutdown": 0}
+        self._shed_total = 0
+        # rollup of evicted auto-registered tenants so served/shed totals
+        # stay monotonic after their per-tenant series disappear
+        self._evicted = {"tenants": 0, "served_bytes": 0, "served_pieces": 0, "shed": 0}
+
+    # ------------------------------------------------------------ admin
+
+    async def start(self) -> "HashPlaneScheduler":
+        """Bind to the running loop (lanes spawn lazily on first use)."""
+        return self
+
+    async def close(self) -> None:
+        """Flush every pending item (reason 'shutdown') and stop lanes."""
+        self._closing = True
+        for lane in self._lanes.values():
+            lane.event.set()
+        self._space.set()
+        for lane in list(self._lanes.values()):
+            if lane.task is not None:
+                await lane.task
+            if lane.inflight:
+                await asyncio.gather(*lane.inflight, return_exceptions=True)
+
+    def register_tenant(
+        self, name: str, weight: float = 1.0, max_bytes: int | None = None
+    ) -> None:
+        """Declare a tenant's scheduling weight / byte bound (idempotent;
+        unseen tenants are auto-registered at weight 1.0 on first use)."""
+        if weight <= 0:
+            raise ValueError("tenant weight must be positive")
+        t = self._tenants.get(name)
+        if t is None:
+            t = self._tenants[name] = _Tenant(name, weight, max_bytes)
+        else:
+            t.weight = weight
+            if max_bytes is not None:
+                t.max_bytes = max_bytes
+        t.pinned = True
+
+    # ---------------------------------------------------------- helpers
+
+    @staticmethod
+    def bucket_for(piece_length: int) -> int:
+        """Pow-2 piece-length bucket (shared executable per bucket)."""
+        return 1 << (piece_length - 1).bit_length() if piece_length > 1 else 1
+
+    def chunk_for(self, piece_length: int) -> int:
+        """Effective batch target for this geometry — the lane flush
+        size, shrunk for big-piece buckets by the staging budget. Stream
+        ingests use it as their submission chunk so one submission maps
+        to roughly one launch."""
+        from torrent_tpu.ops.padding import padded_len_for
+
+        bucket = self.bucket_for(piece_length)
+        afford = max(1, self.config.staging_budget // padded_len_for(bucket))
+        return max(1, min(self.config.batch_target, afford))
+
+    def _lane(self, algo: str, piece_length: int) -> _Lane:
+        bucket = self.bucket_for(piece_length)
+        key = (algo, bucket)
+        lane = self._lanes.get(key)
+        if lane is None:
+            lane = _Lane(algo, bucket, self.chunk_for(bucket), self.config.pipeline_depth)
+            self._lanes[key] = lane
+            lane.task = asyncio.ensure_future(self._lane_loop(lane))
+        return lane
+
+    def _tenant(self, name: str) -> _Tenant:
+        t = self._tenants.get(name)
+        if t is None:
+            t = _Tenant(name)
+            self._tenants[name] = t
+            if len(self._tenants) > self.config.max_idle_tenants:
+                self._prune_tenants()
+        return t
+
+    def _prune_tenants(self) -> None:
+        """Evict idle auto-registered tenants once past the cardinality
+        bound — an attacker sending a fresh X-Tenant per request must not
+        grow per-tenant state, /metrics series, or the DRR rotation
+        without limit. Pinned (register_tenant) tenants are kept."""
+        excess = len(self._tenants) - self.config.max_idle_tenants
+        for name, t in list(self._tenants.items()):
+            if excess <= 0:
+                return
+            if t.pinned or t.queued_bytes:
+                continue
+            # queued_bytes misses zero-length payloads, so check queues too
+            if any(lane.queues.get(name) for lane in self._lanes.values()):
+                continue
+            del self._tenants[name]
+            for lane in self._lanes.values():
+                if lane.queues.pop(name, None) is not None:
+                    lane.rotation.remove(name)
+            self._evicted["tenants"] += 1
+            self._evicted["served_bytes"] += t.served_bytes
+            self._evicted["served_pieces"] += t.served_pieces
+            self._evicted["shed"] += t.shed
+            excess -= 1
+
+    # ------------------------------------------------------------ submit
+
+    async def enqueue(
+        self,
+        tenant: str,
+        pieces: list[bytes],
+        expected: list[bytes] | None = None,
+        algo: str = "sha1",
+        piece_length: int | None = None,
+        wait: bool = False,
+    ) -> asyncio.Future:
+        """Queue one submission; returns a future resolving to its
+        results (digest list, or ok-bytes when ``expected`` is given).
+
+        ``wait=False`` sheds with :class:`SchedRejected` when admission
+        control is over budget (the bridge's 429); ``wait=True`` blocks
+        until space frees — the backpressure path for streaming ingest.
+        """
+        if algo not in DIGEST_LEN:
+            raise ValueError(f"unknown algo {algo!r}")
+        mode = "digest" if expected is None else "verify"
+        if expected is not None and len(expected) != len(pieces):
+            raise ValueError("expected list must match pieces")
+        loop = asyncio.get_running_loop()
+        sub = _Submission(len(pieces), mode, loop)
+        if not pieces:
+            sub.future.set_result(b"" if mode == "verify" else [])
+            return sub.future
+        ts = self._tenant(tenant)
+        nbytes = sum(len(p) for p in pieces)
+        await self._admit(ts, nbytes, wait)
+        plen = piece_length if piece_length else max(len(p) for p in pieces)
+        if any(len(p) > self.bucket_for(plen) for p in pieces):
+            raise ValueError("piece exceeds submission piece_length")
+        lane = self._lane(algo, plen)
+        q = lane.queues.get(tenant)
+        if q is None:
+            q = lane.queues[tenant] = deque()
+            lane.rotation.append(tenant)
+        now = time.monotonic()
+        for i, p in enumerate(pieces):
+            q.append(_Ticket(sub, i, p, expected[i] if expected else None, tenant, now))
+        lane.pending_pieces += len(pieces)
+        ts.queued_bytes += nbytes
+        self._queued_bytes += nbytes
+        lane.event.set()
+        return sub.future
+
+    async def submit(self, tenant: str, pieces, expected=None, algo="sha1",
+                     piece_length=None, wait: bool = False):
+        """``enqueue`` + await: returns digests (or ok-bytes) directly."""
+        fut = await self.enqueue(tenant, pieces, expected, algo, piece_length, wait)
+        return await fut
+
+    async def _admit(self, ts: _Tenant, nbytes: int, wait: bool) -> None:
+        cfg = self.config
+        tenant_limit = ts.max_bytes if ts.max_bytes is not None else cfg.max_tenant_bytes
+
+        def over() -> tuple[bool, int, int]:
+            # The empty-queue escape exists ONLY for the blocking path: an
+            # oversize submission that can never fit must be admitted once
+            # the queue drains or wait=True livelocks forever. On the shed
+            # path it would let one giant submission blow past both bounds
+            # into an idle queue and then 429 everyone else while it drains.
+            if self._queued_bytes + nbytes > cfg.max_queue_bytes and not (
+                wait and self._queued_bytes == 0
+            ):
+                return True, self._queued_bytes, cfg.max_queue_bytes
+            if ts.queued_bytes + nbytes > tenant_limit and not (
+                wait and ts.queued_bytes == 0
+            ):
+                return True, ts.queued_bytes, tenant_limit
+            return False, 0, 0
+
+        while True:
+            if self._closing:
+                ts.shed += 1
+                self._shed_total += 1
+                raise SchedRejected("scheduler shutting down", ts.name)
+            is_over, got, limit = over()
+            if not is_over:
+                return
+            if not wait:
+                ts.shed += 1
+                self._shed_total += 1
+                raise SchedRejected("queue full", ts.name, got, limit)
+            # blocking backpressure: wait for the next byte release.
+            # clear-then-recheck so a release between over() and wait()
+            # can't be lost.
+            self._space.clear()
+            is_over, _, _ = over()
+            if not is_over:
+                return
+            await self._space.wait()
+
+    # --------------------------------------------------------- assembler
+
+    async def _lane_loop(self, lane: _Lane) -> None:
+        cfg = self.config
+        while True:
+            if lane.pending_pieces == 0:
+                if self._closing:
+                    return
+                lane.event.clear()
+                if lane.pending_pieces == 0 and not self._closing:
+                    await lane.event.wait()
+                continue
+            # oldest queued item bounds the wait: flush at target fill
+            # or when its deadline expires, whichever comes first
+            deadline = lane.oldest_ts() + cfg.flush_deadline
+            while lane.pending_pieces < lane.target and not self._closing:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                lane.event.clear()
+                if lane.pending_pieces >= lane.target or self._closing:
+                    break
+                try:
+                    await asyncio.wait_for(lane.event.wait(), remaining)
+                except asyncio.TimeoutError:
+                    break
+            tickets = self._drr_take(lane)
+            if not tickets:
+                continue
+            reason = (
+                "full"
+                if len(tickets) >= lane.target
+                else ("shutdown" if self._closing else "deadline")
+            )
+            # pipelined launch: the semaphore bounds in-flight launches
+            # (depth 2 = double-buffer) while this loop keeps assembling
+            # the next batch during the device run — the host/device
+            # overlap the old stream gate had
+            await lane.sem.acquire()
+            task = asyncio.ensure_future(self._launch(lane, tickets, reason))
+            lane.inflight.add(task)
+            task.add_done_callback(lambda t, lane=lane: self._launch_done(lane, t))
+
+    def _launch_done(self, lane: _Lane, task: asyncio.Task) -> None:
+        lane.inflight.discard(task)
+        lane.sem.release()
+        if not task.cancelled() and task.exception() is not None:
+            # _launch resolves caller futures on every path, so an escape
+            # here is a bug — log it rather than dropping it silently
+            log.error("sched launch task error: %r", task.exception())
+
+    def _drr_take(self, lane: _Lane) -> list[_Ticket]:
+        """Deficit round-robin over queued bytes, up to the lane target."""
+        cfg = self.config
+        taken: list[_Ticket] = []
+        target = lane.target
+        while len(taken) < target:
+            active = [n for n in lane.rotation if lane.queues.get(n)]
+            if not active:
+                break
+            for name in active:
+                q = lane.queues[name]
+                t = self._tenants[name]
+                t.deficit += max(1, int(cfg.drr_quantum * t.weight))
+                while q and len(taken) < target and t.deficit >= q[0].nbytes:
+                    tkt = q.popleft()
+                    t.deficit -= tkt.nbytes
+                    lane.pending_pieces -= 1
+                    taken.append(tkt)
+                if not q:
+                    t.deficit = 0  # classic DRR: no credit hoarding
+                if len(taken) >= target:
+                    break
+        # rotate so the same tenant doesn't always lead the next pass
+        if lane.rotation:
+            lane.rotation.append(lane.rotation.pop(0))
+        return taken
+
+    # ------------------------------------------------------------ launch
+
+    def _build_plane(self, lane: _Lane):
+        cfg = self.config
+        if cfg.plane_factory is not None:
+            return cfg.plane_factory(lane.algo, lane.bucket, lane.target)
+        if self.hasher == "cpu":
+            return _CpuPlane(lane.algo)
+        if lane.algo == "sha256":
+            return _Sha256DevicePlane(lane.bucket, lane.target)
+        return _Sha1DevicePlane(lane.bucket, lane.target)
+
+    def _run_plane(self, lane: _Lane, payloads: list[bytes]) -> list[bytes]:
+        """Worker-thread body: build the plane on first use (JAX init and
+        compiles run off the event loop) and execute the launch under a
+        trace annotation so batches are attributable in the timeline."""
+        if lane.plane is None:
+            # pipelined launches reach here from concurrent worker
+            # threads; double-checked lock so the plane compiles once
+            with lane.build_lock:
+                if lane.plane is None:
+                    lane.plane = self._build_plane(lane)
+        if self.hasher == "cpu":
+            return lane.plane.run(payloads)
+        from torrent_tpu.utils.trace import maybe_profile_batch
+
+        with maybe_profile_batch(f"sched_{lane.algo}_launch_b{lane.bucket}"):
+            return lane.plane.run(payloads)
+
+    async def _launch(self, lane: _Lane, tickets: list[_Ticket], reason: str) -> None:
+        self._launches += 1
+        self._fill_sum += len(tickets) / lane.target
+        self._flush_reasons[reason] += 1
+        payloads = [t.payload for t in tickets]
+        try:
+            digests = await asyncio.to_thread(self._run_plane, lane, payloads)
+            if len(digests) != len(tickets):
+                raise RuntimeError(
+                    f"plane returned {len(digests)} digests for {len(tickets)} pieces"
+                )
+        except Exception as e:  # a poisoned launch must not wedge the lane
+            log.error("sched launch failed (%s/%d): %s", lane.algo, lane.bucket, e)
+            self._demux(tickets, None, error=e)
+            return
+        self._demux(tickets, digests)
+
+    def _demux(self, tickets: list[_Ticket], digests, error=None) -> None:
+        """Per-launch result demux back to the awaiting submissions,
+        releasing queue bytes (and any blocked submitters) as it goes."""
+        for i, tkt in enumerate(tickets):
+            # the tenant may have been pruned while a zero-byte ticket was
+            # in flight — global accounting and delivery must still happen
+            t = self._tenants.get(tkt.tenant)
+            if t is not None:
+                t.queued_bytes -= tkt.nbytes
+            self._queued_bytes -= tkt.nbytes
+            if error is not None:
+                if not tkt.sub.future.done():
+                    tkt.sub.future.set_exception(error)
+                continue
+            if t is not None:
+                t.served_bytes += tkt.nbytes
+                t.served_pieces += 1
+            d = digests[i]
+            if tkt.sub.mode == "verify":
+                tkt.sub.deliver(tkt.idx, 1 if d == tkt.expected else 0)
+            else:
+                tkt.sub.deliver(tkt.idx, d)
+        self._space.set()  # wake admission waiters
+
+    # ----------------------------------------------------------- metrics
+
+    def metrics_snapshot(self) -> dict:
+        """Counters for utils/metrics.py's Prometheus rendering."""
+        pending = sum(l.pending_pieces for l in self._lanes.values())
+        return {
+            "queue_pieces": pending,
+            "queue_bytes": self._queued_bytes,
+            "lanes": len(self._lanes),
+            "launches": self._launches,
+            "fill_sum": self._fill_sum,
+            "mean_fill": (self._fill_sum / self._launches) if self._launches else 0.0,
+            "flush_reasons": dict(self._flush_reasons),
+            "shed_total": self._shed_total,
+            "evicted": dict(self._evicted),
+            "tenants": {
+                name: {
+                    "queued_bytes": t.queued_bytes,
+                    "served_bytes": t.served_bytes,
+                    "served_pieces": t.served_pieces,
+                    "shed": t.shed,
+                    "weight": t.weight,
+                }
+                for name, t in self._tenants.items()
+            },
+        }

@@ -43,6 +43,10 @@ class ClientConfig:
     host: str = "0.0.0.0"
     peer_id: bytes = field(default_factory=generate_peer_id)
     hasher: str = "cpu"  # 'cpu' | 'tpu' piece verification (BASELINE API)
+    # Shared hash-plane scheduler (torrent_tpu.sched): when set, every
+    # torrent's resume/self-heal recheck submits to this queue as a
+    # low-priority tenant instead of dispatching private device batches
+    scheduler: object | None = None
     torrent: TorrentConfig = field(default_factory=TorrentConfig)
     enable_upnp: bool = False  # optional, off by default (SURVEY §7.8)
     # NAT-PMP (RFC 6886): lighter port mapping many gateways speak when
@@ -406,7 +410,13 @@ class Client:
         # the caller across clients stays untouched (the same
         # shared-mutation bug class the reference had, SURVEY §8.2).
         torrent_config = dataclasses.replace(
-            self.config.torrent, hasher=self.config.hasher
+            self.config.torrent,
+            hasher=self.config.hasher,
+            scheduler=(
+                self.config.scheduler
+                if self.config.scheduler is not None
+                else self.config.torrent.scheduler
+            ),
         )
         torrent = Torrent(
             metainfo=metainfo,

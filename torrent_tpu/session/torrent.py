@@ -106,6 +106,13 @@ class TorrentConfig:
     announce_retry: float = 30.0
     hasher: str = "cpu"  # 'cpu' | 'tpu' — resume-recheck + batch verify
     verify_batch_size: int = 256
+    # Shared hash-plane scheduler (torrent_tpu.sched.HashPlaneScheduler).
+    # When set, resume/self-heal rechecks ride the shared verify queue as
+    # the low-priority "selfheal" tenant (DRR weight below) instead of
+    # dispatching their own device batches — swarm background traffic can
+    # never starve a foreground CLI verify or bridge client.
+    scheduler: object | None = None
+    selfheal_weight: float = 0.25
     dht_interval: float = 300.0  # DHT announce/lookup cadence
     pex_interval: float = 60.0  # BEP 11 peer-exchange cadence
     webseed_retry: float = 15.0  # backoff after a webseed failure
@@ -814,6 +821,20 @@ class Torrent:
         ):
             return  # nothing on disk, skip the scan
         cfg = self.config
+        if cfg.scheduler is not None and not getattr(self.info, "v2", False):
+            # shared-plane path: submit to the process-wide verify queue
+            # as a low-priority tenant — the scheduler coalesces these
+            # pieces with foreground traffic and its DRR keeps the
+            # background recheck from starving anyone (and vice versa:
+            # low weight, never zero, so it always progresses)
+            from torrent_tpu.parallel.verify import verify_pieces_sched
+
+            cfg.scheduler.register_tenant("selfheal", weight=cfg.selfheal_weight)
+            ok = await verify_pieces_sched(
+                self.storage, self.info, cfg.scheduler, tenant="selfheal"
+            )
+            self._apply_recheck(ok)
+            return
         kwargs = {}
         if cfg.hasher == "tpu":
             kwargs = {"batch_size": cfg.verify_batch_size}

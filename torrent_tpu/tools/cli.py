@@ -7,7 +7,8 @@ One multiplexed entry point over the whole framework::
     torrent-tpu verify   FILE.torrent DIR [--hasher cpu|tpu] [--batch N]
     torrent-tpu download SOURCE DIR [--port P] [--hasher cpu|tpu] [--seed] [--no-resume] [--files I,J]
     torrent-tpu tracker  [--http-port P] [--udp-port P] [--interval S]
-    torrent-tpu bridge   [--port P] [--hasher cpu|tpu]
+    torrent-tpu bridge   [--port P] [--hasher cpu|tpu] [--batch-target N]
+                         [--flush-deadline-ms MS] [--max-queue-mb MB] [--tenant-max-mb MB]
 
 ``download`` accepts either a ``.torrent`` file or a ``magnet:?...`` URI
 (BEP 9 metadata fetch). Also runnable as ``python -m torrent_tpu``.
@@ -1164,7 +1165,16 @@ def _cmd_tracker(args) -> int:
 def _cmd_bridge(args) -> int:
     from torrent_tpu.bridge.service import main as bridge_main
 
-    return bridge_main(["--port", str(args.port), "--hasher", args.hasher])
+    return bridge_main(
+        [
+            "--port", str(args.port),
+            "--hasher", args.hasher,
+            "--batch-target", str(args.batch_target),
+            "--flush-deadline-ms", str(args.flush_deadline_ms),
+            "--max-queue-mb", str(args.max_queue_mb),
+            "--tenant-max-mb", str(args.tenant_max_mb),
+        ]
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1443,6 +1453,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("bridge", help="run the TPU hash-plane HTTP bridge")
     sp.add_argument("--port", type=int, default=8421)
     sp.add_argument("--hasher", choices=("cpu", "tpu"), default="tpu")
+    # continuous-batching scheduler knobs (torrent_tpu/sched): launch
+    # fill target, deadline for stranded small requests, and the
+    # admission-control byte bounds that turn overload into 429s
+    sp.add_argument("--batch-target", type=int, default=256,
+                    help="pieces per device launch the scheduler fills to")
+    sp.add_argument("--flush-deadline-ms", type=float, default=20.0,
+                    help="max ms a queued piece waits before a partial flush")
+    sp.add_argument("--max-queue-mb", type=int, default=256,
+                    help="global queued-bytes bound (requests shed with 429 beyond)")
+    sp.add_argument("--tenant-max-mb", type=int, default=128,
+                    help="per-tenant queued-bytes bound")
     sp.set_defaults(fn=_cmd_bridge)
 
     return p

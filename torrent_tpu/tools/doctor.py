@@ -12,7 +12,9 @@ one-line remedy on failure:
 4. native io_engine availability (falls back to Python preads)
 5. loopback swarm smoke: author → seed → download 256 KiB through a
    real tracker + two Clients
-6. bridge smoke: /v1/digests round-trip on an ephemeral port
+6. verify-scheduler smoke: four tenants coalesce into one shared
+   hash-plane launch with correct digests (torrent_tpu/sched)
+7. bridge smoke: /v1/digests round-trip on an ephemeral port
 
 Exit code: 0 all PASS/WARN, 1 any FAIL. With ``--json``, stdout carries
 exactly one JSON object (``doctor --json | jq .`` works); human check
@@ -352,6 +354,32 @@ async def _swarm_smoke(tmp: str) -> None:
         server.close()
 
 
+async def _sched_smoke() -> str:
+    """Verify-scheduler smoke: four tenants submit small piece lists
+    concurrently and must come back with correct digests out of a
+    COALESCED launch (cross-request batch fill is the scheduler's whole
+    point). Returns the observed mean batch-fill ratio for the check
+    detail line."""
+    from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
+
+    sched = HashPlaneScheduler(
+        SchedulerConfig(batch_target=32, flush_deadline=0.25), hasher="cpu"
+    )
+    await sched.start()
+    try:
+        pieces = [bytes([i]) * 1024 for i in range(8)]
+        want = [hashlib.sha1(p).digest() for p in pieces]
+        outs = await asyncio.gather(
+            *(sched.submit(f"smoke{j}", pieces, algo="sha1") for j in range(4))
+        )
+        assert all(o == want for o in outs), "scheduler digests diverge from hashlib"
+        snap = sched.metrics_snapshot()
+        assert snap["launches"] >= 1, "no launch recorded"
+        return f"4 tenants coalesced, mean fill {snap['mean_fill']:.2f}"
+    finally:
+        await sched.close()
+
+
 async def _bridge_smoke() -> None:
     from torrent_tpu.bridge.service import BridgeServer
     from torrent_tpu.codec.bencode import bdecode, bencode
@@ -451,6 +479,11 @@ def main(argv=None) -> int:
                 _report("PASS", "loopback swarm", "256 KiB author→seed→download")
             except Exception as e:
                 _report("FAIL", "loopback swarm", repr(e))
+    try:
+        detail = asyncio.run(asyncio.wait_for(_sched_smoke(), 30))
+        _report("PASS", "verify scheduler", detail)
+    except Exception as e:
+        _report("FAIL", "verify scheduler", repr(e))
     try:
         asyncio.run(asyncio.wait_for(_bridge_smoke(), 30))
         _report("PASS", "bridge", "/v1/digests round-trip")

@@ -21,6 +21,59 @@ def _esc(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def render_sched_metrics(sched) -> str:
+    """Prometheus rendering of a hash-plane scheduler's counters.
+
+    ``sched`` is a ``torrent_tpu.sched.HashPlaneScheduler`` (anything
+    with its ``metrics_snapshot()`` contract). Served by the bridge's
+    ``GET /metrics`` and appended to the session exposition when a
+    ``MetricsServer`` is given a scheduler."""
+    s = sched.metrics_snapshot()
+    lines = [
+        "# HELP torrent_tpu_sched_queue_pieces Pieces queued awaiting a device launch",
+        "# TYPE torrent_tpu_sched_queue_pieces gauge",
+        f"torrent_tpu_sched_queue_pieces {s['queue_pieces']}",
+        "# HELP torrent_tpu_sched_queue_bytes Queued + in-flight payload bytes",
+        "# TYPE torrent_tpu_sched_queue_bytes gauge",
+        f"torrent_tpu_sched_queue_bytes {s['queue_bytes']}",
+        "# HELP torrent_tpu_sched_lanes Compiled (algo, piece-bucket) lanes",
+        "# TYPE torrent_tpu_sched_lanes gauge",
+        f"torrent_tpu_sched_lanes {s['lanes']}",
+        "# HELP torrent_tpu_sched_launches_total Device launches dispatched",
+        "# TYPE torrent_tpu_sched_launches_total counter",
+        f"torrent_tpu_sched_launches_total {s['launches']}",
+        "# HELP torrent_tpu_sched_batch_fill_ratio Mean launch fill vs the lane target",
+        "# TYPE torrent_tpu_sched_batch_fill_ratio gauge",
+        f"torrent_tpu_sched_batch_fill_ratio {s['mean_fill']:.6f}",
+        "# HELP torrent_tpu_sched_shed_total Submissions rejected by admission control",
+        "# TYPE torrent_tpu_sched_shed_total counter",
+        f"torrent_tpu_sched_shed_total {s['shed_total']}",
+        "# HELP torrent_tpu_sched_evicted_tenants_total Idle auto-registered tenants evicted to bound cardinality",
+        "# TYPE torrent_tpu_sched_evicted_tenants_total counter",
+        f"torrent_tpu_sched_evicted_tenants_total {s.get('evicted', {}).get('tenants', 0)}",
+        "# HELP torrent_tpu_sched_flush_total Launch flushes by reason",
+        "# TYPE torrent_tpu_sched_flush_total counter",
+    ]
+    for reason, n in sorted(s["flush_reasons"].items()):
+        lines.append(f'torrent_tpu_sched_flush_total{{reason="{reason}"}} {n}')
+    per_tenant = [
+        ("torrent_tpu_sched_tenant_served_bytes_total", "counter",
+         "Payload bytes hashed for this tenant", "served_bytes"),
+        ("torrent_tpu_sched_tenant_served_pieces_total", "counter",
+         "Pieces hashed for this tenant", "served_pieces"),
+        ("torrent_tpu_sched_tenant_queued_bytes", "gauge",
+         "Queued + in-flight bytes for this tenant", "queued_bytes"),
+        ("torrent_tpu_sched_tenant_shed_total", "counter",
+         "Submissions shed for this tenant", "shed"),
+    ]
+    for name, kind, help_text, key in per_tenant:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        for tenant, t in sorted(s["tenants"].items()):
+            lines.append(f'{name}{{tenant="{_esc(tenant)}"}} {t[key]}')
+    return "\n".join(lines) + "\n"
+
+
 def render_metrics(client) -> str:
     """The /metrics payload for one Client (Prometheus text format 0.0.4).
 
@@ -95,10 +148,15 @@ def render_metrics(client) -> str:
 
 
 class MetricsServer:
-    """``GET /metrics`` for one Client. Anything else is 404."""
+    """``GET /metrics`` for one Client. Anything else is 404.
 
-    def __init__(self, client, host: str = "127.0.0.1"):
+    ``scheduler``: optionally a hash-plane scheduler whose queue/fill/
+    shed counters are appended to the session exposition, so one scrape
+    covers both the swarm and the verify queue it feeds."""
+
+    def __init__(self, client, host: str = "127.0.0.1", scheduler=None):
         self.client = client
+        self.scheduler = scheduler
         self.host = host
         self.port: int | None = None
         self._server: asyncio.AbstractServer | None = None
@@ -130,7 +188,10 @@ class MetricsServer:
                     break
             parts = request.split()
             if len(parts) >= 2 and parts[0] == b"GET" and parts[1].split(b"?")[0] == b"/metrics":
-                body = render_metrics(self.client).encode()
+                text = render_metrics(self.client)
+                if self.scheduler is not None:
+                    text += render_sched_metrics(self.scheduler)
+                body = text.encode()
                 status = "200 OK"
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             else:

@@ -42,6 +42,23 @@ An ``X-Hash-Algo: sha256`` header switches the stream routes to the v2
 hash plane (BEP 52 leaf/merkle hashing feeds on 32-byte digests); the
 default is sha1. Digest/expected width follows the algorithm.
 
+Failure mapping (scheduler fault-tolerance layer, ``sched/scheduler``):
+admission shed stays **429**; a launch failure that outlives retry +
+bisection surfaces on the buffered routes as **503** with a
+``Retry-After`` header when transient, or **500** (no Retry-After) when
+deterministic — the payload itself fails the plane, so resubmitting
+cannot help. Streaming responses never drop the connection for a
+per-frame hash failure — failed frames come back as empty digests (or
+``ok=0``) plus a ``failed`` count, so a 100 GiB recheck survives one
+poisoned piece:
+
+  {digests: [20B | "" per failed frame, ...], failed: int}
+  {ok: bytes, valid: int, failed: int}   (failed ⊆ the ok=0 frames)
+
+``--fault-plan SPEC`` (dev/test mode only — requires ``--dev`` or
+``TORRENT_TPU_DEV=1``) injects deterministic faults through
+``sched/faults.py`` for manual chaos runs.
+
 Hand-rolled asyncio HTTP — no web framework needed for six routes.
 """
 
@@ -50,7 +67,13 @@ from __future__ import annotations
 import asyncio
 
 from torrent_tpu.codec.bencode import BencodeError, bdecode, bencode
-from torrent_tpu.sched import HashPlaneScheduler, SchedRejected, SchedulerConfig
+from torrent_tpu.sched import (
+    FaultPlan,
+    HashPlaneScheduler,
+    SchedLaunchError,
+    SchedRejected,
+    SchedulerConfig,
+)
 from torrent_tpu.utils.log import get_logger
 
 log = get_logger("bridge")
@@ -149,17 +172,25 @@ class BridgeServer:
         flush_deadline_ms: float = 20.0,
         max_queue_mb: int = 256,
         tenant_max_mb: int = 128,
+        fault_plan: FaultPlan | str | None = None,
     ):
         self.host = host
         self.port = port
         self.hasher = hasher
         self._server: asyncio.AbstractServer | None = None
         self.sched: HashPlaneScheduler | None = None
+        # chaos harness: injected faults wrap the planes the scheduler
+        # would build anyway (dev/test only — main() gates the CLI knob)
+        if isinstance(fault_plan, str):
+            fault_plan = FaultPlan.parse(fault_plan)
         self._sched_config = SchedulerConfig(
             batch_target=batch_target,
             flush_deadline=flush_deadline_ms / 1e3,
             max_queue_bytes=max_queue_mb << 20,
             max_tenant_bytes=tenant_max_mb << 20,
+            plane_factory=(
+                fault_plan.plane_factory(hasher=hasher) if fault_plan else None
+            ),
         )
 
     async def start(self) -> "BridgeServer":
@@ -250,7 +281,7 @@ class BridgeServer:
     ):
         dlen = 32 if algo == "sha256" else 20
         chunk = self.sched.chunk_for(plen)
-        futs: list[asyncio.Future] = []
+        futs: list[tuple[asyncio.Future, int]] = []
         batch: list[bytes] = []
         batch_exp: list[bytes] = []
         batch_bytes = 0
@@ -266,7 +297,7 @@ class BridgeServer:
                 piece_length=plen,
                 wait=True,  # streaming backpressure, not load-shed
             )
-            futs.append(fut)
+            futs.append((fut, len(batch)))
             batch, batch_exp, batch_bytes = [], [], 0
 
         try:
@@ -293,16 +324,31 @@ class BridgeServer:
                 await flush()
             digests: list[bytes] = []
             ok_flags = bytearray()
-            for fut in futs:
-                res = await fut
+            failed = 0
+            for fut, npieces in futs:
+                # a per-frame hash failure (retry/bisection exhausted)
+                # must not drop the whole connection: report the frames
+                # as failed and keep streaming the rest of the response
+                try:
+                    res = await fut
+                except SchedLaunchError as e:
+                    log.warning("stream frames failed (%d pieces): %s", npieces, e)
+                    failed += npieces
+                    if mode == "digests":
+                        digests.extend([b""] * npieces)
+                    else:
+                        ok_flags.extend(b"\x00" * npieces)
+                    continue
                 if mode == "digests":
                     digests.extend(res)
                 else:
                     ok_flags.extend(res)
             if mode == "digests":
-                payload = bencode({b"digests": digests})
+                payload = bencode({b"digests": digests, b"failed": failed})
             else:
-                payload = bencode({b"ok": bytes(ok_flags), b"valid": sum(ok_flags)})
+                payload = bencode(
+                    {b"ok": bytes(ok_flags), b"valid": sum(ok_flags), b"failed": failed}
+                )
             await self._reply(writer, 200, payload)
         except ValueError as e:
             await self._reply(writer, 400, str(e).encode())
@@ -395,6 +441,8 @@ class BridgeServer:
                 digests = await self.sched.submit(tenant, pieces, algo="sha1")
             except SchedRejected as e:
                 return await self._reply(writer, 429, str(e).encode())
+            except SchedLaunchError as e:
+                return await self._reply_launch_failed(writer, e)
             return await self._reply(writer, 200, bencode({b"digests": digests}))
         if target == "/v1/verify":
             expected = req.get(b"expected")
@@ -410,15 +458,32 @@ class BridgeServer:
                 )
             except SchedRejected as e:
                 return await self._reply(writer, 429, str(e).encode())
+            except SchedLaunchError as e:
+                return await self._reply_launch_failed(writer, e)
             return await self._reply(writer, 200, bencode({b"ok": ok}))
         await self._reply(writer, 404, b"not found")
 
-    async def _reply(self, writer, status: int, body: bytes):
+    async def _reply_launch_failed(self, writer, e: SchedLaunchError):
+        # transient retry-exhausted failure: 503 + Retry-After (shed is
+        # 429 — different remedy). A deterministic (payload-caused)
+        # failure must NOT advertise Retry-After: resubmitting the same
+        # payload re-runs the whole retry+bisection cascade forever — 500
+        # tells the client the request itself is the problem.
+        if e.kind == "transient":
+            return await self._reply(
+                writer, 503, str(e).encode(), headers={"Retry-After": "1"}
+            )
+        return await self._reply(writer, 500, str(e).encode())
+
+    async def _reply(self, writer, status: int, body: bytes, headers=None):
         try:
             head = (
                 f"HTTP/1.1 {status} X\r\nContent-Type: application/octet-stream\r\n"
-                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n"
             )
+            for k, v in (headers or {}).items():
+                head += f"{k}: {v}\r\n"
+            head += "\r\n"
             writer.write(head.encode("latin-1") + body)
             await writer.drain()
         except (ConnectionError, OSError):
@@ -456,7 +521,36 @@ def main(argv=None):  # pragma: no cover - manual entrypoint
         "--tenant-max-mb", type=int, default=128,
         help="per-tenant admission bound on queued piece bytes",
     )
+    parser.add_argument(
+        "--fault-plan", default=None, metavar="SPEC",
+        help="inject deterministic hash-plane faults (sched/faults.py spec, "
+        "e.g. 'fail_first=3;latency_ms=5'); dev/test mode only",
+    )
+    parser.add_argument(
+        "--dev", action="store_true",
+        help="dev/test mode: unlocks chaos knobs like --fault-plan",
+    )
     args = parser.parse_args(argv)
+
+    fault_plan = None
+    if args.fault_plan:
+        # chaos knobs must not leak into production invocations: require
+        # an explicit dev-mode opt-in (flag or env), and fail closed
+        import os
+        import sys
+
+        if not (args.dev or os.environ.get("TORRENT_TPU_DEV", "") in ("1", "true")):
+            print(
+                "error: --fault-plan is a dev/test chaos knob; pass --dev "
+                "or set TORRENT_TPU_DEV=1 to use it",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            fault_plan = FaultPlan.parse(args.fault_plan)
+        except ValueError as e:
+            print(f"error: bad --fault-plan: {e}", file=sys.stderr)
+            return 2
 
     async def go():
         server = await serve_bridge(
@@ -467,6 +561,7 @@ def main(argv=None):  # pragma: no cover - manual entrypoint
             flush_deadline_ms=args.flush_deadline_ms,
             max_queue_mb=args.max_queue_mb,
             tenant_max_mb=args.tenant_max_mb,
+            fault_plan=fault_plan,
         )
         print(f"bridge listening on {args.host}:{server.port}")
         await server.wait_closed()
@@ -476,4 +571,4 @@ def main(argv=None):  # pragma: no cover - manual entrypoint
 
 
 if __name__ == "__main__":  # pragma: no cover
-    main()
+    raise SystemExit(main())

@@ -189,8 +189,15 @@ async def verify_library_sched(
     too, with the scheduler's DRR keeping them fair. Geometry grouping
     is the scheduler's lane map, so the compile cache is shared with
     every other consumer rather than per-call.
+
+    Per-piece hash failures (``SchedLaunchError`` after the scheduler's
+    retry/bisection) leave those pieces unverified (False) and the sweep
+    continues — a poisoned piece in torrent 3 must not abort the other
+    997 torrents' results.
     """
     from torrent_tpu.parallel.verify import enqueue_torrent_sched
+    from torrent_tpu.sched import SchedLaunchError
+    from torrent_tpu.utils.log import get_logger
 
     t0 = time.perf_counter()
     bitfields = [np.zeros(info.num_pieces, dtype=bool) for _, info in items]
@@ -206,7 +213,17 @@ async def verify_library_sched(
             pending.append((fut, ti, keep))
     done = 0
     for fut, ti, keep in pending:
-        ok = await fut
+        try:
+            ok = await fut
+        except SchedLaunchError as e:
+            get_logger("parallel.bulk").warning(
+                "library sweep: %d pieces of torrent %d unverified "
+                "(hash launch failed: %s)", len(keep), ti, e,
+            )
+            done += len(keep)  # stay False: recheck later
+            if progress_cb:
+                progress_cb(min(done, total_pieces), total_pieces)
+            continue
         for j, pi in enumerate(keep):
             bitfields[ti][pi] = bool(ok[j])
         done += len(keep)

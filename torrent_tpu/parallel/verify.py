@@ -67,8 +67,8 @@ def verify_pieces_cpu(
     for idx in range(n):
         try:
             data = storage.read_piece(idx)
-        except StorageError:
-            continue
+        except (StorageError, OSError):
+            continue  # unreadable = failed piece, keep checking the rest
         if len(data) == piece_length(info, idx) and hashlib.sha1(data).digest() == info.pieces[idx]:
             bitfield[idx] = True
         if progress_cb and (idx + 1) % 256 == 0:
@@ -147,8 +147,8 @@ def verify_pieces_v2_cpu(
     for idx in range(n):
         try:
             data = storage.read_piece(idx)
-        except StorageError:
-            continue
+        except (StorageError, OSError):
+            continue  # unreadable = failed piece, keep checking the rest
         if (
             len(data) == info.piece_sizes[idx]
             and piece_root_cpu(data, info.piece_pad_leaves[idx]) == info.pieces[idx]
@@ -262,9 +262,14 @@ async def enqueue_torrent_sched(
     def read_chunk(idxs: list[int]):
         payloads, exps, keep = [], [], []
         for i in idxs:
+            # mark-and-continue, same as the CPU path (verify_pieces_cpu):
+            # a torn/unreadable piece mid-recheck stays False in the
+            # caller's bitfield instead of aborting every other piece.
+            # OSError too — a backend that leaks a raw errno (file
+            # truncated between open and pread) must not kill the pass.
             try:
                 data = storage.read_piece(i)
-            except StorageError:
+            except (StorageError, OSError):
                 continue
             if len(data) != piece_length(info, i):
                 continue
@@ -310,9 +315,17 @@ async def verify_pieces_sched(
     admission (``wait=True``), so a full queue pauses the disk read
     loop instead of buffering without bound.
 
+    A launch failure that outlives the scheduler's retry/bisection
+    (``SchedLaunchError``) marks its pieces unverified (False — retried
+    on the next recheck or re-downloaded) instead of aborting the whole
+    pass: one poisoned piece must not discard every verified one.
+
     v2 (merkle) infos don't map onto the flat digest plane; use
     ``verify_pieces`` for those.
     """
+    from torrent_tpu.sched import SchedLaunchError
+    from torrent_tpu.utils.log import get_logger
+
     if getattr(info, "v2", False):
         raise ValueError("scheduler sessions are sha1/v1-only; use verify_pieces")
     n = info.num_pieces
@@ -322,7 +335,17 @@ async def verify_pieces_sched(
     futs = await enqueue_torrent_sched(storage, info, scheduler, tenant, chunk_pieces)
     done = 0
     for fut, keep in futs:
-        ok = await fut
+        try:
+            ok = await fut
+        except SchedLaunchError as e:
+            get_logger("parallel.verify").warning(
+                "recheck: %d pieces unverified (hash launch failed: %s)",
+                len(keep), e,
+            )
+            done += len(keep)  # stay False in the bitfield: retry later
+            if progress_cb:
+                progress_cb(min(done, n), n)
+            continue
         for j, i in enumerate(keep):
             bitfield[i] = bool(ok[j])
         done += len(keep)

@@ -8,10 +8,26 @@ admission control, deadline-aware batch assembly, deficit-round-robin
 fairness, and per-launch result demux. See scheduler.py for the design.
 """
 
+from torrent_tpu.sched.faults import (
+    DeviceFaultError,
+    FaultPlan,
+    PoisonedPayloadError,
+)
 from torrent_tpu.sched.scheduler import (
     HashPlaneScheduler,
+    SchedLaunchError,
     SchedRejected,
     SchedulerConfig,
+    classify_error,
 )
 
-__all__ = ["HashPlaneScheduler", "SchedRejected", "SchedulerConfig"]
+__all__ = [
+    "DeviceFaultError",
+    "FaultPlan",
+    "HashPlaneScheduler",
+    "PoisonedPayloadError",
+    "SchedLaunchError",
+    "SchedRejected",
+    "SchedulerConfig",
+    "classify_error",
+]

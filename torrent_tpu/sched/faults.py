@@ -1,0 +1,169 @@
+"""Deterministic fault injection for the hash plane.
+
+The fault-tolerance layer in ``scheduler.py`` (launch retry, bisection,
+per-lane circuit breaker, CPU degradation) is only trustworthy if every
+behavior has a deterministic CPU-only test — accelerator faults can't
+be provoked on demand, so they are *injected* instead. A
+:class:`FaultPlan` describes what goes wrong and when:
+
+* ``fail_first`` / ``fail_launches`` — the Nth plane launches raise a
+  *transient* :class:`DeviceFaultError` (the XLA-hiccup model; feeds
+  the breaker, worth a retry).
+* ``payload_prefix`` — any launch whose batch contains a payload with
+  this byte prefix raises a *deterministic*
+  :class:`PoisonedPayloadError` (the poisoned-ticket model; skips
+  retries, drives bisection until the ticket fails alone).
+* ``latency_s`` — every launch sleeps first (latency-spike model; used
+  to prove deadlines/backpressure survive a slow plane).
+* ``dead_after`` — every launch past the Nth raises (permanent device
+  loss; the breaker must pin the lane on the CPU plane).
+
+Plans wrap whatever plane the scheduler would otherwise build, through
+the existing ``SchedulerConfig.plane_factory`` seam::
+
+    plan = FaultPlan.parse("fail_first=3;latency_ms=5")
+    cfg = SchedulerConfig(plane_factory=plan.plane_factory(hasher="cpu"))
+
+``bridge --fault-plan SPEC`` (dev/test mode only) and ``doctor
+--faults`` wire the same specs up for manual chaos runs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+__all__ = [
+    "DeviceFaultError",
+    "FaultPlan",
+    "FaultyPlane",
+    "PoisonedPayloadError",
+]
+
+
+class DeviceFaultError(Exception):
+    """Injected transient device failure (XLA/launch hiccup model)."""
+
+    sched_error_class = "transient"
+
+
+class PoisonedPayloadError(Exception):
+    """Injected deterministic failure tied to a payload (poisoned
+    ticket model) — retrying the same batch can never succeed."""
+
+    sched_error_class = "deterministic"
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """Declarative description of injected hash-plane faults.
+
+    Launch ordinals are 1-based and counted per wrapped plane (= per
+    scheduler lane), under a lock — pipelined launches run in worker
+    threads, and the count must stay deterministic.
+    """
+
+    # transient: launches 1..fail_first raise DeviceFaultError
+    fail_first: int = 0
+    # transient: these exact launch ordinals raise DeviceFaultError
+    fail_launches: frozenset[int] = field(default_factory=frozenset)
+    # deterministic: a batch containing a payload with this prefix
+    # raises PoisonedPayloadError
+    payload_prefix: bytes | None = None
+    # every launch sleeps this long before running (latency spike)
+    latency_s: float = 0.0
+    # permanent device loss: every launch past this ordinal raises
+    dead_after: int | None = None
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Build a plan from the CLI spec grammar: ``;``-separated
+        ``key=value`` pairs, e.g. ``"fail_first=3;latency_ms=5"`` or
+        ``"payload=deadbeef;fail_launches=2,5"``."""
+        kw: dict = {}
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"fault-plan term {part!r} is not key=value")
+            key, _, value = part.partition("=")
+            key, value = key.strip(), value.strip()
+            if key not in (
+                "fail_first", "fail_launches", "payload", "latency_ms", "dead_after"
+            ):
+                raise ValueError(f"unknown fault-plan key {key!r}")
+            try:
+                if key == "fail_first":
+                    kw["fail_first"] = int(value)
+                elif key == "fail_launches":
+                    kw["fail_launches"] = frozenset(
+                        int(v) for v in value.split(",") if v
+                    )
+                elif key == "payload":
+                    kw["payload_prefix"] = bytes.fromhex(value)
+                elif key == "latency_ms":
+                    kw["latency_s"] = float(value) / 1e3
+                elif key == "dead_after":
+                    kw["dead_after"] = int(value)
+            except Exception as e:  # int()/fromhex() failures with context
+                raise ValueError(f"bad fault-plan value {part!r}: {e}") from e
+        plan = cls(**kw)
+        if plan.fail_first < 0 or (plan.dead_after is not None and plan.dead_after < 0):
+            raise ValueError("fault-plan launch ordinals must be >= 0")
+        if plan.latency_s < 0:
+            raise ValueError("fault-plan latency must be >= 0")
+        if plan.payload_prefix is not None and not plan.payload_prefix:
+            # b"" startswith-matches every payload: a typo'd "payload="
+            # must not silently become fail-every-launch
+            raise ValueError("fault-plan payload prefix must be non-empty")
+        return plan
+
+    def plane_factory(self, hasher: str = "tpu", base_factory=None):
+        """A ``SchedulerConfig.plane_factory`` injecting this plan
+        around the planes the scheduler would otherwise build (or
+        around ``base_factory``'s planes when given)."""
+
+        def factory(algo: str, bucket: int, batch: int):
+            if base_factory is not None:
+                inner = base_factory(algo, bucket, batch)
+            else:
+                from torrent_tpu.sched.scheduler import build_builtin_plane
+
+                inner = build_builtin_plane(hasher, algo, bucket, batch)
+            return FaultyPlane(self, inner)
+
+        return factory
+
+
+class FaultyPlane:
+    """Plane wrapper applying a :class:`FaultPlan` to each launch."""
+
+    def __init__(self, plan: FaultPlan, inner):
+        self.plan = plan
+        self.inner = inner
+        self.launches = 0
+        self._lock = threading.Lock()
+
+    def run(self, payloads: list[bytes]) -> list[bytes]:
+        plan = self.plan
+        with self._lock:
+            self.launches += 1
+            n = self.launches
+        if plan.latency_s:
+            time.sleep(plan.latency_s)
+        if plan.payload_prefix is not None and any(
+            p.startswith(plan.payload_prefix) for p in payloads
+        ):
+            raise PoisonedPayloadError(
+                f"injected poisoned payload (prefix {plan.payload_prefix.hex()}, "
+                f"launch {n})"
+            )
+        if (
+            n <= plan.fail_first
+            or n in plan.fail_launches
+            or (plan.dead_after is not None and n > plan.dead_after)
+        ):
+            raise DeviceFaultError(f"injected device fault (launch {n})")
+        return self.inner.run(payloads)

@@ -38,6 +38,29 @@ socket. Queue depth, batch-fill ratio, flush reasons, per-tenant served
 bytes, and shed counts are exported via ``utils/metrics.py``
 (``render_sched_metrics``); device launches are annotated in the
 profiler timeline via ``utils/trace.py``.
+
+Failure domains. A launch exception must not fail every co-batched
+ticket across all tenants, so dispatch is fault-isolated in two layers:
+
+* **Retry + bisection** (:meth:`HashPlaneScheduler._dispatch`): a
+  failed launch is retried once if the error classifies as *transient*
+  (device/XLA hiccups — retrying a *deterministic* payload error is
+  pointless and skipped), then split in half and each half relaunched,
+  recursively to ``bisect_depth``. A single poisoned ticket therefore
+  fails alone — its submitter's future gets a classified
+  :class:`SchedLaunchError` — while every innocent co-batched ticket
+  still receives its digest.
+* **Per-lane circuit breaker** (:class:`_LaneBreaker`): consecutive
+  transient failures of a lane's primary plane trip the lane to the
+  hashlib :class:`_CpuPlane` (the parity fallback the BASELINE contract
+  keeps), so the verify plane degrades to correct-but-slower instead of
+  erroring. After ``breaker_cooldown`` a half-open probe sends one
+  launch back to the primary plane; success re-closes the breaker.
+  Breaker state and transitions are exported in ``metrics_snapshot()``.
+
+Both layers are driven deterministically in tests by
+``torrent_tpu.sched.faults`` (a :class:`FaultPlan` wired through the
+``plane_factory`` seam), so every behavior above has a CPU-only test.
 """
 
 from __future__ import annotations
@@ -74,6 +97,38 @@ class SchedRejected(Exception):
         self.limit_bytes = limit_bytes
 
 
+class SchedLaunchError(Exception):
+    """A submission's pieces could not be hashed after retry/bisection.
+
+    ``kind`` classifies the root cause: ``"transient"`` (device/XLA
+    error that outlived the retry budget — the caller may retry later;
+    the bridge maps this to 503 + Retry-After) or ``"deterministic"``
+    (the payload itself makes the plane fail — retrying cannot help).
+    """
+
+    def __init__(self, message: str, kind: str, cause: Exception | None = None):
+        super().__init__(message)
+        self.kind = kind
+        self.cause = cause
+        self.__cause__ = cause
+
+
+def classify_error(e: BaseException) -> str:
+    """``'deterministic'`` (payload-caused, retry is pointless) or
+    ``'transient'`` (device-plane hiccup, worth one retry).
+
+    Fault-injection errors self-classify via ``sched_error_class``;
+    otherwise value/shape errors are deterministic and everything else
+    (XLA runtime errors, OSError, …) is assumed transient.
+    """
+    kind = getattr(e, "sched_error_class", None)
+    if kind in ("deterministic", "transient"):
+        return kind
+    if isinstance(e, (ValueError, TypeError, KeyError, IndexError, AssertionError)):
+        return "deterministic"
+    return "transient"
+
+
 @dataclass
 class SchedulerConfig:
     # pieces per device launch the assembler aims to fill (per-lane
@@ -101,6 +156,19 @@ class SchedulerConfig:
     # test/extension hook: (algo, bucket, batch) -> plane with
     # .run(payloads) -> list[digest]; None = built-in planes
     plane_factory: Callable | None = None
+    # relaunches of a failed batch before bisection, transient errors
+    # only (a deterministic payload error skips straight to bisection)
+    launch_retries: int = 1
+    # max split-and-relaunch recursion isolating a poisoned ticket: a
+    # depth of 12 isolates one piece out of a 4096-piece launch; past
+    # the bound the surviving group fails together
+    bisect_depth: int = 12
+    # consecutive transient failures of a lane's primary plane before
+    # the lane trips to the CPU (hashlib) fallback plane
+    breaker_threshold: int = 3
+    # seconds an open breaker waits before a half-open probe re-admits
+    # the primary plane
+    breaker_cooldown: float = 30.0
 
 
 class _Tenant:
@@ -163,9 +231,17 @@ class _Lane:
     __slots__ = (
         "algo", "bucket", "target", "queues", "rotation", "pending_pieces",
         "event", "task", "plane", "build_lock", "sem", "inflight",
+        "breaker", "cpu_plane",
     )
 
-    def __init__(self, algo: str, bucket: int, target: int, pipeline_depth: int):
+    def __init__(
+        self,
+        algo: str,
+        bucket: int,
+        target: int,
+        pipeline_depth: int,
+        breaker: "_LaneBreaker",
+    ):
         self.algo = algo
         self.bucket = bucket
         self.target = target
@@ -180,12 +256,111 @@ class _Lane:
         self.build_lock = threading.Lock()
         self.sem = asyncio.Semaphore(max(1, pipeline_depth))
         self.inflight: set[asyncio.Task] = set()
+        self.breaker = breaker
+        self.cpu_plane = None  # hashlib degradation plane, built lazily
 
     def oldest_ts(self) -> float:
         return min(q[0].ts for q in self.queues.values() if q)
 
 
+class _LaneBreaker:
+    """Per-lane circuit breaker over the primary (device) plane.
+
+    closed → open after ``threshold`` consecutive transient failures;
+    open → half_open after ``cooldown`` seconds; half_open admits ONE
+    probe launch — success closes the breaker, failure re-opens it.
+    Launches run in concurrent worker threads (pipeline_depth ≥ 2), so
+    every state read/transition holds the lock. Deterministic payload
+    failures are not device faults: they release a probe slot but never
+    move the state or the failure count.
+    """
+
+    __slots__ = (
+        "threshold", "cooldown", "state", "failures", "opened_at",
+        "probing", "transitions", "lock",
+    )
+
+    def __init__(self, threshold: int, cooldown: float):
+        self.threshold = max(1, threshold)
+        self.cooldown = cooldown
+        self.state = "closed"
+        self.failures = 0
+        self.opened_at = 0.0
+        self.probing = False  # one half-open probe in flight at a time
+        self.transitions: dict[str, int] = {}
+        self.lock = threading.Lock()
+
+    def _to(self, state: str) -> None:
+        key = f"{self.state}->{state}"
+        self.transitions[key] = self.transitions.get(key, 0) + 1
+        self.state = state
+
+    def acquire_primary(self) -> bool:
+        """Whether the next launch may use the primary plane (False =
+        degrade to the CPU plane for this launch)."""
+        with self.lock:
+            if self.state == "closed":
+                return True
+            if (
+                self.state == "open"
+                and time.monotonic() - self.opened_at >= self.cooldown
+            ):
+                self._to("half_open")
+                self.probing = False
+            if self.state == "half_open" and not self.probing:
+                self.probing = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self.lock:
+            self.probing = False
+            self.failures = 0
+            if self.state != "closed":
+                self._to("closed")
+
+    def record_failure(self) -> None:
+        """A transient primary-plane failure (deterministic payload
+        errors go through :meth:`release_probe` instead)."""
+        with self.lock:
+            if self.state == "half_open":
+                self.probing = False
+                self._to("open")
+                self.opened_at = time.monotonic()
+                return
+            self.failures += 1
+            if self.state == "closed" and self.failures >= self.threshold:
+                self._to("open")
+                self.opened_at = time.monotonic()
+
+    def release_probe(self) -> None:
+        with self.lock:
+            self.probing = False
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {
+                "state": self.state,
+                "consecutive_failures": self.failures,
+                "transitions": dict(self.transitions),
+            }
+
+
 # --------------------------------------------------------------- planes
+
+
+def build_builtin_plane(hasher: str, algo: str, bucket: int, batch: int):
+    """The plane the scheduler builds when no ``plane_factory`` is set.
+
+    Module-level so fault injection (``sched/faults.py``) can wrap the
+    real planes through the ``plane_factory`` seam without duplicating
+    the construction rules.
+    """
+    if hasher == "cpu":
+        return _CpuPlane(algo)
+    if algo == "sha256":
+        return _Sha256DevicePlane(bucket, batch)
+    return _Sha1DevicePlane(bucket, batch)
 
 
 class _CpuPlane:
@@ -209,7 +384,15 @@ class _Sha1DevicePlane:
     everything past each message to be zero, so each slot remembers its
     per-row content extent from the previous launch and zeroes only the
     stale tail. Slot checkout is locked: pipelined launches run in
-    concurrent worker threads."""
+    concurrent worker threads.
+
+    The jitted execution itself is serialized per plane
+    (``_device_lock``): two worker threads entering the same compiled
+    executable concurrently can deadlock inside the XLA runtime
+    (observed as an intermittent pipelined-launch hang on XLA-CPU).
+    Host staging — the copy + pad, the expensive host-side part — still
+    overlaps across pipelined launches; only the device call is single-
+    file, and the device serializes launches anyway."""
 
     def __init__(self, bucket: int, batch: int):
         from torrent_tpu.models.verifier import TPUVerifier
@@ -217,6 +400,7 @@ class _Sha1DevicePlane:
         self._verifier = TPUVerifier(piece_length=bucket, batch_size=batch)
         self._slots: list[tuple] = []  # (padded, view, ends) free list
         self._slot_lock = threading.Lock()
+        self._device_lock = threading.Lock()
 
     def _checkout(self):
         import numpy as np
@@ -258,7 +442,8 @@ class _Sha1DevicePlane:
                 # reuse's tail zeroing — recorded before sentinels clear
                 ends[:] = nblocks.astype(np.int64) * 64
                 nblocks[len(chunk) :] = 0  # sentinel rows: skip entirely
-                words = v.digest_batch(padded, nblocks)
+                with self._device_lock:
+                    words = v.digest_batch(padded, nblocks)
                 out.extend(words_to_digests(words[: len(chunk)]))
             finally:
                 with self._slot_lock:
@@ -277,6 +462,9 @@ class _Sha256DevicePlane:
         self._fn = make_sha256_fn("jax")
         self._bucket = bucket
         self._batch = batch
+        # serialize the jitted call: concurrent entry from pipelined
+        # worker threads can deadlock the XLA runtime (see sha1 plane)
+        self._device_lock = threading.Lock()
 
     def run(self, payloads: list[bytes]) -> list[bytes]:
         import jax.numpy as jnp
@@ -296,7 +484,10 @@ class _Sha256DevicePlane:
                 lengths[i] = len(p)
             nblocks = pad_in_place(padded, lengths)
             nblocks[len(chunk) :] = 0
-            words = np.asarray(self._fn(jnp.asarray(padded), jnp.asarray(nblocks)))
+            with self._device_lock:
+                words = np.asarray(
+                    self._fn(jnp.asarray(padded), jnp.asarray(nblocks))
+                )
             out.extend(words32_to_digests(words[: len(chunk)]))
         return out
 
@@ -321,6 +512,16 @@ class HashPlaneScheduler:
         self._fill_sum = 0.0
         self._flush_reasons = {"full": 0, "deadline": 0, "shutdown": 0}
         self._shed_total = 0
+        # fault-tolerance counters (satellite observability: exported
+        # via metrics_snapshot -> render_sched_metrics -> /metrics)
+        self._launch_failures = 0
+        self._retries = 0
+        self._bisections = 0
+        self._cpu_fallback_launches = 0
+        # the only fault counter touched off the event loop (worker
+        # threads, possibly in different lanes) — needs its own lock
+        self._counter_lock = threading.Lock()
+        self._failed_pieces = 0  # tickets that exhausted retry+bisection
         # rollup of evicted auto-registered tenants so served/shed totals
         # stay monotonic after their per-tenant series disappear
         self._evicted = {"tenants": 0, "served_bytes": 0, "served_pieces": 0, "shed": 0}
@@ -382,7 +583,15 @@ class HashPlaneScheduler:
         key = (algo, bucket)
         lane = self._lanes.get(key)
         if lane is None:
-            lane = _Lane(algo, bucket, self.chunk_for(bucket), self.config.pipeline_depth)
+            lane = _Lane(
+                algo,
+                bucket,
+                self.chunk_for(bucket),
+                self.config.pipeline_depth,
+                _LaneBreaker(
+                    self.config.breaker_threshold, self.config.breaker_cooldown
+                ),
+            )
             self._lanes[key] = lane
             lane.task = asyncio.ensure_future(self._lane_loop(lane))
         return lane
@@ -599,45 +808,113 @@ class HashPlaneScheduler:
         cfg = self.config
         if cfg.plane_factory is not None:
             return cfg.plane_factory(lane.algo, lane.bucket, lane.target)
-        if self.hasher == "cpu":
-            return _CpuPlane(lane.algo)
-        if lane.algo == "sha256":
-            return _Sha256DevicePlane(lane.bucket, lane.target)
-        return _Sha1DevicePlane(lane.bucket, lane.target)
+        return build_builtin_plane(self.hasher, lane.algo, lane.bucket, lane.target)
 
     def _run_plane(self, lane: _Lane, payloads: list[bytes]) -> list[bytes]:
         """Worker-thread body: build the plane on first use (JAX init and
         compiles run off the event loop) and execute the launch under a
-        trace annotation so batches are attributable in the timeline."""
+        trace annotation so batches are attributable in the timeline.
+
+        The lane breaker gates the primary plane: while it is open,
+        launches degrade to the hashlib CPU plane (correct, slower) and
+        only a half-open probe touches the primary again. Transient
+        primary failures feed the breaker; deterministic payload errors
+        do not (the device is answering — the payload is the problem).
+        """
+        if not lane.breaker.acquire_primary():
+            if lane.cpu_plane is None:  # benign to race: planes are stateless
+                lane.cpu_plane = _CpuPlane(lane.algo)
+            with self._counter_lock:  # worker threads across lanes race this
+                self._cpu_fallback_launches += 1
+            return lane.cpu_plane.run(payloads)
         if lane.plane is None:
             # pipelined launches reach here from concurrent worker
             # threads; double-checked lock so the plane compiles once
             with lane.build_lock:
                 if lane.plane is None:
-                    lane.plane = self._build_plane(lane)
-        if self.hasher == "cpu":
-            return lane.plane.run(payloads)
-        from torrent_tpu.utils.trace import maybe_profile_batch
+                    try:
+                        lane.plane = self._build_plane(lane)
+                    except Exception as e:
+                        # same classification as the launch path: a
+                        # deterministic build error (factory misconfig)
+                        # must not masquerade as device flakiness
+                        if classify_error(e) == "transient":
+                            lane.breaker.record_failure()
+                        else:
+                            lane.breaker.release_probe()
+                        raise
+        try:
+            if self.hasher == "cpu":
+                digests = lane.plane.run(payloads)
+            else:
+                from torrent_tpu.utils.trace import maybe_profile_batch
 
-        with maybe_profile_batch(f"sched_{lane.algo}_launch_b{lane.bucket}"):
-            return lane.plane.run(payloads)
+                with maybe_profile_batch(f"sched_{lane.algo}_launch_b{lane.bucket}"):
+                    digests = lane.plane.run(payloads)
+            # contract check BEFORE record_success: a plane persistently
+            # returning the wrong count must feed the breaker (and trip
+            # to the CPU plane) instead of resetting it every launch
+            if len(digests) != len(payloads):
+                raise RuntimeError(
+                    f"plane returned {len(digests)} digests for {len(payloads)} pieces"
+                )
+        except Exception as e:
+            if classify_error(e) == "transient":
+                lane.breaker.record_failure()
+            else:
+                lane.breaker.release_probe()
+            raise
+        lane.breaker.record_success()
+        return digests
 
     async def _launch(self, lane: _Lane, tickets: list[_Ticket], reason: str) -> None:
         self._launches += 1
         self._fill_sum += len(tickets) / lane.target
         self._flush_reasons[reason] += 1
+        await self._dispatch(lane, tickets, depth=0)
+
+    async def _dispatch(self, lane: _Lane, tickets: list[_Ticket], depth: int) -> None:
+        """Run one (sub-)batch with failure-domain isolation: retry a
+        transient failure once, then bisect so a poisoned ticket fails
+        alone while innocent co-batched tenants still get digests. Every
+        relaunch re-selects the plane, so a breaker that trips mid-
+        bisection routes the surviving halves through the CPU plane."""
+        cfg = self.config
         payloads = [t.payload for t in tickets]
-        try:
-            digests = await asyncio.to_thread(self._run_plane, lane, payloads)
-            if len(digests) != len(tickets):
-                raise RuntimeError(
-                    f"plane returned {len(digests)} digests for {len(tickets)} pieces"
+        attempts = 0
+        while True:
+            try:
+                # digest-count contract is checked inside _run_plane, so
+                # a persistent violation feeds the breaker there
+                digests = await asyncio.to_thread(self._run_plane, lane, payloads)
+            except Exception as e:  # a poisoned launch must not wedge the lane
+                self._launch_failures += 1
+                kind = classify_error(e)
+                log.warning(
+                    "sched launch failed (%s/%d, %d pieces, depth %d, %s): %s",
+                    lane.algo, lane.bucket, len(tickets), depth, kind, e,
                 )
-        except Exception as e:  # a poisoned launch must not wedge the lane
-            log.error("sched launch failed (%s/%d): %s", lane.algo, lane.bucket, e)
-            self._demux(tickets, None, error=e)
+                if kind == "transient" and attempts < cfg.launch_retries:
+                    attempts += 1
+                    self._retries += 1
+                    continue
+                if len(tickets) > 1 and depth < cfg.bisect_depth:
+                    self._bisections += 1
+                    mid = len(tickets) // 2
+                    await self._dispatch(lane, tickets[:mid], depth + 1)
+                    await self._dispatch(lane, tickets[mid:], depth + 1)
+                    return
+                self._failed_pieces += len(tickets)
+                err = SchedLaunchError(
+                    f"hash launch failed ({kind}, {len(tickets)} pieces, "
+                    f"{attempts} retries): {e}",
+                    kind,
+                    e,
+                )
+                self._demux(tickets, None, error=err)
+                return
+            self._demux(tickets, digests)
             return
-        self._demux(tickets, digests)
 
     def _demux(self, tickets: list[_Ticket], digests, error=None) -> None:
         """Per-launch result demux back to the awaiting submissions,
@@ -677,6 +954,15 @@ class HashPlaneScheduler:
             "mean_fill": (self._fill_sum / self._launches) if self._launches else 0.0,
             "flush_reasons": dict(self._flush_reasons),
             "shed_total": self._shed_total,
+            "launch_failures": self._launch_failures,
+            "retries": self._retries,
+            "bisections": self._bisections,
+            "cpu_fallback_launches": self._cpu_fallback_launches,
+            "failed_pieces": self._failed_pieces,
+            "breakers": {
+                f"{algo}/{bucket}": lane.breaker.snapshot()
+                for (algo, bucket), lane in self._lanes.items()
+            },
             "evicted": dict(self._evicted),
             "tenants": {
                 name: {

@@ -828,13 +828,21 @@ class Torrent:
             # background recheck from starving anyone (and vice versa:
             # low weight, never zero, so it always progresses)
             from torrent_tpu.parallel.verify import verify_pieces_sched
+            from torrent_tpu.sched import SchedRejected
 
             cfg.scheduler.register_tenant("selfheal", weight=cfg.selfheal_weight)
-            ok = await verify_pieces_sched(
-                self.storage, self.info, cfg.scheduler, tenant="selfheal"
-            )
-            self._apply_recheck(ok)
-            return
+            try:
+                # per-piece launch failures come back as unverified
+                # (False) inside verify_pieces_sched — only a whole-
+                # queue rejection (scheduler shutting down) falls
+                # through to the local verify path below
+                ok = await verify_pieces_sched(
+                    self.storage, self.info, cfg.scheduler, tenant="selfheal"
+                )
+                self._apply_recheck(ok)
+                return
+            except SchedRejected as e:
+                log.warning("scheduler recheck rejected (%s); local fallback", e)
         kwargs = {}
         if cfg.hasher == "tpu":
             kwargs = {"batch_size": cfg.verify_batch_size}

@@ -230,8 +230,12 @@ class Storage:
                 try:
                     data = self.method.get(path, foff, chunk)
                     out[row, pos : pos + len(data)] = np.frombuffer(data, dtype=np.uint8)
-                except StorageError:
-                    pass  # leave zeros; SHA1 mismatch will flag the piece
+                except (StorageError, OSError):
+                    # leave zeros; SHA1 mismatch will flag the piece.
+                    # OSError too: a file torn mid-recheck can surface a
+                    # raw errno from backends that don't wrap, and the
+                    # device paths must mark-and-continue like the CPU one
+                    pass
                 pos += chunk
         return out, lengths
 

@@ -9,6 +9,7 @@ One multiplexed entry point over the whole framework::
     torrent-tpu tracker  [--http-port P] [--udp-port P] [--interval S]
     torrent-tpu bridge   [--port P] [--hasher cpu|tpu] [--batch-target N]
                          [--flush-deadline-ms MS] [--max-queue-mb MB] [--tenant-max-mb MB]
+                         [--dev --fault-plan SPEC]
 
 ``download`` accepts either a ``.torrent`` file or a ``magnet:?...`` URI
 (BEP 9 metadata fetch). Also runnable as ``python -m torrent_tpu``.
@@ -1174,6 +1175,8 @@ def _cmd_bridge(args) -> int:
             "--max-queue-mb", str(args.max_queue_mb),
             "--tenant-max-mb", str(args.tenant_max_mb),
         ]
+        + (["--fault-plan", args.fault_plan] if args.fault_plan else [])
+        + (["--dev"] if args.dev else [])
     )
 
 
@@ -1464,6 +1467,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="global queued-bytes bound (requests shed with 429 beyond)")
     sp.add_argument("--tenant-max-mb", type=int, default=128,
                     help="per-tenant queued-bytes bound")
+    sp.add_argument("--fault-plan", default=None, metavar="SPEC",
+                    help="inject deterministic hash-plane faults "
+                    "(sched/faults.py spec; requires --dev or TORRENT_TPU_DEV=1)")
+    sp.add_argument("--dev", action="store_true",
+                    help="dev/test mode: unlocks chaos knobs like --fault-plan")
     sp.set_defaults(fn=_cmd_bridge)
 
     return p

@@ -380,6 +380,88 @@ async def _sched_smoke() -> str:
         await sched.close()
 
 
+async def _faults_smoke() -> str:
+    """Fault-tolerance smoke (``--faults``): an in-process scheduler with
+    an injected fail-then-recover plan must (a) bisect a poisoned batch
+    so only the poisoned piece fails while co-batched pieces get correct
+    digests, and (b) trip the lane breaker to the CPU plane under
+    consecutive device faults, then restore the device plane with a
+    half-open probe. Deterministic and CPU-only: the faults come from
+    sched/faults.py through the plane_factory seam."""
+    from torrent_tpu.sched import (
+        FaultPlan,
+        HashPlaneScheduler,
+        SchedLaunchError,
+        SchedulerConfig,
+    )
+
+    # (a) poisoned-payload isolation via bisection
+    poison = b"\xbd" * 64
+    plan = FaultPlan(payload_prefix=b"\xbd\xbd\xbd\xbd")
+    sched = HashPlaneScheduler(
+        SchedulerConfig(
+            batch_target=16,
+            flush_deadline=0.2,
+            plane_factory=plan.plane_factory(hasher="cpu"),
+        ),
+        hasher="cpu",
+    )
+    await sched.start()
+    try:
+        good = [bytes([i + 1]) * 64 for i in range(15)]
+        # enqueue both before awaiting (no intervening yield), so the 16
+        # pieces deterministically ride ONE coalesced poisoned launch
+        fut_ok = await sched.enqueue("ok", good)
+        fut_bad = await sched.enqueue("poisoned", [poison])
+        results = await asyncio.gather(fut_ok, fut_bad, return_exceptions=True)
+        assert results[0] == [hashlib.sha1(p).digest() for p in good], (
+            "co-batched pieces lost to a poisoned ticket"
+        )
+        assert isinstance(results[1], SchedLaunchError), results[1]
+        snap = sched.metrics_snapshot()
+        assert snap["bisections"] > 0, "poisoned batch was not bisected"
+        bisections = snap["bisections"]
+    finally:
+        await sched.close()
+
+    # (b) breaker trip -> CPU degradation -> half-open recovery: the
+    # first two plane launches fail (launch + its retry -> threshold 2
+    # trips the breaker, bisected halves ride the CPU plane), and the
+    # third — the half-open probe after the cooldown — succeeds
+    plan = FaultPlan(fail_first=2)
+    sched = HashPlaneScheduler(
+        SchedulerConfig(
+            batch_target=4,
+            flush_deadline=0.05,
+            breaker_threshold=2,
+            breaker_cooldown=300.0,
+            plane_factory=plan.plane_factory(hasher="cpu"),
+        ),
+        hasher="cpu",
+    )
+    await sched.start()
+    try:
+        pieces = [bytes([i]) * 128 for i in range(4)]
+        want = [hashlib.sha1(p).digest() for p in pieces]
+        assert await sched.submit("t", pieces) == want, "CPU degradation wrong"
+        snap = sched.metrics_snapshot()
+        lane = next(iter(snap["breakers"].values()))
+        assert lane["state"] == "open", f"breaker did not trip: {lane}"
+        assert snap["cpu_fallback_launches"] > 0
+        # expire the cooldown without sleeping (wall-clock-stall-proof):
+        # the next launch becomes the half-open probe
+        for ln in sched._lanes.values():
+            with ln.breaker.lock:
+                ln.breaker.opened_at -= 1e6
+        assert await sched.submit("t", pieces) == want
+        lane = next(iter(sched.metrics_snapshot()["breakers"].values()))
+        assert lane["state"] == "closed", f"probe did not recover: {lane}"
+        assert lane["transitions"].get("half_open->closed", 0) >= 1
+    finally:
+        await sched.close()
+    return f"bisected poisoned piece ({bisections} splits), breaker tripped+recovered"
+
+
 async def _bridge_smoke() -> None:
     from torrent_tpu.bridge.service import BridgeServer
     from torrent_tpu.codec.bencode import bdecode, bencode
@@ -430,6 +512,12 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--skip-swarm", action="store_true", help="skip the loopback swarm smoke"
+    )
+    ap.add_argument(
+        "--faults",
+        action="store_true",
+        help="also run the fault-tolerance smoke: injected fail-then-recover "
+        "plan proving bisection isolation and breaker trip/recovery",
     )
     ap.add_argument(
         "--json",
@@ -484,6 +572,12 @@ def main(argv=None) -> int:
         _report("PASS", "verify scheduler", detail)
     except Exception as e:
         _report("FAIL", "verify scheduler", repr(e))
+    if args.faults:
+        try:
+            detail = asyncio.run(asyncio.wait_for(_faults_smoke(), 30))
+            _report("PASS", "fault tolerance", detail)
+        except Exception as e:
+            _report("FAIL", "fault tolerance", repr(e))
     try:
         asyncio.run(asyncio.wait_for(_bridge_smoke(), 30))
         _report("PASS", "bridge", "/v1/digests round-trip")

@@ -48,6 +48,21 @@ def render_sched_metrics(sched) -> str:
         "# HELP torrent_tpu_sched_shed_total Submissions rejected by admission control",
         "# TYPE torrent_tpu_sched_shed_total counter",
         f"torrent_tpu_sched_shed_total {s['shed_total']}",
+        "# HELP torrent_tpu_sched_launch_failures_total Device launches that raised",
+        "# TYPE torrent_tpu_sched_launch_failures_total counter",
+        f"torrent_tpu_sched_launch_failures_total {s.get('launch_failures', 0)}",
+        "# HELP torrent_tpu_sched_retries_total Failed launches retried (transient errors)",
+        "# TYPE torrent_tpu_sched_retries_total counter",
+        f"torrent_tpu_sched_retries_total {s.get('retries', 0)}",
+        "# HELP torrent_tpu_sched_bisections_total Failed launches split to isolate a poisoned ticket",
+        "# TYPE torrent_tpu_sched_bisections_total counter",
+        f"torrent_tpu_sched_bisections_total {s.get('bisections', 0)}",
+        "# HELP torrent_tpu_sched_cpu_fallback_launches_total Launches degraded to the CPU plane by an open breaker",
+        "# TYPE torrent_tpu_sched_cpu_fallback_launches_total counter",
+        f"torrent_tpu_sched_cpu_fallback_launches_total {s.get('cpu_fallback_launches', 0)}",
+        "# HELP torrent_tpu_sched_failed_pieces_total Pieces whose hashing exhausted retry and bisection",
+        "# TYPE torrent_tpu_sched_failed_pieces_total counter",
+        f"torrent_tpu_sched_failed_pieces_total {s.get('failed_pieces', 0)}",
         "# HELP torrent_tpu_sched_evicted_tenants_total Idle auto-registered tenants evicted to bound cardinality",
         "# TYPE torrent_tpu_sched_evicted_tenants_total counter",
         f"torrent_tpu_sched_evicted_tenants_total {s.get('evicted', {}).get('tenants', 0)}",
@@ -56,6 +71,29 @@ def render_sched_metrics(sched) -> str:
     ]
     for reason, n in sorted(s["flush_reasons"].items()):
         lines.append(f'torrent_tpu_sched_flush_total{{reason="{reason}"}} {n}')
+    # breaker lifecycle per lane: state as an enum gauge (0 closed,
+    # 1 half-open, 2 open — alert on > 0) plus transition counters
+    _breaker_states = {"closed": 0, "half_open": 1, "open": 2}
+    lines.append(
+        "# HELP torrent_tpu_sched_breaker_state Lane circuit-breaker state "
+        "(0=closed device plane live, 1=half-open probing, 2=open CPU degraded)"
+    )
+    lines.append("# TYPE torrent_tpu_sched_breaker_state gauge")
+    for lane, b in sorted(s.get("breakers", {}).items()):
+        lines.append(
+            f'torrent_tpu_sched_breaker_state{{lane="{_esc(lane)}"}} '
+            f"{_breaker_states.get(b['state'], 2)}"
+        )
+    lines.append(
+        "# HELP torrent_tpu_sched_breaker_transitions_total Breaker state transitions"
+    )
+    lines.append("# TYPE torrent_tpu_sched_breaker_transitions_total counter")
+    for lane, b in sorted(s.get("breakers", {}).items()):
+        for transition, n in sorted(b.get("transitions", {}).items()):
+            lines.append(
+                "torrent_tpu_sched_breaker_transitions_total"
+                f'{{lane="{_esc(lane)}",transition="{_esc(transition)}"}} {n}'
+            )
     per_tenant = [
         ("torrent_tpu_sched_tenant_served_bytes_total", "counter",
          "Payload bytes hashed for this tenant", "served_bytes"),

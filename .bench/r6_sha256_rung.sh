@@ -1,0 +1,78 @@
+#!/bin/bash
+# Round-6 sha256 leaf-plane rung: sweep-then-bank, targeting the v2
+# >=20x north star (>=19 GiB/s; r5 banked 12.49x / 11.9 GiB/s on the
+# scan-era plane). Two strictly serialized legs:
+#
+#   1. tools/tune_sha256 sweeps the full tile_sub x unroll x
+#      (full_unroll, interleave2) variant matrix ON DEVICE (golden-
+#      checked there; the straight-line and interleaved bodies have no
+#      off-chip validation) and emits the winner as ready-to-export env
+#      knobs ("env" in the best line).
+#   2. bench.py BENCH_CONFIG=v2 runs the proven r5 micro shape under
+#      the median-of-3 contract with the winning knobs exported, plus
+#      TORRENT_TPU_SHA256_BACKEND=pallas so the scheduler's v2 lanes
+#      take the same fast path the record claims.
+#
+# Ladder rules apply: never kill a TPU-touching process, never
+# overwrite a banked non-null record (the rung skips once banked).
+cd /root/repo
+CACHE=/root/repo/.bench/cpu_baseline.json
+SWEEP=/root/repo/.bench/r6_sha256_sweep.jsonl
+OUT=/root/repo/.bench/r6_v2_pallas.json
+
+banked() {
+  [ -s "$1" ] && python - "$1" <<'PY'
+import json, sys
+try:
+    rec = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if rec.get("value") is not None else 1)
+PY
+}
+
+{
+echo "=== r6 sha256 rung start $(date -u)"
+if banked "$OUT"; then
+  echo "skip $OUT (already banked)"
+  exit 0
+fi
+
+# leg 1: the on-device knob sweep (12h park on the relay; the sweep is
+# its own sentinel — it runs the moment a grant arrives)
+if [ ! -s "$SWEEP" ] || ! grep -q '"best"' "$SWEEP"; then
+  python -m torrent_tpu.tools.tune_sha256 \
+      --block-kb 16 --batch 32768 \
+      --grid 8x16,16x16,32x8,32x16,32x32 --iters 8 \
+      > "$SWEEP.tmp" 2> "${SWEEP%.jsonl}.err" && mv "$SWEEP.tmp" "$SWEEP"
+fi
+
+# winner -> env (falls back to defaults if the sweep produced no best)
+WINNER_ENV=$(python - "$SWEEP" <<'PY'
+import json, sys
+env = {}
+try:
+    for line in open(sys.argv[1]):
+        rec = json.loads(line)
+        if "best" in rec:
+            env = rec.get("env", {})
+except Exception:
+    pass
+print(" ".join(f"{k}={v}" for k, v in env.items()))
+PY
+)
+echo "sweep winner env: ${WINNER_ENV:-<none, defaults>}"
+
+# leg 2: the banked rung (r5's proven micro shape, median-of-3)
+env BENCH_NO_REPLAY=1 BENCH_BASELINE_CACHE="$CACHE" BENCH_TPU_WAIT=43200 \
+    TORRENT_TPU_SHA256_BACKEND=pallas $WINNER_ENV \
+    BENCH_CONFIG=v2 BENCH_TOTAL_MB=256 BENCH_V2_NRES=3 \
+    BENCH_E2E_MB=16 BENCH_H2D_MB=8 \
+    python bench.py > "$OUT.tmp" 2> "${OUT%.json}.err" \
+  && mv "$OUT.tmp" "$OUT" \
+  || echo "bench attempt failed rc=$? — keeping previous $OUT"
+# newest SUCCESSFUL attempt wins while the record is un-banked (a failed
+# run must not clobber the last well-formed record); a banked non-null
+# record is protected by the check above
+[ -s "$OUT" ] && echo "$OUT attempt done $(date -u): $(cat "$OUT")"
+} 2>&1 | tee -a /root/repo/.bench/r6_sha256_rung.log

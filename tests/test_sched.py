@@ -498,6 +498,331 @@ class TestFairnessAndBackpressure:
         run(go())
 
 
+class TestSha256PallasLane:
+    """The v2 fast path: scheduler sha256 lanes on the pallas plane
+    (interpret mode on CPU — same dispatch path, deterministic)."""
+
+    def test_pallas_lane_parity_and_sentinel_rows(self):
+        """A partial-fill launch pads to the 1024-row sub-tile granule
+        with nblocks=0 sentinels; ragged live rows (incl. an empty
+        piece) hash bit-identically to hashlib, and the pad waste is
+        observable per lane."""
+
+        async def go():
+            from torrent_tpu.utils.metrics import render_sched_metrics
+
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=1024, flush_deadline=0.05, sha256_backend="pallas"
+                ),
+                hasher="tpu",
+            )
+            try:
+                pieces = [b"", b"x" * 200, b"y" * 64, b"z" * 256, b"w" * 129]
+                got = await sched.submit(
+                    "t", pieces, algo="sha256", piece_length=256
+                )
+                assert got == [hashlib.sha256(p).digest() for p in pieces]
+                snap = sched.metrics_snapshot()
+                assert snap["launch_failures"] == 0
+                assert snap["cpu_fallback_launches"] == 0, "fell back off pallas"
+                lane = snap["lane_stats"]["sha256/256"]
+                assert lane["backend"] == "pallas"
+                assert lane["pad_rows_total"] == 1024 - len(pieces)
+                # staging-slot reuse across launches: a second, shorter
+                # ragged batch must not see the first launch's stale bytes
+                short = [b"a", b"bb" * 100, b"", b"c" * 256]
+                got = await sched.submit(
+                    "t", short, algo="sha256", piece_length=256
+                )
+                assert got == [hashlib.sha256(p).digest() for p in short]
+                text = render_sched_metrics(sched)
+                assert 'torrent_tpu_sched_launch_pad_rows_total{lane="sha256/256"}' in text
+                assert 'torrent_tpu_sched_lane_fill_ratio{lane="sha256/256"}' in text
+                assert 'backend="pallas"' in text
+            finally:
+                await sched.close()
+
+        run(go())
+
+    def test_flush_target_snaps_to_tile_and_full_launch_wastes_zero(self):
+        """ISSUE acceptance: the sha256 lane flush target snaps to a
+        tile multiple (batch_target 300 → 1024) and a full-target launch
+        stages zero pad rows."""
+
+        async def go():
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=300, flush_deadline=0.5, sha256_backend="pallas"
+                ),
+                hasher="tpu",
+            )
+            try:
+                assert sched.chunk_for(64, "sha256") == 1024
+                assert sched.chunk_for(64) == 300  # sha1 lanes unchanged
+                pieces = [bytes([i % 251]) * 64 for i in range(1024)]
+                got = await sched.submit(
+                    "t", pieces, algo="sha256", piece_length=64
+                )
+                assert got == [hashlib.sha256(p).digest() for p in pieces]
+                snap = sched.metrics_snapshot()
+                lane = snap["lane_stats"]["sha256/64"]
+                assert lane["target"] == 1024
+                assert lane["launches"] == 1
+                assert lane["mean_fill"] == 1.0
+                assert lane["pad_rows_total"] == 0, lane
+                assert snap["flush_reasons"]["full"] == 1
+            finally:
+                await sched.close()
+
+            # a budget-clamped target whose only legal tiling is the
+            # slow tile_sub=8 (5120 rows) rounds down to a full
+            # configured-tile multiple (4096 @ tile_sub 32) instead
+            from torrent_tpu.ops.padding import padded_len_for
+
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=8192,
+                    staging_budget=5500 * padded_len_for(64),
+                    sha256_backend="pallas",
+                ),
+                hasher="tpu",
+            )
+            assert sched._lane_plan("sha256", 64) == ("pallas", 4096)
+            await sched.close()
+
+            # but a configured target that tiles legally at 24 sublanes
+            # stands — no silent shrink over a mild tiling preference
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=6144, sha256_backend="pallas"),
+                hasher="tpu",
+            )
+            assert sched._lane_plan("sha256", 64) == ("pallas", 6144)
+            await sched.close()
+
+        run(go())
+
+    def test_scan_fallback_selection(self):
+        """Backend selection end to end: explicit scan pins the lax.scan
+        plane, a bucket whose tile floor blows the staging budget falls
+        back to scan even under pallas, and a cpu-hasher scheduler never
+        consults the device backends at all."""
+        from torrent_tpu.sched.scheduler import (
+            _Sha256DevicePlane,
+            _Sha256PallasPlane,
+            build_builtin_plane,
+        )
+
+        plane = build_builtin_plane("tpu", "sha256", 256, 64, sha256_backend="scan")
+        assert isinstance(plane, _Sha256DevicePlane)
+        plane = build_builtin_plane("tpu", "sha256", 256, 64, sha256_backend="pallas")
+        assert isinstance(plane, _Sha256PallasPlane)
+
+        async def go():
+            # explicit scan: parity through the scheduler
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=8, flush_deadline=0.05, sha256_backend="scan"
+                ),
+                hasher="tpu",
+            )
+            try:
+                pieces = _pieces(5, 200)
+                got = await sched.submit("t", pieces, algo="sha256")
+                assert got == [hashlib.sha256(p).digest() for p in pieces]
+                lane = sched.metrics_snapshot()["lane_stats"]["sha256/256"]
+                assert lane["backend"] == "scan"
+                assert lane["pad_rows_total"] == 0  # scan launches are row-exact
+            finally:
+                await sched.close()
+
+            # staging budget fallback: a 1 MiB bucket's 1024-row tile
+            # floor exceeds a 64 MiB budget → scan, target un-snapped
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=2048,
+                    staging_budget=64 << 20,
+                    sha256_backend="pallas",
+                ),
+                hasher="tpu",
+            )
+            backend, target = sched._lane_plan("sha256", 1 << 20)
+            assert backend == "scan"
+            assert target == (64 << 20) // 1048704  # afford, not snapped
+            await sched.close()
+
+        run(go())
+
+        with pytest.raises(ValueError, match="auto|pallas|scan"):
+            from torrent_tpu.sched import resolve_sha256_backend
+
+            resolve_sha256_backend("mosaic")
+
+    def test_plane_factory_honors_budget_scan_fallback(self):
+        """A FaultPlan factory carrying an explicit 'pallas' pin (bridge
+        --fault-plan + --sha256-backend pallas) must not override the
+        lane's budget-forced scan fallback: _build_plane passes the
+        lane's resolved backend through the factory seam, so the pinned
+        kernel's ≥1024-row tile floor can't allocate staging far beyond
+        the configured budget."""
+        from torrent_tpu.sched.faults import FaultPlan, FaultyPlane
+        from torrent_tpu.sched.scheduler import (
+            _Sha256DevicePlane,
+            _Sha256PallasPlane,
+        )
+
+        async def go():
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=2048,
+                    staging_budget=64 << 20,  # < the 1 MiB bucket's tile floor
+                    sha256_backend="pallas",
+                    plane_factory=FaultPlan().plane_factory(  # no-op: wiring only
+                        hasher="tpu", sha256_backend="pallas"
+                    ),
+                ),
+                hasher="tpu",
+            )
+            try:
+                lane = sched._lane("sha256", 1 << 20)
+                assert lane.backend == "scan"
+                plane = sched._build_plane(lane)
+                assert isinstance(plane, FaultyPlane)
+                assert isinstance(plane.inner, _Sha256DevicePlane), type(plane.inner)
+                # where the budget affords the tile floor, the pin stands
+                lane = sched._lane("sha256", 256)
+                assert lane.backend == "pallas"
+                plane = sched._build_plane(lane)
+                assert isinstance(plane.inner, _Sha256PallasPlane), type(plane.inner)
+            finally:
+                await sched.close()
+
+        run(go())
+
+    def test_interleave2_suppressed_on_sub_tile_launches(self, monkeypatch):
+        """The interleave2 knob needs >=16 sublanes with whole-vreg
+        halves; a 1024-row sub-tile launch silently runs the straight
+        kernel (and still matches hashlib) instead of erroring."""
+        from torrent_tpu.ops import sha256_pallas as sp
+        from torrent_tpu.sched.scheduler import _Sha256PallasPlane
+
+        monkeypatch.setattr(sp, "INTERLEAVE2", True)
+        plane = _Sha256PallasPlane(256, 2048)
+        assert plane._plan(5) == (1024, 8, False)  # il2 off: ts < 16
+        assert plane._plan(2048) == (2048, 16, True)  # il2 composes at ts 16
+        got = plane.run([b"q" * 200, b"r" * 64])
+        assert got == [hashlib.sha256(b"q" * 200).digest(),
+                       hashlib.sha256(b"r" * 64).digest()]
+
+    def test_padded_admission_charges_staging_footprint(self):
+        """Admission accounting charges the padded staging row, not raw
+        payload bytes: tiny pieces in a big bucket pin full rows, so the
+        queue bound reflects what launches actually stage."""
+        from torrent_tpu.ops.padding import padded_len_for
+
+        async def go():
+            row = padded_len_for(4096)  # 4224
+            stall = _StallPlane()
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=64,
+                    flush_deadline=0.02,
+                    max_queue_bytes=4 * row,
+                    plane_factory=lambda a, b, t: stall,
+                ),
+                hasher="tpu",
+            )
+            try:
+                # 4 ten-byte pieces: 40 raw bytes, but 4 staging rows —
+                # exactly the budget
+                futs = [
+                    await sched.enqueue("t", [b"0123456789"], piece_length=4096)
+                    for _ in range(4)
+                ]
+                assert sched.metrics_snapshot()["queue_bytes"] == 4 * row
+                with pytest.raises(SchedRejected) as ei:
+                    await sched.enqueue("t", [b"x"], piece_length=4096)
+                assert ei.value.queued_bytes == 4 * row
+                stall.release.set()
+                for fut in futs:
+                    await asyncio.wait_for(fut, 10)
+                # release returns the charged (padded) bytes, not raw
+                assert sched.metrics_snapshot()["queue_bytes"] == 0
+            finally:
+                stall.release.set()
+                await sched.close()
+
+        run(go())
+
+    def test_breaker_and_fault_plan_through_pallas_plane(self):
+        """Fault-plan / breaker compatibility through the plane_factory
+        seam: a FaultPlan wrapping the pallas plane still trips the lane
+        to the CPU plane (digests stay correct) and recovers via the
+        half-open probe back onto pallas; FaultyPlane delegates the
+        launch_geometry hook to the wrapped plane."""
+        from torrent_tpu.ops.padding import padded_len_for
+        from torrent_tpu.sched import FaultPlan
+
+        plan = FaultPlan(fail_first=2)
+        factory = plan.plane_factory(hasher="tpu", sha256_backend="pallas")
+        wrapped = factory("sha256", 256, 1024)
+        assert wrapped.launch_geometry(5, 256) == (1024, 1024 * padded_len_for(256))
+
+        async def go():
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=1024,
+                    flush_deadline=0.05,
+                    breaker_threshold=2,
+                    breaker_cooldown=300.0,
+                    sha256_backend="pallas",
+                    plane_factory=plan.plane_factory(
+                        hasher="tpu", sha256_backend="pallas"
+                    ),
+                ),
+                hasher="tpu",
+            )
+            try:
+                pieces = [bytes([i + 1]) * 64 for i in range(4)]
+                want = [hashlib.sha256(p).digest() for p in pieces]
+                got = await sched.submit("t", pieces, algo="sha256", piece_length=256)
+                assert got == want, "CPU degradation digests wrong"
+                snap = sched.metrics_snapshot()
+                lane = next(iter(snap["breakers"].values()))
+                assert lane["state"] == "open", lane
+                assert snap["cpu_fallback_launches"] > 0
+                # degraded launches run on hashlib, which stages nothing:
+                # the tile-padding waste counter must not grow while open
+                pads_open = snap["lane_stats"]["sha256/256"]["pad_rows_total"]
+                got = await sched.submit("t", pieces, algo="sha256", piece_length=256)
+                assert got == want
+                stats = sched.metrics_snapshot()["lane_stats"]["sha256/256"]
+                assert stats["pad_rows_total"] == pads_open, stats
+                # rewind the cooldown: next launch is the half-open probe
+                # through the real pallas plane, which re-closes the lane
+                for ln in sched._lanes.values():
+                    with ln.breaker.lock:
+                        ln.breaker.opened_at -= 1e6
+                got = await sched.submit("t", pieces, algo="sha256", piece_length=256)
+                assert got == want
+                lane = next(iter(sched.metrics_snapshot()["breakers"].values()))
+                assert lane["state"] == "closed", lane
+            finally:
+                await sched.close()
+
+        run(go())
+
+
+class TestDoctorV2:
+    def test_doctor_v2_smoke(self):
+        """doctor --v2: leaf + merkle-pair digests vs hashlib through
+        the scheduler's pallas lane, interpret-safe on CPU."""
+        from torrent_tpu.tools import doctor
+
+        detail = run(doctor._v2_smoke())
+        assert "parity ok" in detail
+
+
 # ----------------------------------------------------------- sessions
 
 

@@ -125,6 +125,49 @@ class TestSha256Kernels:
                 padded, nblocks, interpret=True, tile_sub=8, interleave2=True
             )
 
+    def test_sub_tile_row_bucketing_helpers(self):
+        """Row-bucketed sub-tile launches: a live batch pads to the
+        nearest 8-sublane granule (1024 rows), and the tile_sub pick is
+        the largest legal sublane count that tiles the bucketed rows."""
+        from torrent_tpu.ops.sha256_pallas import (
+            SUB_TILE_ROWS,
+            pad_rows_for,
+            tile_sub_for_rows,
+        )
+
+        assert SUB_TILE_ROWS == 1024
+        assert pad_rows_for(0) == 1024
+        assert pad_rows_for(1) == 1024
+        assert pad_rows_for(1024) == 1024
+        assert pad_rows_for(1025) == 2048
+        assert pad_rows_for(5000) == 5120
+        assert tile_sub_for_rows(1024, cap=32) == 8
+        assert tile_sub_for_rows(2048, cap=32) == 16
+        assert tile_sub_for_rows(3072, cap=32) == 24
+        assert tile_sub_for_rows(4096, cap=32) == 32
+        assert tile_sub_for_rows(4096, cap=16) == 16
+        assert tile_sub_for_rows(5120, cap=32) == 8  # 40 sublanes: only 8 divides
+        with pytest.raises(ValueError, match="multiple"):
+            tile_sub_for_rows(1000)
+
+    def test_sub_tile_launch_parity(self):
+        """A 24-sublane bucketed launch (the odd tiling partial flushes
+        land on) is bit-identical to hashlib."""
+        from torrent_tpu.ops.sha256_pallas import sha256_pieces_pallas
+
+        rng = np.random.default_rng(31)
+        msgs = [
+            rng.integers(0, 256, size=int(n), dtype=np.uint8).tobytes()
+            for n in rng.integers(0, 200, size=40)
+        ]
+        padded, nblocks = pad_pieces(msgs)
+        words = np.asarray(
+            sha256_pieces_pallas(padded, nblocks, interpret=True, tile_sub=24)
+        )
+        for i, m in enumerate(msgs):
+            got = b"".join(int(w).to_bytes(4, "big") for w in words[i])
+            assert got == hashlib.sha256(m).digest(), f"msg {i}"
+
     def test_pairs_matches_hashlib(self):
         rng = np.random.default_rng(3)
         kids = [rng.bytes(32) for _ in range(64)]

@@ -40,7 +40,11 @@ Bodies may be Content-Length or chunked transfer-encoding (what a Deno
 
 An ``X-Hash-Algo: sha256`` header switches the stream routes to the v2
 hash plane (BEP 52 leaf/merkle hashing feeds on 32-byte digests); the
-default is sha1. Digest/expected width follows the algorithm.
+default is sha1. Digest/expected width follows the algorithm. The v2
+lanes run the pallas kernel by default (``--sha256-backend`` /
+``TORRENT_TPU_SHA256_BACKEND`` select pallas/scan/auto), and stream
+chunking follows the lane's tile-snapped flush target so submissions
+arrive launch-shaped.
 
 Failure mapping (scheduler fault-tolerance layer, ``sched/scheduler``):
 admission shed stays **429**; a launch failure that outlives retry +
@@ -173,6 +177,7 @@ class BridgeServer:
         max_queue_mb: int = 256,
         tenant_max_mb: int = 128,
         fault_plan: FaultPlan | str | None = None,
+        sha256_backend: str | None = None,
     ):
         self.host = host
         self.port = port
@@ -189,8 +194,11 @@ class BridgeServer:
             max_queue_bytes=max_queue_mb << 20,
             max_tenant_bytes=tenant_max_mb << 20,
             plane_factory=(
-                fault_plan.plane_factory(hasher=hasher) if fault_plan else None
+                fault_plan.plane_factory(hasher=hasher, sha256_backend=sha256_backend)
+                if fault_plan
+                else None
             ),
+            sha256_backend=sha256_backend,
         )
 
     async def start(self) -> "BridgeServer":
@@ -280,7 +288,9 @@ class BridgeServer:
         self, writer, mode: str, plen: int, body: _BodyReader, algo: str, tenant: str
     ):
         dlen = 32 if algo == "sha256" else 20
-        chunk = self.sched.chunk_for(plen)
+        # plane-aware chunking: pallas sha256 lanes have tile-snapped
+        # flush targets, so stream submissions arrive launch-shaped
+        chunk = self.sched.chunk_for(plen, algo)
         futs: list[tuple[asyncio.Future, int]] = []
         batch: list[bytes] = []
         batch_exp: list[bytes] = []
@@ -401,6 +411,13 @@ class BridgeServer:
                     b"backend": self.hasher.encode(),
                     b"devices": len(jax.devices()),
                     b"batch": self.sched.config.batch_target,
+                    # memoized on the scheduler (start() resolved it
+                    # off-loop; 'auto' probes jax.devices())
+                    b"sha256_backend": (
+                        b"cpu"
+                        if self.hasher == "cpu"
+                        else self.sched.sha256_backend().encode()
+                    ),
                     b"version": b"torrent-tpu/0.1",
                 }
             )
@@ -522,6 +539,12 @@ def main(argv=None):  # pragma: no cover - manual entrypoint
         help="per-tenant admission bound on queued piece bytes",
     )
     parser.add_argument(
+        "--sha256-backend", choices=("auto", "pallas", "scan"), default=None,
+        help="v2 (sha256) device plane: hand-tiled pallas kernel, lax.scan "
+        "fallback, or auto (pallas on TPU-kind devices). Defaults to the "
+        "TORRENT_TPU_SHA256_BACKEND env, then auto",
+    )
+    parser.add_argument(
         "--fault-plan", default=None, metavar="SPEC",
         help="inject deterministic hash-plane faults (sched/faults.py spec, "
         "e.g. 'fail_first=3;latency_ms=5'); dev/test mode only",
@@ -562,6 +585,7 @@ def main(argv=None):  # pragma: no cover - manual entrypoint
             max_queue_mb=args.max_queue_mb,
             tenant_max_mb=args.tenant_max_mb,
             fault_plan=fault_plan,
+            sha256_backend=args.sha256_backend,
         )
         print(f"bridge listening on {args.host}:{server.port}")
         await server.wait_closed()

@@ -74,8 +74,11 @@ class TPUVerifier:
             # local piece sub-batch (embarrassingly parallel, no collectives).
             # Per-device sub-batches must be tile-aligned or every
             # launch pads with wasted sentinel rows.
-            from jax import shard_map
             from jax.sharding import PartitionSpec as P
+
+            from torrent_tpu.parallel.mesh import compat_shard_map
+
+            shard_map, _sm_kw = compat_shard_map()
 
             from torrent_tpu.ops.sha1_pallas import TILE_SUB, sha1_pieces_pallas
 
@@ -104,7 +107,7 @@ class TPUVerifier:
                     mesh=self.mesh,
                     in_specs=(spec, spec),
                     out_specs=spec,
-                    check_vma=False,
+                    **_sm_kw,
                 )
             self.batch_size = round_up_to_multiple(self.batch_size, tile * self.mesh.size)
         shard = batch_sharding(self.mesh)

@@ -49,6 +49,13 @@ from jax.experimental.pallas import tpu as pltpu
 from torrent_tpu.ops.sha1_jax import _IV, _K, _bswap32, _rotl
 from torrent_tpu.utils.env import env_bool, env_int
 
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams around 0.5;
+# resolve whichever this jax ships so the kernels (and their interpret-
+# mode tests) run on both sides of the rename.
+_COMPILER_PARAMS_CLS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 TILE_LANE = 128
 # Default pieces-per-program sublane rows; see the sweep table above.
 TILE_SUB = env_int("TORRENT_TPU_SHA1_TILE_SUB", 32)
@@ -255,7 +262,7 @@ def _sha1_pallas_aligned(data, nblocks, interpret, tile_sub, unroll, interleave2
             (1, 5, tile_sub, TILE_LANE), lambda i, k: (i, 0, 0, 0), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((1, 5, tile_sub, TILE_LANE), jnp.uint32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS_CLS(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -29,6 +29,7 @@ from torrent_tpu.ops.sha1_pallas import (
     TILE_LANE,
     TILE_SUB as _SHA1_TILE_SUB,
     UNROLL as _SHA1_UNROLL,
+    _COMPILER_PARAMS_CLS,
     _check_tiling,
     _swizzle_tile,
 )
@@ -63,6 +64,42 @@ if INTERLEAVE2 and (TILE_SUB < 16 or (TILE_SUB // 2) % 8):
         "TORRENT_TPU_SHA256_INTERLEAVE2 needs TILE_SUB >= 16 with "
         f"8-sublane halves, got {TILE_SUB}"
     )
+
+# Sub-tile launch granule: the smallest legal tile is 8 sublanes × 128
+# lanes, so any launch stages a multiple of 1024 rows. Row-bucketed
+# padding (below) rounds a live batch up to this granule instead of the
+# configured TILE_SUB tile (default 32 → 4096 rows) — a 300-row partial
+# flush pads to 1024 sentinel rows, not 4096.
+SUB_TILE_ROWS = 8 * TILE_LANE
+
+
+def pad_rows_for(n_rows: int) -> int:
+    """Rows a pallas launch of ``n_rows`` live pieces actually stages:
+    the nearest ``SUB_TILE_ROWS`` multiple at or above the batch (the
+    sentinel rows carry ``nblocks=0`` and their chains never run)."""
+    if n_rows <= 0:
+        return SUB_TILE_ROWS
+    return -(-n_rows // SUB_TILE_ROWS) * SUB_TILE_ROWS
+
+
+def tile_sub_for_rows(padded_rows: int, cap: int | None = None) -> int:
+    """Largest legal ``tile_sub`` that tiles ``padded_rows`` exactly.
+
+    ``padded_rows`` must be a ``SUB_TILE_ROWS`` multiple (see
+    :func:`pad_rows_for`). The cap defaults to the env-tuned TILE_SUB:
+    full-target launches keep the sweep's fastest tiling, sub-tile
+    launches drop to whatever multiple-of-8 sublane count divides the
+    bucketed row count (8 for 1024 rows, 16 for 2048, 24 for 3072, …).
+    """
+    cap = TILE_SUB if cap is None else cap
+    subs = padded_rows // TILE_LANE
+    if padded_rows % SUB_TILE_ROWS:
+        raise ValueError(f"padded_rows={padded_rows} is not a {SUB_TILE_ROWS} multiple")
+    best = 8
+    for cand in range(8, min(cap, 64) + 1, 8):
+        if subs % cand == 0:
+            best = cand
+    return best
 
 
 def _one_block256(state, w, kc_ref):
@@ -261,7 +298,7 @@ def _sha256_pallas_aligned(
             (1, 8, tile_sub, TILE_LANE), lambda i, k: (i, 0, 0, 0), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((1, 8, tile_sub, TILE_LANE), jnp.uint32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS_CLS(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

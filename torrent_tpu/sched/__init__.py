@@ -19,6 +19,7 @@ from torrent_tpu.sched.scheduler import (
     SchedRejected,
     SchedulerConfig,
     classify_error,
+    resolve_sha256_backend,
 )
 
 __all__ = [
@@ -30,4 +31,5 @@ __all__ = [
     "SchedRejected",
     "SchedulerConfig",
     "classify_error",
+    "resolve_sha256_backend",
 ]

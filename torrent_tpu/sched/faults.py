@@ -120,18 +120,41 @@ class FaultPlan:
             raise ValueError("fault-plan payload prefix must be non-empty")
         return plan
 
-    def plane_factory(self, hasher: str = "tpu", base_factory=None):
+    def plane_factory(
+        self, hasher: str = "tpu", base_factory=None, sha256_backend: str | None = None
+    ):
         """A ``SchedulerConfig.plane_factory`` injecting this plan
         around the planes the scheduler would otherwise build (or
-        around ``base_factory``'s planes when given)."""
+        around ``base_factory``'s planes when given). ``sha256_backend``
+        pins the v2 plane ('pallas'/'scan') the same way the scheduler's
+        own builder does — but the lane's resolved backend, when the
+        scheduler passes one at build time, wins over the pin: the lane
+        plan folds in the staging-budget scan fallback, and a pinned
+        'pallas' must not resurrect a tile floor the budget can't hold."""
 
-        def factory(algo: str, bucket: int, batch: int):
+        pin = sha256_backend
+
+        def factory(
+            algo: str, bucket: int, batch: int, sha256_backend: str | None = None
+        ):
+            backend = sha256_backend if sha256_backend is not None else pin
+            from torrent_tpu.sched.scheduler import (
+                accepts_sha256_backend,
+                build_builtin_plane,
+            )
+
             if base_factory is not None:
-                inner = base_factory(algo, bucket, batch)
+                # forward the resolved backend when the base factory can
+                # take it — a nested builder pinning 'pallas' on its own
+                # would bypass the budget fallback just like we would
+                if accepts_sha256_backend(base_factory):
+                    inner = base_factory(algo, bucket, batch, sha256_backend=backend)
+                else:
+                    inner = base_factory(algo, bucket, batch)
             else:
-                from torrent_tpu.sched.scheduler import build_builtin_plane
-
-                inner = build_builtin_plane(hasher, algo, bucket, batch)
+                inner = build_builtin_plane(
+                    hasher, algo, bucket, batch, sha256_backend=backend
+                )
             return FaultyPlane(self, inner)
 
         return factory
@@ -145,6 +168,14 @@ class FaultyPlane:
         self.inner = inner
         self.launches = 0
         self._lock = threading.Lock()
+
+    def launch_geometry(self, n_rows: int, bucket: int) -> tuple[int, int]:
+        """Faults change nothing about staging: delegate to the wrapped
+        plane's geometry (row-exact if it exposes none)."""
+        hook = getattr(self.inner, "launch_geometry", None)
+        if hook is None:
+            return n_rows, 0
+        return hook(n_rows, bucket)
 
     def run(self, payloads: list[bytes]) -> list[bytes]:
         plan = self.plan

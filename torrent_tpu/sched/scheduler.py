@@ -61,6 +61,15 @@ ticket across all tenants, so dispatch is fault-isolated in two layers:
 Both layers are driven deterministically in tests by
 ``torrent_tpu.sched.faults`` (a :class:`FaultPlan` wired through the
 ``plane_factory`` seam), so every behavior above has a CPU-only test.
+
+The v2 (sha256) lanes default to the hand-tiled pallas kernel
+(:class:`_Sha256PallasPlane`; ``TORRENT_TPU_SHA256_BACKEND`` /
+``SchedulerConfig.sha256_backend`` select, lax.scan is the fallback).
+Lane batching is plane-aware: pallas lane flush targets snap to tile
+multiples (full launches waste zero pad rows), sub-tile partial flushes
+round up to the 1024-row granule with ``nblocks=0`` sentinels, and
+admission control charges the padded staging footprint per queued piece
+rather than raw payload bytes. See ARCHITECTURE.md "The v2 hash plane".
 """
 
 from __future__ import annotations
@@ -169,6 +178,38 @@ class SchedulerConfig:
     # seconds an open breaker waits before a half-open probe re-admits
     # the primary plane
     breaker_cooldown: float = 30.0
+    # sha256 device backend: 'pallas' | 'scan' | 'auto' (None = the
+    # TORRENT_TPU_SHA256_BACKEND env knob, defaulting to auto: pallas on
+    # TPU-kind devices, scan elsewhere). A lane whose tile floor would
+    # blow the staging budget falls back to scan regardless.
+    sha256_backend: str | None = None
+
+
+def resolve_sha256_backend(override: str | None = None) -> str:
+    """``'pallas'`` or ``'scan'`` for the sha256 device plane.
+
+    Precedence: explicit ``override`` (SchedulerConfig / bridge CLI) >
+    ``TORRENT_TPU_SHA256_BACKEND`` env > ``auto``. Auto picks pallas on
+    TPU-kind devices and scan everywhere else — choosing pallas
+    explicitly on a CPU host runs the kernel in interpret mode (the
+    deterministic parity path tests and ``doctor --v2`` use).
+    """
+    import os
+
+    choice = (override or os.environ.get("TORRENT_TPU_SHA256_BACKEND") or "auto")
+    choice = choice.strip().lower()
+    if choice not in ("auto", "pallas", "scan"):
+        raise ValueError(
+            f"sha256 backend must be auto|pallas|scan, got {choice!r}"
+        )
+    if choice != "auto":
+        return choice
+    try:
+        from torrent_tpu.ops.sha1_pallas import _auto_interpret
+
+        return "scan" if _auto_interpret() else "pallas"
+    except ImportError:  # pragma: no cover - jax without pallas
+        return "scan"
 
 
 class _Tenant:
@@ -211,17 +252,25 @@ class _Submission:
 
 
 class _Ticket:
-    """One piece in the queue: (submission, index, payload, expected)."""
+    """One piece in the queue: (submission, index, payload, expected).
 
-    __slots__ = ("sub", "idx", "payload", "expected", "tenant", "nbytes", "ts")
+    ``nbytes`` is the true payload size (DRR fairness, served-bytes
+    accounting); ``charged`` is what admission control holds for this
+    row — the padded staging footprint on device lanes, so the queue
+    bound tracks what the launch actually stages, not the raw bytes.
+    """
 
-    def __init__(self, sub, idx, payload, expected, tenant, ts):
+    __slots__ = ("sub", "idx", "payload", "expected", "tenant", "nbytes",
+                 "charged", "ts")
+
+    def __init__(self, sub, idx, payload, expected, tenant, ts, charged=None):
         self.sub = sub
         self.idx = idx
         self.payload = payload
         self.expected = expected
         self.tenant = tenant
         self.nbytes = len(payload)
+        self.charged = self.nbytes if charged is None else charged
         self.ts = ts
 
 
@@ -231,7 +280,8 @@ class _Lane:
     __slots__ = (
         "algo", "bucket", "target", "queues", "rotation", "pending_pieces",
         "event", "task", "plane", "build_lock", "sem", "inflight",
-        "breaker", "cpu_plane",
+        "breaker", "cpu_plane", "backend",
+        "launches", "fill_sum", "pad_rows_total",
     )
 
     def __init__(
@@ -241,6 +291,7 @@ class _Lane:
         target: int,
         pipeline_depth: int,
         breaker: "_LaneBreaker",
+        backend: str = "device",
     ):
         self.algo = algo
         self.bucket = bucket
@@ -258,6 +309,11 @@ class _Lane:
         self.inflight: set[asyncio.Task] = set()
         self.breaker = breaker
         self.cpu_plane = None  # hashlib degradation plane, built lazily
+        self.backend = backend  # 'cpu' | 'device' | 'scan' | 'pallas'
+        # per-lane observability: launch-fill and pad-row waste gauges
+        self.launches = 0
+        self.fill_sum = 0.0
+        self.pad_rows_total = 0
 
     def oldest_ts(self) -> float:
         return min(q[0].ts for q in self.queues.values() if q)
@@ -349,18 +405,110 @@ class _LaneBreaker:
 # --------------------------------------------------------------- planes
 
 
-def build_builtin_plane(hasher: str, algo: str, bucket: int, batch: int):
+def build_builtin_plane(
+    hasher: str, algo: str, bucket: int, batch: int, sha256_backend: str | None = None
+):
     """The plane the scheduler builds when no ``plane_factory`` is set.
 
     Module-level so fault injection (``sched/faults.py``) can wrap the
     real planes through the ``plane_factory`` seam without duplicating
-    the construction rules.
+    the construction rules. ``sha256_backend`` pins the v2 backend
+    ('pallas'/'scan'); None resolves env/auto via
+    :func:`resolve_sha256_backend`.
     """
     if hasher == "cpu":
         return _CpuPlane(algo)
     if algo == "sha256":
+        if resolve_sha256_backend(sha256_backend) == "pallas":
+            return _Sha256PallasPlane(bucket, batch)
         return _Sha256DevicePlane(bucket, batch)
     return _Sha1DevicePlane(bucket, batch)
+
+
+def accepts_sha256_backend(fn) -> bool:
+    """Whether a plane-factory callable takes the optional
+    ``sha256_backend`` kwarg — the seam stays backward compatible with
+    3-arg factories, but a factory that can take the lane's resolved
+    backend must get it (a 'pallas' pin must not override a
+    budget-forced scan fallback; see :meth:`_build_plane`)."""
+    import inspect
+
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # builtins/partials w/o signature
+        return False
+    return "sha256_backend" in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
+
+
+class _StagingSlots:
+    """Reusable tail-zeroed staging slots shared by the device planes.
+
+    ``hash_pieces``-style staging allocates + zeroes a fresh
+    ``batch × padded_len`` buffer every launch — tens of MiB of memset on
+    the hot path. Slots are checked out of a locked free list instead
+    (pipelined launches run in concurrent worker threads) and remember
+    each row's content extent from the previous launch, so ``stage``
+    zeroes only the stale tail ``pad_in_place`` requires.
+    """
+
+    def __init__(self, rows: int, piece_len: int):
+        self.rows = rows
+        self.piece_len = piece_len
+        self._slots: list[tuple] = []  # (padded, view, ends) free list
+        self._lock = threading.Lock()
+
+    def stage(self, chunk: list[bytes], rows: int | None = None):
+        """Checkout a slot and stage ``chunk`` into its first ``rows``
+        rows (default: the whole slot).
+
+        Returns ``(slot, padded, nblocks)`` with ``nblocks`` of length
+        ``rows``; rows past ``len(chunk)`` are ``nblocks=0`` sentinels.
+        Bounding ``rows`` to the launch (the pallas plane's tile bucket)
+        skips the staging work for slot rows the launch never reads —
+        untouched rows keep their recorded extents, so later reuse still
+        tail-zeroes them correctly. The caller runs its launch, then
+        MUST ``checkin(slot)`` (a finally block) to recycle the buffer.
+        """
+        import numpy as np
+
+        from torrent_tpu.ops.padding import alloc_padded, pad_in_place
+
+        rows = self.rows if rows is None else rows
+        with self._lock:
+            slot = self._slots.pop() if self._slots else None
+        if slot is None:
+            padded, view = alloc_padded(self.rows, self.piece_len)
+            slot = (padded, view, np.zeros(self.rows, dtype=np.int64))
+        padded, view, ends = slot
+        try:
+            lengths = np.zeros(rows, dtype=np.int64)
+            for i in range(rows):
+                n = len(chunk[i]) if i < len(chunk) else 0
+                stale = int(ends[i])
+                if stale > n:
+                    padded[i, n:stale] = 0
+                if n:
+                    view[i, :n] = np.frombuffer(chunk[i], dtype=np.uint8)
+                    lengths[i] = n
+            nblocks = pad_in_place(padded[:rows], lengths)
+            # content extent (message + padding) per row, for the next
+            # reuse's tail zeroing — recorded before sentinels clear
+            ends[:rows] = nblocks.astype(np.int64) * 64
+        except Exception:
+            # return the slot instead of leaking it; rows may hold
+            # half-staged content past their recorded extents, so mark
+            # them full-width — the next reuse tail-zeroes everything
+            ends[:rows] = padded.shape[1]
+            self.checkin(slot)
+            raise
+        nblocks[len(chunk) :] = 0  # sentinel rows: skip entirely
+        return slot, padded, nblocks
+
+    def checkin(self, slot) -> None:
+        with self._lock:
+            self._slots.append(slot)
 
 
 class _CpuPlane:
@@ -368,6 +516,11 @@ class _CpuPlane:
 
     def __init__(self, algo: str):
         self._h = hashlib.sha256 if algo == "sha256" else hashlib.sha1
+
+    @staticmethod
+    def launch_geometry(n_rows: int, bucket: int) -> tuple[int, int]:
+        """hashlib stages nothing: no padding, no staging footprint."""
+        return n_rows, 0
 
     def run(self, payloads: list[bytes]) -> list[bytes]:
         h = self._h
@@ -378,13 +531,9 @@ class _Sha1DevicePlane:
     """SHA-1 device plane: one compiled TPUVerifier per bucket (the
     geometry-grouped compile cache the bulk/verify loops relied on).
 
-    Stages into reusable per-plane slots instead of ``hash_pieces`` (which
-    allocates + zeroes a fresh ``batch × padded_len`` buffer every launch
-    — tens of MiB of memset on the hot path). ``pad_in_place`` requires
-    everything past each message to be zero, so each slot remembers its
-    per-row content extent from the previous launch and zeroes only the
-    stale tail. Slot checkout is locked: pipelined launches run in
-    concurrent worker threads.
+    Stages into reusable per-plane :class:`_StagingSlots` instead of
+    ``hash_pieces`` (which allocates + zeroes a fresh buffer every
+    launch).
 
     The jitted execution itself is serialized per plane
     (``_device_lock``): two worker threads entering the same compiled
@@ -398,63 +547,42 @@ class _Sha1DevicePlane:
         from torrent_tpu.models.verifier import TPUVerifier
 
         self._verifier = TPUVerifier(piece_length=bucket, batch_size=batch)
-        self._slots: list[tuple] = []  # (padded, view, ends) free list
-        self._slot_lock = threading.Lock()
+        self._slots = _StagingSlots(self._verifier.batch_size, bucket)
         self._device_lock = threading.Lock()
 
-    def _checkout(self):
-        import numpy as np
+    @staticmethod
+    def launch_geometry(n_rows: int, bucket: int) -> tuple[int, int]:
+        """Row-exact launches; staging charges the padded row width."""
+        from torrent_tpu.ops.padding import padded_len_for
 
-        from torrent_tpu.ops.padding import alloc_padded
-
-        with self._slot_lock:
-            if self._slots:
-                return self._slots.pop()
-        v = self._verifier
-        padded, view = alloc_padded(v.batch_size, v.piece_length)
-        return padded, view, np.zeros(v.batch_size, dtype=np.int64)
+        return n_rows, n_rows * padded_len_for(bucket)
 
     def run(self, payloads: list[bytes]) -> list[bytes]:
-        import numpy as np
-
-        from torrent_tpu.ops.padding import pad_in_place, words_to_digests
+        from torrent_tpu.ops.padding import words_to_digests
 
         v = self._verifier
         b = v.batch_size
         if any(len(p) > v.piece_length for p in payloads):
+            # same guard as the sha256 planes: a too-long piece would
+            # fail mid-stage with the slot checked out
             raise ValueError("piece longer than plane piece_length")
         out: list[bytes] = []
         for start in range(0, len(payloads), b):
             chunk = payloads[start : start + b]
-            padded, view, ends = self._checkout()
+            slot, padded, nblocks = self._slots.stage(chunk)
             try:
-                lengths = np.zeros(b, dtype=np.int64)
-                for i in range(b):
-                    n = len(chunk[i]) if i < len(chunk) else 0
-                    stale = int(ends[i])
-                    if stale > n:
-                        padded[i, n:stale] = 0
-                    if n:
-                        view[i, :n] = np.frombuffer(chunk[i], dtype=np.uint8)
-                        lengths[i] = n
-                nblocks = pad_in_place(padded, lengths)
-                # content extent (message + padding) per row, for the next
-                # reuse's tail zeroing — recorded before sentinels clear
-                ends[:] = nblocks.astype(np.int64) * 64
-                nblocks[len(chunk) :] = 0  # sentinel rows: skip entirely
                 with self._device_lock:
                     words = v.digest_batch(padded, nblocks)
                 out.extend(words_to_digests(words[: len(chunk)]))
             finally:
-                with self._slot_lock:
-                    self._slots.append((padded, view, ends))
+                self._slots.checkin(slot)
         return out
 
 
 class _Sha256DevicePlane:
-    """SHA-256 (BEP 52) device plane. Always the scan backend: the
-    pallas kernel pads every launch to a tile multiple (>=1024 rows),
-    which would blow the staging budget the lane batch enforces."""
+    """SHA-256 (BEP 52) scan-backend plane — the fallback when the
+    pallas kernel is unavailable (non-TPU device, ``scan`` selected, or
+    a bucket whose tile floor would blow the lane staging budget)."""
 
     def __init__(self, bucket: int, batch: int):
         from torrent_tpu.ops.sha256_jax import make_sha256_fn
@@ -462,33 +590,137 @@ class _Sha256DevicePlane:
         self._fn = make_sha256_fn("jax")
         self._bucket = bucket
         self._batch = batch
+        self._slots = _StagingSlots(batch, bucket)
         # serialize the jitted call: concurrent entry from pipelined
         # worker threads can deadlock the XLA runtime (see sha1 plane)
         self._device_lock = threading.Lock()
+
+    @staticmethod
+    def launch_geometry(n_rows: int, bucket: int) -> tuple[int, int]:
+        from torrent_tpu.ops.padding import padded_len_for
+
+        return n_rows, n_rows * padded_len_for(bucket)
 
     def run(self, payloads: list[bytes]) -> list[bytes]:
         import jax.numpy as jnp
         import numpy as np
 
         from torrent_tpu.models.merkle import words32_to_digests
-        from torrent_tpu.ops.padding import alloc_padded, pad_in_place
 
+        if any(len(p) > self._bucket for p in payloads):
+            raise ValueError("piece longer than plane piece_length")
         out: list[bytes] = []
         b = self._batch
         for start in range(0, len(payloads), b):
             chunk = payloads[start : start + b]
-            padded, view = alloc_padded(b, self._bucket)
-            lengths = np.zeros(b, dtype=np.int64)
-            for i, p in enumerate(chunk):
-                view[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
-                lengths[i] = len(p)
-            nblocks = pad_in_place(padded, lengths)
-            nblocks[len(chunk) :] = 0
-            with self._device_lock:
-                words = np.asarray(
-                    self._fn(jnp.asarray(padded), jnp.asarray(nblocks))
-                )
-            out.extend(words32_to_digests(words[: len(chunk)]))
+            slot, padded, nblocks = self._slots.stage(chunk)
+            try:
+                with self._device_lock:
+                    words = np.asarray(
+                        self._fn(jnp.asarray(padded), jnp.asarray(nblocks))
+                    )
+                out.extend(words32_to_digests(words[: len(chunk)]))
+            finally:
+                self._slots.checkin(slot)
+        return out
+
+
+class _Sha256PallasPlane:
+    """SHA-256 (BEP 52) pallas plane — the v2 fast path.
+
+    The hand-tiled kernel (``ops/sha256_pallas.py``) wants tile-shaped
+    batches; the old scan-only scheduler avoided it because every launch
+    padded to the configured tile (default 32×128 = 4096 rows). This
+    plane makes sub-tile launches cheap instead:
+
+    * **Row-bucketed padding**: a live batch rounds up to the nearest
+      ``SUB_TILE_ROWS`` (8×128 = 1024) multiple, and ``tile_sub_for_rows``
+      picks the largest legal sublane count that tiles the bucketed row
+      count — full-target launches keep the sweep-tuned TILE_SUB,
+      partial flushes drop to smaller tiles instead of padding 4×.
+    * **Sentinel rows** carry ``nblocks=0``; their chains never run and
+      their stale staging contents are masked off (same contract as the
+      scan plane).
+    * **Reusable staging slots** (:class:`_StagingSlots`) sized to the
+      lane target, with per-row stale-tail zeroing — no per-launch
+      memset. The u32 view of the slot feeds the kernel's fast path
+      (a u8→u32 bitcast on device lowers through a 4×-widened fusion).
+    * **Per-plane launch-plan cache**: the (padded_rows → tile_sub,
+      interleave2) decision is memoized per geometry; jax.jit then keys
+      the compiled executable on the same statics, so a lane serves any
+      fill level from a handful of executables.
+
+    interleave2 needs ≥16 sublanes with whole-vreg halves, so 1024-row
+    sub-tile launches silently run the straight kernel even when the
+    knob is on (correctness is identical; the knob is a scheduling hint).
+    """
+
+    def __init__(self, bucket: int, batch: int, interpret: bool | None = None):
+        from torrent_tpu.ops import sha256_pallas as sp
+
+        self._sp = sp
+        self._bucket = bucket
+        # slots (and the max launch) are sized to the tile-bucketed
+        # target, so a lane target that is already a tile multiple
+        # wastes zero pad rows at full fill
+        self._batch = sp.pad_rows_for(batch)
+        self._interpret = interpret
+        self._slots = _StagingSlots(self._batch, bucket)
+        self._plans: dict[int, tuple[int, int, bool]] = {}  # n -> (rows, ts, il2)
+        self._device_lock = threading.Lock()
+
+    @staticmethod
+    def launch_geometry(n_rows: int, bucket: int) -> tuple[int, int]:
+        """Tile-bucketed rows; staging charges the padded footprint
+        including sentinel rows."""
+        from torrent_tpu.ops.padding import padded_len_for
+        from torrent_tpu.ops.sha256_pallas import pad_rows_for
+
+        rows = pad_rows_for(n_rows)
+        return rows, rows * padded_len_for(bucket)
+
+    def _plan(self, n: int) -> tuple[int, int, bool]:
+        plan = self._plans.get(n)
+        if plan is None:
+            sp = self._sp
+            rows = min(sp.pad_rows_for(n), self._batch)
+            ts = sp.tile_sub_for_rows(rows)
+            il2 = sp.INTERLEAVE2 and ts >= 16 and not (ts // 2) % 8
+            plan = self._plans[n] = (rows, ts, il2)
+        return plan
+
+    def run(self, payloads: list[bytes]) -> list[bytes]:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from torrent_tpu.models.merkle import words32_to_digests
+
+        if any(len(p) > self._bucket for p in payloads):
+            raise ValueError("piece longer than plane piece_length")
+        out: list[bytes] = []
+        b = self._batch
+        for start in range(0, len(payloads), b):
+            chunk = payloads[start : start + b]
+            rows, ts, il2 = self._plan(len(chunk))
+            slot, padded, nblocks = self._slots.stage(chunk, rows)
+            try:
+                # slice to the bucketed row count, reinterpret as the
+                # kernel's u32 fast path (rows are 128-byte aligned so
+                # the view is free and the slab contiguous)
+                data32 = padded[:rows].view(np.uint32)
+                with self._device_lock:
+                    words = np.asarray(
+                        self._sp.sha256_pieces_pallas(
+                            jnp.asarray(data32),
+                            jnp.asarray(nblocks),
+                            interpret=self._interpret,
+                            tile_sub=ts,
+                            interleave2=il2,
+                        )
+                    )
+                out.extend(words32_to_digests(words[: len(chunk)]))
+            finally:
+                self._slots.checkin(slot)
         return out
 
 
@@ -525,11 +757,24 @@ class HashPlaneScheduler:
         # rollup of evicted auto-registered tenants so served/shed totals
         # stay monotonic after their per-tenant series disappear
         self._evicted = {"tenants": 0, "served_bytes": 0, "served_pieces": 0, "shed": 0}
+        # resolved-once sha256 backend ('pallas'/'scan'); auto-resolution
+        # touches jax.devices(), which must stay off the event loop
+        self._sha256_backend_resolved: str | None = None
 
     # ------------------------------------------------------------ admin
 
     async def start(self) -> "HashPlaneScheduler":
-        """Bind to the running loop (lanes spawn lazily on first use)."""
+        """Bind to the running loop (lanes spawn lazily on first use).
+
+        Pre-resolves the sha256 backend in a worker thread: 'auto'
+        probes ``jax.devices()``, which can block for minutes behind a
+        wedged device tunnel — that wait must never land on the serving
+        loop (``chunk_for`` / enqueue call :meth:`_lane_plan` inline).
+        """
+        if self.hasher != "cpu" and self._sha256_backend_resolved is None:
+            self._sha256_backend_resolved = await asyncio.to_thread(
+                resolve_sha256_backend, self.config.sha256_backend
+            )
         return self
 
     async def close(self) -> None:
@@ -567,30 +812,90 @@ class HashPlaneScheduler:
         """Pow-2 piece-length bucket (shared executable per bucket)."""
         return 1 << (piece_length - 1).bit_length() if piece_length > 1 else 1
 
-    def chunk_for(self, piece_length: int) -> int:
-        """Effective batch target for this geometry — the lane flush
-        size, shrunk for big-piece buckets by the staging budget. Stream
-        ingests use it as their submission chunk so one submission maps
-        to roughly one launch."""
+    def sha256_backend(self) -> str:
+        """The resolved v2 backend ('pallas'/'scan'), memoized. start()
+        pre-warms this in a worker thread — 'auto' probes
+        ``jax.devices()``, which can block behind a wedged device tunnel
+        and must not do so on the serving loop. An unstarted scheduler
+        (tests, direct use) resolves inline on first need."""
+        backend = self._sha256_backend_resolved
+        if backend is None:
+            backend = self._sha256_backend_resolved = resolve_sha256_backend(
+                self.config.sha256_backend
+            )
+        return backend
+
+    def _lane_plan(self, algo: str, bucket: int) -> tuple[str, int]:
+        """(backend, flush target) for a lane — plane-aware batching.
+
+        The base target is ``min(batch_target, staging_budget /
+        padded_len)`` — big-piece buckets shrink the launch so staging
+        stays bounded (the bridge's old private-buffer rule). Pallas
+        sha256 lanes then snap the target to a tile multiple: UP to the
+        next ``SUB_TILE_ROWS`` granule (a full launch wastes zero pad
+        rows) but never past what the staging budget affords; a bucket
+        whose single-tile floor already exceeds the budget falls back to
+        the scan backend instead of overrunning it.
+        """
         from torrent_tpu.ops.padding import padded_len_for
 
-        bucket = self.bucket_for(piece_length)
-        afford = max(1, self.config.staging_budget // padded_len_for(bucket))
-        return max(1, min(self.config.batch_target, afford))
+        cfg = self.config
+        afford = max(1, cfg.staging_budget // padded_len_for(bucket))
+        base = max(1, min(cfg.batch_target, afford))
+        if algo != "sha256" or self.hasher == "cpu":
+            return ("cpu" if self.hasher == "cpu" else "device"), base
+        backend = self.sha256_backend()
+        if backend == "pallas":
+            from torrent_tpu.ops.sha256_pallas import (
+                SUB_TILE_ROWS,
+                TILE_LANE,
+                TILE_SUB,
+                pad_rows_for,
+                tile_sub_for_rows,
+            )
+
+            if afford >= SUB_TILE_ROWS:
+                target = min(
+                    pad_rows_for(base), afford // SUB_TILE_ROWS * SUB_TILE_ROWS
+                )
+                # prefer the sweep-tuned tiling: a row count whose ONLY
+                # legal tiling is the minimal tile_sub=8 (e.g. 5120 rows)
+                # rounds down to a full configured-tile multiple (4096 →
+                # tile_sub 32) — a slightly smaller launch on the fast
+                # tiling beats a bigger one on the slow tiling. Targets
+                # that tile at 16/24 sublanes stand: a user-configured
+                # batch_target must not silently shrink over a mild
+                # tiling preference.
+                full_tile = TILE_SUB * TILE_LANE
+                alt = target // full_tile * full_tile
+                if alt and tile_sub_for_rows(target) == 8 < TILE_SUB:
+                    target = alt
+                return "pallas", target
+            backend = "scan"  # tile floor would blow the staging budget
+        return backend, base
+
+    def chunk_for(self, piece_length: int, algo: str = "sha1") -> int:
+        """Effective batch target for this geometry — the lane flush
+        size (plane-aware: pallas sha256 lanes snap to tile multiples).
+        Stream ingests use it as their submission chunk so one
+        submission maps to roughly one launch."""
+        return self._lane_plan(algo, self.bucket_for(piece_length))[1]
 
     def _lane(self, algo: str, piece_length: int) -> _Lane:
         bucket = self.bucket_for(piece_length)
         key = (algo, bucket)
         lane = self._lanes.get(key)
         if lane is None:
+            backend, target = self._lane_plan(algo, bucket)
             lane = _Lane(
                 algo,
                 bucket,
-                self.chunk_for(bucket),
+                target,
                 self.config.pipeline_depth,
                 _LaneBreaker(
                     self.config.breaker_threshold, self.config.breaker_cooldown
                 ),
+                backend=backend,
             )
             self._lanes[key] = lane
             lane.task = asyncio.ensure_future(self._lane_loop(lane))
@@ -658,11 +963,24 @@ class HashPlaneScheduler:
             sub.future.set_result(b"" if mode == "verify" else [])
             return sub.future
         ts = self._tenant(tenant)
-        nbytes = sum(len(p) for p in pieces)
-        await self._admit(ts, nbytes, wait)
         plen = piece_length if piece_length else max(len(p) for p in pieces)
-        if any(len(p) > self.bucket_for(plen) for p in pieces):
+        bucket = self.bucket_for(plen)
+        if any(len(p) > bucket for p in pieces):
             raise ValueError("piece exceeds submission piece_length")
+        # Admission charges what a device launch actually stages — the
+        # padded row footprint (lane-aligned padded_len per piece), not
+        # the raw payload bytes; a 1-byte piece in a 16 MiB bucket still
+        # pins a 16 MiB staging row. The CPU plane stages nothing, so it
+        # keeps raw-byte accounting.
+        if self.hasher == "cpu":
+            row_cost = 0
+            charged = sum(len(p) for p in pieces)
+        else:
+            from torrent_tpu.ops.padding import padded_len_for
+
+            row_cost = padded_len_for(bucket)
+            charged = len(pieces) * row_cost
+        await self._admit(ts, charged, wait)
         lane = self._lane(algo, plen)
         q = lane.queues.get(tenant)
         if q is None:
@@ -670,10 +988,15 @@ class HashPlaneScheduler:
             lane.rotation.append(tenant)
         now = time.monotonic()
         for i, p in enumerate(pieces):
-            q.append(_Ticket(sub, i, p, expected[i] if expected else None, tenant, now))
+            q.append(
+                _Ticket(
+                    sub, i, p, expected[i] if expected else None, tenant, now,
+                    charged=row_cost or len(p),
+                )
+            )
         lane.pending_pieces += len(pieces)
-        ts.queued_bytes += nbytes
-        self._queued_bytes += nbytes
+        ts.queued_bytes += charged
+        self._queued_bytes += charged
         lane.event.set()
         return sub.future
 
@@ -806,9 +1129,24 @@ class HashPlaneScheduler:
 
     def _build_plane(self, lane: _Lane):
         cfg = self.config
+        # the lane's planned backend is authoritative (it already folded
+        # in the staging-budget fallback), so pass it explicitly rather
+        # than re-resolving env/auto at build time — a factory holding
+        # its own 'pallas' pin (bridge --fault-plan + --sha256-backend)
+        # must not override a budget-forced scan fallback, or the tile
+        # floor blows the staging budget the fallback exists to enforce
+        sha256_backend = lane.backend if lane.backend in ("pallas", "scan") else None
         if cfg.plane_factory is not None:
+            if accepts_sha256_backend(cfg.plane_factory):
+                return cfg.plane_factory(
+                    lane.algo, lane.bucket, lane.target,
+                    sha256_backend=sha256_backend,
+                )
             return cfg.plane_factory(lane.algo, lane.bucket, lane.target)
-        return build_builtin_plane(self.hasher, lane.algo, lane.bucket, lane.target)
+        return build_builtin_plane(
+            self.hasher, lane.algo, lane.bucket, lane.target,
+            sha256_backend=sha256_backend,
+        )
 
     def _run_plane(self, lane: _Lane, payloads: list[bytes]) -> list[bytes]:
         """Worker-thread body: build the plane on first use (JAX init and
@@ -843,6 +1181,21 @@ class HashPlaneScheduler:
                         else:
                             lane.breaker.release_probe()
                         raise
+        # pad-row waste: rows this launch stages beyond the live batch
+        # (tile bucketing on the pallas plane; zero on row-exact planes
+        # and the hashlib degradation path, which stages nothing). The
+        # built plane's own launch_geometry hook is authoritative — a
+        # plane_factory plane (faults seam) may stage differently than
+        # the lane plan assumed; one exposing no hook is taken as
+        # row-exact (FaultyPlane's hook-less default agrees). Charged
+        # per actual attempt (retries and bisection halves each
+        # re-stage), under the counter lock: worker threads run this.
+        hook = getattr(lane.plane, "launch_geometry", None)
+        if hook is not None:
+            pad = hook(len(payloads), lane.bucket)[0] - len(payloads)
+            if pad:
+                with self._counter_lock:
+                    lane.pad_rows_total += pad
         try:
             if self.hasher == "cpu":
                 digests = lane.plane.run(payloads)
@@ -868,9 +1221,13 @@ class HashPlaneScheduler:
         return digests
 
     async def _launch(self, lane: _Lane, tickets: list[_Ticket], reason: str) -> None:
+        n = len(tickets)
+        fill = n / lane.target
         self._launches += 1
-        self._fill_sum += len(tickets) / lane.target
+        self._fill_sum += fill
         self._flush_reasons[reason] += 1
+        lane.launches += 1
+        lane.fill_sum += fill
         await self._dispatch(lane, tickets, depth=0)
 
     async def _dispatch(self, lane: _Lane, tickets: list[_Ticket], depth: int) -> None:
@@ -924,8 +1281,8 @@ class HashPlaneScheduler:
             # in flight — global accounting and delivery must still happen
             t = self._tenants.get(tkt.tenant)
             if t is not None:
-                t.queued_bytes -= tkt.nbytes
-            self._queued_bytes -= tkt.nbytes
+                t.queued_bytes -= tkt.charged
+            self._queued_bytes -= tkt.charged
             if error is not None:
                 if not tkt.sub.future.done():
                     tkt.sub.future.set_exception(error)
@@ -961,6 +1318,21 @@ class HashPlaneScheduler:
             "failed_pieces": self._failed_pieces,
             "breakers": {
                 f"{algo}/{bucket}": lane.breaker.snapshot()
+                for (algo, bucket), lane in self._lanes.items()
+            },
+            # per-lane launch-fill and pad-row waste (pallas tile
+            # bucketing observability: a healthy tile-snapped lane shows
+            # mean_fill near 1.0 and pad_rows_total near 0 under load)
+            "lane_stats": {
+                f"{algo}/{bucket}": {
+                    "backend": lane.backend,
+                    "target": lane.target,
+                    "launches": lane.launches,
+                    "mean_fill": (
+                        lane.fill_sum / lane.launches if lane.launches else 0.0
+                    ),
+                    "pad_rows_total": lane.pad_rows_total,
+                }
                 for (algo, bucket), lane in self._lanes.items()
             },
             "evicted": dict(self._evicted),

@@ -462,6 +462,54 @@ async def _faults_smoke() -> str:
     return f"bisected poisoned piece ({bisections} splits), breaker tripped+recovered"
 
 
+async def _v2_smoke() -> str:
+    """BEP 52 plane smoke (``--v2``): 16 KiB leaf digests AND 64-byte
+    merkle-pair digests vs hashlib, through the scheduler's pallas
+    sha256 lane. Interpret-safe: on a CPU host the backend pin runs the
+    kernel in interpret mode, so this validates the exact dispatch path
+    the v2 fast path uses without needing a device. Also asserts the
+    tile-snapped lane wastes zero pad rows at full fill."""
+    from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
+
+    sched = HashPlaneScheduler(
+        SchedulerConfig(
+            batch_target=1024, flush_deadline=0.2, sha256_backend="pallas"
+        ),
+        hasher="tpu",
+    )
+    await sched.start()
+    try:
+        # leaf leg: a couple of 16 KiB BEP 52 leaf blocks (ragged tail)
+        leaves = [bytes([i + 1]) * 16384 for i in range(2)] + [b"\x42" * 5000]
+        got = await sched.submit("doctor", leaves, algo="sha256", piece_length=16384)
+        assert got == [hashlib.sha256(p).digest() for p in leaves], (
+            "leaf digests diverge from hashlib"
+        )
+        # merkle-pair leg: 64-byte child concatenations (the interior-
+        # node message shape), a full 1024-piece launch — the snapped
+        # lane target — which must waste zero pad rows
+        pairs = [bytes([i % 251]) * 64 for i in range(1024)]
+        got = await sched.submit("doctor", pairs, algo="sha256", piece_length=64)
+        assert got == [hashlib.sha256(p).digest() for p in pairs], (
+            "merkle-pair digests diverge from hashlib"
+        )
+        snap = sched.metrics_snapshot()
+        pair_lane = snap["lane_stats"]["sha256/64"]
+        assert pair_lane["backend"] == "pallas", pair_lane
+        assert pair_lane["pad_rows_total"] == 0, (
+            f"full-tile launch wasted pad rows: {pair_lane}"
+        )
+        assert snap["cpu_fallback_launches"] == 0, "pallas lane fell back to CPU"
+        leaf_lane = snap["lane_stats"]["sha256/16384"]
+        return (
+            f"leaf+pair parity ok (pallas, pair fill "
+            f"{pair_lane['mean_fill']:.2f}, leaf pad rows "
+            f"{leaf_lane['pad_rows_total']})"
+        )
+    finally:
+        await sched.close()
+
+
 async def _bridge_smoke() -> None:
     from torrent_tpu.bridge.service import BridgeServer
     from torrent_tpu.codec.bencode import bdecode, bencode
@@ -518,6 +566,12 @@ def main(argv=None) -> int:
         action="store_true",
         help="also run the fault-tolerance smoke: injected fail-then-recover "
         "plan proving bisection isolation and breaker trip/recovery",
+    )
+    ap.add_argument(
+        "--v2",
+        action="store_true",
+        help="also run the BEP 52 plane smoke: leaf + merkle-pair digests vs "
+        "hashlib through the scheduler's pallas sha256 lane (interpret-safe)",
     )
     ap.add_argument(
         "--json",
@@ -578,6 +632,14 @@ def main(argv=None) -> int:
             _report("PASS", "fault tolerance", detail)
         except Exception as e:
             _report("FAIL", "fault tolerance", repr(e))
+    if args.v2:
+        try:
+            # generous bound: interpret-mode compiles of two lane
+            # geometries dominate (the kernel itself is milliseconds)
+            detail = asyncio.run(asyncio.wait_for(_v2_smoke(), 120))
+            _report("PASS", "v2 hash plane", detail)
+        except Exception as e:
+            _report("FAIL", "v2 hash plane", repr(e))
     try:
         asyncio.run(asyncio.wait_for(_bridge_smoke(), 30))
         _report("PASS", "bridge", "/v1/digests round-trip")

@@ -202,7 +202,17 @@ def run_sweep(
 
     if results:
         best = max(results, key=lambda r: r["blocks_per_sec"])
-        print(json.dumps({"best": best, "block_kb": block_kb, "batch": batch}))
+        # the winner as ready-to-export env knobs: the scheduler's pallas
+        # plane and models/v2's leaf fn read these at import, so a rung
+        # script can `export $(jq ...)` the sweep result straight into
+        # the bench run (see .bench/r6_sha256_rung.sh)
+        env = {
+            "TORRENT_TPU_SHA256_TILE_SUB": best["tile_sub"],
+            "TORRENT_TPU_SHA256_UNROLL": best["unroll"],
+            "TORRENT_TPU_SHA256_FULL_UNROLL": int(best["full_unroll"]),
+            "TORRENT_TPU_SHA256_INTERLEAVE2": int(best["interleave2"]),
+        }
+        print(json.dumps({"best": best, "env": env, "block_kb": block_kb, "batch": batch}))
     return results
 
 

@@ -71,6 +71,38 @@ def render_sched_metrics(sched) -> str:
     ]
     for reason, n in sorted(s["flush_reasons"].items()):
         lines.append(f'torrent_tpu_sched_flush_total{{reason="{reason}"}} {n}')
+    # per-lane launch fill and tile-padding waste (pallas sub-tile
+    # bucketing observability: a tile-snapped lane under load should
+    # show fill near 1.0 and a flat pad-rows counter)
+    lane_stats = s.get("lane_stats", {})
+    lines.append(
+        "# HELP torrent_tpu_sched_lane_fill_ratio Mean launch fill vs this lane's target"
+    )
+    lines.append("# TYPE torrent_tpu_sched_lane_fill_ratio gauge")
+    for lane, st in sorted(lane_stats.items()):
+        lines.append(
+            f'torrent_tpu_sched_lane_fill_ratio{{lane="{_esc(lane)}"}} '
+            f"{st['mean_fill']:.6f}"
+        )
+    lines.append(
+        "# HELP torrent_tpu_sched_launch_pad_rows_total Sentinel rows staged "
+        "beyond the live batch (tile-bucketed pallas launches)"
+    )
+    lines.append("# TYPE torrent_tpu_sched_launch_pad_rows_total counter")
+    for lane, st in sorted(lane_stats.items()):
+        lines.append(
+            f'torrent_tpu_sched_launch_pad_rows_total{{lane="{_esc(lane)}"}} '
+            f"{st['pad_rows_total']}"
+        )
+    lines.append(
+        "# HELP torrent_tpu_sched_lane_target Pieces per launch this lane aims to fill"
+    )
+    lines.append("# TYPE torrent_tpu_sched_lane_target gauge")
+    for lane, st in sorted(lane_stats.items()):
+        lines.append(
+            f'torrent_tpu_sched_lane_target{{lane="{_esc(lane)}",'
+            f'backend="{_esc(st["backend"])}"}} {st["target"]}'
+        )
     # breaker lifecycle per lane: state as an enum gauge (0 closed,
     # 1 half-open, 2 open — alert on > 0) plus transition counters
     _breaker_states = {"closed": 0, "half_open": 1, "open": 2}

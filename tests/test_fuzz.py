@@ -9,6 +9,12 @@ arbitrary shapes.
 
 import hashlib
 
+import pytest
+
+# a clean skip, not a tier-1 collection error, on images without the
+# dev extra (pip install -e '.[dev]' brings it in)
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 
 from torrent_tpu.codec.bencode import BencodeError, bdecode, bdecode_prefix, bencode

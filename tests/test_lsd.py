@@ -167,19 +167,61 @@ class TestClientWiring:
         async def go():
             from torrent_tpu.net.lsd import LSD_GROUP
 
+            import select
             import socket as _s
 
-            probe = _s.socket(_s.AF_INET, _s.SOCK_DGRAM)
+            # Capability probe must be END-TO-END and must mirror what
+            # the real path requires, not just socket setup: sandboxes
+            # commonly allow IP_ADD_MEMBERSHIP (a join-only probe
+            # passes) and even deliver loopback multicast — but from a
+            # SOURCE ADDRESS in globally-routable space (e.g. a
+            # container IP), which LSD's off-LAN reflector guard then
+            # rightly drops. Send a real group datagram between two
+            # port-sharing sockets and require both delivery AND a
+            # LAN-acceptable source; otherwise skip (environment, not
+            # code).
+            import ipaddress
+
+            a = _s.socket(_s.AF_INET, _s.SOCK_DGRAM)
+            b = _s.socket(_s.AF_INET, _s.SOCK_DGRAM)
             try:
-                probe.setsockopt(
-                    _s.IPPROTO_IP,
-                    _s.IP_ADD_MEMBERSHIP,
-                    _s.inet_aton(LSD_GROUP) + _s.inet_aton("0.0.0.0"),
-                )
+                mreq = _s.inet_aton(LSD_GROUP) + _s.inet_aton("0.0.0.0")
+                for sock in (a, b):
+                    sock.setsockopt(_s.SOL_SOCKET, _s.SO_REUSEADDR, 1)
+                a.bind(("", 0))
+                port = a.getsockname()[1]
+                b.bind(("", port))
+                for sock in (a, b):
+                    sock.setsockopt(_s.IPPROTO_IP, _s.IP_ADD_MEMBERSHIP, mreq)
+                    sock.setsockopt(_s.IPPROTO_IP, _s.IP_MULTICAST_LOOP, 1)
+                b.sendto(b"lsd-probe", (LSD_GROUP, port))
+                ready, _, _ = select.select([a], [], [], 1.0)
+                if not ready:
+                    pytest.skip(
+                        "multicast fan-out unavailable in this environment"
+                    )
+                data, addr = a.recvfrom(64)
+                src = ipaddress.ip_address(addr[0])
+                if data != b"lsd-probe":
+                    pytest.skip("multicast delivery garbled in this environment")
+                if not (
+                    src.is_private
+                    or src.is_link_local
+                    or src.is_loopback
+                    or src in ipaddress.ip_network("100.64.0.0/10")
+                ):
+                    # same acceptance set as LocalServiceDiscovery's
+                    # off-LAN guard: a host whose own multicast source
+                    # address is globally routable cannot pass it
+                    pytest.skip(
+                        f"multicast source {src} is off-LAN for the "
+                        "reflector guard in this environment"
+                    )
             except OSError:
                 pytest.skip("multicast unavailable in this environment")
             finally:
-                probe.close()
+                a.close()
+                b.close()
 
             found = []
             a = LocalServiceDiscovery(6001, lambda ih, addr: found.append(ih))

@@ -12,6 +12,14 @@ codec every BitTorrent client already has:
   GET  /v1/info      → {backend, devices, batch} (capability probe)
   GET  /metrics      → scheduler queue/fill/shed counters (Prometheus)
 
+  POST /v1/fabric/verify  body {items: [{torrent, root}, ...]}
+                          → 202; starts a scheduler-fed library recheck
+                            (torrent_tpu/fabric) of sidecar-local paths
+  GET  /v1/fabric/status  → {state, fabric: {units_done, adopted, ...}}
+                            plus the result summary once done; the same
+                            gauges flow into /metrics as
+                            torrent_tpu_fabric_* while the job exists
+
 Every route submits into the shared hash-plane scheduler
 (``torrent_tpu/sched``) instead of owning staging buffers: pieces from
 many concurrent clients coalesce into full device batches (one ~55 ms
@@ -184,6 +192,10 @@ class BridgeServer:
         self.hasher = hasher
         self._server: asyncio.AbstractServer | None = None
         self.sched: HashPlaneScheduler | None = None
+        # one fabric job at a time: {"task", "executors" (the running
+        # FabricExecutor appended by verify_library_fabric), "result",
+        # "error", "torrents"} — /v1/fabric/* and /metrics read it
+        self._fabric: dict | None = None
         # chaos harness: injected faults wrap the planes the scheduler
         # would build anyway (dev/test only — main() gates the CLI knob)
         if isinstance(fault_plan, str):
@@ -217,6 +229,12 @@ class BridgeServer:
     async def wait_closed(self) -> None:
         if self._server:
             await self._server.wait_closed()
+        if self._fabric is not None and self._fabric["task"] is not None and not self._fabric["task"].done():
+            self._fabric["task"].cancel()
+            try:
+                await self._fabric["task"]
+            except (asyncio.CancelledError, Exception):
+                pass
         if self.sched is not None:
             await self.sched.close()
 
@@ -423,13 +441,23 @@ class BridgeServer:
             )
             return await self._reply(writer, 200, payload)
         if method == "GET" and target.split("?")[0] == "/metrics":
-            from torrent_tpu.utils.metrics import render_sched_metrics
-
-            return await self._reply(
-                writer, 200, render_sched_metrics(self.sched).encode()
+            from torrent_tpu.utils.metrics import (
+                render_fabric_metrics,
+                render_sched_metrics,
             )
+
+            text = render_sched_metrics(self.sched)
+            if self._fabric and self._fabric["executors"]:
+                text += render_fabric_metrics(
+                    self._fabric["executors"][0].metrics_snapshot()
+                )
+            return await self._reply(writer, 200, text.encode())
+        if method == "GET" and target == "/v1/fabric/status":
+            return await self._reply(writer, 200, bencode(self._fabric_status()))
         if method != "POST":
             return await self._reply(writer, 405, b"method not allowed")
+        if target == "/v1/fabric/verify":
+            return await self._fabric_verify(writer, body)
         # the buffered hash routes are sha1-only; a sha256 request must
         # fail closed, not silently return v1 digests with a 200 (the
         # algorithm-agnostic /v1/info above is exempt)
@@ -479,6 +507,137 @@ class BridgeServer:
                 return await self._reply_launch_failed(writer, e)
             return await self._reply(writer, 200, bencode({b"ok": ok}))
         await self._reply(writer, 404, b"not found")
+
+    # ------------------------------------------------------------- fabric
+
+    async def _fabric_verify(self, writer, body: bytes):
+        """Start a scheduler-fed library recheck of local torrents.
+
+        Body (bencode): ``{items: [{torrent: PATH, root: PATH}, ...],
+        unit_mb?: int}`` — paths are local to the sidecar host, the same
+        trust model as the CLI (the bridge binds loopback by default).
+        Replies 202 immediately; poll ``GET /v1/fabric/status``. One job
+        at a time: a second POST while one runs gets 409.
+        """
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.storage.storage import FsStorage, Storage
+
+        if self._fabric is not None and (
+            self._fabric["task"] is None or not self._fabric["task"].done()
+        ):
+            return await self._reply(writer, 409, b"fabric verify already running")
+        try:
+            req = bdecode(body)
+        except BencodeError as e:
+            return await self._reply(writer, 400, f"bad bencode: {e}".encode())
+        specs = req.get(b"items") if isinstance(req, dict) else None
+        if not isinstance(specs, list) or not specs:
+            return await self._reply(writer, 400, b"missing items list")
+        for spec in specs:
+            if not isinstance(spec, dict) or not isinstance(
+                spec.get(b"torrent"), bytes
+            ):
+                return await self._reply(
+                    writer, 400, b"each item needs torrent and root paths"
+                )
+
+        # claim the job slot BEFORE the first await: a concurrent POST
+        # suspended in load_items must hit the 409 above, not race two
+        # sweeps into one record (task=None means "starting" = busy)
+        job = self._fabric = {
+            "executors": [],
+            "result": None,
+            "error": None,
+            "torrents": len(specs),
+            "task": None,
+        }
+
+        def load_items():
+            # disk reads + parses off the event loop: a long manifest on
+            # slow storage must not stall concurrent hash requests
+            out = []
+            for spec in specs:
+                tpath = spec[b"torrent"].decode("utf-8", "surrogateescape")
+                root = spec.get(b"root", b".").decode("utf-8", "surrogateescape")
+                try:
+                    with open(tpath, "rb") as f:
+                        meta = parse_metainfo(f.read())
+                except OSError as e:
+                    raise ValueError(f"cannot read {tpath}: {e}") from e
+                if meta is None:
+                    raise ValueError(f"not a v1 .torrent: {tpath}")
+                out.append((Storage(FsStorage(root), meta.info), meta.info))
+            return out
+
+        try:
+            items = await asyncio.to_thread(load_items)
+        except ValueError as e:
+            self._fabric = None  # release the claim: nothing ran
+            return await self._reply(writer, 400, str(e).encode())
+        unit_mb = req.get(b"unit_mb")
+        unit_bytes = (unit_mb << 20) if isinstance(unit_mb, int) and unit_mb > 0 else None
+        job["task"] = asyncio.ensure_future(
+            self._run_fabric(job, items, unit_bytes)
+        )
+        total = sum(info.num_pieces for _, info in items)
+        return await self._reply(
+            writer,
+            202,
+            bencode({b"state": b"started", b"torrents": len(items), b"pieces": total}),
+        )
+
+    async def _run_fabric(self, job: dict, items, unit_bytes) -> None:
+        from torrent_tpu.parallel.bulk import verify_library_fabric
+
+        try:
+            res = await verify_library_fabric(
+                items,
+                self.sched,
+                unit_bytes=unit_bytes,
+                executor_out=job["executors"],
+            )
+        except Exception as e:  # surfaced via /v1/fabric/status
+            log.error("fabric verify failed: %s", e)
+            job["error"] = str(e)
+            return
+        job["result"] = {
+            b"valid": sum(int(bf.sum()) for bf in res.bitfields),
+            b"pieces": res.n_pieces,
+            b"per_torrent": [int(bf.sum()) for bf in res.bitfields],
+            b"millis": int(res.seconds * 1000),
+        }
+
+    def _fabric_status(self) -> dict:
+        job = self._fabric
+        if job is None:
+            return {b"state": b"idle"}
+        out: dict = {b"torrents": job["torrents"]}
+        if job["error"] is not None:
+            out[b"state"] = b"failed"
+            out[b"error"] = job["error"].encode()
+        elif job["result"] is not None:
+            out[b"state"] = b"done"
+            out[b"result"] = job["result"]
+        else:
+            out[b"state"] = b"running"
+        if job["executors"]:
+            s = job["executors"][0].metrics_snapshot()
+            out[b"fabric"] = {
+                b"pid": s["pid"],
+                b"nproc": s["nproc"],
+                b"plan": s["plan_fingerprint"].encode(),
+                b"shard_units": s["shard_units"],
+                b"shard_bytes": s["shard_bytes"],
+                b"units_done": s["units_done"],
+                b"units_adopted": s["units_adopted"],
+                b"pieces_verified": s["pieces_verified"],
+                b"sentinel_checks": s["sentinel_checks"],
+                b"sentinel_mismatches": s["sentinel_mismatches"],
+                b"stragglers": s["stragglers"],
+                b"heartbeat_age_ms": int(s["heartbeat_age"] * 1000),
+                b"degraded": int(s["degraded"]),
+            }
+        return out
 
     async def _reply_launch_failed(self, writer, e: SchedLaunchError):
         # transient retry-exhausted failure: 503 + Retry-After (shed is

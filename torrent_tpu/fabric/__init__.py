@@ -1,0 +1,118 @@
+"""torrent_tpu.fabric — the pod-scale verify fabric.
+
+Shards a library recheck across processes (``fabric/plan.py``: a
+deterministic byte-weight planner every process computes identically —
+no coordinator RPC) and feeds each process's shard through its LOCAL
+continuous-batching scheduler (``fabric/executor.py``), so cross-tenant
+coalescing and pod-scale sharding compose instead of competing for the
+hash plane. A periodic few-byte heartbeat carries progress and verdict
+bits; survivors adopt orphaned work from lapsed or breaker-degraded
+processes, sentinel-cross-checking adopted verdicts so a bad worker
+cannot poison the global bitfield. Public entry point:
+``torrent_tpu.parallel.bulk.verify_library_fabric``.
+"""
+
+from torrent_tpu.fabric.executor import (
+    FAULT_EXIT_CODE,
+    AllgatherHeartbeat,
+    FabricConfig,
+    FabricExecutor,
+    FileHeartbeat,
+    pack_bits,
+    plan_payload_bytes,
+    unpack_bits,
+)
+from torrent_tpu.fabric.plan import (
+    DEFAULT_UNIT_BYTES,
+    FabricPlan,
+    WorkUnit,
+    adoption_owner,
+    plan_library,
+)
+
+__all__ = [
+    "FAULT_EXIT_CODE",
+    "AllgatherHeartbeat",
+    "DEFAULT_UNIT_BYTES",
+    "FabricConfig",
+    "FabricExecutor",
+    "FabricPlan",
+    "FileHeartbeat",
+    "WorkUnit",
+    "adoption_owner",
+    "build_fabric_executor",
+    "pack_bits",
+    "plan_library",
+    "plan_payload_bytes",
+    "unpack_bits",
+]
+
+
+def build_fabric_executor(
+    items,
+    scheduler,
+    *,
+    nproc: int | None = None,
+    pid: int | None = None,
+    heartbeat_dir: str | None = None,
+    transport=None,
+    config: FabricConfig | None = None,
+    unit_bytes: int = DEFAULT_UNIT_BYTES,
+    progress_cb=None,
+) -> FabricExecutor:
+    """Plan a library and build this process's executor.
+
+    ``nproc``/``pid`` default to the live ``jax.distributed`` cluster
+    (``jax.process_count()`` / ``jax.process_index()``); pass them
+    explicitly to run the fabric WITHOUT ``jax.distributed`` (the file
+    transport needs no collective — that is how the doctor self-test and
+    the CPU tests spawn plain OS processes).
+
+    Transport precedence: explicit ``transport`` > ``heartbeat_dir``
+    (:class:`FileHeartbeat` — shared-filesystem heartbeats, supports
+    lapse adoption) > the DCN allgather transport on a multi-process
+    cluster > none (solo). Shared by ``verify_library_fabric``, the
+    bridge's ``/v1/fabric/*`` routes, and the CLI so the wiring lives in
+    one place.
+    """
+    if nproc is None or pid is None:
+        try:
+            import jax
+
+            nproc = jax.process_count() if nproc is None else nproc
+            pid = jax.process_index() if pid is None else pid
+        except Exception:
+            nproc = 1 if nproc is None else nproc
+            pid = 0 if pid is None else pid
+    plan = plan_library([info for _, info in items], nproc, unit_bytes)
+    if transport is None:
+        if heartbeat_dir is not None:
+            # purge heartbeat files older than the lapse window so a
+            # reused dir can't feed this run the previous run's verdicts
+            cfg = config or FabricConfig()
+            transport = FileHeartbeat(
+                heartbeat_dir, pid, purge_stale_s=cfg.lapse_after
+            )
+        elif nproc > 1:
+            # the collective transport only works on a live cluster of
+            # exactly nproc processes — anything else would hang the
+            # first allgather round forever, so fail loudly up front
+            import jax
+
+            if jax.process_count() != nproc:
+                raise ValueError(
+                    f"allgather heartbeat needs a live jax.distributed "
+                    f"cluster of {nproc} processes (found "
+                    f"{jax.process_count()}); pass heartbeat_dir for the "
+                    "shared-filesystem transport instead"
+                )
+            transport = AllgatherHeartbeat(nproc, pid, plan_payload_bytes(plan))
+    return FabricExecutor(
+        items,
+        plan,
+        pid,
+        scheduler,
+        config=config,
+        transport=transport,
+        progress_cb=progress_cb,
+    )

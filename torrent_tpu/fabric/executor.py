@@ -1,0 +1,848 @@
+"""Per-process fabric executor: one process's shard of a pod-scale
+library recheck, fed through the LOCAL continuous-batching scheduler.
+
+``verify_library_distributed`` shards torrents across processes but
+each shard runs a private ``verify_library`` batch loop — bypassing the
+scheduler, so a pod-scale recheck and foreground verify traffic compete
+for the hash plane instead of coalescing. The executor closes that gap:
+its shard's pieces are submitted to the shared
+:class:`~torrent_tpu.sched.HashPlaneScheduler` as a low-priority
+``"fabric"`` tenant, so bulk rechecks ride the same launches (and the
+same retry/bisection/breaker machinery) as everyone else, and DRR keeps
+them from starving interactive callers.
+
+Failure layer. Processes exchange a periodic few-byte heartbeat —
+sequence, in-flight units, completed-unit verdict bits, a degraded
+flag, and a distrust list — over a pluggable transport:
+
+* :class:`FileHeartbeat` — atomic JSON files in a shared directory.
+  Files outlive their writer and staleness is visible, so this is the
+  transport that supports **lapse adoption**: when a peer's heartbeat
+  goes stale, its unfinished units are re-assigned among the survivors
+  by the deterministic :func:`~torrent_tpu.fabric.plan.adoption_owner`
+  rule — no claim protocol, every survivor computes the same answer.
+* :class:`AllgatherHeartbeat` — ``multihost_utils.process_allgather``
+  of a fixed-size buffer, the same DCN-only discipline as
+  ``allgather_bitfield``: a few KiB per round is the only payload that
+  crosses the network. Collective, so a *dead* peer blocks the round
+  (that is the ``jax.distributed`` reality); it still carries the
+  degraded flag, so breaker-stuck adoption works on a healthy pod.
+
+A process whose sha1 lane breaker has been stuck open past
+``breaker_stuck_after`` publishes ``degraded=True``: it keeps its
+in-flight units (the CPU fallback plane is correct, just slow) but
+yields its unstarted ones to the survivors. Verdict bits adopted from a
+lapsed or degraded peer are **sentinel cross-checked** — one reportedly
+valid piece per adopted unit is re-hashed locally against the info
+dict — so a worker with silently corrupt storage or a lying hash plane
+cannot poison the global bitfield: a mismatch adds a ``(publisher,
+unit)`` pair to the exchanged distrust list, every process discards
+those verdicts, and a survivor re-verifies the unit locally.
+
+Termination is symmetric by construction: verdicts are tracked per
+publisher, only *published* verdicts count toward the heartbeat loop's
+stop condition, and the distrust list is part of the exchange — so
+after any round, every process evaluates the same coverage state and
+all heartbeat loops stop on the same round (the collective transport
+requires exactly this). The final per-unit verdict is picked by the
+same deterministic rule everywhere (lowest acceptable publisher pid),
+so :meth:`FabricExecutor.bitfields` is identical on every process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from collections import deque
+from dataclasses import dataclass
+
+import numpy as np
+
+from torrent_tpu.fabric.plan import FabricPlan, adoption_owner
+from torrent_tpu.utils.log import get_logger
+
+log = get_logger("fabric")
+
+
+def pack_bits(bits: np.ndarray) -> str:
+    """bool verdict vector -> hex (the heartbeat's few-byte encoding)."""
+    return np.packbits(np.asarray(bits, dtype=bool)).tobytes().hex()
+
+
+def unpack_bits(hexstr: str, n: int) -> np.ndarray:
+    raw = np.frombuffer(bytes.fromhex(hexstr), dtype=np.uint8)
+    bits = np.unpackbits(raw)[:n]
+    if len(bits) != n:
+        raise ValueError(f"verdict payload too short for {n} pieces")
+    return bits.astype(bool)
+
+
+@dataclass
+class FabricConfig:
+    tenant: str = "fabric"
+    # low priority: bulk rechecks yield to foreground verify traffic in
+    # the scheduler's DRR, but are never starved (weight > 0)
+    weight: float = 0.25
+    # bound on payload bytes this executor holds in scheduler futures —
+    # on top of the scheduler's own admission budget, so one fabric
+    # sweep can't monopolize the shared queue either
+    max_inflight_bytes: int = 64 << 20
+    heartbeat_interval: float = 0.5
+    # a peer whose newest heartbeat is older than this is lapsed (file
+    # transport only; collective transports can't outlive a dead peer)
+    lapse_after: float = 5.0
+    # seconds a sha1 lane breaker must stay open before this process
+    # declares itself degraded and yields its unstarted units
+    breaker_stuck_after: float = 3.0
+    # a unit in flight longer than factor x the mean unit time (and at
+    # least min_s) is logged as a straggler
+    straggler_factor: float = 4.0
+    straggler_min_s: float = 10.0
+    # consecutive failed heartbeat exchanges (lost shared dir, broken
+    # collective) before the run aborts with a classified error rather
+    # than spinning forever with stale state
+    heartbeat_fail_limit: int = 20
+    # TEST/FAULT HOOK (doctor --fabric, tests/test_fabric.py): publish a
+    # final heartbeat then hard-exit the process after this many units
+    # complete — the deterministic stand-in for a worker dying mid-run.
+    # File transport only (an extra collective round would break the
+    # allgather lockstep — and a dead peer wedges it anyway).
+    fault_exit_after_units: int | None = None
+
+
+FAULT_EXIT_CODE = 42  # fault_exit_after_units exits with this
+
+
+class FileHeartbeat:
+    """Heartbeat over atomic JSON files in a shared directory.
+
+    One ``fabric_hb_<pid>.json`` per process, replaced atomically each
+    round. Staleness (and absence) is visible to every reader, so this
+    transport supports lapse detection — and the files outlive their
+    writer, so a survivor can still read a dead peer's last published
+    verdicts. Same-host tests and shared-filesystem pods use this.
+    """
+
+    supports_lapse = True
+
+    def __init__(self, directory: str, pid: int, purge_stale_s: float | None = None):
+        self.dir = directory
+        self.pid = pid
+        os.makedirs(directory, exist_ok=True)
+        if purge_stale_s is not None:
+            # a reused heartbeat dir must not feed a fresh run the
+            # PREVIOUS run's verdicts (e.g. a re-check after repairing
+            # data would silently return the pre-repair bitfield).
+            # Files from live peers are refreshed every interval, so an
+            # mtime older than the lapse window can only be leftovers.
+            now = time.time()
+            for name in os.listdir(directory):
+                if not name.startswith("fabric_hb_"):
+                    continue
+                path = os.path.join(directory, name)
+                try:
+                    if now - os.path.getmtime(path) > purge_stale_s:
+                        os.unlink(path)
+                except OSError:
+                    continue
+
+    def _path(self, pid: int) -> str:
+        return os.path.join(self.dir, f"fabric_hb_{pid}.json")
+
+    def exchange(self, payload: dict) -> dict[int, dict]:
+        tmp = self._path(self.pid) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self._path(self.pid))
+        peers: dict[int, dict] = {}
+        for name in os.listdir(self.dir):
+            if not (name.startswith("fabric_hb_") and name.endswith(".json")):
+                continue
+            try:
+                pid = int(name[len("fabric_hb_") : -len(".json")])
+            except ValueError:
+                continue
+            if pid == self.pid:
+                continue
+            try:
+                with open(os.path.join(self.dir, name)) as f:
+                    peers[pid] = json.load(f)
+            except (OSError, ValueError):
+                continue  # mid-replace or corrupt: next round re-reads
+        return peers
+
+
+class AllgatherHeartbeat:
+    """Heartbeat over ``multihost_utils.process_allgather`` — the
+    DCN-only discipline ``allgather_bitfield`` set: a fixed-size buffer
+    of a few KiB per round is the only cross-host payload.
+
+    Collective: every process must call :meth:`exchange` the same
+    number of times, which the executor guarantees by terminating its
+    heartbeat loop on the symmetric published-coverage condition. A
+    dead peer therefore blocks the round — lapse adoption needs the
+    file transport; this one carries the degraded flag and distrust
+    list, so breaker-stuck adoption works on a healthy pod.
+    """
+
+    supports_lapse = False
+
+    def __init__(self, nproc: int, pid: int, max_bytes: int):
+        self.nproc = nproc
+        self.pid = pid
+        self.max_bytes = max_bytes
+
+    def exchange(self, payload: dict) -> dict[int, dict]:
+        from jax.experimental import multihost_utils
+
+        raw = json.dumps(payload).encode()
+        if len(raw) > self.max_bytes:
+            # NEVER bail out before the collective — peers are already
+            # blocked in process_allgather and a raise here would wedge
+            # the whole pod. Participate with a minimal envelope (no
+            # verdicts published this round) and scream; sizing comes
+            # from plan_payload_bytes, so this is a should-not-happen.
+            log.error(
+                "fabric heartbeat payload %dB exceeds the %dB allgather "
+                "buffer; sending minimal envelope this round",
+                len(raw), self.max_bytes,
+            )
+            raw = json.dumps(
+                {
+                    "pid": payload.get("pid"),
+                    "seq": payload.get("seq"),
+                    "t": payload.get("t"),
+                    "fp": payload.get("fp"),
+                    "degraded": payload.get("degraded", False),
+                    "overflow": True,
+                }
+            ).encode()
+        buf = np.zeros(self.max_bytes + 4, dtype=np.uint8)
+        buf[:4] = np.frombuffer(len(raw).to_bytes(4, "big"), dtype=np.uint8)
+        buf[4 : 4 + len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+        rows = np.asarray(multihost_utils.process_allgather(buf, tiled=False))
+        peers: dict[int, dict] = {}
+        for p in range(rows.shape[0]):
+            if p == self.pid:
+                continue
+            ln = int.from_bytes(rows[p, :4].tobytes(), "big")
+            peers[p] = json.loads(rows[p, 4 : 4 + ln].tobytes().decode())
+        return peers
+
+
+def plan_payload_bytes(plan: FabricPlan) -> int:
+    """Allgather buffer size for a plan: the worst-case heartbeat is
+    every unit's verdict bits (hex doubles the packed bytes) plus
+    per-unit JSON overhead, a distrust/redone list that can hold one
+    entry per (publisher, unit) pair, and a fixed envelope."""
+    bits_hex = sum((u.npieces + 7) // 8 * 2 for u in plan.units)
+    return (
+        4096
+        + bits_hex
+        + 48 * len(plan.units)
+        + 24 * len(plan.units) * plan.nproc  # distrust pairs, worst case
+    )
+
+
+_PENDING, _INFLIGHT, _DONE = "pending", "inflight", "done"
+
+
+class FabricExecutor:
+    """One process's fabric role: verify its shard through the local
+    scheduler, heartbeat progress, adopt orphans. See the module
+    docstring for the failure model."""
+
+    def __init__(
+        self,
+        items,
+        plan: FabricPlan,
+        pid: int,
+        scheduler,
+        config: FabricConfig | None = None,
+        transport=None,
+        progress_cb=None,
+    ):
+        if not 0 <= pid < plan.nproc:
+            raise ValueError(f"pid {pid} outside plan's {plan.nproc} processes")
+        if transport is None and plan.nproc > 1:
+            raise ValueError("multi-process plan needs a heartbeat transport")
+        self.items = items
+        self.plan = plan
+        self.pid = pid
+        self.scheduler = scheduler
+        self.config = config or FabricConfig()
+        self.transport = transport
+        self.progress_cb = progress_cb
+        self._fp = plan.fingerprint()
+        # local work state
+        self._queue: deque[int] = deque(u.uid for u in plan.units_for(pid))
+        self._status: dict[int, str] = {u: _PENDING for u in self._queue}
+        # verdicts per (unit, publisher): own results live under our own
+        # pid; peers' published results are merged in. The deterministic
+        # picker in bitfields() reads the same structure on every process.
+        self._verdicts: dict[int, dict[int, np.ndarray]] = {}
+        self._published_done: set[int] = set()
+        self._peer_seen: dict[int, dict] = {}  # pid -> latest payload
+        # liveness by LOCAL monotonic receipt of seq advances — never by
+        # the payload's wall-clock stamp, which cross-host clock skew
+        # would turn into permanent false lapses
+        self._peer_advance: dict[int, tuple[int, float]] = {}
+        # (publisher, uid) pairs whose verdicts failed a sentinel check —
+        # exchanged in every heartbeat so coverage stays symmetric
+        self._distrust: set[tuple[int, int]] = set()
+        self._checked: set[tuple[int, int]] = set()
+        # pairs retired by a re-verification (ours published as
+        # "redone"; peers' redone processed into here) — the distrust
+        # merge skips them so stale heartbeat files can't resurrect a
+        # superseded rejection
+        self._superseded: set[tuple[int, int]] = set()
+        self._yielded: dict[int, float] = {}  # uid -> yield time
+        self._warned_straggler: set[int] = set()
+        self._unit_started: dict[int, float] = {}
+        self._unit_times: list[float] = []
+        self._breaker_open_since: dict[str, float] = {}
+        self._degraded = False
+        # counters / gauges (metrics_snapshot)
+        self._seq = 0
+        self._units_done = 0
+        self._units_adopted = 0
+        self._pieces_verified = 0
+        self._sentinel_checks = 0
+        self._sentinel_mismatches = 0
+        self._stragglers = 0
+        self._hb_errors = 0
+        self._hb_consec_fail = 0
+        self._hb_fatal: Exception | None = None
+        self._inflight_bytes = 0
+        self._bytes_cond: asyncio.Condition | None = None
+        self._last_exchange: float | None = None
+        self._started_mono = time.monotonic()
+        self._started_wall = time.time()
+        self._state = "idle"
+
+    # ---------------------------------------------------------- coverage
+
+    def _own_bits(self) -> dict[int, np.ndarray]:
+        return {
+            uid: pubs[self.pid]
+            for uid, pubs in self._verdicts.items()
+            if self.pid in pubs
+        }
+
+    def _unit_covered(self, uid: int, published_only: bool = False) -> bool:
+        """An acceptable (non-distrusted) verdict exists for the unit.
+        ``published_only`` restricts our OWN verdicts to those already
+        exchanged — the symmetric form every process evaluates equally,
+        so heartbeat loops all stop on the same round."""
+        for p in self._verdicts.get(uid, ()):
+            if (p, uid) in self._distrust:
+                continue
+            if published_only and p == self.pid and uid not in self._published_done:
+                continue
+            return True
+        return False
+
+    def _covered(self) -> bool:
+        return all(self._unit_covered(u.uid) for u in self.plan.units)
+
+    def _covered_published(self) -> bool:
+        return all(
+            self._unit_covered(u.uid, published_only=True)
+            for u in self.plan.units
+        )
+
+    def bitfields(self) -> list[np.ndarray]:
+        """Global per-torrent bitfields from the merged verdict view.
+
+        Per unit, the verdict used is the lowest-pid publisher whose
+        (publisher, unit) pair is not distrusted — a pure function of
+        exchanged state, so every process assembles the identical global
+        bitfield once run() returns."""
+        out = [np.zeros(info.num_pieces, dtype=bool) for _, info in self.items]
+        for u in self.plan.units:
+            pubs = self._verdicts.get(u.uid)
+            if not pubs:
+                continue
+            ok = [p for p in sorted(pubs) if (p, u.uid) not in self._distrust]
+            pick = ok[0] if ok else sorted(pubs)[0]
+            out[u.torrent][u.start : u.stop] = pubs[pick]
+        return out
+
+    # -------------------------------------------------------------- run
+
+    async def run(self) -> None:
+        self._state = "running"
+        self.scheduler.register_tenant(
+            self.config.tenant, weight=self.config.weight
+        )
+        self._bytes_cond = asyncio.Condition()
+        hb_task = (
+            asyncio.ensure_future(self._heartbeat_loop())
+            if self.transport is not None
+            else None
+        )
+        try:
+            while not self._covered():
+                if self._hb_fatal is not None:
+                    raise self._hb_fatal
+                uid = self._next_uid()
+                if uid is None:
+                    if self.transport is None:
+                        raise RuntimeError(
+                            "solo fabric run drained its queue without coverage"
+                        )
+                    # waiting on peers (or on adoption): idle briefly
+                    await asyncio.sleep(
+                        min(self.config.heartbeat_interval, 0.05)
+                    )
+                    continue
+                await self._verify_unit(uid)
+            self._state = "done"
+        except BaseException:
+            self._state = "failed"
+            raise
+        finally:
+            if hb_task is not None:
+                # the loop terminates itself on published coverage (the
+                # collective transport needs every process to stop on
+                # the same round); on failure paths cancel it instead
+                if self._state == "done":
+                    await hb_task
+                else:
+                    hb_task.cancel()
+                    try:
+                        await hb_task
+                    except (asyncio.CancelledError, Exception):
+                        pass
+
+    def _next_uid(self) -> int | None:
+        while self._queue:
+            uid = self._queue.popleft()
+            if self._unit_covered(uid):
+                continue  # a peer (or an adoption race) already covered it
+            return uid
+        return None
+
+    # ------------------------------------------------------ verification
+
+    async def _acquire_bytes(self, n: int) -> None:
+        async with self._bytes_cond:
+            await self._bytes_cond.wait_for(
+                lambda: self._inflight_bytes == 0
+                or self._inflight_bytes + n <= self.config.max_inflight_bytes
+            )
+            self._inflight_bytes += n
+
+    async def _release_bytes(self, n: int) -> None:
+        async with self._bytes_cond:
+            self._inflight_bytes -= n
+            self._bytes_cond.notify_all()
+
+    async def _verify_unit(self, uid: int) -> None:
+        from torrent_tpu.parallel.verify import read_pieces_chunk
+        from torrent_tpu.sched import SchedLaunchError
+
+        unit = self.plan.units[uid]
+        storage, info = self.items[unit.torrent]
+        self._status[uid] = _INFLIGHT
+        self._unit_started[uid] = time.monotonic()
+        bits = np.zeros(unit.npieces, dtype=bool)
+        chunk = self.scheduler.chunk_for(info.piece_length)
+        futs: deque = deque()
+        n_ok = 0
+
+        async def drain_one() -> None:
+            nonlocal n_ok
+            fut, keep, nb = futs.popleft()
+            try:
+                ok = await fut
+            except SchedLaunchError as e:
+                log.warning(
+                    "fabric unit %d: %d pieces unverified (launch failed: %s)",
+                    uid, len(keep), e,
+                )
+                ok = None  # stay False: recheck later
+            finally:
+                await self._release_bytes(nb)
+            if ok is not None:
+                for j, i in enumerate(keep):
+                    bits[i - unit.start] = bool(ok[j])
+                n_ok += len(keep)
+
+        for start in range(unit.start, unit.stop, chunk):
+            idxs = list(range(start, min(start + chunk, unit.stop)))
+            payloads, exps, keep = await asyncio.to_thread(
+                read_pieces_chunk, storage, info, idxs
+            )
+            if not payloads:
+                continue
+            nb = sum(len(p) for p in payloads)
+            # free budget by draining the oldest outstanding launch
+            # rather than blocking in _acquire_bytes: a unit bigger than
+            # max_inflight_bytes would otherwise deadlock (releases only
+            # happen here, in this coroutine)
+            while futs and (
+                self._inflight_bytes
+                and self._inflight_bytes + nb > self.config.max_inflight_bytes
+            ):
+                await drain_one()
+            await self._acquire_bytes(nb)
+            try:
+                fut = await self.scheduler.enqueue(
+                    self.config.tenant,
+                    payloads,
+                    expected=exps,
+                    algo="sha1",
+                    piece_length=info.piece_length,
+                    wait=True,  # backpressure pauses the read loop
+                )
+            except BaseException:
+                await self._release_bytes(nb)
+                raise
+            futs.append((fut, keep, nb))
+        while futs:
+            await drain_one()
+        self._verdicts.setdefault(uid, {})[self.pid] = bits
+        self._status[uid] = _DONE
+        self._units_done += 1
+        # count pieces actually hashed — unreadable pieces and failed
+        # launches must not inflate the verified gauge or progress
+        self._pieces_verified += n_ok
+        self._unit_times.append(time.monotonic() - self._unit_started.pop(uid))
+        if self.progress_cb:
+            self.progress_cb(self._pieces_verified, self.plan.total_pieces)
+        cfg = self.config
+        if (
+            cfg.fault_exit_after_units is not None
+            and self._units_done >= cfg.fault_exit_after_units
+        ):
+            # deterministic worker-death injection: publish what we have
+            # (so peers adopt only what we did NOT finish), then die at
+            # the unit boundary — no cleanup, like a real SIGKILL
+            if self.transport is not None:
+                await self._heartbeat_once()
+            log.warning(
+                "fabric fault injection: exiting after %d units", self._units_done
+            )
+            os._exit(FAULT_EXIT_CODE)
+
+    # --------------------------------------------------------- heartbeat
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            ok = await self._heartbeat_once()
+            if ok:
+                self._hb_consec_fail = 0
+            else:
+                self._hb_consec_fail += 1
+                if self._hb_consec_fail >= self.config.heartbeat_fail_limit:
+                    # a dead transport (lost shared dir, broken
+                    # collective) must abort the run with a classified
+                    # error, not spin forever on stale state — run()
+                    # re-raises this on its next loop pass
+                    self._hb_fatal = RuntimeError(
+                        f"fabric heartbeat failed {self._hb_consec_fail} "
+                        "consecutive exchanges; aborting the sweep"
+                    )
+                    return
+            if self._covered_published():
+                return
+            await asyncio.sleep(self.config.heartbeat_interval)
+
+    async def _heartbeat_once(self) -> None:
+        self._refresh_degraded()
+        self._seq += 1
+        own = self._own_bits()
+        payload = {
+            "pid": self.pid,
+            "seq": self._seq,
+            "t": time.time(),
+            "fp": self._fp,
+            "degraded": self._degraded,
+            "done": {str(uid): pack_bits(b) for uid, b in own.items()},
+            "inflight": sorted(self._unit_started),
+            "distrust": sorted([p, u] for p, u in self._distrust),
+            "redone": sorted(
+                u for p, u in self._superseded if p == self.pid
+            ),
+        }
+        try:
+            peers = await asyncio.to_thread(self.transport.exchange, payload)
+        except Exception as e:
+            self._hb_errors += 1
+            log.warning("fabric heartbeat exchange failed: %s", e)
+            return False
+        self._last_exchange = time.monotonic()
+        # only after a successful exchange do our verdicts count as
+        # published — the symmetric-coverage condition depends on peers
+        # actually having been able to see them
+        self._published_done = set(own)
+        for p, pl in peers.items():
+            if pl.get("fp") != self._fp:
+                log.warning(
+                    "fabric peer %s heartbeat carries plan %s != ours %s; "
+                    "ignoring (inputs diverged?)", p, pl.get("fp"), self._fp,
+                )
+                continue
+            self._peer_seen[p] = pl
+            seq = int(pl.get("seq", 0))
+            prev = self._peer_advance.get(p)
+            if prev is None or seq != prev[0]:
+                self._peer_advance[p] = (seq, time.monotonic())
+            for pair in pl.get("distrust", []):
+                pair = (int(pair[0]), int(pair[1]))
+                if pair not in self._superseded:
+                    self._distrust.add(pair)
+        await self._merge_and_adopt()
+        self._check_stragglers()
+        return True
+
+    def _peer_age(self, p: int) -> float:
+        """Seconds since we LOCALLY observed this peer's seq advance —
+        monotonic receipt time, never the payload's wall-clock stamp
+        (cross-host clock skew would turn that into permanent false
+        lapses). A never-seen peer ages from our own start."""
+        adv = self._peer_advance.get(p)
+        if adv is None:
+            return time.monotonic() - self._started_mono
+        return time.monotonic() - adv[1]
+
+    def _unavailable(self) -> tuple[set[int], set[int]]:
+        """(lapsed, degraded) peer sets from the latest heartbeat view."""
+        lapsed: set[int] = set()
+        degraded: set[int] = set()
+        for p in range(self.plan.nproc):
+            if p == self.pid:
+                continue
+            if (
+                self.transport.supports_lapse
+                and self._peer_age(p) > self.config.lapse_after
+            ):
+                lapsed.add(p)
+            elif self._peer_seen.get(p, {}).get("degraded"):
+                degraded.add(p)
+        return lapsed, degraded
+
+    async def _merge_and_adopt(self) -> None:
+        cfg = self.config
+        now = time.monotonic()
+        lapsed, degraded = self._unavailable()
+        unavailable = lapsed | degraded
+        survivors = [
+            p
+            for p in range(self.plan.nproc)
+            if p not in unavailable and (p != self.pid or not self._degraded)
+        ]
+        if not survivors:
+            # everyone is degraded/lapsed: progress beats purity — keep
+            # our own units rather than stranding the sweep
+            survivors = [self.pid]
+        # 1. merge published verdicts; verdicts from an unavailable peer
+        # get one sentinel re-hash per (publisher, unit) before trust.
+        # A peer's "redone" list retires a distrusted pair first: the
+        # re-verified verdict replaces the rejected one and goes back
+        # through the sentinel gate like any fresh publication.
+        for p, pl in self._peer_seen.items():
+            for uid_s in pl.get("redone", []):
+                pair = (p, int(uid_s))
+                if pair in self._distrust:
+                    self._distrust.discard(pair)
+                    self._checked.discard(pair)
+                    self._verdicts.get(pair[1], {}).pop(p, None)
+                    self._superseded.add(pair)
+            for uid_s, hexbits in pl.get("done", {}).items():
+                uid = int(uid_s)
+                if p in self._verdicts.get(uid, ()):
+                    continue
+                try:
+                    bits = unpack_bits(hexbits, self.plan.units[uid].npieces)
+                except (ValueError, IndexError):
+                    continue
+                self._verdicts.setdefault(uid, {})[p] = bits
+        # 1b. cross-check foreign verdicts held from any UNAVAILABLE
+        # publisher — including ones accepted while it was still healthy
+        # (the lapse came later): one sentinel re-hash per (publisher,
+        # unit). A mismatch goes on the exchanged distrust list, so
+        # every process drops those verdicts and the unit is re-verified
+        # by a survivor — a degraded or dead worker cannot silently
+        # poison the global bitfield.
+        for uid, pubs in list(self._verdicts.items()):
+            for p in unavailable:
+                if p not in pubs or (p, uid) in self._checked:
+                    continue
+                self._checked.add((p, uid))
+                if not await self._sentinel_check(uid, pubs[p]):
+                    self._sentinel_mismatches += 1
+                    self._distrust.add((p, uid))
+                    log.warning(
+                        "fabric sentinel mismatch on unit %d from peer %d: "
+                        "discarding its verdicts, re-verifying",
+                        uid, p,
+                    )
+        # 2. degraded self: yield unstarted units a survivor will adopt
+        if self._degraded:
+            for uid in list(self._queue):
+                if (
+                    adoption_owner(uid, survivors) != self.pid
+                    and uid not in self._yielded
+                ):
+                    self._yielded[uid] = now
+                    self._queue.remove(uid)
+                    log.warning(
+                        "fabric: yielding unit %d (breaker stuck open)", uid
+                    )
+        # 3. reclaim yields nobody picked up (the adopter lapsed, or we
+        # recovered and the survivor set moved on)
+        reclaim_after = cfg.lapse_after + 2 * cfg.heartbeat_interval
+        inflight_elsewhere: set[int] = set()
+        for p, pl in self._peer_seen.items():
+            if p not in lapsed:
+                inflight_elsewhere.update(int(u) for u in pl.get("inflight", []))
+        for uid, t0 in list(self._yielded.items()):
+            if self._unit_covered(uid):
+                del self._yielded[uid]
+            elif uid in inflight_elsewhere:
+                self._yielded[uid] = now  # someone is on it; keep waiting
+            elif now - t0 > reclaim_after:
+                del self._yielded[uid]
+                self._status[uid] = _PENDING
+                self._queue.append(uid)
+                log.warning("fabric: reclaiming yielded unit %d", uid)
+        # 4. adopt orphans: uncovered units whose responsible process is
+        # unavailable (or whose only verdicts were distrusted), not in
+        # flight on any available peer
+        distrusted_uids = {u for _, u in self._distrust}
+        for u in self.plan.units:
+            uid = u.uid
+            owner = self.plan.owner[uid]
+            orphan = owner in unavailable or uid in distrusted_uids
+            if not orphan or self._unit_covered(uid):
+                continue
+            if uid in inflight_elsewhere:
+                continue  # an alive peer is already verifying it
+            if uid in self._yielded:
+                continue  # we yielded it; reclaim path handles comebacks
+            # never route the re-verify to a survivor whose own verdict
+            # is the distrusted one — its _DONE status would skip the
+            # requeue and the sweep would never converge
+            candidates = [
+                s for s in survivors if (s, uid) not in self._distrust
+            ]
+            if adoption_owner(uid, candidates or survivors) != self.pid:
+                continue
+            if (
+                (self.pid, uid) in self._distrust
+                and self._status.get(uid) == _DONE
+            ):
+                # no untainted candidate left: supersede our own
+                # rejected verdict and re-verify — published as
+                # "redone" so peers retire the distrust pair too
+                self._distrust.discard((self.pid, uid))
+                self._superseded.add((self.pid, uid))
+                self._verdicts.get(uid, {}).pop(self.pid, None)
+            elif self._status.get(uid) in (_PENDING, _INFLIGHT, _DONE):
+                continue  # ours already (queued, running, or done)
+            self._status[uid] = _PENDING
+            self._queue.append(uid)
+            self._units_adopted += 1
+            log.warning(
+                "fabric: adopting unit %d from process %d (%s)",
+                uid, owner,
+                "lapsed" if owner in lapsed else "degraded/distrusted",
+            )
+
+    async def _sentinel_check(self, uid: int, bits: np.ndarray) -> bool:
+        """Re-hash one reportedly-valid piece of a foreign unit against
+        the info dict. All-False verdicts pass vacuously (claiming a
+        piece is BAD cannot poison the bitfield — it only triggers a
+        redownload)."""
+        unit = self.plan.units[uid]
+        true_rows = np.flatnonzero(bits)
+        if not len(true_rows):
+            return True
+        piece = unit.start + int(true_rows[0])
+        storage, info = self.items[unit.torrent]
+
+        def rehash() -> bool:
+            import hashlib
+
+            from torrent_tpu.storage.piece import piece_length
+            from torrent_tpu.storage.storage import StorageError
+
+            try:
+                data = storage.read_piece(piece)
+            except (StorageError, OSError):
+                return False
+            return (
+                len(data) == piece_length(info, piece)
+                and hashlib.sha1(data).digest() == info.pieces[piece]
+            )
+
+        self._sentinel_checks += 1
+        return await asyncio.to_thread(rehash)
+
+    def _refresh_degraded(self) -> None:
+        """Self-diagnose a stuck-open sha1 lane breaker from the
+        scheduler's public snapshot (no private state reached into)."""
+        now = time.monotonic()
+        open_lanes: set[str] = set()
+        for lane, b in self.scheduler.metrics_snapshot()["breakers"].items():
+            if lane.startswith("sha1/") and b["state"] == "open":
+                open_lanes.add(lane)
+                self._breaker_open_since.setdefault(lane, now)
+        for lane in list(self._breaker_open_since):
+            if lane not in open_lanes:
+                del self._breaker_open_since[lane]
+        self._degraded = any(
+            now - since >= self.config.breaker_stuck_after
+            for since in self._breaker_open_since.values()
+        )
+
+    def _check_stragglers(self) -> None:
+        mean = (
+            sum(self._unit_times) / len(self._unit_times)
+            if self._unit_times
+            else 0.0
+        )
+        threshold = max(
+            self.config.straggler_min_s, self.config.straggler_factor * mean
+        )
+        now = time.monotonic()
+        for uid, t0 in self._unit_started.items():
+            if now - t0 > threshold and uid not in self._warned_straggler:
+                self._warned_straggler.add(uid)
+                self._stragglers += 1
+                log.warning(
+                    "fabric straggler: unit %d in flight %.1fs (threshold %.1fs)",
+                    uid, now - t0, threshold,
+                )
+
+    # ----------------------------------------------------------- metrics
+
+    def metrics_snapshot(self) -> dict:
+        """Per-process fabric gauges for utils/metrics.py rendering."""
+        return {
+            "state": self._state,
+            "pid": self.pid,
+            "nproc": self.plan.nproc,
+            "plan_fingerprint": self._fp,
+            "units_total": len(self.plan.units),
+            "shard_units": len(self.plan.units_for(self.pid)),
+            "shard_bytes": self.plan.shard_bytes(self.pid),
+            "units_done": self._units_done,
+            "units_adopted": self._units_adopted,
+            "pieces_verified": self._pieces_verified,
+            "inflight_bytes": self._inflight_bytes,
+            "sentinel_checks": self._sentinel_checks,
+            "sentinel_mismatches": self._sentinel_mismatches,
+            "stragglers": self._stragglers,
+            "heartbeat_errors": self._hb_errors,
+            "heartbeat_age": (
+                time.monotonic() - self._last_exchange
+                if self._last_exchange is not None
+                else time.monotonic() - self._started_mono
+            ),
+            "degraded": self._degraded,
+        }

@@ -1,6 +1,11 @@
 from torrent_tpu.parallel.mesh import make_mesh, batch_sharding, replicated_sharding
 from torrent_tpu.parallel.verify import verify_pieces, verify_pieces_sched, VerifyResult
-from torrent_tpu.parallel.bulk import verify_library, verify_library_sched, LibraryResult
+from torrent_tpu.parallel.bulk import (
+    verify_library,
+    verify_library_fabric,
+    verify_library_sched,
+    LibraryResult,
+)
 from torrent_tpu.parallel.distributed import (
     initialize as init_distributed,
     verify_library_distributed,
@@ -15,6 +20,7 @@ __all__ = [
     "verify_pieces_sched",
     "VerifyResult",
     "verify_library",
+    "verify_library_fabric",
     "verify_library_sched",
     "LibraryResult",
     "init_distributed",

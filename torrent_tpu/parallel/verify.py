@@ -237,6 +237,30 @@ def verify_pieces_v2_tpu(
     return bitfield
 
 
+def read_pieces_chunk(storage: Storage, info: InfoDict, idxs):
+    """Read a chunk of pieces with mark-and-continue semantics.
+
+    Returns ``(payloads, expected, keep)`` — a torn/unreadable/short
+    piece is skipped (stays False in the caller's bitfield) instead of
+    aborting, the same contract as ``verify_pieces_cpu``; OSError too,
+    because a backend that leaks a raw errno (file truncated between
+    open and pread) must not kill the pass. The ONE implementation of
+    the read/filter/keep contract, shared by the scheduler sessions
+    here and the fabric executor (``torrent_tpu/fabric``)."""
+    payloads, exps, keep = [], [], []
+    for i in idxs:
+        try:
+            data = storage.read_piece(i)
+        except (StorageError, OSError):
+            continue
+        if len(data) != piece_length(info, i):
+            continue
+        payloads.append(data)
+        exps.append(info.pieces[i])
+        keep.append(i)
+    return payloads, exps, keep
+
+
 async def enqueue_torrent_sched(
     storage: Storage,
     info: InfoDict,
@@ -259,29 +283,12 @@ async def enqueue_torrent_sched(
 
     chunk = chunk_pieces or scheduler.chunk_for(info.piece_length)
 
-    def read_chunk(idxs: list[int]):
-        payloads, exps, keep = [], [], []
-        for i in idxs:
-            # mark-and-continue, same as the CPU path (verify_pieces_cpu):
-            # a torn/unreadable piece mid-recheck stays False in the
-            # caller's bitfield instead of aborting every other piece.
-            # OSError too — a backend that leaks a raw errno (file
-            # truncated between open and pread) must not kill the pass.
-            try:
-                data = storage.read_piece(i)
-            except (StorageError, OSError):
-                continue
-            if len(data) != piece_length(info, i):
-                continue
-            payloads.append(data)
-            exps.append(info.pieces[i])
-            keep.append(i)
-        return payloads, exps, keep
-
     futs: list[tuple] = []
     for start in range(0, info.num_pieces, chunk):
         idxs = list(range(start, min(start + chunk, info.num_pieces)))
-        payloads, exps, keep = await asyncio.to_thread(read_chunk, idxs)
+        payloads, exps, keep = await asyncio.to_thread(
+            read_pieces_chunk, storage, info, idxs
+        )
         if not payloads:
             continue
         fut = await scheduler.enqueue(

@@ -10,6 +10,9 @@ One multiplexed entry point over the whole framework::
     torrent-tpu bridge   [--port P] [--hasher cpu|tpu] [--batch-target N]
                          [--flush-deadline-ms MS] [--max-queue-mb MB] [--tenant-max-mb MB]
                          [--dev --fault-plan SPEC]
+    torrent-tpu fabric-verify TORRENTS_DIR DATA_ROOT
+                         [--coordinator HOST:PORT --num-processes N --process-id I]
+                         [--cpu-devices K] [--heartbeat-dir DIR] [--hasher cpu|tpu]
 
 ``download`` accepts either a ``.torrent`` file or a ``magnet:?...`` URI
 (BEP 9 metadata fetch). Also runnable as ``python -m torrent_tpu``.
@@ -860,6 +863,155 @@ def _cmd_sign(args) -> int:
     return 0
 
 
+async def _fabric_verify(args) -> int:
+    """One process of a pod-scale scheduler-fed library recheck
+    (torrent_tpu/fabric). Mirrors tests/distributed_worker.py's process
+    flags: ``--coordinator/--num-processes/--process-id`` join a real
+    ``jax.distributed`` cluster (``--cpu-devices K`` pins K virtual CPU
+    devices first, for CPU test rigs); ``--num-processes/--process-id``
+    WITHOUT a coordinator runs over the shared-filesystem heartbeat
+    transport (``--heartbeat-dir``) with no collective at all — the
+    mode that survives a killed worker via lapse adoption."""
+    import glob
+    import json
+
+    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.storage.storage import FsStorage, Storage
+
+    if args.cpu_devices:
+        # stage the XLA flag BEFORE jax import: on jax < 0.5 (no
+        # jax_num_cpu_devices config) the virtual CPU device count is
+        # parsed once at backend init (same shim as __graft_entry__)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.cpu_devices}"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:  # newer jax: the config knob exists and wins
+            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        except AttributeError:
+            pass
+    nproc, pid = args.num_processes, args.process_id
+    if (nproc is None) != (pid is None):
+        print(
+            "error: --num-processes and --process-id go together",
+            file=sys.stderr,
+        )
+        return 2
+    if args.coordinator:
+        if nproc is None:
+            print(
+                "error: --coordinator needs --num-processes and --process-id",
+                file=sys.stderr,
+            )
+            return 2
+        from torrent_tpu.parallel.distributed import initialize
+
+        initialize(args.coordinator, nproc, pid)
+    if nproc is not None and nproc > 1 and not (
+        args.coordinator or args.heartbeat_dir
+    ):
+        print(
+            "error: multi-process fabric needs a transport: --coordinator "
+            "(jax.distributed allgather) or --heartbeat-dir (shared "
+            "filesystem)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.die_after_units is not None and not args.heartbeat_dir:
+        print(
+            "error: --die-after-units needs --heartbeat-dir (file transport)",
+            file=sys.stderr,
+        )
+        return 2
+
+    torrent_files = sorted(glob.glob(os.path.join(args.torrents, "*.torrent")))
+    if not torrent_files:
+        print(f"error: no .torrent files in {args.torrents!r}", file=sys.stderr)
+        return 1
+    items = []
+    for tf in torrent_files:
+        with open(tf, "rb") as f:
+            meta = parse_metainfo(f.read())
+        if meta is None:
+            print(f"skipping {tf}: not a v1 .torrent (fabric is sha1-plane)",
+                  file=sys.stderr)
+            continue
+        stem = os.path.splitext(os.path.basename(tf))[0]
+        root = os.path.join(args.data, stem)
+        if not os.path.isdir(root):
+            root = args.data
+        items.append((Storage(FsStorage(root), meta.info), meta.info))
+    if not items:
+        print("error: nothing to verify", file=sys.stderr)
+        return 1
+
+    from torrent_tpu.fabric import FabricConfig
+    from torrent_tpu.parallel.bulk import verify_library_fabric
+    from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
+
+    sched = await HashPlaneScheduler(
+        SchedulerConfig(batch_target=args.batch_target), hasher=args.hasher
+    ).start()
+    cfg = FabricConfig(
+        heartbeat_interval=args.heartbeat_interval,
+        lapse_after=args.lapse_after,
+        fault_exit_after_units=args.die_after_units,
+    )
+    executors: list = []
+    try:
+        res = await verify_library_fabric(
+            items,
+            sched,
+            nproc=nproc,
+            pid=pid,
+            heartbeat_dir=args.heartbeat_dir,
+            fabric_config=cfg,
+            unit_bytes=(args.unit_mb << 20) if args.unit_mb else None,
+            executor_out=executors,
+        )
+    finally:
+        await sched.close()
+    snap = executors[0].metrics_snapshot()
+    payload = {
+        "pid": snap["pid"],
+        "nproc": snap["nproc"],
+        "plan": snap["plan_fingerprint"],
+        "bitfields": [
+            "".join("1" if b else "0" for b in bf) for bf in res.bitfields
+        ],
+        "n_valid": int(sum(bf.sum() for bf in res.bitfields)),
+        "n_pieces": res.n_pieces,
+        "shard_units": snap["shard_units"],
+        "shard_bytes": snap["shard_bytes"],
+        "units_done": snap["units_done"],
+        "units_adopted": snap["units_adopted"],
+        "pieces_verified": snap["pieces_verified"],
+        "sentinel_checks": snap["sentinel_checks"],
+        "sentinel_mismatches": snap["sentinel_mismatches"],
+        "stragglers": snap["stragglers"],
+        "seconds": res.seconds,
+    }
+    line = json.dumps(payload)
+    if args.result_file:
+        # atomic, same contract as tests/distributed_worker.py's _emit:
+        # concurrent C++/runtime stdout noise can garble the print
+        tmp = args.result_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(line)
+        os.replace(tmp, args.result_file)
+    print(line)
+    return 0 if payload["n_valid"] == payload["n_pieces"] else 2
+
+
+def _cmd_fabric_verify(args) -> int:
+    return asyncio.run(_fabric_verify(args))
+
+
 def _cmd_doctor(args) -> int:
     # run_cli, not main: the triage tool must not run its checks inside
     # an interpreter wired to the device plugin it is triaging — it
@@ -870,6 +1022,8 @@ def _cmd_doctor(args) -> int:
     argv = ["--device-wait", str(args.device_wait)]
     if args.skip_swarm:
         argv.append("--skip-swarm")
+    if getattr(args, "fabric", False):
+        argv.append("--fabric")
     if getattr(args, "json", False):
         argv.append("--json")
     return doctor_cli(argv)
@@ -1436,10 +1590,48 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=_cmd_seed)
 
     sp = sub.add_parser(
+        "fabric-verify",
+        help="one process of a pod-scale scheduler-fed library recheck",
+    )
+    sp.add_argument("torrents", help="directory of .torrent files")
+    sp.add_argument("data", help="data root (per-torrent subdir or flat)")
+    sp.add_argument("--hasher", choices=("cpu", "tpu"), default="cpu")
+    sp.add_argument("--batch-target", type=int, default=256,
+                    help="scheduler pieces-per-launch target")
+    sp.add_argument("--coordinator", metavar="HOST:PORT",
+                    help="jax.distributed coordinator (mirrors "
+                    "tests/distributed_worker.py; enables the DCN "
+                    "allgather heartbeat)")
+    sp.add_argument("--num-processes", type=int, default=None)
+    sp.add_argument("--process-id", type=int, default=None)
+    sp.add_argument("--cpu-devices", type=int, default=0, metavar="K",
+                    help="pin K virtual CPU devices before backend init "
+                    "(jax_num_cpu_devices; CPU test rigs)")
+    sp.add_argument("--heartbeat-dir", default=None, metavar="DIR",
+                    help="shared-filesystem heartbeat transport (lapse "
+                    "adoption; no jax.distributed needed)")
+    sp.add_argument("--heartbeat-interval", type=float, default=0.5)
+    sp.add_argument("--lapse-after", type=float, default=5.0,
+                    help="seconds of heartbeat silence before a peer's "
+                    "units are adopted (file transport)")
+    sp.add_argument("--unit-mb", type=int, default=0,
+                    help="work-unit size bound in MiB (0 = default 64)")
+    sp.add_argument("--result-file", default=None,
+                    help="also write the JSON result line here (atomic)")
+    # deterministic worker-death injection for doctor --fabric / tests
+    sp.add_argument("--die-after-units", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    sp.set_defaults(fn=_cmd_fabric_verify)
+
+    sp = sub.add_parser(
         "doctor", help="environment triage: deps, device, kernels, swarm smoke"
     )
     sp.add_argument("--device-wait", type=float, default=20.0)
     sp.add_argument("--skip-swarm", action="store_true")
+    sp.add_argument("--fabric", action="store_true",
+                    help="also run the verify-fabric self-test: two local "
+                    "worker processes plan/execute/heartbeat, one dies "
+                    "mid-run, the survivor adopts its shard")
     sp.add_argument("--json", action="store_true",
                     help="emit a machine-readable JSON summary line")
     sp.set_defaults(fn=_cmd_doctor)

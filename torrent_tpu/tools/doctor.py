@@ -510,6 +510,99 @@ async def _v2_smoke() -> str:
         await sched.close()
 
 
+def _fabric_smoke(tmp: str) -> str:
+    """Verify-fabric self-test (``--fabric``): a tiny two-torrent
+    library, TWO real fabric-verify worker subprocesses over the
+    shared-directory heartbeat transport (explicit process ids — no
+    jax.distributed), worker 1 fault-injected to die after its first
+    unit. Worker 0 must watch the heartbeat lapse, adopt the orphaned
+    units, sentinel-cross-check the dead worker's published verdicts,
+    and finish with every piece verified — plan → execute → heartbeat →
+    adopt, end to end. Returns the per-process shard stats line."""
+    import json
+
+    import numpy as np
+
+    from torrent_tpu.tools.make_torrent import make_torrent
+
+    plen = 16384
+    rng = np.random.default_rng(3)
+    tdir = os.path.join(tmp, "torrents")
+    ddir = os.path.join(tmp, "data")
+    os.makedirs(tdir)
+    # 96 + 160 pieces at 16 KiB = 5 one-MiB work units across 2 workers
+    for t, npieces in enumerate((96, 160)):
+        root = os.path.join(ddir, f"fab{t}")
+        os.makedirs(root)
+        payload = os.path.join(root, "payload.bin")
+        with open(payload, "wb") as f:
+            f.write(
+                rng.integers(
+                    0, 256, (npieces - 1) * plen + plen // 3, dtype=np.uint8
+                ).tobytes()
+            )
+        with open(os.path.join(tdir, f"fab{t}.torrent"), "wb") as f:
+            f.write(
+                make_torrent(payload, "http://t.invalid/announce", piece_length=plen)
+            )
+    hb = os.path.join(tmp, "hb")
+    env = dict(os.environ)
+    env.pop(_AXON_VAR, None)  # workers must never register a device plugin
+    env["JAX_PLATFORMS"] = "cpu"
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    workers = []
+    for p in range(2):
+        cmd = [
+            sys.executable, "-m", "torrent_tpu", "fabric-verify", tdir, ddir,
+            "--hasher", "cpu", "--num-processes", "2", "--process-id", str(p),
+            "--heartbeat-dir", hb, "--heartbeat-interval", "0.1",
+            "--lapse-after", "1.0", "--unit-mb", "1", "--batch-target", "64",
+            "--result-file", os.path.join(tmp, f"result_{p}.json"),
+        ]
+        if p == 1:
+            cmd += ["--die-after-units", "1"]
+        workers.append(
+            subprocess.Popen(
+                cmd, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+        )
+    try:
+        for p, w in enumerate(workers):
+            _, err = w.communicate(timeout=180)
+            if p == 0:
+                assert w.returncode == 0, f"worker 0 failed:\n{err[-2000:]}"
+            else:
+                from torrent_tpu.fabric import FAULT_EXIT_CODE
+
+                assert w.returncode == FAULT_EXIT_CODE, (
+                    f"worker 1 should die with the fault code, got "
+                    f"{w.returncode}:\n{err[-2000:]}"
+                )
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.communicate()
+    with open(os.path.join(tmp, "result_0.json")) as f:
+        rec = json.load(f)
+    assert rec["n_valid"] == rec["n_pieces"], (
+        f"survivor left pieces unverified: {rec['n_valid']}/{rec['n_pieces']}"
+    )
+    assert rec["units_adopted"] >= 1, f"no units adopted: {rec}"
+    assert rec["sentinel_checks"] >= 1, f"no sentinel cross-check ran: {rec}"
+    assert rec["sentinel_mismatches"] == 0, rec
+    return (
+        f"worker1 died after 1 unit; survivor shard {rec['shard_units']}u/"
+        f"{rec['shard_bytes'] >> 20}MiB + {rec['units_adopted']} adopted, "
+        f"{rec['sentinel_checks']} sentinel checks, "
+        f"{rec['n_valid']}/{rec['n_pieces']} pieces valid (plan {rec['plan']})"
+    )
+
+
 async def _bridge_smoke() -> None:
     from torrent_tpu.bridge.service import BridgeServer
     from torrent_tpu.codec.bencode import bdecode, bencode
@@ -572,6 +665,13 @@ def main(argv=None) -> int:
         action="store_true",
         help="also run the BEP 52 plane smoke: leaf + merkle-pair digests vs "
         "hashlib through the scheduler's pallas sha256 lane (interpret-safe)",
+    )
+    ap.add_argument(
+        "--fabric",
+        action="store_true",
+        help="also run the verify-fabric self-test: two local worker "
+        "processes plan/execute/heartbeat over a shared directory, one "
+        "dies mid-run, the survivor adopts and sentinel-checks its shard",
     )
     ap.add_argument(
         "--json",
@@ -640,6 +740,14 @@ def main(argv=None) -> int:
             _report("PASS", "v2 hash plane", detail)
         except Exception as e:
             _report("FAIL", "v2 hash plane", repr(e))
+    if args.fabric:
+        with tempfile.TemporaryDirectory(prefix="doctor_fabric_") as tmp:
+            try:
+                # bounded by the workers' communicate(timeout) inside
+                detail = _fabric_smoke(tmp)
+                _report("PASS", "verify fabric", detail)
+            except Exception as e:
+                _report("FAIL", "verify fabric", repr(e))
     try:
         asyncio.run(asyncio.wait_for(_bridge_smoke(), 30))
         _report("PASS", "bridge", "/v1/digests round-trip")

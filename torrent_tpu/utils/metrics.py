@@ -144,6 +144,55 @@ def render_sched_metrics(sched) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_fabric_metrics(snapshot: dict) -> str:
+    """Prometheus rendering of one process's verify-fabric gauges.
+
+    ``snapshot`` is a ``torrent_tpu.fabric.FabricExecutor.
+    metrics_snapshot()`` dict. Appended to the bridge's ``/metrics``
+    while a fabric job exists, labeled by the process id so a pod-wide
+    scrape distinguishes shards."""
+    s = snapshot
+    pid = f'pid="{s["pid"]}"'
+    states = {"idle": 0, "running": 1, "done": 2, "failed": 3}
+    lines = [
+        "# HELP torrent_tpu_fabric_state Fabric executor state "
+        "(0=idle 1=running 2=done 3=failed)",
+        "# TYPE torrent_tpu_fabric_state gauge",
+        f"torrent_tpu_fabric_state{{{pid}}} {states.get(s['state'], 3)}",
+        "# HELP torrent_tpu_fabric_shard_bytes Payload bytes planned onto this process",
+        "# TYPE torrent_tpu_fabric_shard_bytes gauge",
+        f"torrent_tpu_fabric_shard_bytes{{{pid}}} {s['shard_bytes']}",
+        "# HELP torrent_tpu_fabric_units Work units by disposition for this process",
+        "# TYPE torrent_tpu_fabric_units gauge",
+        f'torrent_tpu_fabric_units{{{pid},kind="planned"}} {s["shard_units"]}',
+        f'torrent_tpu_fabric_units{{{pid},kind="done"}} {s["units_done"]}',
+        f'torrent_tpu_fabric_units{{{pid},kind="adopted"}} {s["units_adopted"]}',
+        f'torrent_tpu_fabric_units{{{pid},kind="total"}} {s["units_total"]}',
+        "# HELP torrent_tpu_fabric_pieces_verified_total Pieces this process verified",
+        "# TYPE torrent_tpu_fabric_pieces_verified_total counter",
+        f"torrent_tpu_fabric_pieces_verified_total{{{pid}}} {s['pieces_verified']}",
+        "# HELP torrent_tpu_fabric_inflight_bytes Payload bytes in scheduler futures",
+        "# TYPE torrent_tpu_fabric_inflight_bytes gauge",
+        f"torrent_tpu_fabric_inflight_bytes{{{pid}}} {s['inflight_bytes']}",
+        "# HELP torrent_tpu_fabric_heartbeat_age_seconds Seconds since the last successful heartbeat exchange",
+        "# TYPE torrent_tpu_fabric_heartbeat_age_seconds gauge",
+        f"torrent_tpu_fabric_heartbeat_age_seconds{{{pid}}} {s['heartbeat_age']:.3f}",
+        "# HELP torrent_tpu_fabric_sentinel_checks_total Adopted-unit verdicts cross-checked by a sentinel re-hash",
+        "# TYPE torrent_tpu_fabric_sentinel_checks_total counter",
+        f"torrent_tpu_fabric_sentinel_checks_total{{{pid}}} {s['sentinel_checks']}",
+        "# HELP torrent_tpu_fabric_sentinel_mismatches_total Foreign verdicts rejected by the sentinel cross-check",
+        "# TYPE torrent_tpu_fabric_sentinel_mismatches_total counter",
+        f"torrent_tpu_fabric_sentinel_mismatches_total{{{pid}}} {s['sentinel_mismatches']}",
+        "# HELP torrent_tpu_fabric_stragglers_total Units flagged in flight past the straggler threshold",
+        "# TYPE torrent_tpu_fabric_stragglers_total counter",
+        f"torrent_tpu_fabric_stragglers_total{{{pid}}} {s['stragglers']}",
+        "# HELP torrent_tpu_fabric_degraded Breaker-stuck degradation flag (unstarted units yielded)",
+        "# TYPE torrent_tpu_fabric_degraded gauge",
+        f"torrent_tpu_fabric_degraded{{{pid}}} {1 if s['degraded'] else 0}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
 def render_metrics(client) -> str:
     """The /metrics payload for one Client (Prometheus text format 0.0.4).
 

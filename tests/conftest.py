@@ -27,6 +27,35 @@ import signal
 
 import pytest
 
+# Concurrency sanitizer (tsan-lite): with TORRENT_TPU_TSAN=1 every
+# named_lock the package creates is instrumented, so the whole suite
+# doubles as a concurrency test. Enable BEFORE any torrent_tpu module
+# import — module-level locks (native/io_engine) are created at import
+# time and only locks created after enabling are sanitized.
+_TSAN = os.environ.get("TORRENT_TPU_TSAN", "") in ("1", "true")
+if _TSAN:
+    from torrent_tpu.analysis import sanitizer as _tsan
+
+    _tsan.enable()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Under TSAN, a lock-order cycle observed anywhere in the run
+    fails the session even if every individual test passed."""
+    if not _TSAN:
+        return
+    snap = _tsan.snapshot()
+    rep = (
+        f"tsan: {len(snap['locks'])} locks, {snap['edges']} order edges, "
+        f"{len(snap['cycles'])} cycles, {snap['loop_stalls']} loop stalls "
+        f"(max {snap['loop_stall_max_s']:.3f}s), {snap['long_holds']} long holds"
+    )
+    print(f"\n{rep}")
+    if snap["cycles"]:
+        for cyc in snap["cycles"]:
+            print(f"tsan: LOCK-ORDER CYCLE: {' -> '.join(cyc + cyc[:1])}")
+        session.exitstatus = 3
+
 REFERENCE_FIXTURES = pathlib.Path("/root/reference/test_data")
 
 

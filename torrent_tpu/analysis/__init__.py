@@ -1,0 +1,57 @@
+"""Concurrency sanitizer & invariant lint plane.
+
+Two halves, one contract — the concurrency invariants the hash plane is
+built on are machine-checked, not review-enforced:
+
+* **Static** (``analysis/passes/``): four AST passes over the package —
+  ``lock-order`` (acquisition-graph cycles + the documented partial
+  order ``build_lock → lock → _device_lock``, ``_counter_lock`` leaf),
+  ``blocking-in-async`` (no sync stalls on serving loops),
+  ``device-under-lock`` (only ``_device_lock`` guards plane entry),
+  ``determinism`` (bit-stable bytes where fabric processes must agree).
+  Gated by ``torrent-tpu lint`` against ``analysis_baseline.json``.
+* **Dynamic** (``analysis/sanitizer.py``): tsan-lite. Under
+  ``TORRENT_TPU_TSAN=1`` every :func:`named_lock` is instrumented —
+  dynamic lock-order graph with cycle detection, wait/hold accounting
+  (→ ``/metrics``), a hold-time watchdog, and an event-loop stall
+  monitor. ``tests/conftest.py`` wires it into the whole suite.
+"""
+
+# The sanitizer is imported by every module that creates a named_lock,
+# so this package __init__ must stay a leaf: the AST pass machinery
+# (Finding, run_passes, ALL_PASS_NAMES) is loaded lazily on first
+# attribute access (PEP 562), never at runtime-lock-construction time.
+from torrent_tpu.analysis.sanitizer import (
+    SanitizedLock,
+    enable as enable_tsan,
+    is_enabled as tsan_is_enabled,
+    named_lock,
+    snapshot as tsan_snapshot,
+)
+
+__all__ = [
+    "ALL_PASS_NAMES",
+    "Finding",
+    "SanitizedLock",
+    "enable_tsan",
+    "named_lock",
+    "run_passes",
+    "tsan_is_enabled",
+    "tsan_snapshot",
+]
+
+_LAZY = {
+    "Finding": ("torrent_tpu.analysis.findings", "Finding"),
+    "run_passes": ("torrent_tpu.analysis.passes", "run_passes"),
+    "ALL_PASS_NAMES": ("torrent_tpu.analysis.passes", "ALL_PASS_NAMES"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        module, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    return getattr(importlib.import_module(module), attr)

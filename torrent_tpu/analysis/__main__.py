@@ -1,0 +1,5 @@
+"""``python -m torrent_tpu.analysis`` — the lint gate."""
+
+from torrent_tpu.analysis.lint import main
+
+raise SystemExit(main())

@@ -1,0 +1,114 @@
+"""Finding model + committed-baseline bookkeeping for the lint plane.
+
+A :class:`Finding` is one violation one pass raised at one site. Its
+identity (:attr:`Finding.key`) deliberately excludes the line number:
+baselines must survive unrelated edits above a finding, so the key is
+``pass::path::symbol::message`` — stable until the finding itself moves
+to a different function or changes meaning.
+
+The committed baseline (``torrent_tpu/analysis_baseline.json``, shipped
+as package data) records the findings the tree currently carries *on
+purpose*, each with a human justification string. The lint gate fails only on findings NOT
+in the baseline — new hazards — so the suite stays green while the
+debt list stays visible and reviewed.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation raised by one analysis pass."""
+
+    pass_name: str  # e.g. "lock-order"
+    path: str       # repo-relative posix path, e.g. "torrent_tpu/sched/scheduler.py"
+    line: int       # 1-based; informational only (not part of the key)
+    symbol: str     # enclosing qualname ("Class.method", "<module>")
+    message: str    # stable description — no line numbers, no volatile state
+
+    @property
+    def key(self) -> str:
+        return f"{self.pass_name}::{self.path}::{self.symbol}::{self.message}"
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_name}] {self.message} ({self.symbol})"
+
+
+@dataclass
+class BaselineEntry:
+    """One accepted finding with its review justification."""
+
+    pass_name: str
+    path: str
+    symbol: str
+    message: str
+    justification: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.pass_name}::{self.path}::{self.symbol}::{self.message}"
+
+
+@dataclass
+class BaselineDiff:
+    new: list = field(default_factory=list)        # Findings not in baseline -> gate fails
+    known: list = field(default_factory=list)      # Findings covered by baseline
+    stale: list = field(default_factory=list)      # BaselineEntries no current finding matches
+
+
+def load_baseline(path) -> dict[str, BaselineEntry]:
+    """Baseline file -> {key: entry}. A missing file is an empty
+    baseline (every finding is new), not an error."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return {}
+    entries = {}
+    for raw in doc.get("findings", []):
+        e = BaselineEntry(
+            pass_name=raw["pass"],
+            path=raw["path"],
+            symbol=raw["symbol"],
+            message=raw["message"],
+            justification=raw.get("justification", ""),
+        )
+        entries[e.key] = e
+    return entries
+
+
+def diff_baseline(findings, baseline: dict[str, BaselineEntry]) -> BaselineDiff:
+    diff = BaselineDiff()
+    seen: set[str] = set()
+    for f in findings:
+        seen.add(f.key)
+        (diff.known if f.key in baseline else diff.new).append(f)
+    diff.stale = [e for k, e in baseline.items() if k not in seen]
+    return diff
+
+
+def save_baseline(findings, path, keep: dict[str, BaselineEntry] | None = None) -> None:
+    """Write the baseline for ``findings``, preserving justification
+    strings from ``keep`` (the previous baseline) where keys match."""
+    keep = keep or {}
+    out, emitted = [], set()
+    for f in sorted(findings, key=lambda f: (f.path, f.pass_name, f.symbol, f.message)):
+        if f.key in emitted:  # two sites of the same finding share one entry
+            continue
+        emitted.add(f.key)
+        prev = keep.get(f.key)
+        out.append(
+            {
+                "pass": f.pass_name,
+                "path": f.path,
+                "symbol": f.symbol,
+                "message": f.message,
+                "justification": prev.justification if prev else "TODO: justify or fix",
+            }
+        )
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "findings": out}, fh, indent=2)
+        fh.write("\n")

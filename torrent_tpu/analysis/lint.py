@@ -1,0 +1,145 @@
+"""``torrent-tpu lint`` / ``python -m torrent_tpu.analysis`` — the gate.
+
+Runs the four analysis passes over the package and compares the
+findings against the committed baseline (``torrent_tpu/
+analysis_baseline.json``): exit 0 when every finding is baselined (each baseline
+entry carries a reviewed justification), exit 1 on any NEW finding.
+Stale baseline entries (the finding was fixed) are reported but do not
+fail — refresh with ``--update-baseline``.
+
+    torrent-tpu lint                      # gate against the baseline
+    torrent-tpu lint --json               # machine-readable findings
+    torrent-tpu lint --graph              # dump the lock-order graph
+    torrent-tpu lint --update-baseline    # re-baseline (keeps justifications)
+    torrent-tpu lint --no-baseline        # raw findings, exit 1 if any
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from torrent_tpu.analysis.findings import (
+    diff_baseline,
+    load_baseline,
+    save_baseline,
+)
+from torrent_tpu.analysis.passes import ALL_PASS_NAMES, run_passes
+from torrent_tpu.analysis.passes import lock_order as _lock_order
+
+
+def default_root() -> Path:
+    import torrent_tpu
+
+    return Path(torrent_tpu.__file__).resolve().parent
+
+
+def default_baseline(root: Path) -> Path:
+    # inside the package (shipped as package data), so the gate works
+    # on pip installs as well as source checkouts
+    return root / "analysis_baseline.json"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="torrent-tpu lint",
+        description="concurrency/invariant static analysis over torrent_tpu",
+    )
+    ap.add_argument(
+        "--root", default=None,
+        help="package directory to lint (default: the installed torrent_tpu)",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON path (default: analysis_baseline.json inside the package)",
+    )
+    ap.add_argument(
+        "--passes", default=None, metavar="A,B",
+        help=f"comma-separated subset of: {', '.join(ALL_PASS_NAMES)}",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report raw findings, exit 1 if any",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from current findings (justifications "
+        "on unchanged entries are preserved; new entries get a TODO)",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON findings report")
+    ap.add_argument(
+        "--graph", action="store_true",
+        help="also dump the static lock-acquisition graph",
+    )
+    args = ap.parse_args(argv)
+
+    root = Path(args.root) if args.root else default_root()
+    if not root.is_dir():
+        print(f"error: {root} is not a directory", file=sys.stderr)
+        return 2
+    baseline_path = Path(args.baseline) if args.baseline else default_baseline(root)
+    pass_names = (
+        [p.strip() for p in args.passes.split(",") if p.strip()]
+        if args.passes
+        else None
+    )
+    try:
+        findings, index = run_passes(root, pass_names)
+    except (SyntaxError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.graph:
+        print("# static lock-acquisition graph")
+        print(_lock_order.render_graph(index) or "(no edges)")
+        print()
+
+    if args.update_baseline:
+        if pass_names is not None:
+            # a subset run only produced a subset of findings — writing
+            # it would silently delete every other pass's entries (and
+            # their reviewed justifications)
+            print(
+                "error: --update-baseline requires a full run "
+                "(drop --passes)",
+                file=sys.stderr,
+            )
+            return 2
+        prev = load_baseline(baseline_path)
+        save_baseline(findings, baseline_path, keep=prev)
+        print(f"baseline written: {baseline_path} ({len(findings)} findings)")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    diff = diff_baseline(findings, baseline)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "ok": not diff.new,
+                    "new": [f.__dict__ for f in diff.new],
+                    "baselined": [f.__dict__ for f in diff.known],
+                    "stale_baseline": [e.__dict__ for e in diff.stale],
+                }
+            )
+        )
+        return 1 if diff.new else 0
+
+    for f in diff.new:
+        print(f"NEW  {f.format()}")
+    if diff.stale:
+        for e in diff.stale:
+            print(f"stale baseline entry (fixed?): {e.key}")
+    print(
+        f"lint: {len(findings)} finding(s) — {len(diff.known)} baselined, "
+        f"{len(diff.new)} new, {len(diff.stale)} stale baseline entr"
+        f"{'y' if len(diff.stale) == 1 else 'ies'}"
+    )
+    return 1 if diff.new else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - module entrypoint
+    raise SystemExit(main())

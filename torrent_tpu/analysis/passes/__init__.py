@@ -1,0 +1,69 @@
+"""Pass registry: named analysis passes over a parsed package.
+
+Each pass module exposes ``PASS_NAME`` and ``run(index, files) ->
+list[Finding]``. ``run_passes`` is the one entry point: it parses the
+package once, builds the shared :class:`~.common.PackageIndex`, and
+runs the requested passes over it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from pathlib import Path
+
+from torrent_tpu.analysis.passes import (
+    blocking_async,
+    determinism,
+    device_under_lock,
+    lock_order,
+)
+from torrent_tpu.analysis.passes.common import ModuleFile, PackageIndex
+
+PASSES = {
+    lock_order.PASS_NAME: lock_order,
+    blocking_async.PASS_NAME: blocking_async,
+    device_under_lock.PASS_NAME: device_under_lock,
+    determinism.PASS_NAME: determinism,
+}
+
+ALL_PASS_NAMES = tuple(PASSES)
+
+
+def load_package(root) -> PackageIndex:
+    """Parse every ``*.py`` under ``root`` into a PackageIndex. Paths
+    are recorded relative to ``root``'s parent ("torrent_tpu/…"), the
+    stable form baseline keys use."""
+    root = Path(root)
+    base = root.parent
+    files: list[ModuleFile] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = Path(dirpath) / name
+            rel = path.relative_to(base).as_posix()
+            source = path.read_text()
+            try:
+                tree = ast.parse(source, filename=str(path))
+            except SyntaxError as e:  # a broken file is its own problem
+                raise SyntaxError(f"{rel}: {e}") from e
+            files.append(ModuleFile(rel, tree, source))
+    return PackageIndex(files)
+
+
+def run_passes(root, pass_names=None):
+    """Run the named passes (default: all) over the package at ``root``.
+    Returns (findings, index)."""
+    names = list(pass_names or ALL_PASS_NAMES)
+    for n in names:
+        if n not in PASSES:
+            raise ValueError(
+                f"unknown pass {n!r} (known: {', '.join(ALL_PASS_NAMES)})"
+            )
+    index = load_package(root)
+    findings = []
+    for n in names:
+        findings.extend(PASSES[n].run(index, index.files))
+    return findings, index

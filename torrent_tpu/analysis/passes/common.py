@@ -1,0 +1,365 @@
+"""Shared AST machinery for the analysis passes.
+
+The passes all reason about the same three facts, so they are computed
+once per lint run in a :class:`PackageIndex`:
+
+* **who acquires what** — every ``with <lock>`` / ``<lock>.acquire()``
+  site, with the set of locks already held at that point (nested
+  ``with`` scopes plus linear ``acquire()``/``release()`` tracking);
+* **who calls whom** — every call site, with the held-set at the call,
+  resolved conservatively (see below) so lock acquisitions and device
+  entries propagate through one level of indirection and beyond via a
+  fixpoint;
+* **who enters the device** — calls that dispatch compiled work
+  (``digest_batch``, the pallas kernels, ``jnp.*`` / ``jax.*`` rooted
+  calls, collectives).
+
+Lock identity is the *attribute name* (``_device_lock``,
+``build_lock``, ``_counter_lock`` …): instances of a lane's
+``build_lock`` are interchangeable for ordering purposes, and the
+documented partial order is written in exactly these names.
+Anything whose name ends in ``lock`` (case-insensitive) is a lock;
+``async with`` items are asyncio locks — a different (loop-confined)
+discipline — and are excluded from the thread-lock graph.
+
+Call resolution is deliberately conservative: ``self.m()`` resolves
+within the enclosing class; a bare ``Name()`` call resolves to a
+same-module function, a package-unique function, or a class's
+``__init__``; any other attribute call resolves only if the method
+name is unique across the package. Ambiguous names (``run``, ``set``…)
+are NOT traversed — the static pass under-approximates there and the
+runtime sanitizer (``analysis/sanitizer.py``) is the ground truth.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+# Calls that enter the device plane (jit dispatch / kernel launch /
+# collective). Tail-name matches; plus any call rooted at jnp./jax.
+DEVICE_CALL_NAMES = {
+    "digest_batch",
+    "verify_batch",
+    "sha256_pieces_pallas",
+    "sha1_pieces_pallas",
+    "hash_pieces",
+    "process_allgather",
+    "block_until_ready",
+    "device_put",
+}
+DEVICE_ROOTS = ("jnp", "jax")
+
+
+def dotted_name(expr) -> str | None:
+    """Full dotted chain of a Name/Attribute expr ('jax.devices'), or
+    None when the chain bottoms out in a call/subscript."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return None
+    parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+def tail_name(expr) -> str | None:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def lock_name_of(expr) -> str | None:
+    """Canonical lock name of a with-item / acquire receiver, or None."""
+    name = tail_name(expr)
+    if name and name.lower().endswith("lock"):
+        return name
+    return None
+
+
+def is_device_call(call: ast.Call) -> str | None:
+    """A token naming the device entry this call performs, or None."""
+    tail = tail_name(call.func)
+    if tail in DEVICE_CALL_NAMES:
+        return tail
+    dn = dotted_name(call.func)
+    if dn and dn.split(".", 1)[0] in DEVICE_ROOTS:
+        return dn
+    return None
+
+
+@dataclass
+class AcquireSite:
+    lock: str
+    held: tuple[str, ...]  # locks already held when this one is taken
+    line: int
+
+
+@dataclass
+class CallSite:
+    func: ast.expr          # the call's func node (for resolution)
+    held: tuple[str, ...]
+    line: int
+
+
+@dataclass
+class DeviceSite:
+    token: str
+    held: tuple[str, ...]
+    line: int
+
+
+@dataclass
+class FunctionInfo:
+    module: str             # repo-relative posix path
+    cls: str | None
+    name: str
+    node: ast.AST
+    is_async: bool
+    acquires: list[AcquireSite] = field(default_factory=list)
+    calls: list[CallSite] = field(default_factory=list)
+    device: list[DeviceSite] = field(default_factory=list)
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+class _FnWalker:
+    """Walks one function body tracking the held-lock set.
+
+    Nested ``def``/``class`` bodies are skipped — they get their own
+    FunctionInfo and do not run where they are defined. ``lambda``
+    bodies run inline often enough (sort keys) that their calls are
+    recorded under the current held-set.
+    """
+
+    def __init__(self, info: FunctionInfo):
+        self.info = info
+
+    def walk(self) -> None:
+        self._stmts(self.info.node.body, ())
+
+    # ------------------------------------------------------- statements
+
+    def _stmts(self, body, held) -> None:
+        held = list(held)
+        for stmt in body:
+            held = self._stmt(stmt, held)
+
+    def _stmt(self, stmt, held: list) -> list:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return held  # separate FunctionInfo; doesn't run here
+        if isinstance(stmt, ast.With):
+            inner = list(held)
+            for item in stmt.items:
+                self._expr(item.context_expr, tuple(inner))
+                lock = lock_name_of(item.context_expr)
+                if lock:
+                    self.info.acquires.append(
+                        AcquireSite(lock, tuple(inner), item.context_expr.lineno)
+                    )
+                    inner.append(lock)
+            self._stmts(stmt.body, tuple(inner))
+            return held
+        if isinstance(stmt, ast.AsyncWith):
+            # asyncio locks: excluded from the thread-lock graph, but
+            # the body still runs under the current (thread) held-set
+            for item in stmt.items:
+                self._expr(item.context_expr, tuple(held))
+            self._stmts(stmt.body, tuple(held))
+            return held
+        # linear acquire()/release() tracking within a statement list
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if isinstance(call.func, ast.Attribute) and call.func.attr in (
+                "acquire",
+                "release",
+            ):
+                lock = lock_name_of(call.func.value)
+                if lock:
+                    if call.func.attr == "acquire":
+                        self.info.acquires.append(
+                            AcquireSite(lock, tuple(held), call.lineno)
+                        )
+                        return held + [lock]
+                    out = list(held)
+                    if lock in out:  # drop the most recent acquisition
+                        out.reverse()
+                        out.remove(lock)
+                        out.reverse()
+                    return out
+        # generic statement: visit child expressions + statement lists
+        for _field, value in ast.iter_fields(stmt):
+            if isinstance(value, list):
+                if value and isinstance(value[0], ast.stmt):
+                    self._stmts(value, tuple(held))
+                else:
+                    for v in value:
+                        if isinstance(v, ast.ExceptHandler):
+                            self._stmts(v.body, tuple(held))
+                        elif isinstance(v, ast.expr):
+                            self._expr(v, tuple(held))
+            elif isinstance(value, ast.expr):
+                self._expr(value, tuple(held))
+        return held
+
+    # ------------------------------------------------------ expressions
+
+    def _expr(self, expr, held: tuple[str, ...]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self.info.calls.append(CallSite(node.func, held, node.lineno))
+                token = is_device_call(node)
+                if token:
+                    self.info.device.append(DeviceSite(token, held, node.lineno))
+
+
+# ------------------------------------------------------------- indexing
+
+
+@dataclass
+class ModuleFile:
+    path: str        # repo-relative posix path
+    tree: ast.Module
+    source: str
+
+
+class PackageIndex:
+    """All functions of the linted package, with call resolution and
+    the transitive acquire/device fixpoint."""
+
+    def __init__(self, files: list[ModuleFile]):
+        self.files = files
+        self.functions: list[FunctionInfo] = []
+        self.by_bare_name: dict[str, list[FunctionInfo]] = {}
+        self.by_module_func: dict[tuple[str, str], FunctionInfo] = {}
+        self.by_class_method: dict[tuple[str, str], list[FunctionInfo]] = {}
+        self.class_init: dict[str, list[FunctionInfo]] = {}
+        for mf in files:
+            self._index_module(mf)
+        for fn in self.functions:
+            _FnWalker(fn).walk()
+        self._resolved: dict[int, FunctionInfo | None] = {}
+        self._trans_acquires: dict[int, frozenset[str]] = {}
+        self._trans_device: dict[int, bool] = {}
+        self._fixpoint()
+
+    # -------------------------------------------------------- structure
+
+    def _index_module(self, mf: ModuleFile) -> None:
+        def add(node, cls: str | None):
+            info = FunctionInfo(
+                module=mf.path,
+                cls=cls,
+                name=node.name,
+                node=node,
+                is_async=isinstance(node, ast.AsyncFunctionDef),
+            )
+            self.functions.append(info)
+            self.by_bare_name.setdefault(node.name, []).append(info)
+            if cls is None:
+                self.by_module_func.setdefault((mf.path, node.name), info)
+            else:
+                self.by_class_method.setdefault((cls, node.name), []).append(info)
+                if node.name == "__init__":
+                    self.class_init.setdefault(cls, []).append(info)
+            # nested defs get their own entries (resolution by unique
+            # bare name may still reach them)
+            for child in ast.walk(node):
+                if child is node:
+                    continue
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nested = FunctionInfo(
+                        module=mf.path,
+                        cls=cls,
+                        name=child.name,
+                        node=child,
+                        is_async=isinstance(child, ast.AsyncFunctionDef),
+                    )
+                    self.functions.append(nested)
+                    self.by_bare_name.setdefault(child.name, []).append(nested)
+
+        for node in mf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                add(node, None)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        add(sub, node.name)
+
+    # ------------------------------------------------------- resolution
+
+    def resolve(self, caller: FunctionInfo, site: CallSite) -> FunctionInfo | None:
+        key = id(site)
+        if key in self._resolved:
+            return self._resolved[key]
+        out = self._resolve_uncached(caller, site)
+        self._resolved[key] = out
+        return out
+
+    def _resolve_uncached(self, caller, site) -> FunctionInfo | None:
+        f = site.func
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if (
+                isinstance(recv, ast.Name)
+                and recv.id in ("self", "cls")
+                and caller.cls is not None
+            ):
+                methods = self.by_class_method.get((caller.cls, f.attr))
+                if methods:
+                    same = [m for m in methods if m.module == caller.module]
+                    return same[0] if same else methods[0]
+            cands = self.by_bare_name.get(f.attr, [])
+            return cands[0] if len(cands) == 1 else None
+        if isinstance(f, ast.Name):
+            inits = self.class_init.get(f.id, [])
+            if len(inits) == 1:
+                return inits[0]
+            same_mod = self.by_module_func.get((caller.module, f.id))
+            if same_mod is not None:
+                return same_mod
+            cands = [fn for fn in self.by_bare_name.get(f.id, []) if fn.cls is None]
+            return cands[0] if len(cands) == 1 else None
+        return None
+
+    # --------------------------------------------------------- fixpoint
+
+    def _fixpoint(self) -> None:
+        acq = {
+            id(fn): {a.lock for a in fn.acquires} for fn in self.functions
+        }
+        dev = {id(fn): bool(fn.device) for fn in self.functions}
+        edges: dict[int, list[int]] = {}
+        for fn in self.functions:
+            outs = []
+            for site in fn.calls:
+                callee = self.resolve(fn, site)
+                if callee is not None:
+                    outs.append(id(callee))
+            edges[id(fn)] = outs
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                k = id(fn)
+                for callee in edges[k]:
+                    before = len(acq[k])
+                    acq[k] |= acq[callee]
+                    if len(acq[k]) != before:
+                        changed = True
+                    if dev[callee] and not dev[k]:
+                        dev[k] = True
+                        changed = True
+        self._trans_acquires = {k: frozenset(v) for k, v in acq.items()}
+        self._trans_device = dev
+
+    def transitive_acquires(self, fn: FunctionInfo) -> frozenset[str]:
+        return self._trans_acquires[id(fn)]
+
+    def transitive_device(self, fn: FunctionInfo) -> bool:
+        return self._trans_device[id(fn)]

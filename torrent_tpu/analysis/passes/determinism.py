@@ -1,0 +1,189 @@
+"""``determinism`` — bit-stable bytes where processes must agree.
+
+The fabric's correctness rests on every process computing identical
+answers from identical inputs: ``fabric/plan.py`` fingerprints the
+shard assignment to prove plan agreement, and the heartbeat exchange's
+coverage/adoption rules assume each process evaluates the same state.
+Wall-clock reads, randomness, and unordered ``set``/``dict`` iteration
+are the three ways nondeterminism leaks into those bytes.
+
+Scope is explicit (``SCOPE``): all of ``fabric/plan.py`` plus the
+executor functions that build, merge, or consume exchanged heartbeat
+state. Within scope, the pass flags:
+
+* wall-clock reads (``time.time``, ``datetime.now`` …) — cross-host
+  clock skew turns these into divergent values;
+* randomness (``random.*``, ``os.urandom``, ``uuid.*``, ``hash()`` —
+  the latter is PYTHONHASHSEED-dependent);
+* iteration over sets or ``dict.items()/keys()/values()`` whose order
+  feeds the output, unless the iteration is consumed by an
+  order-insensitive sink (``sorted``, ``min``, ``max``, ``sum``,
+  ``any``, ``all``, ``len``, ``set``, ``frozenset``).
+
+Set-typed attributes are recognized from ``self.x: set[...] = ...``
+annotations in the class ``__init__``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torrent_tpu.analysis.findings import Finding
+from torrent_tpu.analysis.passes.common import (
+    PackageIndex,
+    dotted_name,
+    tail_name,
+)
+
+PASS_NAME = "determinism"
+
+# path suffix -> function names in scope ("*" = every function)
+SCOPE: dict[str, frozenset[str]] = {
+    "fabric/plan.py": frozenset({"*"}),
+    # _own_bits is deliberately NOT in scope: its dict order provably
+    # never reaches exchanged bytes (the payload sorts own.items() and
+    # _published_done is a set)
+    "fabric/executor.py": frozenset(
+        {
+            "_heartbeat_once",
+            "bitfields",
+            "pack_bits",
+            "unpack_bits",
+            "plan_payload_bytes",
+        }
+    ),
+}
+
+WALL_CLOCK = frozenset(
+    {"time.time", "time.time_ns", "time.ctime", "datetime.now", "datetime.utcnow"}
+)
+RANDOM_ROOTS = ("random", "uuid", "secrets")
+RANDOM_DOTTED = frozenset({"os.urandom"})
+UNORDERED_METHODS = frozenset({"items", "keys", "values"})
+ORDER_INSENSITIVE_SINKS = frozenset(
+    {"sorted", "min", "max", "sum", "any", "all", "len", "set", "frozenset"}
+)
+
+
+def _scope_functions(path: str) -> frozenset[str] | None:
+    for suffix, names in SCOPE.items():
+        if path.endswith(suffix):
+            return names
+    return None
+
+
+def _set_typed_attrs(tree: ast.Module) -> set[str]:
+    """Attribute names annotated ``self.x: set[...]`` in any __init__."""
+    attrs: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Attribute):
+            tgt = node.target
+            ann = node.annotation
+            base = ann.value if isinstance(ann, ast.Subscript) else ann
+            if (
+                isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and isinstance(base, ast.Name)
+                and base.id in ("set", "frozenset")
+            ):
+                attrs.add(tgt.attr)
+    return attrs
+
+
+class _DetWalker(ast.NodeVisitor):
+    def __init__(self, set_attrs: set[str]):
+        self.set_attrs = set_attrs
+        self.hits: list[tuple[str, int]] = []
+        self._sink_depth = 0
+
+    # ------------------------------------------------------------ calls
+
+    def visit_Call(self, node: ast.Call):
+        dn = dotted_name(node.func)
+        if dn:
+            if dn in WALL_CLOCK:
+                self.hits.append((f"wall-clock {dn}()", node.lineno))
+            elif dn in RANDOM_DOTTED or dn.split(".", 1)[0] in RANDOM_ROOTS:
+                self.hits.append((f"randomness {dn}()", node.lineno))
+        if isinstance(node.func, ast.Name) and node.func.id == "hash":
+            self.hits.append(
+                ("PYTHONHASHSEED-dependent hash()", node.lineno)
+            )
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ORDER_INSENSITIVE_SINKS
+        ):
+            self._sink_depth += 1
+            self.generic_visit(node)
+            self._sink_depth -= 1
+            return
+        self.generic_visit(node)
+
+    # -------------------------------------------------------- iteration
+
+    def _unordered_iter(self, expr) -> str | None:
+        if isinstance(expr, ast.Call):
+            tail = tail_name(expr.func)
+            if tail in UNORDERED_METHODS and isinstance(expr.func, ast.Attribute):
+                return f".{tail}()"
+            if isinstance(expr.func, ast.Name) and expr.func.id in (
+                "set",
+                "frozenset",
+            ):
+                return f"{expr.func.id}(...)"
+        if isinstance(expr, (ast.Set, ast.SetComp)):
+            return "set literal"
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and expr.attr in self.set_attrs
+        ):
+            return f"self.{expr.attr} (set-typed)"
+        return None
+
+    def _check_iter(self, expr, line: int) -> None:
+        if self._sink_depth:
+            return
+        what = self._unordered_iter(expr)
+        if what:
+            self.hits.append((f"unordered iteration over {what}", line))
+
+    def visit_For(self, node: ast.For):
+        self._check_iter(node.iter, node.lineno)
+        self.generic_visit(node)
+
+    def _visit_comp(self, node):
+        for gen in node.generators:
+            self._check_iter(gen.iter, node.lineno)
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_DictComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+
+
+def run(index: PackageIndex, files=None) -> list[Finding]:
+    findings: list[Finding] = []
+    set_attrs_by_module: dict[str, set[str]] = {}
+    for mf in index.files:
+        set_attrs_by_module[mf.path] = _set_typed_attrs(mf.tree)
+    for fn in index.functions:
+        names = _scope_functions(fn.module)
+        if names is None or ("*" not in names and fn.name not in names):
+            continue
+        w = _DetWalker(set_attrs_by_module.get(fn.module, set()))
+        for stmt in fn.node.body:
+            w.visit(stmt)
+        for what, line in w.hits:
+            findings.append(
+                Finding(
+                    PASS_NAME,
+                    fn.module,
+                    line,
+                    fn.qualname,
+                    f"{what} in deterministic scope",
+                )
+            )
+    return findings

@@ -1,0 +1,160 @@
+"""``lock-order`` — the static lock-acquisition graph and its rules.
+
+Builds the package-wide digraph of "lock B acquired while lock A is
+held" edges: direct nesting (``with a: with b:``, linear
+``acquire()``/``release()`` scopes) plus edges propagated through
+resolved calls (holding ``a`` while calling a function that —
+transitively — acquires ``b``). Then checks three rules:
+
+1. **No cycles.** Any strongly-connected component (including a
+   self-edge between same-named locks) is a potential ABBA deadlock.
+2. **Documented partial order.** The project's lock hierarchy is
+   ``build_lock → lock → _device_lock`` (lane plane construction,
+   breaker state, device serialization — see ARCHITECTURE.md
+   "Concurrency invariants"). An edge that acquires a lower-ranked
+   lock while holding a higher-ranked one inverts the hierarchy.
+3. **Leaf locks.** ``_counter_lock`` (cross-lane metrics counters) is
+   documented leaf-only: nothing may be acquired while holding it.
+
+Call resolution is conservative (see ``common.py``): ambiguous names
+are not traversed, so this pass under-approximates — the runtime
+sanitizer covers the dynamic remainder.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from torrent_tpu.analysis.findings import Finding
+from torrent_tpu.analysis.passes.common import FunctionInfo, PackageIndex
+
+PASS_NAME = "lock-order"
+
+# The documented partial order, outermost first. Locks not listed are
+# unconstrained relative to these except through the cycle rule.
+DOCUMENTED_ORDER = ("build_lock", "lock", "_device_lock")
+# Locks nothing else may be acquired under.
+LEAF_LOCKS = frozenset({"_counter_lock"})
+
+
+@dataclass(frozen=True)
+class Edge:
+    held: str
+    acquired: str
+    module: str
+    line: int
+    symbol: str
+    via_call: bool  # propagated through a resolved call, not direct nesting
+
+
+def build_edges(index: PackageIndex) -> list[Edge]:
+    edges: list[Edge] = []
+    for fn in index.functions:
+        for site in fn.acquires:
+            for held in site.held:
+                edges.append(
+                    Edge(held, site.lock, fn.module, site.line, fn.qualname, False)
+                )
+        for site in fn.calls:
+            if not site.held:
+                continue
+            callee = index.resolve(fn, site)
+            if callee is None:
+                continue
+            for lock in sorted(index.transitive_acquires(callee)):
+                for held in site.held:
+                    edges.append(
+                        Edge(held, lock, fn.module, site.line, fn.qualname, True)
+                    )
+    return edges
+
+
+def _cycles(edges: list[Edge]) -> list[tuple[str, ...]]:
+    """All elementary cycles reachable in the (small) lock graph,
+    deduplicated by rotation-normalized node tuple."""
+    graph: dict[str, set[str]] = {}
+    for e in edges:
+        graph.setdefault(e.held, set()).add(e.acquired)
+    seen: set[tuple[str, ...]] = set()
+    out: list[tuple[str, ...]] = []
+
+    def dfs(start: str, node: str, path: list[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                cyc = tuple(path)
+                # rotate so the lexicographically smallest node leads
+                k = cyc.index(min(cyc))
+                norm = cyc[k:] + cyc[:k]
+                if norm not in seen:
+                    seen.add(norm)
+                    out.append(norm)
+            elif nxt not in path and len(path) < 8:
+                dfs(start, nxt, path + [nxt])
+
+    for node in sorted(graph):
+        dfs(node, node, [node])
+    return out
+
+
+def run(index: PackageIndex, files=None) -> list[Finding]:
+    edges = build_edges(index)
+    findings: list[Finding] = []
+
+    # one representative site per (held, acquired) pair for reporting
+    rep: dict[tuple[str, str], Edge] = {}
+    for e in edges:
+        rep.setdefault((e.held, e.acquired), e)
+
+    for cyc in _cycles(edges):
+        chain = " -> ".join(cyc + (cyc[0],))
+        # anchor the finding at the edge closing the cycle
+        e = rep.get((cyc[-1], cyc[0])) or rep.get((cyc[0], cyc[1 % len(cyc)]))
+        findings.append(
+            Finding(
+                PASS_NAME,
+                e.module,
+                e.line,
+                e.symbol,
+                f"lock-order cycle: {chain}",
+            )
+        )
+
+    rank = {name: i for i, name in enumerate(DOCUMENTED_ORDER)}
+    for (held, acquired), e in sorted(rep.items()):
+        if held in rank and acquired in rank and rank[held] > rank[acquired]:
+            findings.append(
+                Finding(
+                    PASS_NAME,
+                    e.module,
+                    e.line,
+                    e.symbol,
+                    f"acquisition {held} -> {acquired} inverts the documented "
+                    f"order {' -> '.join(DOCUMENTED_ORDER)}",
+                )
+            )
+        if held in LEAF_LOCKS:
+            findings.append(
+                Finding(
+                    PASS_NAME,
+                    e.module,
+                    e.line,
+                    e.symbol,
+                    f"{held} is a leaf lock but {acquired} is acquired under it",
+                )
+            )
+    return findings
+
+
+def render_graph(index: PackageIndex) -> str:
+    """Human-readable dump of the acquisition graph (``lint --graph``)."""
+    edges = build_edges(index)
+    rep: dict[tuple[str, str], Edge] = {}
+    for e in edges:
+        rep.setdefault((e.held, e.acquired), e)
+    lines = []
+    for (held, acquired), e in sorted(rep.items()):
+        kind = "via-call" if e.via_call else "direct"
+        lines.append(
+            f"{held} -> {acquired}  [{kind}] {e.module}:{e.line} ({e.symbol})"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,353 @@
+"""tsan-lite: the runtime concurrency sanitizer (``TORRENT_TPU_TSAN=1``).
+
+The static passes under-approximate (ambiguous call names are not
+traversed); this is the dynamic complement. When enabled, every lock the
+package creates through :func:`named_lock` is a :class:`SanitizedLock`:
+a plain ``threading.Lock`` plus, on each acquisition,
+
+* **lock-order recording** — the acquiring thread's held-set becomes
+  edges in a dynamic acquisition graph; a new edge that closes a cycle
+  is an observed ABBA hazard, recorded (and asserted zero by
+  ``tests/conftest.py`` at session end, so the whole tier-1 suite
+  doubles as a concurrency test);
+* **wait/hold accounting** — per-lock total wait seconds, max hold
+  seconds, acquisition and contention counts, exported through
+  ``utils/metrics.py`` ``render_tsan_metrics`` → ``/metrics``;
+* **hold-time watchdog** — a daemon thread flags any lock held longer
+  than ``TORRENT_TPU_TSAN_HOLD_S`` (default 10 s) while it is still
+  held, naming the lock and the owning thread.
+
+Independent of locks, enabling also installs an **event-loop stall
+monitor**: ``asyncio``'s callback runner is wrapped so any single
+callback exceeding ``TORRENT_TPU_TSAN_STALL_S`` (default 0.5 s) —
+sync IO or jit dispatch on the serving loop, the blocking-in-async
+hazard class at runtime — increments a stall counter with the max
+observed stall.
+
+Node identity in the dynamic graph is the lock's *name* (the
+:func:`named_lock` annotation, e.g. ``"sched.lane.build_lock"``), not
+the instance: all lanes' build locks are one node, which is what lock
+*ordering* is about. Same-name self-edges are counted separately
+(``same_name_nesting``) rather than reported as cycles — two distinct
+instances of one class's lock may legally nest.
+
+When TSAN is off, :func:`named_lock` returns a plain
+``threading.Lock`` — zero overhead, zero behavior change.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from torrent_tpu.utils.log import get_logger
+
+log = get_logger("analysis.tsan")
+
+_TSAN_ENV = "TORRENT_TPU_TSAN"
+_HOLD_ENV = "TORRENT_TPU_TSAN_HOLD_S"
+_STALL_ENV = "TORRENT_TPU_TSAN_STALL_S"
+
+_enabled = False
+
+
+def tsan_env_set() -> bool:
+    return os.environ.get(_TSAN_ENV, "") in ("1", "true")
+
+
+def is_enabled() -> bool:
+    return _enabled or tsan_env_set()
+
+
+def _hold_threshold() -> float:
+    try:
+        return float(os.environ.get(_HOLD_ENV, "") or 10.0)
+    except ValueError:
+        return 10.0
+
+
+def _stall_threshold() -> float:
+    try:
+        return float(os.environ.get(_STALL_ENV, "") or 0.5)
+    except ValueError:
+        return 0.5
+
+
+class _LockStats:
+    __slots__ = ("acquisitions", "contended", "wait_total", "hold_max")
+
+    def __init__(self):
+        self.acquisitions = 0
+        self.contended = 0
+        self.wait_total = 0.0
+        self.hold_max = 0.0
+
+
+class TsanState:
+    """All sanitizer state. One module-global instance backs the
+    process; tests may construct private ones and hand them to
+    :class:`SanitizedLock` directly."""
+
+    def __init__(self):
+        # the meta lock guards everything below; it is a PLAIN lock
+        # (sanitizing the sanitizer would recurse) and is only ever
+        # held for dict updates — never across user code
+        self._meta = threading.Lock()
+        self._tls = threading.local()
+        self.edges: dict[str, set[str]] = {}
+        self.cycles: list[tuple[str, ...]] = []
+        self._cycle_keys: set[tuple[str, ...]] = set()
+        self.locks: dict[str, _LockStats] = {}
+        self.same_name_nesting = 0
+        self.long_holds = 0
+        self.loop_stalls = 0
+        self.loop_stall_max = 0.0
+        # id(lock) -> (name, thread name, since) for the hold watchdog
+        self._held_registry: dict[int, tuple[str, str, float]] = {}
+        self._watchdog_flagged: set[int] = set()
+
+    # ------------------------------------------------------- lock hooks
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def on_acquired(self, lock, name: str, waited: float) -> None:
+        stack = self._stack()
+        now = time.monotonic()
+        with self._meta:
+            st = self.locks.get(name)
+            if st is None:
+                st = self.locks[name] = _LockStats()
+            st.acquisitions += 1
+            st.wait_total += waited
+            if waited > 1e-3:
+                st.contended += 1
+            for held_name, _held_id in stack:
+                if held_name == name:
+                    self.same_name_nesting += 1
+                    continue
+                self._add_edge(held_name, name)
+            self._held_registry[id(lock)] = (
+                name,
+                threading.current_thread().name,
+                now,
+            )
+        stack.append((name, id(lock)))
+
+    def on_released(self, lock, name: str) -> None:
+        now = time.monotonic()
+        stack = self._stack()
+        # releases may be out of LIFO order: drop the newest matching entry
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][1] == id(lock):
+                del stack[i]
+                break
+        with self._meta:
+            entry = self._held_registry.pop(id(lock), None)
+            self._watchdog_flagged.discard(id(lock))
+            if entry is not None:
+                st = self.locks.get(name)
+                if st is not None:
+                    st.hold_max = max(st.hold_max, now - entry[2])
+
+    def _add_edge(self, frm: str, to: str) -> None:
+        """Record frm -> to (held while acquiring); detect a new cycle.
+        Caller holds the meta lock."""
+        outs = self.edges.setdefault(frm, set())
+        if to in outs:
+            return
+        outs.add(to)
+        # does `frm` become reachable from `to` now? DFS on a small graph
+        seen = set()
+        path = self._find_path(to, frm, seen)
+        if path is not None:
+            cyc = tuple(path)
+            k = cyc.index(min(cyc))
+            norm = cyc[k:] + cyc[:k]
+            if norm not in self._cycle_keys:
+                self._cycle_keys.add(norm)
+                self.cycles.append(norm)
+                log.error(
+                    "tsan: lock-order cycle observed: %s",
+                    " -> ".join(norm + (norm[0],)),
+                )
+
+    def _find_path(self, start: str, goal: str, seen: set) -> list | None:
+        if start == goal:
+            return [start]
+        seen.add(start)
+        for nxt in self.edges.get(start, ()):
+            if nxt in seen:
+                continue
+            sub = self._find_path(nxt, goal, seen)
+            if sub is not None:
+                return [start] + sub
+        return None
+
+    # ------------------------------------------------- watchdog / stalls
+
+    def watchdog_scan(self) -> None:
+        threshold = _hold_threshold()
+        now = time.monotonic()
+        with self._meta:
+            for key, (name, thread, since) in list(self._held_registry.items()):
+                if now - since > threshold and key not in self._watchdog_flagged:
+                    self._watchdog_flagged.add(key)
+                    self.long_holds += 1
+                    log.warning(
+                        "tsan: lock %s held %.1fs by thread %s (threshold %.1fs)",
+                        name, now - since, thread, threshold,
+                    )
+
+    def on_stall(self, seconds: float) -> None:
+        with self._meta:
+            self.loop_stalls += 1
+            self.loop_stall_max = max(self.loop_stall_max, seconds)
+            log.warning("tsan: event-loop callback stalled %.3fs", seconds)
+
+    # ----------------------------------------------------------- output
+
+    def snapshot(self) -> dict:
+        with self._meta:
+            return {
+                "enabled": is_enabled(),
+                "locks": {
+                    name: {
+                        "acquisitions": st.acquisitions,
+                        "contended": st.contended,
+                        "wait_total_s": st.wait_total,
+                        "hold_max_s": st.hold_max,
+                    }
+                    for name, st in sorted(self.locks.items())
+                },
+                "edges": sum(len(v) for v in self.edges.values()),
+                "cycles": [list(c) for c in self.cycles],
+                "same_name_nesting": self.same_name_nesting,
+                "long_holds": self.long_holds,
+                "loop_stalls": self.loop_stalls,
+                "loop_stall_max_s": self.loop_stall_max,
+            }
+
+
+_state = TsanState()
+
+
+def global_state() -> TsanState:
+    return _state
+
+
+def snapshot() -> dict:
+    return _state.snapshot()
+
+
+class SanitizedLock:
+    """``threading.Lock`` with acquisition-order + timing recording."""
+
+    __slots__ = ("_name", "_lock", "_state")
+
+    def __init__(self, name: str, state: TsanState | None = None):
+        self._name = name
+        self._lock = threading.Lock()
+        self._state = state or _state
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        t0 = time.monotonic()
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._state.on_acquired(self, self._name, time.monotonic() - t0)
+        return ok
+
+    def release(self) -> None:
+        self._state.on_released(self, self._name)
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def named_lock(name: str):
+    """The package's lock constructor: a plain ``threading.Lock`` when
+    TSAN is off, a :class:`SanitizedLock` recording under ``name`` when
+    on. Name convention: ``<area>.<owner>.<attr>`` with the attribute
+    name last (``"sched.lane.build_lock"``), so dynamic nodes map back
+    to the static pass's canonical lock names."""
+    if is_enabled():
+        _autoenable()
+        return SanitizedLock(name)
+    return threading.Lock()
+
+
+# ------------------------------------------------------------- enabling
+
+_watchdog_started = False
+_loop_patched = False
+
+
+def _watchdog_main() -> None:  # pragma: no cover - timing-dependent
+    while True:
+        time.sleep(max(0.05, _hold_threshold() / 4))
+        _state.watchdog_scan()
+
+
+def _start_watchdog() -> None:
+    global _watchdog_started
+    if _watchdog_started:
+        return
+    _watchdog_started = True
+    t = threading.Thread(target=_watchdog_main, name="tsan-watchdog", daemon=True)
+    t.start()
+
+
+def _install_loop_monitor() -> None:
+    """Wrap asyncio's callback runner so any single callback exceeding
+    the stall threshold is counted — the runtime form of the
+    blocking-in-async pass."""
+    global _loop_patched
+    if _loop_patched:
+        return
+    _loop_patched = True
+    import asyncio.events as events
+
+    orig = events.Handle._run
+
+    def _run(self):
+        t0 = time.monotonic()
+        try:
+            return orig(self)
+        finally:
+            dt = time.monotonic() - t0
+            if dt > _stall_threshold():
+                _state.on_stall(dt)
+
+    events.Handle._run = _run
+
+
+def _autoenable() -> None:
+    global _enabled
+    if not _enabled:
+        _enabled = True
+        _start_watchdog()
+        _install_loop_monitor()
+
+
+def enable() -> None:
+    """Turn the sanitizer on programmatically (tests/conftest). Locks
+    created BEFORE this call stay plain; enable as early as possible —
+    before importing the modules whose locks you want instrumented."""
+    _autoenable()

@@ -192,6 +192,12 @@ class BridgeServer:
         self.hasher = hasher
         self._server: asyncio.AbstractServer | None = None
         self.sched: HashPlaneScheduler | None = None
+        # /v1/info device count, probed off-loop in the background by
+        # start(): jax.devices() can block for minutes behind a wedged
+        # device tunnel and must never run on the serving loop (the
+        # same hazard class as sha256 backend auto-resolution)
+        self._device_count = 0
+        self._probe_task: asyncio.Task | None = None
         # one fabric job at a time: {"task", "executors" (the running
         # FabricExecutor appended by verify_library_fabric), "result",
         # "error", "torrents"} — /v1/fabric/* and /metrics read it
@@ -217,6 +223,23 @@ class BridgeServer:
         self.sched = await HashPlaneScheduler(
             self._sched_config, hasher=self.hasher
         ).start()
+
+        def _count_devices() -> int:
+            import jax
+
+            return len(jax.devices())
+
+        async def _probe() -> None:
+            try:
+                self._device_count = await asyncio.to_thread(_count_devices)
+            except Exception as e:  # /v1/info keeps reporting 0
+                log.warning("device-count probe failed: %s", e)
+
+        # fire-and-forget: the probe must neither run on the serving
+        # loop NOR gate the listen socket — behind a wedged tunnel every
+        # other route keeps serving and /v1/info reports 0 devices until
+        # the probe resolves
+        self._probe_task = asyncio.ensure_future(_probe())
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
         log.info("bridge listening on %s:%d", self.host, self.port)
@@ -229,6 +252,14 @@ class BridgeServer:
     async def wait_closed(self) -> None:
         if self._server:
             await self._server.wait_closed()
+        if self._probe_task is not None and not self._probe_task.done():
+            # cancel releases the coroutine; an in-flight jax.devices()
+            # thread finishes on its own, harmlessly
+            self._probe_task.cancel()
+            try:
+                await self._probe_task
+            except (asyncio.CancelledError, Exception):
+                pass
         if self._fabric is not None and self._fabric["task"] is not None and not self._fabric["task"].done():
             self._fabric["task"].cancel()
             try:
@@ -422,12 +453,11 @@ class BridgeServer:
 
     async def _route(self, writer, method: str, target: str, body: bytes, headers=None):
         if method == "GET" and target == "/v1/info":
-            import jax
-
             payload = bencode(
                 {
                     b"backend": self.hasher.encode(),
-                    b"devices": len(jax.devices()),
+                    # probed off-loop in start() — never on the serving loop
+                    b"devices": self._device_count,
                     b"batch": self.sched.config.batch_target,
                     # memoized on the scheduler (start() resolved it
                     # off-loop; 'auto' probes jax.devices())
@@ -451,6 +481,12 @@ class BridgeServer:
                 text += render_fabric_metrics(
                     self._fabric["executors"][0].metrics_snapshot()
                 )
+            from torrent_tpu.analysis import sanitizer
+
+            if sanitizer.is_enabled():
+                from torrent_tpu.utils.metrics import render_tsan_metrics
+
+                text += render_tsan_metrics(sanitizer.snapshot())
             return await self._reply(writer, 200, text.encode())
         if method == "GET" and target == "/v1/fabric/status":
             return await self._reply(writer, 200, bencode(self._fabric_status()))

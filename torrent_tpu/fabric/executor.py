@@ -325,6 +325,8 @@ class FabricExecutor:
     # ---------------------------------------------------------- coverage
 
     def _own_bits(self) -> dict[int, np.ndarray]:
+        # iteration order doesn't matter here: the heartbeat payload
+        # sorts own.items() and _published_done is a set
         return {
             uid: pubs[self.pid]
             for uid, pubs in self._verdicts.items()
@@ -561,7 +563,7 @@ class FabricExecutor:
             "t": time.time(),
             "fp": self._fp,
             "degraded": self._degraded,
-            "done": {str(uid): pack_bits(b) for uid, b in own.items()},
+            "done": {str(uid): pack_bits(b) for uid, b in sorted(own.items())},
             "inflight": sorted(self._unit_started),
             "distrust": sorted([p, u] for p, u in self._distrust),
             "redone": sorted(
@@ -579,7 +581,9 @@ class FabricExecutor:
         # published — the symmetric-coverage condition depends on peers
         # actually having been able to see them
         self._published_done = set(own)
-        for p, pl in peers.items():
+        # sorted: merge order must match on every process so the shared
+        # coverage/adoption state stays symmetric round for round
+        for p, pl in sorted(peers.items()):
             if pl.get("fp") != self._fp:
                 log.warning(
                     "fabric peer %s heartbeat carries plan %s != ours %s; "

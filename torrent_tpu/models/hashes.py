@@ -66,9 +66,9 @@ class HashTreeCache:
         # serve() runs in worker threads (session offloads the first
         # build); one lock bounds a pipelined burst of requests for the
         # same root to a single tree construction
-        import threading
+        from torrent_tpu.analysis.sanitizer import named_lock
 
-        self._build_lock = threading.Lock()
+        self._build_lock = named_lock("models.hashes._build_lock")
 
     def _tree_for(self, root: bytes) -> list[list[bytes]] | None:
         with self._build_lock:

@@ -21,7 +21,8 @@ traffic is output gathering.
 
 from __future__ import annotations
 
-import threading
+
+from torrent_tpu.analysis.sanitizer import named_lock
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -166,7 +167,7 @@ class TPUVerifier:
         self._upload_pool: ThreadPoolExecutor | None = None
         # verify_batch/digest_batch may be called from several threads on a
         # shared verifier (the bridge does); first-use pool init must not race
-        self._upload_pool_lock = threading.Lock()
+        self._upload_pool_lock = named_lock("models.verifier._upload_pool_lock")
         # On the CPU backend device_put can zero-copy an aligned numpy
         # view — the "device" array then aliases the staging buffer, and
         # reusing the buffer while a batch is still in flight would

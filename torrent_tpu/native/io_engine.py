@@ -10,12 +10,13 @@ semantics either way (tests/test_native_io.py runs both differentially).
 from __future__ import annotations
 
 import ctypes
-import threading
 
 import numpy as np
 
+from torrent_tpu.analysis.sanitizer import named_lock
+
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = named_lock("native._lib_lock")
 _lib_tried = False
 
 
@@ -51,7 +52,7 @@ class NativeIOEngine:
             raise NativeIOError("native io engine unavailable (no toolchain?)")
         self._lib = lib
         self._handle = lib.tt_io_create(int(n_threads))
-        self._lock = threading.Lock()  # C pool services one batch at a time
+        self._lock = named_lock("native.io_engine._lock")  # C pool services one batch at a time
 
     def close(self) -> None:
         if self._handle:
@@ -127,7 +128,7 @@ class NativeIOEngine:
 
 
 _engine = None
-_engine_lock = threading.Lock()
+_engine_lock = named_lock("native._engine_lock")
 
 
 def get_engine(n_threads: int | None = None):

@@ -30,9 +30,10 @@ the existing ``SchedulerConfig.plane_factory`` seam::
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
+
+from torrent_tpu.analysis.sanitizer import named_lock
 
 __all__ = [
     "DeviceFaultError",
@@ -167,7 +168,7 @@ class FaultyPlane:
         self.plan = plan
         self.inner = inner
         self.launches = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("sched.faulty_plane._lock")
 
     def launch_geometry(self, n_rows: int, bucket: int) -> tuple[int, int]:
         """Faults change nothing about staging: delegate to the wrapped

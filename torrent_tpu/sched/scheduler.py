@@ -76,12 +76,12 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable
 
+from torrent_tpu.analysis.sanitizer import named_lock
 from torrent_tpu.utils.log import get_logger
 
 log = get_logger("sched")
@@ -304,7 +304,7 @@ class _Lane:
         self.plane = None  # built lazily off the event loop
         # pipelined launches run _run_plane in concurrent worker threads,
         # so first-use plane construction needs a real lock
-        self.build_lock = threading.Lock()
+        self.build_lock = named_lock("sched.lane.build_lock")
         self.sem = asyncio.Semaphore(max(1, pipeline_depth))
         self.inflight: set[asyncio.Task] = set()
         self.breaker = breaker
@@ -344,7 +344,7 @@ class _LaneBreaker:
         self.opened_at = 0.0
         self.probing = False  # one half-open probe in flight at a time
         self.transitions: dict[str, int] = {}
-        self.lock = threading.Lock()
+        self.lock = named_lock("sched.breaker.lock")
 
     def _to(self, state: str) -> None:
         key = f"{self.state}->{state}"
@@ -457,7 +457,7 @@ class _StagingSlots:
         self.rows = rows
         self.piece_len = piece_len
         self._slots: list[tuple] = []  # (padded, view, ends) free list
-        self._lock = threading.Lock()
+        self._lock = named_lock("sched.staging._lock")
 
     def stage(self, chunk: list[bytes], rows: int | None = None):
         """Checkout a slot and stage ``chunk`` into its first ``rows``
@@ -548,7 +548,7 @@ class _Sha1DevicePlane:
 
         self._verifier = TPUVerifier(piece_length=bucket, batch_size=batch)
         self._slots = _StagingSlots(self._verifier.batch_size, bucket)
-        self._device_lock = threading.Lock()
+        self._device_lock = named_lock("sched.sha1_plane._device_lock")
 
     @staticmethod
     def launch_geometry(n_rows: int, bucket: int) -> tuple[int, int]:
@@ -593,7 +593,7 @@ class _Sha256DevicePlane:
         self._slots = _StagingSlots(batch, bucket)
         # serialize the jitted call: concurrent entry from pipelined
         # worker threads can deadlock the XLA runtime (see sha1 plane)
-        self._device_lock = threading.Lock()
+        self._device_lock = named_lock("sched.sha256_scan_plane._device_lock")
 
     @staticmethod
     def launch_geometry(n_rows: int, bucket: int) -> tuple[int, int]:
@@ -667,7 +667,7 @@ class _Sha256PallasPlane:
         self._interpret = interpret
         self._slots = _StagingSlots(self._batch, bucket)
         self._plans: dict[int, tuple[int, int, bool]] = {}  # n -> (rows, ts, il2)
-        self._device_lock = threading.Lock()
+        self._device_lock = named_lock("sched.sha256_pallas_plane._device_lock")
 
     @staticmethod
     def launch_geometry(n_rows: int, bucket: int) -> tuple[int, int]:
@@ -752,7 +752,7 @@ class HashPlaneScheduler:
         self._cpu_fallback_launches = 0
         # the only fault counter touched off the event loop (worker
         # threads, possibly in different lanes) — needs its own lock
-        self._counter_lock = threading.Lock()
+        self._counter_lock = named_lock("sched._counter_lock")
         self._failed_pieces = 0  # tickets that exhausted retry+bisection
         # rollup of evicted auto-registered tenants so served/shed totals
         # stay monotonic after their per-tenant series disappear

@@ -20,7 +20,8 @@ from __future__ import annotations
 
 import hashlib
 import os
-import threading
+
+from torrent_tpu.analysis.sanitizer import named_lock
 from typing import Iterator, Protocol
 
 import numpy as np
@@ -103,7 +104,7 @@ class Storage:
         # Exact byte offsets of blocks already written (duplicate-write
         # suppression, storage.ts:39,67-87 — fixed per SURVEY §8.15).
         self._written: set[int] = set()
-        self._lock = threading.Lock()
+        self._lock = named_lock("storage.written._lock")
 
     # ------------------------------------------------------------ mapping
 
@@ -310,7 +311,7 @@ class FsStorage:
     def __init__(self, root: str | os.PathLike):
         self.root = os.fspath(root)
         self._handles: dict[tuple[str, ...], object] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("storage.fs._lock")
         # deselected files: their boundary-piece spill is routed into a
         # hidden .parts mirror instead of creating visible stub files
         # (the partfile behavior of long-lived clients)

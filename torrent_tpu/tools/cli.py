@@ -1012,6 +1012,28 @@ def _cmd_fabric_verify(args) -> int:
     return asyncio.run(_fabric_verify(args))
 
 
+def _cmd_lint(args) -> int:
+    """Static concurrency/invariant analysis gate (torrent_tpu/analysis)."""
+    from torrent_tpu.analysis.lint import main as lint_main
+
+    argv = []
+    if args.root:
+        argv += ["--root", args.root]
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    if args.passes:
+        argv += ["--passes", args.passes]
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    if args.update_baseline:
+        argv.append("--update-baseline")
+    if args.json:
+        argv.append("--json")
+    if args.graph:
+        argv.append("--graph")
+    return lint_main(argv)
+
+
 def _cmd_doctor(args) -> int:
     # run_cli, not main: the triage tool must not run its checks inside
     # an interpreter wired to the device plugin it is triaging — it
@@ -1024,6 +1046,8 @@ def _cmd_doctor(args) -> int:
         argv.append("--skip-swarm")
     if getattr(args, "fabric", False):
         argv.append("--fabric")
+    if getattr(args, "lint", False):
+        argv.append("--lint")
     if getattr(args, "json", False):
         argv.append("--json")
     return doctor_cli(argv)
@@ -1624,6 +1648,28 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=_cmd_fabric_verify)
 
     sp = sub.add_parser(
+        "lint",
+        help="concurrency/invariant static analysis (lock order, "
+        "blocking-in-async, device-under-lock, determinism)",
+    )
+    sp.add_argument("--root", default=None,
+                    help="package dir to lint (default: installed torrent_tpu)")
+    sp.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: analysis_baseline.json "
+                    "next to the package)")
+    sp.add_argument("--passes", default=None, metavar="A,B",
+                    help="comma-separated pass subset")
+    sp.add_argument("--no-baseline", action="store_true",
+                    help="raw findings; exit 1 if any")
+    sp.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline, keeping justifications")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable findings report")
+    sp.add_argument("--graph", action="store_true",
+                    help="dump the static lock-acquisition graph")
+    sp.set_defaults(fn=_cmd_lint)
+
+    sp = sub.add_parser(
         "doctor", help="environment triage: deps, device, kernels, swarm smoke"
     )
     sp.add_argument("--device-wait", type=float, default=20.0)
@@ -1632,6 +1678,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also run the verify-fabric self-test: two local "
                     "worker processes plan/execute/heartbeat, one dies "
                     "mid-run, the survivor adopts its shard")
+    sp.add_argument("--lint", action="store_true",
+                    help="also run the analysis-plane smoke: all four "
+                    "static passes clean against the committed baseline")
     sp.add_argument("--json", action="store_true",
                     help="emit a machine-readable JSON summary line")
     sp.set_defaults(fn=_cmd_doctor)

@@ -603,6 +603,29 @@ def _fabric_smoke(tmp: str) -> str:
     )
 
 
+def _lint_smoke() -> str:
+    """Analysis-plane smoke (``--lint``): run all four static passes
+    over the installed package and require a clean gate — zero findings
+    beyond the committed baseline (= what `torrent-tpu lint` enforces)."""
+    from torrent_tpu.analysis.findings import diff_baseline, load_baseline
+    from torrent_tpu.analysis.lint import default_baseline, default_root
+    from torrent_tpu.analysis.passes import ALL_PASS_NAMES, run_passes
+
+    root = default_root()
+    findings, _index = run_passes(root)
+    baseline = load_baseline(default_baseline(root))
+    diff = diff_baseline(findings, baseline)
+    if diff.new:
+        lines = "; ".join(f.format() for f in diff.new[:5])
+        raise AssertionError(
+            f"{len(diff.new)} finding(s) beyond baseline: {lines}"
+        )
+    return (
+        f"{len(ALL_PASS_NAMES)} passes, {len(findings)} findings, "
+        f"all baselined ({len(baseline)} baseline entries)"
+    )
+
+
 async def _bridge_smoke() -> None:
     from torrent_tpu.bridge.service import BridgeServer
     from torrent_tpu.codec.bencode import bdecode, bencode
@@ -674,6 +697,12 @@ def main(argv=None) -> int:
         "dies mid-run, the survivor adopts and sentinel-checks its shard",
     )
     ap.add_argument(
+        "--lint",
+        action="store_true",
+        help="also run the analysis-plane smoke: all four static passes "
+        "over the installed package, clean against the committed baseline",
+    )
+    ap.add_argument(
         "--json",
         action="store_true",
         help="emit one JSON object after the checks (machine-readable)",
@@ -740,6 +769,12 @@ def main(argv=None) -> int:
             _report("PASS", "v2 hash plane", detail)
         except Exception as e:
             _report("FAIL", "v2 hash plane", repr(e))
+    if args.lint:
+        try:
+            detail = _lint_smoke()
+            _report("PASS", "analysis plane", detail)
+        except Exception as e:
+            _report("FAIL", "analysis plane", repr(e))
     if args.fabric:
         with tempfile.TemporaryDirectory(prefix="doctor_fabric_") as tmp:
             try:

@@ -144,6 +144,67 @@ def render_sched_metrics(sched) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_tsan_metrics(snapshot: dict) -> str:
+    """Prometheus rendering of the concurrency sanitizer's counters.
+
+    ``snapshot`` is ``torrent_tpu.analysis.sanitizer.snapshot()``.
+    Appended to ``/metrics`` (bridge and MetricsServer) only while
+    TSAN mode is on — the series simply don't exist otherwise."""
+    s = snapshot
+    lines = [
+        "# HELP torrent_tpu_lock_wait_seconds_total Seconds threads spent waiting to acquire this lock",
+        "# TYPE torrent_tpu_lock_wait_seconds_total counter",
+    ]
+    locks = s.get("locks", {})
+    for name, st in sorted(locks.items()):
+        lines.append(
+            f'torrent_tpu_lock_wait_seconds_total{{lock="{_esc(name)}"}} '
+            f"{st['wait_total_s']:.6f}"
+        )
+    lines.append(
+        "# HELP torrent_tpu_lock_hold_max_seconds Longest single hold observed for this lock"
+    )
+    lines.append("# TYPE torrent_tpu_lock_hold_max_seconds gauge")
+    for name, st in sorted(locks.items()):
+        lines.append(
+            f'torrent_tpu_lock_hold_max_seconds{{lock="{_esc(name)}"}} '
+            f"{st['hold_max_s']:.6f}"
+        )
+    lines.append(
+        "# HELP torrent_tpu_lock_acquisitions_total Acquisitions of this lock"
+    )
+    lines.append("# TYPE torrent_tpu_lock_acquisitions_total counter")
+    for name, st in sorted(locks.items()):
+        lines.append(
+            f'torrent_tpu_lock_acquisitions_total{{lock="{_esc(name)}"}} '
+            f"{st['acquisitions']}"
+        )
+    lines.append(
+        "# HELP torrent_tpu_lock_contended_total Acquisitions that waited more than 1ms"
+    )
+    lines.append("# TYPE torrent_tpu_lock_contended_total counter")
+    for name, st in sorted(locks.items()):
+        lines.append(
+            f'torrent_tpu_lock_contended_total{{lock="{_esc(name)}"}} '
+            f"{st['contended']}"
+        )
+    lines += [
+        "# HELP torrent_tpu_lock_order_cycles_total Lock-order cycles observed at runtime (any nonzero value is a bug)",
+        "# TYPE torrent_tpu_lock_order_cycles_total counter",
+        f"torrent_tpu_lock_order_cycles_total {len(s.get('cycles', []))}",
+        "# HELP torrent_tpu_lock_long_holds_total Locks flagged by the hold-time watchdog",
+        "# TYPE torrent_tpu_lock_long_holds_total counter",
+        f"torrent_tpu_lock_long_holds_total {s.get('long_holds', 0)}",
+        "# HELP torrent_tpu_loop_stalls_total Event-loop callbacks that exceeded the stall threshold",
+        "# TYPE torrent_tpu_loop_stalls_total counter",
+        f"torrent_tpu_loop_stalls_total {s.get('loop_stalls', 0)}",
+        "# HELP torrent_tpu_loop_stall_max_seconds Longest single event-loop callback observed",
+        "# TYPE torrent_tpu_loop_stall_max_seconds gauge",
+        f"torrent_tpu_loop_stall_max_seconds {s.get('loop_stall_max_s', 0.0):.6f}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
 def render_fabric_metrics(snapshot: dict) -> str:
     """Prometheus rendering of one process's verify-fabric gauges.
 
@@ -310,6 +371,10 @@ class MetricsServer:
                 text = render_metrics(self.client)
                 if self.scheduler is not None:
                     text += render_sched_metrics(self.scheduler)
+                from torrent_tpu.analysis import sanitizer
+
+                if sanitizer.is_enabled():
+                    text += render_tsan_metrics(sanitizer.snapshot())
                 body = text.encode()
                 status = "200 OK"
                 ctype = "text/plain; version=0.0.4; charset=utf-8"

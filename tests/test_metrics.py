@@ -56,6 +56,124 @@ class TestRenderFormat:
         assert _esc('na"me\\x\n') == 'na\\"me\\\\x\\n'
 
 
+def prom_lint(text: str) -> None:
+    """Prometheus text-format lint: every sample belongs to a family
+    that declared # HELP and # TYPE before it, histogram suffixes map
+    to a histogram-typed family, and no series (name + label set) is
+    emitted twice."""
+    helps: set[str] = set()
+    types: dict[str, str] = {}
+    seen_series: set[str] = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            helps.add(line.split(" ", 3)[2])
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        assert _SAMPLE.match(line), f"malformed sample: {line!r}"
+        series = line.rsplit(" ", 1)[0]
+        assert series not in seen_series, f"duplicate series: {series!r}"
+        seen_series.add(series)
+        name = series.split("{", 1)[0]
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                family = name[: -len(suffix)]
+                assert types[family] == "histogram", (
+                    f"{name} uses histogram suffixes but {family} is "
+                    f"{types[family]}"
+                )
+                break
+        assert family in types, f"sample {name!r} has no # TYPE"
+        assert family in helps, f"sample {name!r} has no # HELP"
+
+
+class TestRendererEdgeCases:
+    """Renderers must survive fresh components and partial (degraded)
+    snapshots — /metrics is often scraped exactly when things are
+    half-initialized — and every output must pass the format lint."""
+
+    def test_fresh_scheduler_renders_clean(self):
+        from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
+        from torrent_tpu.utils.metrics import render_sched_metrics
+
+        sched = HashPlaneScheduler(SchedulerConfig(), hasher="cpu")
+        text = render_sched_metrics(sched)
+        prom_lint(text)
+        assert "torrent_tpu_sched_queue_pieces 0" in text
+        assert "torrent_tpu_sched_launches_total 0" in text
+
+    def test_sched_renderer_tolerates_missing_keys(self):
+        from torrent_tpu.utils.metrics import render_sched_metrics
+
+        class _Degraded:
+            def metrics_snapshot(self):
+                return {"queue_pieces": 3}  # everything else absent
+
+        text = render_sched_metrics(_Degraded())
+        prom_lint(text)
+        assert "torrent_tpu_sched_queue_pieces 3" in text
+        assert "torrent_tpu_sched_queue_bytes 0" in text
+
+    def test_fabric_renderer_tolerates_empty_snapshot(self):
+        from torrent_tpu.utils.metrics import render_fabric_metrics
+
+        text = render_fabric_metrics({})
+        prom_lint(text)
+        assert 'torrent_tpu_fabric_state{pid="0"} 3' in text  # unknown = failed
+
+    def test_fabric_renderer_partial_snapshot(self):
+        from torrent_tpu.utils.metrics import render_fabric_metrics
+
+        text = render_fabric_metrics({"pid": 2, "state": "running", "units_done": 4})
+        prom_lint(text)
+        assert 'torrent_tpu_fabric_state{pid="2"} 1' in text
+        assert 'torrent_tpu_fabric_units{pid="2",kind="done"} 4' in text
+        assert 'torrent_tpu_fabric_shard_bytes{pid="2"} 0' in text
+
+    def test_tsan_renderer_empty_snapshot(self):
+        from torrent_tpu.utils.metrics import render_tsan_metrics
+
+        text = render_tsan_metrics({})
+        prom_lint(text)
+        assert "torrent_tpu_lock_order_cycles_total 0" in text
+
+    def test_obs_render_lints(self):
+        from torrent_tpu.obs import histograms, render_obs_metrics
+
+        histograms().get(
+            "torrent_tpu_sched_queue_wait_seconds", help="x", lane="sha1/64"
+        ).observe(0.004)
+        prom_lint(render_obs_metrics())
+
+    def test_full_exposition_concatenation_lints(self):
+        """What the bridge actually serves: sched + obs (+ tsan) in one
+        payload must still have unique series and complete headers."""
+        from torrent_tpu.analysis import sanitizer
+        from torrent_tpu.obs import render_obs_metrics
+        from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
+        from torrent_tpu.utils.metrics import (
+            render_fabric_metrics,
+            render_sched_metrics,
+            render_tsan_metrics,
+        )
+
+        sched = HashPlaneScheduler(SchedulerConfig(), hasher="cpu")
+        text = (
+            render_sched_metrics(sched)
+            + render_fabric_metrics({"pid": 0})
+            + render_obs_metrics()
+            + render_tsan_metrics(sanitizer.TsanState().snapshot())
+        )
+        prom_lint(text)
+
+
 class TestLiveScrape:
     def test_scrape_during_swarm(self):
         async def go():

@@ -1,0 +1,870 @@
+"""Observability plane tests (torrent_tpu/obs): span tracer, latency
+histograms, flight recorder, and their integrations — the ISSUE 6
+acceptance criteria live here.
+
+* A bridge verify request with ``X-Trace-Id: t1`` yields, via
+  ``GET /v1/trace?id=t1``, an ordered span tree covering enqueue →
+  admission → lane-wait → launch → digest with monotonic durations.
+* ``/metrics`` exposes valid Prometheus histogram series for the
+  queue-wait and launch stages with the correct
+  ``text/plain; version=0.0.4`` content type.
+* A fault-injected retry-exhausted launch and a breaker-open
+  transition each produce exactly one flight-recorder dump carrying
+  the failing ticket's spans and the breaker state.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import os
+import time
+
+import pytest
+
+from torrent_tpu.obs import (
+    fabric_trace_id,
+    flight_recorder,
+    heartbeat_span_context,
+    histograms,
+    tracer,
+    valid_trace_id,
+)
+from torrent_tpu.obs.hist import BUCKET_BOUNDS, HistogramRegistry
+from torrent_tpu.obs.recorder import FlightRecorder, _redact
+from torrent_tpu.obs.tracer import Tracer
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    """Dump counts are asserted exactly; start each test clean (the
+    recorder is process-global by design)."""
+    flight_recorder().clear()
+    yield
+    flight_recorder().clear()
+
+
+def span_names(tree):
+    def walk(node):
+        yield node["name"]
+        for c in node["children"]:
+            yield from walk(c)
+
+    return [n for root in tree["spans"] for n in walk(root)]
+
+
+def flat_spans(tree):
+    def walk(node):
+        yield node
+        for c in node["children"]:
+            yield from walk(c)
+
+    return [s for root in tree["spans"] for s in walk(root)]
+
+
+# --------------------------------------------------------------- tracer
+
+
+class TestTracer:
+    def test_span_nesting_and_tree_order(self):
+        t = Tracer()
+        tid = "t-nest"
+        with t.span("root", trace_id=tid) as rid:
+            with t.span("child-a"):
+                pass
+            with t.span("child-b"):
+                pass
+        tree = t.trace_tree(tid)
+        assert tree["span_count"] == 3
+        root = tree["spans"][0]
+        assert root["name"] == "root" and root["span_id"] == rid
+        assert [c["name"] for c in root["children"]] == ["child-a", "child-b"]
+        # monotonic: every child starts at/after the root, durations >= 0
+        for s in flat_spans(tree):
+            assert s["start_ms"] >= 0 and s["duration_ms"] >= 0
+
+    def test_span_without_context_is_noop(self):
+        t = Tracer()
+        with t.span("orphan") as sid:
+            assert sid is None
+        assert t.trace_ids() == []
+
+    def test_error_status_recorded(self):
+        t = Tracer()
+        with pytest.raises(ValueError):
+            with t.span("boom", trace_id="t-err"):
+                raise ValueError("nope")
+        tree = t.trace_tree("t-err")
+        span = tree["spans"][0]
+        assert span["status"] == "error"
+        assert "nope" in span["attrs"]["error"]
+
+    def test_attr_cleaning_strips_payload_bytes(self):
+        t = Tracer()
+        t.add_span("t-attr", "s", payload=b"\x00" * 4096, note="x" * 500, n=3)
+        attrs = t.trace_tree("t-attr")["spans"][0]["attrs"]
+        assert attrs["payload"] == "<4096 bytes>"
+        assert len(attrs["note"]) <= 201 and attrs["note"].endswith("…")
+        assert attrs["n"] == 3
+
+    def test_trace_store_is_bounded(self):
+        t = Tracer(max_traces=4, max_spans_per_trace=3)
+        for i in range(10):
+            t.add_span(f"t{i}", "s")
+        assert len(t.trace_ids()) == 4
+        for _ in range(10):
+            t.add_span("t9", "extra")
+        tree = t.trace_tree("t9")
+        assert tree["span_count"] == 3
+        assert tree["dropped_spans"] == 8
+
+    def test_trace_id_validation(self):
+        assert valid_trace_id("t1")
+        assert valid_trace_id("a-b_c.9" * 8)
+        assert not valid_trace_id("")
+        assert not valid_trace_id("x" * 65)
+        assert not valid_trace_id("bad id\n")
+        assert not valid_trace_id('q"uote')
+
+    def test_mint_is_unique(self):
+        t = Tracer()
+        ids = {t.mint() for _ in range(100)}
+        assert len(ids) == 100
+        assert all(valid_trace_id(i) for i in ids)
+
+    def test_fabric_ids_deterministic(self):
+        assert fabric_trace_id("abcdef0123456789", 3) == fabric_trace_id(
+            "abcdef0123456789", 3
+        )
+        ctx = heartbeat_span_context(fabric_trace_id("abcdef0123456789", 3), 7)
+        assert ctx == {"seq": 7, "trace": "fabric-abcdef012345-p3"}
+
+
+# ----------------------------------------------------------- histograms
+
+
+class TestHistograms:
+    def test_bucket_placement_and_render(self):
+        reg = HistogramRegistry()
+        h = reg.get("x_seconds", help="test family", lane="sha1/64")
+        h.observe(0.0)  # below the lowest bound -> first bucket
+        h.observe(1.5)  # between 1 and 2 -> le=2 bucket
+        h.observe(1e9)  # beyond every bound -> +Inf only
+        counts, count, total = h.snapshot()
+        assert count == 3 and total == pytest.approx(1e9 + 1.5)
+        assert counts[0] == 1 and counts[-1] == 1
+        text = reg.render()
+        assert "# TYPE x_seconds histogram" in text
+        # cumulative: +Inf bucket equals _count
+        assert 'x_seconds_bucket{lane="sha1/64",le="+Inf"} 3' in text
+        assert 'x_seconds_count{lane="sha1/64"} 3' in text
+        # every configured bound appears
+        assert text.count("x_seconds_bucket{") == len(BUCKET_BOUNDS) + 1
+
+    def test_cumulative_monotone(self):
+        reg = HistogramRegistry()
+        h = reg.get("y_seconds")
+        h.observe_batch([2.0 ** k for k in range(-20, 8)])
+        lines = [
+            line for line in reg.render().splitlines() if "y_seconds_bucket" in line
+        ]
+        values = [int(line.rsplit(" ", 1)[1]) for line in lines]
+        assert values == sorted(values)
+        assert values[-1] == h.snapshot()[1]
+
+    def test_observe_batch_matches_singles(self):
+        reg = HistogramRegistry()
+        a = reg.get("a_seconds")
+        b = reg.get("b_seconds")
+        vals = [0.001, 0.5, 3.0, 70.0]
+        a.observe_batch(vals)
+        for v in vals:
+            b.observe(v)
+        assert a.snapshot()[0] == b.snapshot()[0]
+
+    def test_label_cardinality_bounded(self):
+        reg = HistogramRegistry(max_series=4)
+        for i in range(50):
+            reg.get("z_seconds", tenant=f"t{i}").observe(0.01)
+        text = reg.render()
+        # 4 real series + the shared overflow series
+        assert text.count("z_seconds_count") == 5
+        assert 'z_seconds_count{overflow="true"} 46' in text
+
+
+# ------------------------------------------------------ flight recorder
+
+
+class TestFlightRecorder:
+    def test_redaction(self):
+        redacted = _redact(
+            {"payload": b"\xff" * 1000, "msg": "y" * 1000, "n": 7,
+             "nested": {"deep": [b"zz", "ok"]}}
+        )
+        assert redacted["payload"] == "<1000 bytes>"
+        assert len(redacted["msg"]) <= 301
+        assert redacted["n"] == 7
+        assert redacted["nested"]["deep"] == ["<2 bytes>", "ok"]
+        json.dumps(redacted)  # must be JSON-clean
+
+    def test_trigger_bounded_ring_and_counts(self):
+        rec = FlightRecorder(max_dumps=3)
+        for i in range(5):
+            rec.trigger("breaker_open", detail={"i": i})
+        dumps = rec.dumps()
+        assert len(dumps) == 3
+        assert [d["detail"]["i"] for d in dumps] == [2, 3, 4]
+        assert rec.counts() == {"breaker_open": 5}
+        assert (
+            'torrent_tpu_flight_dumps_total{reason="breaker_open"} 5'
+            in rec.render_metrics()
+        )
+
+    def test_dump_carries_named_traces(self):
+        t = tracer()
+        tid = t.mint()
+        t.add_span(tid, "the-failing-span")
+        dump = flight_recorder().trigger("retry_exhausted", trace_ids=[tid])
+        assert tid in dump["traces"]
+        assert "the-failing-span" in span_names(dump["traces"][tid])
+
+    def test_dump_written_to_flight_dir(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORRENT_TPU_FLIGHT_DIR", str(tmp_path))
+        dump = flight_recorder().trigger("fabric_distrust", detail={"unit": 1})
+        # filename carries a per-run token (a restarted process must not
+        # overwrite the previous run's evidence) + the dump seq
+        pattern = f"blackbox_*_{dump['seq']:04d}.json"
+        deadline = time.monotonic() + 5  # written off-thread
+        while not list(tmp_path.glob(pattern)) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        (path,) = tmp_path.glob(pattern)
+        on_disk = json.loads(path.read_text())
+        assert on_disk["reason"] == "fabric_distrust"
+        assert on_disk["detail"] == {"unit": 1}
+
+
+# ------------------------------------------------- scheduler lifecycle
+
+
+class TestSchedulerTracing:
+    def test_traced_submission_full_lifecycle(self):
+        from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
+
+        async def go():
+            t = tracer()
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=8, flush_deadline=0.02),
+                hasher="cpu",
+            )
+            await sched.start()
+            try:
+                pieces = [bytes([i]) * 512 for i in range(4)]
+                exp = [hashlib.sha1(p).digest() for p in pieces]
+                tid = t.mint()
+                with t.span("request", trace_id=tid):
+                    ok = await sched.submit("acme", pieces, expected=exp)
+                assert ok == b"\x01" * 4
+            finally:
+                await sched.close()
+            tree = t.trace_tree(tid)
+            names = span_names(tree)
+            for stage in ("sched.enqueue", "sched.admission",
+                          "sched.lane_wait", "sched.launch", "sched.digest",
+                          "sched.verdict"):
+                assert stage in names, names
+            # ordered: start offsets are non-decreasing through the chain
+            by_name = {s["name"]: s for s in flat_spans(tree)}
+            chain = ["sched.enqueue", "sched.lane_wait", "sched.launch",
+                     "sched.digest"]
+            starts = [by_name[n]["start_ms"] for n in chain]
+            assert starts == sorted(starts)
+            assert by_name["sched.verdict"]["attrs"]["valid"] == 4
+
+        run(go())
+
+    def test_shed_records_error_span(self):
+        from torrent_tpu.sched import (
+            HashPlaneScheduler,
+            SchedRejected,
+            SchedulerConfig,
+        )
+
+        async def go():
+            t = tracer()
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=4, max_queue_bytes=64,
+                                max_tenant_bytes=64),
+                hasher="cpu",
+            )
+            await sched.start()
+            try:
+                tid = t.mint()
+                with t.span("request", trace_id=tid):
+                    with pytest.raises(SchedRejected):
+                        await sched.submit("greedy", [b"x" * 4096])
+            finally:
+                await sched.close()
+            tree = t.trace_tree(tid)
+            shed = [s for s in flat_spans(tree) if s["name"] == "sched.shed"]
+            assert len(shed) == 1 and shed[0]["status"] == "error"
+            assert shed[0]["attrs"]["reason"] == "queue full"
+
+        run(go())
+
+    def test_retry_exhausted_exactly_one_dump(self):
+        from torrent_tpu.sched import (
+            FaultPlan,
+            HashPlaneScheduler,
+            SchedLaunchError,
+            SchedulerConfig,
+        )
+
+        async def go():
+            t = tracer()
+            plan = FaultPlan(payload_prefix=b"\xbd\xbd")
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=4, flush_deadline=0.02,
+                    plane_factory=plan.plane_factory(hasher="cpu"),
+                ),
+                hasher="cpu",
+            )
+            await sched.start()
+            tid = t.mint()
+            try:
+                with t.span("request", trace_id=tid):
+                    with pytest.raises(SchedLaunchError):
+                        await sched.submit("bad", [b"\xbd\xbd" + b"p" * 64])
+            finally:
+                await sched.close()
+            dumps = flight_recorder().dumps()
+            assert [d["reason"] for d in dumps] == ["retry_exhausted"]
+            dump = dumps[0]
+            # the dump carries the failing ticket's spans...
+            assert tid in dump["traces"]
+            names = span_names(dump["traces"][tid])
+            assert "sched.launch" in names and "sched.digest" in names
+            launch = [
+                s for s in flat_spans(dump["traces"][tid])
+                if s["name"] == "sched.launch"
+            ][0]
+            assert launch["status"] == "error"
+            assert launch["attrs"]["kind"] == "deterministic"
+            # ...and the breaker/scheduler state
+            sched_snap = dump["snapshots"]["sched"]
+            assert sched_snap["failed_pieces"] == 1
+            assert "sha1/128" in sched_snap["breakers"]
+
+        run(go())
+
+    def test_bisected_double_failure_single_digest_span(self):
+        """A submission whose halves BOTH terminally fail must get one
+        sched.digest span, not one per failing demux."""
+        from torrent_tpu.sched import (
+            FaultPlan,
+            HashPlaneScheduler,
+            SchedLaunchError,
+            SchedulerConfig,
+        )
+
+        async def go():
+            t = tracer()
+            plan = FaultPlan(payload_prefix=b"\xbd\xbd")
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=2, flush_deadline=0.02,
+                    plane_factory=plan.plane_factory(hasher="cpu"),
+                ),
+                hasher="cpu",
+            )
+            await sched.start()
+            tid = t.mint()
+            try:
+                with t.span("request", trace_id=tid):
+                    with pytest.raises(SchedLaunchError):
+                        # both pieces poisoned: the bisected halves each
+                        # fail terminally in separate demux calls
+                        await sched.submit(
+                            "bad", [b"\xbd\xbd" + b"a" * 64, b"\xbd\xbd" + b"b" * 64]
+                        )
+            finally:
+                await sched.close()
+            spans = flat_spans(t.trace_tree(tid))
+            assert len([s for s in spans if s["name"] == "sched.digest"]) == 1
+
+        run(go())
+
+    def test_breaker_open_exactly_one_dump(self):
+        from torrent_tpu.sched import (
+            FaultPlan,
+            HashPlaneScheduler,
+            SchedulerConfig,
+        )
+
+        async def go():
+            plan = FaultPlan(fail_first=2)
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=4, flush_deadline=0.02, breaker_threshold=2,
+                    launch_retries=2, breaker_cooldown=300.0,
+                    plane_factory=plan.plane_factory(hasher="cpu"),
+                ),
+                hasher="cpu",
+            )
+            await sched.start()
+            try:
+                pieces = [bytes([i]) * 128 for i in range(2)]
+                want = [hashlib.sha1(p).digest() for p in pieces]
+                # two transient failures trip the breaker; the third
+                # attempt rides the CPU fallback and succeeds
+                assert await sched.submit("t", pieces) == want
+                snap = sched.metrics_snapshot()
+                assert next(iter(snap["breakers"].values()))["state"] == "open"
+            finally:
+                await sched.close()
+            dumps = flight_recorder().dumps()
+            assert [d["reason"] for d in dumps] == ["breaker_open"]
+            breakers = dumps[0]["snapshots"]["sched"]["breakers"]
+            assert next(iter(breakers.values()))["state"] == "open"
+
+        run(go())
+
+    def test_stage_histograms_recorded(self):
+        from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
+
+        async def go():
+            sched = HashPlaneScheduler(
+                SchedulerConfig(batch_target=4, flush_deadline=0.02),
+                hasher="cpu",
+            )
+            await sched.start()
+            try:
+                await sched.submit("histo-tenant", [b"q" * 256])
+            finally:
+                await sched.close()
+            text = histograms().render()
+            assert "torrent_tpu_sched_queue_wait_seconds_bucket" in text
+            assert "torrent_tpu_sched_launch_seconds_sum" in text
+            assert (
+                'torrent_tpu_sched_e2e_seconds_count{tenant="histo-tenant"}'
+                in text
+            )
+
+        run(go())
+
+
+# -------------------------------------------------------------- bridge
+
+
+async def _http(port, method, path, headers=None, body=b""):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    head = [f"{method} {path} HTTP/1.1", "Host: x",
+            f"Content-Length: {len(body)}"]
+    for k, v in (headers or {}).items():
+        head.append(f"{k}: {v}")
+    writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + body)
+    await writer.drain()
+    status = int((await reader.readline()).split()[1])
+    hdrs = {}
+    clen = 0
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b""):
+            break
+        if b":" in line:
+            k, _, v = line.partition(b":")
+            hdrs[k.strip().lower().decode()] = v.strip().decode()
+            if k.strip().lower() == b"content-length":
+                clen = int(v)
+    resp = await reader.readexactly(clen)
+    writer.close()
+    return status, hdrs, resp
+
+
+class TestBridgeTracing:
+    def test_verify_with_trace_header_yields_span_tree(self):
+        """The ISSUE acceptance path: X-Trace-Id: t1 on /v1/verify, then
+        GET /v1/trace?id=t1 shows the ordered lifecycle."""
+        from torrent_tpu.bridge.service import serve_bridge
+        from torrent_tpu.codec.bencode import bencode
+
+        async def go():
+            server = await serve_bridge(port=0, hasher="cpu")
+            try:
+                pieces = [b"a" * 100, b"b" * 100]
+                exp = [hashlib.sha1(p).digest() for p in pieces]
+                body = bencode({b"pieces": pieces, b"expected": exp})
+                st, hdrs, _ = await _http(
+                    server.port, "POST", "/v1/verify",
+                    {"X-Trace-Id": "t1", "X-Tenant": "deno"}, body,
+                )
+                assert st == 200
+                assert hdrs["x-trace-id"] == "t1"  # honored + echoed
+                st, hdrs, resp = await _http(server.port, "GET", "/v1/trace?id=t1")
+                assert st == 200
+                assert hdrs["content-type"] == "application/json"
+                tree = json.loads(resp)
+                names = span_names(tree)
+                for stage in ("bridge.request", "sched.enqueue",
+                              "sched.admission", "sched.lane_wait",
+                              "sched.launch", "sched.digest"):
+                    assert stage in names, names
+                # ordered with monotonic durations
+                spans = flat_spans(tree)
+                assert all(s["duration_ms"] >= 0 for s in spans)
+                root = tree["spans"][0]
+                assert root["name"] == "bridge.request"
+                assert root["attrs"]["tenant"] == "deno"
+                kids = root["children"][0]["children"]
+                starts = [k["start_ms"] for k in kids]
+                assert starts == sorted(starts)
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+    def test_minted_trace_id_echoed_and_bad_header_replaced(self):
+        from torrent_tpu.bridge.service import serve_bridge
+
+        async def go():
+            server = await serve_bridge(port=0, hasher="cpu")
+            try:
+                st, hdrs, _ = await _http(server.port, "GET", "/v1/info")
+                assert st == 200
+                minted = hdrs["x-trace-id"]
+                assert valid_trace_id(minted)
+                st, hdrs, _ = await _http(
+                    server.port, "GET", "/v1/info",
+                    {"X-Trace-Id": 'evil"id\x01' + "x" * 100},
+                )
+                assert valid_trace_id(hdrs["x-trace-id"])
+                assert hdrs["x-trace-id"] != minted
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+    def test_metrics_content_type_and_histogram_series(self):
+        from torrent_tpu.bridge.service import serve_bridge
+        from torrent_tpu.codec.bencode import bencode
+
+        async def go():
+            server = await serve_bridge(port=0, hasher="cpu")
+            try:
+                body = bencode({b"pieces": [b"x" * 64]})
+                await _http(server.port, "POST", "/v1/digests", {}, body)
+                st, hdrs, resp = await _http(server.port, "GET", "/metrics")
+                assert st == 200
+                assert hdrs["content-type"] == (
+                    "text/plain; version=0.0.4; charset=utf-8"
+                )
+                text = resp.decode()
+                for family in (
+                    "torrent_tpu_sched_queue_wait_seconds",
+                    "torrent_tpu_sched_launch_seconds",
+                    "torrent_tpu_bridge_request_seconds",
+                ):
+                    assert f"# TYPE {family} histogram" in text
+                    assert f"{family}_bucket" in text
+                    assert f"{family}_sum" in text
+                    assert f"{family}_count" in text
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+    def test_trace_listing_and_unknown_id(self):
+        from torrent_tpu.bridge.service import serve_bridge
+
+        async def go():
+            server = await serve_bridge(port=0, hasher="cpu")
+            try:
+                st, _, resp = await _http(server.port, "GET", "/v1/trace")
+                assert st == 200
+                listing = json.loads(resp)
+                assert set(listing) == {"dump_counts", "dumps", "traces"}
+                st, _, _ = await _http(server.port, "GET", "/v1/trace?id=absent")
+                assert st == 404
+            finally:
+                server.close()
+                await server.wait_closed()
+
+        run(go())
+
+
+# -------------------------------------------------------------- fabric
+
+
+class TestFabricTracing:
+    def test_heartbeat_carries_deterministic_span_context(self, tmp_path):
+        from test_fabric import cpu_sched, make_library
+        from torrent_tpu.fabric import FabricConfig, FabricExecutor, FileHeartbeat
+        from torrent_tpu.fabric.plan import plan_library
+
+        async def go():
+            items, _, _ = make_library(tmp_path, [6])
+            plan = plan_library([info for _, info in items], 1)
+            hb_dir = tmp_path / "hb"
+            sched = cpu_sched()
+            await sched.start()
+            try:
+                ex = FabricExecutor(
+                    items, plan, 0, sched,
+                    FabricConfig(heartbeat_interval=0.05),
+                    transport=FileHeartbeat(str(hb_dir), 0),
+                )
+                await ex.run()
+            finally:
+                await sched.close()
+            payload = json.loads((hb_dir / "fabric_hb_0.json").read_text())
+            want_tid = fabric_trace_id(plan.fingerprint(), 0)
+            assert payload["span"]["trace"] == want_tid
+            assert payload["span"]["seq"] == payload["seq"]
+            # unit spans landed in the deterministic fabric trace
+            tree = tracer().trace_tree(want_tid)
+            names = span_names(tree)
+            assert "fabric.unit" in names and "fabric.run" in names
+            assert ex.metrics_snapshot()["trace_id"] == want_tid
+
+        run(go())
+
+    def test_sentinel_distrust_triggers_dump(self, tmp_path):
+        """A lying peer's verdicts fail the sentinel cross-check: the
+        distrust must leave exactly one black-box dump behind."""
+        from test_fabric import cpu_sched, make_library
+        from torrent_tpu.fabric import (
+            FabricConfig,
+            FabricExecutor,
+            FileHeartbeat,
+            pack_bits,
+        )
+        from torrent_tpu.fabric.plan import plan_library
+
+        import numpy as np
+
+        async def go():
+            PLEN = 16384
+            items, _, ddir = make_library(tmp_path, [12])
+            plan = plan_library(
+                [info for _, info in items], 2, unit_bytes=3 * PLEN
+            )
+            hb_dir = str(tmp_path / "hb")
+            # a dead peer (pid 1) claims its unit is all-valid — but its
+            # first piece is corrupt on disk, so the sentinel re-hash of
+            # exactly that piece must reject the verdicts
+            liar_unit = plan.units_for(1)[0]
+            payload = ddir / "lib0" / "payload.bin"
+            buf = bytearray(payload.read_bytes())
+            buf[liar_unit.start * PLEN + 11] ^= 0xFF
+            payload.write_bytes(bytes(buf))
+            FileHeartbeat(hb_dir, 1).exchange(
+                {
+                    "pid": 1, "seq": 1, "t": 0.0, "fp": plan.fingerprint(),
+                    "degraded": False,
+                    "done": {
+                        str(liar_unit.uid): pack_bits(
+                            np.ones(liar_unit.npieces, dtype=bool)
+                        )
+                    },
+                    "inflight": [], "distrust": [], "redone": [],
+                }
+            )
+            sched = cpu_sched()
+            await sched.start()
+            try:
+                ex = FabricExecutor(
+                    items, plan, 0, sched,
+                    FabricConfig(heartbeat_interval=0.05, lapse_after=0.2),
+                    transport=FileHeartbeat(hb_dir, 0),
+                )
+                await asyncio.wait_for(ex.run(), 60)
+                assert ex.metrics_snapshot()["sentinel_mismatches"] >= 1
+            finally:
+                await sched.close()
+            counts = flight_recorder().counts()
+            assert counts.get("fabric_distrust") == 1
+            dump = [
+                d for d in flight_recorder().dumps()
+                if d["reason"] == "fabric_distrust"
+            ][0]
+            assert dump["detail"]["peer"] == 1
+            assert dump["snapshots"]["fabric"]["pid"] == 0
+
+        run(go())
+
+
+# ------------------------------------------------------- tsan trigger
+
+
+class TestTsanCycleTrigger:
+    def test_observed_cycle_dumps_once(self, monkeypatch):
+        from torrent_tpu.analysis import sanitizer
+
+        st = sanitizer.TsanState()
+        # the notify hook only fires for the process-global state; point
+        # it at our private one so the deliberate cycle below registers
+        # without polluting the real sanitizer graph
+        monkeypatch.setattr(sanitizer, "_state", st)
+        a = sanitizer.SanitizedLock("A", st)
+        b = sanitizer.SanitizedLock("B", st)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:  # closes the A->B->A cycle
+                pass
+        assert len(st.cycles) == 1
+        counts = flight_recorder().counts()
+        assert counts.get("tsan_cycle") == 1
+        dump = flight_recorder().dumps()[-1]
+        assert dump["detail"]["cycle"] == ["A", "B"]
+
+    def test_private_state_cycles_do_not_dump(self):
+        from torrent_tpu.analysis import sanitizer
+
+        st = sanitizer.TsanState()
+        a = sanitizer.SanitizedLock("A", st)
+        b = sanitizer.SanitizedLock("B", st)
+        with a, b:
+            pass
+        with b, a:
+            pass
+        assert len(st.cycles) == 1
+        assert flight_recorder().counts().get("tsan_cycle") is None
+
+
+# ------------------------------------------------- satellites: log/env
+
+
+class TestLogSatellites:
+    def _fresh_root(self, monkeypatch, name):
+        """Re-run first-configure against a scratch logger hierarchy."""
+        from torrent_tpu.utils import log as tlog
+
+        monkeypatch.setattr(tlog, "_configured", False)
+        monkeypatch.setattr(tlog, "_ROOT", name)
+        return tlog
+
+    def test_json_lines_with_trace_id(self, monkeypatch, capsys):
+        monkeypatch.setenv("TORRENT_TPU_LOG_JSON", "1")
+        monkeypatch.setenv("TORRENT_TPU_LOG", "INFO")
+        tlog = self._fresh_root(monkeypatch, "tlogjson")
+        logger = tlog.get_logger("sub.system")
+        t = tracer()
+        with t.span("ctx", trace_id="t-log"):
+            logger.info("hello %s", "world")
+        err = capsys.readouterr().err.strip().splitlines()[-1]
+        rec = json.loads(err)
+        assert rec["level"] == "INFO"
+        assert rec["subsystem"] == "sub.system"
+        assert rec["msg"] == "hello world"
+        assert rec["trace_id"] == "t-log"
+        assert isinstance(rec["ts"], float)
+
+    def test_invalid_level_warns_once_and_falls_back(self, monkeypatch, capsys):
+        monkeypatch.delenv("TORRENT_TPU_LOG_JSON", raising=False)
+        monkeypatch.setenv("TORRENT_TPU_LOG", "DEUBG")
+        tlog = self._fresh_root(monkeypatch, "tlogwarn")
+        logger = tlog.get_logger("x")
+        tlog.get_logger("y")  # second call: no second warning
+        assert logging.getLogger("tlogwarn").level == logging.WARNING
+        err = capsys.readouterr().err
+        assert err.count("invalid TORRENT_TPU_LOG level 'DEUBG'") == 1
+        logger.debug("must not raise")
+
+
+class TestProfilerSatellite:
+    def test_env_resolved_lazily_per_call(self, monkeypatch):
+        from torrent_tpu.obs import profiler
+
+        monkeypatch.delenv("TORRENT_TPU_PROFILE", raising=False)
+        assert profiler.profile_dir() is None
+        # enabling AFTER import must take effect (the old utils/trace.py
+        # read the env at import time and ignored later changes)
+        monkeypatch.setenv("TORRENT_TPU_PROFILE", "/tmp/prof")
+        assert profiler.profile_dir() == "/tmp/prof"
+        monkeypatch.setenv("TORRENT_TPU_PROFILE_BATCHES", "3")
+        assert profiler.profile_batches() == 3
+        monkeypatch.setenv("TORRENT_TPU_PROFILE_BATCHES", "junk")
+        assert profiler.profile_batches() == 8
+        monkeypatch.setenv("TORRENT_TPU_PROFILE_BATCHES", "-2")
+        assert profiler.profile_batches() == 8
+
+    def test_utils_trace_shim_reexports(self):
+        from torrent_tpu.obs import profiler
+        from torrent_tpu.utils import trace as shim
+
+        assert shim.maybe_profile_batch is profiler.maybe_profile_batch
+        assert shim.annotate is profiler.annotate
+        assert shim.profile_dir is profiler.profile_dir
+
+    def test_profiler_capture_lifecycle(self, monkeypatch, tmp_path):
+        """Start/stop through monkeypatched jax.profiler hooks: the
+        capture must start on the first batch and stop after N."""
+        import jax
+
+        from torrent_tpu.obs import profiler
+
+        calls = []
+        monkeypatch.setattr(
+            jax.profiler, "start_trace", lambda d: calls.append(("start", d))
+        )
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace", lambda: calls.append(("stop",))
+        )
+        monkeypatch.setattr(profiler, "_trace_started", False)
+        monkeypatch.setattr(profiler, "_trace_done", False)
+        monkeypatch.setattr(profiler, "_batches_seen", 0)
+        monkeypatch.setenv("TORRENT_TPU_PROFILE", str(tmp_path))
+        monkeypatch.setenv("TORRENT_TPU_PROFILE_BATCHES", "2")
+        for _ in range(3):
+            with profiler.maybe_profile_batch("b"):
+                pass
+        assert calls == [("start", str(tmp_path)), ("stop",)]
+        assert profiler._trace_done
+
+
+# ----------------------------------------------------- CLI rendering
+
+
+class TestTraceCli:
+    def test_render_span_tree(self):
+        from torrent_tpu.tools.cli import _render_span_tree
+
+        t = Tracer()
+        with t.span("root", trace_id="t-cli", route="/v1/verify"):
+            with t.span("child"):
+                pass
+        out = _render_span_tree(t.trace_tree("t-cli"))
+        assert "trace t-cli — 2 span(s)" in out
+        assert "root" in out and "child" in out
+        assert "route=/v1/verify" in out
+
+    def test_dump_from_dir(self, tmp_path, capsys):
+        from torrent_tpu.tools.cli import main as cli_main
+
+        (tmp_path / "blackbox_0001.json").write_text(
+            json.dumps(
+                {"seq": 1, "reason": "breaker_open", "detail": {"lane": "sha1/64"},
+                 "recent_spans": [], "traces": {}}
+            )
+        )
+        rc = cli_main(["trace", "dump", "--dir", str(tmp_path)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "breaker_open" in out and "sha1/64" in out
+
+    def test_dump_from_dir_empty(self, tmp_path, capsys):
+        from torrent_tpu.tools.cli import main as cli_main
+
+        rc = cli_main(["trace", "dump", "--dir", str(tmp_path)])
+        assert rc == 1

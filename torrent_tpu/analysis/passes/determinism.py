@@ -7,9 +7,12 @@ coverage/adoption rules assume each process evaluates the same state.
 Wall-clock reads, randomness, and unordered ``set``/``dict`` iteration
 are the three ways nondeterminism leaks into those bytes.
 
-Scope is explicit (``SCOPE``): all of ``fabric/plan.py`` plus the
-executor functions that build, merge, or consume exchanged heartbeat
-state. Within scope, the pass flags:
+Scope is explicit (``SCOPE``): all of ``fabric/plan.py``, the executor
+functions that build, merge, or consume exchanged heartbeat state, and
+the obs-plane helpers whose output rides those heartbeats
+(``obs/tracer.py``'s span-context builders — trace ids and span
+payloads exchanged between processes must be as bit-stable as the
+verdicts they annotate). Within scope, the pass flags:
 
 * wall-clock reads (``time.time``, ``datetime.now`` …) — cross-host
   clock skew turns these into divergent values;
@@ -52,6 +55,9 @@ SCOPE: dict[str, frozenset[str]] = {
             "plan_payload_bytes",
         }
     ),
+    # span context carried in fabric heartbeat payloads: the obs plane's
+    # contribution to exchanged bytes must obey the same rules
+    "obs/tracer.py": frozenset({"fabric_trace_id", "heartbeat_span_context"}),
 }
 
 WALL_CLOCK = frozenset(

@@ -118,6 +118,7 @@ class TsanState:
     def on_acquired(self, lock, name: str, waited: float) -> None:
         stack = self._stack()
         now = time.monotonic()
+        new_cycle: tuple[str, ...] | None = None
         with self._meta:
             st = self.locks.get(name)
             if st is None:
@@ -130,13 +131,20 @@ class TsanState:
                 if held_name == name:
                     self.same_name_nesting += 1
                     continue
-                self._add_edge(held_name, name)
+                cyc = self._add_edge(held_name, name)
+                if cyc is not None:
+                    new_cycle = cyc
             self._held_registry[id(lock)] = (
                 name,
                 threading.current_thread().name,
                 now,
             )
         stack.append((name, id(lock)))
+        if new_cycle is not None:
+            # flight-recorder trigger OUTSIDE the meta lock: the dump
+            # itself acquires (sanitized) obs locks and re-takes meta
+            # for its tsan snapshot
+            _notify_cycle(self, new_cycle)
 
     def on_released(self, lock, name: str) -> None:
         now = time.monotonic()
@@ -154,12 +162,14 @@ class TsanState:
                 if st is not None:
                     st.hold_max = max(st.hold_max, now - entry[2])
 
-    def _add_edge(self, frm: str, to: str) -> None:
+    def _add_edge(self, frm: str, to: str) -> tuple[str, ...] | None:
         """Record frm -> to (held while acquiring); detect a new cycle.
-        Caller holds the meta lock."""
+        Caller holds the meta lock. Returns the normalized cycle when
+        this edge closed a NEW one (the caller notifies the flight
+        recorder after releasing meta), else None."""
         outs = self.edges.setdefault(frm, set())
         if to in outs:
-            return
+            return None
         outs.add(to)
         # does `frm` become reachable from `to` now? DFS on a small graph
         seen = set()
@@ -175,6 +185,8 @@ class TsanState:
                     "tsan: lock-order cycle observed: %s",
                     " -> ".join(norm + (norm[0],)),
                 )
+                return norm
+        return None
 
     def _find_path(self, start: str, goal: str, seen: set) -> list | None:
         if start == goal:
@@ -234,6 +246,23 @@ class TsanState:
 
 
 _state = TsanState()
+
+
+def _notify_cycle(state: "TsanState", cycle: tuple[str, ...]) -> None:
+    """One black-box dump per newly observed lock-order cycle. Global
+    state only: tests drive private TsanState instances through
+    deliberate cycles and must not pollute the process recorder. Lazy
+    import — obs depends on this module for named_lock."""
+    if state is not _state:
+        return
+    try:
+        from torrent_tpu.obs.recorder import flight_recorder
+
+        flight_recorder().trigger(
+            "tsan_cycle", detail={"cycle": list(cycle)}
+        )
+    except Exception:  # the sanitizer must never take the process down
+        log.exception("tsan cycle flight-recorder dump failed")
 
 
 def global_state() -> TsanState:

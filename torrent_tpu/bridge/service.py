@@ -10,7 +10,18 @@ codec every BitTorrent client already has:
   POST /v1/verify    body {pieces: [bytes, ...], expected: [20B, ...]}
                      → {ok: bytes}            (one 0x00/0x01 per piece)
   GET  /v1/info      → {backend, devices, batch} (capability probe)
-  GET  /metrics      → scheduler queue/fill/shed counters (Prometheus)
+  GET  /metrics      → scheduler queue/fill/shed counters + per-stage
+                       latency histograms (Prometheus text format 0.0.4)
+  GET  /v1/trace     → JSON: ?id=<trace> the ordered span tree for that
+                       trace; without id, the flight recorder's black-
+                       box dumps + known trace ids (torrent_tpu/obs)
+
+Every request runs under a trace span: an ``X-Trace-Id`` request header
+is honored (well-formed tokens only) or a fresh id is minted, the id is
+echoed back in the response, and the scheduler threads it through the
+ticket lifecycle (enqueue → admission/shed → lane wait → launch/retry/
+bisect → digest → verdict) so ``/v1/trace?id=…`` shows where a request
+spent its time.
 
   POST /v1/fabric/verify  body {items: [{torrent, root}, ...]}
                           → 202; starts a scheduler-fed library recheck
@@ -77,8 +88,17 @@ Hand-rolled asyncio HTTP — no web framework needed for six routes.
 from __future__ import annotations
 
 import asyncio
+import json
+import time
 
 from torrent_tpu.codec.bencode import BencodeError, bdecode, bencode
+from torrent_tpu.obs import (
+    flight_recorder,
+    histograms,
+    render_obs_metrics,
+    tracer,
+    valid_trace_id,
+)
 from torrent_tpu.sched import (
     FaultPlan,
     HashPlaneScheduler,
@@ -89,6 +109,20 @@ from torrent_tpu.sched import (
 from torrent_tpu.utils.log import get_logger
 
 log = get_logger("bridge")
+
+# request-latency histogram label set stays bounded: unknown paths
+# collapse into "other"
+_KNOWN_ROUTES = frozenset(
+    {
+        "/v1/digests", "/v1/verify", "/v1/info", "/v1/trace", "/metrics",
+        "/v1/fabric/verify", "/v1/fabric/status",
+        "/v1/stream/digests", "/v1/stream/verify",
+    }
+)
+_H_REQUEST = (
+    "torrent_tpu_bridge_request_seconds",
+    "Bridge HTTP request duration by route",
+)
 
 MAX_BODY = 1 << 30  # 1 GiB of piece data per buffered (non-stream) request
 # Cap on one streamed frame. 16 MiB is the practical BitTorrent piece-size
@@ -434,17 +468,42 @@ class BridgeServer:
                 if b":" in line:
                     k, v = line.split(b":", 1)
                     headers[k.strip().lower()] = v.strip()
-            if method == "POST" and target.startswith("/v1/stream/"):
-                body_reader = _BodyReader(reader, headers)
-                return await self._route_stream(writer, target, headers, body_reader)
+            # trace ids are minted HERE (or honored from X-Trace-Id when
+            # it is a well-formed token): every request runs inside a
+            # root span, the scheduler threads it through the ticket
+            # lifecycle, and _reply echoes it so the client can fetch
+            # the span tree from GET /v1/trace?id=…
+            raw_tid = headers.get(b"x-trace-id", b"").decode("latin-1").strip()
+            trace_id = raw_tid if valid_trace_id(raw_tid) else tracer().mint()
+            path = target.split("?")[0]
+            route = path if path in _KNOWN_ROUTES else "other"
+            t0 = time.monotonic()
             try:
-                content_length = int(headers.get(b"content-length", b"0") or 0)
-            except ValueError:
-                return await self._reply(writer, 400, b"bad content-length")
-            if content_length > MAX_BODY:
-                return await self._reply(writer, 413, b"body too large")
-            body = await reader.readexactly(content_length) if content_length else b""
-            await self._route(writer, method, target, body, headers)
+                with tracer().span(
+                    "bridge.request", trace_id=trace_id, method=method,
+                    target=path, tenant=self._tenant_of(headers),
+                ):
+                    if method == "POST" and target.startswith("/v1/stream/"):
+                        body_reader = _BodyReader(reader, headers)
+                        return await self._route_stream(
+                            writer, target, headers, body_reader
+                        )
+                    try:
+                        content_length = int(headers.get(b"content-length", b"0") or 0)
+                    except ValueError:
+                        return await self._reply(writer, 400, b"bad content-length")
+                    if content_length > MAX_BODY:
+                        return await self._reply(writer, 413, b"body too large")
+                    body = (
+                        await reader.readexactly(content_length)
+                        if content_length
+                        else b""
+                    )
+                    await self._route(writer, method, target, body, headers)
+            finally:
+                histograms().get(*_H_REQUEST, route=route).observe(
+                    time.monotonic() - t0
+                )
         except (asyncio.TimeoutError, asyncio.IncompleteReadError, ConnectionError, OSError):
             writer.close()
         except Exception as e:  # one bad request must not kill the sidecar
@@ -481,13 +540,21 @@ class BridgeServer:
                 text += render_fabric_metrics(
                     self._fabric["executors"][0].metrics_snapshot()
                 )
+            text += render_obs_metrics()
             from torrent_tpu.analysis import sanitizer
 
             if sanitizer.is_enabled():
                 from torrent_tpu.utils.metrics import render_tsan_metrics
 
                 text += render_tsan_metrics(sanitizer.snapshot())
-            return await self._reply(writer, 200, text.encode())
+            # the Prometheus exposition format has its own content type;
+            # collectors (and promtool) reject octet-stream
+            return await self._reply(
+                writer, 200, text.encode(),
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            )
+        if method == "GET" and target.split("?")[0] == "/v1/trace":
+            return await self._trace_route(writer, target)
         if method == "GET" and target == "/v1/fabric/status":
             return await self._reply(writer, 200, bencode(self._fabric_status()))
         if method != "POST":
@@ -675,6 +742,43 @@ class BridgeServer:
             }
         return out
 
+    async def _trace_route(self, writer, target: str):
+        """``GET /v1/trace`` — the obs plane's query surface.
+
+        ``?id=<trace>`` returns that trace's ordered span tree (the
+        ticket lifecycle a client tagged with ``X-Trace-Id``); without
+        an id it returns the flight recorder's black-box dumps plus the
+        known trace ids. JSON (sorted keys), not bencode: this is an
+        operator/debugging surface, not a data-plane wire format.
+        """
+        params: dict[str, str] = {}
+        for part in target.partition("?")[2].split("&"):
+            if "=" in part:
+                k, _, v = part.partition("=")
+                params[k] = v
+        tid = params.get("id")
+        if tid:
+            tree = tracer().trace_tree(tid)
+            if tree is None:
+                return await self._reply(
+                    writer, 404, b'{"error": "unknown trace id"}',
+                    content_type="application/json",
+                )
+            body = json.dumps(tree, sort_keys=True).encode()
+        else:
+            rec = flight_recorder()
+            body = json.dumps(
+                {
+                    "dump_counts": rec.counts(),
+                    "dumps": rec.dumps(),
+                    "traces": tracer().trace_ids(),
+                },
+                sort_keys=True,
+            ).encode()
+        return await self._reply(
+            writer, 200, body, content_type="application/json"
+        )
+
     async def _reply_launch_failed(self, writer, e: SchedLaunchError):
         # transient retry-exhausted failure: 503 + Retry-After (shed is
         # 429 — different remedy). A deterministic (payload-caused)
@@ -687,12 +791,24 @@ class BridgeServer:
             )
         return await self._reply(writer, 500, str(e).encode())
 
-    async def _reply(self, writer, status: int, body: bytes, headers=None):
+    async def _reply(
+        self,
+        writer,
+        status: int,
+        body: bytes,
+        headers=None,
+        content_type: str = "application/octet-stream",
+    ):
         try:
             head = (
-                f"HTTP/1.1 {status} X\r\nContent-Type: application/octet-stream\r\n"
+                f"HTTP/1.1 {status} X\r\nContent-Type: {content_type}\r\n"
                 f"Content-Length: {len(body)}\r\nConnection: close\r\n"
             )
+            # every traced request echoes its trace id, honored or
+            # minted, so the client can fetch GET /v1/trace?id=…
+            ctx = tracer().current_context()
+            if ctx is not None:
+                head += f"X-Trace-Id: {ctx[0]}\r\n"
             for k, v in (headers or {}).items():
                 head += f"{k}: {v}\r\n"
             head += "\r\n"

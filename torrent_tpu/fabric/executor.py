@@ -61,6 +61,8 @@ from dataclasses import dataclass
 import numpy as np
 
 from torrent_tpu.fabric.plan import FabricPlan, adoption_owner
+from torrent_tpu.obs.recorder import flight_recorder
+from torrent_tpu.obs.tracer import fabric_trace_id, heartbeat_span_context, tracer
 from torrent_tpu.utils.log import get_logger
 
 log = get_logger("fabric")
@@ -276,6 +278,11 @@ class FabricExecutor:
         self.transport = transport
         self.progress_cb = progress_cb
         self._fp = plan.fingerprint()
+        # deterministic trace id (plan fingerprint + pid): every process
+        # names the sweep the same way without exchanging random bytes,
+        # and the heartbeat span context stays inside the analysis
+        # plane's determinism pass
+        self._trace_id = fabric_trace_id(self._fp, pid)
         # local work state
         self._queue: deque[int] = deque(u.uid for u in plan.units_for(pid))
         self._status: dict[int, str] = {u: _PENDING for u in self._queue}
@@ -376,6 +383,7 @@ class FabricExecutor:
 
     async def run(self) -> None:
         self._state = "running"
+        t_run = time.monotonic()
         self.scheduler.register_tenant(
             self.config.tenant, weight=self.config.weight
         )
@@ -418,6 +426,13 @@ class FabricExecutor:
                         await hb_task
                     except (asyncio.CancelledError, Exception):
                         pass
+            tracer().add_span(
+                self._trace_id, "fabric.run", t0=t_run,
+                status="ok" if self._state == "done" else "error",
+                pid=self.pid, units_done=self._units_done,
+                units_adopted=self._units_adopted,
+                pieces_verified=self._pieces_verified,
+            )
 
     def _next_uid(self) -> int | None:
         while self._queue:
@@ -512,7 +527,12 @@ class FabricExecutor:
         # count pieces actually hashed — unreadable pieces and failed
         # launches must not inflate the verified gauge or progress
         self._pieces_verified += n_ok
-        self._unit_times.append(time.monotonic() - self._unit_started.pop(uid))
+        t_started = self._unit_started.pop(uid)
+        self._unit_times.append(time.monotonic() - t_started)
+        tracer().add_span(
+            self._trace_id, "fabric.unit", t0=t_started, uid=uid,
+            pieces=unit.npieces, ok=n_ok, torrent=unit.torrent, pid=self.pid,
+        )
         if self.progress_cb:
             self.progress_cb(self._pieces_verified, self.plan.total_pieces)
         cfg = self.config
@@ -562,6 +582,10 @@ class FabricExecutor:
             "seq": self._seq,
             "t": time.time(),
             "fp": self._fp,
+            # span context for the analysis/obs planes: deterministic by
+            # construction (fingerprint-derived id, seq counter — no
+            # wall clock, no randomness reaches exchanged bytes)
+            "span": heartbeat_span_context(self._trace_id, self._seq),
             "degraded": self._degraded,
             "done": {str(uid): pack_bits(b) for uid, b in sorted(own.items())},
             "inflight": sorted(self._unit_started),
@@ -684,6 +708,14 @@ class FabricExecutor:
                         "fabric sentinel mismatch on unit %d from peer %d: "
                         "discarding its verdicts, re-verifying",
                         uid, p,
+                    )
+                    # black box at the moment of distrust: which peer,
+                    # which unit, what the fabric looked like
+                    flight_recorder().trigger(
+                        "fabric_distrust",
+                        detail={"peer": p, "unit": uid, "pid": self.pid},
+                        trace_ids=(self._trace_id,),
+                        snapshots={"fabric": self.metrics_snapshot()},
                     )
         # 2. degraded self: yield unstarted units a survivor will adopt
         if self._degraded:
@@ -831,6 +863,7 @@ class FabricExecutor:
             "state": self._state,
             "pid": self.pid,
             "nproc": self.plan.nproc,
+            "trace_id": self._trace_id,
             "plan_fingerprint": self._fp,
             "units_total": len(self.plan.units),
             "shard_units": len(self.plan.units_for(self.pid)),

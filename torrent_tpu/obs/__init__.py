@@ -1,0 +1,59 @@
+"""torrent_tpu.obs — the observability plane.
+
+Three tiers over the same ticket lifecycle, cheapest first:
+
+1. **Latency histograms** (``obs/hist``): always-on fixed-log2-bucket
+   per-stage distributions (queue wait, launch, end-to-end per tenant,
+   bridge request), rendered as real Prometheus histogram series on
+   every ``/metrics`` scrape.
+2. **Span tracer** (``obs/tracer``): per-trace span trees — trace IDs
+   minted at the bridge (``X-Trace-Id`` honored/emitted), threaded
+   through the scheduler's ticket lifecycle and the fabric's units,
+   served by ``GET /v1/trace?id=…``.
+3. **Profiler** (``obs/profiler``): ``jax.profiler`` device-timeline
+   capture of the first N batches (``TORRENT_TPU_PROFILE``), the
+   deep-dive tier.
+
+Plus the **flight recorder** (``obs/recorder``): a bounded ring of
+recent spans + component snapshots, dumped as redacted black-box JSON
+on breaker-open, retry-exhausted failure, fabric distrust, or an
+observed lock-order cycle — ``GET /v1/trace``, ``torrent-tpu trace
+dump``, ``doctor --trace``.
+
+Everything here locks via ``analysis.sanitizer.named_lock`` (obs locks
+are leaves of the lock-order graph) and keeps exchanged/dumped bytes
+deterministic: monotonic-only timestamps, sorted keys.
+"""
+
+from torrent_tpu.obs.hist import HistogramRegistry, LogHistogram, histograms
+from torrent_tpu.obs.recorder import FlightRecorder, flight_recorder
+from torrent_tpu.obs.tracer import (
+    Span,
+    Tracer,
+    fabric_trace_id,
+    heartbeat_span_context,
+    tracer,
+    valid_trace_id,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "HistogramRegistry",
+    "LogHistogram",
+    "Span",
+    "Tracer",
+    "fabric_trace_id",
+    "flight_recorder",
+    "heartbeat_span_context",
+    "histograms",
+    "render_obs_metrics",
+    "tracer",
+    "valid_trace_id",
+]
+
+
+def render_obs_metrics() -> str:
+    """The obs plane's /metrics contribution: every latency-histogram
+    family plus the flight-recorder dump counters. Appended by both the
+    bridge's ``/metrics`` and the session ``MetricsServer``."""
+    return histograms().render() + flight_recorder().render_metrics()

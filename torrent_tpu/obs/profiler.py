@@ -1,0 +1,105 @@
+"""Deep-dive profiler tier above the always-on tracer.
+
+The obs plane has three tiers: always-on latency histograms
+(``obs/hist``), per-ticket span tracing (``obs/tracer``), and — this
+module — full ``jax.profiler`` device-timeline capture of the first N
+hash batches, the heavyweight tool for kernel-level work (XProf /
+TensorBoard). Moved here from ``utils/trace.py`` (which remains as a
+shim) when the obs plane landed.
+
+Set ``TORRENT_TPU_PROFILE=/some/dir`` to capture;
+``TORRENT_TPU_PROFILE_BATCHES`` (default 8) bounds how many batches the
+trace spans. Both env knobs are resolved **lazily per call** — enabling
+the profiler after the module was imported (a long-lived sidecar, a
+test toggling it) works, where the old import-time read silently
+ignored it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from torrent_tpu.utils.log import get_logger
+
+log = get_logger("obs.profiler")
+
+_PROFILE_ENV = "TORRENT_TPU_PROFILE"
+_BATCHES_ENV = "TORRENT_TPU_PROFILE_BATCHES"
+
+_trace_started = False
+_trace_done = False  # capture happens once; later batches run unprofiled
+_batches_seen = 0
+
+
+def profile_dir() -> str | None:
+    """Where to write the capture, or None when profiling is off.
+    Read from the environment on every call — never cached at import."""
+    return os.environ.get(_PROFILE_ENV) or None
+
+
+def profile_batches() -> int:
+    """How many batches the capture spans (invalid values fall back
+    to the default rather than raising on the hot path)."""
+    raw = os.environ.get(_BATCHES_ENV, "")
+    try:
+        n = int(raw) if raw else 8
+    except ValueError:
+        return 8
+    return n if n > 0 else 8
+
+
+def _flush_trace() -> None:
+    """Stop an open trace (idempotent); registered atexit once started."""
+    global _trace_started, _trace_done
+    if _trace_started:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _trace_started = False
+        _trace_done = True
+        log.info("profiler trace flushed at exit")
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region in the device timeline (no-op off-device)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def maybe_profile_batch(name: str):
+    """Profile the first N hash batches when TORRENT_TPU_PROFILE is set."""
+    global _trace_started, _batches_seen, _trace_done
+    import jax
+
+    trace_dir = profile_dir()
+    if trace_dir is None or _trace_done:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+        return
+    if not _trace_started:
+        jax.profiler.start_trace(trace_dir)
+        _trace_started = True
+        # Runs with fewer than N batches would otherwise exit with the
+        # trace open and unflushed — close it at interpreter exit.
+        import atexit
+
+        atexit.register(_flush_trace)
+        log.info("profiler trace started → %s", trace_dir)
+    _batches_seen += 1
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        if _batches_seen >= profile_batches() and _trace_started:
+            jax.profiler.stop_trace()
+            _trace_started = False
+            _trace_done = True
+            log.info("profiler trace stopped after %d batches", _batches_seen)

@@ -36,8 +36,14 @@ non-blocking submit over the bound sheds with :class:`SchedRejected`
 that wait is the backpressure a streaming ingest propagates to its TCP
 socket. Queue depth, batch-fill ratio, flush reasons, per-tenant served
 bytes, and shed counts are exported via ``utils/metrics.py``
-(``render_sched_metrics``); device launches are annotated in the
-profiler timeline via ``utils/trace.py``.
+(``render_sched_metrics``). The obs plane (``torrent_tpu/obs``) rides
+the same lifecycle: always-on log2 latency histograms (queue wait,
+launch, per-tenant end-to-end) feed ``/metrics`` as real Prometheus
+histograms, traced submissions get per-stage spans (enqueue →
+admission/shed → lane wait → launch/retry/bisect → digest → verdict),
+the flight recorder dumps a black box on breaker-open and
+retry-exhausted failures, and device launches are annotated in the
+deep-dive profiler timeline via ``obs/profiler.py``.
 
 Failure domains. A launch exception must not fail every co-batched
 ticket across all tenants, so dispatch is fault-isolated in two layers:
@@ -82,11 +88,29 @@ from dataclasses import dataclass
 from typing import Callable
 
 from torrent_tpu.analysis.sanitizer import named_lock
+from torrent_tpu.obs.hist import histograms
+from torrent_tpu.obs.recorder import flight_recorder
+from torrent_tpu.obs.tracer import tracer
 from torrent_tpu.utils.log import get_logger
 
 log = get_logger("sched")
 
 DIGEST_LEN = {"sha1": 20, "sha256": 32}
+
+# latency-histogram families (torrent_tpu/obs): always-on per-stage
+# distributions rendered as Prometheus histograms on every scrape
+_H_QUEUE_WAIT = (
+    "torrent_tpu_sched_queue_wait_seconds",
+    "Seconds tickets waited in lane queues before launch assembly",
+)
+_H_LAUNCH = (
+    "torrent_tpu_sched_launch_seconds",
+    "Hash-plane launch duration per attempt (staging + device run)",
+)
+_H_E2E = (
+    "torrent_tpu_sched_e2e_seconds",
+    "Ticket enqueue-to-verdict seconds, labeled by tenant",
+)
 
 
 class SchedRejected(Exception):
@@ -231,15 +255,26 @@ class _Tenant:
 
 
 class _Submission:
-    """One caller request of N pieces; resolves when all N demuxed."""
+    """One caller request of N pieces; resolves when all N demuxed.
 
-    __slots__ = ("mode", "results", "remaining", "future")
+    ``trace`` is the obs span context — ``(trace_id, parent_span_id)``
+    captured at enqueue when the caller ran inside a span (bridge
+    requests always do) — carried explicitly because lane assembler
+    tasks and worker threads never inherit a request's contextvars.
+    """
+
+    __slots__ = ("mode", "results", "remaining", "future", "trace", "traced_done")
 
     def __init__(self, n: int, mode: str, loop: asyncio.AbstractEventLoop):
         self.mode = mode  # 'digest' | 'verify'
         self.results: list = [None] * n
         self.remaining = n
         self.future: asyncio.Future = loop.create_future()
+        self.trace: tuple[str, str] | None = None
+        # terminal digest/verdict spans recorded (a submission split
+        # across launches whose halves fail separately must not get one
+        # span per failing demux)
+        self.traced_done = False
 
     def deliver(self, idx: int, value) -> None:
         self.results[idx] = value
@@ -375,19 +410,25 @@ class _LaneBreaker:
             if self.state != "closed":
                 self._to("closed")
 
-    def record_failure(self) -> None:
+    def record_failure(self) -> bool:
         """A transient primary-plane failure (deterministic payload
-        errors go through :meth:`release_probe` instead)."""
+        errors go through :meth:`release_probe` instead). Returns True
+        when THIS failure transitioned the breaker to open — the
+        caller's flight-recorder trigger point, kept outside the lock
+        (dumping under it would nest the obs locks below breaker
+        state)."""
         with self.lock:
             if self.state == "half_open":
                 self.probing = False
                 self._to("open")
                 self.opened_at = time.monotonic()
-                return
+                return True
             self.failures += 1
             if self.state == "closed" and self.failures >= self.threshold:
                 self._to("open")
                 self.opened_at = time.monotonic()
+                return True
+            return False
 
     def release_probe(self) -> None:
         with self.lock:
@@ -962,6 +1003,10 @@ class HashPlaneScheduler:
         if not pieces:
             sub.future.set_result(b"" if mode == "verify" else [])
             return sub.future
+        # span context captured HERE (the caller's task still holds it);
+        # everything downstream runs in lane tasks / worker threads
+        ctx = tracer().current_context()
+        t_enq = time.monotonic()
         ts = self._tenant(tenant)
         plen = piece_length if piece_length else max(len(p) for p in pieces)
         bucket = self.bucket_for(plen)
@@ -980,7 +1025,17 @@ class HashPlaneScheduler:
 
             row_cost = padded_len_for(bucket)
             charged = len(pieces) * row_cost
-        await self._admit(ts, charged, wait)
+        try:
+            await self._admit(ts, charged, wait)
+        except SchedRejected as e:
+            if ctx is not None:
+                tracer().add_span(
+                    ctx[0], "sched.shed", parent_id=ctx[1], t0=t_enq,
+                    status="error", tenant=tenant, reason=e.reason,
+                    queued_bytes=e.queued_bytes, limit_bytes=e.limit_bytes,
+                )
+            raise
+        t_admitted = time.monotonic()
         lane = self._lane(algo, plen)
         q = lane.queues.get(tenant)
         if q is None:
@@ -998,6 +1053,21 @@ class HashPlaneScheduler:
         ts.queued_bytes += charged
         self._queued_bytes += charged
         lane.event.set()
+        if ctx is not None:
+            t_queued = time.monotonic()
+            enq_id = tracer().add_span(
+                ctx[0], "sched.enqueue", parent_id=ctx[1], t0=t_enq,
+                t1=t_queued, tenant=tenant, algo=algo, mode=mode,
+                pieces=len(pieces), charged_bytes=charged,
+                lane=f"{algo}/{bucket}",
+            )
+            tracer().add_span(
+                ctx[0], "sched.admission", parent_id=enq_id, t0=t_enq,
+                t1=t_admitted, tenant=tenant, wait=wait,
+            )
+            # later stages (lane wait, launch, digest) hang off the
+            # enqueue span — carried by the submission, not contextvars
+            sub.trace = (ctx[0], enq_id)
         return sub.future
 
     async def submit(self, tenant: str, pieces, expected=None, algo="sha1",
@@ -1148,7 +1218,9 @@ class HashPlaneScheduler:
             sha256_backend=sha256_backend,
         )
 
-    def _run_plane(self, lane: _Lane, payloads: list[bytes]) -> list[bytes]:
+    def _run_plane(
+        self, lane: _Lane, payloads: list[bytes], obs_note: dict | None = None
+    ) -> list[bytes]:
         """Worker-thread body: build the plane on first use (JAX init and
         compiles run off the event loop) and execute the launch under a
         trace annotation so batches are attributable in the timeline.
@@ -1158,12 +1230,20 @@ class HashPlaneScheduler:
         only a half-open probe touches the primary again. Transient
         primary failures feed the breaker; deterministic payload errors
         do not (the device is answering — the payload is the problem).
+
+        ``obs_note`` carries per-launch observability facts back to the
+        dispatching coroutine (plane used, breaker-open transition) —
+        the flight-recorder trigger and launch-span attrs live THERE so
+        no obs lock is ever taken under breaker or counter locks.
         """
+        if obs_note is None:
+            obs_note = {}
         if not lane.breaker.acquire_primary():
             if lane.cpu_plane is None:  # benign to race: planes are stateless
                 lane.cpu_plane = _CpuPlane(lane.algo)
             with self._counter_lock:  # worker threads across lanes race this
                 self._cpu_fallback_launches += 1
+            obs_note["plane"] = "cpu_fallback"
             return lane.cpu_plane.run(payloads)
         if lane.plane is None:
             # pipelined launches reach here from concurrent worker
@@ -1177,7 +1257,8 @@ class HashPlaneScheduler:
                         # deterministic build error (factory misconfig)
                         # must not masquerade as device flakiness
                         if classify_error(e) == "transient":
-                            lane.breaker.record_failure()
+                            if lane.breaker.record_failure():
+                                obs_note["breaker_opened"] = True
                         else:
                             lane.breaker.release_probe()
                         raise
@@ -1200,7 +1281,7 @@ class HashPlaneScheduler:
             if self.hasher == "cpu":
                 digests = lane.plane.run(payloads)
             else:
-                from torrent_tpu.utils.trace import maybe_profile_batch
+                from torrent_tpu.obs.profiler import maybe_profile_batch
 
                 with maybe_profile_batch(f"sched_{lane.algo}_launch_b{lane.bucket}"):
                     digests = lane.plane.run(payloads)
@@ -1213,12 +1294,26 @@ class HashPlaneScheduler:
                 )
         except Exception as e:
             if classify_error(e) == "transient":
-                lane.breaker.record_failure()
+                if lane.breaker.record_failure():
+                    obs_note["breaker_opened"] = True
             else:
                 lane.breaker.release_probe()
             raise
         lane.breaker.record_success()
         return digests
+
+    @staticmethod
+    def _traced_subs(tickets: list[_Ticket]) -> dict[int, tuple[_Submission, float]]:
+        """Distinct traced submissions in a batch with their oldest
+        ticket timestamp (one obs span per submission, not per ticket)."""
+        out: dict[int, tuple[_Submission, float]] = {}
+        for t in tickets:
+            if t.sub.trace is None:
+                continue
+            prev = out.get(id(t.sub))
+            if prev is None or t.ts < prev[1]:
+                out[id(t.sub)] = (t.sub, t.ts)
+        return out
 
     async def _launch(self, lane: _Lane, tickets: list[_Ticket], reason: str) -> None:
         n = len(tickets)
@@ -1228,6 +1323,17 @@ class HashPlaneScheduler:
         self._flush_reasons[reason] += 1
         lane.launches += 1
         lane.fill_sum += fill
+        lane_name = f"{lane.algo}/{lane.bucket}"
+        t_take = time.monotonic()
+        # one lock acquisition for the whole launch's queue waits
+        histograms().get(*_H_QUEUE_WAIT, lane=lane_name).observe_batch(
+            [t_take - t.ts for t in tickets]
+        )
+        for sub, ts0 in self._traced_subs(tickets).values():
+            tracer().add_span(
+                sub.trace[0], "sched.lane_wait", parent_id=sub.trace[1],
+                t0=ts0, t1=t_take, lane=lane_name, flush=reason, rows=n,
+            )
         await self._dispatch(lane, tickets, depth=0)
 
     async def _dispatch(self, lane: _Lane, tickets: list[_Ticket], depth: int) -> None:
@@ -1238,19 +1344,41 @@ class HashPlaneScheduler:
         bisection routes the surviving halves through the CPU plane."""
         cfg = self.config
         payloads = [t.payload for t in tickets]
+        lane_name = f"{lane.algo}/{lane.bucket}"
         attempts = 0
         while True:
+            obs_note: dict = {}
+            t0 = time.monotonic()
             try:
                 # digest-count contract is checked inside _run_plane, so
                 # a persistent violation feeds the breaker there
-                digests = await asyncio.to_thread(self._run_plane, lane, payloads)
+                digests = await asyncio.to_thread(
+                    self._run_plane, lane, payloads, obs_note
+                )
             except Exception as e:  # a poisoned launch must not wedge the lane
+                t1 = time.monotonic()
+                histograms().get(*_H_LAUNCH, lane=lane_name).observe(t1 - t0)
                 self._launch_failures += 1
                 kind = classify_error(e)
                 log.warning(
                     "sched launch failed (%s/%d, %d pieces, depth %d, %s): %s",
                     lane.algo, lane.bucket, len(tickets), depth, kind, e,
                 )
+                self._obs_launch_spans(
+                    tickets, lane_name, t0, t1, depth, attempts, obs_note,
+                    status="error", error=e,
+                )
+                if obs_note.get("breaker_opened"):
+                    # black box BEFORE the state evaporates: the dump
+                    # carries the breaker snapshot plus the failing
+                    # tickets' span trees
+                    flight_recorder().trigger(
+                        "breaker_open",
+                        detail={"lane": lane_name, "kind": kind,
+                                "error": str(e)},
+                        trace_ids=self._trace_ids(tickets),
+                        snapshots={"sched": self.metrics_snapshot()},
+                    )
                 if kind == "transient" and attempts < cfg.launch_retries:
                     attempts += 1
                     self._retries += 1
@@ -1269,13 +1397,63 @@ class HashPlaneScheduler:
                     e,
                 )
                 self._demux(tickets, None, error=err)
+                flight_recorder().trigger(
+                    "retry_exhausted",
+                    detail={"lane": lane_name, "kind": kind,
+                            "pieces": len(tickets), "depth": depth,
+                            "retries": attempts, "error": str(e)},
+                    trace_ids=self._trace_ids(tickets),
+                    snapshots={"sched": self.metrics_snapshot()},
+                )
                 return
+            t1 = time.monotonic()
+            histograms().get(*_H_LAUNCH, lane=lane_name).observe(t1 - t0)
+            self._obs_launch_spans(
+                tickets, lane_name, t0, t1, depth, attempts, obs_note,
+                status="ok",
+            )
             self._demux(tickets, digests)
             return
+
+    @staticmethod
+    def _trace_ids(tickets: list[_Ticket]) -> list[str]:
+        out: list[str] = []
+        for t in tickets:
+            if t.sub.trace is not None and t.sub.trace[0] not in out:
+                out.append(t.sub.trace[0])
+        return out
+
+    def _obs_launch_spans(
+        self, tickets, lane_name, t0, t1, depth, attempt, note, status,
+        error=None,
+    ) -> None:
+        """One sched.launch span per traced submission in the batch
+        (retry attempts and bisection halves each record their own,
+        distinguished by the attempt/depth attrs)."""
+        subs = self._traced_subs(tickets)
+        if not subs:
+            return
+        attrs = {"lane": lane_name, "rows": len(tickets), "depth": depth,
+                 "attempt": attempt}
+        if note.get("plane") == "cpu_fallback":
+            attrs["plane"] = "cpu_fallback"
+        if note.get("breaker_opened"):
+            attrs["breaker_opened"] = True
+        if error is not None:
+            attrs["error"] = str(error)
+            attrs["kind"] = classify_error(error)
+        for sub, _ts0 in subs.values():
+            tracer().add_span(
+                sub.trace[0], "sched.launch", parent_id=sub.trace[1],
+                t0=t0, t1=t1, status=status, **attrs,
+            )
 
     def _demux(self, tickets: list[_Ticket], digests, error=None) -> None:
         """Per-launch result demux back to the awaiting submissions,
         releasing queue bytes (and any blocked submitters) as it goes."""
+        t_now = time.monotonic()
+        e2e_by_tenant: dict[str, list[float]] = {}
+        done_subs: dict[int, _Submission] = {}
         for i, tkt in enumerate(tickets):
             # the tenant may have been pruned while a zero-byte ticket was
             # in flight — global accounting and delivery must still happen
@@ -1283,9 +1461,12 @@ class HashPlaneScheduler:
             if t is not None:
                 t.queued_bytes -= tkt.charged
             self._queued_bytes -= tkt.charged
+            e2e_by_tenant.setdefault(tkt.tenant, []).append(t_now - tkt.ts)
             if error is not None:
                 if not tkt.sub.future.done():
                     tkt.sub.future.set_exception(error)
+                if tkt.sub.trace is not None:
+                    done_subs.setdefault(id(tkt.sub), tkt.sub)
                 continue
             if t is not None:
                 t.served_bytes += tkt.nbytes
@@ -1295,6 +1476,28 @@ class HashPlaneScheduler:
                 tkt.sub.deliver(tkt.idx, 1 if d == tkt.expected else 0)
             else:
                 tkt.sub.deliver(tkt.idx, d)
+            if tkt.sub.trace is not None and tkt.sub.remaining == 0:
+                done_subs.setdefault(id(tkt.sub), tkt.sub)
+        for tenant, vals in e2e_by_tenant.items():
+            histograms().get(*_H_E2E, tenant=tenant).observe_batch(vals)
+        for sub in done_subs.values():
+            if sub.traced_done:
+                continue
+            sub.traced_done = True
+            status = "error" if error is not None else "ok"
+            attrs: dict = {"mode": sub.mode, "pieces": len(sub.results)}
+            if error is not None:
+                attrs["error"] = str(error)
+            did = tracer().add_span(
+                sub.trace[0], "sched.digest", parent_id=sub.trace[1],
+                t0=t_now, status=status, **attrs,
+            )
+            if sub.mode == "verify":
+                valid = sum(1 for r in sub.results if r) if error is None else 0
+                tracer().add_span(
+                    sub.trace[0], "sched.verdict", parent_id=did, t0=t_now,
+                    status=status, valid=valid, pieces=len(sub.results),
+                )
         self._space.set()  # wake admission waiters
 
     # ----------------------------------------------------------- metrics

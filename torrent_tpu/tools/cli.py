@@ -1034,6 +1034,118 @@ def _cmd_lint(args) -> int:
     return lint_main(argv)
 
 
+def _render_span_tree(tree: dict) -> str:
+    """Human-readable span tree (torrent-tpu trace dump --id)."""
+    lines = [
+        f"trace {tree.get('trace_id')} — {tree.get('span_count', 0)} span(s)"
+        + (
+            f", {tree['dropped_spans']} dropped"
+            if tree.get("dropped_spans")
+            else ""
+        )
+    ]
+
+    def walk(node: dict, depth: int) -> None:
+        mark = "" if node.get("status") == "ok" else f" [{node.get('status')}]"
+        attrs = node.get("attrs") or {}
+        detail = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        lines.append(
+            f"{'  ' * depth}{node.get('name')}  "
+            f"+{node.get('start_ms', 0)}ms {node.get('duration_ms', 0)}ms"
+            f"{mark}" + (f"  {detail}" if detail else "")
+        )
+        for child in node.get("children", ()):
+            walk(child, depth + 1)
+
+    for root in tree.get("spans", ()):
+        walk(root, 1)
+    return "\n".join(lines)
+
+
+def _cmd_trace(args) -> int:
+    """Fetch span trees / flight-recorder dumps (torrent_tpu/obs).
+
+    ``torrent-tpu trace dump`` reads ``GET /v1/trace`` from a running
+    bridge (``--id`` narrows to one trace's span tree); ``--dir`` reads
+    the newest black-box file a flight recorder wrote to disk
+    (``TORRENT_TPU_FLIGHT_DIR``) instead — the post-mortem path when
+    the process is already gone.
+    """
+    import json as _json
+
+    if args.dir:
+        import glob
+
+        # newest by mtime, not filename: dump seqs restart per process,
+        # so a restarted service's fresh dumps must not be shadowed by a
+        # previous run's higher-numbered leftovers
+        files = sorted(
+            glob.glob(os.path.join(args.dir, "blackbox_*.json")),
+            key=os.path.getmtime,
+        )
+        if not files:
+            print(f"error: no blackbox_*.json files in {args.dir!r}", file=sys.stderr)
+            return 1
+        with open(files[-1]) as f:
+            dump = _json.load(f)
+        if args.json:
+            print(_json.dumps(dump, sort_keys=True))
+        else:
+            print(
+                f"{files[-1]}: dump #{dump.get('seq')} ({dump.get('reason')}), "
+                f"{len(dump.get('recent_spans', []))} recent spans, "
+                f"{len(dump.get('traces', {}))} trace(s)"
+            )
+            print(_json.dumps(dump.get("detail", {}), sort_keys=True))
+        return 0
+
+    import http.client
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/v1/trace"
+    if args.id:
+        url += "?id=" + urllib.parse.quote(args.id, safe="")
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            payload = _json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        # the bridge answered — a 404 means the trace id is unknown,
+        # not that the bridge is unreachable
+        print(f"error: {url} returned {e.code} {e.reason}", file=sys.stderr)
+        return 1
+    except (OSError, ValueError, http.client.HTTPException) as e:
+        print(f"error: cannot reach {url}: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(_json.dumps(payload, sort_keys=True))
+        return 0
+    if args.id:
+        print(_render_span_tree(payload))
+        return 0
+    counts = payload.get("dump_counts", {})
+    dumps = payload.get("dumps", [])
+    print(
+        f"flight recorder: {len(dumps)} dump(s) held"
+        + (
+            " — " + ", ".join(f"{k}×{v}" for k, v in sorted(counts.items()))
+            if counts
+            else ""
+        )
+    )
+    for d in dumps:
+        print(
+            f"  #{d.get('seq')} {d.get('reason')}: "
+            f"{_json.dumps(d.get('detail', {}), sort_keys=True)}"
+        )
+    traces = payload.get("traces", [])
+    print(f"traces held: {len(traces)}")
+    for tid in traces[-10:]:
+        print(f"  {tid}")
+    return 0
+
+
 def _cmd_doctor(args) -> int:
     # run_cli, not main: the triage tool must not run its checks inside
     # an interpreter wired to the device plugin it is triaging — it
@@ -1048,6 +1160,8 @@ def _cmd_doctor(args) -> int:
         argv.append("--fabric")
     if getattr(args, "lint", False):
         argv.append("--lint")
+    if getattr(args, "trace", False):
+        argv.append("--trace")
     if getattr(args, "json", False):
         argv.append("--json")
     return doctor_cli(argv)
@@ -1670,6 +1784,27 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=_cmd_lint)
 
     sp = sub.add_parser(
+        "trace",
+        help="ticket-lifecycle tracing: span trees and flight-recorder "
+        "dumps from a running bridge (torrent_tpu/obs)",
+    )
+    sp.add_argument("action", choices=("dump",),
+                    help="dump: fetch GET /v1/trace (all dumps + trace ids, "
+                    "or one span tree with --id)")
+    sp.add_argument("--url", default="http://127.0.0.1:8421",
+                    help="bridge base URL (default %(default)s)")
+    sp.add_argument("--id", default=None, metavar="TRACE",
+                    help="trace id (the X-Trace-Id a request carried/got "
+                    "back) to fetch as an ordered span tree")
+    sp.add_argument("--dir", default=None, metavar="DIR",
+                    help="read the newest blackbox_*.json from DIR "
+                    "(TORRENT_TPU_FLIGHT_DIR) instead of a bridge — the "
+                    "post-mortem path")
+    sp.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the rendered tree/summary")
+    sp.set_defaults(fn=_cmd_trace)
+
+    sp = sub.add_parser(
         "doctor", help="environment triage: deps, device, kernels, swarm smoke"
     )
     sp.add_argument("--device-wait", type=float, default=20.0)
@@ -1681,6 +1816,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--lint", action="store_true",
                     help="also run the analysis-plane smoke: all four "
                     "static passes clean against the committed baseline")
+    sp.add_argument("--trace", action="store_true",
+                    help="also run the observability smoke: traced "
+                    "fault-injected run producing a span tree, latency "
+                    "histograms, and flight-recorder dumps")
     sp.add_argument("--json", action="store_true",
                     help="emit a machine-readable JSON summary line")
     sp.set_defaults(fn=_cmd_doctor)

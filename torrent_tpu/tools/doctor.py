@@ -603,6 +603,105 @@ def _fabric_smoke(tmp: str) -> str:
     )
 
 
+async def _trace_smoke() -> str:
+    """Observability smoke (``--trace``): a traced, fault-injected run
+    must produce (a) an ordered span tree covering the ticket lifecycle
+    (enqueue → admission → lane wait → launch → digest), (b) latency-
+    histogram series for the queue-wait and launch stages, and (c)
+    exactly one flight-recorder dump for a retry-exhausted launch and
+    one for a breaker-open transition. Deterministic and CPU-only —
+    the same machinery ``GET /v1/trace`` and ``torrent-tpu trace
+    dump`` expose on a live bridge."""
+    from torrent_tpu.obs import flight_recorder, histograms, tracer
+    from torrent_tpu.sched import (
+        FaultPlan,
+        HashPlaneScheduler,
+        SchedLaunchError,
+        SchedulerConfig,
+    )
+
+    t = tracer()
+    base = flight_recorder().counts()
+
+    # (a)+(b): a healthy traced submission
+    sched = HashPlaneScheduler(
+        SchedulerConfig(batch_target=8, flush_deadline=0.05), hasher="cpu"
+    )
+    await sched.start()
+    try:
+        pieces = [bytes([i]) * 256 for i in range(4)]
+        want = [hashlib.sha1(p).digest() for p in pieces]
+        tid = t.mint()
+        with t.span("doctor.trace", trace_id=tid):
+            assert await sched.submit("doctor", pieces) == want
+    finally:
+        await sched.close()
+    tree = t.trace_tree(tid)
+    assert tree is not None, "trace not recorded"
+
+    def names(node):
+        yield node["name"]
+        for c in node["children"]:
+            yield from names(c)
+
+    got = [n for root in tree["spans"] for n in names(root)]
+    for stage in ("sched.enqueue", "sched.admission", "sched.lane_wait",
+                  "sched.launch", "sched.digest"):
+        assert stage in got, f"span tree missing {stage}: {got}"
+    rendered = histograms().render()
+    for family in ("torrent_tpu_sched_queue_wait_seconds",
+                   "torrent_tpu_sched_launch_seconds"):
+        assert f"{family}_bucket" in rendered, f"no {family} histogram"
+
+    # (c) retry-exhausted: a poisoned single-piece launch fails alone
+    plan = FaultPlan(payload_prefix=b"\xbd\xbd")
+    sched = HashPlaneScheduler(
+        SchedulerConfig(
+            batch_target=4, flush_deadline=0.05,
+            plane_factory=plan.plane_factory(hasher="cpu"),
+        ),
+        hasher="cpu",
+    )
+    await sched.start()
+    try:
+        try:
+            await sched.submit("doctor", [b"\xbd\xbd" + b"x" * 64])
+            raise AssertionError("poisoned launch unexpectedly succeeded")
+        except SchedLaunchError:
+            pass
+    finally:
+        await sched.close()
+
+    # (c) breaker-open: enough consecutive transient faults to trip the
+    # breaker; the CPU fallback still answers, so the ticket succeeds
+    plan = FaultPlan(fail_first=2)
+    sched = HashPlaneScheduler(
+        SchedulerConfig(
+            batch_target=4, flush_deadline=0.05, breaker_threshold=2,
+            launch_retries=2, breaker_cooldown=300.0,
+            plane_factory=plan.plane_factory(hasher="cpu"),
+        ),
+        hasher="cpu",
+    )
+    await sched.start()
+    try:
+        pieces = [bytes([i]) * 128 for i in range(2)]
+        want = [hashlib.sha1(p).digest() for p in pieces]
+        assert await sched.submit("doctor", pieces) == want
+    finally:
+        await sched.close()
+
+    counts = flight_recorder().counts()
+    retry = counts.get("retry_exhausted", 0) - base.get("retry_exhausted", 0)
+    brk = counts.get("breaker_open", 0) - base.get("breaker_open", 0)
+    assert retry == 1, f"expected exactly 1 retry_exhausted dump, got {retry}"
+    assert brk == 1, f"expected exactly 1 breaker_open dump, got {brk}"
+    return (
+        f"{tree['span_count']}-span tree, queue-wait/launch histograms, "
+        f"1 retry-exhausted + 1 breaker-open dump"
+    )
+
+
 def _lint_smoke() -> str:
     """Analysis-plane smoke (``--lint``): run all four static passes
     over the installed package and require a clean gate — zero findings
@@ -703,6 +802,13 @@ def main(argv=None) -> int:
         "over the installed package, clean against the committed baseline",
     )
     ap.add_argument(
+        "--trace",
+        action="store_true",
+        help="also run the observability smoke: traced fault-injected run "
+        "producing a span tree, latency histograms, and flight-recorder "
+        "dumps (retry-exhausted + breaker-open)",
+    )
+    ap.add_argument(
         "--json",
         action="store_true",
         help="emit one JSON object after the checks (machine-readable)",
@@ -775,6 +881,12 @@ def main(argv=None) -> int:
             _report("PASS", "analysis plane", detail)
         except Exception as e:
             _report("FAIL", "analysis plane", repr(e))
+    if args.trace:
+        try:
+            detail = asyncio.run(asyncio.wait_for(_trace_smoke(), 30))
+            _report("PASS", "observability plane", detail)
+        except Exception as e:
+            _report("FAIL", "observability plane", repr(e))
     if args.fabric:
         with tempfile.TemporaryDirectory(prefix="doctor_fabric_") as tmp:
             try:

@@ -27,27 +27,29 @@ def render_sched_metrics(sched) -> str:
     ``sched`` is a ``torrent_tpu.sched.HashPlaneScheduler`` (anything
     with its ``metrics_snapshot()`` contract). Served by the bridge's
     ``GET /metrics`` and appended to the session exposition when a
-    ``MetricsServer`` is given a scheduler."""
+    ``MetricsServer`` is given a scheduler. Defensive against partial
+    snapshots (a fresh or degraded component may not carry every key):
+    a missing counter renders as 0, never a crash mid-scrape."""
     s = sched.metrics_snapshot()
     lines = [
         "# HELP torrent_tpu_sched_queue_pieces Pieces queued awaiting a device launch",
         "# TYPE torrent_tpu_sched_queue_pieces gauge",
-        f"torrent_tpu_sched_queue_pieces {s['queue_pieces']}",
+        f"torrent_tpu_sched_queue_pieces {s.get('queue_pieces', 0)}",
         "# HELP torrent_tpu_sched_queue_bytes Queued + in-flight payload bytes",
         "# TYPE torrent_tpu_sched_queue_bytes gauge",
-        f"torrent_tpu_sched_queue_bytes {s['queue_bytes']}",
+        f"torrent_tpu_sched_queue_bytes {s.get('queue_bytes', 0)}",
         "# HELP torrent_tpu_sched_lanes Compiled (algo, piece-bucket) lanes",
         "# TYPE torrent_tpu_sched_lanes gauge",
-        f"torrent_tpu_sched_lanes {s['lanes']}",
+        f"torrent_tpu_sched_lanes {s.get('lanes', 0)}",
         "# HELP torrent_tpu_sched_launches_total Device launches dispatched",
         "# TYPE torrent_tpu_sched_launches_total counter",
-        f"torrent_tpu_sched_launches_total {s['launches']}",
+        f"torrent_tpu_sched_launches_total {s.get('launches', 0)}",
         "# HELP torrent_tpu_sched_batch_fill_ratio Mean launch fill vs the lane target",
         "# TYPE torrent_tpu_sched_batch_fill_ratio gauge",
-        f"torrent_tpu_sched_batch_fill_ratio {s['mean_fill']:.6f}",
+        f"torrent_tpu_sched_batch_fill_ratio {s.get('mean_fill', 0.0):.6f}",
         "# HELP torrent_tpu_sched_shed_total Submissions rejected by admission control",
         "# TYPE torrent_tpu_sched_shed_total counter",
-        f"torrent_tpu_sched_shed_total {s['shed_total']}",
+        f"torrent_tpu_sched_shed_total {s.get('shed_total', 0)}",
         "# HELP torrent_tpu_sched_launch_failures_total Device launches that raised",
         "# TYPE torrent_tpu_sched_launch_failures_total counter",
         f"torrent_tpu_sched_launch_failures_total {s.get('launch_failures', 0)}",
@@ -69,7 +71,7 @@ def render_sched_metrics(sched) -> str:
         "# HELP torrent_tpu_sched_flush_total Launch flushes by reason",
         "# TYPE torrent_tpu_sched_flush_total counter",
     ]
-    for reason, n in sorted(s["flush_reasons"].items()):
+    for reason, n in sorted(s.get("flush_reasons", {}).items()):
         lines.append(f'torrent_tpu_sched_flush_total{{reason="{reason}"}} {n}')
     # per-lane launch fill and tile-padding waste (pallas sub-tile
     # bucketing observability: a tile-snapped lane under load should
@@ -82,7 +84,7 @@ def render_sched_metrics(sched) -> str:
     for lane, st in sorted(lane_stats.items()):
         lines.append(
             f'torrent_tpu_sched_lane_fill_ratio{{lane="{_esc(lane)}"}} '
-            f"{st['mean_fill']:.6f}"
+            f"{st.get('mean_fill', 0.0):.6f}"
         )
     lines.append(
         "# HELP torrent_tpu_sched_launch_pad_rows_total Sentinel rows staged "
@@ -92,7 +94,7 @@ def render_sched_metrics(sched) -> str:
     for lane, st in sorted(lane_stats.items()):
         lines.append(
             f'torrent_tpu_sched_launch_pad_rows_total{{lane="{_esc(lane)}"}} '
-            f"{st['pad_rows_total']}"
+            f"{st.get('pad_rows_total', 0)}"
         )
     lines.append(
         "# HELP torrent_tpu_sched_lane_target Pieces per launch this lane aims to fill"
@@ -101,7 +103,7 @@ def render_sched_metrics(sched) -> str:
     for lane, st in sorted(lane_stats.items()):
         lines.append(
             f'torrent_tpu_sched_lane_target{{lane="{_esc(lane)}",'
-            f'backend="{_esc(st["backend"])}"}} {st["target"]}'
+            f'backend="{_esc(st.get("backend", "device"))}"}} {st.get("target", 0)}'
         )
     # breaker lifecycle per lane: state as an enum gauge (0 closed,
     # 1 half-open, 2 open — alert on > 0) plus transition counters
@@ -114,7 +116,7 @@ def render_sched_metrics(sched) -> str:
     for lane, b in sorted(s.get("breakers", {}).items()):
         lines.append(
             f'torrent_tpu_sched_breaker_state{{lane="{_esc(lane)}"}} '
-            f"{_breaker_states.get(b['state'], 2)}"
+            f"{_breaker_states.get(b.get('state'), 2)}"
         )
     lines.append(
         "# HELP torrent_tpu_sched_breaker_transitions_total Breaker state transitions"
@@ -139,8 +141,8 @@ def render_sched_metrics(sched) -> str:
     for name, kind, help_text, key in per_tenant:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {kind}")
-        for tenant, t in sorted(s["tenants"].items()):
-            lines.append(f'{name}{{tenant="{_esc(tenant)}"}} {t[key]}')
+        for tenant, t in sorted(s.get("tenants", {}).items()):
+            lines.append(f'{name}{{tenant="{_esc(tenant)}"}} {t.get(key, 0)}')
     return "\n".join(lines) + "\n"
 
 
@@ -211,45 +213,46 @@ def render_fabric_metrics(snapshot: dict) -> str:
     ``snapshot`` is a ``torrent_tpu.fabric.FabricExecutor.
     metrics_snapshot()`` dict. Appended to the bridge's ``/metrics``
     while a fabric job exists, labeled by the process id so a pod-wide
-    scrape distinguishes shards."""
+    scrape distinguishes shards. Defensive against partial snapshots:
+    missing keys render as 0, never a crash mid-scrape."""
     s = snapshot
-    pid = f'pid="{s["pid"]}"'
+    pid = f'pid="{s.get("pid", 0)}"'
     states = {"idle": 0, "running": 1, "done": 2, "failed": 3}
     lines = [
         "# HELP torrent_tpu_fabric_state Fabric executor state "
         "(0=idle 1=running 2=done 3=failed)",
         "# TYPE torrent_tpu_fabric_state gauge",
-        f"torrent_tpu_fabric_state{{{pid}}} {states.get(s['state'], 3)}",
+        f"torrent_tpu_fabric_state{{{pid}}} {states.get(s.get('state'), 3)}",
         "# HELP torrent_tpu_fabric_shard_bytes Payload bytes planned onto this process",
         "# TYPE torrent_tpu_fabric_shard_bytes gauge",
-        f"torrent_tpu_fabric_shard_bytes{{{pid}}} {s['shard_bytes']}",
+        f"torrent_tpu_fabric_shard_bytes{{{pid}}} {s.get('shard_bytes', 0)}",
         "# HELP torrent_tpu_fabric_units Work units by disposition for this process",
         "# TYPE torrent_tpu_fabric_units gauge",
-        f'torrent_tpu_fabric_units{{{pid},kind="planned"}} {s["shard_units"]}',
-        f'torrent_tpu_fabric_units{{{pid},kind="done"}} {s["units_done"]}',
-        f'torrent_tpu_fabric_units{{{pid},kind="adopted"}} {s["units_adopted"]}',
-        f'torrent_tpu_fabric_units{{{pid},kind="total"}} {s["units_total"]}',
+        f'torrent_tpu_fabric_units{{{pid},kind="planned"}} {s.get("shard_units", 0)}',
+        f'torrent_tpu_fabric_units{{{pid},kind="done"}} {s.get("units_done", 0)}',
+        f'torrent_tpu_fabric_units{{{pid},kind="adopted"}} {s.get("units_adopted", 0)}',
+        f'torrent_tpu_fabric_units{{{pid},kind="total"}} {s.get("units_total", 0)}',
         "# HELP torrent_tpu_fabric_pieces_verified_total Pieces this process verified",
         "# TYPE torrent_tpu_fabric_pieces_verified_total counter",
-        f"torrent_tpu_fabric_pieces_verified_total{{{pid}}} {s['pieces_verified']}",
+        f"torrent_tpu_fabric_pieces_verified_total{{{pid}}} {s.get('pieces_verified', 0)}",
         "# HELP torrent_tpu_fabric_inflight_bytes Payload bytes in scheduler futures",
         "# TYPE torrent_tpu_fabric_inflight_bytes gauge",
-        f"torrent_tpu_fabric_inflight_bytes{{{pid}}} {s['inflight_bytes']}",
+        f"torrent_tpu_fabric_inflight_bytes{{{pid}}} {s.get('inflight_bytes', 0)}",
         "# HELP torrent_tpu_fabric_heartbeat_age_seconds Seconds since the last successful heartbeat exchange",
         "# TYPE torrent_tpu_fabric_heartbeat_age_seconds gauge",
-        f"torrent_tpu_fabric_heartbeat_age_seconds{{{pid}}} {s['heartbeat_age']:.3f}",
+        f"torrent_tpu_fabric_heartbeat_age_seconds{{{pid}}} {s.get('heartbeat_age', 0.0):.3f}",
         "# HELP torrent_tpu_fabric_sentinel_checks_total Adopted-unit verdicts cross-checked by a sentinel re-hash",
         "# TYPE torrent_tpu_fabric_sentinel_checks_total counter",
-        f"torrent_tpu_fabric_sentinel_checks_total{{{pid}}} {s['sentinel_checks']}",
+        f"torrent_tpu_fabric_sentinel_checks_total{{{pid}}} {s.get('sentinel_checks', 0)}",
         "# HELP torrent_tpu_fabric_sentinel_mismatches_total Foreign verdicts rejected by the sentinel cross-check",
         "# TYPE torrent_tpu_fabric_sentinel_mismatches_total counter",
-        f"torrent_tpu_fabric_sentinel_mismatches_total{{{pid}}} {s['sentinel_mismatches']}",
+        f"torrent_tpu_fabric_sentinel_mismatches_total{{{pid}}} {s.get('sentinel_mismatches', 0)}",
         "# HELP torrent_tpu_fabric_stragglers_total Units flagged in flight past the straggler threshold",
         "# TYPE torrent_tpu_fabric_stragglers_total counter",
-        f"torrent_tpu_fabric_stragglers_total{{{pid}}} {s['stragglers']}",
+        f"torrent_tpu_fabric_stragglers_total{{{pid}}} {s.get('stragglers', 0)}",
         "# HELP torrent_tpu_fabric_degraded Breaker-stuck degradation flag (unstarted units yielded)",
         "# TYPE torrent_tpu_fabric_degraded gauge",
-        f"torrent_tpu_fabric_degraded{{{pid}}} {1 if s['degraded'] else 0}",
+        f"torrent_tpu_fabric_degraded{{{pid}}} {1 if s.get('degraded') else 0}",
     ]
     return "\n".join(lines) + "\n"
 
@@ -371,6 +374,9 @@ class MetricsServer:
                 text = render_metrics(self.client)
                 if self.scheduler is not None:
                     text += render_sched_metrics(self.scheduler)
+                from torrent_tpu.obs import render_obs_metrics
+
+                text += render_obs_metrics()
                 from torrent_tpu.analysis import sanitizer
 
                 if sanitizer.is_enabled():

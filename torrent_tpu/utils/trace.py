@@ -1,77 +1,19 @@
-"""Profiling hooks around the hash plane (SURVEY §5: reference has none).
+"""Back-compat shim — the profiler hook moved to ``torrent_tpu.obs``.
 
-Set ``TORRENT_TPU_PROFILE=/some/dir`` to capture a ``jax.profiler`` trace
-of the first verify/digest launches (viewable in XProf/TensorBoard);
-``annotate()`` scopes named regions so batches are attributable in the
-timeline either way.
+The jax.profiler capture tier now lives in ``obs/profiler.py`` (the
+deep-dive tier of the observability plane, above the always-on span
+tracer and latency histograms), where the ``TORRENT_TPU_PROFILE`` /
+``TORRENT_TPU_PROFILE_BATCHES`` knobs are resolved lazily per call
+instead of at import time. Import from ``torrent_tpu.obs.profiler``
+directly in new code.
 """
 
 from __future__ import annotations
 
-import contextlib
-import os
-
-from torrent_tpu.utils.log import get_logger
-
-log = get_logger("trace")
-
-_trace_dir = os.environ.get("TORRENT_TPU_PROFILE")
-_trace_started = False
-_trace_done = False  # capture happens once; later batches run unprofiled
-_batches_to_trace = int(os.environ.get("TORRENT_TPU_PROFILE_BATCHES", "8"))
-_batches_seen = 0
-
-
-def _flush_trace() -> None:
-    """Stop an open trace (idempotent); registered atexit once started."""
-    global _trace_started, _trace_done
-    if _trace_started:
-        import jax
-
-        try:
-            jax.profiler.stop_trace()
-        except Exception:
-            pass
-        _trace_started = False
-        _trace_done = True
-        log.info("profiler trace flushed at exit")
-
-
-@contextlib.contextmanager
-def annotate(name: str):
-    """Named region in the device timeline (no-op off-device)."""
-    import jax
-
-    with jax.profiler.TraceAnnotation(name):
-        yield
-
-
-@contextlib.contextmanager
-def maybe_profile_batch(name: str):
-    """Profile the first N hash batches when TORRENT_TPU_PROFILE is set."""
-    global _trace_started, _batches_seen, _trace_done
-    import jax
-
-    if _trace_dir is None or _trace_done:
-        with jax.profiler.TraceAnnotation(name):
-            yield
-        return
-    if not _trace_started:
-        jax.profiler.start_trace(_trace_dir)
-        _trace_started = True
-        # Runs with fewer than N batches would otherwise exit with the
-        # trace open and unflushed — close it at interpreter exit.
-        import atexit
-
-        atexit.register(_flush_trace)
-        log.info("profiler trace started → %s", _trace_dir)
-    _batches_seen += 1
-    try:
-        with jax.profiler.TraceAnnotation(name):
-            yield
-    finally:
-        if _batches_seen >= _batches_to_trace and _trace_started:
-            jax.profiler.stop_trace()
-            _trace_started = False
-            _trace_done = True
-            log.info("profiler trace stopped after %d batches", _batches_seen)
+from torrent_tpu.obs.profiler import (  # noqa: F401
+    _flush_trace,
+    annotate,
+    maybe_profile_batch,
+    profile_batches,
+    profile_dir,
+)

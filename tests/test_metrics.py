@@ -152,11 +152,39 @@ class TestRendererEdgeCases:
         ).observe(0.004)
         prom_lint(render_obs_metrics())
 
+    def test_pipeline_renderer_fresh_ledger(self):
+        """A fresh (never-touched) ledger must render complete headers
+        with no samples — /metrics is often scraped at startup."""
+        from torrent_tpu.obs.ledger import PipelineLedger, render_pipeline_metrics
+
+        text = render_pipeline_metrics(PipelineLedger())
+        prom_lint(text)
+        assert "torrent_tpu_pipeline_wall_seconds 0" in text
+        assert "torrent_tpu_pipeline_stage_busy_seconds_total" in text
+
+    def test_pipeline_renderer_partial_and_overflow_stages(self):
+        """Partial activity (one stage touched) and unknown stage names
+        (a plane_factory plane inventing stages past the cardinality
+        bound) both render clean."""
+        from torrent_tpu.obs.ledger import PipelineLedger, render_pipeline_metrics
+
+        led = PipelineLedger()
+        led.record("h2d", 4096, 0.25)
+        for i in range(32):
+            led.record(f"rogue{i}", 1, 0.001)
+        text = render_pipeline_metrics(led)
+        prom_lint(text)
+        assert 'torrent_tpu_pipeline_stage_bytes_total{stage="h2d"} 4096' in text
+        assert 'stage="other"' in text
+        assert 'torrent_tpu_pipeline_bottleneck{stage="h2d"}' in text
+
     def test_full_exposition_concatenation_lints(self):
-        """What the bridge actually serves: sched + obs (+ tsan) in one
-        payload must still have unique series and complete headers."""
+        """What the bridge actually serves: sched + fabric + obs (incl.
+        the pipeline ledger) + tsan in one payload must still have
+        unique series and complete headers."""
         from torrent_tpu.analysis import sanitizer
         from torrent_tpu.obs import render_obs_metrics
+        from torrent_tpu.obs.ledger import pipeline_ledger
         from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
         from torrent_tpu.utils.metrics import (
             render_fabric_metrics,
@@ -164,6 +192,7 @@ class TestRendererEdgeCases:
             render_tsan_metrics,
         )
 
+        pipeline_ledger().record("read", 1024, 0.01)  # ledger series live
         sched = HashPlaneScheduler(SchedulerConfig(), hasher="cpu")
         text = (
             render_sched_metrics(sched)
@@ -172,6 +201,7 @@ class TestRendererEdgeCases:
             + render_tsan_metrics(sanitizer.TsanState().snapshot())
         )
         prom_lint(text)
+        assert "torrent_tpu_pipeline_stage_busy_seconds_total" in text
 
 
 class TestLiveScrape:

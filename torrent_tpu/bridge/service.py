@@ -15,6 +15,12 @@ codec every BitTorrent client already has:
   GET  /v1/trace     → JSON: ?id=<trace> the ordered span tree for that
                        trace; without id, the flight recorder's black-
                        box dumps + known trace ids (torrent_tpu/obs)
+  GET  /v1/pipeline  → JSON: the pipeline ledger's per-stage snapshot
+                       (read → stage → h2d → launch → digest → verdict)
+                       plus the bottleneck attributor's verdict — which
+                       stage limits the pipeline, achieved vs demanded
+                       rate (obs/ledger + obs/attrib; `torrent-tpu top`
+                       renders this live)
 
 Every request runs under a trace span: an ``X-Trace-Id`` request header
 is honored (well-formed tokens only) or a fresh id is minted, the id is
@@ -115,7 +121,7 @@ log = get_logger("bridge")
 _KNOWN_ROUTES = frozenset(
     {
         "/v1/digests", "/v1/verify", "/v1/info", "/v1/trace", "/metrics",
-        "/v1/fabric/verify", "/v1/fabric/status",
+        "/v1/pipeline", "/v1/fabric/verify", "/v1/fabric/status",
         "/v1/stream/digests", "/v1/stream/verify",
     }
 )
@@ -555,6 +561,8 @@ class BridgeServer:
             )
         if method == "GET" and target.split("?")[0] == "/v1/trace":
             return await self._trace_route(writer, target)
+        if method == "GET" and target.split("?")[0] == "/v1/pipeline":
+            return await self._pipeline_route(writer)
         if method == "GET" and target == "/v1/fabric/status":
             return await self._reply(writer, 200, bencode(self._fabric_status()))
         if method != "POST":
@@ -741,6 +749,41 @@ class BridgeServer:
                 b"degraded": int(s["degraded"]),
             }
         return out
+
+    async def _pipeline_route(self, writer):
+        """``GET /v1/pipeline`` — the bottleneck attribution surface.
+
+        Returns the pipeline ledger's since-start per-stage snapshot,
+        the attributor's verdict (limiting stage, achieved vs demanded
+        rate), and a small scheduler summary so ``torrent-tpu top`` can
+        render queue depth next to stage utilization. JSON with sorted
+        keys, same operator-surface conventions as ``/v1/trace``; pure
+        in-memory reads, safe on the serving loop."""
+        from torrent_tpu.obs.attrib import attribute
+        from torrent_tpu.obs.ledger import pipeline_ledger
+
+        snap = pipeline_ledger().snapshot()
+        sched_snap = self.sched.metrics_snapshot() if self.sched else {}
+        body = json.dumps(
+            {
+                "attribution": attribute(snap),
+                "snapshot": snap,
+                "sched": {
+                    "queue_pieces": sched_snap.get("queue_pieces", 0),
+                    "queue_bytes": sched_snap.get("queue_bytes", 0),
+                    "launches": sched_snap.get("launches", 0),
+                    "mean_fill": sched_snap.get("mean_fill", 0.0),
+                    "lanes": sched_snap.get("lanes", 0),
+                    "cpu_fallback_launches": sched_snap.get(
+                        "cpu_fallback_launches", 0
+                    ),
+                },
+            },
+            sort_keys=True,
+        ).encode()
+        return await self._reply(
+            writer, 200, body, content_type="application/json"
+        )
 
     async def _trace_route(self, writer, target: str):
         """``GET /v1/trace`` — the obs plane's query surface.

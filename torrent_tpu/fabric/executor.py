@@ -804,16 +804,24 @@ class FabricExecutor:
         def rehash() -> bool:
             import hashlib
 
+            from torrent_tpu.obs.ledger import pipeline_ledger
             from torrent_tpu.storage.piece import piece_length
             from torrent_tpu.storage.storage import StorageError
 
+            # sentinel work is real pipeline work: account the read and
+            # the CPU re-hash to the ledger like any other stage entry
+            led = pipeline_ledger()
             try:
-                data = storage.read_piece(piece)
+                with led.track("read") as tracked:
+                    data = storage.read_piece(piece)
+                    tracked.add(len(data))
             except (StorageError, OSError):
                 return False
+            with led.track("launch", len(data)):
+                digest = hashlib.sha1(data).digest()
             return (
                 len(data) == piece_length(info, piece)
-                and hashlib.sha1(data).digest() == info.pieces[piece]
+                and digest == info.pieces[piece]
             )
 
         self._sentinel_checks += 1

@@ -107,16 +107,22 @@ class NativeIOEngine:
             raise ValueError("segment file index out of range")
         path_arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
         statuses = np.zeros(seg_arr.shape[0], dtype=np.int32)
-        with self._lock:
-            rc = self._lib.tt_io_read_batch(
-                self._handle,
-                path_arr,
-                len(paths),
-                seg_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-                seg_arr.shape[0],
-                ctypes.cast(base_addr, ctypes.POINTER(ctypes.c_uint8)),
-                statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            )
+        # pipeline-ledger "read" stage: the batched pread is the storage
+        # boundary of the read_batch paths (read_pieces_chunk instruments
+        # the per-piece Python path; the two never overlap)
+        from torrent_tpu.obs.ledger import pipeline_ledger
+
+        with pipeline_ledger().track("read", int(seg_arr[:, 3].sum())):
+            with self._lock:
+                rc = self._lib.tt_io_read_batch(
+                    self._handle,
+                    path_arr,
+                    len(paths),
+                    seg_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    seg_arr.shape[0],
+                    ctypes.cast(base_addr, ctypes.POINTER(ctypes.c_uint8)),
+                    statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                )
         del keepalive
         if rc != 0:
             bad = np.nonzero(statuses)[0]

@@ -2,10 +2,15 @@
 
 Three tiers over the same ticket lifecycle, cheapest first:
 
-1. **Latency histograms** (``obs/hist``): always-on fixed-log2-bucket
+1. **Latency histograms** (``obs/hist``) and the **pipeline ledger**
+   (``obs/ledger`` + ``obs/attrib``): always-on fixed-log2-bucket
    per-stage distributions (queue wait, launch, end-to-end per tenant,
-   bridge request), rendered as real Prometheus histogram series on
-   every ``/metrics`` scrape.
+   bridge request) plus byte/time/occupancy accounting at every
+   pipeline stage boundary (read → stage → h2d → launch → digest →
+   verdict) feeding a bottleneck attributor — rendered as real
+   Prometheus series on every ``/metrics`` scrape and served as
+   ``GET /v1/pipeline`` / ``torrent-tpu top`` / ``doctor
+   --bottleneck``.
 2. **Span tracer** (``obs/tracer``): per-trace span trees — trace IDs
    minted at the bridge (``X-Trace-Id`` honored/emitted), threaded
    through the scheduler's ticket lifecycle and the fabric's units,
@@ -25,7 +30,14 @@ are leaves of the lock-order graph) and keeps exchanged/dumped bytes
 deterministic: monotonic-only timestamps, sorted keys.
 """
 
+from torrent_tpu.obs.attrib import attribute, format_report
 from torrent_tpu.obs.hist import HistogramRegistry, LogHistogram, histograms
+from torrent_tpu.obs.ledger import (
+    PIPELINE_STAGES,
+    PipelineLedger,
+    pipeline_ledger,
+    render_pipeline_metrics,
+)
 from torrent_tpu.obs.recorder import FlightRecorder, flight_recorder
 from torrent_tpu.obs.tracer import (
     Span,
@@ -40,13 +52,19 @@ __all__ = [
     "FlightRecorder",
     "HistogramRegistry",
     "LogHistogram",
+    "PIPELINE_STAGES",
+    "PipelineLedger",
     "Span",
     "Tracer",
+    "attribute",
     "fabric_trace_id",
     "flight_recorder",
+    "format_report",
     "heartbeat_span_context",
     "histograms",
+    "pipeline_ledger",
     "render_obs_metrics",
+    "render_pipeline_metrics",
     "tracer",
     "valid_trace_id",
 ]
@@ -54,6 +72,11 @@ __all__ = [
 
 def render_obs_metrics() -> str:
     """The obs plane's /metrics contribution: every latency-histogram
-    family plus the flight-recorder dump counters. Appended by both the
-    bridge's ``/metrics`` and the session ``MetricsServer``."""
-    return histograms().render() + flight_recorder().render_metrics()
+    family, the pipeline ledger's per-stage series + bottleneck verdict,
+    and the flight-recorder dump counters. Appended by both the bridge's
+    ``/metrics`` and the session ``MetricsServer``."""
+    return (
+        histograms().render()
+        + render_pipeline_metrics()
+        + flight_recorder().render_metrics()
+    )

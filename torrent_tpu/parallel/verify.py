@@ -246,18 +246,24 @@ def read_pieces_chunk(storage: Storage, info: InfoDict, idxs):
     because a backend that leaks a raw errno (file truncated between
     open and pread) must not kill the pass. The ONE implementation of
     the read/filter/keep contract, shared by the scheduler sessions
-    here and the fabric executor (``torrent_tpu/fabric``)."""
+    here and the fabric executor (``torrent_tpu/fabric``) — which also
+    makes it the pipeline ledger's ``read`` stage boundary for every
+    scheduler-fed path."""
+    from torrent_tpu.obs.ledger import pipeline_ledger
+
     payloads, exps, keep = [], [], []
-    for i in idxs:
-        try:
-            data = storage.read_piece(i)
-        except (StorageError, OSError):
-            continue
-        if len(data) != piece_length(info, i):
-            continue
-        payloads.append(data)
-        exps.append(info.pieces[i])
-        keep.append(i)
+    with pipeline_ledger().track("read") as tracked:
+        for i in idxs:
+            try:
+                data = storage.read_piece(i)
+            except (StorageError, OSError):
+                continue
+            tracked.add(len(data))
+            if len(data) != piece_length(info, i):
+                continue
+            payloads.append(data)
+            exps.append(info.pieces[i])
+            keep.append(i)
     return payloads, exps, keep
 
 

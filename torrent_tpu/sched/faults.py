@@ -14,7 +14,11 @@ be provoked on demand, so they are *injected* instead. A
   :class:`PoisonedPayloadError` (the poisoned-ticket model; skips
   retries, drives bisection until the ticket fails alone).
 * ``latency_s`` — every launch sleeps first (latency-spike model; used
-  to prove deadlines/backpressure survive a slow plane).
+  to prove deadlines/backpressure survive a slow plane). The sleep is
+  accounted to the pipeline ledger's ``h2d`` stage — it models a slow
+  host→device interconnect, which makes bottleneck attribution
+  (``obs/attrib.py``, ``doctor --bottleneck``) deterministically
+  testable on CPU-only hosts.
 * ``dead_after`` — every launch past the Nth raises (permanent device
   loss; the breaker must pin the lane on the CPU plane).
 
@@ -184,7 +188,16 @@ class FaultyPlane:
             self.launches += 1
             n = self.launches
         if plan.latency_s:
-            time.sleep(plan.latency_s)
+            from torrent_tpu.obs.ledger import pipeline_ledger
+
+            # the injected latency models a slow host→device transfer:
+            # account it to the ledger's h2d stage so the bottleneck
+            # attributor can be exercised deterministically without a
+            # device (the sleep runs outside every obs lock)
+            with pipeline_ledger().track(
+                "h2d", sum(len(p) for p in payloads)
+            ):
+                time.sleep(plan.latency_s)
         if plan.payload_prefix is not None and any(
             p.startswith(plan.payload_prefix) for p in payloads
         ):

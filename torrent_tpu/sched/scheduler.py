@@ -43,7 +43,11 @@ histograms, traced submissions get per-stage spans (enqueue →
 admission/shed → lane wait → launch/retry/bisect → digest → verdict),
 the flight recorder dumps a black box on breaker-open and
 retry-exhausted failures, and device launches are annotated in the
-deep-dive profiler timeline via ``obs/profiler.py``.
+deep-dive profiler timeline via ``obs/profiler.py``. The pipeline
+ledger (``obs/ledger.py``) additionally accounts byte/time/occupancy
+at every stage boundary — staging-slot copies, device puts (h2d),
+launches, D2H fetches, and the verdict demux — feeding the bottleneck
+attributor behind ``GET /v1/pipeline`` and ``doctor --bottleneck``.
 
 Failure domains. A launch exception must not fail every co-batched
 ticket across all tenants, so dispatch is fault-isolated in two layers:
@@ -89,6 +93,7 @@ from typing import Callable
 
 from torrent_tpu.analysis.sanitizer import named_lock
 from torrent_tpu.obs.hist import histograms
+from torrent_tpu.obs.ledger import pipeline_ledger
 from torrent_tpu.obs.recorder import flight_recorder
 from torrent_tpu.obs.tracer import tracer
 from torrent_tpu.utils.log import get_logger
@@ -517,35 +522,41 @@ class _StagingSlots:
         from torrent_tpu.ops.padding import alloc_padded, pad_in_place
 
         rows = self.rows if rows is None else rows
-        with self._lock:
-            slot = self._slots.pop() if self._slots else None
-        if slot is None:
-            padded, view = alloc_padded(self.rows, self.piece_len)
-            slot = (padded, view, np.zeros(self.rows, dtype=np.int64))
-        padded, view, ends = slot
-        try:
-            lengths = np.zeros(rows, dtype=np.int64)
-            for i in range(rows):
-                n = len(chunk[i]) if i < len(chunk) else 0
-                stale = int(ends[i])
-                if stale > n:
-                    padded[i, n:stale] = 0
-                if n:
-                    view[i, :n] = np.frombuffer(chunk[i], dtype=np.uint8)
-                    lengths[i] = n
-            nblocks = pad_in_place(padded[:rows], lengths)
-            # content extent (message + padding) per row, for the next
-            # reuse's tail zeroing — recorded before sentinels clear
-            ends[:rows] = nblocks.astype(np.int64) * 64
-        except Exception:
-            # return the slot instead of leaking it; rows may hold
-            # half-staged content past their recorded extents, so mark
-            # them full-width — the next reuse tail-zeroes everything
-            ends[:rows] = padded.shape[1]
-            self.checkin(slot)
-            raise
-        nblocks[len(chunk) :] = 0  # sentinel rows: skip entirely
-        return slot, padded, nblocks
+        # pipeline-ledger "stage" boundary: the host copy into the
+        # staging slot (the tracker's lock is leaf-scoped at entry/exit;
+        # the copy itself runs outside any obs lock)
+        with pipeline_ledger().track(
+            "stage", sum(len(c) for c in chunk)
+        ):
+            with self._lock:
+                slot = self._slots.pop() if self._slots else None
+            if slot is None:
+                padded, view = alloc_padded(self.rows, self.piece_len)
+                slot = (padded, view, np.zeros(self.rows, dtype=np.int64))
+            padded, view, ends = slot
+            try:
+                lengths = np.zeros(rows, dtype=np.int64)
+                for i in range(rows):
+                    n = len(chunk[i]) if i < len(chunk) else 0
+                    stale = int(ends[i])
+                    if stale > n:
+                        padded[i, n:stale] = 0
+                    if n:
+                        view[i, :n] = np.frombuffer(chunk[i], dtype=np.uint8)
+                        lengths[i] = n
+                nblocks = pad_in_place(padded[:rows], lengths)
+                # content extent (message + padding) per row, for the next
+                # reuse's tail zeroing — recorded before sentinels clear
+                ends[:rows] = nblocks.astype(np.int64) * 64
+            except Exception:
+                # return the slot instead of leaking it; rows may hold
+                # half-staged content past their recorded extents, so mark
+                # them full-width — the next reuse tail-zeroes everything
+                ends[:rows] = padded.shape[1]
+                self.checkin(slot)
+                raise
+            nblocks[len(chunk) :] = 0  # sentinel rows: skip entirely
+            return slot, padded, nblocks
 
     def checkin(self, slot) -> None:
         with self._lock:
@@ -565,7 +576,8 @@ class _CpuPlane:
 
     def run(self, payloads: list[bytes]) -> list[bytes]:
         h = self._h
-        return [h(p).digest() for p in payloads]
+        with pipeline_ledger().track("launch", sum(len(p) for p in payloads)):
+            return [h(p).digest() for p in payloads]
 
 
 class _Sha1DevicePlane:
@@ -610,11 +622,18 @@ class _Sha1DevicePlane:
         out: list[bytes] = []
         for start in range(0, len(payloads), b):
             chunk = payloads[start : start + b]
+            nb = sum(len(p) for p in chunk)
             slot, padded, nblocks = self._slots.stage(chunk)
             try:
+                # ledger note: digest_batch fuses its device put into the
+                # dispatch, so this plane's h2d shows under "launch" until
+                # the zero-copy ingest refactor splits it (the sha256
+                # planes already report h2d explicitly)
                 with self._device_lock:
-                    words = v.digest_batch(padded, nblocks)
-                out.extend(words_to_digests(words[: len(chunk)]))
+                    with pipeline_ledger().track("launch", nb):
+                        words = v.digest_batch(padded, nblocks)
+                with pipeline_ledger().track("digest", nb):
+                    out.extend(words_to_digests(words[: len(chunk)]))
             finally:
                 self._slots.checkin(slot)
         return out
@@ -652,14 +671,26 @@ class _Sha256DevicePlane:
             raise ValueError("piece longer than plane piece_length")
         out: list[bytes] = []
         b = self._batch
+        led = pipeline_ledger()
         for start in range(0, len(payloads), b):
             chunk = payloads[start : start + b]
+            nb = sum(len(p) for p in chunk)
             slot, padded, nblocks = self._slots.stage(chunk)
             try:
                 with self._device_lock:
-                    words = np.asarray(
-                        self._fn(jnp.asarray(padded), jnp.asarray(nblocks))
-                    )
+                    # ledger stage boundaries: the explicit device put
+                    # (h2d), the jitted dispatch (launch — async, so the
+                    # blocking D2H fetch absorbs device time), D2H fetch
+                    # (digest). Bytes are payload bytes throughout so
+                    # cross-stage rates compare (the physical transfer
+                    # moves the padded footprint).
+                    with led.track("h2d", nb):
+                        dev_p = jnp.asarray(padded)
+                        dev_n = jnp.asarray(nblocks)
+                    with led.track("launch", nb):
+                        words_dev = self._fn(dev_p, dev_n)
+                    with led.track("digest", nb):
+                        words = np.asarray(words_dev)
                 out.extend(words32_to_digests(words[: len(chunk)]))
             finally:
                 self._slots.checkin(slot)
@@ -740,8 +771,10 @@ class _Sha256PallasPlane:
             raise ValueError("piece longer than plane piece_length")
         out: list[bytes] = []
         b = self._batch
+        led = pipeline_ledger()
         for start in range(0, len(payloads), b):
             chunk = payloads[start : start + b]
+            nb = sum(len(p) for p in chunk)
             rows, ts, il2 = self._plan(len(chunk))
             slot, padded, nblocks = self._slots.stage(chunk, rows)
             try:
@@ -750,15 +783,22 @@ class _Sha256PallasPlane:
                 # the view is free and the slab contiguous)
                 data32 = padded[:rows].view(np.uint32)
                 with self._device_lock:
-                    words = np.asarray(
-                        self._sp.sha256_pieces_pallas(
-                            jnp.asarray(data32),
-                            jnp.asarray(nblocks),
+                    # same ledger boundaries as the scan plane: explicit
+                    # put = h2d, jitted dispatch = launch (async — the
+                    # blocking fetch absorbs device time), fetch = digest
+                    with led.track("h2d", nb):
+                        dev_d = jnp.asarray(data32)
+                        dev_n = jnp.asarray(nblocks)
+                    with led.track("launch", nb):
+                        words_dev = self._sp.sha256_pieces_pallas(
+                            dev_d,
+                            dev_n,
                             interpret=self._interpret,
                             tile_sub=ts,
                             interleave2=il2,
                         )
-                    )
+                    with led.track("digest", nb):
+                        words = np.asarray(words_dev)
                 out.extend(words32_to_digests(words[: len(chunk)]))
             finally:
                 self._slots.checkin(slot)
@@ -1451,6 +1491,12 @@ class HashPlaneScheduler:
     def _demux(self, tickets: list[_Ticket], digests, error=None) -> None:
         """Per-launch result demux back to the awaiting submissions,
         releasing queue bytes (and any blocked submitters) as it goes."""
+        with pipeline_ledger().track(
+            "verdict", sum(t.nbytes for t in tickets)
+        ):
+            self._demux_inner(tickets, digests, error)
+
+    def _demux_inner(self, tickets: list[_Ticket], digests, error=None) -> None:
         t_now = time.monotonic()
         e2e_by_tenant: dict[str, list[float]] = {}
         done_subs: dict[int, _Submission] = {}

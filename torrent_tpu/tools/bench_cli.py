@@ -1,0 +1,507 @@
+"""`torrent-tpu bench` — unified bench rungs, banked-schema records, and
+the trajectory comparator.
+
+Replaces the ad-hoc ``.bench/*.sh`` rung logic with one command: every
+rung is named, emits ONE banked-schema JSON line, and (for in-process
+rungs) embeds the pipeline ledger's per-stage breakdown, so every
+record carries its own bottleneck attribution instead of needing bench
+archaeology. The moment a quiet device window opens, banking a rung is
+one command.
+
+Rungs::
+
+    torrent-tpu bench smoke      # CPU-plane scheduler recheck (seconds;
+                                 # the CI rung — in-process, ledger
+                                 # breakdown embedded)
+    torrent-tpu bench v2         # r6 sha256 leaf-plane rung: bench.py
+                                 # BENCH_CONFIG=v2 under the median-of-3
+                                 # contract, pallas backend (device)
+    torrent-tpu bench flagship   # B=8192 headline shape re-confirmation
+                                 # (device, BENCH_CONFIG=headline)
+    torrent-tpu bench fabric     # r7 fabric scaling rung: 1/2/4-process
+                                 # CPU fabric verify, median-of-3
+
+``--smoke`` is an alias for the smoke rung (CI spells it that way).
+Device rungs shell out to the repo's ``bench.py`` / ``.bench/
+measure_fabric.py`` with the same env the retired rung scripts
+exported, and pass the child's record through wrapped in the bench
+schema; they obey bench.py's wedge-safety rules (never kill a
+TPU-touching process).
+
+Record schema (``"schema": "torrent-tpu-bench/1"``): the banked-record
+fields bench.py already emits (metric/value/unit/vs_baseline/batch/
+platform/…) plus ``rung``, ``measured_at_utc``, and ``ledger`` — the
+per-stage busy/bytes/utilization table and the bottleneck verdict from
+``obs/attrib.attribute`` (null for subprocess rungs, whose ledger lives
+in the child).
+
+Comparator (``--compare``): gates a candidate record against the banked
+trajectory (``BENCH_trajectory.json``, built by ``.bench/summarize.py
+--trajectory`` and appended to by ``--bank``). Like-for-like means an
+identical measurement shape — ``metric``, ``platform``, ``batch``,
+payload shape (``piece_kb``/``bytes``), and host class (``nproc``) —
+and the banked record is not flagged ``non_like_for_like`` (the
+BENCH_CONFIGS_r05 shape caveats).
+With no like-for-like banked record the comparator reports itself
+**unarmed** and exits 0 — the CI gate arms itself only once a
+comparable record is banked. ``--report-only`` never fails the run.
+
+Exit codes: 0 = rung ok / comparator passed or unarmed; 1 = rung
+failed, null value, or regression beyond ``--tolerance``; 2 = usage.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+__all__ = ["compare_record", "load_trajectory", "main"]
+
+SCHEMA = "torrent-tpu-bench/1"
+TRAJECTORY_SCHEMA = "torrent-tpu-bench-trajectory/1"
+RUNGS = ("smoke", "v2", "fabric", "flagship")
+DEFAULT_TOLERANCE = 0.10
+
+# env the retired .bench rung scripts exported, reproduced per rung
+# (r6_sha256_rung.sh leg 2; the flagship shape from BENCH_CONFIGS_r05)
+_DEVICE_RUNG_ENV = {
+    "v2": {
+        "BENCH_CONFIG": "v2",
+        "BENCH_TOTAL_MB": "256",
+        "BENCH_V2_NRES": "3",
+        "BENCH_E2E_MB": "16",
+        "BENCH_H2D_MB": "8",
+        "BENCH_NO_REPLAY": "1",
+        "TORRENT_TPU_SHA256_BACKEND": "pallas",
+    },
+    "flagship": {
+        "BENCH_CONFIG": "headline",
+        "BENCH_BATCH": "8192",
+        "BENCH_TOTAL_MB": "2048",
+        "BENCH_NO_REPLAY": "1",
+    },
+}
+
+
+def _utcnow() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _repo_root() -> str:
+    """The source checkout root (bench.py / .bench live there). Device
+    rungs need it; the smoke rung and comparator do not."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def default_trajectory_path() -> str:
+    env = os.environ.get("TORRENT_TPU_BENCH_TRAJECTORY")
+    if env:
+        return env
+    repo = os.path.join(_repo_root(), "BENCH_trajectory.json")
+    if os.path.exists(repo):
+        return repo
+    return os.path.join(os.getcwd(), "BENCH_trajectory.json")
+
+
+# ------------------------------------------------------------ smoke rung
+
+
+def _build_smoke_torrent(tmp: str, total_mb: int, piece_kb: int):
+    """Synthetic single-file torrent on real disk (the read stage must
+    measure actual storage reads, not memory copies)."""
+    import numpy as np
+
+    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.storage.storage import FsStorage, Storage
+    from torrent_tpu.tools.make_torrent import make_torrent
+
+    payload_path = os.path.join(tmp, "bench_smoke.bin")
+    rng = np.random.default_rng(7)
+    total = total_mb << 20
+    with open(payload_path, "wb") as f:
+        f.write(rng.integers(0, 256, total, dtype=np.uint8).tobytes())
+    meta = parse_metainfo(
+        make_torrent(
+            payload_path, "http://bench.invalid/announce",
+            piece_length=piece_kb << 10,
+        )
+    )
+    return Storage(FsStorage(tmp), meta.info), meta.info
+
+
+async def _smoke(total_mb: int, piece_kb: int, batch_target: int) -> dict:
+    """The CPU-plane rung: a scheduler-fed library recheck with the
+    pipeline ledger attributing every stage. Deterministic, CPU-only,
+    seconds — the rung CI runs on every PR."""
+    from torrent_tpu.obs.attrib import attribute
+    from torrent_tpu.obs.ledger import pipeline_ledger
+    from torrent_tpu.parallel.bulk import verify_library_sched
+    from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
+
+    with tempfile.TemporaryDirectory(prefix="tt_bench_smoke_") as tmp:
+        storage, info = await asyncio.to_thread(
+            _build_smoke_torrent, tmp, total_mb, piece_kb
+        )
+        led = pipeline_ledger()
+        prev = led.snapshot()
+        sched = HashPlaneScheduler(
+            SchedulerConfig(batch_target=batch_target, flush_deadline=0.02),
+            hasher="cpu",
+        )
+        await sched.start()
+        try:
+            t0 = time.perf_counter()
+            res = await verify_library_sched([(storage, info)], sched, tenant="bench")
+            seconds = time.perf_counter() - t0
+        finally:
+            await sched.close()
+        rep = attribute(led.snapshot(), prev=prev)
+    n_valid = int(res.bitfields[0].sum())
+    pieces = info.num_pieces
+    value = round(pieces / seconds, 1) if seconds > 0 else None
+    return {
+        "schema": SCHEMA,
+        "rung": "smoke",
+        "metric": f"sha1_recheck_smoke_{piece_kb}KiB_pieces_per_sec",
+        "value": value if n_valid == pieces else None,
+        "unit": "pieces/s",
+        "pieces": pieces,
+        "valid": n_valid,
+        "bytes": info.length,
+        "seconds": round(seconds, 4),
+        "gib_per_sec": round(info.length / seconds / 2**30, 3) if seconds else None,
+        "batch": batch_target,
+        "piece_kb": piece_kb,
+        "platform": "cpu",
+        "plane": "cpu",
+        # host class for the like-for-like key: a CPU-plane rate banked
+        # on a big workstation must not gate a smaller CI runner
+        "nproc": os.cpu_count(),
+        "measured_at_utc": _utcnow(),
+        "ledger": {
+            "wall_s": rep["wall_s"],
+            "stages": rep["stages"],
+            "bottleneck": rep["bottleneck"],
+        },
+    }
+
+
+# ----------------------------------------------------------- device rungs
+
+
+def _run_bench_py(rung: str, timeout: float | None) -> dict:
+    """Run the repo bench.py with the rung's env; pass its record
+    through wrapped in the bench schema. Wedge safety is bench.py's own
+    (never kills a TPU process; emits tpu_unavailable markers)."""
+    bench_py = os.path.join(_repo_root(), "bench.py")
+    if not os.path.exists(bench_py):
+        raise FileNotFoundError(
+            f"device rung {rung!r} needs the source checkout's bench.py "
+            f"(looked at {bench_py})"
+        )
+    env = dict(os.environ)
+    env.update(_DEVICE_RUNG_ENV[rung])
+    proc = subprocess.run(
+        [sys.executable, bench_py],
+        env=env, cwd=_repo_root(), capture_output=True, text=True,
+        timeout=timeout,
+    )
+    line = ""
+    for out_line in (proc.stdout or "").splitlines():
+        out_line = out_line.strip()
+        if out_line.startswith("{"):
+            line = out_line  # last JSON line wins (bench.py contract)
+    if not line:
+        raise RuntimeError(
+            f"bench.py emitted no record (rc={proc.returncode}): "
+            f"{(proc.stderr or '')[-500:]}"
+        )
+    rec = json.loads(line)
+    rec.update(
+        schema=SCHEMA, rung=rung, measured_at_utc=_utcnow(),
+        # the ledger lives in the child process; only in-process rungs
+        # embed the stage breakdown
+        ledger=None,
+    )
+    return rec
+
+
+def _run_fabric_rung(timeout: float | None) -> dict:
+    """The r7 scaling rung: 1/2/4-process CPU fabric verify, median-of-3
+    per process count, value = the 4-process GiB/s."""
+    measure = os.path.join(_repo_root(), ".bench", "measure_fabric.py")
+    if not os.path.exists(measure):
+        raise FileNotFoundError(
+            f"fabric rung needs the source checkout ({measure} missing)"
+        )
+    results: dict[int, list[float]] = {}
+    with tempfile.TemporaryDirectory(prefix="tt_bench_fabric_") as work:
+        for nproc in (1, 2, 4):
+            proc = subprocess.run(
+                [
+                    sys.executable, measure, "--workdir", work,
+                    "--nproc", str(nproc), "--reps", "3",
+                    "--torrents", "8", "--mb-per-torrent", "64",
+                    "--hasher", os.environ.get("FABRIC_HASHER", "cpu"),
+                ],
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                capture_output=True, text=True, timeout=timeout,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"fabric leg nproc={nproc} failed rc={proc.returncode}: "
+                    f"{(proc.stderr or '')[-500:]}"
+                )
+            for out_line in (proc.stdout or "").splitlines():
+                out_line = out_line.strip()
+                if out_line.startswith("{"):
+                    rec = json.loads(out_line)
+                    results.setdefault(rec["nproc"], []).append(
+                        rec["gib_per_sec"]
+                    )
+    med = {n: round(statistics.median(v), 3) for n, v in sorted(results.items())}
+    base = med.get(1)
+    return {
+        "schema": SCHEMA,
+        "rung": "fabric",
+        "metric": "fabric_scaling_gib_per_sec",
+        "value": med.get(4),
+        "unit": "GiB/s",
+        "contract": "median-of-3",
+        "scaling": {str(n): v for n, v in med.items()},
+        "speedup_4p": round(med[4] / base, 2) if base and med.get(4) else None,
+        "platform": os.environ.get("FABRIC_HASHER", "cpu"),
+        "batch": None,
+        "measured_at_utc": _utcnow(),
+        "ledger": None,
+    }
+
+
+# ------------------------------------------------------------- comparator
+
+
+def load_trajectory(path: str) -> list[dict]:
+    """Records list from a trajectory file (``{"records": [...]}``,
+    a bare list, or a single record dict). Missing file → []."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return []
+    if isinstance(data, dict):
+        recs = data.get("records")
+        if isinstance(recs, list):
+            return [r for r in recs if isinstance(r, dict)]
+        return [data] if data.get("metric") else []
+    if isinstance(data, list):
+        return [r for r in data if isinstance(r, dict)]
+    return []
+
+
+# every field that defines a comparable measurement: the metric, the
+# plane (platform), the launch shape (batch), the payload shape
+# (piece_kb/bytes), and the host class (nproc — CPU-plane throughput
+# scales with cores, and a workstation-banked record must not gate a
+# smaller CI runner). Fields absent from BOTH records match vacuously,
+# so device bench.py records (no piece_kb/nproc) keep their old key.
+_LIKE_KEYS = ("metric", "platform", "batch", "piece_kb", "bytes", "nproc")
+
+
+def like_for_like(records: list[dict], cand: dict) -> list[dict]:
+    """Banked records the candidate may be gated against: identical
+    measurement shape (:data:`_LIKE_KEYS`), value present, and not
+    carrying a non-like-for-like shape caveat (the BENCH_CONFIGS_r05
+    discipline)."""
+    return [
+        r
+        for r in records
+        if r.get("value") is not None
+        and not r.get("non_like_for_like")
+        and all(r.get(k) == cand.get(k) for k in _LIKE_KEYS)
+    ]
+
+
+def compare_record(
+    cand: dict, records: list[dict], tolerance: float = DEFAULT_TOLERANCE
+) -> tuple[int, str]:
+    """(exit_code, message): 0 = within tolerance of the banked best or
+    comparator unarmed (no like-for-like record); 1 = regression."""
+    if cand.get("value") is None:
+        return 1, "comparator: candidate record has no value (rung failed?)"
+    eligible = like_for_like(records, cand)
+    if not eligible:
+        return 0, (
+            f"comparator unarmed: no banked like-for-like record for "
+            f"metric={cand.get('metric')!r} platform={cand.get('platform')!r} "
+            f"batch={cand.get('batch')!r} (gate arms once one is banked)"
+        )
+    best = max(r["value"] for r in eligible)
+    floor = best * (1.0 - tolerance)
+    value = cand["value"]
+    if value < floor:
+        return 1, (
+            f"REGRESSION: {cand['metric']} = {value} {cand.get('unit', '')} "
+            f"< {floor:.1f} (banked best {best} − {tolerance:.0%} tolerance, "
+            f"{len(eligible)} like-for-like record(s))"
+        )
+    verdict = "improves on" if value > best else "within tolerance of"
+    return 0, (
+        f"comparator ok: {cand['metric']} = {value} {cand.get('unit', '')} "
+        f"{verdict} banked best {best}"
+    )
+
+
+def bank_record(cand: dict, path: str) -> None:
+    """Append the record to the trajectory file (atomic write; creates
+    the file with the trajectory schema when missing). History is kept —
+    the comparator gates against the best like-for-like value."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {"schema": TRAJECTORY_SCHEMA, "records": []}
+    if isinstance(data, list):
+        data = {"schema": TRAJECTORY_SCHEMA, "records": data}
+    data.setdefault("records", []).append(cand)
+    data["banked_at_utc"] = _utcnow()
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+# -------------------------------------------------------------------- cli
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="torrent-tpu bench", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "rung", nargs="?", choices=RUNGS,
+        help="named rung to run (smoke/v2/fabric/flagship)",
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="alias for the smoke rung (the CI spelling)",
+    )
+    ap.add_argument(
+        "--mb", type=int, default=8,
+        help="smoke rung: payload MiB (default %(default)s)",
+    )
+    ap.add_argument(
+        "--piece-kb", type=int, default=256,
+        help="smoke rung: piece size KiB (default %(default)s)",
+    )
+    ap.add_argument(
+        "--batch-target", type=int, default=32,
+        help="smoke rung: scheduler pieces-per-launch target",
+    )
+    ap.add_argument(
+        "--timeout", type=float, default=None,
+        help="device-rung subprocess timeout seconds (default: none)",
+    )
+    ap.add_argument("--out", default=None, help="also write the record here")
+    ap.add_argument(
+        "--record", default=None, metavar="FILE",
+        help="skip the run; compare/bank this existing record instead",
+    )
+    ap.add_argument(
+        "--compare", action="store_true",
+        help="gate the record against the banked trajectory",
+    )
+    ap.add_argument(
+        "--trajectory", default=None, metavar="FILE",
+        help="trajectory file (default: TORRENT_TPU_BENCH_TRAJECTORY or "
+        "BENCH_trajectory.json in the repo root / cwd)",
+    )
+    ap.add_argument(
+        "--tolerance", type=float, default=DEFAULT_TOLERANCE,
+        help="allowed fractional regression vs the banked best "
+        "(default %(default)s)",
+    )
+    ap.add_argument(
+        "--report-only", action="store_true",
+        help="comparator reports but never fails the run",
+    )
+    ap.add_argument(
+        "--bank", action="store_true",
+        help="append the record to the trajectory file (self-banking)",
+    )
+    args = ap.parse_args(argv)
+
+    rung = args.rung
+    if args.smoke:
+        if rung not in (None, "smoke"):
+            print("error: --smoke conflicts with an explicit rung",
+                  file=sys.stderr)
+            return 2
+        rung = "smoke"
+    if rung is None and args.record is None:
+        print("error: name a rung (smoke/v2/fabric/flagship) or pass "
+              "--record FILE", file=sys.stderr)
+        return 2
+
+    if args.record is not None:
+        try:
+            with open(args.record) as f:
+                record = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read record {args.record!r}: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        try:
+            if rung == "smoke":
+                record = asyncio.run(
+                    _smoke(args.mb, args.piece_kb, args.batch_target)
+                )
+            elif rung == "fabric":
+                record = _run_fabric_rung(args.timeout)
+            else:
+                record = _run_bench_py(rung, args.timeout)
+        except (RuntimeError, FileNotFoundError,
+                subprocess.TimeoutExpired) as e:
+            print(f"error: rung {rung!r} failed: {e}", file=sys.stderr)
+            return 1
+        line = json.dumps(record, sort_keys=True)
+        print(line)
+        if args.out:
+            tmp = args.out + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(line + "\n")
+            os.replace(tmp, args.out)
+
+    rc = 0
+    if record.get("value") is None and not args.report_only:
+        print("bench: record value is null (device unavailable or rung "
+              "failed)", file=sys.stderr)
+        rc = 1
+
+    trajectory_path = args.trajectory or default_trajectory_path()
+    if args.bank and record.get("value") is not None:
+        bank_record(record, trajectory_path)
+        print(f"banked into {trajectory_path}", file=sys.stderr)
+    if args.compare:
+        code, message = compare_record(
+            record, load_trajectory(trajectory_path), args.tolerance
+        )
+        print(message, file=sys.stderr)
+        if code and not args.report_only:
+            rc = max(rc, code)
+    return rc
+
+
+if __name__ == "__main__":  # pragma: no cover - manual entrypoint
+    sys.exit(main())

@@ -13,6 +13,9 @@ One multiplexed entry point over the whole framework::
     torrent-tpu fabric-verify TORRENTS_DIR DATA_ROOT
                          [--coordinator HOST:PORT --num-processes N --process-id I]
                          [--cpu-devices K] [--heartbeat-dir DIR] [--hasher cpu|tpu]
+    torrent-tpu top      [--url URL] [--interval S] [--once]
+    torrent-tpu bench    [smoke|v2|fabric|flagship] [--compare] [--bank]
+                         [--trajectory FILE] [--tolerance F] [--report-only]
 
 ``download`` accepts either a ``.torrent`` file or a ``magnet:?...`` URI
 (BEP 9 metadata fetch). Also runnable as ``python -m torrent_tpu``.
@@ -1156,15 +1159,58 @@ def _cmd_doctor(args) -> int:
     argv = ["--device-wait", str(args.device_wait)]
     if args.skip_swarm:
         argv.append("--skip-swarm")
+    if getattr(args, "faults", False):
+        argv.append("--faults")
+    if getattr(args, "v2", False):
+        argv.append("--v2")
     if getattr(args, "fabric", False):
         argv.append("--fabric")
     if getattr(args, "lint", False):
         argv.append("--lint")
     if getattr(args, "trace", False):
         argv.append("--trace")
+    if getattr(args, "bottleneck", False):
+        argv.append("--bottleneck")
     if getattr(args, "json", False):
         argv.append("--json")
     return doctor_cli(argv)
+
+
+def _cmd_top(args) -> int:
+    from torrent_tpu.tools.top import main as top_main
+
+    argv = ["--url", args.url, "--interval", str(args.interval)]
+    if args.once:
+        argv.append("--once")
+    return top_main(argv)
+
+
+def _cmd_bench(args) -> int:
+    from torrent_tpu.tools.bench_cli import main as bench_main
+
+    argv: list[str] = []
+    if args.rung:
+        argv.append(args.rung)
+    if args.smoke:
+        argv.append("--smoke")
+    argv += ["--mb", str(args.mb), "--piece-kb", str(args.piece_kb),
+             "--batch-target", str(args.batch_target),
+             "--tolerance", str(args.tolerance)]
+    if args.timeout is not None:
+        argv += ["--timeout", str(args.timeout)]
+    if args.out:
+        argv += ["--out", args.out]
+    if args.record:
+        argv += ["--record", args.record]
+    if args.trajectory:
+        argv += ["--trajectory", args.trajectory]
+    if args.compare:
+        argv.append("--compare")
+    if args.report_only:
+        argv.append("--report-only")
+    if args.bank:
+        argv.append("--bank")
+    return bench_main(argv)
 
 
 def _cmd_edit(args) -> int:
@@ -1809,6 +1855,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("--device-wait", type=float, default=20.0)
     sp.add_argument("--skip-swarm", action="store_true")
+    sp.add_argument("--faults", action="store_true",
+                    help="also run the fault-tolerance smoke: injected "
+                    "fail-then-recover plan proving bisection isolation "
+                    "and breaker trip/recovery")
+    sp.add_argument("--v2", action="store_true",
+                    help="also run the BEP 52 plane smoke: leaf + "
+                    "merkle-pair digests vs hashlib through the pallas "
+                    "sha256 lane (interpret-safe)")
+    sp.add_argument("--bottleneck", action="store_true",
+                    help="also run the pipeline-ledger smoke: a "
+                    "scheduler-fed recheck attributed stage by stage; "
+                    "with --faults the H2D stage is latency-throttled "
+                    "and the attributor must name it")
     sp.add_argument("--fabric", action="store_true",
                     help="also run the verify-fabric self-test: two local "
                     "worker processes plan/execute/heartbeat, one dies "
@@ -1823,6 +1882,53 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true",
                     help="emit a machine-readable JSON summary line")
     sp.set_defaults(fn=_cmd_doctor)
+
+    sp = sub.add_parser(
+        "top",
+        help="live terminal view of the pipeline ledger from a running "
+        "bridge (per-stage utilization + bottleneck verdict)",
+    )
+    sp.add_argument("--url", default="http://127.0.0.1:8421",
+                    help="bridge base URL (default %(default)s)")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds (default %(default)s)")
+    sp.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    sp.set_defaults(fn=_cmd_top)
+
+    sp = sub.add_parser(
+        "bench",
+        help="unified bench rungs (smoke/v2/fabric/flagship): banked-"
+        "schema records with the pipeline-ledger stage breakdown "
+        "embedded, plus the trajectory comparator",
+    )
+    sp.add_argument("rung", nargs="?",
+                    choices=("smoke", "v2", "fabric", "flagship"))
+    sp.add_argument("--smoke", action="store_true",
+                    help="alias for the smoke rung (the CI spelling)")
+    sp.add_argument("--mb", type=int, default=8,
+                    help="smoke rung payload MiB (default %(default)s)")
+    sp.add_argument("--piece-kb", type=int, default=256,
+                    help="smoke rung piece KiB (default %(default)s)")
+    sp.add_argument("--batch-target", type=int, default=32,
+                    help="smoke rung scheduler launch target")
+    sp.add_argument("--timeout", type=float, default=None,
+                    help="device-rung subprocess timeout seconds")
+    sp.add_argument("--out", default=None, help="also write the record here")
+    sp.add_argument("--record", default=None, metavar="FILE",
+                    help="skip the run; compare/bank this record instead")
+    sp.add_argument("--compare", action="store_true",
+                    help="gate the record against the banked trajectory "
+                    "(unarmed when no like-for-like record is banked)")
+    sp.add_argument("--trajectory", default=None, metavar="FILE",
+                    help="trajectory file (default BENCH_trajectory.json)")
+    sp.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed fractional regression (default %(default)s)")
+    sp.add_argument("--report-only", action="store_true",
+                    help="comparator reports but never fails the run")
+    sp.add_argument("--bank", action="store_true",
+                    help="append the record to the trajectory (self-banking)")
+    sp.set_defaults(fn=_cmd_bench)
 
     sp = sub.add_parser("tracker", help="run the in-memory tracker server")
     sp.add_argument("--http-port", type=int, default=8080)

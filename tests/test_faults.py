@@ -432,14 +432,19 @@ class TestRecheckFailureSemantics:
 
         async def go():
             info, storage = _build_torrent(16 * 16384, 16384, seed=11)
-            orig = storage.read_piece
+            # tear at the BACKEND seam: both read paths (per-piece
+            # read_piece bytes and the zero-copy read_batch-into-slab
+            # form) route through method.get, so the torn range fails
+            # whichever one the scheduler session picks
+            orig = storage.method.get
+            lo, hi = 5 * 16384, 6 * 16384
 
-            def torn(i):
-                if i == 5:
+            def torn(path, offset, length):
+                if offset < hi and offset + length > lo:
                     raise OSError(5, "input/output error")
-                return orig(i)
+                return orig(path, offset, length)
 
-            storage.read_piece = torn
+            storage.method.get = torn
             sched = HashPlaneScheduler(
                 SchedulerConfig(batch_target=8, flush_deadline=0.05), hasher="cpu"
             )

@@ -178,6 +178,38 @@ class TestRendererEdgeCases:
         assert 'stage="other"' in text
         assert 'torrent_tpu_pipeline_bottleneck{stage="h2d"}' in text
 
+    def test_pipeline_renderer_overlap_and_occupancy_series(self):
+        """The zero-copy ingest visibility series: per-stage max_active
+        plus the cross-stage overlap counter/gauges (read while h2d
+        while launch — the double-buffering proof) render and lint."""
+        from torrent_tpu.obs.ledger import PipelineLedger, render_pipeline_metrics
+
+        led = PipelineLedger()
+        with led.track("read", 100):
+            with led.track("h2d", 100):
+                pass
+        text = render_pipeline_metrics(led)
+        prom_lint(text)
+        assert 'torrent_tpu_pipeline_stage_max_active{stage="read"} 1' in text
+        assert "torrent_tpu_pipeline_overlap_seconds_total" in text
+        assert "torrent_tpu_pipeline_concurrent_stages 0" in text
+        assert "torrent_tpu_pipeline_concurrent_stages_max 2" in text
+
+    def test_sched_staging_series_render(self):
+        """Zero-copy slab accounting on /metrics: outstanding gauge and
+        checkout counter (leak visibility for the ingest pools)."""
+        from torrent_tpu.utils.metrics import render_sched_metrics
+
+        class _Stub:
+            def metrics_snapshot(self):
+                return {"staging": {"pools": 1, "outstanding": 2,
+                                    "checkouts": 9}}
+
+        text = render_sched_metrics(_Stub())
+        prom_lint(text)
+        assert "torrent_tpu_sched_staging_outstanding 2" in text
+        assert "torrent_tpu_sched_staging_checkouts_total 9" in text
+
     def test_full_exposition_concatenation_lints(self):
         """What the bridge actually serves: sched + fabric + obs (incl.
         the pipeline ledger) + tsan in one payload must still have

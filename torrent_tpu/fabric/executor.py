@@ -458,7 +458,7 @@ class FabricExecutor:
             self._bytes_cond.notify_all()
 
     async def _verify_unit(self, uid: int) -> None:
-        from torrent_tpu.parallel.verify import read_pieces_chunk
+        from torrent_tpu.parallel.verify import read_chunk_for_sched
         from torrent_tpu.sched import SchedLaunchError
 
         unit = self.plan.units[uid]
@@ -490,12 +490,17 @@ class FabricExecutor:
 
         for start in range(unit.start, unit.stop, chunk):
             idxs = list(range(start, min(start + chunk, unit.stop)))
-            payloads, exps, keep = await asyncio.to_thread(
-                read_pieces_chunk, storage, info, idxs
+            # zero-copy when the local scheduler's ingest pool covers
+            # this geometry (slot-carrying submission), byte chunks
+            # otherwise — same helper as the verify/bulk sessions, so
+            # fabric units ride the identical read contract
+            ck = await asyncio.to_thread(
+                read_chunk_for_sched, storage, info, idxs, self.scheduler
             )
-            if not payloads:
+            if ck.empty:
+                ck.discard()
                 continue
-            nb = sum(len(p) for p in payloads)
+            nb = ck.nbytes
             # free budget by draining the oldest outstanding launch
             # rather than blocking in _acquire_bytes: a unit bigger than
             # max_inflight_bytes would otherwise deadlock (releases only
@@ -507,18 +512,15 @@ class FabricExecutor:
                 await drain_one()
             await self._acquire_bytes(nb)
             try:
-                fut = await self.scheduler.enqueue(
-                    self.config.tenant,
-                    payloads,
-                    expected=exps,
-                    algo="sha1",
-                    piece_length=info.piece_length,
-                    wait=True,  # backpressure pauses the read loop
+                # wait=True: backpressure pauses the read loop; the
+                # chunk releases its slab hold on every path itself
+                fut = await ck.enqueue(
+                    self.scheduler, self.config.tenant, wait=True
                 )
             except BaseException:
                 await self._release_bytes(nb)
                 raise
-            futs.append((fut, keep, nb))
+            futs.append((fut, ck.keep, nb))
         while futs:
             await drain_one()
         self._verdicts.setdefault(uid, {})[self.pid] = bits

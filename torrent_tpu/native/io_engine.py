@@ -87,17 +87,26 @@ class NativeIOEngine:
         base_addr: int,
         extent: int,
         keepalive=None,
-    ) -> None:
+        statuses: np.ndarray | None = None,
+    ) -> int:
         """Segment reads into raw memory ``[base_addr, base_addr+extent)``.
 
         The strided entry point: ``Storage.read_batch`` computes absolute
         byte offsets into a row-strided staging view, so out_offsets here
         are *memory* offsets, not logical array indices. ``keepalive``
         pins the owning buffer for the duration of the call.
+
+        ``statuses``: optional caller-owned ``int32[n_segments]`` array.
+        When given, per-segment errnos land there and a failed segment
+        does NOT raise — the mark-and-continue contract the zero-copy
+        ingest path needs (a torn piece becomes an ``nblocks=0`` sentinel
+        row, not an aborted batch). Returns the engine rc (0 = every
+        segment read fully); without ``statuses`` a nonzero rc raises
+        :class:`NativeIOError` as before.
         """
         seg_arr = np.asarray(segments, dtype=np.int64)
         if seg_arr.size == 0:
-            return
+            return 0
         if seg_arr.ndim != 2 or seg_arr.shape[1] != 4:
             raise ValueError("segments must be (file_index, file_off, out_off, len) quads")
         ends = seg_arr[:, 2] + seg_arr[:, 3]
@@ -106,7 +115,14 @@ class NativeIOEngine:
         if (seg_arr[:, 0] < 0).any() or int(seg_arr[:, 0].max()) >= len(paths):
             raise ValueError("segment file index out of range")
         path_arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
-        statuses = np.zeros(seg_arr.shape[0], dtype=np.int32)
+        raise_on_error = statuses is None
+        if statuses is None:
+            statuses = np.zeros(seg_arr.shape[0], dtype=np.int32)
+        elif (
+            statuses.dtype != np.int32
+            or statuses.shape != (seg_arr.shape[0],)
+        ):
+            raise ValueError("statuses must be int32[n_segments]")
         # pipeline-ledger "read" stage: the batched pread is the storage
         # boundary of the read_batch paths (read_pieces_chunk instruments
         # the per-piece Python path; the two never overlap)
@@ -124,26 +140,53 @@ class NativeIOEngine:
                     statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
                 )
         del keepalive
-        if rc != 0:
+        if rc != 0 and raise_on_error:
             bad = np.nonzero(statuses)[0]
             first = int(bad[0]) if bad.size else -1
             raise NativeIOError(
                 f"native read failed (rc={rc}) on segment {first}: "
                 f"{seg_arr[first].tolist() if first >= 0 else '?'}"
             )
+        return int(rc)
 
 
 _engine = None
 _engine_lock = named_lock("native._engine_lock")
+_engine_threads: int | None = None
+_threads_conflict_warned = False
 
 
 def get_engine(n_threads: int | None = None):
-    """Process-global engine (or None when native IO is unavailable)."""
-    global _engine
+    """Process-global engine (or None when native IO is unavailable).
+
+    The FIRST caller's ``n_threads`` (or ``TT_IO_THREADS``, default 8)
+    sizes the pread pool for the whole process; a later caller asking
+    for a different count gets the existing engine — warned once, never
+    silently — because resizing a pool with batches in flight isn't
+    worth the churn for a tuning knob. Set ``TT_IO_THREADS`` before
+    first use to size it deterministically (documented in README).
+    """
+    global _engine, _engine_threads, _threads_conflict_warned
     with _engine_lock:
         if _engine is None and native_available():
             import os
 
             threads = n_threads or int(os.environ.get("TT_IO_THREADS", "8"))
             _engine = NativeIOEngine(threads)
+            _engine_threads = threads
+        elif (
+            _engine is not None
+            and n_threads is not None
+            and n_threads != _engine_threads
+            and not _threads_conflict_warned
+        ):
+            _threads_conflict_warned = True
+            from torrent_tpu.utils.log import get_logger
+
+            get_logger("native").warning(
+                "get_engine(n_threads=%d) ignored: the process-global pread "
+                "pool was already built with %s threads (first caller wins; "
+                "set TT_IO_THREADS before first use)",
+                n_threads, _engine_threads,
+            )
         return _engine

@@ -84,12 +84,25 @@ def attribute(snapshot: dict, prev: dict | None = None) -> dict:
                 round(s["bytes"] / s["busy_s"], 3) if s["busy_s"] > _EPS else None
             ),
         }
+    # cross-stage occupancy overlap (the double-buffering visibility
+    # series): delta the overlap seconds like any counter; the
+    # max-concurrent high-water is since-start (snapshots may predate
+    # the field — missing dicts read as zeros)
+    ov = snapshot.get("overlap") or {}
+    pov = (prev or {}).get("overlap") or {}
+    overlap_s = max(0.0, ov.get("busy_s", 0.0) - pov.get("busy_s", 0.0))
     out: dict = {
         "wall_s": round(wall, 6),
         "stages": report_stages,
         "bottleneck": None,
         "pipeline_bytes": stages.get("verdict", {}).get("bytes", 0),
         "pipeline_bps": None,
+        "overlap": {
+            "busy_s": round(overlap_s, 6),
+            "share": round(overlap_s / wall, 6) if wall > _EPS else 0.0,
+            "concurrent_stages": ov.get("concurrent_stages", 0),
+            "max_concurrent_stages": ov.get("max_concurrent_stages", 0),
+        },
     }
     if wall > _EPS and out["pipeline_bytes"]:
         out["pipeline_bps"] = round(out["pipeline_bytes"] / wall, 3)

@@ -19,18 +19,27 @@ top``, and embedded in every ``torrent-tpu bench`` record.
 Stage boundaries (instrumentation sites):
 
 * ``read``    — storage reads: ``parallel/verify.read_pieces_chunk``
-  (every scheduler-fed path incl. the fabric executor), the native
-  ``io_engine.read_into`` batch path, and the fabric sentinel re-hash.
+  (byte-path chunks + the fabric sentinel re-hash), the native
+  ``io_engine.read_into`` batch path, and the pure-Python
+  ``Storage.read_batch`` fallback walk (exactly one runs per row).
 * ``stage``   — the staging-slot copy (``sched._StagingSlots.stage``).
-* ``h2d``     — host→device transfer: the explicit device put on the
-  sha256 scan/pallas planes; ``sched/faults.py``'s ``latency_ms`` hook
-  also accounts here (it models a slow interconnect), which is what
-  makes bottleneck attribution deterministically testable on CPU.
-* ``launch``  — the device (or hashlib) hash execution. The sha1 plane's
-  ``digest_batch`` fuses its transfer into this stage until the
-  zero-copy ingest refactor splits it (noted in ARCHITECTURE.md).
+  ZERO bytes on the zero-copy ingest path: ``read_pieces_into`` lands
+  reads directly in the launch slab, so this stage only records for
+  byte-path and mixed-slab launches.
+* ``h2d``     — host→device transfer: the explicit device put on every
+  device plane (sha1 included — the zero-copy refactor split its
+  previously fused ``digest_batch`` span); ``sched/faults.py``'s
+  ``latency_ms`` hook also accounts here (it models a slow
+  interconnect), which is what makes bottleneck attribution
+  deterministically testable on CPU.
+* ``launch``  — the device (or hashlib) hash execution.
 * ``digest``  — D2H fetch + digest-word conversion.
 * ``verdict`` — the scheduler's per-launch demux back to submitters.
+
+The ledger also integrates cross-stage occupancy overlap — wall
+seconds with ≥2 distinct stages simultaneously busy and the
+max-concurrent-stages high-water mark — the series that makes
+double-buffered ingest (read while h2d while launch) visible.
 
 Design constraints, same as ``obs/hist.py``: scalar-only counters,
 bounded cardinality (the six pipeline stages plus a capped overflow of
@@ -114,6 +123,15 @@ class PipelineLedger:
         # monotonic extent of recorded activity — the attribution wall
         self._t_first: float | None = None
         self._t_last: float | None = None
+        # cross-stage overlap: how many DISTINCT stages are occupied at
+        # once. Double-buffered ingest is only proven when read, h2d and
+        # launch are simultaneously busy — per-stage max_active can't
+        # show that, so the ledger integrates it here: seconds with ≥2
+        # stages concurrently active, plus the high-water stage count.
+        self._stages_active = 0  # stages with active > 0 right now
+        self._overlap_t0: float | None = None  # when ≥2 became true
+        self._overlap_s = 0.0
+        self._max_concurrent_stages = 0
 
     # ------------------------------------------------------------ record
 
@@ -153,6 +171,12 @@ class PipelineLedger:
             s.active += 1
             if s.active > s.max_active:
                 s.max_active = s.active
+            if s.active == 1:
+                self._stages_active += 1
+                if self._stages_active > self._max_concurrent_stages:
+                    self._max_concurrent_stages = self._stages_active
+                if self._stages_active == 2:
+                    self._overlap_t0 = t0
             self._touch_locked(t0)
 
     def _exit(self, stage: str, nbytes: int, dt: float, t1: float) -> None:
@@ -162,6 +186,11 @@ class PipelineLedger:
             s.busy_s += max(0.0, dt)
             s.bytes += nbytes
             s.ops += 1
+            if s.active == 0:
+                self._stages_active -= 1
+                if self._stages_active == 1 and self._overlap_t0 is not None:
+                    self._overlap_s += max(0.0, t1 - self._overlap_t0)
+                    self._overlap_t0 = None
             self._touch_locked(t1)
 
     # ---------------------------------------------------------- snapshot
@@ -175,10 +204,19 @@ class PipelineLedger:
         previous run's tail, setup work) never dilutes the next
         interval's utilization."""
         with self._lock:
+            now = time.monotonic()
+            overlap_s = self._overlap_s
+            if self._overlap_t0 is not None:  # an overlap window is open
+                overlap_s += max(0.0, now - self._overlap_t0)
             return {
                 "t_first": self._t_first,
                 "t_last": self._t_last,
-                "t_snap": time.monotonic(),
+                "t_snap": now,
+                "overlap": {
+                    "busy_s": overlap_s,
+                    "concurrent_stages": self._stages_active,
+                    "max_concurrent_stages": self._max_concurrent_stages,
+                },
                 "stages": {
                     name: {
                         "busy_s": s.busy_s,
@@ -196,6 +234,10 @@ class PipelineLedger:
             self._stages.clear()
             self._t_first = None
             self._t_last = None
+            self._stages_active = 0
+            self._overlap_t0 = None
+            self._overlap_s = 0.0
+            self._max_concurrent_stages = 0
 
 
 def _stage_order(names) -> list[str]:
@@ -251,6 +293,15 @@ def render_pipeline_metrics(ledger: PipelineLedger | None = None) -> str:
             f"{snap['stages'][name]['active']}"
         )
     lines.append(
+        "# HELP torrent_tpu_pipeline_stage_max_active High-water concurrent entries observed inside this stage"
+    )
+    lines.append("# TYPE torrent_tpu_pipeline_stage_max_active gauge")
+    for name in stages:
+        lines.append(
+            f'torrent_tpu_pipeline_stage_max_active{{stage="{_esc(name)}"}} '
+            f"{snap['stages'][name]['max_active']}"
+        )
+    lines.append(
         "# HELP torrent_tpu_pipeline_stage_utilization Stage busy-seconds per pipeline wall second "
         "(can exceed 1 with overlapped launches)"
     )
@@ -273,7 +324,20 @@ def render_pipeline_metrics(ledger: PipelineLedger | None = None) -> str:
             f'torrent_tpu_pipeline_bottleneck{{stage="{_esc(name)}"}} '
             f"{1 if name == bn else 0}"
         )
+    # cross-stage occupancy overlap: the double-buffering proof series
+    # (read while h2d while launch shows up as overlap seconds plus a
+    # max-concurrent-stages high-water mark)
+    ov = snap.get("overlap") or {}
     lines += [
+        "# HELP torrent_tpu_pipeline_overlap_seconds_total Seconds with two or more pipeline stages concurrently occupied",
+        "# TYPE torrent_tpu_pipeline_overlap_seconds_total counter",
+        f"torrent_tpu_pipeline_overlap_seconds_total {ov.get('busy_s', 0.0):.6f}",
+        "# HELP torrent_tpu_pipeline_concurrent_stages Distinct pipeline stages currently occupied",
+        "# TYPE torrent_tpu_pipeline_concurrent_stages gauge",
+        f"torrent_tpu_pipeline_concurrent_stages {ov.get('concurrent_stages', 0)}",
+        "# HELP torrent_tpu_pipeline_concurrent_stages_max High-water distinct pipeline stages concurrently occupied",
+        "# TYPE torrent_tpu_pipeline_concurrent_stages_max gauge",
+        f"torrent_tpu_pipeline_concurrent_stages_max {ov.get('max_concurrent_stages', 0)}",
         "# HELP torrent_tpu_pipeline_wall_seconds Monotonic extent of recorded pipeline activity",
         "# TYPE torrent_tpu_pipeline_wall_seconds gauge",
         f"torrent_tpu_pipeline_wall_seconds {rep.get('wall_s', 0.0):.6f}",

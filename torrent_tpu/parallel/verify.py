@@ -267,6 +267,131 @@ def read_pieces_chunk(storage: Storage, info: InfoDict, idxs):
     return payloads, exps, keep
 
 
+def read_pieces_into(storage: Storage, info: InfoDict, idxs, scheduler):
+    """Zero-copy sibling of :func:`read_pieces_chunk`.
+
+    Checks a staging slab out of the scheduler's ingest pool
+    (``sched._StagingSlots`` via ``checkout_staging``) FIRST, then has
+    ``Storage.read_batch`` — the native ``io_engine.read_into`` pread
+    pool when available, the pure-Python backend walk otherwise — land
+    the reads directly in the slab's row-strided view and pads the rows
+    in place. No intermediate per-piece ``bytes``, no ``np.frombuffer``
+    row copy, no ``_StagingSlots.stage`` pass later: the slab IS the
+    launch buffer.
+
+    Mark-and-continue semantics are preserved: a torn/short/unreadable
+    piece becomes an ``nblocks=0`` sentinel row, is dropped from the
+    returned ticket rows, and stays False in the caller's bitfield —
+    the same contract as ``read_pieces_chunk`` (differential-tested in
+    tests/test_ingest.py, native engine present and absent).
+
+    Returns ``(slab, rows, expected, keep)`` — the caller holds one
+    slab reference and must ``slab.release()`` after hand-off (or on
+    abort) — or ``None`` when this scheduler/geometry can't take
+    pre-staged submissions (callers fall back to the byte path). Any
+    read-path failure checks the slab back in before returning, so a
+    mid-batch ``NativeIOError`` can never leak a slot.
+    """
+    checkout = getattr(scheduler, "checkout_staging", None)
+    if checkout is None:
+        return None
+    idxs = list(idxs)
+    slab = checkout(info.piece_length, len(idxs), algo="sha1")
+    if slab is None:
+        return None
+    try:
+        n = len(idxs)
+        slab.prepare([piece_length(info, i) for i in idxs])
+        ok = np.zeros(n, dtype=bool)
+        storage.read_batch(
+            idxs,
+            out=slab.padded[:n, : info.piece_length],
+            row_status=ok,
+            zero_fill=False,
+        )
+        slab.finalize(ok)
+    except Exception:
+        # whatever broke (engine fault, backend bug): return the slot —
+        # callers retry through the byte path, which re-reads cleanly
+        slab.release()
+        return None
+    rows = [i for i in range(n) if ok[i]]
+    expected = [info.pieces[idxs[i]] for i in rows]
+    keep = [idxs[i] for i in rows]
+    return slab, rows, expected, keep
+
+
+class _SchedChunk:
+    """One read chunk ready for scheduler submission — staged (slab)
+    or byte form, behind one enqueue/discard surface so every
+    scheduler-fed read loop (torrent rechecks, library sweeps, the
+    fabric executor) shares the zero-copy-with-fallback contract."""
+
+    __slots__ = ("slab", "rows", "payloads", "expected", "keep", "piece_length")
+
+    def __init__(self, slab, rows, payloads, expected, keep, piece_length):
+        self.slab = slab
+        self.rows = rows
+        self.payloads = payloads
+        self.expected = expected
+        self.keep = keep
+        self.piece_length = piece_length
+
+    @property
+    def empty(self) -> bool:
+        return not self.keep
+
+    @property
+    def nbytes(self) -> int:
+        if self.slab is not None:
+            return int(self.slab.lengths[list(self.rows)].sum())
+        return sum(len(p) for p in self.payloads)
+
+    async def enqueue(self, scheduler, tenant: str, wait: bool = True):
+        """Submit and hand ownership over: the creator's slab reference
+        is released on EVERY path (tickets keep the slab alive through
+        demux; a shed releases everything)."""
+        if self.slab is not None:
+            slab, self.slab = self.slab, None
+            try:
+                return await scheduler.enqueue_staged(
+                    tenant, slab, self.rows, expected=self.expected, wait=wait
+                )
+            finally:
+                slab.release()
+        return await scheduler.enqueue(
+            tenant,
+            self.payloads,
+            expected=self.expected,
+            algo="sha1",
+            piece_length=self.piece_length,
+            wait=wait,
+        )
+
+    def discard(self) -> None:
+        """Abandon without submitting (empty chunk, caller abort)."""
+        if self.slab is not None:
+            self.slab.release()
+            self.slab = None
+
+
+def read_chunk_for_sched(
+    storage: Storage, info: InfoDict, idxs, scheduler
+) -> _SchedChunk:
+    """Read one chunk for scheduler submission, zero-copy when the
+    scheduler's ingest pool can take it, ``read_pieces_chunk`` bytes
+    otherwise. Runs in a worker thread (both read paths block)."""
+    staged = read_pieces_into(storage, info, idxs, scheduler)
+    if staged is not None:
+        slab, rows, expected, keep = staged
+        if not keep:  # nothing readable: give the slot straight back
+            slab.release()
+            return _SchedChunk(None, None, [], [], [], info.piece_length)
+        return _SchedChunk(slab, rows, None, expected, keep, info.piece_length)
+    payloads, exps, keep = read_pieces_chunk(storage, info, idxs)
+    return _SchedChunk(None, None, payloads, exps, keep, info.piece_length)
+
+
 async def enqueue_torrent_sched(
     storage: Storage,
     info: InfoDict,
@@ -284,6 +409,12 @@ async def enqueue_torrent_sched(
     pauses the disk read loop instead of buffering without bound. Shared
     by ``verify_pieces_sched`` and ``verify_library_sched`` so the read /
     filter / keep-demux contract lives in one place.
+
+    Chunks go zero-copy whenever the scheduler's ingest pool covers the
+    geometry (:func:`read_pieces_into` → ``enqueue_staged``): reads for
+    chunk *k+1* land in a second slab while chunk *k*'s H2D/launch runs
+    — the read→h2d→launch overlap the pipeline ledger's occupancy
+    series makes visible.
     """
     import asyncio
 
@@ -292,20 +423,14 @@ async def enqueue_torrent_sched(
     futs: list[tuple] = []
     for start in range(0, info.num_pieces, chunk):
         idxs = list(range(start, min(start + chunk, info.num_pieces)))
-        payloads, exps, keep = await asyncio.to_thread(
-            read_pieces_chunk, storage, info, idxs
+        ck = await asyncio.to_thread(
+            read_chunk_for_sched, storage, info, idxs, scheduler
         )
-        if not payloads:
+        if ck.empty:
+            ck.discard()
             continue
-        fut = await scheduler.enqueue(
-            tenant,
-            payloads,
-            expected=exps,
-            algo="sha1",
-            piece_length=info.piece_length,
-            wait=True,
-        )
-        futs.append((fut, keep))
+        fut = await ck.enqueue(scheduler, tenant, wait=True)
+        futs.append((fut, ck.keep))
     return futs
 
 

@@ -182,7 +182,11 @@ class FaultyPlane:
             return n_rows, 0
         return hook(n_rows, bucket)
 
-    def run(self, payloads: list[bytes]) -> list[bytes]:
+    def _apply_faults(self, payloads) -> None:
+        """Count the launch and raise per the plan. ``payloads`` may be
+        bytes or the scheduler's zero-copy ``SlotRow`` views — both
+        support ``len`` and the ``startswith`` prefix probe, so fault
+        semantics are identical for byte and slot-carrying submissions."""
         plan = self.plan
         with self._lock:
             self.launches += 1
@@ -211,4 +215,20 @@ class FaultyPlane:
             or (plan.dead_after is not None and n > plan.dead_after)
         ):
             raise DeviceFaultError(f"injected device fault (launch {n})")
+
+    def run(self, payloads: list[bytes]) -> list[bytes]:
+        self._apply_faults(payloads)
         return self.inner.run(payloads)
+
+    def run_staged(self, slab, rows: list[int]) -> list[bytes]:
+        """Zero-copy launch form: same fault plan, applied to the slab's
+        ticket rows, then delegated to the wrapped plane's staged path
+        (or its copy path when it has none)."""
+        from torrent_tpu.sched.scheduler import SlotRow
+
+        slot_rows = [SlotRow(slab, r) for r in rows]
+        self._apply_faults(slot_rows)
+        inner_staged = getattr(self.inner, "run_staged", None)
+        if inner_staged is not None:
+            return inner_staged(slab, rows)
+        return self.inner.run(slot_rows)

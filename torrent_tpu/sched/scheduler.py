@@ -72,6 +72,19 @@ Both layers are driven deterministically in tests by
 ``torrent_tpu.sched.faults`` (a :class:`FaultPlan` wired through the
 ``plane_factory`` seam), so every behavior above has a CPU-only test.
 
+Zero-copy ingest. Scheduler-fed read loops check a :class:`StagedSlab`
+out of the per-(algo, bucket) ingest pools (:meth:`checkout_staging`),
+land disk reads directly in its row-strided view, and submit it with
+:meth:`enqueue_staged`: tickets carry :class:`SlotRow` views (no
+per-piece ``bytes``), single-slab launches hit the planes'
+``run_staged`` form (the slab IS the launch buffer — the ledger's
+``stage`` copy stage records zero bytes), and device planes H2D the
+slab outside ``_device_lock`` with donated input buffers so batch
+N+1's transfer overlaps batch N's kernel. Slabs are reference counted
+(one ref per ticket, released at demux on every path) and the pools'
+``outstanding`` gauge must return to 0 — see ARCHITECTURE.md
+"Zero-copy ingest" for ownership rules and the fallback matrix.
+
 The v2 (sha256) lanes default to the hand-tiled pallas kernel
 (:class:`_Sha256PallasPlane`; ``TORRENT_TPU_SHA256_BACKEND`` /
 ``SchedulerConfig.sha256_backend`` select, lax.scan is the fallback).
@@ -504,6 +517,28 @@ class _StagingSlots:
         self.piece_len = piece_len
         self._slots: list[tuple] = []  # (padded, view, ends) free list
         self._lock = named_lock("sched.staging._lock")
+        # leak accounting: every checkout must be balanced by a checkin
+        # (asserted by tests and exported via metrics_snapshot)
+        self.outstanding = 0
+        self.checkouts = 0
+
+    def checkout(self) -> tuple:
+        """Raw ``(padded, view, ends)`` slot checkout — the zero-copy
+        ingest path fills the slot itself (disk reads land directly in
+        ``view``); ``stage`` uses the same checkout for its copy path.
+        The caller MUST ``checkin(slot)`` exactly once."""
+        import numpy as np
+
+        from torrent_tpu.ops.padding import alloc_padded
+
+        with self._lock:
+            slot = self._slots.pop() if self._slots else None
+            self.outstanding += 1
+            self.checkouts += 1
+        if slot is None:
+            padded, view = alloc_padded(self.rows, self.piece_len)
+            slot = (padded, view, np.zeros(self.rows, dtype=np.int64))
+        return slot
 
     def stage(self, chunk: list[bytes], rows: int | None = None):
         """Checkout a slot and stage ``chunk`` into its first ``rows``
@@ -528,11 +563,7 @@ class _StagingSlots:
         with pipeline_ledger().track(
             "stage", sum(len(c) for c in chunk)
         ):
-            with self._lock:
-                slot = self._slots.pop() if self._slots else None
-            if slot is None:
-                padded, view = alloc_padded(self.rows, self.piece_len)
-                slot = (padded, view, np.zeros(self.rows, dtype=np.int64))
+            slot = self.checkout()
             padded, view, ends = slot
             try:
                 lengths = np.zeros(rows, dtype=np.int64)
@@ -542,7 +573,7 @@ class _StagingSlots:
                     if stale > n:
                         padded[i, n:stale] = 0
                     if n:
-                        view[i, :n] = np.frombuffer(chunk[i], dtype=np.uint8)
+                        view[i, :n] = _payload_ndarray(chunk[i])
                         lengths[i] = n
                 nblocks = pad_in_place(padded[:rows], lengths)
                 # content extent (message + padding) per row, for the next
@@ -561,6 +592,183 @@ class _StagingSlots:
     def checkin(self, slot) -> None:
         with self._lock:
             self._slots.append(slot)
+            self.outstanding -= 1
+
+
+def _payload_ndarray(p):
+    """uint8 ndarray view of a ticket payload — SlotRow rows come back
+    as views into their slab (no copy), bytes-likes via frombuffer."""
+    import numpy as np
+
+    if type(p) is SlotRow:
+        return p.ndview()
+    return np.frombuffer(p, dtype=np.uint8)
+
+
+class SlotRow:
+    """One staged row of a :class:`StagedSlab`, used as a ticket payload.
+
+    Quacks enough like ``bytes`` for the scheduler's bookkeeping
+    (``len``, ``startswith`` for the fault plane's poisoned-prefix
+    probe) while never materializing a bytes object: CPU hashing and
+    mixed-batch staging consume the numpy row view directly.
+    """
+
+    __slots__ = ("slab", "row")
+
+    def __init__(self, slab: "StagedSlab", row: int):
+        self.slab = slab
+        self.row = row
+
+    def __len__(self) -> int:
+        return int(self.slab.lengths[self.row])
+
+    def ndview(self):
+        """uint8[len] view into the slab row (zero-copy)."""
+        return self.slab.view[self.row, : len(self)]
+
+    def startswith(self, prefix) -> bool:
+        n = len(prefix)
+        if n > len(self):
+            return False
+        return bytes(self.slab.view[self.row, :n]) == bytes(prefix)
+
+    def tobytes(self) -> bytes:
+        return self.ndview().tobytes()
+
+
+class StagedSlab:
+    """A checked-out staging slot pre-filled by the zero-copy read path.
+
+    Owns one ``(padded, view, ends)`` slot of a scheduler ingest pool
+    plus the per-row ``lengths``/``nblocks`` the read path derived —
+    disk reads land directly in ``view``'s row-strided memory, rows
+    that failed to read carry ``nblocks=0`` sentinels, and the whole
+    slab is handed to :meth:`HashPlaneScheduler.enqueue_staged` without
+    ever materializing per-piece ``bytes``.
+
+    Lifecycle is reference counted: the creator (the reader) holds one
+    reference from checkout; ``enqueue_staged`` retains one per ticket
+    and the scheduler's demux releases them as verdicts resolve. The
+    slot returns to its pool exactly when the count hits zero — on
+    every path (success, launch failure, shed, reader abort), which is
+    what the leak-counter test asserts.
+    """
+
+    __slots__ = (
+        "pool", "slot", "padded", "view", "ends", "nblocks", "lengths",
+        "algo", "bucket", "piece_length", "n_used", "_refs", "_lock",
+    )
+
+    def __init__(self, pool: _StagingSlots, slot: tuple, algo: str,
+                 bucket: int, piece_length: int):
+        import numpy as np
+
+        self.pool = pool
+        self.slot = slot
+        self.padded, self.view, self.ends = slot
+        self.nblocks = np.zeros(pool.rows, dtype=np.int32)
+        self.lengths = np.zeros(pool.rows, dtype=np.int64)
+        self.algo = algo
+        self.bucket = bucket
+        self.piece_length = piece_length
+        self.n_used = 0
+        self._refs = 1  # the creator's hold
+        self._lock = named_lock("sched.slab._lock")
+
+    @property
+    def rows_total(self) -> int:
+        return self.pool.rows
+
+    def prepare(self, planned_lengths) -> None:
+        """Zero each row's stale tail beyond its incoming content extent
+        (the reads themselves overwrite ``[0, length)``), so a reused
+        slot needs no full-width memset before ``pad_in_place``."""
+        import numpy as np
+
+        n = len(planned_lengths)
+        self.n_used = n
+        self.lengths[:n] = np.asarray(planned_lengths, dtype=np.int64)
+        self.lengths[n:] = 0
+        for i in range(n):
+            stale = int(self.ends[i])
+            ln = int(self.lengths[i])
+            if stale > ln:
+                self.padded[i, ln:stale] = 0
+
+    def finalize(self, ok) -> None:
+        """Pad the first ``n_used`` rows in place and sentinel the failed
+        ones (``ok[i] is False`` → ``nblocks=0``; mark-and-continue)."""
+        import numpy as np
+
+        from torrent_tpu.ops.padding import pad_in_place
+
+        n = self.n_used
+        nb = pad_in_place(self.padded[:n], self.lengths[:n])
+        # dirty extent per row for the NEXT reuse's tail zeroing: padding
+        # extent for hashed rows, the attempted read extent for failed
+        # ones (their partial bytes are garbage the sentinel masks)
+        self.ends[:n] = np.maximum(nb.astype(np.int64) * 64, self.lengths[:n])
+        nb[~np.asarray(ok, dtype=bool)] = 0
+        self.nblocks[:n] = nb
+        self.nblocks[n:] = 0
+
+    def row(self, i: int):
+        return self.view[i, : int(self.lengths[i])]
+
+    def retain(self, n: int = 1) -> None:
+        with self._lock:
+            self._refs += n
+
+    def release(self, n: int = 1) -> None:
+        with self._lock:
+            self._refs -= n
+            done = self._refs == 0
+        if done:
+            self.pool.checkin(self.slot)
+
+
+def _staged_batch(payloads):
+    """``(slab, rows)`` when every payload is a SlotRow of ONE slab —
+    the zero-copy launch form (the plane reads the pre-staged buffer
+    directly); ``None`` for mixed batches, which take the copying
+    ``plane.run`` path."""
+    first = payloads[0] if payloads else None
+    if type(first) is not SlotRow:
+        return None
+    slab = first.slab
+    rows = []
+    for p in payloads:
+        if type(p) is not SlotRow or p.slab is not slab:
+            return None
+        rows.append(p.row)
+    return slab, rows
+
+
+def _masked_nblocks(slab: StagedSlab, rows: list[int]):
+    """Full-slab nblocks with every row OUTSIDE ``rows`` sentineled —
+    launches always present the slab's static shape to the compiled
+    plane (one executable per lane regardless of fill or bisection
+    half) and the masked rows' chains never run."""
+    import numpy as np
+
+    nb = np.zeros(slab.rows_total, dtype=np.int32)
+    idx = np.asarray(rows, dtype=np.int64)
+    nb[idx] = slab.nblocks[idx]
+    return nb
+
+
+def _donating_wrapper(fn):
+    """Jit-wrap ``fn(data, nblocks)`` donating the data buffer on real
+    accelerators (H2D of batch N+1 then overlaps the kernel of batch N
+    without doubling device-resident input memory). On the CPU backend
+    donation is refused by XLA and would only warn, so the fn is
+    returned unwrapped."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return fn
+    return jax.jit(fn, donate_argnums=(0,))
 
 
 class _CpuPlane:
@@ -577,7 +785,17 @@ class _CpuPlane:
     def run(self, payloads: list[bytes]) -> list[bytes]:
         h = self._h
         with pipeline_ledger().track("launch", sum(len(p) for p in payloads)):
-            return [h(p).digest() for p in payloads]
+            # SlotRow payloads hash their numpy row views directly —
+            # hashlib takes any contiguous buffer, no bytes materialized
+            return [h(_payload_ndarray(p) if type(p) is SlotRow else p).digest()
+                    for p in payloads]
+
+    def run_staged(self, slab: StagedSlab, rows: list[int]) -> list[bytes]:
+        """Zero-copy form: hash the pre-staged rows in place."""
+        h = self._h
+        nb = int(slab.lengths[list(rows)].sum())
+        with pipeline_ledger().track("launch", nb):
+            return [h(slab.row(r)).digest() for r in rows]
 
 
 class _Sha1DevicePlane:
@@ -610,6 +828,33 @@ class _Sha1DevicePlane:
 
         return n_rows, n_rows * padded_len_for(bucket)
 
+    def _launch_padded(self, padded, nblocks, nb: int):
+        """One device launch with the real stage split: explicit upload
+        (h2d, outside the device lock so batch N+1's transfer overlaps
+        batch N's kernel), jitted dispatch under the lock (async — with
+        a donated input buffer on real devices), blocking fetch (digest)
+        back outside it. Falls back to the fused ``digest_batch`` when
+        the flat upload path can't take this shape (multi-process mesh,
+        odd geometry)."""
+        import numpy as np
+
+        led = pipeline_ledger()
+        v = self._verifier
+        if not v.upload_supported(padded):
+            # fused fallback (multi-process mesh, odd geometry): the
+            # transfer runs inside digest_batch, so the bytes stay
+            # under `launch` — never charged to a zero-length h2d span
+            with self._device_lock:
+                with led.track("launch", nb):
+                    return v.digest_batch(padded, nblocks)
+        with led.track("h2d", nb):
+            handle = v.upload_batch(padded)
+        with self._device_lock:
+            with led.track("launch", nb):
+                words_dev = v.digest_uploaded(handle, nblocks)
+        with led.track("digest", nb):
+            return np.asarray(words_dev)
+
     def run(self, payloads: list[bytes]) -> list[bytes]:
         from torrent_tpu.ops.padding import words_to_digests
 
@@ -625,18 +870,36 @@ class _Sha1DevicePlane:
             nb = sum(len(p) for p in chunk)
             slot, padded, nblocks = self._slots.stage(chunk)
             try:
-                # ledger note: digest_batch fuses its device put into the
-                # dispatch, so this plane's h2d shows under "launch" until
-                # the zero-copy ingest refactor splits it (the sha256
-                # planes already report h2d explicitly)
-                with self._device_lock:
-                    with pipeline_ledger().track("launch", nb):
-                        words = v.digest_batch(padded, nblocks)
-                with pipeline_ledger().track("digest", nb):
-                    out.extend(words_to_digests(words[: len(chunk)]))
+                words = self._launch_padded(padded, nblocks, nb)
+                out.extend(words_to_digests(words[: len(chunk)]))
             finally:
                 self._slots.checkin(slot)
         return out
+
+    def run_staged(self, slab: StagedSlab, rows: list[int]) -> list[bytes]:
+        """Zero-copy launch: the pre-staged slab IS the launch buffer —
+        no ``_StagingSlots.stage`` copy, rows outside the ticket set are
+        masked to ``nblocks=0`` so one static shape serves every fill
+        level and bisection half."""
+        from torrent_tpu.ops.padding import words_to_digests
+
+        v = self._verifier
+        if (
+            slab.padded.shape[1] != v.padded_len
+            or slab.rows_total > v.batch_size
+            or slab.rows_total % max(1, v.mesh.size)
+        ):
+            # row width / mesh-divisibility mismatch: copy path. A row
+            # count merely SMALLER than the verifier's (tile/mesh)
+            # rounded batch is fine — upload_batch's sharded form takes
+            # any mesh-divisible shape, so zero-copy launches survive
+            # the batch rounding real accelerators apply.
+            return self.run([SlotRow(slab, r) for r in rows])
+        nb = int(slab.lengths[list(rows)].sum())
+        words = self._launch_padded(
+            slab.padded, _masked_nblocks(slab, rows), nb
+        )
+        return words_to_digests(words[rows])
 
 
 class _Sha256DevicePlane:
@@ -648,6 +911,10 @@ class _Sha256DevicePlane:
         from torrent_tpu.ops.sha256_jax import make_sha256_fn
 
         self._fn = make_sha256_fn("jax")
+        # donated variant for the launch: frees the device input buffer
+        # as the kernel consumes it, so the next batch's H2D can reuse
+        # that memory while this kernel runs (identity on CPU)
+        self._fn_launch = _donating_wrapper(self._fn)
         self._bucket = bucket
         self._batch = batch
         self._slots = _StagingSlots(batch, bucket)
@@ -677,24 +944,44 @@ class _Sha256DevicePlane:
             nb = sum(len(p) for p in chunk)
             slot, padded, nblocks = self._slots.stage(chunk)
             try:
+                # ledger stage boundaries: the explicit device put (h2d,
+                # outside the device lock so transfers overlap kernels),
+                # the jitted dispatch (launch — async, donated input),
+                # D2H fetch (digest). Bytes are payload bytes throughout
+                # so cross-stage rates compare (the physical transfer
+                # moves the padded footprint).
+                with led.track("h2d", nb):
+                    dev_p = jnp.asarray(padded)
+                    dev_n = jnp.asarray(nblocks)
                 with self._device_lock:
-                    # ledger stage boundaries: the explicit device put
-                    # (h2d), the jitted dispatch (launch — async, so the
-                    # blocking D2H fetch absorbs device time), D2H fetch
-                    # (digest). Bytes are payload bytes throughout so
-                    # cross-stage rates compare (the physical transfer
-                    # moves the padded footprint).
-                    with led.track("h2d", nb):
-                        dev_p = jnp.asarray(padded)
-                        dev_n = jnp.asarray(nblocks)
                     with led.track("launch", nb):
-                        words_dev = self._fn(dev_p, dev_n)
-                    with led.track("digest", nb):
-                        words = np.asarray(words_dev)
+                        words_dev = self._fn_launch(dev_p, dev_n)
+                with led.track("digest", nb):
+                    words = np.asarray(words_dev)
                 out.extend(words32_to_digests(words[: len(chunk)]))
             finally:
                 self._slots.checkin(slot)
         return out
+
+    def run_staged(self, slab: StagedSlab, rows: list[int]) -> list[bytes]:
+        """Zero-copy launch from a pre-staged slab (no ``stage`` copy;
+        non-ticket rows masked to sentinels, static full-slab shape)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from torrent_tpu.models.merkle import words32_to_digests
+
+        led = pipeline_ledger()
+        nb = int(slab.lengths[list(rows)].sum())
+        with led.track("h2d", nb):
+            dev_p = jnp.asarray(slab.padded)
+            dev_n = jnp.asarray(_masked_nblocks(slab, rows))
+        with self._device_lock:
+            with led.track("launch", nb):
+                words_dev = self._fn_launch(dev_p, dev_n)
+        with led.track("digest", nb):
+            words = np.asarray(words_dev)
+        return words32_to_digests(words[rows])
 
 
 class _Sha256PallasPlane:
@@ -739,6 +1026,9 @@ class _Sha256PallasPlane:
         self._interpret = interpret
         self._slots = _StagingSlots(self._batch, bucket)
         self._plans: dict[int, tuple[int, int, bool]] = {}  # n -> (rows, ts, il2)
+        # donated launch callables per (tile_sub, interleave2) — built on
+        # first use from the worker thread (jax backend probe included)
+        self._launch_fns: dict[tuple[int, bool], Callable] = {}
         self._device_lock = named_lock("sched.sha256_pallas_plane._device_lock")
 
     @staticmethod
@@ -761,6 +1051,22 @@ class _Sha256PallasPlane:
             plan = self._plans[n] = (rows, ts, il2)
         return plan
 
+    def _launch_fn(self, ts: int, il2: bool):
+        """Kernel callable for a tiling, input-donated off-CPU (the
+        double-buffer memory contract; see :func:`_donating_wrapper`)."""
+        fn = self._launch_fns.get((ts, il2))
+        if fn is None:
+            sp, interp = self._sp, self._interpret
+
+            def base(data32, nblocks, _ts=ts, _il2=il2):
+                return sp.sha256_pieces_pallas(
+                    data32, nblocks, interpret=interp, tile_sub=_ts,
+                    interleave2=_il2,
+                )
+
+            fn = self._launch_fns[(ts, il2)] = _donating_wrapper(base)
+        return fn
+
     def run(self, payloads: list[bytes]) -> list[bytes]:
         import jax.numpy as jnp
         import numpy as np
@@ -782,27 +1088,50 @@ class _Sha256PallasPlane:
                 # kernel's u32 fast path (rows are 128-byte aligned so
                 # the view is free and the slab contiguous)
                 data32 = padded[:rows].view(np.uint32)
+                # same ledger boundaries as the scan plane: explicit put
+                # = h2d (outside the device lock so transfers overlap
+                # kernels), jitted dispatch = launch (async, donated
+                # input), fetch = digest
+                with led.track("h2d", nb):
+                    dev_d = jnp.asarray(data32)
+                    dev_n = jnp.asarray(nblocks)
                 with self._device_lock:
-                    # same ledger boundaries as the scan plane: explicit
-                    # put = h2d, jitted dispatch = launch (async — the
-                    # blocking fetch absorbs device time), fetch = digest
-                    with led.track("h2d", nb):
-                        dev_d = jnp.asarray(data32)
-                        dev_n = jnp.asarray(nblocks)
                     with led.track("launch", nb):
-                        words_dev = self._sp.sha256_pieces_pallas(
-                            dev_d,
-                            dev_n,
-                            interpret=self._interpret,
-                            tile_sub=ts,
-                            interleave2=il2,
-                        )
-                    with led.track("digest", nb):
-                        words = np.asarray(words_dev)
+                        words_dev = self._launch_fn(ts, il2)(dev_d, dev_n)
+                with led.track("digest", nb):
+                    words = np.asarray(words_dev)
                 out.extend(words32_to_digests(words[: len(chunk)]))
             finally:
                 self._slots.checkin(slot)
         return out
+
+    def run_staged(self, slab: StagedSlab, rows: list[int]) -> list[bytes]:
+        """Zero-copy launch from a pre-staged slab: tile-bucket the full
+        slab row count, mask non-ticket rows to sentinels, feed the u32
+        view of the slab directly — no ``stage`` copy."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from torrent_tpu.models.merkle import words32_to_digests
+
+        led = pipeline_ledger()
+        launch_rows, ts, il2 = self._plan(slab.rows_total)
+        if launch_rows > slab.rows_total or any(r >= launch_rows for r in rows):
+            # pool slab smaller than the tile granule (or bigger than
+            # the plane's max launch): copy path
+            return self.run([SlotRow(slab, r) for r in rows])
+        nb = int(slab.lengths[list(rows)].sum())
+        nblocks = _masked_nblocks(slab, rows)[:launch_rows]
+        data32 = slab.padded[:launch_rows].view(np.uint32)
+        with led.track("h2d", nb):
+            dev_d = jnp.asarray(data32)
+            dev_n = jnp.asarray(nblocks)
+        with self._device_lock:
+            with led.track("launch", nb):
+                words_dev = self._launch_fn(ts, il2)(dev_d, dev_n)
+        with led.track("digest", nb):
+            words = np.asarray(words_dev)
+        return words32_to_digests(words[rows])
 
 
 # ------------------------------------------------------------ scheduler
@@ -838,6 +1167,12 @@ class HashPlaneScheduler:
         # rollup of evicted auto-registered tenants so served/shed totals
         # stay monotonic after their per-tenant series disappear
         self._evicted = {"tenants": 0, "served_bytes": 0, "served_pieces": 0, "shed": 0}
+        # zero-copy ingest: reader-side staging pools per (algo, bucket)
+        # — disk reads land directly in these slots and slot-carrying
+        # submissions hand them to the planes without a stage copy.
+        # Checked out from worker threads (read paths run off-loop).
+        self._ingest_pools: dict[tuple[str, int], _StagingSlots] = {}
+        self._ingest_lock = named_lock("sched._ingest_lock")
         # resolved-once sha256 backend ('pallas'/'scan'); auto-resolution
         # touches jax.devices(), which must stay off the event loop
         self._sha256_backend_resolved: str | None = None
@@ -954,6 +1289,40 @@ class HashPlaneScheduler:
                 return "pallas", target
             backend = "scan"  # tile floor would blow the staging budget
         return backend, base
+
+    def checkout_staging(
+        self, piece_length: int, n_rows: int, algo: str = "sha1"
+    ) -> StagedSlab | None:
+        """Check a staging slab out for the zero-copy ingest path.
+
+        The read path (``parallel/verify.read_pieces_into``) fills the
+        slab's row-strided view directly from disk, pads it in place,
+        and submits it via :meth:`enqueue_staged` — no per-piece
+        ``bytes``, no ``_StagingSlots.stage`` copy. Returns ``None``
+        when this geometry can't take pre-staged submissions (chunk
+        bigger than the lane's slab, scheduler closing) — callers then
+        fall back to the ``read_pieces_chunk`` byte path. Safe to call
+        from worker threads (read loops run off the event loop).
+
+        The caller owns one reference; every path must end in
+        ``slab.release()`` (directly, or via ``enqueue_staged``'s
+        per-ticket refs resolving through demux).
+        """
+        if self._closing or algo not in DIGEST_LEN:
+            return None
+        bucket = self.bucket_for(piece_length)
+        key = (algo, bucket)
+        with self._ingest_lock:
+            pool = self._ingest_pools.get(key)
+        if pool is None:
+            _, target = self._lane_plan(algo, bucket)
+            with self._ingest_lock:
+                pool = self._ingest_pools.setdefault(
+                    key, _StagingSlots(target, bucket)
+                )
+        if n_rows > pool.rows:
+            return None
+        return StagedSlab(pool, pool.checkout(), algo, bucket, piece_length)
 
     def chunk_for(self, piece_length: int, algo: str = "sha1") -> int:
         """Effective batch target for this geometry — the lane flush
@@ -1109,6 +1478,42 @@ class HashPlaneScheduler:
             # enqueue span — carried by the submission, not contextvars
             sub.trace = (ctx[0], enq_id)
         return sub.future
+
+    async def enqueue_staged(
+        self,
+        tenant: str,
+        slab: StagedSlab,
+        rows: list[int],
+        expected: list[bytes] | None = None,
+        wait: bool = False,
+    ) -> asyncio.Future:
+        """Slot-carrying submission: queue the pre-staged ``rows`` of a
+        :class:`StagedSlab` (from :meth:`checkout_staging`).
+
+        Tickets carry :class:`SlotRow` payloads — zero-copy views into
+        the slab — and each holds one slab reference that the demux
+        releases on verdict or failure, so the slot returns to its pool
+        exactly when the last co-batched ticket resolves. Admission
+        charging, DRR fairness, shed, retry/bisection and the breaker's
+        CPU fallback all behave exactly as for byte submissions (the
+        CPU plane hashes the slab rows in place). On shed/validation
+        failure the retained ticket refs are released here; the
+        CALLER's own reference is untouched either way.
+        """
+        payloads = [SlotRow(slab, r) for r in rows]
+        slab.retain(len(payloads))  # one ref per ticket, released at demux
+        try:
+            return await self.enqueue(
+                tenant,
+                payloads,
+                expected=expected,
+                algo=slab.algo,
+                piece_length=slab.piece_length,
+                wait=wait,
+            )
+        except BaseException:
+            slab.release(len(payloads))
+            raise
 
     async def submit(self, tenant: str, pieces, expected=None, algo="sha1",
                      piece_length=None, wait: bool = False):
@@ -1317,14 +1722,31 @@ class HashPlaneScheduler:
             if pad:
                 with self._counter_lock:
                     lane.pad_rows_total += pad
+        # zero-copy launch form: when every ticket is a SlotRow of ONE
+        # pre-staged slab and the plane can consume it in place, skip
+        # the stage copy entirely (mixed batches — several slabs, or
+        # slab rows interleaved with byte payloads — take the copying
+        # run path, which stages SlotRow views like any other payload)
+        staged = _staged_batch(payloads)
+        run_staged = (
+            getattr(lane.plane, "run_staged", None) if staged else None
+        )
+        if run_staged is not None:
+            obs_note["staged"] = True
         try:
             if self.hasher == "cpu":
-                digests = lane.plane.run(payloads)
+                if run_staged is not None:
+                    digests = run_staged(*staged)
+                else:
+                    digests = lane.plane.run(payloads)
             else:
                 from torrent_tpu.obs.profiler import maybe_profile_batch
 
                 with maybe_profile_batch(f"sched_{lane.algo}_launch_b{lane.bucket}"):
-                    digests = lane.plane.run(payloads)
+                    if run_staged is not None:
+                        digests = run_staged(*staged)
+                    else:
+                        digests = lane.plane.run(payloads)
             # contract check BEFORE record_success: a plane persistently
             # returning the wrong count must feed the breaker (and trip
             # to the CPU plane) instead of resetting it every launch
@@ -1477,6 +1899,8 @@ class HashPlaneScheduler:
                  "attempt": attempt}
         if note.get("plane") == "cpu_fallback":
             attrs["plane"] = "cpu_fallback"
+        if note.get("staged"):
+            attrs["staged"] = True
         if note.get("breaker_opened"):
             attrs["breaker_opened"] = True
         if error is not None:
@@ -1500,6 +1924,16 @@ class HashPlaneScheduler:
         t_now = time.monotonic()
         e2e_by_tenant: dict[str, list[float]] = {}
         done_subs: dict[int, _Submission] = {}
+        # slot-carrying tickets: release one slab ref per ticket AFTER
+        # delivery (batched per slab; the slot returns to its pool when
+        # the last ref drops) — on the error path too, so a launch that
+        # outlives retry/bisection can never leak a staging slot
+        slab_refs: dict[int, tuple[StagedSlab, int]] = {}
+        for i, tkt in enumerate(tickets):
+            if type(tkt.payload) is SlotRow:
+                slab = tkt.payload.slab
+                prev = slab_refs.get(id(slab))
+                slab_refs[id(slab)] = (slab, 1 if prev is None else prev[1] + 1)
         for i, tkt in enumerate(tickets):
             # the tenant may have been pruned while a zero-byte ticket was
             # in flight — global accounting and delivery must still happen
@@ -1524,6 +1958,8 @@ class HashPlaneScheduler:
                 tkt.sub.deliver(tkt.idx, d)
             if tkt.sub.trace is not None and tkt.sub.remaining == 0:
                 done_subs.setdefault(id(tkt.sub), tkt.sub)
+        for slab, n in slab_refs.values():
+            slab.release(n)
         for tenant, vals in e2e_by_tenant.items():
             histograms().get(*_H_E2E, tenant=tenant).observe_batch(vals)
         for sub in done_subs.values():
@@ -1547,6 +1983,17 @@ class HashPlaneScheduler:
         self._space.set()  # wake admission waiters
 
     # ----------------------------------------------------------- metrics
+
+    def _staging_snapshot(self) -> dict:
+        # worker threads create pools under _ingest_lock; snapshot the
+        # dict under it too so iteration can't race an insert
+        with self._ingest_lock:
+            pools = list(self._ingest_pools.values())
+        return {
+            "pools": len(pools),
+            "outstanding": sum(p.outstanding for p in pools),
+            "checkouts": sum(p.checkouts for p in pools),
+        }
 
     def metrics_snapshot(self) -> dict:
         """Counters for utils/metrics.py's Prometheus rendering."""
@@ -1584,6 +2031,9 @@ class HashPlaneScheduler:
                 }
                 for (algo, bucket), lane in self._lanes.items()
             },
+            # zero-copy ingest pools: outstanding must return to 0 when
+            # no read/launch is in flight (slab-leak test + ops gauge)
+            "staging": self._staging_snapshot(),
             "evicted": dict(self._evicted),
             "tenants": {
                 name: {

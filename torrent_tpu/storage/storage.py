@@ -198,7 +198,11 @@ class Storage:
         return self.get(index * self.info.piece_length, piece_length(self.info, index))
 
     def read_batch(
-        self, indices, out: np.ndarray | None = None
+        self,
+        indices,
+        out: np.ndarray | None = None,
+        row_status: np.ndarray | None = None,
+        zero_fill: bool = True,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Read pieces ``indices`` into ``[n, piece_length]`` uint8 rows.
 
@@ -206,6 +210,17 @@ class Storage:
         length of piece ``indices[i]`` (short for the final piece; the tail
         of its row is zero). Unreadable ranges zero-fill rather than raise —
         the verify plane turns those into hash mismatches.
+
+        ``row_status``: optional caller-owned ``bool[n]``. When given,
+        per-row read success lands there (False = any segment of the row
+        was missing, short, or torn) — the zero-copy ingest path uses it
+        to turn failed rows into ``nblocks=0`` sentinels instead of
+        relying on zero-fill hash mismatches. ``zero_fill=False`` skips
+        the upfront memset of a caller-provided ``out`` (rows may then
+        hold stale/partial bytes wherever ``row_status`` is False; only
+        pass it together with ``row_status``). BEP 47 pad spans are
+        always written as zeros explicitly, so dirty reused buffers
+        can't corrupt pad-covering pieces.
         """
         indices = list(indices)
         n = len(indices)
@@ -215,37 +230,66 @@ class Storage:
         else:
             if out.shape != (n, plen_max) or out.dtype != np.uint8:
                 raise StorageError("read_batch out buffer has wrong shape/dtype")
-            out[:] = 0
+            if zero_fill:
+                out[:] = 0
+        if row_status is not None:
+            if row_status.shape != (n,) or row_status.dtype != np.bool_:
+                raise StorageError("read_batch row_status must be bool[n]")
+            row_status[:] = True
         lengths = np.empty(n, dtype=np.int64)
-        if self._native_read_batch(indices, out, lengths):
+        if self._native_read_batch(indices, out, lengths, row_status):
             return out, lengths
-        for row, idx in enumerate(indices):
-            plen = piece_length(self.info, idx)
-            lengths[row] = plen
-            pos = 0
-            base = idx * plen_max
-            for path, foff, chunk in self.segments(base, plen):
-                if path is None:
-                    pos += chunk  # pad span: buffer is already zeros
-                    continue
-                try:
-                    data = self.method.get(path, foff, chunk)
-                    out[row, pos : pos + len(data)] = np.frombuffer(data, dtype=np.uint8)
-                except (StorageError, OSError):
-                    # leave zeros; SHA1 mismatch will flag the piece.
-                    # OSError too: a file torn mid-recheck can surface a
-                    # raw errno from backends that don't wrap, and the
-                    # device paths must mark-and-continue like the CPU one
-                    pass
-                pos += chunk
+        # pure-Python fallback — the pipeline ledger's "read" boundary for
+        # backends without the native pread pool (the native path accounts
+        # inside io_engine.read_into; the two never both run for one row)
+        from torrent_tpu.obs.ledger import pipeline_ledger
+
+        with pipeline_ledger().track("read") as tracked:
+            for row, idx in enumerate(indices):
+                plen = piece_length(self.info, idx)
+                lengths[row] = plen
+                pos = 0
+                base = idx * plen_max
+                for path, foff, chunk in self.segments(base, plen):
+                    if path is None:
+                        # pad span: zeros by definition — written
+                        # explicitly because a zero_fill=False caller
+                        # (reused staging slab) may hand us dirty rows
+                        out[row, pos : pos + chunk] = 0
+                        pos += chunk
+                        continue
+                    try:
+                        data = self.method.get(path, foff, chunk)
+                        out[row, pos : pos + len(data)] = np.frombuffer(
+                            data, dtype=np.uint8
+                        )
+                        tracked.add(len(data))
+                    except (StorageError, OSError):
+                        # leave zeros; SHA1 mismatch will flag the piece.
+                        # OSError too: a file torn mid-recheck can surface a
+                        # raw errno from backends that don't wrap, and the
+                        # device paths must mark-and-continue like the CPU one
+                        if row_status is not None:
+                            row_status[row] = False
+                    pos += chunk
         return out, lengths
 
-    def _native_read_batch(self, indices, out: np.ndarray, lengths: np.ndarray) -> bool:
+    def _native_read_batch(
+        self,
+        indices,
+        out: np.ndarray,
+        lengths: np.ndarray,
+        row_status: np.ndarray | None = None,
+    ) -> bool:
         """Batch read via the C++ pread pool (native/io_engine.cpp).
 
         Only for filesystem-backed storage; any unreadable range is left
         zeroed (same semantics as the Python path — SHA1 flags the piece).
-        Returns False to fall back when native IO is unavailable.
+        Returns False to fall back when native IO is unavailable. With
+        ``row_status`` given, a failed/short/torn segment marks its row
+        False instead of raising or zero-rebuilding — the preads land
+        directly in the caller's (possibly row-strided) buffer and the
+        caller sentinels the failed rows.
         """
         if not isinstance(self.method, FsStorage):
             return False
@@ -263,13 +307,18 @@ class Storage:
         sizes: list[int] = []
         findex: dict[tuple[str, ...], int | None] = {}
         quads: list[tuple[int, int, int, int]] = []
+        quad_rows: list[int] = []  # row owning each quad (status demux)
         for row, idx in enumerate(indices):
             plen = piece_length(self.info, idx)
             lengths[row] = plen
             pos = 0
             for path, foff, chunk in self.segments(idx * self.info.piece_length, plen):
                 if path is None:
-                    pos += chunk  # pad span: stays zero
+                    # pad span: zeros by definition — force them, since a
+                    # zero_fill=False caller (reused staging slab) hands
+                    # us rows that may hold a previous batch's bytes
+                    out[row, pos : pos + chunk] = 0
+                    pos += chunk
                     continue
                 fi = findex.get(path, -1)
                 if fi == -1:
@@ -284,15 +333,41 @@ class Storage:
                     findex[path] = fi
                 if fi is not None and sizes[fi] - foff >= chunk:
                     quads.append((fi, foff, row * row_stride + pos, chunk))
+                    quad_rows.append(row)
+                elif row_status is not None:
+                    # missing/short file: the row can never be complete
+                    row_status[row] = False
                 # else: leave the whole segment zeroed — same all-or-nothing
                 # semantics as the Python path's short-read StorageError
                 pos += chunk
         extent = (out.shape[0] - 1) * row_stride + out.shape[1] if out.shape[0] else 0
         try:
-            engine.read_into(paths, quads, out.ctypes.data, extent, keepalive=out)
+            if row_status is not None:
+                import errno as _errno
+
+                statuses = np.zeros(len(quads), dtype=np.int32)
+                rc = engine.read_into(
+                    paths, quads, out.ctypes.data, extent,
+                    keepalive=out, statuses=statuses,
+                )
+                if rc != 0 and (statuses == _errno.ENOENT).any():
+                    # a file vanished between our stat() and the
+                    # engine's open(): tt_io_read_batch fast-fails
+                    # WITHOUT submitting any segment, so the zero
+                    # statuses of the other rows are meaningless —
+                    # re-derive every row on the Python path
+                    row_status[:] = True
+                    return False
+                for q in np.nonzero(statuses)[0]:
+                    row_status[quad_rows[int(q)]] = False
+            else:
+                engine.read_into(paths, quads, out.ctypes.data, extent, keepalive=out)
         except (NativeIOError, ValueError):
-            out[:] = 0  # a failed segment can leave partial bytes; the
-            return False  # Python fallback rebuilds from a clean buffer
+            if row_status is None:
+                out[:] = 0  # a failed segment can leave partial bytes; the
+                return False  # Python fallback rebuilds from a clean buffer
+            row_status[:] = True  # the fallback re-derives every row itself
+            return False
         return True
 
 

@@ -13,6 +13,12 @@ Rungs::
     torrent-tpu bench smoke      # CPU-plane scheduler recheck (seconds;
                                  # the CI rung — in-process, ledger
                                  # breakdown embedded)
+    torrent-tpu bench e2e        # end-to-end disk→slot→device recheck
+                                 # through the zero-copy ingest path
+                                 # (scheduler-fed, hasher selectable via
+                                 # --hasher, ledger breakdown + overlap
+                                 # embedded — the banked proof that
+                                 # ingest wins are real, not anecdotal)
     torrent-tpu bench v2         # r6 sha256 leaf-plane rung: bench.py
                                  # BENCH_CONFIG=v2 under the median-of-3
                                  # contract, pallas backend (device)
@@ -65,7 +71,7 @@ __all__ = ["compare_record", "load_trajectory", "main"]
 
 SCHEMA = "torrent-tpu-bench/1"
 TRAJECTORY_SCHEMA = "torrent-tpu-bench-trajectory/1"
-RUNGS = ("smoke", "v2", "fabric", "flagship")
+RUNGS = ("smoke", "e2e", "v2", "fabric", "flagship")
 DEFAULT_TOLERANCE = 0.10
 
 # env the retired .bench rung scripts exported, reproduced per rung
@@ -190,6 +196,74 @@ async def _smoke(total_mb: int, piece_kb: int, batch_target: int) -> dict:
             "wall_s": rep["wall_s"],
             "stages": rep["stages"],
             "bottleneck": rep["bottleneck"],
+            "overlap": rep.get("overlap"),
+        },
+    }
+
+
+async def _e2e(
+    total_mb: int, piece_kb: int, batch_target: int, hasher: str
+) -> dict:
+    """The end-to-end ingest rung: a scheduler-fed recheck over real
+    disk through the zero-copy path (disk → staging slot → device),
+    with the ledger's per-stage breakdown AND the cross-stage overlap
+    series embedded — read-while-h2d-while-launch is part of the banked
+    record, so double-buffering regressions are visible, not anecdotal.
+    ``hasher='tpu'`` runs the device plane (XLA-CPU off-device), 'cpu'
+    the hashlib plane; both go through the same ingest path."""
+    from torrent_tpu.obs.attrib import attribute
+    from torrent_tpu.obs.ledger import pipeline_ledger
+    from torrent_tpu.parallel.bulk import verify_library_sched
+    from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
+
+    with tempfile.TemporaryDirectory(prefix="tt_bench_e2e_") as tmp:
+        storage, info = await asyncio.to_thread(
+            _build_smoke_torrent, tmp, total_mb, piece_kb
+        )
+        led = pipeline_ledger()
+        prev = led.snapshot()
+        sched = HashPlaneScheduler(
+            SchedulerConfig(batch_target=batch_target, flush_deadline=0.02),
+            hasher=hasher,
+        )
+        await sched.start()
+        try:
+            t0 = time.perf_counter()
+            res = await verify_library_sched([(storage, info)], sched, tenant="bench")
+            seconds = time.perf_counter() - t0
+        finally:
+            await sched.close()
+        staging = sched.metrics_snapshot().get("staging", {})
+        rep = attribute(led.snapshot(), prev=prev)
+    n_valid = int(res.bitfields[0].sum())
+    pieces = info.num_pieces
+    value = round(pieces / seconds, 1) if seconds > 0 else None
+    return {
+        "schema": SCHEMA,
+        "rung": "e2e",
+        "metric": f"sha1_recheck_e2e_{hasher}_{piece_kb}KiB_pieces_per_sec",
+        "value": value if n_valid == pieces else None,
+        "unit": "pieces/s",
+        "pieces": pieces,
+        "valid": n_valid,
+        "bytes": info.length,
+        "seconds": round(seconds, 4),
+        "gib_per_sec": round(info.length / seconds / 2**30, 3) if seconds else None,
+        "batch": batch_target,
+        "piece_kb": piece_kb,
+        "platform": hasher,
+        "plane": hasher,
+        "nproc": os.cpu_count(),
+        # zero-copy health facts alongside the rate: stage-copy bytes
+        # must stay ~0 and every slab must have come back
+        "staging_outstanding": staging.get("outstanding"),
+        "staged_checkouts": staging.get("checkouts"),
+        "measured_at_utc": _utcnow(),
+        "ledger": {
+            "wall_s": rep["wall_s"],
+            "stages": rep["stages"],
+            "bottleneck": rep["bottleneck"],
+            "overlap": rep.get("overlap"),
         },
     }
 
@@ -390,7 +464,7 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "rung", nargs="?", choices=RUNGS,
-        help="named rung to run (smoke/v2/fabric/flagship)",
+        help="named rung to run (smoke/e2e/v2/fabric/flagship)",
     )
     ap.add_argument(
         "--smoke", action="store_true",
@@ -398,15 +472,20 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--mb", type=int, default=8,
-        help="smoke rung: payload MiB (default %(default)s)",
+        help="smoke/e2e rungs: payload MiB (default %(default)s)",
     )
     ap.add_argument(
         "--piece-kb", type=int, default=256,
-        help="smoke rung: piece size KiB (default %(default)s)",
+        help="smoke/e2e rungs: piece size KiB (default %(default)s)",
     )
     ap.add_argument(
         "--batch-target", type=int, default=32,
-        help="smoke rung: scheduler pieces-per-launch target",
+        help="smoke/e2e rungs: scheduler pieces-per-launch target",
+    )
+    ap.add_argument(
+        "--hasher", default="tpu", choices=("tpu", "cpu"),
+        help="e2e rung: hash plane (default %(default)s; 'tpu' is XLA — "
+        "on a CPU-only host it still exercises the device-plane path)",
     )
     ap.add_argument(
         "--timeout", type=float, default=None,
@@ -449,7 +528,7 @@ def main(argv=None) -> int:
             return 2
         rung = "smoke"
     if rung is None and args.record is None:
-        print("error: name a rung (smoke/v2/fabric/flagship) or pass "
+        print("error: name a rung (smoke/e2e/v2/fabric/flagship) or pass "
               "--record FILE", file=sys.stderr)
         return 2
 
@@ -466,6 +545,10 @@ def main(argv=None) -> int:
             if rung == "smoke":
                 record = asyncio.run(
                     _smoke(args.mb, args.piece_kb, args.batch_target)
+                )
+            elif rung == "e2e":
+                record = asyncio.run(
+                    _e2e(args.mb, args.piece_kb, args.batch_target, args.hasher)
                 )
             elif rung == "fabric":
                 record = _run_fabric_rung(args.timeout)

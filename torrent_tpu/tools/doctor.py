@@ -768,6 +768,17 @@ async def _bottleneck_smoke(throttled: bool, tmp: str) -> str:
     )
     rep = attribute(led.snapshot(), prev=prev)
     assert rep["bottleneck"] is not None, "ledger recorded no activity"
+    # zero-copy ingest proof: the scheduler-fed recheck reads straight
+    # into staging slabs, so the `stage` copy stage must account ~zero
+    # bytes — and every slab must have come back to its pool
+    stage_bytes = rep["stages"].get("stage", {}).get("bytes", 0)
+    assert stage_bytes == 0, (
+        f"zero-copy path still staged {stage_bytes} bytes"
+    )
+    staging = sched.metrics_snapshot().get("staging", {})
+    assert staging.get("outstanding", 0) == 0, (
+        f"staging slabs leaked: {staging}"
+    )
     if throttled:
         bn = rep["bottleneck"]
         assert bn["stage"] == "h2d", (
@@ -776,7 +787,7 @@ async def _bottleneck_smoke(throttled: bool, tmp: str) -> str:
         assert bn["utilization"] > 0.5, (
             f"throttled H2D should own the majority of wall time: {bn}"
         )
-    return format_report(rep)
+    return format_report(rep) + "; zero-copy: stage 0 B, slabs all returned"
 
 
 def _lint_smoke() -> str:

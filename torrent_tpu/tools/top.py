@@ -84,6 +84,15 @@ def render_top(payload: dict, url: str = "") -> str:
                 f"{_fmt_bytes(st.get('bytes', 0)):>10s} "
                 f"{_fmt_rate(st.get('achieved_bps')):>10s}"
             )
+    ov = rep.get("overlap") or {}
+    if ov.get("max_concurrent_stages", 0) or ov.get("busy_s", 0.0):
+        # the double-buffering proof line: read while h2d while launch
+        # shows up as wall seconds with ≥2 stages simultaneously busy
+        lines.append(
+            f"overlap: {ov.get('busy_s', 0.0):.1f}s with ≥2 stages busy "
+            f"({ov.get('share', 0.0) * 100:.0f}% of wall, "
+            f"max {ov.get('max_concurrent_stages', 0)} stages at once)"
+        )
     bn = rep.get("bottleneck")
     if bn:
         line = (

@@ -68,6 +68,12 @@ def render_sched_metrics(sched) -> str:
         "# HELP torrent_tpu_sched_evicted_tenants_total Idle auto-registered tenants evicted to bound cardinality",
         "# TYPE torrent_tpu_sched_evicted_tenants_total counter",
         f"torrent_tpu_sched_evicted_tenants_total {s.get('evicted', {}).get('tenants', 0)}",
+        "# HELP torrent_tpu_sched_staging_outstanding Zero-copy ingest slabs checked out and not yet returned",
+        "# TYPE torrent_tpu_sched_staging_outstanding gauge",
+        f"torrent_tpu_sched_staging_outstanding {s.get('staging', {}).get('outstanding', 0)}",
+        "# HELP torrent_tpu_sched_staging_checkouts_total Zero-copy ingest slab checkouts",
+        "# TYPE torrent_tpu_sched_staging_checkouts_total counter",
+        f"torrent_tpu_sched_staging_checkouts_total {s.get('staging', {}).get('checkouts', 0)}",
         "# HELP torrent_tpu_sched_flush_total Launch flushes by reason",
         "# TYPE torrent_tpu_sched_flush_total counter",
     ]

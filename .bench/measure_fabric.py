@@ -1,7 +1,14 @@
 """Fabric scaling measurement (r7 rung): spawn N fabric-verify worker
 processes over the shared-directory heartbeat transport against one
 synthetic library and report wall-clock GiB/s. One JSON line per run on
-stdout: {"nproc", "rep", "seconds", "gib_per_sec", "pieces", "valid"}.
+stdout: {"nproc", "rep", "seconds", "gib_per_sec", "pieces", "valid",
+"per_process", "fleet_bottleneck"}.
+
+``per_process`` embeds every worker's pipeline-ledger breakdown (stage
+busy/bytes/utilization, bottleneck verdict, overlap) straight from its
+result record, and ``fleet_bottleneck`` is worker 0's two-level fleet
+verdict (limiting process → its limiting stage) — so a banked fabric
+rate carries its own per-process attribution instead of a bare number.
 
 The library is built once (deterministic seed) and reused across runs;
 each run gets a fresh heartbeat dir. Workers are plain OS processes —
@@ -83,6 +90,32 @@ def run_once(tdir, ddir, hb, nproc, hasher, batch_target):
     rec = json.load(open(os.path.join(hb, "result_0.json")))
     if rec["n_valid"] != rec["n_pieces"]:
         raise RuntimeError(f"incomplete verify: {rec['n_valid']}/{rec['n_pieces']}")
+    # per-process ledger/overlap breakdowns: every worker's result file
+    # embeds its own attribution report (fabric-verify writes it), so
+    # the rung's record explains its rate instead of just banking it
+    per_process = []
+    for p in range(nproc):
+        if p == 0:
+            wrec = rec  # already loaded (and rate-checked) above
+        else:
+            try:
+                wrec = json.load(open(os.path.join(hb, f"result_{p}.json")))
+            except (OSError, ValueError):
+                continue
+        led = wrec.get("ledger") or {}
+        per_process.append(
+            {
+                "pid": wrec.get("pid", p),
+                "pieces_verified": wrec.get("pieces_verified"),
+                "units_done": wrec.get("units_done"),
+                "units_adopted": wrec.get("units_adopted"),
+                "wall_s": led.get("wall_s"),
+                "stages": led.get("stages"),
+                "bottleneck": led.get("bottleneck"),
+                "overlap": led.get("overlap"),
+            }
+        )
+    rec["per_process"] = per_process
     return seconds, rec
 
 
@@ -111,6 +144,7 @@ def main() -> int:
         seconds, rec = run_once(
             tdir, ddir, hb, args.nproc, args.hasher, args.batch_target
         )
+        fleet = rec.get("fleet") or {}
         print(
             json.dumps(
                 {
@@ -122,6 +156,8 @@ def main() -> int:
                     "valid": rec["n_valid"],
                     "plan": rec["plan"],
                     "hasher": args.hasher,
+                    "per_process": rec.get("per_process", []),
+                    "fleet_bottleneck": fleet.get("bottleneck"),
                 }
             ),
             flush=True,

@@ -210,16 +210,92 @@ class TestRendererEdgeCases:
         assert "torrent_tpu_sched_staging_outstanding 2" in text
         assert "torrent_tpu_sched_staging_checkouts_total 9" in text
 
+    def test_fleet_renderer_fresh_rollup(self):
+        """A fresh/empty fleet rollup (no digests held yet, even an
+        empty dict) must render complete headers and zero samples —
+        /metrics is often scraped before the first heartbeat lands."""
+        from torrent_tpu.utils.metrics import render_fleet_metrics
+
+        for rollup in ({}, {"nproc": 0, "scoreboard": []}):
+            text = render_fleet_metrics(rollup)
+            prom_lint(text)
+            assert "torrent_tpu_fleet_processes 0" in text
+            assert "torrent_tpu_fleet_digest_dropped_total 0" in text
+
+    def test_fleet_renderer_partial_peer_set(self):
+        """Mid-run view: some peers reported digests, some are only
+        known by status (unreported/lapsed) — partial rows with missing
+        keys must render as zeros, never a crash."""
+        from torrent_tpu.utils.metrics import render_fleet_metrics
+
+        rollup = {
+            "nproc": 3,
+            "reporting": 2,
+            "bottleneck": {"pid": 1, "stage": "h2d",
+                           "fleet_median_bps": 1000.0},
+            "scoreboard": [
+                {"pid": 0, "status": "ok", "achieved_bps": 2000.0,
+                 "vs_median": 2.0, "units_planned": 2, "units_done": 2},
+                {"pid": 1, "status": "ok", "achieved_bps": 10.0},
+                {"pid": 2, "status": "lapsed", "adoption_debt": 4},
+            ],
+            "digest_drops": 1,
+        }
+        text = render_fleet_metrics(rollup)
+        prom_lint(text)
+        assert 'torrent_tpu_fleet_status{status="lapsed"} 1' in text
+        assert (
+            'torrent_tpu_fleet_limiting_process{pid="1",stage="h2d"} 1'
+            in text
+        )
+        assert 'torrent_tpu_fleet_pid_achieved_bps{pid="2"} 0' in text
+        assert 'torrent_tpu_fleet_pid_adoption_debt{pid="2"} 4' in text
+        assert 'torrent_tpu_fleet_pid_units{pid="0",kind="done"} 2' in text
+        assert "torrent_tpu_fleet_digest_dropped_total 1" in text
+
+    def test_fleet_renderer_pid_overflow(self):
+        """Bounded pid cardinality: a fleet wider than MAX_FLEET_PIDS
+        folds the tail rows into one pid="overflow" aggregate."""
+        from torrent_tpu.utils.metrics import (
+            MAX_FLEET_PIDS,
+            render_fleet_metrics,
+        )
+
+        n = MAX_FLEET_PIDS + 4
+        rollup = {
+            "nproc": n,
+            "reporting": n,
+            "scoreboard": [
+                {"pid": p, "status": "ok", "achieved_bps": 100.0,
+                 "vs_median": 0.4 if p == n - 1 else 1.0,
+                 "units_planned": 1, "units_done": 1}
+                for p in range(n)
+            ],
+        }
+        text = render_fleet_metrics(rollup)
+        prom_lint(text)
+        assert 'torrent_tpu_fleet_pid_achieved_bps{pid="overflow"} 400.0' in text
+        assert f'pid="{MAX_FLEET_PIDS - 1}"' in text
+        assert f'pid="{MAX_FLEET_PIDS}"' not in text
+        assert (
+            'torrent_tpu_fleet_pid_units{pid="overflow",kind="done"} 4' in text
+        )
+        # a ratio doesn't sum: the folded vs_median reports the WORST
+        # member, so an alert on < 0.5 still catches a folded straggler
+        assert 'torrent_tpu_fleet_pid_vs_median{pid="overflow"} 0.4' in text
+
     def test_full_exposition_concatenation_lints(self):
-        """What the bridge actually serves: sched + fabric + obs (incl.
-        the pipeline ledger) + tsan in one payload must still have
-        unique series and complete headers."""
+        """What the bridge actually serves: sched + fabric + fleet +
+        obs (incl. the pipeline ledger) + tsan in one payload must
+        still have unique series and complete headers."""
         from torrent_tpu.analysis import sanitizer
         from torrent_tpu.obs import render_obs_metrics
+        from torrent_tpu.obs.fleet import local_fleet_snapshot
         from torrent_tpu.obs.ledger import pipeline_ledger
         from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
         from torrent_tpu.utils.metrics import (
             render_fabric_metrics,
+            render_fleet_metrics,
             render_sched_metrics,
             render_tsan_metrics,
         )
@@ -229,11 +305,13 @@ class TestRendererEdgeCases:
         text = (
             render_sched_metrics(sched)
             + render_fabric_metrics({"pid": 0})
+            + render_fleet_metrics(local_fleet_snapshot(sched))
             + render_obs_metrics()
             + render_tsan_metrics(sanitizer.TsanState().snapshot())
         )
         prom_lint(text)
         assert "torrent_tpu_pipeline_stage_busy_seconds_total" in text
+        assert "torrent_tpu_fleet_reporting 1" in text
 
 
 class TestLiveScrape:

@@ -49,6 +49,7 @@ SCOPE: dict[str, frozenset[str]] = {
     "fabric/executor.py": frozenset(
         {
             "_heartbeat_once",
+            "_build_obs_digest",
             "bitfields",
             "pack_bits",
             "unpack_bits",
@@ -58,6 +59,20 @@ SCOPE: dict[str, frozenset[str]] = {
     # span context carried in fabric heartbeat payloads: the obs plane's
     # contribution to exchanged bytes must obey the same rules
     "obs/tracer.py": frozenset({"fabric_trace_id", "heartbeat_span_context"}),
+    # the fleet obs digest rides the same heartbeats: every builder that
+    # shapes exchanged digest bytes is held to the same bit-stability
+    # rules (monotonic-only, no randomness, sorted iteration)
+    "obs/fleet.py": frozenset(
+        {
+            "build_obs_digest",
+            "clamp_digest",
+            "digest_bytes",
+            "obs_digest",
+            "_digest_stages",
+            "_digest_hist",
+            "_digest_sched",
+        }
+    ),
 }
 
 WALL_CLOCK = frozenset(

@@ -36,6 +36,15 @@ spent its time.
                             plus the result summary once done; the same
                             gauges flow into /metrics as
                             torrent_tpu_fabric_* while the job exists
+  GET  /v1/fleet     → JSON: this process's view of the FLEET — own obs
+                       digest merged with every peer's heartbeat-carried
+                       digest (obs/fleet): two-level bottleneck verdict
+                       (limiting process → its limiting stage), the
+                       straggler scoreboard, per-process attribution.
+                       A fleet-of-one from local state when no fabric
+                       job runs; torrent_tpu_fleet_* series mirror it
+                       on /metrics, `torrent-tpu top --fleet` renders
+                       it live
 
 Every route submits into the shared hash-plane scheduler
 (``torrent_tpu/sched``) instead of owning staging buffers: pieces from
@@ -121,7 +130,7 @@ log = get_logger("bridge")
 _KNOWN_ROUTES = frozenset(
     {
         "/v1/digests", "/v1/verify", "/v1/info", "/v1/trace", "/metrics",
-        "/v1/pipeline", "/v1/fabric/verify", "/v1/fabric/status",
+        "/v1/pipeline", "/v1/fleet", "/v1/fabric/verify", "/v1/fabric/status",
         "/v1/stream/digests", "/v1/stream/verify",
     }
 )
@@ -543,9 +552,13 @@ class BridgeServer:
 
             text = render_sched_metrics(self.sched)
             if self._fabric and self._fabric["executors"]:
-                text += render_fabric_metrics(
-                    self._fabric["executors"][0].metrics_snapshot()
-                )
+                from torrent_tpu.utils.metrics import render_fleet_metrics
+
+                ex = self._fabric["executors"][0]
+                text += render_fabric_metrics(ex.metrics_snapshot())
+                # the swarm-wide view: this process's fleet rollup from
+                # its own + heartbeat-carried peer digests
+                text += render_fleet_metrics(ex.fleet_snapshot())
             text += render_obs_metrics()
             from torrent_tpu.analysis import sanitizer
 
@@ -563,6 +576,8 @@ class BridgeServer:
             return await self._trace_route(writer, target)
         if method == "GET" and target.split("?")[0] == "/v1/pipeline":
             return await self._pipeline_route(writer)
+        if method == "GET" and target.split("?")[0] == "/v1/fleet":
+            return await self._fleet_route(writer)
         if method == "GET" and target == "/v1/fabric/status":
             return await self._reply(writer, 200, bencode(self._fabric_status()))
         if method != "POST":
@@ -781,6 +796,27 @@ class BridgeServer:
             },
             sort_keys=True,
         ).encode()
+        return await self._reply(
+            writer, 200, body, content_type="application/json"
+        )
+
+    async def _fleet_route(self, writer):
+        """``GET /v1/fleet`` — this process's view of the fleet.
+
+        While a fabric job runs (or after it finished) the rollup comes
+        from the executor: own obs digest + every peer's heartbeat-
+        carried digest, two-level bottleneck attribution, straggler
+        scoreboard. With no fabric job it degrades to a fleet-of-one
+        built from local obs state, so the route (and ``top --fleet``)
+        always answers. JSON with sorted keys; pure in-memory reads,
+        safe on the serving loop."""
+        from torrent_tpu.obs.fleet import local_fleet_snapshot
+
+        if self._fabric and self._fabric["executors"]:
+            roll = self._fabric["executors"][0].fleet_snapshot()
+        else:
+            roll = local_fleet_snapshot(self.sched)
+        body = json.dumps(roll, sort_keys=True).encode()
         return await self._reply(
             writer, 200, body, content_type="application/json"
         )

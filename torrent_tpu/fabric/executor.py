@@ -13,7 +13,10 @@ them from starving interactive callers.
 
 Failure layer. Processes exchange a periodic few-byte heartbeat —
 sequence, in-flight units, completed-unit verdict bits, a degraded
-flag, and a distrust list — over a pluggable transport:
+flag, a distrust list, and a bounded fleet obs digest (``obs/fleet``:
+ledger stage deltas, histogram summaries, sched + unit progress — the
+raw material of ``fleet_snapshot()``'s swarm rollup) — over a pluggable
+transport:
 
 * :class:`FileHeartbeat` — atomic JSON files in a shared directory.
   Files outlive their writer and staleness is visible, so this is the
@@ -61,6 +64,8 @@ from dataclasses import dataclass
 import numpy as np
 
 from torrent_tpu.fabric.plan import FabricPlan, adoption_owner
+from torrent_tpu.obs.fleet import DIGEST_MAX_BYTES, aggregate_fleet, obs_digest
+from torrent_tpu.obs.ledger import pipeline_ledger
 from torrent_tpu.obs.recorder import flight_recorder
 from torrent_tpu.obs.tracer import fabric_trace_id, heartbeat_span_context, tracer
 from torrent_tpu.utils.log import get_logger
@@ -106,6 +111,11 @@ class FabricConfig:
     # collective) before the run aborts with a classified error rather
     # than spinning forever with stale state
     heartbeat_fail_limit: int = 20
+    # carry the fleet obs digest (obs/fleet.py: ledger stage deltas,
+    # histogram summaries, sched + unit progress) on every heartbeat —
+    # the payload cost is budgeted into plan_payload_bytes; disable only
+    # to shrink heartbeats on an extremely constrained transport
+    carry_obs_digest: bool = True
     # TEST/FAULT HOOK (doctor --fabric, tests/test_fabric.py): publish a
     # final heartbeat then hard-exit the process after this many units
     # complete — the deterministic stand-in for a worker dying mid-run.
@@ -195,11 +205,27 @@ class AllgatherHeartbeat:
         self.nproc = nproc
         self.pid = pid
         self.max_bytes = max_bytes
+        # heartbeats that had to shed their obs digest to fit the
+        # buffer — surfaced as torrent_tpu_fleet_digest_dropped_total
+        self.digest_drops = 0
 
     def exchange(self, payload: dict) -> dict[int, dict]:
         from jax.experimental import multihost_utils
 
         raw = json.dumps(payload).encode()
+        if len(raw) > self.max_bytes and "obs" in payload:
+            # overflow hardening: the obs digest is advisory — shed it
+            # FIRST (counted, never silent) so verdict bits still
+            # publish; plan_payload_bytes budgets the worst-case digest,
+            # so reaching this line already means the sizing was wrong
+            payload = {k: v for k, v in payload.items() if k != "obs"}
+            self.digest_drops += 1
+            log.warning(
+                "fabric heartbeat payload over the %dB allgather buffer; "
+                "dropping the obs digest this round (drop #%d)",
+                self.max_bytes, self.digest_drops,
+            )
+            raw = json.dumps(payload).encode()
         if len(raw) > self.max_bytes:
             # NEVER bail out before the collective — peers are already
             # blocked in process_allgather and a raise here would wedge
@@ -238,10 +264,13 @@ def plan_payload_bytes(plan: FabricPlan) -> int:
     """Allgather buffer size for a plan: the worst-case heartbeat is
     every unit's verdict bits (hex doubles the packed bytes) plus
     per-unit JSON overhead, a distrust/redone list that can hold one
-    entry per (publisher, unit) pair, and a fixed envelope."""
+    entry per (publisher, unit) pair, a fixed envelope, and the
+    worst-case fleet obs digest (clamped to DIGEST_MAX_BYTES by
+    construction, so the budget term is exact)."""
     bits_hex = sum((u.npieces + 7) // 8 * 2 for u in plan.units)
     return (
         4096
+        + DIGEST_MAX_BYTES
         + bits_hex
         + 48 * len(plan.units)
         + 24 * len(plan.units) * plan.nproc  # distrust pairs, worst case
@@ -328,6 +357,10 @@ class FabricExecutor:
         self._started_mono = time.monotonic()
         self._started_wall = time.time()
         self._state = "idle"
+        # fleet obs plane: digests are ledger DELTAS against this base,
+        # so a long-lived process's earlier traffic never dilutes the
+        # sweep's attribution; peers' digests ride _peer_seen
+        self._obs_base = pipeline_ledger().snapshot()
 
     # ---------------------------------------------------------- coverage
 
@@ -596,6 +629,8 @@ class FabricExecutor:
                 u for p, u in self._superseded if p == self.pid
             ),
         }
+        if self.config.carry_obs_digest:
+            payload["obs"] = self._build_obs_digest()
         try:
             peers = await asyncio.to_thread(self.transport.exchange, payload)
         except Exception as e:
@@ -865,6 +900,85 @@ class FabricExecutor:
                     uid, now - t0, threshold,
                 )
 
+    # ------------------------------------------------------------- fleet
+
+    def _build_obs_digest(self) -> dict:
+        """This process's heartbeat-carried obs digest (obs/fleet.py).
+        In the determinism pass's scope — exchanged bytes: counters and
+        monotonic deltas only, clamped to DIGEST_MAX_BYTES."""
+        unit = {
+            "done": self._units_done,
+            "planned": len(self.plan.units_for(self.pid)),
+            "adopted": self._units_adopted,
+            "pieces": self._pieces_verified,
+            "inflight": len(self._unit_started),
+            "stragglers": self._stragglers,
+            "degraded": self._degraded,
+        }
+        return obs_digest(
+            scheduler=self.scheduler, base=self._obs_base, unit=unit
+        )
+
+    def digest_drops(self) -> int:
+        """Heartbeats that shed their obs digest to fit the transport
+        buffer (allgather overflow hardening) — never silent."""
+        return getattr(self.transport, "digest_drops", 0)
+
+    def fleet_snapshot(self) -> dict:
+        """This process's VIEW OF THE FLEET: own digest plus every
+        peer's latest heartbeat-carried digest, merged by
+        ``obs/fleet.aggregate_fleet`` into the two-level bottleneck
+        verdict (limiting process → its limiting stage) and the
+        straggler scoreboard. Statuses come from the same heartbeat
+        view the adoption machinery uses, so ``GET /v1/fleet`` and the
+        orphan-adoption decisions can never disagree about who is
+        lapsed or degraded."""
+        digests: dict[int, dict] = {self.pid: self._build_obs_digest()}
+        for p in sorted(self._peer_seen):
+            obs = self._peer_seen[p].get("obs")
+            if isinstance(obs, dict):
+                digests[p] = obs
+        if (
+            self.transport is not None
+            and self.plan.nproc > 1
+            and self._state == "running"
+        ):
+            # the live lapse test only makes sense mid-sweep: after a
+            # completed (or failed) run peers legitimately stop
+            # heartbeating, and a later /v1/fleet or /metrics scrape
+            # must not flip every finished peer to "lapsed" with
+            # spurious adoption debt
+            lapsed, degraded = self._unavailable()
+        else:
+            lapsed, degraded = set(), set()
+        distrusted = {p for p, _ in self._distrust}
+        statuses: dict[int, str] = {}
+        for p in range(self.plan.nproc):
+            if p in distrusted:
+                statuses[p] = "distrusted"
+            elif p in lapsed:
+                statuses[p] = "lapsed"
+            elif p in degraded or (p == self.pid and self._degraded):
+                statuses[p] = "degraded"
+            elif p in digests:
+                statuses[p] = "ok"
+            else:
+                statuses[p] = "unreported"
+        planned = {
+            p: len(self.plan.units_for(p)) for p in range(self.plan.nproc)
+        }
+        roll = aggregate_fleet(
+            digests,
+            statuses=statuses,
+            planned_units=planned,
+            nproc=self.plan.nproc,
+            digest_drops=self.digest_drops(),
+        )
+        roll["pid"] = self.pid
+        roll["plan"] = self._fp
+        roll["state"] = self._state
+        return roll
+
     # ----------------------------------------------------------- metrics
 
     def metrics_snapshot(self) -> dict:
@@ -892,4 +1006,5 @@ class FabricExecutor:
                 else time.monotonic() - self._started_mono
             ),
             "degraded": self._degraded,
+            "digest_drops": self.digest_drops(),
         }

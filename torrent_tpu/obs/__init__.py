@@ -19,6 +19,13 @@ Three tiers over the same ticket lifecycle, cheapest first:
    capture of the first N batches (``TORRENT_TPU_PROFILE``), the
    deep-dive tier.
 
+Plus the **fleet plane** (``obs/fleet``): a compact deterministic
+per-process obs digest carried on every fabric heartbeat, merged into a
+swarm-wide rollup with two-level bottleneck attribution (limiting
+process → its limiting stage) and a straggler scoreboard — served as
+``GET /v1/fleet``, ``torrent_tpu_fleet_*`` Prometheus series,
+``torrent-tpu top --fleet``, and ``doctor --fleet``.
+
 Plus the **flight recorder** (``obs/recorder``): a bounded ring of
 recent spans + component snapshots, dumped as redacted black-box JSON
 on breaker-open, retry-exhausted failure, fabric distrust, or an
@@ -31,7 +38,18 @@ deterministic: monotonic-only timestamps, sorted keys.
 """
 
 from torrent_tpu.obs.attrib import attribute, format_report
-from torrent_tpu.obs.hist import HistogramRegistry, LogHistogram, histograms
+from torrent_tpu.obs.fleet import (
+    DIGEST_MAX_BYTES,
+    aggregate_fleet,
+    local_fleet_snapshot,
+    obs_digest,
+)
+from torrent_tpu.obs.hist import (
+    HistogramRegistry,
+    LogHistogram,
+    histograms,
+    merge_snapshots,
+)
 from torrent_tpu.obs.ledger import (
     PIPELINE_STAGES,
     PipelineLedger,
@@ -49,6 +67,7 @@ from torrent_tpu.obs.tracer import (
 )
 
 __all__ = [
+    "DIGEST_MAX_BYTES",
     "FlightRecorder",
     "HistogramRegistry",
     "LogHistogram",
@@ -56,12 +75,16 @@ __all__ = [
     "PipelineLedger",
     "Span",
     "Tracer",
+    "aggregate_fleet",
     "attribute",
     "fabric_trace_id",
     "flight_recorder",
     "format_report",
     "heartbeat_span_context",
     "histograms",
+    "local_fleet_snapshot",
+    "merge_snapshots",
+    "obs_digest",
     "pipeline_ledger",
     "render_obs_metrics",
     "render_pipeline_metrics",

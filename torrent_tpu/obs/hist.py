@@ -31,7 +31,13 @@ from bisect import bisect_left
 from torrent_tpu.analysis.sanitizer import named_lock
 from torrent_tpu.utils.metrics import _esc
 
-__all__ = ["BUCKET_BOUNDS", "LogHistogram", "HistogramRegistry", "histograms"]
+__all__ = [
+    "BUCKET_BOUNDS",
+    "HistogramRegistry",
+    "LogHistogram",
+    "histograms",
+    "merge_snapshots",
+]
 
 # 2^-17 s .. 2^6 s: sub-10µs through 64 s, the full range a hash-plane
 # stage can plausibly occupy (a CPU-plane 16 MiB piece is ~50 ms; a
@@ -77,6 +83,40 @@ class LogHistogram:
             return list(self.counts), self.count, self.sum
 
 
+def merge_snapshots(
+    snaps,
+) -> tuple[list[int], int, float]:
+    """Bucket-aligned sum of :meth:`LogHistogram.snapshot` tuples.
+
+    Because every histogram shares the fixed :data:`BUCKET_BOUNDS`,
+    merging series — across label sets, or across PROCESSES (the fleet
+    rollup merges digest-carried summaries from every fabric peer) — is
+    an elementwise sum; the final +Inf overflow bucket merges like any
+    other, so wedged-launch outliers survive aggregation. Rejects
+    snapshots whose bucket count diverges (a peer running a different
+    build must fail loudly, not mis-bin silently). An empty iterable
+    merges to the all-zero snapshot."""
+    counts: list[int] | None = None
+    count = 0
+    total = 0.0
+    for c, k, s in snaps:
+        if counts is None:
+            counts = list(c)
+        else:
+            if len(c) != len(counts):
+                raise ValueError(
+                    f"bucket count mismatch: {len(c)} != {len(counts)} "
+                    "(snapshots from different BUCKET_BOUNDS builds?)"
+                )
+            for i, v in enumerate(c):
+                counts[i] += v
+        count += int(k)
+        total += float(s)
+    if counts is None:
+        counts = [0] * (len(BUCKET_BOUNDS) + 1)
+    return counts, count, total
+
+
 class HistogramRegistry:
     """(family name, labels) -> :class:`LogHistogram`, bounded per
     family, rendered as Prometheus exposition text."""
@@ -106,6 +146,21 @@ class HistogramRegistry:
                         return h
                 h = fam[key] = LogHistogram()
             return h
+
+    def family_snapshot(self, name: str) -> tuple[list[int], int, float] | None:
+        """One merged snapshot for a whole family (every label set summed
+        via :func:`merge_snapshots`) — the compact per-process form the
+        fleet obs digest carries. ``None`` when the family has never been
+        observed, so digests stay minimal on idle planes."""
+        with self._lock:
+            fam = self._families.get(name)
+            hists = [h for _, h in sorted(fam.items())] if fam else []
+        # snapshot OUTSIDE the registry lock (same discipline as render:
+        # the registry and per-histogram locks are both leaves and are
+        # never nested)
+        if not hists:
+            return None
+        return merge_snapshots(h.snapshot() for h in hists)
 
     def render(self) -> str:
         """Prometheus text exposition for every family: cumulative

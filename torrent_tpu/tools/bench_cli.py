@@ -39,7 +39,10 @@ fields bench.py already emits (metric/value/unit/vs_baseline/batch/
 platform/…) plus ``rung``, ``measured_at_utc``, and ``ledger`` — the
 per-stage busy/bytes/utilization table and the bottleneck verdict from
 ``obs/attrib.attribute`` (null for subprocess rungs, whose ledger lives
-in the child).
+in the child). The fabric rung instead embeds ``per_process`` — each
+worker's ledger/overlap breakdown per process count — and
+``fleet_bottleneck``, worker 0's two-level fleet verdict (limiting
+process → its limiting stage, ``obs/fleet``).
 
 Comparator (``--compare``): gates a candidate record against the banked
 trajectory (``BENCH_trajectory.json``, built by ``.bench/summarize.py
@@ -310,13 +313,19 @@ def _run_bench_py(rung: str, timeout: float | None) -> dict:
 
 def _run_fabric_rung(timeout: float | None) -> dict:
     """The r7 scaling rung: 1/2/4-process CPU fabric verify, median-of-3
-    per process count, value = the 4-process GiB/s."""
+    per process count, value = the 4-process GiB/s. The record embeds
+    every leg's PER-PROCESS ledger/overlap breakdown (last rep) plus the
+    fleet's two-level bottleneck verdict — the rate banks WITH its
+    attribution, so a scaling regression names the process and stage
+    that caused it instead of needing bench archaeology."""
     measure = os.path.join(_repo_root(), ".bench", "measure_fabric.py")
     if not os.path.exists(measure):
         raise FileNotFoundError(
             f"fabric rung needs the source checkout ({measure} missing)"
         )
     results: dict[int, list[float]] = {}
+    per_process: dict[str, list] = {}
+    fleet_bottleneck: dict[str, dict | None] = {}
     with tempfile.TemporaryDirectory(prefix="tt_bench_fabric_") as work:
         for nproc in (1, 2, 4):
             proc = subprocess.run(
@@ -341,6 +350,13 @@ def _run_fabric_rung(timeout: float | None) -> dict:
                     results.setdefault(rec["nproc"], []).append(
                         rec["gib_per_sec"]
                     )
+                    # last rep wins: one representative breakdown per leg
+                    if rec.get("per_process"):
+                        per_process[str(rec["nproc"])] = rec["per_process"]
+                    if rec.get("fleet_bottleneck") is not None:
+                        fleet_bottleneck[str(rec["nproc"])] = rec[
+                            "fleet_bottleneck"
+                        ]
     med = {n: round(statistics.median(v), 3) for n, v in sorted(results.items())}
     base = med.get(1)
     return {
@@ -355,7 +371,11 @@ def _run_fabric_rung(timeout: float | None) -> dict:
         "platform": os.environ.get("FABRIC_HASHER", "cpu"),
         "batch": None,
         "measured_at_utc": _utcnow(),
+        # subprocess rung: the parent's own ledger stays null, but the
+        # per-worker breakdowns (and the fleet verdict) ride along
         "ledger": None,
+        "per_process": per_process,
+        "fleet_bottleneck": fleet_bottleneck,
     }
 
 

@@ -13,7 +13,8 @@ One multiplexed entry point over the whole framework::
     torrent-tpu fabric-verify TORRENTS_DIR DATA_ROOT
                          [--coordinator HOST:PORT --num-processes N --process-id I]
                          [--cpu-devices K] [--heartbeat-dir DIR] [--hasher cpu|tpu]
-    torrent-tpu top      [--url URL] [--interval S] [--once]
+                         [--obs-port P] [--fault-plan SPEC]
+    torrent-tpu top      [--url URL] [--interval S] [--once] [--fleet]
     torrent-tpu bench    [smoke|v2|fabric|flagship] [--compare] [--bank]
                          [--trajectory FILE] [--tolerance F] [--report-only]
 
@@ -954,11 +955,28 @@ async def _fabric_verify(args) -> int:
         return 1
 
     from torrent_tpu.fabric import FabricConfig
+    from torrent_tpu.obs.attrib import attribute
+    from torrent_tpu.obs.ledger import pipeline_ledger
     from torrent_tpu.parallel.bulk import verify_library_fabric
-    from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
+    from torrent_tpu.sched import FaultPlan, HashPlaneScheduler, SchedulerConfig
 
+    plane_factory = None
+    if args.fault_plan:
+        # deterministic chaos, same spec language as the bridge and
+        # doctor (sched/faults.py) — e.g. latency_ms throttles h2d so
+        # doctor --fleet can prove cross-process bottleneck attribution
+        try:
+            plane_factory = FaultPlan.parse(args.fault_plan).plane_factory(
+                hasher=args.hasher
+            )
+        except ValueError as e:
+            print(f"error: bad --fault-plan: {e}", file=sys.stderr)
+            return 2
     sched = await HashPlaneScheduler(
-        SchedulerConfig(batch_target=args.batch_target), hasher=args.hasher
+        SchedulerConfig(
+            batch_target=args.batch_target, plane_factory=plane_factory
+        ),
+        hasher=args.hasher,
     ).start()
     cfg = FabricConfig(
         heartbeat_interval=args.heartbeat_interval,
@@ -966,6 +984,23 @@ async def _fabric_verify(args) -> int:
         fault_exit_after_units=args.die_after_units,
     )
     executors: list = []
+    obs_server = None
+    if args.obs_port is not None:
+        # the worker's live observability surface: GET /v1/fleet (this
+        # process's swarm rollup) + GET /metrics, so `top --fleet` and
+        # doctor --fleet can watch the sweep from a peer's point of view
+        from torrent_tpu.obs.fleet import FleetObsServer
+
+        obs_server = await FleetObsServer(
+            lambda: executors[0] if executors else None, sched
+        ).start(args.obs_port)
+        print(f"obs server on 127.0.0.1:{obs_server.port}", file=sys.stderr)
+        if args.obs_port_file:
+            tmp = args.obs_port_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(obs_server.port))
+            os.replace(tmp, args.obs_port_file)
+    led_prev = pipeline_ledger().snapshot()
     try:
         res = await verify_library_fabric(
             items,
@@ -978,7 +1013,10 @@ async def _fabric_verify(args) -> int:
             executor_out=executors,
         )
     finally:
+        if obs_server is not None:
+            obs_server.close()
         await sched.close()
+    led_rep = attribute(pipeline_ledger().snapshot(), prev=led_prev)
     snap = executors[0].metrics_snapshot()
     payload = {
         "pid": snap["pid"],
@@ -998,6 +1036,16 @@ async def _fabric_verify(args) -> int:
         "sentinel_mismatches": snap["sentinel_mismatches"],
         "stragglers": snap["stragglers"],
         "seconds": res.seconds,
+        # this process's pipeline-ledger breakdown (bench fabric embeds
+        # these per worker) and its final view of the fleet — which peer
+        # limited the sweep, and which stage inside it
+        "ledger": {
+            "wall_s": led_rep["wall_s"],
+            "stages": led_rep["stages"],
+            "bottleneck": led_rep["bottleneck"],
+            "overlap": led_rep.get("overlap"),
+        },
+        "fleet": executors[0].fleet_snapshot(),
     }
     line = json.dumps(payload)
     if args.result_file:
@@ -1165,6 +1213,8 @@ def _cmd_doctor(args) -> int:
         argv.append("--v2")
     if getattr(args, "fabric", False):
         argv.append("--fabric")
+    if getattr(args, "fleet", False):
+        argv.append("--fleet")
     if getattr(args, "lint", False):
         argv.append("--lint")
     if getattr(args, "trace", False):
@@ -1182,6 +1232,8 @@ def _cmd_top(args) -> int:
     argv = ["--url", args.url, "--interval", str(args.interval)]
     if args.once:
         argv.append("--once")
+    if getattr(args, "fleet", False):
+        argv.append("--fleet")
     return top_main(argv)
 
 
@@ -1802,6 +1854,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="work-unit size bound in MiB (0 = default 64)")
     sp.add_argument("--result-file", default=None,
                     help="also write the JSON result line here (atomic)")
+    sp.add_argument("--obs-port", type=int, default=None, metavar="PORT",
+                    help="serve GET /v1/fleet + /metrics on this loopback "
+                    "port while the sweep runs (0 = ephemeral) — the "
+                    "surface `torrent-tpu top --fleet` and doctor "
+                    "--fleet watch")
+    sp.add_argument("--obs-port-file", default=None, metavar="FILE",
+                    help="write the bound obs-server port here (atomic; "
+                    "for --obs-port 0 callers)")
+    sp.add_argument("--fault-plan", default=None, metavar="SPEC",
+                    help="inject deterministic hash-plane faults "
+                    "(sched/faults.py spec, e.g. 'latency_ms=200' to "
+                    "throttle h2d); doctor --fleet uses this to prove "
+                    "cross-process bottleneck attribution")
     # deterministic worker-death injection for doctor --fabric / tests
     sp.add_argument("--die-after-units", type=int, default=None,
                     help=argparse.SUPPRESS)
@@ -1872,6 +1937,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also run the verify-fabric self-test: two local "
                     "worker processes plan/execute/heartbeat, one dies "
                     "mid-run, the survivor adopts its shard")
+    sp.add_argument("--fleet", action="store_true",
+                    help="also run the fleet-observability smoke: two "
+                    "workers, one h2d-throttled; the healthy peer's "
+                    "/v1/fleet must name the throttled process (and its "
+                    "h2d stage) as the fleet bottleneck")
     sp.add_argument("--lint", action="store_true",
                     help="also run the analysis-plane smoke: all four "
                     "static passes clean against the committed baseline")
@@ -1894,6 +1964,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="refresh seconds (default %(default)s)")
     sp.add_argument("--once", action="store_true",
                     help="print one frame and exit")
+    sp.add_argument("--fleet", action="store_true",
+                    help="render the swarm-wide fleet view (/v1/fleet: "
+                    "straggler scoreboard + limiting process/stage) "
+                    "instead of the local pipeline ledger")
     sp.set_defaults(fn=_cmd_top)
 
     sp = sub.add_parser(

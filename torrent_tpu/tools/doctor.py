@@ -613,6 +613,142 @@ def _fabric_smoke(tmp: str) -> str:
     )
 
 
+def _fleet_smoke(tmp: str) -> str:
+    """Fleet-observability self-test (``--fleet``): two real
+    fabric-verify worker subprocesses over the shared-directory
+    heartbeat, worker 0 fault-throttled with a ``latency_ms`` plan (the
+    slow-interconnect model, accounted to its h2d ledger stage) and
+    worker 1 serving its live obs surface (``--obs-port``). Worker 1's
+    ``/v1/fleet`` — the heartbeat-carried digests merged by
+    obs/fleet — must name worker 0 as the fleet's limiting process and
+    ``h2d`` as its limiting stage: cross-process bottleneck
+    attribution proven deterministically on CPU, from the PEER's point
+    of view. Also exercises the ``top --fleet`` renderer on the live
+    payload."""
+    import json
+    import urllib.request
+
+    import numpy as np
+
+    from torrent_tpu.tools.make_torrent import make_torrent
+    from torrent_tpu.tools.top import render_fleet
+
+    plen = 16384
+    rng = np.random.default_rng(17)
+    tdir = os.path.join(tmp, "torrents")
+    ddir = os.path.join(tmp, "data")
+    os.makedirs(tdir)
+    # 96 + 160 pieces at 16 KiB = 5 one-MiB work units across 2 workers
+    for t, npieces in enumerate((96, 160)):
+        root = os.path.join(ddir, f"fleet{t}")
+        os.makedirs(root)
+        payload = os.path.join(root, "payload.bin")
+        with open(payload, "wb") as f:
+            f.write(
+                rng.integers(
+                    0, 256, (npieces - 1) * plen + plen // 3, dtype=np.uint8
+                ).tobytes()
+            )
+        with open(os.path.join(tdir, f"fleet{t}.torrent"), "wb") as f:
+            f.write(
+                make_torrent(payload, "http://t.invalid/announce", piece_length=plen)
+            )
+    hb = os.path.join(tmp, "hb")
+    port_file = os.path.join(tmp, "obs_port")
+    env = dict(os.environ)
+    env.pop(_AXON_VAR, None)  # workers must never register a device plugin
+    env["JAX_PLATFORMS"] = "cpu"
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    workers = []
+    for p in range(2):
+        cmd = [
+            sys.executable, "-m", "torrent_tpu", "fabric-verify", tdir, ddir,
+            "--hasher", "cpu", "--num-processes", "2", "--process-id", str(p),
+            "--heartbeat-dir", hb, "--heartbeat-interval", "0.1",
+            "--lapse-after", "30", "--unit-mb", "1", "--batch-target", "16",
+            "--result-file", os.path.join(tmp, f"result_{p}.json"),
+        ]
+        if p == 0:
+            # worker 0 is the designated straggler: every launch's h2d
+            # sleeps 250 ms, so its shard dominates the sweep's wall
+            cmd += ["--fault-plan", "latency_ms=250"]
+        else:
+            cmd += ["--obs-port", "0", "--obs-port-file", port_file]
+        workers.append(
+            subprocess.Popen(
+                cmd, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+        )
+    live_fleet = None
+    live_frames = 0
+    try:
+        deadline = time.monotonic() + 180
+        port = None
+        while time.monotonic() < deadline:
+            if all(w.poll() is not None for w in workers):
+                break
+            if port is None:
+                try:
+                    with open(port_file) as f:
+                        port = int(f.read().strip())
+                except (OSError, ValueError):
+                    time.sleep(0.1)
+                    continue
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/fleet", timeout=5
+                ) as r:
+                    live_fleet = json.loads(r.read().decode())
+                    live_frames += 1
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.1)
+        for p, w in enumerate(workers):
+            _, err = w.communicate(timeout=60)
+            assert w.returncode == 0, f"worker {p} failed:\n{err[-2000:]}"
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.communicate()
+    # the deterministic check: worker 1's FINAL fleet view (the result
+    # record embeds it) must name worker 0 / h2d — the two-level verdict
+    with open(os.path.join(tmp, "result_1.json")) as f:
+        rec = json.load(f)
+    assert rec["n_valid"] == rec["n_pieces"], (
+        f"sweep left pieces unverified: {rec['n_valid']}/{rec['n_pieces']}"
+    )
+    fleet = rec.get("fleet") or {}
+    bn = fleet.get("bottleneck") or {}
+    assert bn.get("pid") == 0, (
+        f"peer view did not name the throttled worker 0 as limiting: {bn}"
+    )
+    assert bn.get("stage") == "h2d", (
+        f"peer view did not name h2d as worker 0's limiting stage: {bn}"
+    )
+    assert fleet.get("reporting", 0) == 2, f"peer digest missing: {fleet}"
+    assert fleet.get("digest_drops", 0) == 0, fleet
+    row0 = next(r for r in fleet["scoreboard"] if r["pid"] == 0)
+    assert row0.get("limiting_stage") == "h2d", row0
+    # the live surface answered while the sweep ran, and the top --fleet
+    # renderer names the same verdict from the same payload
+    assert live_frames > 0, "worker 1's /v1/fleet never answered"
+    frame = render_fleet(live_fleet)
+    assert "fleet bottleneck: process 0 (h2d)" in render_fleet(fleet), (
+        f"top --fleet rendering lost the verdict:\n{render_fleet(fleet)}"
+    )
+    return (
+        f"worker0 h2d-throttled; peer's /v1/fleet named pid 0/h2d "
+        f"({bn.get('utilization', 0) * 100:.0f}% util, "
+        f"{fleet['reporting']}/2 digests, {live_frames} live frames, "
+        f"{len(frame.splitlines())}-line top frame)"
+    )
+
+
 async def _trace_smoke() -> str:
     """Observability smoke (``--trace``): a traced, fault-injected run
     must produce (a) an ordered span tree covering the ticket lifecycle
@@ -884,6 +1020,14 @@ def main(argv=None) -> int:
         "dies mid-run, the survivor adopts and sentinel-checks its shard",
     )
     ap.add_argument(
+        "--fleet",
+        action="store_true",
+        help="also run the fleet-observability smoke: two worker "
+        "processes, one h2d-throttled via latency_ms faults; the healthy "
+        "peer's /v1/fleet must name the throttled process (and its h2d "
+        "stage) as the fleet bottleneck",
+    )
+    ap.add_argument(
         "--lint",
         action="store_true",
         help="also run the analysis-plane smoke: all four static passes "
@@ -1004,6 +1148,14 @@ def main(argv=None) -> int:
                 _report("PASS", "verify fabric", detail)
             except Exception as e:
                 _report("FAIL", "verify fabric", repr(e))
+    if args.fleet:
+        with tempfile.TemporaryDirectory(prefix="doctor_fleet_") as tmp:
+            try:
+                # bounded by the poll deadline + communicate(timeout)
+                detail = _fleet_smoke(tmp)
+                _report("PASS", "fleet observability", detail)
+            except Exception as e:
+                _report("FAIL", "fleet observability", repr(e))
     try:
         asyncio.run(asyncio.wait_for(_bridge_smoke(), 30))
         _report("PASS", "bridge", "/v1/digests round-trip")

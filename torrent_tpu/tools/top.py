@@ -18,6 +18,16 @@ concurrent reader threads) accumulate more busy-seconds than wall
 seconds — that is occupancy, not an error. ``--once`` prints a single
 frame and exits (scripting/tests); the rendering is a pure function of
 the JSON payload, so it is unit-testable without a bridge.
+
+``--fleet`` switches to the fleet view: ``GET /v1/fleet`` (the bridge,
+or a fabric worker's ``--obs-port`` server) rendered as the straggler
+scoreboard plus the two-level bottleneck verdict::
+
+    torrent-tpu fleet — http://127.0.0.1:8421  2/2 reporting  1.9 GiB/s
+    pid status     units           rate   vs med  limits
+    0   ok          3/3 done   49.1 MiB/s  0.05x  h2d        *straggler*
+    1   ok          2/2 done    1.9 GiB/s  1.95x  launch
+    fleet bottleneck: process 0 (h2d) — 96% utilized, 49.1 MiB/s ...
 """
 
 from __future__ import annotations
@@ -30,7 +40,7 @@ import urllib.request
 
 from torrent_tpu.obs.attrib import format_rate as _fmt_rate
 
-__all__ = ["fetch_pipeline", "render_top", "main"]
+__all__ = ["fetch_fleet", "fetch_pipeline", "render_fleet", "render_top", "main"]
 
 BAR_WIDTH = 26
 
@@ -39,6 +49,14 @@ def fetch_pipeline(url: str, timeout: float = 10.0) -> dict:
     """One ``GET /v1/pipeline`` read. Raises OSError-family on failure."""
     with urllib.request.urlopen(
         url.rstrip("/") + "/v1/pipeline", timeout=timeout
+    ) as r:
+        return json.loads(r.read().decode())
+
+
+def fetch_fleet(url: str, timeout: float = 10.0) -> dict:
+    """One ``GET /v1/fleet`` read. Raises OSError-family on failure."""
+    with urllib.request.urlopen(
+        url.rstrip("/") + "/v1/fleet", timeout=timeout
     ) as r:
         return json.loads(r.read().decode())
 
@@ -116,6 +134,70 @@ def render_top(payload: dict, url: str = "") -> str:
     return "\n".join(lines)
 
 
+def render_fleet(payload: dict, url: str = "") -> str:
+    """Render one fleet frame from a ``/v1/fleet`` payload (pure).
+
+    The straggler scoreboard (per-pid status, units, achieved rate vs
+    the fleet median, limiting stage) plus the two-level bottleneck
+    verdict: which PROCESS limits the fleet, and which STAGE inside it.
+    """
+    rows = [r for r in payload.get("scoreboard") or [] if isinstance(r, dict)]
+    totals = payload.get("totals") or {}
+    lines = []
+    head = "torrent-tpu fleet"
+    if url:
+        head += f" — {url}"
+    head += (
+        f"  {payload.get('reporting', 0)}/{payload.get('nproc', 0)} reporting"
+    )
+    if totals.get("fleet_bps"):
+        head += f"  fleet {_fmt_rate(totals['fleet_bps'])}"
+    if payload.get("state"):
+        head += f"  [{payload['state']}]"
+    lines.append(head)
+    if not rows:
+        lines.append("fleet idle: no process digests held yet")
+    else:
+        lines.append(
+            f"{'pid':>3s} {'status':10s} {'units':>14s} {'rate':>10s} "
+            f"{'vs med':>7s}  limits"
+        )
+        for r in rows:
+            units = f"{r.get('units_done', 0)}/{r.get('units_planned', 0)} done"
+            if r.get("units_adopted"):
+                units += f" +{r['units_adopted']}a"
+            if r.get("adoption_debt"):
+                units += f" (debt {r['adoption_debt']})"
+            vs = r.get("vs_median")
+            line = (
+                f"{r.get('pid', 0):>3} {r.get('status', '?'):10s} "
+                f"{units:>14s} {_fmt_rate(r.get('achieved_bps')):>10s} "
+                f"{(f'{vs:.2f}x' if vs is not None else '—'):>7s}  "
+                f"{r.get('limiting_stage') or '—'}"
+            )
+            if r.get("straggler"):
+                line += "  *straggler*"
+            lines.append(line)
+    bn = payload.get("bottleneck")
+    if bn:
+        line = (
+            f"fleet bottleneck: process {bn.get('pid')} "
+            f"({bn.get('stage')}) — {bn.get('utilization', 0) * 100:.0f}% "
+            f"utilized, {_fmt_rate(bn.get('achieved_bps'))} achieved"
+        )
+        if bn.get("fleet_median_bps"):
+            line += f" vs fleet median {_fmt_rate(bn['fleet_median_bps'])}"
+        if bn.get("headroom"):
+            line += f" ({bn['headroom']}x headroom)"
+        lines.append(line)
+    if payload.get("digest_drops"):
+        lines.append(
+            f"digest drops: {payload['digest_drops']} heartbeat(s) shed "
+            "their obs digest (payload over the transport buffer)"
+        )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -135,16 +217,29 @@ def main(argv=None) -> int:
         "--once", action="store_true",
         help="print one frame and exit (no screen clearing)",
     )
+    ap.add_argument(
+        "--fleet", action="store_true",
+        help="render the swarm-wide fleet view (GET /v1/fleet: straggler "
+        "scoreboard + limiting process/stage) instead of the local "
+        "pipeline ledger",
+    )
     args = ap.parse_args(argv)
+    route = "/v1/fleet" if args.fleet else "/v1/pipeline"
     try:
         while True:
             try:
-                payload = fetch_pipeline(args.url)
+                payload = (
+                    fetch_fleet(args.url) if args.fleet
+                    else fetch_pipeline(args.url)
+                )
             except (OSError, ValueError) as e:
-                print(f"error: cannot reach {args.url}/v1/pipeline: {e}",
+                print(f"error: cannot reach {args.url}{route}: {e}",
                       file=sys.stderr)
                 return 1
-            frame = render_top(payload, url=args.url)
+            frame = (
+                render_fleet(payload, url=args.url) if args.fleet
+                else render_top(payload, url=args.url)
+            )
             if args.once:
                 print(frame)
                 return 0

@@ -263,6 +263,122 @@ def render_fabric_metrics(snapshot: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+# per-pid series cap for the fleet rendering: a pod bigger than this
+# folds the tail pids into one pid="overflow" aggregate, so /metrics
+# cardinality is bounded no matter how wide the fleet plans
+MAX_FLEET_PIDS = 16
+
+_FLEET_STATUSES = ("ok", "unreported", "degraded", "lapsed", "distrusted")
+
+
+def render_fleet_metrics(rollup: dict) -> str:
+    """Prometheus rendering of a fleet rollup (``obs/fleet.
+    aggregate_fleet`` / ``FabricExecutor.fleet_snapshot``).
+
+    Appended to both ``/metrics`` endpoints while a fleet view exists.
+    Bounded pid cardinality: the first :data:`MAX_FLEET_PIDS` scoreboard
+    rows (pid order) get per-pid series; the rest fold into a single
+    ``pid="overflow"`` aggregate (summed units/rates — a bounded scrape
+    beats per-pid fidelity past the cap). Defensive against partial
+    rollups: missing keys render as 0, never a crash mid-scrape."""
+    s = rollup or {}
+    rows = [r for r in s.get("scoreboard") or [] if isinstance(r, dict)]
+    named = rows[:MAX_FLEET_PIDS]
+    folded = rows[MAX_FLEET_PIDS:]
+    bn = s.get("bottleneck") or {}
+    totals = s.get("totals") or {}
+    status_counts = {st: 0 for st in _FLEET_STATUSES}
+    for r in rows:
+        status_counts[r.get("status") or "unreported"] = (
+            status_counts.get(r.get("status") or "unreported", 0) + 1
+        )
+    lines = [
+        "# HELP torrent_tpu_fleet_processes Processes the fabric plan spans",
+        "# TYPE torrent_tpu_fleet_processes gauge",
+        f"torrent_tpu_fleet_processes {s.get('nproc', 0)}",
+        "# HELP torrent_tpu_fleet_reporting Processes whose obs digest this view holds",
+        "# TYPE torrent_tpu_fleet_reporting gauge",
+        f"torrent_tpu_fleet_reporting {s.get('reporting', 0)}",
+        "# HELP torrent_tpu_fleet_status Scoreboard processes by heartbeat status",
+        "# TYPE torrent_tpu_fleet_status gauge",
+    ]
+    for st in _FLEET_STATUSES:
+        lines.append(
+            f'torrent_tpu_fleet_status{{status="{st}"}} {status_counts.get(st, 0)}'
+        )
+    lines += [
+        "# HELP torrent_tpu_fleet_median_bps Fleet median achieved pipeline bytes/s",
+        "# TYPE torrent_tpu_fleet_median_bps gauge",
+        "torrent_tpu_fleet_median_bps "
+        f"{bn.get('fleet_median_bps') or (totals.get('fleet_bps') or 0.0)}",
+        "# HELP torrent_tpu_fleet_bps Summed achieved pipeline bytes/s across reporting processes",
+        "# TYPE torrent_tpu_fleet_bps gauge",
+        f"torrent_tpu_fleet_bps {totals.get('fleet_bps') or 0.0}",
+        "# HELP torrent_tpu_fleet_limiting_process The fleet's limiting process and its limiting stage (1 = current verdict)",
+        "# TYPE torrent_tpu_fleet_limiting_process gauge",
+    ]
+    if bn.get("stage") is not None:
+        lines.append(
+            "torrent_tpu_fleet_limiting_process"
+            f'{{pid="{bn.get("pid", 0)}",stage="{_esc(str(bn["stage"]))}"}} 1'
+        )
+
+    def _pid_series(name, kind, help_text, get, fold=sum):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        for r in named:
+            lines.append(f'{name}{{pid="{r.get("pid", 0)}"}} {get(r)}')
+        if folded:
+            lines.append(
+                f'{name}{{pid="overflow"}} {fold(get(r) for r in folded)}'
+            )
+
+    _pid_series(
+        "torrent_tpu_fleet_pid_achieved_bps", "gauge",
+        "Achieved pipeline bytes/s per process (digest view)",
+        lambda r: r.get("achieved_bps") or 0.0,
+    )
+    _pid_series(
+        "torrent_tpu_fleet_pid_vs_median", "gauge",
+        "Achieved rate vs the fleet median (1.0 = median; stragglers < 0.5)",
+        lambda r: r.get("vs_median") or 0.0,
+        # a ratio doesn't sum: the folded tail reports its WORST member —
+        # the actionable straggler signal an alert on < 0.5 still catches
+        fold=min,
+    )
+    _pid_series(
+        "torrent_tpu_fleet_pid_adoption_debt", "gauge",
+        "Planned-but-undone units of an unavailable process that survivors must absorb",
+        lambda r: r.get("adoption_debt") or 0,
+    )
+    lines.append(
+        "# HELP torrent_tpu_fleet_pid_units Work units by disposition per process"
+    )
+    lines.append("# TYPE torrent_tpu_fleet_pid_units gauge")
+    for kind_name, key in (
+        ("planned", "units_planned"),
+        ("done", "units_done"),
+        ("adopted", "units_adopted"),
+    ):
+        for r in named:
+            lines.append(
+                "torrent_tpu_fleet_pid_units"
+                f'{{pid="{r.get("pid", 0)}",kind="{kind_name}"}} {r.get(key, 0) or 0}'
+            )
+        if folded:
+            lines.append(
+                "torrent_tpu_fleet_pid_units"
+                f'{{pid="overflow",kind="{kind_name}"}} '
+                f"{sum(r.get(key, 0) or 0 for r in folded)}"
+            )
+    lines += [
+        "# HELP torrent_tpu_fleet_digest_dropped_total Heartbeats that shed their obs digest to fit the transport buffer",
+        "# TYPE torrent_tpu_fleet_digest_dropped_total counter",
+        f"torrent_tpu_fleet_digest_dropped_total {s.get('digest_drops', 0)}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
 def render_metrics(client) -> str:
     """The /metrics payload for one Client (Prometheus text format 0.0.4).
 
@@ -341,11 +457,16 @@ class MetricsServer:
 
     ``scheduler``: optionally a hash-plane scheduler whose queue/fill/
     shed counters are appended to the session exposition, so one scrape
-    covers both the swarm and the verify queue it feeds."""
+    covers both the swarm and the verify queue it feeds.
+    ``fabric``: optionally a running ``FabricExecutor`` — its per-shard
+    gauges AND its fleet rollup (``torrent_tpu_fleet_*``) join the same
+    exposition, so the session endpoint carries the swarm-wide view just
+    like the bridge's does."""
 
-    def __init__(self, client, host: str = "127.0.0.1", scheduler=None):
+    def __init__(self, client, host: str = "127.0.0.1", scheduler=None, fabric=None):
         self.client = client
         self.scheduler = scheduler
+        self.fabric = fabric
         self.host = host
         self.port: int | None = None
         self._server: asyncio.AbstractServer | None = None
@@ -380,6 +501,9 @@ class MetricsServer:
                 text = render_metrics(self.client)
                 if self.scheduler is not None:
                     text += render_sched_metrics(self.scheduler)
+                if self.fabric is not None:
+                    text += render_fabric_metrics(self.fabric.metrics_snapshot())
+                    text += render_fleet_metrics(self.fabric.fleet_snapshot())
                 from torrent_tpu.obs import render_obs_metrics
 
                 text += render_obs_metrics()

@@ -74,7 +74,14 @@ def _normalize(rec: dict, artifact: str) -> dict:
     }
     for key in ("shape", "like_for_like", "provenance", "pre_median_contract",
                 "replayed", "status", "n_runs", "spread", "end_to_end_pps",
-                "h2d_mib_s", "rung", "ledger"):
+                "h2d_mib_s", "rung", "ledger",
+                # the controller A/B record schema (bench controller):
+                # both sides of the A/B, the throttle that framed it,
+                # and the decision trail that produced the win — banked
+                # WITH the rate so the regression gate stays auditable
+                "ab", "decision", "fault",
+                # the comparator's full like-for-like shape key
+                "piece_kb", "bytes", "nproc"):
         if key in rec:
             out[key] = rec[key]
     return out

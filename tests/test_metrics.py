@@ -286,14 +286,20 @@ class TestRendererEdgeCases:
 
     def test_full_exposition_concatenation_lints(self):
         """What the bridge actually serves: sched + fabric + fleet +
-        obs (incl. the pipeline ledger) + tsan in one payload must
-        still have unique series and complete headers."""
+        control + obs (incl. the pipeline ledger) + tsan in one payload
+        must still have unique series and complete headers."""
         from torrent_tpu.analysis import sanitizer
         from torrent_tpu.obs import render_obs_metrics
         from torrent_tpu.obs.fleet import local_fleet_snapshot
         from torrent_tpu.obs.ledger import pipeline_ledger
-        from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
+        from torrent_tpu.sched import (
+            ControlConfig,
+            HashPlaneScheduler,
+            SchedulerAutopilot,
+            SchedulerConfig,
+        )
         from torrent_tpu.utils.metrics import (
+            render_control_metrics,
             render_fabric_metrics,
             render_fleet_metrics,
             render_sched_metrics,
@@ -302,16 +308,19 @@ class TestRendererEdgeCases:
 
         pipeline_ledger().record("read", 1024, 0.01)  # ledger series live
         sched = HashPlaneScheduler(SchedulerConfig(), hasher="cpu")
+        pilot = SchedulerAutopilot(sched, ControlConfig())
         text = (
             render_sched_metrics(sched)
             + render_fabric_metrics({"pid": 0})
             + render_fleet_metrics(local_fleet_snapshot(sched))
+            + render_control_metrics(pilot.metrics_snapshot())
             + render_obs_metrics()
             + render_tsan_metrics(sanitizer.TsanState().snapshot())
         )
         prom_lint(text)
         assert "torrent_tpu_pipeline_stage_busy_seconds_total" in text
         assert "torrent_tpu_fleet_reporting 1" in text
+        assert "torrent_tpu_control_enabled 1" in text
 
 
 class TestLiveScrape:

@@ -50,10 +50,28 @@ SCOPE: dict[str, frozenset[str]] = {
         {
             "_heartbeat_once",
             "_build_obs_digest",
+            "_rebalance_offers",
             "bitfields",
             "pack_bits",
             "unpack_bits",
             "plan_payload_bytes",
+        }
+    ),
+    # the scheduler autopilot's decision core: decisions are pure
+    # functions of snapshot deltas — the same sequence of snapshots
+    # must always produce the same sequence of actuator moves (and the
+    # rebalance offers ride the heartbeat exchange), so the decision
+    # functions are held to the exchanged-bytes rules
+    "sched/control.py": frozenset(
+        {
+            "decide",
+            "build_inputs",
+            "initial_state",
+            "decision_summary",
+            "_confirmed_stage",
+            "_lane_decisions",
+            "_admission_decision",
+            "_backend_decisions",
         }
     ),
     # span context carried in fabric heartbeat payloads: the obs plane's

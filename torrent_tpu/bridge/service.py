@@ -21,6 +21,11 @@ codec every BitTorrent client already has:
                        stage limits the pipeline, achieved vs demanded
                        rate (obs/ledger + obs/attrib; `torrent-tpu top`
                        renders this live)
+  GET  /v1/control   → JSON: the scheduler autopilot's last decision,
+                       the inputs it saw, and every actuator's current
+                       value (sched/control.py; `--autopilot` arms
+                       actuation, otherwise the route reports the
+                       controller as absent)
 
 Every request runs under a trace span: an ``X-Trace-Id`` request header
 is honored (well-formed tokens only) or a fresh id is minted, the id is
@@ -130,7 +135,8 @@ log = get_logger("bridge")
 _KNOWN_ROUTES = frozenset(
     {
         "/v1/digests", "/v1/verify", "/v1/info", "/v1/trace", "/metrics",
-        "/v1/pipeline", "/v1/fleet", "/v1/fabric/verify", "/v1/fabric/status",
+        "/v1/pipeline", "/v1/fleet", "/v1/control",
+        "/v1/fabric/verify", "/v1/fabric/status",
         "/v1/stream/digests", "/v1/stream/verify",
     }
 )
@@ -235,12 +241,18 @@ class BridgeServer:
         tenant_max_mb: int = 128,
         fault_plan: FaultPlan | str | None = None,
         sha256_backend: str | None = None,
+        autopilot=None,
     ):
         self.host = host
         self.port = port
         self.hasher = hasher
         self._server: asyncio.AbstractServer | None = None
         self.sched: HashPlaneScheduler | None = None
+        # scheduler autopilot (sched/control.py): True = default
+        # ControlConfig, a ControlConfig instance = custom knobs,
+        # None/False = no controller (bit-identical static behavior)
+        self._autopilot_cfg = autopilot
+        self.autopilot = None
         # /v1/info device count, probed off-loop in the background by
         # start(): jax.devices() can block for minutes behind a wedged
         # device tunnel and must never run on the serving loop (the
@@ -272,6 +284,15 @@ class BridgeServer:
         self.sched = await HashPlaneScheduler(
             self._sched_config, hasher=self.hasher
         ).start()
+        if self._autopilot_cfg:
+            from torrent_tpu.sched.control import ControlConfig, SchedulerAutopilot
+
+            cfg = (
+                self._autopilot_cfg
+                if isinstance(self._autopilot_cfg, ControlConfig)
+                else ControlConfig()
+            )
+            self.autopilot = SchedulerAutopilot(self.sched, cfg).start()
 
         def _count_devices() -> int:
             import jax
@@ -315,6 +336,8 @@ class BridgeServer:
                 await self._fabric["task"]
             except (asyncio.CancelledError, Exception):
                 pass
+        if self.autopilot is not None:
+            await self.autopilot.close()
         if self.sched is not None:
             await self.sched.close()
 
@@ -559,6 +582,10 @@ class BridgeServer:
                 # the swarm-wide view: this process's fleet rollup from
                 # its own + heartbeat-carried peer digests
                 text += render_fleet_metrics(ex.fleet_snapshot())
+            if self.autopilot is not None:
+                from torrent_tpu.utils.metrics import render_control_metrics
+
+                text += render_control_metrics(self.autopilot.metrics_snapshot())
             text += render_obs_metrics()
             from torrent_tpu.analysis import sanitizer
 
@@ -578,6 +605,8 @@ class BridgeServer:
             return await self._pipeline_route(writer)
         if method == "GET" and target.split("?")[0] == "/v1/fleet":
             return await self._fleet_route(writer)
+        if method == "GET" and target.split("?")[0] == "/v1/control":
+            return await self._control_route(writer)
         if method == "GET" and target == "/v1/fabric/status":
             return await self._reply(writer, 200, bencode(self._fabric_status()))
         if method != "POST":
@@ -783,6 +812,11 @@ class BridgeServer:
             {
                 "attribution": attribute(snap),
                 "snapshot": snap,
+                # autopilot view for `torrent-tpu top`'s decision line
+                # (null when no controller is attached)
+                "control": (
+                    self.autopilot.status() if self.autopilot is not None else None
+                ),
                 "sched": {
                     "queue_pieces": sched_snap.get("queue_pieces", 0),
                     "queue_bytes": sched_snap.get("queue_bytes", 0),
@@ -817,6 +851,24 @@ class BridgeServer:
         else:
             roll = local_fleet_snapshot(self.sched)
         body = json.dumps(roll, sort_keys=True).encode()
+        return await self._reply(
+            writer, 200, body, content_type="application/json"
+        )
+
+    async def _control_route(self, writer):
+        """``GET /v1/control`` — the scheduler autopilot's surface.
+
+        Last decision (bottleneck verdict + actions), the applied
+        actuator moves, the inputs the decision saw, and every
+        actuator's current value. Always answers: with no autopilot
+        attached it reports ``attached: false`` so operators can tell
+        "controller off" from "bridge down". JSON with sorted keys;
+        pure in-memory reads, safe on the serving loop."""
+        if self.autopilot is None:
+            payload: dict = {"attached": False, "enabled": False, "decision": None}
+        else:
+            payload = {"attached": True, **self.autopilot.status()}
+        body = json.dumps(payload, sort_keys=True).encode()
         return await self._reply(
             writer, 200, body, content_type="application/json"
         )
@@ -935,6 +987,18 @@ def main(argv=None):  # pragma: no cover - manual entrypoint
         "TORRENT_TPU_SHA256_BACKEND env, then auto",
     )
     parser.add_argument(
+        "--autopilot", action="store_true",
+        help="arm the scheduler autopilot (sched/control.py): adaptive "
+        "lane batch targets/flush deadlines, admission budgets that "
+        "follow the limiting stage, and hysteresis-guarded backend "
+        "steering, driven by the pipeline ledger's attribution. "
+        "GET /v1/control serves the decisions either way",
+    )
+    parser.add_argument(
+        "--autopilot-interval", type=float, default=1.0, metavar="S",
+        help="seconds between controller decisions (default %(default)s)",
+    )
+    parser.add_argument(
         "--fault-plan", default=None, metavar="SPEC",
         help="inject deterministic hash-plane faults (sched/faults.py spec, "
         "e.g. 'fail_first=3;latency_ms=5'); dev/test mode only",
@@ -965,6 +1029,12 @@ def main(argv=None):  # pragma: no cover - manual entrypoint
             print(f"error: bad --fault-plan: {e}", file=sys.stderr)
             return 2
 
+    autopilot = None
+    if args.autopilot:
+        from torrent_tpu.sched.control import ControlConfig
+
+        autopilot = ControlConfig(interval_s=args.autopilot_interval)
+
     async def go():
         server = await serve_bridge(
             args.host,
@@ -976,6 +1046,7 @@ def main(argv=None):  # pragma: no cover - manual entrypoint
             tenant_max_mb=args.tenant_max_mb,
             fault_plan=fault_plan,
             sha256_backend=args.sha256_backend,
+            autopilot=autopilot,
         )
         print(f"bridge listening on {args.host}:{server.port}")
         await server.wait_closed()

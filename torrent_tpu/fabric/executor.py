@@ -116,6 +116,15 @@ class FabricConfig:
     # the payload cost is budgeted into plan_payload_bytes; disable only
     # to shrink heartbeats on an extremely constrained transport
     carry_obs_digest: bool = True
+    # scheduler-autopilot work rebalancing (sched/control.py closes the
+    # observe→act loop; this is its fleet-level actuator): when the
+    # fleet rollup names THIS process a straggler for rebalance_after
+    # consecutive heartbeats, its unstarted units are offered to peers
+    # with headroom over the heartbeat channel — the same yield/reclaim
+    # and sentinel/distrust machinery the degraded path uses, so
+    # rebalancing cannot weaken the trust model
+    rebalance: bool = False
+    rebalance_after: int = 3
     # TEST/FAULT HOOK (doctor --fabric, tests/test_fabric.py): publish a
     # final heartbeat then hard-exit the process after this many units
     # complete — the deterministic stand-in for a worker dying mid-run.
@@ -335,6 +344,12 @@ class FabricExecutor:
         # superseded rejection
         self._superseded: set[tuple[int, int]] = set()
         self._yielded: dict[int, float] = {}  # uid -> yield time
+        # autopilot rebalancing: unstarted units currently OFFERED to
+        # peers with headroom (rides the heartbeat "offer" field; every
+        # offered uid is also in _yielded so the reclaim path takes it
+        # back if nobody adopts)
+        self._offered: set[int] = set()
+        self._straggler_streak = 0
         self._warned_straggler: set[int] = set()
         self._unit_started: dict[int, float] = {}
         self._unit_times: list[float] = []
@@ -344,6 +359,8 @@ class FabricExecutor:
         self._seq = 0
         self._units_done = 0
         self._units_adopted = 0
+        self._units_offered = 0
+        self._units_rebalanced = 0  # adopted specifically from an offer
         self._pieces_verified = 0
         self._sentinel_checks = 0
         self._sentinel_mismatches = 0
@@ -610,6 +627,7 @@ class FabricExecutor:
 
     async def _heartbeat_once(self) -> None:
         self._refresh_degraded()
+        self._update_rebalance()
         self._seq += 1
         own = self._own_bits()
         payload = {
@@ -628,6 +646,10 @@ class FabricExecutor:
             "redone": sorted(
                 u for p, u in self._superseded if p == self.pid
             ),
+            # autopilot rebalancing: unstarted units this (straggling)
+            # process offers to peers with headroom (empty unless the
+            # rebalance actuator is on and the straggler streak fired)
+            "offer": sorted(self._offered),
         }
         if self.config.carry_obs_digest:
             payload["obs"] = self._build_obs_digest()
@@ -663,6 +685,69 @@ class FabricExecutor:
         await self._merge_and_adopt()
         self._check_stragglers()
         return True
+
+    @staticmethod
+    def _scoreboard_rows(rollup: dict) -> dict[int, dict]:
+        """pid -> scoreboard row of a fleet rollup (shared by the
+        straggler-streak gate and the offer law, so the two can never
+        diverge on which rows count)."""
+        return {
+            int(r["pid"]): r
+            for r in rollup.get("scoreboard") or []
+            if isinstance(r, dict) and "pid" in r
+        }
+
+    def _rebalance_offers(self, rollup: dict) -> list[int]:
+        """Unstarted units this process should offer to peers, given a
+        fleet rollup (``fleet_snapshot``): everything still PENDING in
+        our queue, but only when the scoreboard names us a straggler
+        AND at least one healthy non-straggler peer exists to absorb
+        the work. Pure function of the rollup + local queue state (the
+        analysis determinism pass holds it to the heartbeat rules)."""
+        rows = self._scoreboard_rows(rollup)
+        me = rows.get(self.pid)
+        if me is None or not me.get("straggler"):
+            return []
+        if not any(
+            p != self.pid
+            and rows[p].get("status") == "ok"
+            and not rows[p].get("straggler")
+            for p in rows
+        ):
+            return []  # nobody with headroom to absorb the work
+        return sorted(
+            u for u in self._queue if self._status.get(u) == _PENDING
+        )
+
+    def _update_rebalance(self) -> None:
+        """The autopilot's fleet actuator, laggard side: after
+        ``rebalance_after`` consecutive heartbeats in which the fleet
+        rollup names this process a straggler, move every unstarted
+        unit into the offered set (and the yield/reclaim machinery, so
+        unadopted offers come back)."""
+        cfg = self.config
+        if not cfg.rebalance or self.plan.nproc <= 1 or self.transport is None:
+            return
+        roll = self.fleet_snapshot()
+        if (self._scoreboard_rows(roll).get(self.pid) or {}).get("straggler"):
+            self._straggler_streak += 1
+        else:
+            self._straggler_streak = 0
+        if self._straggler_streak < cfg.rebalance_after:
+            return  # the (queue-walking) offer law only runs past the gate
+        now = time.monotonic()
+        for uid in self._rebalance_offers(roll):
+            if uid in self._offered or uid not in self._queue:
+                continue
+            self._queue.remove(uid)
+            self._offered.add(uid)
+            self._yielded[uid] = now
+            self._units_offered += 1
+            log.warning(
+                "fabric rebalance: offering unstarted unit %d to peers "
+                "with headroom (straggler x%d heartbeats)",
+                uid, self._straggler_streak,
+            )
 
     def _peer_age(self, p: int) -> float:
         """Seconds since we LOCALLY observed this peer's seq advance —
@@ -776,21 +861,49 @@ class FabricExecutor:
         for uid, t0 in list(self._yielded.items()):
             if self._unit_covered(uid):
                 del self._yielded[uid]
+                self._offered.discard(uid)
             elif uid in inflight_elsewhere:
                 self._yielded[uid] = now  # someone is on it; keep waiting
             elif now - t0 > reclaim_after:
                 del self._yielded[uid]
+                self._offered.discard(uid)
                 self._status[uid] = _PENDING
                 self._queue.append(uid)
                 log.warning("fabric: reclaiming yielded unit %d", uid)
         # 4. adopt orphans: uncovered units whose responsible process is
         # unavailable (or whose only verdicts were distrusted), not in
-        # flight on any available peer
+        # flight on any available peer. Units OFFERED by a straggling
+        # peer (autopilot rebalancing) join the same orphan set — the
+        # adoption rule, the sentinel gate, and the distrust machinery
+        # apply to them unchanged, so rebalancing can't weaken trust.
+        offered_elsewhere: dict[int, int] = {}
+        for p, pl in sorted(self._peer_seen.items()):
+            if p in lapsed:
+                continue  # a dead peer's stale offer is plain adoption
+            for uid_s in pl.get("offer", []):
+                offered_elsewhere.setdefault(int(uid_s), p)
+        # headroom gate on the ADOPTION side too: an offered unit must
+        # move to a peer with headroom, never to another straggler —
+        # the same scoreboard rule the offer law applied
+        offer_helpers: set[int] = set()
+        if offered_elsewhere:
+            rows = self._scoreboard_rows(self.fleet_snapshot())
+            offer_helpers = {
+                p
+                for p in rows
+                if rows[p].get("status") == "ok"
+                and not rows[p].get("straggler")
+            }
         distrusted_uids = {u for _, u in self._distrust}
         for u in self.plan.units:
             uid = u.uid
             owner = self.plan.owner[uid]
-            orphan = owner in unavailable or uid in distrusted_uids
+            offerer = offered_elsewhere.get(uid)
+            orphan = (
+                owner in unavailable
+                or uid in distrusted_uids
+                or offerer is not None
+            )
             if not orphan or self._unit_covered(uid):
                 continue
             if uid in inflight_elsewhere:
@@ -799,11 +912,26 @@ class FabricExecutor:
                 continue  # we yielded it; reclaim path handles comebacks
             # never route the re-verify to a survivor whose own verdict
             # is the distrusted one — its _DONE status would skip the
-            # requeue and the sweep would never converge
+            # requeue and the sweep would never converge. The offerer is
+            # excluded too: it keeps no claim while an offer stands.
             candidates = [
-                s for s in survivors if (s, uid) not in self._distrust
+                s
+                for s in survivors
+                if (s, uid) not in self._distrust and s != offerer
             ]
-            if adoption_owner(uid, candidates or survivors) != self.pid:
+            pure_offer = (
+                offerer is not None
+                and owner not in unavailable
+                and uid not in distrusted_uids
+            )
+            if pure_offer:
+                # rebalancing (not a lapse/distrust): only peers with
+                # headroom may take the unit; with none, nobody adopts
+                # and the offerer's reclaim path takes it back
+                candidates = [s for s in candidates if s in offer_helpers]
+                if not candidates or adoption_owner(uid, candidates) != self.pid:
+                    continue
+            elif adoption_owner(uid, candidates or survivors) != self.pid:
                 continue
             if (
                 (self.pid, uid) in self._distrust
@@ -820,11 +948,18 @@ class FabricExecutor:
             self._status[uid] = _PENDING
             self._queue.append(uid)
             self._units_adopted += 1
-            log.warning(
-                "fabric: adopting unit %d from process %d (%s)",
-                uid, owner,
-                "lapsed" if owner in lapsed else "degraded/distrusted",
-            )
+            if offerer is not None and owner not in unavailable:
+                self._units_rebalanced += 1
+                log.warning(
+                    "fabric rebalance: adopting offered unit %d from "
+                    "straggler %d", uid, offerer,
+                )
+            else:
+                log.warning(
+                    "fabric: adopting unit %d from process %d (%s)",
+                    uid, owner,
+                    "lapsed" if owner in lapsed else "degraded/distrusted",
+                )
 
     async def _sentinel_check(self, uid: int, bits: np.ndarray) -> bool:
         """Re-hash one reportedly-valid piece of a foreign unit against
@@ -994,6 +1129,9 @@ class FabricExecutor:
             "shard_bytes": self.plan.shard_bytes(self.pid),
             "units_done": self._units_done,
             "units_adopted": self._units_adopted,
+            "units_offered": self._units_offered,
+            "units_rebalanced": self._units_rebalanced,
+            "rebalance_streak": self._straggler_streak,
             "pieces_verified": self._pieces_verified,
             "inflight_bytes": self._inflight_bytes,
             "sentinel_checks": self._sentinel_checks,

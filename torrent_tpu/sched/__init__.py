@@ -8,6 +8,7 @@ admission control, deadline-aware batch assembly, deficit-round-robin
 fairness, and per-launch result demux. See scheduler.py for the design.
 """
 
+from torrent_tpu.sched.control import ControlConfig, SchedulerAutopilot
 from torrent_tpu.sched.faults import (
     DeviceFaultError,
     FaultPlan,
@@ -23,6 +24,7 @@ from torrent_tpu.sched.scheduler import (
 )
 
 __all__ = [
+    "ControlConfig",
     "DeviceFaultError",
     "FaultPlan",
     "HashPlaneScheduler",
@@ -30,6 +32,7 @@ __all__ = [
     "SchedLaunchError",
     "SchedRejected",
     "SchedulerConfig",
+    "SchedulerAutopilot",
     "classify_error",
     "resolve_sha256_backend",
 ]

@@ -19,6 +19,10 @@ be provoked on demand, so they are *injected* instead. A
   host→device interconnect, which makes bottleneck attribution
   (``obs/attrib.py``, ``doctor --bottleneck``) deterministically
   testable on CPU-only hosts.
+* ``read_latency_s`` — same mechanism, accounted to the ledger's
+  ``read`` stage: the slow-storage model. This is how controller tests
+  (``sched/control.py``) deterministically make ``read`` the limiting
+  stage — the regime PR 8 predicted once H2D overlaps.
 * ``dead_after`` — every launch past the Nth raises (permanent device
   loss; the breaker must pin the lane on the CPU plane).
 
@@ -76,8 +80,12 @@ class FaultPlan:
     # deterministic: a batch containing a payload with this prefix
     # raises PoisonedPayloadError
     payload_prefix: bytes | None = None
-    # every launch sleeps this long before running (latency spike)
+    # every launch sleeps this long before running (latency spike,
+    # charged to the ledger's h2d stage — slow interconnect model)
     latency_s: float = 0.0
+    # every launch sleeps this long charged to the ledger's read stage
+    # (slow-storage model; makes `read` the limiting stage on demand)
+    read_latency_s: float = 0.0
     # permanent device loss: every launch past this ordinal raises
     dead_after: int | None = None
 
@@ -96,7 +104,8 @@ class FaultPlan:
             key, _, value = part.partition("=")
             key, value = key.strip(), value.strip()
             if key not in (
-                "fail_first", "fail_launches", "payload", "latency_ms", "dead_after"
+                "fail_first", "fail_launches", "payload", "latency_ms",
+                "read_latency_ms", "dead_after",
             ):
                 raise ValueError(f"unknown fault-plan key {key!r}")
             try:
@@ -110,6 +119,8 @@ class FaultPlan:
                     kw["payload_prefix"] = bytes.fromhex(value)
                 elif key == "latency_ms":
                     kw["latency_s"] = float(value) / 1e3
+                elif key == "read_latency_ms":
+                    kw["read_latency_s"] = float(value) / 1e3
                 elif key == "dead_after":
                     kw["dead_after"] = int(value)
             except Exception as e:  # int()/fromhex() failures with context
@@ -117,7 +128,7 @@ class FaultPlan:
         plan = cls(**kw)
         if plan.fail_first < 0 or (plan.dead_after is not None and plan.dead_after < 0):
             raise ValueError("fault-plan launch ordinals must be >= 0")
-        if plan.latency_s < 0:
+        if plan.latency_s < 0 or plan.read_latency_s < 0:
             raise ValueError("fault-plan latency must be >= 0")
         if plan.payload_prefix is not None and not plan.payload_prefix:
             # b"" startswith-matches every payload: a typo'd "payload="
@@ -191,6 +202,16 @@ class FaultyPlane:
         with self._lock:
             self.launches += 1
             n = self.launches
+        if plan.read_latency_s:
+            from torrent_tpu.obs.ledger import pipeline_ledger
+
+            # slow-storage model: the sleep is charged to the ledger's
+            # read stage, so `read` becomes the limiting stage on demand
+            # (controller tests; the sleep runs outside every obs lock)
+            with pipeline_ledger().track(
+                "read", sum(len(p) for p in payloads)
+            ):
+                time.sleep(plan.read_latency_s)
         if plan.latency_s:
             from torrent_tpu.obs.ledger import pipeline_ledger
 

@@ -85,6 +85,16 @@ N+1's transfer overlaps batch N's kernel. Slabs are reference counted
 ``outstanding`` gauge must return to 0 — see ARCHITECTURE.md
 "Zero-copy ingest" for ownership rules and the fallback matrix.
 
+The scheduler autopilot (``sched/control.py``) closes the observe→act
+loop over these sensors: a periodic controller turns ledger/attribution
+snapshot deltas into bounded actuator moves through the seams below —
+``set_lane_target`` / ``set_lane_deadline`` (adaptive batching, snapped
+via the planes' ``launch_geometry`` hooks), ``set_admission_factor``
+(admit no faster than the limiting stage drains), and
+``steer_lane_backend`` (hysteresis-guarded backend trials). With no
+autopilot attached every seam stays at its static default and behavior
+is bit-identical to the config.
+
 The v2 (sha256) lanes default to the hand-tiled pallas kernel
 (:class:`_Sha256PallasPlane`; ``TORRENT_TPU_SHA256_BACKEND`` /
 ``SchedulerConfig.sha256_backend`` select, lax.scan is the fallback).
@@ -333,7 +343,7 @@ class _Lane:
     __slots__ = (
         "algo", "bucket", "target", "queues", "rotation", "pending_pieces",
         "event", "task", "plane", "build_lock", "sem", "inflight",
-        "breaker", "cpu_plane", "backend",
+        "breaker", "cpu_plane", "backend", "deadline",
         "launches", "fill_sum", "pad_rows_total",
     )
 
@@ -363,6 +373,10 @@ class _Lane:
         self.breaker = breaker
         self.cpu_plane = None  # hashlib degradation plane, built lazily
         self.backend = backend  # 'cpu' | 'device' | 'scan' | 'pallas'
+        # per-lane flush-deadline override (the autopilot's actuator);
+        # None = the SchedulerConfig value, so controller-off behavior
+        # is bit-identical to the static config
+        self.deadline: float | None = None
         # per-lane observability: launch-fill and pad-row waste gauges
         self.launches = 0
         self.fill_sum = 0.0
@@ -1176,6 +1190,11 @@ class HashPlaneScheduler:
         # resolved-once sha256 backend ('pallas'/'scan'); auto-resolution
         # touches jax.devices(), which must stay off the event loop
         self._sha256_backend_resolved: str | None = None
+        # autopilot actuator (sched/control.py): fraction of the
+        # configured global admission budget currently admitted. 1.0 =
+        # the static config exactly (the comparison short-circuits, so
+        # controller-off behavior is bit-identical)
+        self._admission_factor = 1.0
 
     # ------------------------------------------------------------ admin
 
@@ -1330,6 +1349,160 @@ class HashPlaneScheduler:
         Stream ingests use it as their submission chunk so one
         submission maps to roughly one launch."""
         return self._lane_plan(algo, self.bucket_for(piece_length))[1]
+
+    # -------------------------------------------- autopilot actuators
+    # (sched/control.py — every setter is a no-op-able, bounded seam;
+    # with no autopilot attached none of these ever runs and behavior
+    # is bit-identical to the static config)
+
+    def _lane_by_key(self, lane_key: str) -> _Lane | None:
+        algo, _, bucket = lane_key.rpartition("/")
+        try:
+            return self._lanes.get((algo, int(bucket)))
+        except ValueError:
+            return None
+
+    def set_lane_target(self, lane_key: str, target: int) -> int | None:
+        """Set a lane's flush target (autopilot batch actuator).
+
+        The applied value is clamped to the staging budget and snapped
+        to what the built plane actually stages via its
+        ``launch_geometry`` hook — a pallas lane's adapted target is
+        always a tile multiple. Returns the applied target (None for an
+        unknown lane)."""
+        lane = self._lane_by_key(lane_key)
+        if lane is None:
+            return None
+        target = max(1, int(target))
+        afford = None
+        if self.hasher != "cpu":
+            from torrent_tpu.ops.padding import padded_len_for
+
+            afford = max(1, self.config.staging_budget // padded_len_for(lane.bucket))
+            target = min(target, afford)
+        hook = (
+            getattr(lane.plane, "launch_geometry", None)
+            if lane.plane is not None
+            else None
+        )
+        if hook is not None:
+            rows = int(hook(target, lane.bucket)[0])
+            if afford is not None and rows > afford:
+                # the hook snaps UP (pallas tile granule); a snap past
+                # the staging afford must round DOWN to the largest
+                # granule multiple instead — same discipline as the
+                # lane plan's `afford // SUB_TILE_ROWS * SUB_TILE_ROWS`.
+                # When even one granule doesn't fit, the budget beats
+                # the tiling and the raw afford stands.
+                granule = max(1, int(hook(1, lane.bucket)[0]))
+                rows = afford // granule * granule
+                if rows < 1:
+                    rows = afford
+            if rows >= 1:
+                target = rows
+        elif lane.backend == "pallas":
+            from torrent_tpu.ops.sha256_pallas import pad_rows_for
+
+            rows = max(1, pad_rows_for(target))
+            if afford is None or rows <= afford:
+                target = rows
+        lane.target = target
+        lane.event.set()  # re-evaluate the flush condition now
+        return lane.target
+
+    def set_lane_deadline(self, lane_key: str, seconds: float) -> float | None:
+        """Per-lane flush-deadline override (autopilot). Returns the
+        applied value (None for an unknown lane)."""
+        lane = self._lane_by_key(lane_key)
+        if lane is None:
+            return None
+        lane.deadline = max(0.001, float(seconds))
+        lane.event.set()
+        return lane.deadline
+
+    def set_admission_factor(self, factor: float) -> float:
+        """Scale the global admission budget (autopilot). 1.0 restores
+        the static config exactly; raising the factor wakes blocked
+        submitters."""
+        factor = min(1.0, max(0.01, float(factor)))
+        raised = factor > self._admission_factor
+        self._admission_factor = factor
+        if raised:
+            self._space.set()
+        return factor
+
+    def steer_lane_backend(self, lane_key: str, backend: str) -> str | None:
+        """Steer a lane to another backend (autopilot). The plane is
+        rebuilt lazily on the next launch; an in-flight launch finishes
+        on the old plane (planes are stateless). Returns the new
+        backend, or None when unknown lane / already there."""
+        if backend not in ("cpu", "device", "scan", "pallas"):
+            raise ValueError(f"unknown backend {backend!r}")
+        lane = self._lane_by_key(lane_key)
+        if lane is None or lane.backend == backend:
+            return None
+        log.info(
+            "steering lane %s backend %s -> %s", lane_key, lane.backend, backend
+        )
+        lane.backend = backend
+        lane.plane = None  # next _run_plane rebuilds under build_lock
+        return backend
+
+    def control_surface(self) -> dict:
+        """Per-lane + admission view the autopilot decides over (pure
+        reads; the controller deltas launches/fill_sum itself)."""
+        from torrent_tpu.ops.padding import padded_len_for
+
+        cfg = self.config
+        lanes: dict[str, dict] = {}
+        for (algo, bucket) in sorted(self._lanes):
+            lane = self._lanes[(algo, bucket)]
+            if self.hasher == "cpu":
+                # hashlib stages nothing: growth is bounded only by the
+                # controller's own target_max_factor law
+                afford = max(lane.target, cfg.batch_target) * 64
+            else:
+                afford = max(1, cfg.staging_budget // padded_len_for(bucket))
+            # launch granule (1 = row-exact): the controller snaps its
+            # grow cap to this so it never proposes a target the
+            # set_lane_target snap would round back down forever
+            hook = (
+                getattr(lane.plane, "launch_geometry", None)
+                if lane.plane is not None
+                else None
+            )
+            if hook is not None:
+                granule = max(1, int(hook(1, bucket)[0]))
+            elif lane.backend == "pallas":
+                from torrent_tpu.ops.sha256_pallas import SUB_TILE_ROWS
+
+                granule = SUB_TILE_ROWS
+            else:
+                granule = 1
+            lanes[f"{algo}/{bucket}"] = {
+                "algo": algo,
+                "bucket": bucket,
+                "granule": granule,
+                "target": lane.target,
+                "base_target": self._lane_plan(algo, bucket)[1],
+                "afford": afford,
+                "deadline": (
+                    lane.deadline if lane.deadline is not None else cfg.flush_deadline
+                ),
+                "base_deadline": cfg.flush_deadline,
+                "backend": lane.backend,
+                "launches": lane.launches,
+                "fill_sum": lane.fill_sum,
+                "pending": lane.pending_pieces,
+            }
+        return {
+            "lanes": lanes,
+            "admission": {
+                "factor": self._admission_factor,
+                "max_queue_bytes": cfg.max_queue_bytes,
+                "queue_bytes": self._queued_bytes,
+            },
+        }
 
     def _lane(self, algo: str, piece_length: int) -> _Lane:
         bucket = self.bucket_for(piece_length)
@@ -1525,16 +1698,29 @@ class HashPlaneScheduler:
         cfg = self.config
         tenant_limit = ts.max_bytes if ts.max_bytes is not None else cfg.max_tenant_bytes
 
+        def max_queue() -> int:
+            # the autopilot's admission actuator scales the GLOBAL budget
+            # only (per-tenant limits are policy, not control); at the 1.0
+            # default this is exactly the static config. Re-read on every
+            # evaluation: a submitter blocked under a shrunken budget must
+            # observe the recovered factor when set_admission_factor wakes
+            # it, not a bound baked in at entry.
+            factor = self._admission_factor
+            if factor < 1.0:
+                return max(1, int(cfg.max_queue_bytes * factor))
+            return cfg.max_queue_bytes
+
         def over() -> tuple[bool, int, int]:
             # The empty-queue escape exists ONLY for the blocking path: an
             # oversize submission that can never fit must be admitted once
             # the queue drains or wait=True livelocks forever. On the shed
             # path it would let one giant submission blow past both bounds
             # into an idle queue and then 429 everyone else while it drains.
-            if self._queued_bytes + nbytes > cfg.max_queue_bytes and not (
+            limit = max_queue()
+            if self._queued_bytes + nbytes > limit and not (
                 wait and self._queued_bytes == 0
             ):
-                return True, self._queued_bytes, cfg.max_queue_bytes
+                return True, self._queued_bytes, limit
             if ts.queued_bytes + nbytes > tenant_limit and not (
                 wait and ts.queued_bytes == 0
             ):
@@ -1575,8 +1761,12 @@ class HashPlaneScheduler:
                     await lane.event.wait()
                 continue
             # oldest queued item bounds the wait: flush at target fill
-            # or when its deadline expires, whichever comes first
-            deadline = lane.oldest_ts() + cfg.flush_deadline
+            # or when its deadline expires, whichever comes first (the
+            # autopilot may have set a per-lane deadline override)
+            flush_after = (
+                lane.deadline if lane.deadline is not None else cfg.flush_deadline
+            )
+            deadline = lane.oldest_ts() + flush_after
             while lane.pending_pieces < lane.target and not self._closing:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -1644,6 +1834,11 @@ class HashPlaneScheduler:
 
     def _build_plane(self, lane: _Lane):
         cfg = self.config
+        if lane.backend == "cpu" and self.hasher != "cpu":
+            # controller-steered degradation (steer_lane_backend): like
+            # the breaker's CPU fallback, this bypasses plane_factory —
+            # hashlib is the parity floor, not a wrappable device plane
+            return _CpuPlane(lane.algo)
         # the lane's planned backend is authoritative (it already folded
         # in the staging-budget fallback), so pass it explicitly rather
         # than re-resolving env/auto at build time — a factory holding
@@ -2001,6 +2196,8 @@ class HashPlaneScheduler:
         return {
             "queue_pieces": pending,
             "queue_bytes": self._queued_bytes,
+            # autopilot admission actuator (1.0 = the static config)
+            "admission_factor": self._admission_factor,
             "lanes": len(self._lanes),
             "launches": self._launches,
             "fill_sum": self._fill_sum,
@@ -2023,6 +2220,11 @@ class HashPlaneScheduler:
                 f"{algo}/{bucket}": {
                     "backend": lane.backend,
                     "target": lane.target,
+                    "deadline": (
+                        lane.deadline
+                        if lane.deadline is not None
+                        else self.config.flush_deadline
+                    ),
                     "launches": lane.launches,
                     "mean_fill": (
                         lane.fill_sum / lane.launches if lane.launches else 0.0

@@ -26,6 +26,12 @@ Rungs::
                                  # (device, BENCH_CONFIG=headline)
     torrent-tpu bench fabric     # r7 fabric scaling rung: 1/2/4-process
                                  # CPU fabric verify, median-of-3
+    torrent-tpu bench controller # scheduler-autopilot A/B: the SAME
+                                 # h2d-throttled recheck run with the
+                                 # controller off then on; the record
+                                 # banks both rates plus the decisions,
+                                 # proving the observe→act loop beats
+                                 # the static config (value = on-rate)
 
 ``--smoke`` is an alias for the smoke rung (CI spells it that way).
 Device rungs shell out to the repo's ``bench.py`` / ``.bench/
@@ -74,8 +80,12 @@ __all__ = ["compare_record", "load_trajectory", "main"]
 
 SCHEMA = "torrent-tpu-bench/1"
 TRAJECTORY_SCHEMA = "torrent-tpu-bench-trajectory/1"
-RUNGS = ("smoke", "e2e", "v2", "fabric", "flagship")
+RUNGS = ("smoke", "e2e", "v2", "fabric", "flagship", "controller")
 DEFAULT_TOLERANCE = 0.10
+# the controller rung's deterministic throttle: every launch's h2d
+# sleeps this long (sched/faults.py slow-interconnect model), so the
+# autopilot's grown batches measurably amortize the fixed cost
+CONTROLLER_FAULT = "latency_ms=25"
 
 # env the retired .bench rung scripts exported, reproduced per rung
 # (r6_sha256_rung.sh leg 2; the flagship shape from BENCH_CONFIGS_r05)
@@ -262,6 +272,129 @@ async def _e2e(
         "staging_outstanding": staging.get("outstanding"),
         "staged_checkouts": staging.get("checkouts"),
         "measured_at_utc": _utcnow(),
+        "ledger": {
+            "wall_s": rep["wall_s"],
+            "stages": rep["stages"],
+            "bottleneck": rep["bottleneck"],
+            "overlap": rep.get("overlap"),
+        },
+    }
+
+
+async def _controller_ab(total_mb: int, piece_kb: int, batch_target: int) -> dict:
+    """The scheduler-autopilot A/B rung: one shape, run twice under the
+    same deterministic h2d throttle (:data:`CONTROLLER_FAULT`) — first
+    with the static config, then with the autopilot armed. The fixed
+    per-launch transfer cost means fewer, bigger launches win; the
+    controller's batch actuator must discover that live, so
+    controller-on ≥ controller-off pieces/s is the banked proof that
+    the observe→act loop changes throughput instead of describing it."""
+    from torrent_tpu.obs.attrib import attribute
+    from torrent_tpu.obs.ledger import pipeline_ledger
+    from torrent_tpu.parallel.bulk import verify_library_sched
+    from torrent_tpu.sched import (
+        ControlConfig,
+        FaultPlan,
+        HashPlaneScheduler,
+        SchedulerAutopilot,
+        SchedulerConfig,
+    )
+
+    with tempfile.TemporaryDirectory(prefix="tt_bench_ctl_") as tmp:
+        storage, info = await asyncio.to_thread(
+            _build_smoke_torrent, tmp, total_mb, piece_kb
+        )
+
+        async def run_once(controller_on: bool):
+            led = pipeline_ledger()
+            prev = led.snapshot()
+            plan = FaultPlan.parse(CONTROLLER_FAULT)
+            sched = HashPlaneScheduler(
+                SchedulerConfig(
+                    batch_target=batch_target,
+                    flush_deadline=0.02,
+                    plane_factory=plan.plane_factory(hasher="cpu"),
+                ),
+                hasher="cpu",
+            )
+            await sched.start()
+            pilot = None
+            if controller_on:
+                pilot = SchedulerAutopilot(
+                    sched,
+                    ControlConfig(
+                        enabled=True, interval_s=0.05,
+                        hysteresis_ticks=1, cooldown_ticks=0,
+                    ),
+                ).start()
+            try:
+                t0 = time.perf_counter()
+                res = await verify_library_sched(
+                    [(storage, info)], sched, tenant="bench"
+                )
+                seconds = time.perf_counter() - t0
+            finally:
+                if pilot is not None:
+                    await pilot.close()
+                await sched.close()
+            rep = attribute(led.snapshot(), prev=prev)
+            status = pilot.status() if pilot is not None else None
+            snap = sched.metrics_snapshot()
+            return {
+                "seconds": seconds,
+                "valid": int(res.bitfields[0].sum()),
+                "launches": snap.get("launches", 0),
+                "lane_stats": snap.get("lane_stats", {}),
+                "admission_factor": snap.get("admission_factor", 1.0),
+                "rep": rep,
+                "control": status,
+            }
+
+        off = await run_once(False)
+        on = await run_once(True)
+    pieces = info.num_pieces
+    off_pps = round(pieces / off["seconds"], 1) if off["seconds"] > 0 else None
+    on_pps = round(pieces / on["seconds"], 1) if on["seconds"] > 0 else None
+    complete = off["valid"] == pieces and on["valid"] == pieces
+    control = on["control"] or {}
+    decision = control.get("decision") or {}
+    rep = on["rep"]
+    return {
+        "schema": SCHEMA,
+        "rung": "controller",
+        "metric": f"sha1_recheck_controller_ab_{piece_kb}KiB_pieces_per_sec",
+        # the headline value is the CONTROLLER-ON rate; the embedded A/B
+        # record carries both sides so the win is auditable
+        "value": on_pps if complete else None,
+        "unit": "pieces/s",
+        "pieces": pieces,
+        "bytes": info.length,
+        "batch": batch_target,
+        "piece_kb": piece_kb,
+        "platform": "cpu",
+        "plane": "cpu",
+        "nproc": os.cpu_count(),
+        "fault": CONTROLLER_FAULT,
+        "measured_at_utc": _utcnow(),
+        "ab": {
+            "controller_off_pps": off_pps,
+            "controller_on_pps": on_pps,
+            "ratio": (
+                round(on_pps / off_pps, 3) if on_pps and off_pps else None
+            ),
+            "launches_off": off["launches"],
+            "launches_on": on["launches"],
+        },
+        "decision": {
+            "ticks": control.get("tick"),
+            "bottleneck": (decision.get("bottleneck") or {}).get("stage"),
+            "actions_total": control.get("actions_total"),
+            "admission_factor": on["admission_factor"],
+            "lane_targets": {
+                lane: st.get("target")
+                for lane, st in sorted(on["lane_stats"].items())
+            },
+        },
         "ledger": {
             "wall_s": rep["wall_s"],
             "stages": rep["stages"],
@@ -484,7 +617,7 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "rung", nargs="?", choices=RUNGS,
-        help="named rung to run (smoke/e2e/v2/fabric/flagship)",
+        help="named rung to run (smoke/e2e/v2/fabric/flagship/controller)",
     )
     ap.add_argument(
         "--smoke", action="store_true",
@@ -548,8 +681,8 @@ def main(argv=None) -> int:
             return 2
         rung = "smoke"
     if rung is None and args.record is None:
-        print("error: name a rung (smoke/e2e/v2/fabric/flagship) or pass "
-              "--record FILE", file=sys.stderr)
+        print("error: name a rung (smoke/e2e/v2/fabric/flagship/controller) "
+              "or pass --record FILE", file=sys.stderr)
         return 2
 
     if args.record is not None:
@@ -569,6 +702,10 @@ def main(argv=None) -> int:
             elif rung == "e2e":
                 record = asyncio.run(
                     _e2e(args.mb, args.piece_kb, args.batch_target, args.hasher)
+                )
+            elif rung == "controller":
+                record = asyncio.run(
+                    _controller_ab(args.mb, args.piece_kb, args.batch_target)
                 )
             elif rung == "fabric":
                 record = _run_fabric_rung(args.timeout)

@@ -1221,6 +1221,8 @@ def _cmd_doctor(args) -> int:
         argv.append("--trace")
     if getattr(args, "bottleneck", False):
         argv.append("--bottleneck")
+    if getattr(args, "control", False):
+        argv.append("--control")
     if getattr(args, "json", False):
         argv.append("--json")
     return doctor_cli(argv)
@@ -1247,6 +1249,7 @@ def _cmd_bench(args) -> int:
         argv.append("--smoke")
     argv += ["--mb", str(args.mb), "--piece-kb", str(args.piece_kb),
              "--batch-target", str(args.batch_target),
+             "--hasher", args.hasher,
              "--tolerance", str(args.tolerance)]
     if args.timeout is not None:
         argv += ["--timeout", str(args.timeout)]
@@ -1565,6 +1568,8 @@ def _cmd_bridge(args) -> int:
             "--max-queue-mb", str(args.max_queue_mb),
             "--tenant-max-mb", str(args.tenant_max_mb),
         ]
+        + (["--autopilot", "--autopilot-interval", str(args.autopilot_interval)]
+           if args.autopilot else [])
         + (["--fault-plan", args.fault_plan] if args.fault_plan else [])
         + (["--dev"] if args.dev else [])
     )
@@ -1933,6 +1938,11 @@ def build_parser() -> argparse.ArgumentParser:
                     "scheduler-fed recheck attributed stage by stage; "
                     "with --faults the H2D stage is latency-throttled "
                     "and the attributor must name it")
+    sp.add_argument("--control", action="store_true",
+                    help="also run the scheduler-autopilot smoke: an "
+                    "h2d-throttled scheduler must get its lane target "
+                    "grown and its admission budget pulled toward the "
+                    "limiting stage (controller-off moves nothing)")
     sp.add_argument("--fabric", action="store_true",
                     help="also run the verify-fabric self-test: two local "
                     "worker processes plan/execute/heartbeat, one dies "
@@ -1977,7 +1987,8 @@ def build_parser() -> argparse.ArgumentParser:
         "embedded, plus the trajectory comparator",
     )
     sp.add_argument("rung", nargs="?",
-                    choices=("smoke", "v2", "fabric", "flagship"))
+                    choices=("smoke", "e2e", "v2", "fabric", "flagship",
+                             "controller"))
     sp.add_argument("--smoke", action="store_true",
                     help="alias for the smoke rung (the CI spelling)")
     sp.add_argument("--mb", type=int, default=8,
@@ -1986,6 +1997,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="smoke rung piece KiB (default %(default)s)")
     sp.add_argument("--batch-target", type=int, default=32,
                     help="smoke rung scheduler launch target")
+    sp.add_argument("--hasher", default="tpu", choices=("tpu", "cpu"),
+                    help="e2e rung hash plane (default %(default)s)")
     sp.add_argument("--timeout", type=float, default=None,
                     help="device-rung subprocess timeout seconds")
     sp.add_argument("--out", default=None, help="also write the record here")
@@ -2027,6 +2040,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="global queued-bytes bound (requests shed with 429 beyond)")
     sp.add_argument("--tenant-max-mb", type=int, default=128,
                     help="per-tenant queued-bytes bound")
+    sp.add_argument("--autopilot", action="store_true",
+                    help="arm the scheduler autopilot: adaptive lane "
+                    "targets/deadlines, limiting-stage admission budgets, "
+                    "hysteresis-guarded backend steering (GET /v1/control)")
+    sp.add_argument("--autopilot-interval", type=float, default=1.0,
+                    metavar="S",
+                    help="seconds between controller decisions "
+                    "(default %(default)s)")
     sp.add_argument("--fault-plan", default=None, metavar="SPEC",
                     help="inject deterministic hash-plane faults "
                     "(sched/faults.py spec; requires --dev or TORRENT_TPU_DEV=1)")

@@ -926,6 +926,102 @@ async def _bottleneck_smoke(throttled: bool, tmp: str) -> str:
     return format_report(rep) + "; zero-copy: stage 0 B, slabs all returned"
 
 
+async def _control_smoke() -> str:
+    """Scheduler-autopilot smoke (``--control``): an in-process
+    scheduler whose plane is h2d-throttled through ``sched/faults.py``
+    (``latency_ms`` — the slow-interconnect model) runs waves of
+    submissions while the autopilot ticks between them. The controller
+    must (a) name ``h2d`` as the confirmed bottleneck, (b) move the
+    batch actuator TOWARD it — grow the lane's flush target so fewer,
+    bigger launches amortize the fixed per-launch transfer cost — and
+    (c) pull the admission budget down to what the limiting stage
+    drains. A disabled controller ticking over the same scheduler must
+    move nothing (controller-off = bit-identical static config).
+    Deterministic and CPU-only; the decisions are pure functions of
+    ledger/lane snapshot deltas."""
+    import hashlib as _hashlib
+
+    from torrent_tpu.sched import (
+        ControlConfig,
+        FaultPlan,
+        HashPlaneScheduler,
+        SchedulerAutopilot,
+        SchedulerConfig,
+    )
+
+    base_target = 8
+    plan = FaultPlan.parse("latency_ms=40")
+    sched = HashPlaneScheduler(
+        SchedulerConfig(
+            batch_target=base_target,
+            flush_deadline=0.02,
+            plane_factory=plan.plane_factory(hasher="cpu"),
+        ),
+        hasher="cpu",
+    )
+    await sched.start()
+    pilot = SchedulerAutopilot(
+        sched, ControlConfig(enabled=True, hysteresis_ticks=1, cooldown_ticks=0)
+    )
+    try:
+        pieces = [bytes([i % 251]) * 1024 for i in range(64)]
+        want = [_hashlib.sha1(p).digest() for p in pieces]
+        pilot.tick()  # baseline snapshots
+        last = None
+        for _ in range(3):
+            assert await sched.submit("doctor", pieces) == want, (
+                "digests diverged under autopilot control"
+            )
+            last = pilot.tick()
+        decision = last["decision"]
+        bn = decision.get("bottleneck") or {}
+        assert bn.get("stage") == "h2d", (
+            f"controller did not name the throttled h2d stage: {decision}"
+        )
+        snap = sched.metrics_snapshot()
+        lane = next(iter(snap["lane_stats"].values()))
+        assert lane["target"] > base_target, (
+            f"batch actuator did not move toward the bottleneck: {lane}"
+        )
+        assert snap["admission_factor"] < 1.0, (
+            f"admission budget did not follow the limiting stage: "
+            f"{snap['admission_factor']}"
+        )
+        grown = lane["target"]
+        factor = snap["admission_factor"]
+
+        # controller-off parity: a DISABLED pilot over a fresh scheduler
+        # must leave every actuator at its static value
+        plan2 = FaultPlan.parse("latency_ms=40")
+        sched2 = HashPlaneScheduler(
+            SchedulerConfig(
+                batch_target=base_target,
+                flush_deadline=0.02,
+                plane_factory=plan2.plane_factory(hasher="cpu"),
+            ),
+            hasher="cpu",
+        )
+        await sched2.start()
+        try:
+            pilot2 = SchedulerAutopilot(sched2, ControlConfig(enabled=False))
+            pilot2.tick()
+            assert await sched2.submit("doctor", pieces) == want
+            off = pilot2.tick()
+            assert not off.get("applied"), f"disabled pilot applied {off}"
+            snap2 = sched2.metrics_snapshot()
+            lane2 = next(iter(snap2["lane_stats"].values()))
+            assert lane2["target"] == base_target, lane2
+            assert snap2["admission_factor"] == 1.0, snap2
+        finally:
+            await sched2.close()
+    finally:
+        await sched.close()
+    return (
+        f"h2d confirmed limiting; lane target {base_target}→{grown}, "
+        f"admission ×{factor:.2f}; disabled controller moved nothing"
+    )
+
+
 def _lint_smoke() -> str:
     """Analysis-plane smoke (``--lint``): run all four static passes
     over the installed package and require a clean gate — zero findings
@@ -1049,6 +1145,14 @@ def main(argv=None) -> int:
         "attributor must name it as the limiting stage",
     )
     ap.add_argument(
+        "--control",
+        action="store_true",
+        help="also run the scheduler-autopilot smoke: an h2d-throttled "
+        "scheduler under the controller must get its lane target grown "
+        "and its admission budget pulled toward the limiting stage, while "
+        "a disabled controller moves nothing",
+    )
+    ap.add_argument(
         "--json",
         action="store_true",
         help="emit one JSON object after the checks (machine-readable)",
@@ -1140,6 +1244,12 @@ def main(argv=None) -> int:
                 _report("PASS", "pipeline ledger", detail)
             except Exception as e:
                 _report("FAIL", "pipeline ledger", repr(e))
+    if args.control:
+        try:
+            detail = asyncio.run(asyncio.wait_for(_control_smoke(), 60))
+            _report("PASS", "scheduler autopilot", detail)
+        except Exception as e:
+            _report("FAIL", "scheduler autopilot", repr(e))
     if args.fabric:
         with tempfile.TemporaryDirectory(prefix="doctor_fabric_") as tmp:
             try:

@@ -12,6 +12,13 @@ verdict, refreshing in place::
     ...
     bottleneck: h2d — 104% utilized, 49.1 MiB/s achieved vs 6.1 GiB/s demanded
     sched: 840 queued pieces (205.0 MiB), 312 launches, fill 0.94, 3 lanes
+    autopilot: h2d limiting x4 [confirmed] — batch_target[sha1/262144] 16→64
+      lane sha1/262144: target 64, deadline 80ms, backend device
+
+When the bridge runs with ``--autopilot`` the frame also carries the
+controller's last decision and every actuator's current value (the
+``control`` key of ``/v1/pipeline``); ``--interval`` sets the refresh
+cadence for watching the controller converge.
 
 Utilization can exceed 100%: overlapped launches (depth-2 pipelining,
 concurrent reader threads) accumulate more busy-seconds than wall
@@ -131,6 +138,19 @@ def render_top(payload: dict, url: str = "") -> str:
             f"fill {sched.get('mean_fill', 0.0):.2f}, "
             f"{sched.get('lanes', 0)} lanes"
         )
+    ctl = payload.get("control")
+    if ctl:
+        # the autopilot's decision line: last verdict + what moved, plus
+        # every actuator's current value (sched/control.decision_summary)
+        from torrent_tpu.sched.control import decision_summary
+
+        lines.append(decision_summary(ctl))
+        for lane, st in sorted(((ctl.get("actuators") or {}).get("lanes") or {}).items()):
+            lines.append(
+                f"  lane {lane}: target {st.get('target')}, "
+                f"deadline {st.get('deadline', 0) * 1000:.0f}ms, "
+                f"backend {st.get('backend')}"
+            )
     return "\n".join(lines)
 
 

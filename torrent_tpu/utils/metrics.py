@@ -237,6 +237,8 @@ def render_fabric_metrics(snapshot: dict) -> str:
         f'torrent_tpu_fabric_units{{{pid},kind="planned"}} {s.get("shard_units", 0)}',
         f'torrent_tpu_fabric_units{{{pid},kind="done"}} {s.get("units_done", 0)}',
         f'torrent_tpu_fabric_units{{{pid},kind="adopted"}} {s.get("units_adopted", 0)}',
+        f'torrent_tpu_fabric_units{{{pid},kind="offered"}} {s.get("units_offered", 0)}',
+        f'torrent_tpu_fabric_units{{{pid},kind="rebalanced"}} {s.get("units_rebalanced", 0)}',
         f'torrent_tpu_fabric_units{{{pid},kind="total"}} {s.get("units_total", 0)}',
         "# HELP torrent_tpu_fabric_pieces_verified_total Pieces this process verified",
         "# TYPE torrent_tpu_fabric_pieces_verified_total counter",
@@ -260,6 +262,71 @@ def render_fabric_metrics(snapshot: dict) -> str:
         "# TYPE torrent_tpu_fabric_degraded gauge",
         f"torrent_tpu_fabric_degraded{{{pid}}} {1 if s.get('degraded') else 0}",
     ]
+    return "\n".join(lines) + "\n"
+
+
+def render_control_metrics(snapshot: dict) -> str:
+    """Prometheus rendering of the scheduler autopilot's counters.
+
+    ``snapshot`` is ``torrent_tpu.sched.control.SchedulerAutopilot.
+    metrics_snapshot()``. Appended to both ``/metrics`` endpoints while
+    an autopilot is attached — the series simply don't exist otherwise.
+    Defensive against partial snapshots: missing keys render as 0."""
+    s = snapshot or {}
+    lines = [
+        "# HELP torrent_tpu_control_enabled Scheduler autopilot actuation switch (0 = observe-only)",
+        "# TYPE torrent_tpu_control_enabled gauge",
+        f"torrent_tpu_control_enabled {1 if s.get('enabled') else 0}",
+        "# HELP torrent_tpu_control_ticks_total Controller decisions computed",
+        "# TYPE torrent_tpu_control_ticks_total counter",
+        f"torrent_tpu_control_ticks_total {s.get('ticks', 0)}",
+        "# HELP torrent_tpu_control_admission_factor Fraction of the configured admission budget currently admitted",
+        "# TYPE torrent_tpu_control_admission_factor gauge",
+        f"torrent_tpu_control_admission_factor {s.get('admission_factor', 1.0):.4f}",
+        "# HELP torrent_tpu_control_backend_switches_total Lane backend steers applied by the controller",
+        "# TYPE torrent_tpu_control_backend_switches_total counter",
+        f"torrent_tpu_control_backend_switches_total {s.get('backend_switches', 0)}",
+        "# HELP torrent_tpu_control_actions_total Actuator moves applied, by actuator",
+        "# TYPE torrent_tpu_control_actions_total counter",
+    ]
+    for actuator in ("batch_target", "flush_deadline", "admission", "backend"):
+        lines.append(
+            f'torrent_tpu_control_actions_total{{actuator="{actuator}"}} '
+            f"{(s.get('actions') or {}).get(actuator, 0)}"
+        )
+    # the controller's last confirmed bottleneck as a 0/1 enum family
+    from torrent_tpu.obs.ledger import PIPELINE_STAGES
+
+    bn = s.get("bottleneck")
+    lines.append(
+        "# HELP torrent_tpu_control_bottleneck Stage the controller's last decision named limiting (1 = current)"
+    )
+    lines.append("# TYPE torrent_tpu_control_bottleneck gauge")
+    for stage in PIPELINE_STAGES:
+        lines.append(
+            f'torrent_tpu_control_bottleneck{{stage="{stage}"}} '
+            f"{1 if stage == bn else 0}"
+        )
+    lanes = s.get("lanes") or {}
+    lines.append(
+        "# HELP torrent_tpu_control_lane_target Current (possibly adapted) pieces-per-launch target per lane"
+    )
+    lines.append("# TYPE torrent_tpu_control_lane_target gauge")
+    for lane, st in sorted(lanes.items()):
+        lines.append(
+            f'torrent_tpu_control_lane_target{{lane="{_esc(lane)}",'
+            f'backend="{_esc(str(st.get("backend", "device")))}"}} '
+            f"{st.get('target', 0)}"
+        )
+    lines.append(
+        "# HELP torrent_tpu_control_lane_flush_deadline_seconds Current (possibly adapted) flush deadline per lane"
+    )
+    lines.append("# TYPE torrent_tpu_control_lane_flush_deadline_seconds gauge")
+    for lane, st in sorted(lanes.items()):
+        lines.append(
+            f'torrent_tpu_control_lane_flush_deadline_seconds{{lane="{_esc(lane)}"}} '
+            f"{st.get('deadline', 0.0):.6f}"
+        )
     return "\n".join(lines) + "\n"
 
 
@@ -461,12 +528,17 @@ class MetricsServer:
     ``fabric``: optionally a running ``FabricExecutor`` — its per-shard
     gauges AND its fleet rollup (``torrent_tpu_fleet_*``) join the same
     exposition, so the session endpoint carries the swarm-wide view just
-    like the bridge's does."""
+    like the bridge's does.
+    ``controller``: optionally a ``SchedulerAutopilot`` whose
+    ``torrent_tpu_control_*`` series join the exposition too — both
+    /metrics endpoints carry the observe→act loop's state."""
 
-    def __init__(self, client, host: str = "127.0.0.1", scheduler=None, fabric=None):
+    def __init__(self, client, host: str = "127.0.0.1", scheduler=None, fabric=None,
+                 controller=None):
         self.client = client
         self.scheduler = scheduler
         self.fabric = fabric
+        self.controller = controller
         self.host = host
         self.port: int | None = None
         self._server: asyncio.AbstractServer | None = None
@@ -504,6 +576,10 @@ class MetricsServer:
                 if self.fabric is not None:
                     text += render_fabric_metrics(self.fabric.metrics_snapshot())
                     text += render_fleet_metrics(self.fabric.fleet_snapshot())
+                if self.controller is not None:
+                    text += render_control_metrics(
+                        self.controller.metrics_snapshot()
+                    )
                 from torrent_tpu.obs import render_obs_metrics
 
                 text += render_obs_metrics()

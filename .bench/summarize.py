@@ -80,6 +80,13 @@ def _normalize(rec: dict, artifact: str) -> dict:
                 # and the decision trail that produced the win — banked
                 # WITH the rate so the regression gate stays auditable
                 "ab", "decision", "fault",
+                # the announce rung schema (bench announce): the storm
+                # shape, the cross-shard occupancy proof, and the
+                # latency summary ride the banked rate (same treatment
+                # the controller rung got)
+                "clients", "swarms", "shards", "shards_hit", "numwant",
+                "announces", "rates", "latency", "shard_occupancy", "store",
+                "contract",
                 # the comparator's full like-for-like shape key
                 "piece_kb", "bytes", "nproc"):
         if key in rec:

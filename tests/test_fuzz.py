@@ -418,6 +418,123 @@ class TestBep38HintParsers:
         assert isinstance(parse_similar({b"info": bad_info}), tuple)
 
 
+class TestAnnouncePlaneProperties:
+    """Announce-plane hardening (PR 12): the tracker's param validator
+    never crashes and only emits well-bounded fields, and the compact
+    peer codecs round-trip arbitrary valid addresses, v4 and v6."""
+
+    # raw query params as the HTTP parser produces them: str keys,
+    # lists of arbitrary bytes values
+    params = st.dictionaries(
+        st.text(max_size=12),
+        st.lists(st.binary(max_size=24), min_size=1, max_size=3),
+        max_size=8,
+    )
+
+    @given(params)
+    @settings(max_examples=300, deadline=None)
+    def test_validate_announce_params_never_crashes(self, params):
+        from torrent_tpu.net.types import AnnounceEvent
+        from torrent_tpu.server.tracker import _validate_announce_params
+
+        out = _validate_announce_params(params, "9.9.9.9")
+        if isinstance(out, str):
+            return  # typed rejection is the contract
+        assert len(out["info_hash"]) == 20 and len(out["peer_id"]) == 20
+        assert 0 < out["port"] < 65536
+        for key in ("uploaded", "downloaded", "left"):
+            assert out[key] >= 0
+        assert isinstance(out["event"], AnnounceEvent)
+        if "numwant" in out:
+            assert out["numwant"] >= 0
+
+    @given(st.binary(max_size=120))
+    @settings(max_examples=200, deadline=None)
+    def test_query_string_to_validator_never_crashes(self, raw):
+        """The full HTTP path: arbitrary bytes as the query string
+        through the binary-safe parser into the validator."""
+        from torrent_tpu.server.tracker import (
+            _parse_query_raw,
+            _validate_announce_params,
+        )
+
+        query = raw.decode("latin-1")
+        out = _validate_announce_params(_parse_query_raw(query), "1.2.3.4")
+        assert isinstance(out, (str, dict))
+
+    v4_addr = st.tuples(
+        st.integers(min_value=0, max_value=2**32 - 1),
+        st.integers(min_value=1, max_value=65535),
+    )
+
+    @given(st.lists(v4_addr, max_size=32))
+    @settings(max_examples=200, deadline=None)
+    def test_compact_v4_roundtrip(self, addrs):
+        import ipaddress
+
+        from torrent_tpu.net.types import pack_compact_v4, unpack_compact_v4
+
+        pairs = [(str(ipaddress.IPv4Address(ip)), port) for ip, port in addrs]
+        blob = pack_compact_v4(pairs)
+        assert len(blob) == 6 * len(pairs)
+        assert unpack_compact_v4(blob) == pairs
+
+    v6_addr = st.tuples(
+        st.integers(min_value=0, max_value=2**128 - 1),
+        st.integers(min_value=1, max_value=65535),
+    )
+
+    @given(st.lists(v6_addr, max_size=16))
+    @settings(max_examples=200, deadline=None)
+    def test_compact_v6_roundtrip(self, addrs):
+        import ipaddress
+
+        from torrent_tpu.net.types import pack_compact_v6, unpack_compact_v6
+
+        pairs = []
+        for ip, port in addrs:
+            addr = ipaddress.IPv6Address(ip)
+            if addr.ipv4_mapped is not None:
+                continue  # mapped addrs normalize to v4, packed elsewhere
+            pairs.append((str(addr), port))
+        blob = pack_compact_v6(pairs)
+        assert len(blob) == 18 * len(pairs)
+        # compare as parsed addresses: inet_ntop renders v4-compatible
+        # (::a.b.c.d) addresses differently than ipaddress's canonical
+        # text, but the address identity must round-trip exactly
+        got = unpack_compact_v6(blob)
+        assert [(ipaddress.ip_address(h), p) for h, p in got] == [
+            (ipaddress.ip_address(h), p) for h, p in pairs
+        ]
+
+    @given(st.lists(v4_addr, min_size=1, max_size=64),
+           st.integers(min_value=0, max_value=500))
+    @settings(max_examples=150, deadline=None)
+    def test_store_reply_bounds_hold(self, addrs, numwant):
+        """Whatever swarm shape and numwant arrive, the sharded store's
+        reply obeys the server-side bounds: ≤ clamped numwant peers,
+        never the requester, all ports valid."""
+        import ipaddress
+
+        from torrent_tpu.server.shard import ShardedSwarmStore
+
+        store = ShardedSwarmStore(n_shards=2)
+        info_hash = b"\x07" * 20
+        for i, (ip, port) in enumerate(addrs):
+            store.announce(
+                info_hash, i.to_bytes(2, "big") * 10,
+                str(ipaddress.IPv4Address(ip)), port, left=i % 2,
+            )
+        me = b"\xff" * 20
+        out = store.announce(
+            info_hash, me, "1.1.1.1", 7000, left=1, numwant=numwant
+        )
+        want, _ = store.clamp_numwant(numwant)
+        assert len(out.peers) <= want
+        assert all(p.peer_id != me for p in out.peers)
+        assert all(0 < p.port < 65536 for p in out.peers)
+
+
 class TestMutationCorpusFuzz:
     """Structure-aware mutation fuzz: take VALID artifacts (the golden
     reference .torrent fixtures, encoded wire messages, uTP packets) and

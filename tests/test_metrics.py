@@ -284,6 +284,72 @@ class TestRendererEdgeCases:
         # member, so an alert on < 0.5 still catches a folded straggler
         assert 'torrent_tpu_fleet_pid_vs_median{pid="overflow"} 0.4' in text
 
+    def test_tracker_renderer_fresh_store(self):
+        """A fresh sharded store (no announces yet) must render complete
+        headers and zeroed totals — the tracker's /metrics is scraped
+        from the moment the listener binds."""
+        from torrent_tpu.server.shard import ShardedSwarmStore
+        from torrent_tpu.utils.metrics import render_tracker_metrics
+
+        text = render_tracker_metrics(ShardedSwarmStore(n_shards=4).metrics_snapshot())
+        prom_lint(text)
+        assert "torrent_tpu_tracker_announces_total 0" in text
+        assert "torrent_tpu_tracker_shards 4" in text
+        assert 'torrent_tpu_tracker_shard_peers{shard="3"} 0' in text
+
+    def test_tracker_renderer_partial_snapshot(self):
+        """Missing keys (a degraded or hand-rolled snapshot) render as
+        zeros, never a crash mid-scrape; an indexer sub-dict adds the
+        indexer families."""
+        from torrent_tpu.utils.metrics import render_tracker_metrics
+
+        text = render_tracker_metrics({"announces": 7, "shards": [{"peers": 3}]})
+        prom_lint(text)
+        assert "torrent_tpu_tracker_announces_total 7" in text
+        assert "torrent_tpu_tracker_scrapes_total 0" in text
+        assert 'torrent_tpu_tracker_shard_peers{shard="0"} 3' in text
+        assert 'torrent_tpu_tracker_shard_swarms{shard="0"} 0' in text
+        text = render_tracker_metrics(
+            {"indexer": {"hashes": 5, "harvested": {"announce_peer": 2}}}
+        )
+        prom_lint(text)
+        assert "torrent_tpu_tracker_indexer_hashes 5" in text
+        assert (
+            'torrent_tpu_tracker_indexer_harvested_total{kind="announce_peer"} 2'
+            in text
+        )
+        assert (
+            'torrent_tpu_tracker_indexer_harvested_total{kind="get_peers"} 0'
+            in text
+        )
+        prom_lint(render_tracker_metrics({}))
+        prom_lint(render_tracker_metrics(None))
+
+    def test_tracker_renderer_shard_overflow(self):
+        """Bounded shard cardinality: a store misconfigured wider than
+        MAX_TRACKER_SHARDS folds the tail into shard="overflow"."""
+        from torrent_tpu.utils.metrics import (
+            MAX_TRACKER_SHARDS,
+            render_tracker_metrics,
+        )
+
+        n = MAX_TRACKER_SHARDS + 4
+        snap = {
+            "n_shards": n,
+            "shards": [
+                {"swarms": 1, "peers": 2, "announces": 3} for _ in range(n)
+            ],
+        }
+        text = render_tracker_metrics(snap)
+        prom_lint(text)
+        assert f'shard="{MAX_TRACKER_SHARDS - 1}"' in text
+        assert f'shard="{MAX_TRACKER_SHARDS}"' not in text
+        assert 'torrent_tpu_tracker_shard_peers{shard="overflow"} 8' in text
+        assert (
+            'torrent_tpu_tracker_shard_announces_total{shard="overflow"} 12'
+            in text
+        )
+
     def test_full_exposition_concatenation_lints(self):
         """What the bridge actually serves: sched + fabric + fleet +
         control + obs (incl. the pipeline ledger) + tsan in one payload
@@ -298,22 +364,27 @@ class TestRendererEdgeCases:
             SchedulerAutopilot,
             SchedulerConfig,
         )
+        from torrent_tpu.server.shard import ShardedSwarmStore
         from torrent_tpu.utils.metrics import (
             render_control_metrics,
             render_fabric_metrics,
             render_fleet_metrics,
             render_sched_metrics,
+            render_tracker_metrics,
             render_tsan_metrics,
         )
 
         pipeline_ledger().record("read", 1024, 0.01)  # ledger series live
         sched = HashPlaneScheduler(SchedulerConfig(), hasher="cpu")
         pilot = SchedulerAutopilot(sched, ControlConfig())
+        store = ShardedSwarmStore(n_shards=2)
+        store.announce(b"\x01" * 20, b"\x02" * 20, "1.1.1.1", 7001, left=0)
         text = (
             render_sched_metrics(sched)
             + render_fabric_metrics({"pid": 0})
             + render_fleet_metrics(local_fleet_snapshot(sched))
             + render_control_metrics(pilot.metrics_snapshot())
+            + render_tracker_metrics(store.metrics_snapshot())
             + render_obs_metrics()
             + render_tsan_metrics(sanitizer.TsanState().snapshot())
         )
@@ -321,6 +392,7 @@ class TestRendererEdgeCases:
         assert "torrent_tpu_pipeline_stage_busy_seconds_total" in text
         assert "torrent_tpu_fleet_reporting 1" in text
         assert "torrent_tpu_control_enabled 1" in text
+        assert "torrent_tpu_tracker_announces_total 1" in text
 
 
 class TestLiveScrape:

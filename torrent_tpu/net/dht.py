@@ -416,6 +416,11 @@ class DHTNode:
         # for immutable items)
         self.item_store: dict[bytes, dict] = {}
         self._put_tasks: set[asyncio.Task] = set()  # keep verifies alive
+        # indexer seam: sync callbacks fired on harvested inbound traffic
+        # — ``cb(kind, info_hash, addr, port, seed)`` with kind one of
+        # "get_peers" (demand signal) / "announce_peer" (a live peer).
+        # Observers must be fast and non-blocking (datagram path).
+        self._observers: list = []
         self._transport: asyncio.DatagramTransport | None = None
         # tid -> (queried address, future): responses are only accepted
         # from the address the query went to
@@ -458,6 +463,19 @@ class DHTNode:
     @property
     def addr(self) -> tuple[str, int]:
         return (self.host, self.port)
+
+    def add_observer(self, cb) -> None:
+        """Register an indexer callback (see ``_observers`` above)."""
+        self._observers.append(cb)
+
+    def _notify(
+        self, kind: str, info_hash: bytes, addr, port: int | None, seed: bool
+    ) -> None:
+        for cb in self._observers:
+            try:
+                cb(kind, info_hash, addr, port, seed)
+            except Exception as e:  # a broken observer must not drop queries
+                log.debug("dht observer failed: %s", e)
 
     def _table_update(self, node_id: bytes, ip: str, port: int) -> None:
         """Routing-table insertion with optional BEP 42 enforcement:
@@ -626,6 +644,9 @@ class DHTNode:
             if not isinstance(info_hash, bytes) or len(info_hash) != 20:
                 self._error(addr, tid, 203, "bad info_hash")
                 return
+            # a get_peers query is a demand signal: someone wants this
+            # swarm — the indexer harvests the hash even with no peer yet
+            self._notify("get_peers", info_hash, addr, None, False)
             r: dict = {b"token": self.tokens.issue(addr[0])}
             peers = self._live_peers(info_hash)
             if a.get(b"scrape"):
@@ -688,6 +709,12 @@ class DHTNode:
                     marks = self.seed_marks.get(info_hash)
                     if marks is not None:
                         marks.discard(key)
+                # token-validated announce: the strongest harvest signal
+                # — a reachable peer claiming membership in the swarm
+                self._notify(
+                    "announce_peer", info_hash, (key[0], addr[1]), port,
+                    bool(a.get(b"seed")),
+                )
             self._respond(addr, tid, {})
             return
         if q == b"get":

@@ -10,8 +10,15 @@ from torrent_tpu.server.tracker import (
     serve_tracker,
 )
 from torrent_tpu.server.in_memory import InMemoryTracker, run_tracker
+from torrent_tpu.server.shard import (
+    AnnounceOutcome,
+    ShardedSwarmStore,
+    ShardedTracker,
+    run_sharded_tracker,
+)
 
 __all__ = [
+    "AnnounceOutcome",
     "AnnounceRequest",
     "ScrapeRequest",
     "HttpAnnounceRequest",
@@ -19,8 +26,11 @@ __all__ = [
     "UdpAnnounceRequest",
     "UdpScrapeRequest",
     "ServeOptions",
+    "ShardedSwarmStore",
+    "ShardedTracker",
     "TrackerServer",
     "serve_tracker",
+    "run_sharded_tracker",
     "InMemoryTracker",
     "run_tracker",
 ]

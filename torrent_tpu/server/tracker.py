@@ -160,11 +160,16 @@ class HttpScrapeRequest(ScrapeRequest):
         await _http_reply(self._writer, 200, bencode({b"failure reason": reason}))
 
 
-async def _http_reply(writer: asyncio.StreamWriter, status: int, body: bytes):
+async def _http_reply(
+    writer: asyncio.StreamWriter,
+    status: int,
+    body: bytes,
+    content_type: str = "text/plain",
+):
     if writer is None or writer.is_closing():
         return
     head = (
-        f"HTTP/1.1 {status} OK\r\nContent-Type: text/plain\r\n"
+        f"HTTP/1.1 {status} OK\r\nContent-Type: {content_type}\r\n"
         f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
     )
     try:
@@ -270,6 +275,9 @@ class TrackerServer:
         self._closed = False
         # live counters served by /stats
         self.stats = {"announce": 0, "scrape": 0, "rejected": 0}
+        # optional /metrics provider (set by the sharded announce plane:
+        # server/shard.run_sharded_tracker wires render_tracker_metrics)
+        self.metrics_provider = None
         # UDP connection ids: id → minted_at (server/tracker.ts:512-516)
         self._conn_ids: dict[int, float] = {}
 
@@ -321,6 +329,23 @@ class TrackerServer:
             raise StopAsyncIteration
         return item
 
+    def drain_nowait(self, max_items: int = 256) -> list:
+        """Everything already queued, without awaiting — the sharded
+        pump's batch-drain: one cycle picks up a whole burst of parsed
+        requests so announces can be processed per shard, not per
+        datagram. The close sentinel is put back for the iterator."""
+        out: list = []
+        while len(out) < max_items:
+            try:
+                item = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if item is None:
+                self._queue.put_nowait(None)
+                break
+            out.append(item)
+        return out
+
     # ---------------------------------------------------------------- HTTP
 
     async def _handle_http(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -357,6 +382,16 @@ class TrackerServer:
         elif route == "stats":
             body = bencode({k.encode(): v for k, v in sorted(self.stats.items())})
             await _http_reply(writer, 200, body)
+        elif route == "metrics" and self.metrics_provider is not None:
+            try:
+                body = self.metrics_provider().encode()
+            except Exception:  # a render bug must not kill the listener
+                await _http_reply(writer, 500, b"metrics render failed")
+                return
+            await _http_reply(
+                writer, 200, body,
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            )
         else:
             await _http_reply(writer, 404, b"not found")
 

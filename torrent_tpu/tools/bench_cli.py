@@ -32,6 +32,13 @@ Rungs::
                                  # banks both rates plus the decisions,
                                  # proving the observe→act loop beats
                                  # the static config (value = on-rate)
+    torrent-tpu bench announce   # announce-plane rung: a many-client
+                                 # announce storm (threads) against the
+                                 # sharded swarm store, median-of-3;
+                                 # the record embeds per-shard occupancy
+                                 # and the announce latency summary, and
+                                 # FAILS unless >= 4 shards were
+                                 # exercised concurrently
 
 ``--smoke`` is an alias for the smoke rung (CI spells it that way).
 Device rungs shell out to the repo's ``bench.py`` / ``.bench/
@@ -80,7 +87,10 @@ __all__ = ["compare_record", "load_trajectory", "main"]
 
 SCHEMA = "torrent-tpu-bench/1"
 TRAJECTORY_SCHEMA = "torrent-tpu-bench-trajectory/1"
-RUNGS = ("smoke", "e2e", "v2", "fabric", "flagship", "controller")
+RUNGS = ("smoke", "e2e", "v2", "fabric", "flagship", "controller", "announce")
+# the announce rung's acceptance floor: the banked rate must come from
+# real cross-shard concurrency, not one hot shard
+ANNOUNCE_MIN_SHARDS_HIT = 4
 DEFAULT_TOLERANCE = 0.10
 # the controller rung's deterministic throttle: every launch's h2d
 # sleeps this long (sched/faults.py slow-interconnect model), so the
@@ -404,6 +414,113 @@ async def _controller_ab(total_mb: int, piece_kb: int, batch_target: int) -> dic
     }
 
 
+async def _announce_storm(
+    clients: int, swarms: int, per_client: int, shards: int, numwant: int
+) -> dict:
+    """The announce-plane rung: ``clients`` worker threads storm the
+    sharded swarm store concurrently, each announcing ``per_client``
+    times round-robin across ``swarms`` distinct info-hashes (fixed
+    sha1-derived hashes, so shard distribution is deterministic).
+    Median-of-3 announces/s, with per-shard occupancy and a latency
+    summary embedded — the banked proof that the control plane's O(1)
+    sampling and leaf-locked shards actually scale, not a slogan.
+
+    The record's value is ``None`` (rung FAILED) unless at least
+    :data:`ANNOUNCE_MIN_SHARDS_HIT` shards held peers at the end — the
+    rate must come from cross-shard concurrency."""
+    import hashlib
+
+    from torrent_tpu.net.types import AnnounceEvent
+    from torrent_tpu.obs.hist import histograms
+    from torrent_tpu.server.shard import ShardedSwarmStore
+
+    info_hashes = [
+        hashlib.sha1(f"bench-announce-swarm-{i}".encode()).digest()
+        for i in range(swarms)
+    ]
+
+    def worker(store: ShardedSwarmStore, ci: int) -> list[float]:
+        lats: list[float] = []
+        for k in range(per_client):
+            ih = info_hashes[(ci + k) % swarms]
+            pid = b"%04d%04d" % (ci, k % 2000)
+            pid = pid + b"p" * (20 - len(pid))
+            t0 = time.perf_counter()
+            store.announce(
+                ih, pid, f"10.0.{ci % 256}.{k % 256}", 6881 + ci,
+                left=(k % 4) and 1 or 0, event=AnnounceEvent.EMPTY,
+                numwant=numwant,
+            )
+            lats.append(time.perf_counter() - t0)
+        return lats
+
+    rates: list[float] = []
+    all_lats: list[float] = []
+    snap: dict = {}
+    for _rep in range(3):
+        store = ShardedSwarmStore(n_shards=shards)
+        t0 = time.perf_counter()
+        lat_lists = await asyncio.gather(
+            *(asyncio.to_thread(worker, store, ci) for ci in range(clients))
+        )
+        wall = time.perf_counter() - t0
+        total = clients * per_client
+        rates.append(total / wall if wall > 0 else 0.0)
+        for lats in lat_lists:
+            all_lats.extend(lats)
+        snap = store.metrics_snapshot()
+    # the storm observes into the shared log2 family too, so the rung
+    # exercises the same wiring /metrics scrapes
+    histograms().get(
+        "torrent_tpu_tracker_announce_seconds",
+        help="Tracker announce handle latency (receive to reply)",
+        transport="storm",
+    ).observe_batch(all_lats[-10000:])
+    occupancy = {
+        str(i): sh.get("peers", 0) for i, sh in enumerate(snap.get("shards", []))
+    }
+    shards_hit = sum(1 for v in occupancy.values() if v > 0)
+    all_lats.sort()
+
+    def _pct(q: float) -> float:
+        return round(all_lats[int(q * (len(all_lats) - 1))] * 1e6, 1)
+
+    value = round(statistics.median(rates), 1)
+    ok = bool(all_lats) and shards_hit >= ANNOUNCE_MIN_SHARDS_HIT
+    return {
+        "schema": SCHEMA,
+        "rung": "announce",
+        "metric": f"tracker_announce_storm_{swarms}sw_announces_per_sec",
+        "value": value if ok else None,
+        "unit": "announces/s",
+        "contract": "median-of-3",
+        "rates": [round(r, 1) for r in rates],
+        "announces": clients * per_client,
+        "clients": clients,
+        "swarms": swarms,
+        "shards": shards,
+        "shards_hit": shards_hit,
+        "numwant": numwant,
+        # the storm width is the launch shape for the like-for-like key
+        "batch": clients,
+        "platform": "cpu",
+        "nproc": os.cpu_count(),
+        "latency": {
+            "p50_us": _pct(0.50) if all_lats else None,
+            "p99_us": _pct(0.99) if all_lats else None,
+            "max_us": _pct(1.0) if all_lats else None,
+        },
+        "shard_occupancy": occupancy,
+        "store": {
+            "swarms": snap.get("swarms"),
+            "peers": snap.get("peers"),
+            "numwant_clamped": snap.get("numwant_clamped"),
+        },
+        "measured_at_utc": _utcnow(),
+        "ledger": None,  # the announce plane is not a pipeline-ledger path
+    }
+
+
 # ----------------------------------------------------------- device rungs
 
 
@@ -617,7 +734,8 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "rung", nargs="?", choices=RUNGS,
-        help="named rung to run (smoke/e2e/v2/fabric/flagship/controller)",
+        help="named rung to run "
+        "(smoke/e2e/v2/fabric/flagship/controller/announce)",
     )
     ap.add_argument(
         "--smoke", action="store_true",
@@ -639,6 +757,30 @@ def main(argv=None) -> int:
         "--hasher", default="tpu", choices=("tpu", "cpu"),
         help="e2e rung: hash plane (default %(default)s; 'tpu' is XLA — "
         "on a CPU-only host it still exercises the device-plane path)",
+    )
+    ap.add_argument(
+        "--clients", type=int, default=8,
+        help="announce rung: concurrent announcer threads "
+        "(default %(default)s)",
+    )
+    ap.add_argument(
+        "--swarms", type=int, default=32,
+        help="announce rung: distinct info-hashes stormed "
+        "(default %(default)s)",
+    )
+    ap.add_argument(
+        "--per-client", type=int, default=2000,
+        help="announce rung: announces per client per rep "
+        "(default %(default)s)",
+    )
+    ap.add_argument(
+        "--shards", type=int, default=8,
+        help="announce rung: store shard count (default %(default)s)",
+    )
+    ap.add_argument(
+        "--numwant", type=int, default=30,
+        help="announce rung: peers requested per announce "
+        "(default %(default)s)",
     )
     ap.add_argument(
         "--timeout", type=float, default=None,
@@ -681,8 +823,24 @@ def main(argv=None) -> int:
             return 2
         rung = "smoke"
     if rung is None and args.record is None:
-        print("error: name a rung (smoke/e2e/v2/fabric/flagship/controller) "
-              "or pass --record FILE", file=sys.stderr)
+        print("error: name a rung (smoke/e2e/v2/fabric/flagship/controller/"
+              "announce) or pass --record FILE", file=sys.stderr)
+        return 2
+    if rung == "announce" and (
+        args.shards < ANNOUNCE_MIN_SHARDS_HIT
+        or args.swarms < ANNOUNCE_MIN_SHARDS_HIT
+    ):
+        # refuse upfront instead of running a storm guaranteed to fail
+        # the >=4-shards acceptance floor with a misleading null-value
+        # error at the end
+        print(
+            f"error: the announce rung's banked rate must come from "
+            f">= {ANNOUNCE_MIN_SHARDS_HIT} concurrently exercised shards; "
+            f"--shards and --swarms must both be >= "
+            f"{ANNOUNCE_MIN_SHARDS_HIT} (got --shards {args.shards} "
+            f"--swarms {args.swarms})",
+            file=sys.stderr,
+        )
         return 2
 
     if args.record is not None:
@@ -706,6 +864,13 @@ def main(argv=None) -> int:
             elif rung == "controller":
                 record = asyncio.run(
                     _controller_ab(args.mb, args.piece_kb, args.batch_target)
+                )
+            elif rung == "announce":
+                record = asyncio.run(
+                    _announce_storm(
+                        args.clients, args.swarms, args.per_client,
+                        args.shards, args.numwant,
+                    )
                 )
             elif rung == "fabric":
                 record = _run_fabric_rung(args.timeout)

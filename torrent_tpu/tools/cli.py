@@ -1223,6 +1223,8 @@ def _cmd_doctor(args) -> int:
         argv.append("--bottleneck")
     if getattr(args, "control", False):
         argv.append("--control")
+    if getattr(args, "announce", False):
+        argv.append("--announce")
     if getattr(args, "json", False):
         argv.append("--json")
     return doctor_cli(argv)
@@ -1250,6 +1252,9 @@ def _cmd_bench(args) -> int:
     argv += ["--mb", str(args.mb), "--piece-kb", str(args.piece_kb),
              "--batch-target", str(args.batch_target),
              "--hasher", args.hasher,
+             "--clients", str(args.clients), "--swarms", str(args.swarms),
+             "--per-client", str(args.per_client),
+             "--shards", str(args.shards), "--numwant", str(args.numwant),
              "--tolerance", str(args.tolerance)]
     if args.timeout is not None:
         argv += ["--timeout", str(args.timeout)]
@@ -1547,12 +1552,29 @@ def _cmd_scrape(args) -> int:
 
 
 def _cmd_tracker(args) -> int:
+    base = ["--http-port", str(args.http_port),
+            "--udp-port", str(args.udp_port),
+            "--interval", str(args.interval)]
+    if getattr(args, "shards", 0) > 0:
+        if args.state_file:
+            # refuse rather than silently drop persistence: the sharded
+            # plane has no snapshot file (persistent-tracker semantics
+            # come from the DHT indexer seam), and an operator relying
+            # on --state-file must learn that BEFORE losing state
+            print(
+                "error: --state-file is not supported with --shards "
+                "(the sharded plane persists swarms via the DHT indexer, "
+                "not a snapshot file)",
+                file=sys.stderr,
+            )
+            return 2
+        from torrent_tpu.server.shard import main as shard_main
+
+        return shard_main(base + ["--shards", str(args.shards)])
     from torrent_tpu.server.in_memory import main as tracker_main
 
     return tracker_main(
-        ["--http-port", str(args.http_port), "--udp-port", str(args.udp_port),
-         "--interval", str(args.interval)]
-        + (["--state-file", args.state_file] if args.state_file else [])
+        base + (["--state-file", args.state_file] if args.state_file else [])
     )
 
 
@@ -1952,6 +1974,11 @@ def build_parser() -> argparse.ArgumentParser:
                     "workers, one h2d-throttled; the healthy peer's "
                     "/v1/fleet must name the throttled process (and its "
                     "h2d stage) as the fleet bottleneck")
+    sp.add_argument("--announce", action="store_true",
+                    help="also run the announce-plane smoke: concurrent "
+                    "announces from multiple simulated swarms against "
+                    "the sharded store; sampled replies well-formed, "
+                    "shard counts reconcile")
     sp.add_argument("--lint", action="store_true",
                     help="also run the analysis-plane smoke: all four "
                     "static passes clean against the committed baseline")
@@ -1988,7 +2015,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("rung", nargs="?",
                     choices=("smoke", "e2e", "v2", "fabric", "flagship",
-                             "controller"))
+                             "controller", "announce"))
     sp.add_argument("--smoke", action="store_true",
                     help="alias for the smoke rung (the CI spelling)")
     sp.add_argument("--mb", type=int, default=8,
@@ -1999,6 +2026,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="smoke rung scheduler launch target")
     sp.add_argument("--hasher", default="tpu", choices=("tpu", "cpu"),
                     help="e2e rung hash plane (default %(default)s)")
+    sp.add_argument("--clients", type=int, default=8,
+                    help="announce rung announcer threads")
+    sp.add_argument("--swarms", type=int, default=32,
+                    help="announce rung distinct info-hashes")
+    sp.add_argument("--per-client", type=int, default=2000,
+                    help="announce rung announces per client per rep")
+    sp.add_argument("--shards", type=int, default=8,
+                    help="announce rung store shard count")
+    sp.add_argument("--numwant", type=int, default=30,
+                    help="announce rung peers requested per announce")
     sp.add_argument("--timeout", type=float, default=None,
                     help="device-rung subprocess timeout seconds")
     sp.add_argument("--out", default=None, help="also write the record here")
@@ -2024,6 +2061,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--udp-port", type=int, default=6969)
     sp.add_argument("--interval", type=int, default=600)
     sp.add_argument("--state-file", help="persist swarm state across restarts")
+    sp.add_argument("--shards", type=int, default=0,
+                    help="run the sharded announce plane with N shards "
+                    "(batched announces, O(numwant) sampling, per-shard "
+                    "TTL sweeps, /metrics route; 0 = legacy single-dict "
+                    "tracker)")
     sp.set_defaults(fn=_cmd_tracker)
 
     sp = sub.add_parser("bridge", help="run the TPU hash-plane HTTP bridge")

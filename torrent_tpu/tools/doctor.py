@@ -1022,6 +1022,73 @@ async def _control_smoke() -> str:
     )
 
 
+async def _announce_smoke() -> str:
+    """Announce-plane smoke (``--announce``): concurrent announce storms
+    from multiple simulated swarms against the sharded store, then
+    three contracts checked:
+
+    - sampled replies are well-formed (≤ numwant peers, valid ports,
+      never the requester itself);
+    - shard counts reconcile (per-shard peer sums == store totals ==
+      scrape sums — no peer lost or double-counted across shard locks);
+    - the batch path (the UDP drain's shape) returns one outcome per
+      announce in order.
+    """
+    import hashlib
+
+    from torrent_tpu.net.types import AnnounceEvent
+    from torrent_tpu.server.shard import ShardedSwarmStore
+
+    n_workers, per_worker = 4, 50
+    store = ShardedSwarmStore(n_shards=4)
+    swarm_hashes = [
+        hashlib.sha1(b"doctor-swarm-%d" % i).digest() for i in range(4)
+    ]
+
+    def worker(wi: int) -> None:
+        for k in range(per_worker):
+            ih = swarm_hashes[(wi + k) % len(swarm_hashes)]
+            pid = (b"W%dK%03d" % (wi, k)).ljust(20, b"w")
+            store.announce(
+                ih, pid, f"10.1.{wi}.{k}", 7000 + wi,
+                left=k % 2, event=AnnounceEvent.EMPTY, numwant=20,
+            )
+
+    await asyncio.gather(
+        *(asyncio.to_thread(worker, wi) for wi in range(n_workers))
+    )
+
+    probe_id = b"probe".ljust(20, b"q")
+    out = store.announce(
+        swarm_hashes[0], probe_id, "10.9.9.9", 9999, left=1, numwant=10
+    )
+    assert len(out.peers) <= 10, f"reply overflows numwant: {len(out.peers)}"
+    assert all(0 < p.port < 65536 for p in out.peers), "invalid sampled port"
+    assert all(p.peer_id != probe_id for p in out.peers), "sampled self"
+    assert out.complete + out.incomplete >= len(out.peers)
+
+    snap = store.metrics_snapshot()
+    expected = n_workers * per_worker + 1  # unique announcers + the probe
+    assert snap["peers"] == expected, (snap["peers"], expected)
+    assert snap["peers"] == sum(s["peers"] for s in snap["shards"])
+    sc = store.scrape(swarm_hashes)
+    assert sum(c + i for _, c, _, i in sc) == expected, "scrape diverges"
+    shards_hit = sum(1 for s in snap["shards"] if s["peers"])
+    assert shards_hit >= 2, f"swarms all landed on one shard: {snap}"
+
+    batch = [
+        (swarm_hashes[i % 4], (b"B%02d" % i).ljust(20, b"b"),
+         "10.2.0.1", 8000 + i, 1, AnnounceEvent.EMPTY, 5)
+        for i in range(8)
+    ]
+    outs = store.announce_batch(batch)
+    assert len(outs) == len(batch) and all(o.interval > 0 for o in outs)
+    return (
+        f"{snap['peers']} peers / {snap['swarms']} swarms reconcile across "
+        f"{shards_hit}/4 shards; sampled replies ≤ numwant, batch path ok"
+    )
+
+
 def _lint_smoke() -> str:
     """Analysis-plane smoke (``--lint``): run all four static passes
     over the installed package and require a clean gate — zero findings
@@ -1153,6 +1220,14 @@ def main(argv=None) -> int:
         "a disabled controller moves nothing",
     )
     ap.add_argument(
+        "--announce",
+        action="store_true",
+        help="also run the announce-plane smoke: concurrent announces "
+        "from multiple simulated swarms against the sharded store; "
+        "sampled replies must be well-formed and shard counts must "
+        "reconcile with the store totals and scrape sums",
+    )
+    ap.add_argument(
         "--json",
         action="store_true",
         help="emit one JSON object after the checks (machine-readable)",
@@ -1250,6 +1325,12 @@ def main(argv=None) -> int:
             _report("PASS", "scheduler autopilot", detail)
         except Exception as e:
             _report("FAIL", "scheduler autopilot", repr(e))
+    if args.announce:
+        try:
+            detail = asyncio.run(asyncio.wait_for(_announce_smoke(), 30))
+            _report("PASS", "announce plane", detail)
+        except Exception as e:
+            _report("FAIL", "announce plane", repr(e))
     if args.fabric:
         with tempfile.TemporaryDirectory(prefix="doctor_fabric_") as tmp:
             try:

@@ -446,6 +446,115 @@ def render_fleet_metrics(rollup: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+# per-shard series are bounded: the shard count is operator config, but
+# a misconfigured 4096-shard store must still render a bounded scrape —
+# shards past the cap fold into one shard="overflow" aggregate
+MAX_TRACKER_SHARDS = 32
+
+
+def render_tracker_metrics(snapshot: dict) -> str:
+    """Prometheus rendering of the sharded announce plane
+    (``server.shard.ShardedSwarmStore.metrics_snapshot()``, optionally
+    carrying an ``indexer`` sub-dict from ``net.indexer.DhtIndexer``).
+
+    Served by the tracker's own ``/metrics`` route; the announce-latency
+    log2 histograms (family ``torrent_tpu_tracker_announce_seconds``)
+    ride the shared obs registry and render alongside. Defensive against
+    partial snapshots — a missing key renders as 0, never a crash
+    mid-scrape."""
+    s = snapshot or {}
+    batch = s.get("batch") or {}
+    shards = [sh for sh in s.get("shards") or [] if isinstance(sh, dict)]
+    named = shards[:MAX_TRACKER_SHARDS]
+    folded = shards[MAX_TRACKER_SHARDS:]
+    lines = [
+        "# HELP torrent_tpu_tracker_shards Configured announce-store shards",
+        "# TYPE torrent_tpu_tracker_shards gauge",
+        f"torrent_tpu_tracker_shards {s.get('n_shards', len(shards))}",
+        "# HELP torrent_tpu_tracker_announces_total Announce requests processed",
+        "# TYPE torrent_tpu_tracker_announces_total counter",
+        f"torrent_tpu_tracker_announces_total {s.get('announces', 0)}",
+        "# HELP torrent_tpu_tracker_scrapes_total Scrape requests processed",
+        "# TYPE torrent_tpu_tracker_scrapes_total counter",
+        f"torrent_tpu_tracker_scrapes_total {s.get('scrapes', 0)}",
+        "# HELP torrent_tpu_tracker_swarms Swarms currently tracked",
+        "# TYPE torrent_tpu_tracker_swarms gauge",
+        f"torrent_tpu_tracker_swarms {s.get('swarms', 0)}",
+        "# HELP torrent_tpu_tracker_peers Peers currently tracked across all swarms",
+        "# TYPE torrent_tpu_tracker_peers gauge",
+        f"torrent_tpu_tracker_peers {s.get('peers', 0)}",
+        "# HELP torrent_tpu_tracker_evicted_total Peers expired by TTL sweeps",
+        "# TYPE torrent_tpu_tracker_evicted_total counter",
+        f"torrent_tpu_tracker_evicted_total {s.get('evicted', 0)}",
+        "# HELP torrent_tpu_tracker_indexed_total Peers seeded by the DHT indexer",
+        "# TYPE torrent_tpu_tracker_indexed_total counter",
+        f"torrent_tpu_tracker_indexed_total {s.get('indexed', 0)}",
+        "# HELP torrent_tpu_tracker_numwant_clamped_total Announces whose numwant was clamped by the reply bounds",
+        "# TYPE torrent_tpu_tracker_numwant_clamped_total counter",
+        f"torrent_tpu_tracker_numwant_clamped_total {s.get('numwant_clamped', 0)}",
+        "# HELP torrent_tpu_tracker_batches_total Drained announce batches processed",
+        "# TYPE torrent_tpu_tracker_batches_total counter",
+        f"torrent_tpu_tracker_batches_total {batch.get('batches', 0)}",
+        "# HELP torrent_tpu_tracker_batched_announces_total Announces that rode a drained batch",
+        "# TYPE torrent_tpu_tracker_batched_announces_total counter",
+        f"torrent_tpu_tracker_batched_announces_total {batch.get('announces', 0)}",
+        "# HELP torrent_tpu_tracker_batch_max Largest announce batch drained in one pump cycle",
+        "# TYPE torrent_tpu_tracker_batch_max gauge",
+        f"torrent_tpu_tracker_batch_max {batch.get('max', 0)}",
+    ]
+
+    def _shard_series(name, kind, help_text, key):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        for i, sh in enumerate(named):
+            lines.append(f'{name}{{shard="{i}"}} {sh.get(key, 0)}')
+        if folded:
+            lines.append(
+                f'{name}{{shard="overflow"}} '
+                f"{sum(sh.get(key, 0) for sh in folded)}"
+            )
+
+    _shard_series(
+        "torrent_tpu_tracker_shard_swarms", "gauge",
+        "Swarms tracked per shard", "swarms",
+    )
+    _shard_series(
+        "torrent_tpu_tracker_shard_peers", "gauge",
+        "Peers tracked per shard", "peers",
+    )
+    _shard_series(
+        "torrent_tpu_tracker_shard_announces_total", "counter",
+        "Announces processed per shard", "announces",
+    )
+    idx = s.get("indexer")
+    if isinstance(idx, dict):
+        harvested = idx.get("harvested") or {}
+        lines += [
+            "# HELP torrent_tpu_tracker_indexer_hashes Distinct info-hashes the indexer has discovered (bounded set)",
+            "# TYPE torrent_tpu_tracker_indexer_hashes gauge",
+            f"torrent_tpu_tracker_indexer_hashes {idx.get('hashes', 0)}",
+            "# HELP torrent_tpu_tracker_indexer_harvested_total Inbound DHT queries harvested by kind",
+            "# TYPE torrent_tpu_tracker_indexer_harvested_total counter",
+        ]
+        for kind in ("get_peers", "announce_peer"):
+            lines.append(
+                "torrent_tpu_tracker_indexer_harvested_total"
+                f'{{kind="{kind}"}} {harvested.get(kind, 0)}'
+            )
+        lines += [
+            "# HELP torrent_tpu_tracker_indexer_fed_peers_total Harvested peers fed into the sharded store",
+            "# TYPE torrent_tpu_tracker_indexer_fed_peers_total counter",
+            f"torrent_tpu_tracker_indexer_fed_peers_total {idx.get('fed_peers', 0)}",
+            "# HELP torrent_tpu_tracker_indexer_crawls_total Active crawl steps completed",
+            "# TYPE torrent_tpu_tracker_indexer_crawls_total counter",
+            f"torrent_tpu_tracker_indexer_crawls_total {idx.get('crawls', 0)}",
+            "# HELP torrent_tpu_tracker_indexer_sampled_total Info-hashes received from BEP 51 samples",
+            "# TYPE torrent_tpu_tracker_indexer_sampled_total counter",
+            f"torrent_tpu_tracker_indexer_sampled_total {idx.get('crawl_samples', 0)}",
+        ]
+    return "\n".join(lines) + "\n"
+
+
 def render_metrics(client) -> str:
     """The /metrics payload for one Client (Prometheus text format 0.0.4).
 

@@ -40,20 +40,27 @@ if _TSAN:
 
 
 def pytest_sessionfinish(session, exitstatus):
-    """Under TSAN, a lock-order cycle observed anywhere in the run
-    fails the session even if every individual test passed."""
+    """Under TSAN, a lock-order cycle OR a shared-state lockset race
+    observed anywhere in the run fails the session even if every
+    individual test passed."""
     if not _TSAN:
         return
     snap = _tsan.snapshot()
     rep = (
         f"tsan: {len(snap['locks'])} locks, {snap['edges']} order edges, "
         f"{len(snap['cycles'])} cycles, {snap['loop_stalls']} loop stalls "
-        f"(max {snap['loop_stall_max_s']:.3f}s), {snap['long_holds']} long holds"
+        f"(max {snap['loop_stall_max_s']:.3f}s), {snap['long_holds']} long holds, "
+        f"{len(snap['cells'])} guarded cells, "
+        f"{snap['lockset_race_count']} lockset races"
     )
     print(f"\n{rep}")
     if snap["cycles"]:
         for cyc in snap["cycles"]:
             print(f"tsan: LOCK-ORDER CYCLE: {' -> '.join(cyc + cyc[:1])}")
+        session.exitstatus = 3
+    if snap["lockset_race_count"]:
+        for race in snap["lockset_races"]:
+            print(f"tsan: LOCKSET RACE: {race}")
         session.exitstatus = 3
 
 REFERENCE_FIXTURES = pathlib.Path("/root/reference/test_data")

@@ -5,13 +5,16 @@ Three layers of coverage:
 * **Seeded-violation fixtures** — per pass, a minimal synthetic package
   carrying exactly the hazard the pass exists to catch, plus a clean
   fixture that must produce zero findings (false-positive guard).
-* **Self-run** — the four passes over the real ``torrent_tpu`` package
+* **Self-run** — the six passes over the real ``torrent_tpu`` package
   must produce findings ⊆ the committed baseline (the `torrent-tpu
-  lint` gate), and every baseline entry must carry a real
-  justification.
+  lint` gate), every baseline entry must carry a real justification,
+  and the findings PR 13 *fixed* (rather than baselined) must stay
+  fixed.
 * **Sanitizer units** — a provoked ABBA cycle must be detected by the
   dynamic lock-order graph, a provoked event-loop stall must be
-  counted, and the metrics rendering must expose both.
+  counted, a seeded unguarded mutation must trip the Eraser lockset
+  state machine (and a consistently locked one must not), and the
+  metrics rendering must expose all of it.
 
 The slow tier-2 test re-runs a scheduler stress scenario from
 ``test_sched.py`` in a subprocess with ``TORRENT_TPU_TSAN=1``: the
@@ -329,6 +332,438 @@ class TestDeterminismPass:
         assert findings == []
 
 
+class TestGuardedStatePass:
+    def test_unguarded_mutation_caught(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def locked_bump(self):
+                    with self._lock:
+                        self.count += 1
+
+                def bare_bump(self):
+                    self.count += 1
+            """,
+        })
+        findings, _ = run_passes(root, ["guarded-state"])
+        msgs = [f.message for f in findings]
+        assert any(
+            "mutation of C.count outside its guard _lock" in m for m in msgs
+        ), msgs
+
+    def test_lockset_empties_via_resolved_call(self, tmp_path):
+        # the helper's mutation is locked in one calling context and
+        # bare in the other: only call-graph context propagation sees it
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def _bump(self):
+                    self.count += 1
+
+                def locked(self):
+                    with self._lock:
+                        self._bump()
+
+                def bare(self):
+                    self._bump()
+            """,
+        })
+        findings, _ = run_passes(root, ["guarded-state"])
+        assert any("empties the lockset" in f.message for f in findings)
+
+    def test_locked_suffix_convention_is_verified_not_flagged(self, tmp_path):
+        # every intra-class caller of _bump_locked holds the lock: the
+        # helper's accesses are effectively guarded — zero findings
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def _bump_locked(self):
+                    self.count += 1
+
+                def a(self):
+                    with self._lock:
+                        self._bump_locked()
+
+                def b(self):
+                    with self._lock:
+                        self._bump_locked()
+
+                def snapshot(self):
+                    with self._lock:
+                        return self.count
+            """,
+        })
+        findings, _ = run_passes(root, ["guarded-state"])
+        assert findings == [], [f.format() for f in findings]
+
+    def test_mixed_guards_caught(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.a_lock = threading.Lock()
+                    self.b_lock = threading.Lock()
+                    self.x = 0
+
+                def m1(self):
+                    with self.a_lock:
+                        self.x += 1
+
+                def m2(self):
+                    with self.b_lock:
+                        self.x += 1
+            """,
+        })
+        findings, _ = run_passes(root, ["guarded-state"])
+        assert any("mixed guards" in f.message for f in findings)
+
+    def test_bare_read_of_guarded_attr_caught(self, tmp_path):
+        # the metrics_snapshot shape: worker threads bump under the
+        # lock, a public snapshot method reads bare (the real finding
+        # PR 13 fixed in HashPlaneScheduler.metrics_snapshot)
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._counter_lock = threading.Lock()
+                    self.fallbacks = 0
+
+                def bump(self):
+                    with self._counter_lock:
+                        self.fallbacks += 1
+
+                def metrics_snapshot(self):
+                    return {"fallbacks": self.fallbacks}
+            """,
+        })
+        findings, _ = run_passes(root, ["guarded-state"])
+        assert any(
+            "unguarded read of C.fallbacks" in f.message for f in findings
+        )
+
+    def test_init_publication_and_immutable_after_start_exempt(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.config = {"batch": 64}   # never mutated again
+                    self.count = 0                # mutated in __init__ only
+
+                def read_config(self):
+                    return self.config["batch"]
+
+                def locked_other(self):
+                    with self._lock:
+                        self.other = 1
+            """,
+        })
+        findings, _ = run_passes(root, ["guarded-state"])
+        assert findings == [], [f.format() for f in findings]
+
+    def test_guarded_by_none_annotation_exempts(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.memo = {}  # guarded-by: none
+
+                def locked(self):
+                    with self._lock:
+                        self.memo["a"] = 1
+
+                def bare(self):
+                    self.memo["b"] = 2
+            """,
+        })
+        findings, _ = run_passes(root, ["guarded-state"])
+        assert findings == [], [f.format() for f in findings]
+
+    def test_guarded_by_annotation_pins_and_checks(self, tmp_path):
+        # a declared guard is enforced even when inference alone would
+        # stay silent (no mutation site ever holds the lock)
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.pinned = 0  # guarded-by: _lock
+
+                def bare(self):
+                    self.pinned = 1
+            """,
+        })
+        findings, _ = run_passes(root, ["guarded-state"])
+        assert any(
+            "mutation of C.pinned outside its guard _lock" in f.message
+            for f in findings
+        )
+
+    def test_loop_confined_state_is_silent(self, tmp_path):
+        # a lock-owning class whose OTHER attributes are never mutated
+        # under any lock: single-writer loop discipline, no inference
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.queued = 0
+
+                def enqueue(self, n):
+                    self.queued += n
+
+                def dequeue(self, n):
+                    self.queued -= n
+            """,
+        })
+        findings, _ = run_passes(root, ["guarded-state"])
+        assert findings == [], [f.format() for f in findings]
+
+    def test_no_duplicate_finding_keys(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def locked(self):
+                    with self._lock:
+                        self.count += 1
+
+                def bare(self):
+                    self.count += 1
+                    self.count += 2
+                    self.count += 3
+            """,
+        })
+        findings, _ = run_passes(root, ["guarded-state"])
+        keys = [f.key for f in findings]
+        assert len(keys) == len(set(keys))
+
+    def test_guard_map_renders(self, tmp_path):
+        from torrent_tpu.analysis.passes import load_package
+        from torrent_tpu.analysis.passes.guarded_state import render_guard_map
+
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                    self.memo = {}  # guarded-by: none
+
+                def bump(self):
+                    with self._lock:
+                        self.count += 1
+            """,
+        })
+        text = render_guard_map(load_package(root))
+        assert "C.count -> _lock  [inferred]" in text
+        assert "C.memo -> none  [annotated-none]" in text
+
+
+class TestLifecyclePass:
+    def test_leak_on_exception_edge_caught(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            class C:
+                def leaky(self, pool, chunk):
+                    slot = pool.checkout()
+                    stage(slot, chunk)
+                    pool.checkin(slot)
+            """,
+        })
+        findings, _ = run_passes(root, ["lifecycle"])
+        assert any("exception edge" in f.message for f in findings)
+
+    def test_never_released_caught(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            def worker(sched, piece_length, n):
+                slab = sched.checkout_staging(piece_length, n)
+                fill(slab)
+            """,
+        })
+        findings, _ = run_passes(root, ["lifecycle"])
+        assert any("never released" in f.message for f in findings)
+
+    def test_try_finally_and_except_are_clean(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            class C:
+                def clean_finally(self, pool, chunk):
+                    slot = pool.checkout()
+                    try:
+                        stage(slot, chunk)
+                    finally:
+                        pool.checkin(slot)
+
+                def clean_except(self, pool, chunk):
+                    slot = pool.checkout()
+                    try:
+                        stage(slot, chunk)
+                    except Exception:
+                        pool.checkin(slot)
+                        raise
+                    return slot
+            """,
+        })
+        findings, _ = run_passes(root, ["lifecycle"])
+        assert findings == [], [f.format() for f in findings]
+
+    def test_ownership_transfer_exempt(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            class C:
+                def transfer(self, pool):
+                    return Slab(pool, pool.checkout())
+
+                def escape_to_self(self, pool):
+                    self._slot = pool.checkout()
+            """,
+        })
+        findings, _ = run_passes(root, ["lifecycle"])
+        assert findings == [], [f.format() for f in findings]
+
+    def test_ledger_track_outside_with_caught(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            def bad(data):
+                t = pipeline_ledger().track("read", len(data))
+                return consume(data)
+
+            def good(ledger, data):
+                with ledger.track("read", len(data)) as t:
+                    t.add(len(data))
+                    return consume(data)
+            """,
+        })
+        findings, _ = run_passes(root, ["lifecycle"])
+        assert len(findings) == 1
+        assert "track()" in findings[0].message
+
+    def test_tracer_span_outside_with_caught(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            def bad(x):
+                tracer().span("stage")
+                return x
+
+            def good(x):
+                with tracer().span("stage"):
+                    return x
+            """,
+        })
+        findings, _ = run_passes(root, ["lifecycle"])
+        assert len(findings) == 1
+        assert "span()" in findings[0].message
+
+    def test_unrelated_release_does_not_mask_leak(self, tmp_path):
+        # a finally releasing a DIFFERENT resource (sem) must not count
+        # as the slot's release; pairing is by checked-out variable
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            class C:
+                def leaky(self, pool, chunk):
+                    slot = pool.checkout()
+                    try:
+                        stage(slot, chunk)
+                    finally:
+                        self.sem.release()
+                    pool.checkin(slot)
+            """,
+        })
+        findings, _ = run_passes(root, ["lifecycle"])
+        assert any("exception edge" in f.message for f in findings), [
+            f.format() for f in findings
+        ]
+
+    def test_wrapper_bound_release_pairs(self, tmp_path):
+        # the checkout_staging shape: the checkout is wrapped, the bound
+        # wrapper's .release() in a finally satisfies the pairing
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            def read_into(sched, n):
+                slab = sched.checkout_staging(2048, n)
+                try:
+                    fill(slab)
+                finally:
+                    slab.release()
+            """,
+        })
+        findings, _ = run_passes(root, ["lifecycle"])
+        assert findings == [], [f.format() for f in findings]
+
+    def test_domain_track_method_not_flagged(self, tmp_path):
+        # .track() on a non-ledger receiver is someone else's API
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            def ok(dispatcher, item):
+                return dispatcher.track(item)
+            """,
+        })
+        findings, _ = run_passes(root, ["lifecycle"])
+        assert findings == [], [f.format() for f in findings]
+
+
+class TestSelfRunRegressions:
+    """The real pre-existing findings PR 13 FIXED must stay fixed (not
+    baselined): a reappearance is a new finding and trips the gate."""
+
+    def test_metrics_snapshot_counter_read_stays_fixed(self):
+        findings, _ = run_passes(default_root(), ["guarded-state"])
+        bad = [
+            f for f in findings
+            if f.symbol == "HashPlaneScheduler.metrics_snapshot"
+        ]
+        assert bad == [], [f.format() for f in bad]
+
+    def test_verifier_upload_pool_read_stays_fixed(self):
+        findings, _ = run_passes(default_root(), ["guarded-state"])
+        bad = [f for f in findings if "upload_pool" in f.message]
+        assert bad == [], [f.format() for f in bad]
+
+    def test_package_is_lifecycle_clean(self):
+        findings, _ = run_passes(default_root(), ["lifecycle"])
+        assert findings == [], [f.format() for f in findings]
+
+
 class TestCleanFixture:
     def test_clean_package_has_zero_findings(self, tmp_path):
         root = _fixture_pkg(tmp_path, {
@@ -438,6 +873,32 @@ class TestSelfRun:
                     return time.time()
                 """,
             },
+            "guarded-state": {
+                "mod.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.count = 0
+
+                    def locked(self):
+                        with self._lock:
+                            self.count += 1
+
+                    def bare(self):
+                        self.count += 1
+                """,
+            },
+            "lifecycle": {
+                "mod.py": """
+                class C:
+                    def leaky(self, pool, chunk):
+                        slot = pool.checkout()
+                        stage(slot, chunk)
+                        pool.checkin(slot)
+                """,
+            },
         }
         for pass_name, files in fixtures.items():
             root = _fixture_pkg(tmp_path / pass_name.replace("-", "_"), files)
@@ -463,6 +924,134 @@ class TestSelfRun:
         assert doc["findings"] and doc["findings"][0]["pass"] == "blocking-in-async"
         # gate is green against the fresh baseline
         assert lint_main(["--root", str(root), "--baseline", str(bl)]) == 0
+
+    def test_update_baseline_roundtrip_six_passes(self, tmp_path, capsys):
+        """One violation per pass -> baseline -> green gate, with all
+        six pass names represented in the written baseline."""
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            import time
+
+            async def bad():
+                time.sleep(1)
+            """,
+            "mod.py": """
+            import threading
+
+            def inv(a_lock, b_lock):
+                with a_lock:
+                    with b_lock:
+                        pass
+
+            def rev(a_lock, b_lock):
+                with b_lock:
+                    with a_lock:
+                        pass
+
+            def dev(v, x, some_lock):
+                with some_lock:
+                    return v.digest_batch(x)
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def locked(self):
+                    with self._lock:
+                        self.count += 1
+
+                def bare(self):
+                    self.count += 1
+
+                def leaky(self, pool, chunk):
+                    slot = pool.checkout()
+                    chunk(slot)
+                    pool.checkin(slot)
+            """,
+            "fabric/plan.py": """
+            import time
+
+            def fingerprint():
+                return time.time()
+            """,
+        })
+        bl = tmp_path / "bl.json"
+        assert lint_main(["--root", str(root), "--baseline", str(bl),
+                          "--update-baseline"]) == 0
+        doc = json.loads(bl.read_text())
+        assert {e["pass"] for e in doc["findings"]} == set(ALL_PASS_NAMES)
+        assert lint_main(["--root", str(root), "--baseline", str(bl)]) == 0
+
+    def test_sarif_report(self, tmp_path, capsys):
+        """--sarif dumps a SARIF 2.1.0 doc: new findings bare, baselined
+        findings suppressed with their justification."""
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            import time
+
+            async def bad():
+                time.sleep(1)
+
+            async def worse(fut):
+                return fut.result()
+            """,
+        })
+        # baseline ONE of the two findings so the sarif shows both kinds
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps({
+            "version": 1,
+            "findings": [{
+                "pass": "blocking-in-async",
+                "path": "pkg/net/mod.py",
+                "symbol": "bad",
+                "message": "blocking call time.sleep in coroutine",
+                "justification": "reviewed: fixture",
+            }],
+        }))
+        sarif = tmp_path / "out.sarif"
+        rc = lint_main(["--root", str(root), "--baseline", str(bl),
+                        "--sarif", str(sarif)])
+        assert rc == 1  # the unbaselined finding still trips the gate
+        doc = json.loads(sarif.read_text())
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert {r["id"] for r in run["tool"]["driver"]["rules"]} == set(
+            ALL_PASS_NAMES
+        )
+        results = run["results"]
+        assert len(results) == 2
+        suppressed = [r for r in results if r.get("suppressions")]
+        assert len(suppressed) == 1
+        assert suppressed[0]["suppressions"][0]["justification"] == (
+            "reviewed: fixture"
+        )
+        loc = results[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("net/mod.py")
+        assert loc["region"]["startLine"] >= 1
+
+    def test_sarif_self_run_is_fully_suppressed(self, tmp_path):
+        """Against the committed baseline, every SARIF result of a
+        self-run must carry a suppression (the gate is green)."""
+        sarif = tmp_path / "self.sarif"
+        assert lint_main(["--sarif", str(sarif)]) == 0
+        doc = json.loads(sarif.read_text())
+        results = doc["runs"][0]["results"]
+        assert results, "self-run produced no findings?"
+        for r in results:
+            assert r.get("suppressions"), r["message"]["text"]
+            assert r["suppressions"][0]["justification"].strip()
+
+    def test_graph_includes_guard_map(self, capsys):
+        assert lint_main(["--graph"]) == 0
+        out = capsys.readouterr().out
+        assert "# static lock-acquisition graph" in out
+        assert "# inferred attribute guards" in out
+        # the fixed finding's attribute shows up with its real guard
+        assert (
+            "HashPlaneScheduler._cpu_fallback_launches -> _counter_lock"
+            in out
+        )
 
     def test_update_baseline_refuses_pass_subset(self, tmp_path, capsys):
         # a subset run would silently delete the other passes' entries
@@ -630,6 +1219,186 @@ class TestSanitizer:
         snap = sanitizer.snapshot()
         assert snap["loop_stalls"] > before
         assert snap["loop_stall_max_s"] >= 0.05
+
+    def test_eraser_fires_on_unguarded_mutation(self):
+        """The seeded unguarded-mutation scenario: two overlapping
+        threads write one cell with no lock held — the lockset empties
+        and the race is recorded (name-level counter + message)."""
+        import threading
+
+        from torrent_tpu.analysis.sanitizer import TsanState, guard_attrs
+
+        st = TsanState()
+        cells = guard_attrs("seed.obj", "count", state=st)
+        gate = threading.Barrier(2)
+
+        def w():
+            gate.wait()  # overlap lifetimes: distinct thread idents
+            for _ in range(100):
+                cells.write("count")
+
+        threads = [threading.Thread(target=w) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = st.snapshot()
+        assert snap["lockset_race_count"] >= 1
+        assert snap["cells"]["seed.obj.count"]["races"] >= 1
+        assert any("seed.obj.count" in r for r in snap["lockset_races"])
+
+    def test_eraser_quiet_under_consistent_lock(self):
+        import threading
+
+        from torrent_tpu.analysis.sanitizer import (
+            SanitizedLock, TsanState, guard_attrs,
+        )
+
+        st = TsanState()
+        lock = SanitizedLock("q.lock", st)
+        cells = guard_attrs("q.obj", "count", state=st)
+        gate = threading.Barrier(3)
+
+        def w():
+            gate.wait()
+            for _ in range(50):
+                with lock:
+                    cells.write("count")
+                    cells.read("count")
+
+        threads = [threading.Thread(target=w) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = st.snapshot()
+        assert snap["lockset_race_count"] == 0
+        assert snap["cells"]["q.obj.count"] == {"instances": 1, "races": 0}
+
+    def test_eraser_fires_when_locksets_disjoint(self):
+        """Both writers lock — but different locks: the candidate
+        lockset intersects to empty, Eraser's core report."""
+        import threading
+
+        from torrent_tpu.analysis.sanitizer import (
+            SanitizedLock, TsanState, guard_attrs,
+        )
+
+        st = TsanState()
+        a = SanitizedLock("d.A", st)
+        b = SanitizedLock("d.B", st)
+        cells = guard_attrs("d.obj", "count", state=st)
+        # deterministic interleave: A-write, then B-write (transition to
+        # shared-modified with lockset {B}), then A-write again ({B} ∩
+        # {A} = ∅ -> race). Events keep both threads alive throughout,
+        # so their idents are distinct.
+        turn1 = threading.Event()
+        turn2 = threading.Event()
+
+        def w1():
+            with a:
+                cells.write("count")
+            turn1.set()
+            turn2.wait(5)
+            with a:
+                cells.write("count")
+
+        def w2():
+            turn1.wait(5)
+            with b:
+                cells.write("count")
+            turn2.set()
+
+        threads = [threading.Thread(target=w1), threading.Thread(target=w2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert st.snapshot()["lockset_race_count"] >= 1
+
+    def test_eraser_init_then_handoff_is_silent(self):
+        """virgin -> exclusive covers the publication idiom: one thread
+        initializes, others only read afterwards — shared, never
+        shared-modified, no race regardless of locks."""
+        import threading
+
+        from torrent_tpu.analysis.sanitizer import TsanState, guarded_cell
+
+        st = TsanState()
+        cell = guarded_cell("h.cell", state=st)
+        for _ in range(10):
+            cell.write()  # creator initializes, unlocked
+        gate = threading.Barrier(2)
+
+        def r():
+            gate.wait()
+            for _ in range(50):
+                cell.read()
+
+        threads = [threading.Thread(target=r) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert st.snapshot()["lockset_race_count"] == 0
+
+    def test_eraser_race_reported_once_per_cell(self):
+        import threading
+
+        from torrent_tpu.analysis.sanitizer import TsanState, guard_attrs
+
+        st = TsanState()
+        cells = guard_attrs("once.obj", "count", state=st)
+        gate = threading.Barrier(2)
+
+        def w():
+            gate.wait()
+            for _ in range(200):
+                cells.write("count")
+
+        threads = [threading.Thread(target=w) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert st.snapshot()["lockset_race_count"] == 1
+
+    def test_guard_attrs_null_when_disabled(self, monkeypatch):
+        from torrent_tpu.analysis import sanitizer
+
+        monkeypatch.delenv("TORRENT_TPU_TSAN", raising=False)
+        monkeypatch.setattr(sanitizer, "_enabled", False)
+        cells = sanitizer.guard_attrs("off.obj", "x")
+        assert cells is sanitizer._NULL_CELLS
+        cells.write("x")  # no-ops accept any cell name
+        cells.read("anything")
+        cell = sanitizer.guarded_cell("off.cell")
+        assert cell is sanitizer._NULL_CELL
+        cell.write()
+        cell.read()
+
+    def test_lockset_metrics_render(self):
+        import threading
+
+        from torrent_tpu.analysis.sanitizer import TsanState, guard_attrs
+        from torrent_tpu.utils.metrics import render_tsan_metrics
+
+        st = TsanState()
+        cells = guard_attrs("m.obj", "state", state=st)
+        gate = threading.Barrier(2)
+
+        def w():
+            gate.wait()
+            cells.write("state")
+
+        threads = [threading.Thread(target=w) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        text = render_tsan_metrics(st.snapshot())
+        assert 'torrent_tpu_guarded_cells{cell="m.obj.state"} 1' in text
+        assert "torrent_tpu_lockset_races_total 1" in text
 
     def test_tsan_metrics_render(self):
         from torrent_tpu.analysis.sanitizer import SanitizedLock, TsanState

@@ -611,3 +611,146 @@ class TestMutationCorpusFuzz:
                 unpack_compact_v6(data)
             except ValueError:
                 pass
+
+
+# ------------------------------------------------ analysis-pass fuzzing
+
+
+class TestAnalysisPassProperties:
+    """The guarded-state and lifecycle passes run over every PR as a
+    gate: they must never crash on any syntactically valid class body,
+    and must never emit two findings with the same baseline key (keys
+    are the baseline's identity — duplicates would make entries
+    ambiguous). Class bodies are synthesized from a small statement
+    grammar (attribute reads/writes, lock scopes, try/finally,
+    checkout/checkin pairs, ledger/tracer CM calls, intra-class calls)
+    so the fuzz walks exactly the shapes the passes reason about.
+    """
+
+    ATTRS = ("a", "b", "memo")
+    LOCKS = ("_lock", "_counter_lock", "big_lock")
+    METHODS = ("m0", "m1", "_m2", "_m3_locked")
+
+    @classmethod
+    def _grammar(cls):
+        leaf = st.sampled_from([
+            ("write", a) for a in cls.ATTRS
+        ] + [
+            ("aug", a) for a in cls.ATTRS
+        ] + [
+            ("read", a) for a in cls.ATTRS
+        ] + [
+            ("mutcall", a) for a in cls.ATTRS
+        ] + [
+            ("call", m) for m in cls.METHODS
+        ] + [
+            ("checkout", None),
+            ("checkin", None),
+            ("track", None),
+            ("span", None),
+            ("track_with", None),
+            ("return_checkout", None),
+            ("pass", None),
+        ]).map(lambda t: ("leaf", t))
+        return st.recursive(
+            st.lists(leaf, min_size=1, max_size=4),
+            lambda body: st.one_of(
+                st.tuples(st.sampled_from(cls.LOCKS), body).map(
+                    lambda t: [("with", t[0], t[1])]
+                ),
+                st.tuples(body, body).map(
+                    lambda t: [("try", t[0], t[1])]
+                ),
+                st.tuples(body).map(lambda t: [("for", t[0])]),
+            ),
+            max_leaves=12,
+        )
+
+    @classmethod
+    def _render(cls, body, indent):
+        pad = "    " * indent
+        lines = []
+        for node in body:
+            kind = node[0]
+            if kind == "leaf":
+                op, arg = node[1]
+                if op == "write":
+                    lines.append(f"{pad}self.{arg} = 1")
+                elif op == "aug":
+                    lines.append(f"{pad}self.{arg} += 1")
+                elif op == "read":
+                    lines.append(f"{pad}x = self.{arg}")
+                elif op == "mutcall":
+                    lines.append(f"{pad}self.{arg}.append(1)")
+                elif op == "call":
+                    lines.append(f"{pad}self.{arg}()")
+                elif op == "checkout":
+                    lines.append(f"{pad}slot = self.pool.checkout()")
+                elif op == "checkin":
+                    lines.append(f"{pad}self.pool.checkin(slot)")
+                elif op == "track":
+                    lines.append(f"{pad}t = self.ledger.track('read', 1)")
+                elif op == "span":
+                    lines.append(f"{pad}tracer().span('stage')")
+                elif op == "track_with":
+                    lines.append(f"{pad}with self.ledger.track('read', 1):")
+                    lines.append(f"{pad}    pass")
+                elif op == "return_checkout":
+                    lines.append(f"{pad}return self.pool.checkout()")
+                else:
+                    lines.append(f"{pad}pass")
+            elif kind == "with":
+                lines.append(f"{pad}with self.{node[1]}:")
+                lines.extend(cls._render(node[2], indent + 1))
+            elif kind == "try":
+                lines.append(f"{pad}try:")
+                lines.extend(cls._render(node[1], indent + 1))
+                lines.append(f"{pad}finally:")
+                lines.extend(cls._render(node[2], indent + 1))
+            elif kind == "for":
+                lines.append(f"{pad}for _i in range(2):")
+                lines.extend(cls._render(node[1], indent + 1))
+        return lines
+
+    @classmethod
+    def _source(cls, bodies):
+        lines = [
+            "import threading",
+            "",
+            "class Fuzzed:",
+            "    def __init__(self):",
+        ]
+        for lock in cls.LOCKS:
+            lines.append(f"        self.{lock} = threading.Lock()")
+        for attr in cls.ATTRS:
+            lines.append(f"        self.{attr} = 0")
+        for name, body in zip(cls.METHODS, bodies):
+            lines.append("")
+            lines.append(f"    def {name}(self):")
+            lines.extend(cls._render(body, 2))
+        return "\n".join(lines) + "\n"
+
+    @given(st.data())
+    @settings(max_examples=40, deadline=None)
+    def test_passes_never_crash_nor_duplicate_keys(self, data):
+        import ast
+        import pathlib
+        import tempfile
+
+        from torrent_tpu.analysis.passes import run_passes
+
+        grammar = self._grammar()
+        bodies = [data.draw(grammar) for _ in self.METHODS]
+        src = self._source(bodies)
+        ast.parse(src)  # valid by construction; fail loudly if not
+        with tempfile.TemporaryDirectory() as tmp:
+            root = pathlib.Path(tmp) / "pkg"
+            root.mkdir()
+            (root / "mod.py").write_text(src)
+            findings, _ = run_passes(root, ["guarded-state", "lifecycle"])
+        keys = [f.key for f in findings]
+        assert len(keys) == len(set(keys)), src
+        for f in findings:
+            assert f.pass_name in ("guarded-state", "lifecycle")
+            assert f.path == "pkg/mod.py"
+            assert f.line >= 1

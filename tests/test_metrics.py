@@ -143,6 +143,23 @@ class TestRendererEdgeCases:
         text = render_tsan_metrics({})
         prom_lint(text)
         assert "torrent_tpu_lock_order_cycles_total 0" in text
+        assert "torrent_tpu_lockset_races_total 0" in text
+
+    def test_tsan_renderer_lockset_series(self):
+        """The Eraser's guarded-cell/race series render as valid
+        Prometheus text with per-cell labels."""
+        from torrent_tpu.analysis.sanitizer import TsanState, guard_attrs
+        from torrent_tpu.utils.metrics import render_tsan_metrics
+
+        st = TsanState()
+        guard_attrs("m.breaker", "state", state=st)
+        guard_attrs("m.slab", "refs", state=st)
+        guard_attrs("m.slab", "refs", state=st)  # second instance
+        text = render_tsan_metrics(st.snapshot())
+        prom_lint(text)
+        assert 'torrent_tpu_guarded_cells{cell="m.breaker.state"} 1' in text
+        assert 'torrent_tpu_guarded_cells{cell="m.slab.refs"} 2' in text
+        assert "torrent_tpu_lockset_races_total 0" in text
 
     def test_obs_render_lints(self):
         from torrent_tpu.obs import histograms, render_obs_metrics

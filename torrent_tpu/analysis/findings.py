@@ -59,6 +59,18 @@ class BaselineDiff:
     stale: list = field(default_factory=list)      # BaselineEntries no current finding matches
 
 
+def dedupe_findings(findings) -> list:
+    """One finding per key — the earliest site. Several sites of one
+    hazard share one baseline entry anyway, so extra sites add noise,
+    not signal. Output order is deterministic (path, line, message)."""
+    best: dict[str, Finding] = {}
+    for f in findings:
+        prev = best.get(f.key)
+        if prev is None or f.line < prev.line:
+            best[f.key] = f
+    return sorted(best.values(), key=lambda f: (f.path, f.line, f.message))
+
+
 def load_baseline(path) -> dict[str, BaselineEntry]:
     """Baseline file -> {key: entry}. A missing file is an empty
     baseline (every finding is new), not an error."""

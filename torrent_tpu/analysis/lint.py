@@ -1,6 +1,6 @@
 """``torrent-tpu lint`` / ``python -m torrent_tpu.analysis`` — the gate.
 
-Runs the four analysis passes over the package and compares the
+Runs the six analysis passes over the package and compares the
 findings against the committed baseline (``torrent_tpu/
 analysis_baseline.json``): exit 0 when every finding is baselined (each baseline
 entry carries a reviewed justification), exit 1 on any NEW finding.
@@ -9,7 +9,8 @@ fail — refresh with ``--update-baseline``.
 
     torrent-tpu lint                      # gate against the baseline
     torrent-tpu lint --json               # machine-readable findings
-    torrent-tpu lint --graph              # dump the lock-order graph
+    torrent-tpu lint --graph              # lock-order graph + attr->guard map
+    torrent-tpu lint --sarif out.sarif    # SARIF 2.1.0 report (CI annotations)
     torrent-tpu lint --update-baseline    # re-baseline (keeps justifications)
     torrent-tpu lint --no-baseline        # raw findings, exit 1 if any
 """
@@ -26,8 +27,79 @@ from torrent_tpu.analysis.findings import (
     load_baseline,
     save_baseline,
 )
-from torrent_tpu.analysis.passes import ALL_PASS_NAMES, run_passes
+from torrent_tpu.analysis.passes import ALL_PASS_NAMES, PASSES, run_passes
+from torrent_tpu.analysis.passes import guarded_state as _guarded_state
 from torrent_tpu.analysis.passes import lock_order as _lock_order
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _pass_rule(name: str) -> dict:
+    """One SARIF reportingDescriptor per analysis pass, described from
+    the pass module's own docstring headline."""
+    mod = PASSES[name]
+    doc = (mod.__doc__ or "").strip().splitlines()
+    head = doc[0].split("—", 1)[-1].strip() if doc else name
+    return {
+        "id": name,
+        "name": name,
+        "shortDescription": {"text": head or name},
+    }
+
+
+def sarif_report(findings, baseline) -> dict:
+    """SARIF 2.1.0 document for ALL findings. Baselined findings carry
+    an ``external`` suppression with the reviewed justification, so CI
+    diff annotators show only the new ones while the full debt list
+    stays machine-readable."""
+    results = []
+    for f in findings:
+        entry = baseline.get(f.key)
+        # URIs stay repo-relative with no uriBaseId: consumers (GitHub
+        # code scanning et al.) resolve them against the checkout root,
+        # which is exactly where "torrent_tpu/..." paths live
+        result = {
+            "ruleId": f.pass_name,
+            "level": "error",
+            "message": {"text": f"{f.message} ({f.symbol})"},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": max(1, f.line)},
+                    }
+                }
+            ],
+            "partialFingerprints": {"torrentTpuFindingKey": f.key},
+        }
+        if entry is not None:
+            result["suppressions"] = [
+                {
+                    "kind": "external",
+                    "justification": entry.justification,
+                }
+            ]
+        results.append(result)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "torrent-tpu-lint",
+                        "informationUri": "https://github.com/rclarey/torrent",
+                        "rules": [_pass_rule(n) for n in ALL_PASS_NAMES],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
 
 
 def default_root() -> Path:
@@ -71,7 +143,13 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", help="JSON findings report")
     ap.add_argument(
         "--graph", action="store_true",
-        help="also dump the static lock-acquisition graph",
+        help="also dump the static lock-acquisition graph and the "
+        "inferred attr->guard map",
+    )
+    ap.add_argument(
+        "--sarif", default=None, metavar="PATH",
+        help="also write findings as SARIF 2.1.0 (baselined findings "
+        "carry their justification as a suppression)",
     )
     args = ap.parse_args(argv)
 
@@ -95,6 +173,9 @@ def main(argv=None) -> int:
         print("# static lock-acquisition graph")
         print(_lock_order.render_graph(index) or "(no edges)")
         print()
+        print("# inferred attribute guards (guarded-state pass)")
+        print(_guarded_state.render_guard_map(index) or "(no guarded attributes)")
+        print()
 
     if args.update_baseline:
         if pass_names is not None:
@@ -110,10 +191,32 @@ def main(argv=None) -> int:
         prev = load_baseline(baseline_path)
         save_baseline(findings, baseline_path, keep=prev)
         print(f"baseline written: {baseline_path} ({len(findings)} findings)")
+        if args.sarif:
+            # suppressions come from the baseline just written, so the
+            # artifact and the gate agree
+            doc = sarif_report(findings, load_baseline(baseline_path))
+            with open(args.sarif, "w") as fh:
+                json.dump(doc, fh, indent=2)
+                fh.write("\n")
+            print(
+                f"sarif written: {args.sarif} ({len(findings)} results)",
+                file=sys.stderr,
+            )
         return 0
 
     baseline = {} if args.no_baseline else load_baseline(baseline_path)
     diff = diff_baseline(findings, baseline)
+
+    if args.sarif:
+        doc = sarif_report(findings, baseline)
+        with open(args.sarif, "w") as fh:
+            json.dump(doc, fh, indent=2)
+            fh.write("\n")
+        # stderr: --sarif composes with --json, whose stdout is a document
+        print(
+            f"sarif written: {args.sarif} ({len(findings)} results)",
+            file=sys.stderr,
+        )
 
     if args.json:
         print(

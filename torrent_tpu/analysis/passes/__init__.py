@@ -16,6 +16,8 @@ from torrent_tpu.analysis.passes import (
     blocking_async,
     determinism,
     device_under_lock,
+    guarded_state,
+    lifecycle,
     lock_order,
 )
 from torrent_tpu.analysis.passes.common import ModuleFile, PackageIndex
@@ -25,6 +27,8 @@ PASSES = {
     blocking_async.PASS_NAME: blocking_async,
     device_under_lock.PASS_NAME: device_under_lock,
     determinism.PASS_NAME: determinism,
+    guarded_state.PASS_NAME: guarded_state,
+    lifecycle.PASS_NAME: lifecycle,
 }
 
 ALL_PASS_NAMES = tuple(PASSES)

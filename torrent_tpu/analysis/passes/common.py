@@ -1,6 +1,6 @@
 """Shared AST machinery for the analysis passes.
 
-The passes all reason about the same three facts, so they are computed
+The passes all reason about the same few facts, so they are computed
 once per lint run in a :class:`PackageIndex`:
 
 * **who acquires what** — every ``with <lock>`` / ``<lock>.acquire()``
@@ -12,7 +12,11 @@ once per lint run in a :class:`PackageIndex`:
   fixpoint;
 * **who enters the device** — calls that dispatch compiled work
   (``digest_batch``, the pallas kernels, ``jnp.*`` / ``jax.*`` rooted
-  calls, collectives).
+  calls, collectives);
+* **who touches what state** — every ``self.<attr>`` read/write site
+  with the held-set at that point (direct stores, container stores,
+  and known mutating method calls all count as writes), the raw
+  material of the guarded-state lockset pass.
 
 Lock identity is the *attribute name* (``_device_lock``,
 ``build_lock``, ``_counter_lock`` …): instances of a lane's
@@ -113,6 +117,48 @@ class DeviceSite:
 
 
 @dataclass
+class AttrSite:
+    """One ``self.<attr>`` access with the locks held at that point.
+
+    ``write`` covers direct stores (``self.x = …``, ``self.x += …``,
+    ``del self.x``), container stores through the attribute
+    (``self.x[k] = v``, ``del self.x[k]``), and calls of known mutating
+    methods on the attribute (``self.x.append(…)``); everything else is
+    a read. Attributes whose own name looks like a lock are not
+    recorded — they are the guards, not the guarded."""
+
+    attr: str
+    held: tuple[str, ...]
+    line: int
+    write: bool
+
+
+# container/collection methods that mutate their receiver: a call
+# ``self.x.<m>(…)`` with m here is a WRITE of x for lockset purposes
+MUTATING_METHODS = frozenset(
+    {
+        "append", "appendleft", "extend", "extendleft", "insert",
+        "add", "update", "setdefault", "pop", "popleft", "popitem",
+        "remove", "discard", "clear", "sort", "reverse",
+        "move_to_end",
+    }
+)
+
+
+def self_attr(expr) -> str | None:
+    """``x`` when ``expr`` is exactly ``self.x`` (and x is not itself a
+    lock name), else None."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+        and not expr.attr.lower().endswith("lock")
+    ):
+        return expr.attr
+    return None
+
+
+@dataclass
 class FunctionInfo:
     module: str             # repo-relative posix path
     cls: str | None
@@ -122,6 +168,7 @@ class FunctionInfo:
     acquires: list[AcquireSite] = field(default_factory=list)
     calls: list[CallSite] = field(default_factory=list)
     device: list[DeviceSite] = field(default_factory=list)
+    attrs: list[AttrSite] = field(default_factory=list)
 
     @property
     def qualname(self) -> str:
@@ -210,12 +257,45 @@ class _FnWalker:
     # ------------------------------------------------------ expressions
 
     def _expr(self, expr, held: tuple[str, ...]) -> None:
-        for node in ast.walk(expr):
+        # receivers of a mutation recorded as writes below; their own
+        # Load node must not double-record as a read
+        consumed: set[int] = set()
+        nodes = list(ast.walk(expr))
+        for node in nodes:
             if isinstance(node, ast.Call):
                 self.info.calls.append(CallSite(node.func, held, node.lineno))
                 token = is_device_call(node)
                 if token:
                     self.info.device.append(DeviceSite(token, held, node.lineno))
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in MUTATING_METHODS
+                ):
+                    attr = self_attr(node.func.value)
+                    if attr:
+                        consumed.add(id(node.func.value))
+                        self.info.attrs.append(
+                            AttrSite(attr, held, node.lineno, True)
+                        )
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ):
+                attr = self_attr(node.value)
+                if attr:
+                    consumed.add(id(node.value))
+                    self.info.attrs.append(AttrSite(attr, held, node.lineno, True))
+        for node in nodes:
+            if isinstance(node, ast.Attribute) and id(node) not in consumed:
+                attr = self_attr(node)
+                if attr:
+                    self.info.attrs.append(
+                        AttrSite(
+                            attr,
+                            held,
+                            node.lineno,
+                            isinstance(node.ctx, (ast.Store, ast.Del)),
+                        )
+                    )
 
 
 # ------------------------------------------------------------- indexing

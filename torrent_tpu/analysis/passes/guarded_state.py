@@ -1,0 +1,437 @@
+"""``guarded-state`` — Eraser-style lockset inference for shared state.
+
+The lock-order pass gates *how* locks nest and device-under-lock gates
+*what runs under them*; nothing checked that shared mutable state is
+guarded at all. This pass closes that hole statically, per class that
+constructs a lock:
+
+1. every ``self.<attr>`` read/write site is collected together with the
+   lock set held there (``common.AttrSite`` — the same held-set
+   machinery the other passes use), with held-sets propagated into
+   private helpers through the resolved call graph: a ``_helper`` whose
+   every intra-class call site holds ``_lock`` effectively runs under
+   ``_lock`` (the ``*_locked`` convention, verified instead of trusted);
+2. each attribute's **guard** is inferred as the intersection of locks
+   held across its post-``__init__`` mutation sites (Eraser's C(v)
+   rule applied statically);
+3. findings:
+
+   * **unguarded mutation** — the attribute has a non-empty inferred
+     (or annotated) guard, but this mutation site holds none of it:
+     the lockset has emptied, the classic Eraser report;
+   * **mixed guards** — every mutation site is locked but no single
+     lock is common to all of them (two locks each "guarding" half the
+     sites guard nothing);
+   * **unguarded read** — a read of a guard-mutated attribute holding
+     no part of the guard, in a function reachable from a thread or
+     coroutine entry point (``async def``, a ``Thread(target=…)`` /
+     ``to_thread`` / executor-submit target, or any public callable —
+     i.e. somewhere a second thread can actually be).
+
+Exemptions (what keeps the pass precise enough to gate):
+
+* ``__init__``/``__post_init__``/``__new__``/``__del__`` bodies —
+  publication: the object is not shared yet (or no longer);
+* immutable-after-start — attributes never mutated outside the exempt
+  methods have nothing to guard;
+* loop-confined state — attributes never mutated under ANY lock carry
+  no inferred guard and stay silent (the event-loop single-writer
+  discipline is the blocking-in-async pass's domain, not this one's);
+* annotations — a ``# guarded-by: <lock>`` comment on any assignment
+  line of the attribute pins its guard (mutations/reads are checked
+  against the declaration instead of the inference), and
+  ``# guarded-by: none`` declares the attribute deliberately unguarded
+  (documented loop-confinement / benign monotonic flag) and exempts it
+  entirely.
+
+Like every static pass here this under-approximates: cross-object
+mutations (``lane.x += 1`` from the scheduler) and ambiguous calls are
+not traversed — the dynamic lockset checker in ``analysis/sanitizer.py``
+(``guard_attrs``) is the runtime complement on exactly those seams.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from torrent_tpu.analysis.findings import Finding, dedupe_findings
+from torrent_tpu.analysis.passes.common import (
+    AttrSite,
+    FunctionInfo,
+    PackageIndex,
+)
+
+PASS_NAME = "guarded-state"
+
+# publication scopes: the object is not yet (or no longer) shared
+EXEMPT_METHODS = frozenset({"__init__", "__post_init__", "__new__", "__del__"})
+
+# annotation syntax: "# guarded-by: <lock-attr>" or "# guarded-by: none"
+_ANNOTATION_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z_0-9]*|none)")
+
+# call shapes whose function-valued argument runs on another thread
+_THREAD_HANDOFF_TAILS = frozenset(
+    {"to_thread", "submit", "run_in_executor", "call_soon_threadsafe",
+     "start_new_thread"}
+)
+
+# fixpoint sentinel: "called only from contexts we have not resolved yet"
+_TOP = None
+
+
+def _annotations(source: str) -> dict[int, str]:
+    """{lineno: guard-name} for every ``# guarded-by:`` comment line."""
+    out: dict[int, str] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _ANNOTATION_RE.search(line)
+        if m:
+            out[i] = m.group(1)
+    return out
+
+
+def _class_locks(fns: list[FunctionInfo]) -> set[str]:
+    """Lock attributes this class constructs: ``self.<x>lock = <call>``
+    anywhere in its methods (``named_lock(…)``, ``threading.Lock()`` —
+    the constructor call is the signal; storing ``None`` or a borrowed
+    lock does not make the class a lock owner)."""
+    locks: set[str] = set()
+    for fn in fns:
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                    and tgt.attr.lower().endswith("lock")
+                ):
+                    locks.add(tgt.attr)
+    return locks
+
+
+def _thread_target_names(index: PackageIndex) -> set[str]:
+    """Bare/tail names of callables handed to another thread anywhere in
+    the package: ``Thread(target=f)``, ``asyncio.to_thread(f, …)``,
+    ``pool.submit(f, …)``, ``loop.run_in_executor(None, f)`` …"""
+    names: set[str] = set()
+
+    def _callable_name(arg) -> str | None:
+        if isinstance(arg, ast.Name):
+            return arg.id
+        if isinstance(arg, ast.Attribute):
+            return arg.attr
+        return None
+
+    for mf in index.files:
+        for node in ast.walk(mf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    n = _callable_name(kw.value)
+                    if n:
+                        names.add(n)
+            tail = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else node.func.id
+                if isinstance(node.func, ast.Name)
+                else None
+            )
+            if tail in _THREAD_HANDOFF_TAILS:
+                for arg in node.args:
+                    n = _callable_name(arg)
+                    if n:
+                        names.add(n)
+    return names
+
+
+def _entry_reachable(index: PackageIndex) -> set[int]:
+    """ids of FunctionInfos reachable (via resolved calls) from a
+    thread/coroutine entry point: coroutines, thread-handoff targets,
+    dunders, and public callables (a second thread can start at any of
+    them)."""
+    targets = _thread_target_names(index)
+    reach: set[int] = set()
+    for fn in index.functions:
+        if (
+            fn.is_async
+            or not fn.name.startswith("_")
+            or (fn.name.startswith("__") and fn.name.endswith("__"))
+            or fn.name in targets
+        ):
+            reach.add(id(fn))
+    changed = True
+    while changed:
+        changed = False
+        for fn in index.functions:
+            if id(fn) not in reach:
+                continue
+            for site in fn.calls:
+                callee = index.resolve(fn, site)
+                if callee is not None and id(callee) not in reach:
+                    reach.add(id(callee))
+                    changed = True
+    return reach
+
+
+# cap on tracked caller contexts per method; past it, collapse to the
+# single intersection context (precision degrades, soundness direction
+# preserved: the intersection holds in EVERY context)
+_MAX_CONTEXTS = 8
+
+
+def _caller_contexts(
+    index: PackageIndex, fns: list[FunctionInfo]
+) -> dict[int, frozenset[frozenset[str]]]:
+    """Per-method set of caller lock contexts.
+
+    Public methods and dunders get ``{∅}`` (anyone may call them bare).
+    A private method accumulates one context per intra-class call chain:
+    the locks held at the call site ∪ each of the caller's own contexts,
+    iterated to a fixpoint — so an access inside ``_helper`` is checked
+    once per distinct way the class reaches ``_helper``. This is what
+    both *verifies* the ``_locked``-suffix convention (every context
+    holds the lock) and *catches* the lockset-empties-via-call hazard
+    (one locked context, one bare context → the intersection is empty).
+    Private methods with no resolved intra-class callers get ``{∅}``
+    (they may be callbacks handed elsewhere)."""
+    ids = {id(fn) for fn in fns}
+    bare = frozenset([frozenset()])
+    ctxs: dict[int, frozenset[frozenset[str]] | None] = {}
+    pinned: set[int] = set()  # public/dunder: always callable bare
+    for fn in fns:
+        public = not fn.name.startswith("_") or (
+            fn.name.startswith("__") and fn.name.endswith("__")
+        )
+        ctxs[id(fn)] = bare if public else _TOP
+        if public:
+            pinned.add(id(fn))
+    # intra-class call edges: callee id -> [(caller id, held at site)]
+    callers: dict[int, list[tuple[int, frozenset[str]]]] = {}
+    for fn in fns:
+        for site in fn.calls:
+            callee = index.resolve(fn, site)
+            if callee is None or id(callee) not in ids:
+                continue
+            callers.setdefault(id(callee), []).append(
+                (id(fn), frozenset(site.held))
+            )
+    for _ in range(len(fns) + 2):
+        changed = False
+        for fn in fns:
+            k = id(fn)
+            if k in pinned:
+                continue
+            contributions: set[frozenset[str]] = set()
+            unresolved = False
+            for caller_id, held in callers.get(k, ()):
+                c = ctxs.get(caller_id, bare)
+                if c is _TOP:
+                    unresolved = True
+                    continue
+                contributions.update(held | cc for cc in c)
+            if not contributions:
+                if k in callers and unresolved:
+                    continue  # only unresolved (cyclic) callers so far
+                # no intra-class callers at all: may be a callback
+                new: frozenset[frozenset[str]] | None = bare
+            else:
+                if len(contributions) > _MAX_CONTEXTS:
+                    meet = None
+                    for c in contributions:
+                        meet = c if meet is None else (meet & c)
+                    contributions = {meet}
+                new = frozenset(contributions)
+            if new != ctxs[k]:
+                ctxs[k] = new
+                changed = True
+        if not changed:
+            break
+    # anything still TOP is only reachable through unresolved cycles
+    return {k: (bare if v is _TOP else v) for k, v in ctxs.items()}
+
+
+def _class_groups(
+    index: PackageIndex,
+) -> dict[tuple[str, str], list[FunctionInfo]]:
+    groups: dict[tuple[str, str], list[FunctionInfo]] = {}
+    for fn in index.functions:
+        if fn.cls is not None:
+            groups.setdefault((fn.module, fn.cls), []).append(fn)
+    return groups
+
+
+class AttrGuard:
+    """Inference result for one class attribute (``render_guard_map``
+    and the finding logic share it)."""
+
+    __slots__ = ("cls", "attr", "guard", "source", "module")
+
+    def __init__(self, cls: str, attr: str, guard: frozenset[str],
+                 source: str, module: str):
+        self.cls = cls
+        self.attr = attr
+        self.guard = guard      # empty = no guard
+        self.source = source    # 'inferred' | 'annotated' | 'annotated-none'
+                                # | 'mixed' | 'unguarded'
+        self.module = module
+
+    @property
+    def guard_str(self) -> str:
+        return "+".join(sorted(self.guard)) if self.guard else "none"
+
+
+def _declared_guards(
+    fns: list[FunctionInfo], ann: dict[int, str]
+) -> dict[str, str]:
+    """{attr: declared guard} from ``# guarded-by:`` comments sitting on
+    the attribute's write lines."""
+    out: dict[str, str] = {}
+    if not ann:
+        return out
+    for fn in fns:
+        for site in fn.attrs:
+            if site.write and site.line in ann:
+                out[site.attr] = ann[site.line]
+    return out
+
+
+def _analyze_class(
+    index: PackageIndex,
+    module: str,
+    cls: str,
+    fns: list[FunctionInfo],
+    ann: dict[int, str],
+    reachable: set[int],
+    findings: list[Finding],
+    guards_out: list[AttrGuard] | None = None,
+) -> None:
+    locks = _class_locks(fns)
+    if not locks:
+        return
+    ctxs = _caller_contexts(index, fns)
+    declared = _declared_guards(fns, ann)
+
+    # per-attr post-publication access sites, each expanded to one
+    # virtual site per caller context: effs = {local held ∪ c}
+    Sites = dict[str, list[tuple[FunctionInfo, AttrSite, list[frozenset[str]]]]]
+    writes: Sites = {}
+    reads: Sites = {}
+    for fn in fns:
+        if fn.name in EXEMPT_METHODS:
+            continue
+        for site in fn.attrs:
+            held = frozenset(site.held)
+            effs = [held | c for c in ctxs[id(fn)]]
+            (writes if site.write else reads).setdefault(site.attr, []).append(
+                (fn, site, effs)
+            )
+
+    for attr in sorted(set(writes) | set(declared)):
+        decl = declared.get(attr)
+        if decl == "none":
+            if guards_out is not None:
+                guards_out.append(
+                    AttrGuard(cls, attr, frozenset(), "annotated-none", module)
+                )
+            continue
+        w = writes.get(attr, [])
+        if not w:
+            continue  # immutable after publication
+        if decl is not None:
+            guard = frozenset({decl})
+            source = "annotated"
+        else:
+            locked = [
+                eff for _, _, effs in w for eff in effs if eff
+            ]
+            if not locked:
+                # never mutated under any lock: loop-confined by
+                # discipline, no guard to enforce
+                if guards_out is not None:
+                    guards_out.append(
+                        AttrGuard(cls, attr, frozenset(), "unguarded", module)
+                    )
+                continue
+            guard = locked[0]
+            for eff in locked[1:]:
+                guard = guard & eff
+            if not guard:
+                fn0, s0, _ = min(w, key=lambda t: t[1].line)
+                findings.append(
+                    Finding(
+                        PASS_NAME, module, s0.line, fn0.qualname,
+                        f"{cls}.{attr} has mixed guards: no lock is common "
+                        "to all of its mutation sites",
+                    )
+                )
+                if guards_out is not None:
+                    guards_out.append(
+                        AttrGuard(cls, attr, frozenset(), "mixed", module)
+                    )
+                continue
+            source = "inferred"
+        if guards_out is not None:
+            guards_out.append(AttrGuard(cls, attr, guard, source, module))
+        for fn, s, effs in w:
+            if any(not (eff & guard) for eff in effs):
+                findings.append(
+                    Finding(
+                        PASS_NAME, module, s.line, fn.qualname,
+                        f"mutation of {cls}.{attr} outside its guard "
+                        f"{'+'.join(sorted(guard))} empties the lockset",
+                    )
+                )
+        for fn, s, effs in reads.get(attr, []):
+            if id(fn) not in reachable:
+                continue
+            if any(not (eff & guard) for eff in effs):
+                findings.append(
+                    Finding(
+                        PASS_NAME, module, s.line, fn.qualname,
+                        f"unguarded read of {cls}.{attr} (guard "
+                        f"{'+'.join(sorted(guard))}) reachable from a "
+                        "thread/coroutine entry",
+                    )
+                )
+
+
+def run(index: PackageIndex, files=None) -> list[Finding]:
+    findings: list[Finding] = []
+    reachable = _entry_reachable(index)
+    ann_by_module = {mf.path: _annotations(mf.source) for mf in index.files}
+    for (module, cls), fns in sorted(_class_groups(index).items()):
+        _analyze_class(
+            index, module, cls, fns, ann_by_module.get(module, {}),
+            reachable, findings,
+        )
+    return dedupe_findings(findings)
+
+
+def guard_map(index: PackageIndex) -> list[AttrGuard]:
+    """The inferred attr→guard table (``lint --graph`` and docs)."""
+    guards: list[AttrGuard] = []
+    reachable = _entry_reachable(index)
+    ann_by_module = {mf.path: _annotations(mf.source) for mf in index.files}
+    scratch: list[Finding] = []
+    for (module, cls), fns in sorted(_class_groups(index).items()):
+        _analyze_class(
+            index, module, cls, fns, ann_by_module.get(module, {}),
+            reachable, scratch, guards_out=guards,
+        )
+    return guards
+
+
+def render_guard_map(index: PackageIndex) -> str:
+    """Human-readable attr→guard dump, one line per guarded attribute."""
+    lines = []
+    for g in guard_map(index):
+        lines.append(
+            f"{g.cls}.{g.attr} -> {g.guard_str}  [{g.source}] {g.module}"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,234 @@
+"""``lifecycle`` — acquire/release pairing on refcounted seams.
+
+Lockset analysis can prove an access is guarded; it cannot prove a
+checked-out resource is returned. The zero-copy ingest plane (PR 8)
+runs on exactly such seams: a staging slot checked out of
+``_StagingSlots`` and never checked back in permanently shrinks the
+pool, a leaked :class:`~torrent_tpu.sched.scheduler.StagedSlab`
+reference keeps its slot out of circulation forever, and both leak
+silently — throughput degrades launch by launch with no error. This
+pass checks the pairing statically, per function:
+
+* **checkout pairing** — a call to ``checkout()`` / ``checkout_staging()``
+  whose result stays in the function (not returned, not stored on
+  ``self``) must be protected by an exception edge: the paired release
+  (``checkin``/``release``) has to appear inside a ``finally`` block or
+  an ``except`` handler. A release only in straight-line code leaks the
+  slot the first time the body raises; no release at all leaks it every
+  time.
+* **ownership transfer** is exempt: a checkout inside a ``return``
+  expression, or whose result is assigned to ``self.<attr>``, hands the
+  obligation to the caller / the object lifetime (``checkout_staging``
+  itself does both — the docstring contract passes the release duty to
+  the reader).
+* **context-manager discipline** — ``pipeline_ledger().track(…)`` and
+  ``tracer().span(…)`` return context managers whose ``__exit__`` IS
+  the accounting: calling either outside a ``with`` item opens a stage
+  entry / span that never closes (the ledger's occupancy counts drift
+  up, the span never lands in the ring). Both must appear as the
+  context expression of a ``with`` statement.
+
+Like the other passes this is deliberately shallow on aliasing: it
+reasons per function over names, and the dynamic leak counters
+(``_StagingSlots.outstanding``, asserted by tests, plus the sanitizer's
+guarded cells) cover what escapes it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from torrent_tpu.analysis.findings import Finding, dedupe_findings
+from torrent_tpu.analysis.passes.common import PackageIndex, tail_name
+
+PASS_NAME = "lifecycle"
+
+# acquire tail-names and the release tail-names of the resource family.
+# Pairing accepts any release tail of the family (the APIs alias: a raw
+# slot checkout pairs with checkin, but a checkout wrapped in a
+# StagedSlab — or reached through a `checkout = getattr(...)` alias —
+# pairs with the wrapper's release), BUT the release must reference the
+# checked-out variable: an unrelated `sem.release()` in a finally must
+# not mask a slot leak.
+ACQUIRE_TAILS = frozenset({"checkout", "checkout_staging"})
+RELEASE_TAILS = frozenset({"checkin", "release"})
+
+# context-manager-only calls: tail name -> receiver tails that identify
+# the real API (``.track(`` on anything else is not the ledger)
+CM_ONLY: dict[str, frozenset[str]] = {
+    "track": frozenset({"ledger", "_ledger", "pipeline_ledger"}),
+    "span": frozenset({"tracer", "_tracer"}),
+}
+
+
+def _receiver_tail(call: ast.Call) -> str | None:
+    """Tail name of the call's receiver: ``ledger`` for
+    ``self.ledger.track(…)``, ``pipeline_ledger`` for
+    ``pipeline_ledger().track(…)``."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    recv = call.func.value
+    if isinstance(recv, ast.Call):
+        return tail_name(recv.func)
+    return tail_name(recv)
+
+
+class _FnScan(ast.NodeVisitor):
+    """One pass over a function body (nested defs excluded — they get
+    their own FunctionInfo) collecting every fact the rules need."""
+
+    def __init__(self):
+        # (api, line, result var name or None)
+        self.acquires: list[tuple[str, int, str | None]] = []
+        self.transferred: set[int] = set()             # id() of exempt calls
+        self.acquire_vars: dict[int, str] = {}         # id(call) -> bound name
+        # (tail, names the call touches, protected?) — names are the
+        # receiver tail plus any bare-Name arguments, so `slot` pairs
+        # with both `pool.checkin(slot)` and `slot.release()`
+        self.releases: list[tuple[str, frozenset[str], bool]] = []
+        self.with_items: set[int] = set()              # id() of with context exprs
+        self.cm_calls: list[tuple[str, int, int]] = [] # (api, id, line)
+        self._protected = 0
+
+    # ------------------------------------------------------- structure
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Try(self, node):
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        self._protected += 1
+        for handler in node.handlers:
+            for stmt in handler.body:
+                self.visit(stmt)
+        for stmt in node.finalbody:
+            self.visit(stmt)
+        self._protected -= 1
+
+    def _visit_with(self, node):
+        for item in node.items:
+            self.with_items.add(id(item.context_expr))
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def visit_Return(self, node):
+        # ownership transfer: the caller receives the resource (and the
+        # checkout_staging contract, its release duty)
+        if node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Call):
+                    self.transferred.add(id(sub))
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        # self.<attr> = <...checkout()...> escapes to the object lifetime
+        escapes = any(
+            isinstance(t, ast.Attribute)
+            and isinstance(t.value, ast.Name)
+            and t.value.id == "self"
+            for t in node.targets
+        )
+        bound = (
+            node.targets[0].id
+            if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name)
+            else None
+        )
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Call):
+                if escapes:
+                    self.transferred.add(id(sub))
+                elif bound is not None:
+                    # `slot = pool.checkout()` and wrapper shapes like
+                    # `slab = StagedSlab(pool, pool.checkout(), …)`:
+                    # the bound name is what a release must reference
+                    self.acquire_vars[id(sub)] = bound
+        self.generic_visit(node)
+
+    # ------------------------------------------------------------ calls
+
+    def visit_Call(self, node: ast.Call):
+        tail = tail_name(node.func)
+        if tail in ACQUIRE_TAILS and id(node) not in self.transferred:
+            self.acquires.append(
+                (tail, node.lineno, self.acquire_vars.get(id(node)))
+            )
+        if tail in CM_ONLY and isinstance(node.func, ast.Attribute):
+            recv = _receiver_tail(node)
+            if recv in CM_ONLY[tail]:
+                self.cm_calls.append((tail, id(node), node.lineno))
+        if tail in RELEASE_TAILS and isinstance(node.func, ast.Attribute):
+            names = set()
+            recv = tail_name(node.func.value)
+            if recv is not None:
+                names.add(recv)
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+            self.releases.append(
+                (tail, frozenset(names), bool(self._protected))
+            )
+        self.generic_visit(node)
+
+
+def run(index: PackageIndex, files=None) -> list[Finding]:
+    findings: list[Finding] = []
+    for fn in index.functions:
+        # the resource APIs themselves are the pairing's implementation,
+        # not its clients
+        if fn.name in ACQUIRE_TAILS or fn.name in RELEASE_TAILS:
+            continue
+        scan = _FnScan()
+        for stmt in fn.node.body:
+            scan.visit(stmt)
+        for api, line, var in scan.acquires:
+            matching = [
+                (names, protected)
+                for _tail, names, protected in scan.releases
+                if var is None or var in names
+            ]
+            if any(protected for _names, protected in matching):
+                continue
+            if matching:
+                findings.append(
+                    Finding(
+                        PASS_NAME, fn.module, line, fn.qualname,
+                        f"{api}() released only on the happy path — leaks "
+                        "the slot on an exception edge (release belongs in "
+                        "a finally/except)",
+                    )
+                )
+            else:
+                findings.append(
+                    Finding(
+                        PASS_NAME, fn.module, line, fn.qualname,
+                        f"{api}() result is never released on any path",
+                    )
+                )
+        for api, node_id, line in scan.cm_calls:
+            if node_id in scan.with_items:
+                continue
+            what = (
+                "pipeline_ledger().track()" if api == "track"
+                else "tracer().span()"
+            )
+            findings.append(
+                Finding(
+                    PASS_NAME, fn.module, line, fn.qualname,
+                    f"{what} must be the context expression of a with "
+                    "statement (the exit IS the accounting)",
+                )
+            )
+    return dedupe_findings(findings)

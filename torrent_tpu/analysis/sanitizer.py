@@ -24,6 +24,27 @@ sync IO or jit dispatch on the serving loop, the blocking-in-async
 hazard class at runtime — increments a stall counter with the max
 observed stall.
 
+**Dynamic lockset checking (Eraser).** The static ``guarded-state``
+pass cannot see cross-object mutations or ambiguous calls; the
+:func:`guard_attrs` / :func:`guarded_cell` registration API is its
+runtime complement. A *cell* is one logical piece of shared state
+(breaker state, a staging free list, a slab refcount, a shard's stat
+counters, the ledger's stage table); instrumented call sites report
+reads/writes and the cell runs Eraser's state machine —
+
+    virgin → exclusive(first thread) → shared / shared-modified
+
+— initializing its candidate lockset from the per-thread held-set
+:class:`SanitizedLock` already maintains when a second thread arrives,
+and intersecting it on every subsequent access. A shared-modified cell
+whose lockset empties is an observed data race: logged, counted
+(``lockset_races`` in :func:`snapshot`,
+``torrent_tpu_lockset_races_total`` on ``/metrics``), dumped to the
+flight recorder once, and turned into a failed session by
+``tests/conftest.py`` exactly like a lock-order cycle. When TSAN is
+off, ``guard_attrs`` returns a shared no-op group — zero state, zero
+behavior change.
+
 Node identity in the dynamic graph is the lock's *name* (the
 :func:`named_lock` annotation, e.g. ``"sched.lane.build_lock"``), not
 the instance: all lanes' build locks are one node, which is what lock
@@ -84,6 +105,41 @@ class _LockStats:
         self.hold_max = 0.0
 
 
+class _CellStats:
+    """Per-cell-NAME aggregate (instances come and go with their owning
+    objects; the name-level counters persist for metrics)."""
+
+    __slots__ = ("instances", "races")
+
+    def __init__(self):
+        self.instances = 0
+        self.races = 0
+
+
+class _Cell:
+    """One guarded memory cell's Eraser state. Owned by its
+    :class:`CellGroup` (and thus by the instrumented object), so cell
+    state is garbage-collected with the object; only the name-level
+    aggregates live in :class:`TsanState`."""
+
+    __slots__ = ("name", "state", "owner", "lockset", "raced", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.state = "virgin"  # -> exclusive -> shared[-modified]
+        self.owner: int | None = None
+        self.lockset: set[str] | None = None
+        self.raced = False
+        # plain per-cell lock: accesses normally arrive already
+        # serialized by the guard under test, but racy code (the point)
+        # must not corrupt the checker itself
+        self._lock = threading.Lock()
+
+
+# bound on retained race descriptions (the counter keeps counting)
+_MAX_RACES = 100
+
+
 class TsanState:
     """All sanitizer state. One module-global instance backs the
     process; tests may construct private ones and hand them to
@@ -106,6 +162,10 @@ class TsanState:
         # id(lock) -> (name, thread name, since) for the hold watchdog
         self._held_registry: dict[int, tuple[str, str, float]] = {}
         self._watchdog_flagged: set[int] = set()
+        # Eraser: per-cell-name aggregates + observed races
+        self.cells: dict[str, _CellStats] = {}
+        self.lockset_races: list[str] = []
+        self.lockset_race_count = 0
 
     # ------------------------------------------------------- lock hooks
 
@@ -200,6 +260,65 @@ class TsanState:
                 return [start] + sub
         return None
 
+    # -------------------------------------------------- lockset checking
+
+    def register_cell(self, name: str) -> _Cell:
+        with self._meta:
+            st = self.cells.get(name)
+            if st is None:
+                st = self.cells[name] = _CellStats()
+            st.instances += 1
+        return _Cell(name)
+
+    def on_cell_access(self, cell: _Cell, write: bool) -> None:
+        """Eraser's per-access step: advance the cell's state machine and
+        refine its candidate lockset with the locks this thread holds."""
+        held = {name for name, _lid in self._stack()}
+        tid = threading.get_ident()
+        race: str | None = None
+        with cell._lock:
+            if cell.state == "virgin":
+                cell.state = "exclusive"
+                cell.owner = tid
+            elif cell.state == "exclusive":
+                if tid != cell.owner:
+                    # second thread: start lockset tracking here (the
+                    # initialization-then-handoff idiom stays silent)
+                    cell.state = "shared_modified" if write else "shared"
+                    cell.lockset = set(held)
+                    if write and not cell.lockset and not cell.raced:
+                        cell.raced = True
+                        race = self._race_msg(cell, write)
+            else:
+                if write and cell.state == "shared":
+                    cell.state = "shared_modified"
+                cell.lockset &= held
+                if (
+                    cell.state == "shared_modified"
+                    and not cell.lockset
+                    and not cell.raced
+                ):
+                    cell.raced = True
+                    race = self._race_msg(cell, write)
+        if race is not None:
+            with self._meta:
+                st = self.cells.get(cell.name)
+                if st is not None:
+                    st.races += 1
+                self.lockset_race_count += 1
+                if len(self.lockset_races) < _MAX_RACES:
+                    self.lockset_races.append(race)
+            log.error("tsan: %s", race)
+            _notify_race(self, race)
+
+    @staticmethod
+    def _race_msg(cell: _Cell, write: bool) -> str:
+        return (
+            f"lockset race on cell {cell.name}: candidate lockset emptied "
+            f"on a {'write' if write else 'read'} by thread "
+            f"{threading.current_thread().name} (state {cell.state})"
+        )
+
     # ------------------------------------------------- watchdog / stalls
 
     def watchdog_scan(self) -> None:
@@ -242,6 +361,12 @@ class TsanState:
                 "long_holds": self.long_holds,
                 "loop_stalls": self.loop_stalls,
                 "loop_stall_max_s": self.loop_stall_max,
+                "cells": {
+                    name: {"instances": st.instances, "races": st.races}
+                    for name, st in sorted(self.cells.items())
+                },
+                "lockset_races": list(self.lockset_races),
+                "lockset_race_count": self.lockset_race_count,
             }
 
 
@@ -265,12 +390,121 @@ def _notify_cycle(state: "TsanState", cycle: tuple[str, ...]) -> None:
         log.exception("tsan cycle flight-recorder dump failed")
 
 
+def _notify_race(state: "TsanState", race: str) -> None:
+    """One black-box dump per observed lockset race (global state only,
+    same contract as :func:`_notify_cycle`)."""
+    if state is not _state:
+        return
+    try:
+        from torrent_tpu.obs.recorder import flight_recorder
+
+        flight_recorder().trigger("tsan_lockset_race", detail={"race": race})
+    except Exception:  # the sanitizer must never take the process down
+        log.exception("tsan lockset-race flight-recorder dump failed")
+
+
 def global_state() -> TsanState:
     return _state
 
 
 def snapshot() -> dict:
     return _state.snapshot()
+
+
+# --------------------------------------------------------- guarded cells
+
+
+class CellGroup:
+    """A bundle of guarded cells owned by one object.
+
+    ``guard_attrs("sched.breaker", "state")`` at construction, then
+    ``self._cells.write("state")`` at each mutation site and
+    ``self._cells.read("state")`` at each cross-thread read site —
+    always placed INSIDE the critical section that claims to guard the
+    cell, so the held-set the checker samples is the one the access
+    actually ran under."""
+
+    __slots__ = ("_cells", "_state")
+
+    def __init__(self, owner: str, names, state: TsanState):
+        self._state = state
+        self._cells = {n: state.register_cell(f"{owner}.{n}") for n in names}
+
+    def read(self, cell: str) -> None:
+        self._state.on_cell_access(self._cells[cell], False)
+
+    def write(self, cell: str) -> None:
+        self._state.on_cell_access(self._cells[cell], True)
+
+
+class _NullCells:
+    """TSAN-off stand-in: one shared instance, no state, no overhead
+    beyond a no-op method call at instrumented sites."""
+
+    __slots__ = ()
+
+    def read(self, cell: str) -> None:
+        pass
+
+    def write(self, cell: str) -> None:
+        pass
+
+
+_NULL_CELLS = _NullCells()
+
+
+def guard_attrs(owner: str, *cells: str, state: TsanState | None = None):
+    """Register ``cells`` (logical shared-state members of ``owner``)
+    for dynamic lockset checking. Returns a :class:`CellGroup` when the
+    sanitizer is on (or an explicit ``state`` is given — tests), else
+    the shared no-op group. Name convention mirrors :func:`named_lock`:
+    ``<area>.<owner>`` + the cell name, e.g.
+    ``guard_attrs("sched.slab", "refs")`` → cell ``sched.slab.refs``."""
+    if state is not None:
+        return CellGroup(owner, cells, state)
+    if is_enabled():
+        _autoenable()
+        return CellGroup(owner, cells, _state)
+    return _NULL_CELLS
+
+
+class _SingleCell:
+    __slots__ = ("_cell", "_state")
+
+    def __init__(self, cell: _Cell, state: TsanState):
+        self._cell = cell
+        self._state = state
+
+    def read(self) -> None:
+        self._state.on_cell_access(self._cell, False)
+
+    def write(self) -> None:
+        self._state.on_cell_access(self._cell, True)
+
+
+class _NullCell:
+    __slots__ = ()
+
+    def read(self) -> None:
+        pass
+
+    def write(self) -> None:
+        pass
+
+
+_NULL_CELL = _NullCell()
+
+
+def guarded_cell(name: str, state: TsanState | None = None):
+    """Single-cell form of :func:`guard_attrs` for module-level shared
+    state: ``_cell = guarded_cell("native.engine")``; then
+    ``_cell.read()`` / ``_cell.write()`` at access sites."""
+    if state is not None:
+        return _SingleCell(state.register_cell(name), state)
+    if is_enabled():
+        _autoenable()
+        return _SingleCell(_state.register_cell(name), _state)
+    return _NULL_CELL
 
 
 class SanitizedLock:

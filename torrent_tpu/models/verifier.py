@@ -214,6 +214,7 @@ class TPUVerifier:
         with self._upload_pool_lock:
             if self._upload_pool is None:
                 self._upload_pool = ThreadPoolExecutor(max_workers=self._upload_chunks)
+            pool = self._upload_pool
         rows = padded.shape[0]
         step = -(-rows // self._upload_chunks)
         views = [
@@ -223,7 +224,7 @@ class TPUVerifier:
             put = lambda v: jax.device_put(v.copy())
         else:
             put = jax.device_put
-        chunks = list(self._upload_pool.map(put, views))
+        chunks = list(pool.map(put, views))
         for c in chunks:
             c.block_until_ready()
         return chunks

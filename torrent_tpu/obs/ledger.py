@@ -53,7 +53,7 @@ from __future__ import annotations
 
 import time
 
-from torrent_tpu.analysis.sanitizer import named_lock
+from torrent_tpu.analysis.sanitizer import guard_attrs, named_lock
 from torrent_tpu.utils.metrics import _esc
 
 __all__ = [
@@ -119,6 +119,10 @@ class PipelineLedger:
 
     def __init__(self):
         self._lock = named_lock("obs.ledger._lock")
+        # dynamic lockset checking: the stage table + overlap integrator
+        # is one cell guarded by _lock (stage entries arrive from worker
+        # threads, the loop, and metrics scrapers concurrently)
+        self._cells = guard_attrs("obs.ledger", "stages")
         self._stages: dict[str, _Stage] = {}
         # monotonic extent of recorded activity — the attribution wall
         self._t_first: float | None = None
@@ -144,6 +148,7 @@ class PipelineLedger:
         by the caller (no occupancy window)."""
         now = time.monotonic()
         with self._lock:
+            self._cells.write("stages")
             s = self._stage_locked(stage)
             s.busy_s += max(0.0, seconds)
             s.bytes += nbytes
@@ -167,6 +172,7 @@ class PipelineLedger:
 
     def _enter(self, stage: str, t0: float) -> None:
         with self._lock:
+            self._cells.write("stages")
             s = self._stage_locked(stage)
             s.active += 1
             if s.active > s.max_active:
@@ -181,6 +187,7 @@ class PipelineLedger:
 
     def _exit(self, stage: str, nbytes: int, dt: float, t1: float) -> None:
         with self._lock:
+            self._cells.write("stages")
             s = self._stage_locked(stage)
             s.active -= 1
             s.busy_s += max(0.0, dt)
@@ -204,6 +211,7 @@ class PipelineLedger:
         previous run's tail, setup work) never dilutes the next
         interval's utilization."""
         with self._lock:
+            self._cells.read("stages")
             now = time.monotonic()
             overlap_s = self._overlap_s
             if self._overlap_t0 is not None:  # an overlap window is open
@@ -231,6 +239,7 @@ class PipelineLedger:
 
     def clear(self) -> None:
         with self._lock:
+            self._cells.write("stages")
             self._stages.clear()
             self._t_first = None
             self._t_last = None

@@ -114,7 +114,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable
 
-from torrent_tpu.analysis.sanitizer import named_lock
+from torrent_tpu.analysis.sanitizer import guard_attrs, named_lock
 from torrent_tpu.obs.hist import histograms
 from torrent_tpu.obs.ledger import pipeline_ledger
 from torrent_tpu.obs.recorder import flight_recorder
@@ -400,7 +400,7 @@ class _LaneBreaker:
 
     __slots__ = (
         "threshold", "cooldown", "state", "failures", "opened_at",
-        "probing", "transitions", "lock",
+        "probing", "transitions", "lock", "_cells",
     )
 
     def __init__(self, threshold: int, cooldown: float):
@@ -412,6 +412,9 @@ class _LaneBreaker:
         self.probing = False  # one half-open probe in flight at a time
         self.transitions: dict[str, int] = {}
         self.lock = named_lock("sched.breaker.lock")
+        # dynamic lockset checking (tsan-lite Eraser): the whole
+        # state/failures/probing blob is one cell guarded by self.lock
+        self._cells = guard_attrs("sched.breaker", "state")
 
     def _to(self, state: str) -> None:
         key = f"{self.state}->{state}"
@@ -422,6 +425,7 @@ class _LaneBreaker:
         """Whether the next launch may use the primary plane (False =
         degrade to the CPU plane for this launch)."""
         with self.lock:
+            self._cells.write("state")  # probing may flip below
             if self.state == "closed":
                 return True
             if (
@@ -437,6 +441,7 @@ class _LaneBreaker:
 
     def record_success(self) -> None:
         with self.lock:
+            self._cells.write("state")
             self.probing = False
             self.failures = 0
             if self.state != "closed":
@@ -450,6 +455,7 @@ class _LaneBreaker:
         (dumping under it would nest the obs locks below breaker
         state)."""
         with self.lock:
+            self._cells.write("state")
             if self.state == "half_open":
                 self.probing = False
                 self._to("open")
@@ -464,10 +470,12 @@ class _LaneBreaker:
 
     def release_probe(self) -> None:
         with self.lock:
+            self._cells.write("state")
             self.probing = False
 
     def snapshot(self) -> dict:
         with self.lock:
+            self._cells.read("state")
             return {
                 "state": self.state,
                 "consecutive_failures": self.failures,
@@ -531,6 +539,7 @@ class _StagingSlots:
         self.piece_len = piece_len
         self._slots: list[tuple] = []  # (padded, view, ends) free list
         self._lock = named_lock("sched.staging._lock")
+        self._cells = guard_attrs("sched.staging", "free_list")
         # leak accounting: every checkout must be balanced by a checkin
         # (asserted by tests and exported via metrics_snapshot)
         self.outstanding = 0
@@ -546,6 +555,7 @@ class _StagingSlots:
         from torrent_tpu.ops.padding import alloc_padded
 
         with self._lock:
+            self._cells.write("free_list")
             slot = self._slots.pop() if self._slots else None
             self.outstanding += 1
             self.checkouts += 1
@@ -605,8 +615,16 @@ class _StagingSlots:
 
     def checkin(self, slot) -> None:
         with self._lock:
+            self._cells.write("free_list")
             self._slots.append(slot)
             self.outstanding -= 1
+
+    def stats(self) -> tuple[int, int]:
+        """(outstanding, checkouts) under the free-list lock — snapshot
+        readers run on other threads than the checking-out workers."""
+        with self._lock:
+            self._cells.read("free_list")
+            return self.outstanding, self.checkouts
 
 
 def _payload_ndarray(p):
@@ -672,6 +690,7 @@ class StagedSlab:
     __slots__ = (
         "pool", "slot", "padded", "view", "ends", "nblocks", "lengths",
         "algo", "bucket", "piece_length", "n_used", "_refs", "_lock",
+        "_cells",
     )
 
     def __init__(self, pool: _StagingSlots, slot: tuple, algo: str,
@@ -689,6 +708,7 @@ class StagedSlab:
         self.n_used = 0
         self._refs = 1  # the creator's hold
         self._lock = named_lock("sched.slab._lock")
+        self._cells = guard_attrs("sched.slab", "refs")
 
     @property
     def rows_total(self) -> int:
@@ -732,10 +752,12 @@ class StagedSlab:
 
     def retain(self, n: int = 1) -> None:
         with self._lock:
+            self._cells.write("refs")
             self._refs += n
 
     def release(self, n: int = 1) -> None:
         with self._lock:
+            self._cells.write("refs")
             self._refs -= n
             done = self._refs == 0
         if done:
@@ -1177,6 +1199,7 @@ class HashPlaneScheduler:
         # the only fault counter touched off the event loop (worker
         # threads, possibly in different lanes) — needs its own lock
         self._counter_lock = named_lock("sched._counter_lock")
+        self._counter_cells = guard_attrs("sched.scheduler", "fault_counters")
         self._failed_pieces = 0  # tickets that exhausted retry+bisection
         # rollup of evicted auto-registered tenants so served/shed totals
         # stay monotonic after their per-tenant series disappear
@@ -1882,6 +1905,7 @@ class HashPlaneScheduler:
             if lane.cpu_plane is None:  # benign to race: planes are stateless
                 lane.cpu_plane = _CpuPlane(lane.algo)
             with self._counter_lock:  # worker threads across lanes race this
+                self._counter_cells.write("fault_counters")
                 self._cpu_fallback_launches += 1
             obs_note["plane"] = "cpu_fallback"
             return lane.cpu_plane.run(payloads)
@@ -1916,6 +1940,7 @@ class HashPlaneScheduler:
             pad = hook(len(payloads), lane.bucket)[0] - len(payloads)
             if pad:
                 with self._counter_lock:
+                    self._counter_cells.write("fault_counters")
                     lane.pad_rows_total += pad
         # zero-copy launch form: when every ticket is a SlotRow of ONE
         # pre-staged slab and the plane can consume it in place, skip
@@ -2184,15 +2209,28 @@ class HashPlaneScheduler:
         # dict under it too so iteration can't race an insert
         with self._ingest_lock:
             pools = list(self._ingest_pools.values())
+        # per-pool counters move under each pool's own lock (worker
+        # threads mid-checkout); stats() reads them there
+        stats = [p.stats() for p in pools]
         return {
             "pools": len(pools),
-            "outstanding": sum(p.outstanding for p in pools),
-            "checkouts": sum(p.checkouts for p in pools),
+            "outstanding": sum(s[0] for s in stats),
+            "checkouts": sum(s[1] for s in stats),
         }
 
     def metrics_snapshot(self) -> dict:
         """Counters for utils/metrics.py's Prometheus rendering."""
         pending = sum(l.pending_pieces for l in self._lanes.values())
+        # _cpu_fallback_launches and the per-lane pad counters are
+        # bumped from worker threads under _counter_lock; snapshot them
+        # under it too (the other fault counters are loop-confined but
+        # ride along in the same brief leaf-lock scope)
+        with self._counter_lock:
+            self._counter_cells.read("fault_counters")
+            cpu_fallback_launches = self._cpu_fallback_launches
+            pad_rows = {
+                key: lane.pad_rows_total for key, lane in self._lanes.items()
+            }
         return {
             "queue_pieces": pending,
             "queue_bytes": self._queued_bytes,
@@ -2207,7 +2245,7 @@ class HashPlaneScheduler:
             "launch_failures": self._launch_failures,
             "retries": self._retries,
             "bisections": self._bisections,
-            "cpu_fallback_launches": self._cpu_fallback_launches,
+            "cpu_fallback_launches": cpu_fallback_launches,
             "failed_pieces": self._failed_pieces,
             "breakers": {
                 f"{algo}/{bucket}": lane.breaker.snapshot()
@@ -2229,7 +2267,7 @@ class HashPlaneScheduler:
                     "mean_fill": (
                         lane.fill_sum / lane.launches if lane.launches else 0.0
                     ),
-                    "pad_rows_total": lane.pad_rows_total,
+                    "pad_rows_total": pad_rows.get((algo, bucket), 0),
                 }
                 for (algo, bucket), lane in self._lanes.items()
             },

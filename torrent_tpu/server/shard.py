@@ -47,7 +47,7 @@ import random
 import time
 from dataclasses import dataclass, field
 
-from torrent_tpu.analysis.sanitizer import named_lock
+from torrent_tpu.analysis.sanitizer import guard_attrs, named_lock
 from torrent_tpu.net.constants import DEFAULT_ANNOUNCE_INTERVAL, DEFAULT_NUM_WANT
 from torrent_tpu.net.types import AnnounceEvent, AnnouncePeer
 from torrent_tpu.server.tracker import (
@@ -114,10 +114,13 @@ class _Shard:
     could acquire another lock, no IO, no device work."""
 
     __slots__ = ("_shard_lock", "swarms", "peers", "announces", "evicted",
-                 "indexed", "clamped")
+                 "indexed", "clamped", "_cells")
 
     def __init__(self):
         self._shard_lock = named_lock("server.shard._shard_lock")
+        # dynamic lockset checking: the shard's whole mutable blob
+        # (swarms + counters) is one cell guarded by _shard_lock
+        self._cells = guard_attrs("server.shard", "stats")
         self.swarms: dict[bytes, _Swarm] = {}
         # incremental peer count (maintained on insert/remove) so the
         # metrics snapshot never walks all swarms under the shard lock
@@ -162,6 +165,7 @@ class ShardedSwarmStore:
         # store-level counters (scrapes/batches span shards); leaf lock,
         # never held while a shard lock is taken or vice versa
         self._stats_lock = named_lock("server.shard._stats_lock")
+        self._stats_cells = guard_attrs("server.store", "stats")
         self._scrapes = 0
         self._batches = 0
         self._batched_announces = 0
@@ -199,6 +203,7 @@ class ShardedSwarmStore:
         want, clamped = self.clamp_numwant(numwant)
         now = time.monotonic()
         with shard._shard_lock:
+            shard._cells.write("stats")
             shard.announces += 1
             if clamped:
                 shard.clamped += 1
@@ -223,6 +228,7 @@ class ShardedSwarmStore:
             shard = self._shards[si]
             idxs = by_shard[si]
             with shard._shard_lock:
+                shard._cells.write("stats")
                 shard.announces += len(idxs)
                 for i in idxs:
                     ih, pid, ip, port, left, event, numwant = items[i]
@@ -233,6 +239,7 @@ class ShardedSwarmStore:
                         shard, ih, pid, ip, port, left, event, want, now
                     )
         with self._stats_lock:
+            self._stats_cells.write("stats")
             self._batches += 1
             self._batched_announces += len(items)
             self._batch_max = max(self._batch_max, len(items))
@@ -354,6 +361,7 @@ class ShardedSwarmStore:
                 if len(hashes) >= MAX_SCRAPE_HASHES:
                     break
         with self._stats_lock:
+            self._stats_cells.write("stats")
             self._scrapes += 1
         out = []
         for h in hashes:
@@ -385,6 +393,7 @@ class ShardedSwarmStore:
         shard = self._shards[self.shard_of(info_hash)]
         now = time.monotonic()
         with shard._shard_lock:
+            shard._cells.write("stats")
             shard.indexed += 1
             swarm = shard.swarms.get(info_hash)
             if swarm is None:
@@ -403,6 +412,7 @@ class ShardedSwarmStore:
         cutoff = time.monotonic() - self.peer_ttl
         evicted = 0
         with shard._shard_lock:
+            shard._cells.write("stats")
             for ih in list(shard.swarms):
                 swarm = shard.swarms[ih]
                 for pid in [
@@ -443,6 +453,7 @@ class ShardedSwarmStore:
         per_shard = []
         for shard in self._shards:
             with shard._shard_lock:
+                shard._cells.read("stats")
                 # O(1) per shard: the peer count is maintained
                 # incrementally, never a swarm walk under the lock
                 per_shard.append(
@@ -456,6 +467,7 @@ class ShardedSwarmStore:
                     }
                 )
         with self._stats_lock:
+            self._stats_cells.read("stats")
             batches = {
                 "batches": self._batches,
                 "announces": self._batched_announces,

@@ -391,7 +391,10 @@ class FsStorage:
         # hidden .parts mirror instead of creating visible stub files
         # (the partfile behavior of long-lived clients)
         self._unwanted: set[tuple[str, ...]] = set()
-        self._parts_cache: dict[tuple[str, ...], str] = {}
+        # idempotent memo (same key always computes the same value, dict
+        # setitem is atomic under the GIL): racing writers agree, and
+        # taking _lock here would self-deadlock the locked callers
+        self._parts_cache: dict[tuple[str, ...], str] = {}  # guarded-by: none
 
     def set_unwanted(self, paths, all_paths=()) -> None:
         """Route these files' IO into the parts mirror; every WANTED path

@@ -1082,6 +1082,8 @@ def _cmd_lint(args) -> int:
         argv.append("--json")
     if args.graph:
         argv.append("--graph")
+    if args.sarif:
+        argv += ["--sarif", args.sarif]
     return lint_main(argv)
 
 
@@ -1902,7 +1904,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser(
         "lint",
         help="concurrency/invariant static analysis (lock order, "
-        "blocking-in-async, device-under-lock, determinism)",
+        "blocking-in-async, device-under-lock, determinism, "
+        "guarded-state, lifecycle)",
     )
     sp.add_argument("--root", default=None,
                     help="package dir to lint (default: installed torrent_tpu)")
@@ -1918,7 +1921,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true",
                     help="machine-readable findings report")
     sp.add_argument("--graph", action="store_true",
-                    help="dump the static lock-acquisition graph")
+                    help="dump the static lock-acquisition graph and the "
+                    "inferred attr->guard map")
+    sp.add_argument("--sarif", default=None, metavar="PATH",
+                    help="write findings as SARIF 2.1.0 for CI annotation")
     sp.set_defaults(fn=_cmd_lint)
 
     sp = sub.add_parser(

@@ -210,6 +210,22 @@ def render_tsan_metrics(snapshot: dict) -> str:
         "# TYPE torrent_tpu_loop_stall_max_seconds gauge",
         f"torrent_tpu_loop_stall_max_seconds {s.get('loop_stall_max_s', 0.0):.6f}",
     ]
+    # dynamic lockset checking (Eraser): registered cells + races
+    cells = s.get("cells", {})
+    lines.append(
+        "# HELP torrent_tpu_guarded_cells Cell instances registered with the dynamic lockset checker"
+    )
+    lines.append("# TYPE torrent_tpu_guarded_cells gauge")
+    for name, st in sorted(cells.items()):
+        lines.append(
+            f'torrent_tpu_guarded_cells{{cell="{_esc(name)}"}} '
+            f"{st.get('instances', 0)}"
+        )
+    lines += [
+        "# HELP torrent_tpu_lockset_races_total Shared-state lockset races observed at runtime (any nonzero value is a bug)",
+        "# TYPE torrent_tpu_lockset_races_total counter",
+        f"torrent_tpu_lockset_races_total {s.get('lockset_race_count', 0)}",
+    ]
     return "\n".join(lines) + "\n"
 
 

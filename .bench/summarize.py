@@ -87,6 +87,12 @@ def _normalize(rec: dict, artifact: str) -> dict:
                 "clients", "swarms", "shards", "shards_hit", "numwant",
                 "announces", "rates", "latency", "shard_occupancy", "store",
                 "contract",
+                # the timeline/SLO plane schema (PR 14): the smoke rung
+                # brackets the run in timeline samples and embeds the
+                # default-contract SLO verdict — a clean rung banks
+                # zero burn, so a regression investigator can see
+                # whether the slower record was also BURNING budget
+                "timeline", "slo",
                 # the comparator's full like-for-like shape key
                 "piece_kb", "bytes", "nproc"):
         if key in rec:

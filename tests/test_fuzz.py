@@ -754,3 +754,88 @@ class TestAnalysisPassProperties:
             assert f.pass_name in ("guarded-state", "lifecycle")
             assert f.path == "pkg/mod.py"
             assert f.line >= 1
+
+
+# --------------------------------------------------------------- obs/slo
+
+# arbitrary (hostile) timeline samples: JSON-ish dicts with the real
+# field names sometimes present, wrong-typed values, NaNs, junk keys
+_slo_value = st.none() | st.integers(-10**6, 10**6) | st.floats(
+    allow_nan=True, allow_infinity=True
+) | st.text(max_size=8) | st.dictionaries(
+    st.text(max_size=6), st.integers(-1000, 1000) | st.text(max_size=6),
+    max_size=4,
+)
+_slo_sample = st.dictionaries(
+    st.sampled_from(
+        ["t", "stages", "sched", "hist", "integrity", "overlap_s", "junk"]
+    ) | st.text(max_size=5),
+    _slo_value,
+    max_size=6,
+)
+
+
+class TestSloProperties:
+    """ISSUE satellites: SLO evaluation never crashes on arbitrary
+    sample rings, and the burn rate is monotone in the error count."""
+
+    @given(st.lists(_slo_sample, max_size=12))
+    @settings(max_examples=200, deadline=None)
+    def test_evaluate_slo_total_on_arbitrary_rings(self, samples):
+        from torrent_tpu.obs.slo import evaluate_slo, parse_objectives
+
+        # every objective KIND, so the latency bucket walk and the
+        # throughput interval walk face the hostile samples too
+        rep = evaluate_slo(
+            samples,
+            parse_objectives(
+                "availability=0.999;p99_ms=50:queue_wait;"
+                "floor_mibps=1;integrity=on"
+            ),
+            short_samples=3,
+            long_samples=8,
+        )
+        objs = rep["objectives"]
+        assert set(objs) == {
+            "availability", "integrity", "latency_queue_wait", "throughput"
+        }
+        for obj in objs.values():
+            assert 0.0 <= obj["budget_remaining"] <= 1.0
+            assert obj["burn_rate"] >= 0.0
+            assert obj["classification"] in ("ok", "slow_burn", "fast_burn")
+            assert isinstance(obj["breach"], bool)
+
+    @given(st.lists(_slo_sample, max_size=8))
+    @settings(max_examples=100, deadline=None)
+    def test_replay_report_total_on_arbitrary_rings(self, samples):
+        from torrent_tpu.obs.timeline import replay_report
+
+        rep = replay_report({"samples": samples, "drops": "x"})
+        assert rep["samples"] == sum(1 for s in samples if isinstance(s, dict))
+        assert isinstance(rep["intervals"], list)
+
+    @given(
+        st.integers(0, 500),
+        st.integers(0, 500),
+        st.integers(1, 1000),
+    )
+    @settings(max_examples=200, deadline=None)
+    def test_burn_rate_monotone_in_error_count(self, e1, extra, pieces):
+        """For a fixed served-piece count, more failed pieces never
+        lowers the burn rate (e2 = e1 + extra >= e1)."""
+        from torrent_tpu.obs.slo import evaluate_slo, parse_objectives
+
+        objs = parse_objectives("availability=0.999")
+
+        def burn(failed: int) -> float:
+            samples = [
+                {"t": 1.0, "sched": {"pieces": 0, "shed": 0,
+                                     "failed_pieces": 0}},
+                {"t": 2.0, "sched": {"pieces": pieces, "shed": 0,
+                                     "failed_pieces": failed}},
+            ]
+            return evaluate_slo(samples, objs, short_samples=4,
+                                long_samples=8)[
+                "objectives"]["availability"]["burn_rate"]
+
+        assert burn(e1 + extra) >= burn(e1)

@@ -367,6 +367,89 @@ class TestRendererEdgeCases:
             in text
         )
 
+    def test_timeline_renderer_fresh_partial_and_full(self):
+        """The timeline series render from a fresh ring, a partial
+        hand-rolled snapshot, and a live ring with a sampler flag —
+        never a crash mid-scrape."""
+        from torrent_tpu.obs.timeline import Timeline
+        from torrent_tpu.utils.metrics import render_timeline_metrics
+
+        prom_lint(render_timeline_metrics({}))
+        prom_lint(render_timeline_metrics(None))
+        text = render_timeline_metrics({"seq": 9, "drops": 2})
+        prom_lint(text)
+        assert "torrent_tpu_timeline_samples_total 9" in text
+        assert "torrent_tpu_timeline_dropped_total 2" in text
+        assert "torrent_tpu_timeline_sampler_alive" not in text  # no key
+        tl = Timeline(depth=4)
+        tl.push({"t": 1.0})
+        snap = tl.snapshot()
+        snap["sampler_alive"] = True
+        text = render_timeline_metrics(snap)
+        prom_lint(text)
+        assert "torrent_tpu_timeline_ring_fill 1" in text
+        assert "torrent_tpu_timeline_depth 4" in text
+        assert "torrent_tpu_timeline_sampler_alive 1" in text
+
+    def test_slo_renderer_none_partial_and_breaching(self):
+        """The SLO series render from no report yet (engine armed but
+        never observed), a partial objective dict, and a breaching
+        report — per-objective budget/burn/breach families."""
+        from torrent_tpu.utils.metrics import render_slo_metrics
+
+        prom_lint(render_slo_metrics(None))
+        prom_lint(render_slo_metrics({}))
+        text = render_slo_metrics({"objectives": {"availability": {}}})
+        prom_lint(text)
+        assert (
+            'torrent_tpu_slo_budget_remaining{objective="availability"} 1.0'
+            in text
+        )
+        report = {
+            "objectives": {
+                "availability": {
+                    "budget_remaining": 0.25, "burn_rate": 20.0,
+                    "burn_rate_long": 4.0, "breach": True,
+                },
+                "integrity": {
+                    "budget_remaining": 1.0, "burn_rate": 0.0,
+                    "burn_rate_long": 0.0, "breach": False,
+                },
+            }
+        }
+        text = render_slo_metrics(report)
+        prom_lint(text)
+        assert (
+            'torrent_tpu_slo_burn_rate{objective="availability",window="short"} 20.0'
+            in text
+        )
+        assert (
+            'torrent_tpu_slo_burn_rate{objective="availability",window="long"} 4.0'
+            in text
+        )
+        assert 'torrent_tpu_slo_breach{objective="availability"} 1' in text
+        assert 'torrent_tpu_slo_breach{objective="integrity"} 0' in text
+
+    def test_fleet_renderer_slo_budget_series(self):
+        """A rollup carrying the fleet SLO summary renders the worst
+        burn-rate series; one without it renders no slo series."""
+        from torrent_tpu.obs.fleet import local_fleet_snapshot
+        from torrent_tpu.utils.metrics import render_fleet_metrics
+
+        roll = local_fleet_snapshot()
+        roll["slo"] = {"pid": 1, "objective": "integrity",
+                       "worst_burn": 30.5, "breaching": 1}
+        text = render_fleet_metrics(roll)
+        prom_lint(text)
+        assert (
+            'torrent_tpu_fleet_slo_worst_burn_rate{pid="1",objective="integrity"} 30.5'
+            in text
+        )
+        assert "torrent_tpu_fleet_slo_breaching 1" in text
+        assert "slo_worst_burn" not in render_fleet_metrics(
+            local_fleet_snapshot()
+        )
+
     def test_full_exposition_concatenation_lints(self):
         """What the bridge actually serves: sched + fabric + fleet +
         control + obs (incl. the pipeline ledger) + tsan in one payload
@@ -381,12 +464,16 @@ class TestRendererEdgeCases:
             SchedulerAutopilot,
             SchedulerConfig,
         )
+        from torrent_tpu.obs.slo import SloEngine
+        from torrent_tpu.obs.timeline import Timeline, TimelineSampler
         from torrent_tpu.server.shard import ShardedSwarmStore
         from torrent_tpu.utils.metrics import (
             render_control_metrics,
             render_fabric_metrics,
             render_fleet_metrics,
             render_sched_metrics,
+            render_slo_metrics,
+            render_timeline_metrics,
             render_tracker_metrics,
             render_tsan_metrics,
         )
@@ -396,12 +483,21 @@ class TestRendererEdgeCases:
         pilot = SchedulerAutopilot(sched, ControlConfig())
         store = ShardedSwarmStore(n_shards=2)
         store.announce(b"\x01" * 20, b"\x02" * 20, "1.1.1.1", 7001, left=0)
+        timeline = Timeline(depth=4)
+        engine = SloEngine("availability=0.999;integrity=on")
+        sampler = TimelineSampler(timeline, scheduler=sched,
+                                  on_sample=engine.observe)
+        sampler.sample_once()
+        tl_snap = timeline.snapshot()
+        tl_snap["sampler_alive"] = False
         text = (
             render_sched_metrics(sched)
             + render_fabric_metrics({"pid": 0})
             + render_fleet_metrics(local_fleet_snapshot(sched))
             + render_control_metrics(pilot.metrics_snapshot())
             + render_tracker_metrics(store.metrics_snapshot())
+            + render_timeline_metrics(tl_snap)
+            + render_slo_metrics(engine.report())
             + render_obs_metrics()
             + render_tsan_metrics(sanitizer.TsanState().snapshot())
         )

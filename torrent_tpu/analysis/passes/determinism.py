@@ -91,6 +91,44 @@ SCOPE: dict[str, frozenset[str]] = {
             "_digest_sched",
         }
     ),
+    # the SLO evaluators are pure functions over timeline samples (the
+    # same determinism contract as decide() and the digest builders):
+    # the same sample ring must always produce the same burn-rate
+    # verdicts, breach transitions, and health strings — and the
+    # digest_summary rides the heartbeat exchange
+    "obs/slo.py": frozenset(
+        {
+            "evaluate_slo",
+            "digest_summary",
+            "build_health",
+            "_counter_objective",
+            "_eval_availability",
+            "_eval_latency",
+            "_eval_throughput",
+            "_eval_integrity",
+            "_avail_counters",
+            "_window_delta",
+            "_hist_window",
+            "_hist_errors",
+            "_p99_estimate",
+            "_throughput_intervals",
+            "_integrity_counters_of",
+            "_tail",
+        }
+    ),
+    # timeline sample builders + the offline replay attributor: samples
+    # are dumped/replayed bytes (and the builders feed the digest-shaped
+    # encodings), so they obey the same rules — the monotonic capture
+    # instant is PASSED IN by the sampler, never read inside
+    "obs/timeline.py": frozenset(
+        {
+            "build_sample",
+            "replay_report",
+            "_sample_sched",
+            "_integrity_counters",
+            "_sample_to_ledger",
+        }
+    ),
 }
 
 WALL_CLOCK = frozenset(

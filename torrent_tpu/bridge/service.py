@@ -26,6 +26,18 @@ codec every BitTorrent client already has:
                        value (sched/control.py; `--autopilot` arms
                        actuation, otherwise the route reports the
                        controller as absent)
+  GET  /v1/timeline  → JSON: the bounded ring of periodic obs samples
+                       (obs/timeline; `--slo` arms the off-loop
+                       sampler), dumpable to TORRENT_TPU_TIMELINE_DIR
+                       and replayable offline via `torrent-tpu replay`
+  GET  /v1/slo       → JSON: declared objectives, error-budget burn
+                       rates (multi-window fast/slow classification),
+                       budget remaining, breach state (obs/slo)
+  GET  /v1/health    → JSON: liveness + readiness for a load balancer —
+                       200 only when the backend probe resolved, no
+                       breaker is stuck open past cooldown, the sampler
+                       is alive, and no SLO objective is in breach
+                       (503 with reasons otherwise)
 
 Every request runs under a trace span: an ``X-Trace-Id`` request header
 is honored (well-formed tokens only) or a fresh id is minted, the id is
@@ -136,6 +148,7 @@ _KNOWN_ROUTES = frozenset(
     {
         "/v1/digests", "/v1/verify", "/v1/info", "/v1/trace", "/metrics",
         "/v1/pipeline", "/v1/fleet", "/v1/control",
+        "/v1/timeline", "/v1/slo", "/v1/health",
         "/v1/fabric/verify", "/v1/fabric/status",
         "/v1/stream/digests", "/v1/stream/verify",
     }
@@ -242,6 +255,11 @@ class BridgeServer:
         fault_plan: FaultPlan | str | None = None,
         sha256_backend: str | None = None,
         autopilot=None,
+        slo=None,
+        timeline_interval_s: float = 1.0,
+        timeline_depth: int = 512,
+        slo_short_samples: int | None = None,
+        slo_long_samples: int | None = None,
     ):
         self.host = host
         self.port = port
@@ -253,6 +271,19 @@ class BridgeServer:
         # None/False = no controller (bit-identical static behavior)
         self._autopilot_cfg = autopilot
         self.autopilot = None
+        # timeline + SLO plane (obs/timeline, obs/slo): armed only when
+        # `slo` is set (an objective spec string, a tuple of
+        # SloObjective, or True for the default spec) — a run with no
+        # objectives configured constructs NONE of this, so behavior is
+        # bit-identical to an engine-less build
+        self._slo_cfg = slo
+        self._timeline_interval_s = timeline_interval_s
+        self._timeline_depth = timeline_depth
+        self._slo_short_samples = slo_short_samples
+        self._slo_long_samples = slo_long_samples
+        self.timeline = None
+        self.sampler = None
+        self.slo_engine = None
         # /v1/info device count, probed off-loop in the background by
         # start(): jax.devices() can block for minutes behind a wedged
         # device tunnel and must never run on the serving loop (the
@@ -293,6 +324,34 @@ class BridgeServer:
                 else ControlConfig()
             )
             self.autopilot = SchedulerAutopilot(self.sched, cfg).start()
+        if self._slo_cfg:
+            from torrent_tpu.obs import slo as _slo
+            from torrent_tpu.obs.slo import DEFAULT_SLO_SPEC, SloEngine
+            from torrent_tpu.obs.timeline import Timeline, TimelineSampler
+
+            objectives = (
+                DEFAULT_SLO_SPEC if self._slo_cfg is True else self._slo_cfg
+            )
+            kwargs = {}
+            if self._slo_short_samples is not None:
+                kwargs["short_samples"] = self._slo_short_samples
+            if self._slo_long_samples is not None:
+                kwargs["long_samples"] = self._slo_long_samples
+            self.slo_engine = _slo.arm(SloEngine(objectives, **kwargs))
+            self.timeline = Timeline(depth=self._timeline_depth)
+            self.sampler = TimelineSampler(
+                self.timeline,
+                interval_s=self._timeline_interval_s,
+                scheduler=self.sched,
+                sources={
+                    "control": self._control_source,
+                    "fleet": self._fleet_source,
+                    "distrust": self._distrust_source,
+                },
+                on_sample=self.slo_engine.observe,
+                # bound the per-capture copy to the evaluator's window
+                on_sample_tail=self.slo_engine.long_samples,
+            ).start()
 
         def _count_devices() -> int:
             import jax
@@ -336,10 +395,47 @@ class BridgeServer:
                 await self._fabric["task"]
             except (asyncio.CancelledError, Exception):
                 pass
+        if self.sampler is not None:
+            # off-thread join + final post-mortem dump; release the
+            # process-global engine slot — but only if it is still OURS
+            # (a later server may have armed its own engine since)
+            await asyncio.to_thread(self.sampler.stop)
+            from torrent_tpu.obs import slo as _slo
+
+            _slo.disarm(self.slo_engine)
         if self.autopilot is not None:
             await self.autopilot.close()
         if self.sched is not None:
             await self.sched.close()
+
+    # ----------------------------------------------------- timeline sources
+    # (run on the sampler THREAD; each is wrapped in a try by the
+    # sampler, so a transient race with the serving loop costs one
+    # sample field, never the sampler)
+
+    def _control_source(self):
+        if self.autopilot is None:
+            return None
+        last = self.autopilot._last or {}
+        bn = (last.get("decision") or {}).get("bottleneck") or {}
+        if not bn:
+            return None
+        return {"stage": bn.get("stage"), "confirmed": bn.get("confirmed")}
+
+    def _fleet_source(self):
+        if not (self._fabric and self._fabric["executors"]):
+            return None
+        bn = self._fabric["executors"][0].fleet_snapshot().get("bottleneck") or {}
+        if not bn:
+            return None
+        return {"pid": bn.get("pid"), "stage": bn.get("stage")}
+
+    def _distrust_source(self):
+        if not (self._fabric and self._fabric["executors"]):
+            return 0
+        return self._fabric["executors"][0].metrics_snapshot().get(
+            "sentinel_mismatches", 0
+        )
 
     # ----------------------------------------------------------- streaming
 
@@ -586,6 +682,22 @@ class BridgeServer:
                 from torrent_tpu.utils.metrics import render_control_metrics
 
                 text += render_control_metrics(self.autopilot.metrics_snapshot())
+            if self.timeline is not None:
+                from torrent_tpu.utils.metrics import (
+                    render_slo_metrics,
+                    render_timeline_metrics,
+                )
+
+                # stats(), not snapshot(): a scrape must not copy the
+                # whole ring just to report its counters
+                tl = self.timeline.stats()
+                tl["sampler_alive"] = (
+                    self.sampler.alive if self.sampler is not None else False
+                )
+                text += render_timeline_metrics(tl)
+                text += render_slo_metrics(
+                    self.slo_engine.report() if self.slo_engine else None
+                )
             text += render_obs_metrics()
             from torrent_tpu.analysis import sanitizer
 
@@ -607,6 +719,12 @@ class BridgeServer:
             return await self._fleet_route(writer)
         if method == "GET" and target.split("?")[0] == "/v1/control":
             return await self._control_route(writer)
+        if method == "GET" and target.split("?")[0] == "/v1/timeline":
+            return await self._timeline_route(writer)
+        if method == "GET" and target.split("?")[0] == "/v1/slo":
+            return await self._slo_route(writer)
+        if method == "GET" and target.split("?")[0] == "/v1/health":
+            return await self._health_route(writer)
         if method == "GET" and target == "/v1/fabric/status":
             return await self._reply(writer, 200, bencode(self._fabric_status()))
         if method != "POST":
@@ -873,6 +991,82 @@ class BridgeServer:
             writer, 200, body, content_type="application/json"
         )
 
+    async def _timeline_route(self, writer):
+        """``GET /v1/timeline`` — the obs plane's history surface.
+
+        The bounded sample ring (attached: false when no timeline is
+        armed), dumpable/replayable via ``torrent-tpu replay``. JSON
+        with sorted keys; pure in-memory reads, safe on the serving
+        loop."""
+        if self.timeline is None:
+            payload: dict = {"attached": False, "samples": [], "drops": 0}
+        else:
+            payload = {"attached": True, **self.timeline.snapshot()}
+            payload["sampler_alive"] = (
+                self.sampler.alive if self.sampler is not None else False
+            )
+        body = json.dumps(payload, sort_keys=True).encode()
+        return await self._reply(
+            writer, 200, body, content_type="application/json"
+        )
+
+    async def _slo_route(self, writer):
+        """``GET /v1/slo`` — declared objectives, burn rates, budget.
+
+        The engine's last evaluation report (attached: false when no
+        objectives are configured — operators can tell "SLO off" from
+        "bridge down"). JSON with sorted keys; pure in-memory reads."""
+        if self.slo_engine is None:
+            payload: dict = {"attached": False, "report": None}
+        else:
+            payload = {
+                "attached": True,
+                "objectives": [
+                    {"name": o.name, "kind": o.kind, "target": o.target,
+                     "family": o.family}
+                    for o in self.slo_engine.objectives
+                ],
+                "report": self.slo_engine.report(),
+                "breach_dumps": self.slo_engine.metrics_snapshot()[
+                    "breach_dumps"
+                ],
+            }
+        body = json.dumps(payload, sort_keys=True).encode()
+        return await self._reply(
+            writer, 200, body, content_type="application/json"
+        )
+
+    async def _health_route(self, writer):
+        """``GET /v1/health`` — liveness + readiness for a real load
+        balancer. Always answers (liveness IS the reply); HTTP 200 only
+        when READY — the backend probe resolved, no lane breaker stuck
+        open past its cooldown, the sampler (when armed) alive, and no
+        SLO objective in breach (breach = ``degraded``: live, but
+        leave the rotation while the budget burns)."""
+        from torrent_tpu.obs.slo import build_health
+
+        probe_ok = self._probe_task is None or self._probe_task.done()
+        breakers = (
+            self.sched.metrics_snapshot().get("breakers", {})
+            if self.sched is not None
+            else {}
+        )
+        health = build_health(
+            probe_ok=probe_ok,
+            breakers=breakers,
+            sampler_alive=(
+                self.sampler.alive if self.sampler is not None else None
+            ),
+            slo_report=(
+                self.slo_engine.report() if self.slo_engine is not None else None
+            ),
+        )
+        body = json.dumps(health, sort_keys=True).encode()
+        return await self._reply(
+            writer, 200 if health["ready"] else 503, body,
+            content_type="application/json",
+        )
+
     async def _trace_route(self, writer, target: str):
         """``GET /v1/trace`` — the obs plane's query surface.
 
@@ -999,6 +1193,21 @@ def main(argv=None):  # pragma: no cover - manual entrypoint
         help="seconds between controller decisions (default %(default)s)",
     )
     parser.add_argument(
+        "--slo", nargs="?", const=True, default=None, metavar="SPEC",
+        help="arm the timeline sampler + SLO engine (obs/timeline, "
+        "obs/slo): declarative objectives evaluated over a bounded "
+        "sample ring, e.g. 'availability=0.999;p99_ms=50:queue_wait;"
+        "floor_mibps=10;integrity=on' (no SPEC = the default "
+        "availability+integrity contract). Serves GET /v1/timeline, "
+        "/v1/slo and torrent_tpu_slo_*//timeline_* metrics; "
+        "/v1/health reflects breaches either way",
+    )
+    parser.add_argument(
+        "--timeline-interval", type=float, default=1.0, metavar="S",
+        help="seconds between timeline samples when --slo is armed "
+        "(default %(default)s)",
+    )
+    parser.add_argument(
         "--fault-plan", default=None, metavar="SPEC",
         help="inject deterministic hash-plane faults (sched/faults.py spec, "
         "e.g. 'fail_first=3;latency_ms=5'); dev/test mode only",
@@ -1047,6 +1256,8 @@ def main(argv=None):  # pragma: no cover - manual entrypoint
             fault_plan=fault_plan,
             sha256_backend=args.sha256_backend,
             autopilot=autopilot,
+            slo=args.slo,
+            timeline_interval_s=args.timeline_interval,
         )
         print(f"bridge listening on {args.host}:{server.port}")
         await server.wait_closed()

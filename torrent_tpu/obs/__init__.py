@@ -57,6 +57,19 @@ from torrent_tpu.obs.ledger import (
     render_pipeline_metrics,
 )
 from torrent_tpu.obs.recorder import FlightRecorder, flight_recorder
+from torrent_tpu.obs.slo import (
+    SloEngine,
+    SloObjective,
+    build_health,
+    evaluate_slo,
+    parse_objectives,
+)
+from torrent_tpu.obs.timeline import (
+    Timeline,
+    TimelineSampler,
+    build_sample,
+    replay_report,
+)
 from torrent_tpu.obs.tracer import (
     Span,
     Tracer,
@@ -73,10 +86,19 @@ __all__ = [
     "LogHistogram",
     "PIPELINE_STAGES",
     "PipelineLedger",
+    "SloEngine",
+    "SloObjective",
     "Span",
+    "Timeline",
+    "TimelineSampler",
     "Tracer",
     "aggregate_fleet",
     "attribute",
+    "build_health",
+    "build_sample",
+    "evaluate_slo",
+    "parse_objectives",
+    "replay_report",
     "fabric_trace_id",
     "flight_recorder",
     "format_report",

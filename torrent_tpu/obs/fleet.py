@@ -157,6 +157,7 @@ def build_obs_digest(
     hist_snaps: dict,
     sched_snap: dict,
     unit: dict | None = None,
+    slo: dict | None = None,
 ) -> dict:
     """Assemble one process's obs digest from already-taken snapshots.
 
@@ -184,6 +185,11 @@ def build_obs_digest(
         "sched": _digest_sched(sched_snap),
         "unit": dict(sorted((unit or {}).items())),
     }
+    if slo:
+        # the SLO engine's compact budget-health summary (obs/slo
+        # digest_summary): tiny and scalar-only, so it survives the
+        # clamp alongside unit progress
+        digest["slo"] = dict(sorted(slo.items()))
     return clamp_digest(digest)
 
 
@@ -218,8 +224,15 @@ def obs_digest(
     for short, family in DIGEST_HIST_FAMILIES:
         hist_snaps[short] = reg.family_snapshot(family)
     sched_snap = scheduler.metrics_snapshot() if scheduler is not None else {}
+    # worst burn-rate / breach flag from the process's armed SLO engine
+    # (obs/slo): None unless objectives were explicitly configured, so
+    # an unarmed run's digest bytes are byte-identical to before
+    from torrent_tpu.obs import slo as _slo
+
+    engine = _slo.armed()
     return build_obs_digest(
-        pipeline_ledger().snapshot(), base, hist_snaps, sched_snap, unit
+        pipeline_ledger().snapshot(), base, hist_snaps, sched_snap, unit,
+        slo=engine.summary() if engine is not None else None,
     )
 
 
@@ -378,12 +391,35 @@ def aggregate_fleet(
             ),
         }
     fleet_bps = round(sum(rates), 3) if rates else None
+    # fleet-wide SLO budget health: the worst heartbeat-carried burn
+    # rate across reporting processes (digests only carry an "slo"
+    # field when that process armed an engine — obs/slo)
+    slo_rows = {
+        p: digests[p]["slo"]
+        for p in sorted(digests)
+        if isinstance(digests.get(p), dict)
+        and isinstance(digests[p].get("slo"), dict)
+    }
+    slo = None
+    if slo_rows:
+        worst_pid = max(
+            sorted(slo_rows), key=lambda p: slo_rows[p].get("burn") or 0.0
+        )
+        slo = {
+            "pid": worst_pid,
+            "objective": slo_rows[worst_pid].get("objective"),
+            "worst_burn": slo_rows[worst_pid].get("burn"),
+            "breaching": sum(
+                1 for p in sorted(slo_rows) if slo_rows[p].get("breach")
+            ),
+        }
     return {
         "v": DIGEST_VERSION,
         "nproc": nproc,
         "reporting": len(reports),
         "bottleneck": bottleneck,
         "scoreboard": scoreboard,
+        "slo": slo,
         "processes": {str(p): reports[p] for p in sorted(reports)},
         "totals": {**totals, "fleet_bps": fleet_bps},
         "digest_drops": int(digest_drops),

@@ -476,11 +476,19 @@ class _LaneBreaker:
     def snapshot(self) -> dict:
         with self.lock:
             self._cells.read("state")
-            return {
+            out = {
                 "state": self.state,
                 "consecutive_failures": self.failures,
                 "transitions": dict(self.transitions),
+                # readiness semantics (obs/slo.build_health): an open
+                # breaker within its cooldown is a transient degradation;
+                # one stuck open well past it means the half-open probe
+                # path is wedged and the process should leave rotation
+                "cooldown": self.cooldown,
             }
+            if self.state == "open":
+                out["open_age_s"] = max(0.0, time.monotonic() - self.opened_at)
+            return out
 
 
 # --------------------------------------------------------------- planes

@@ -72,6 +72,10 @@ MAX_NUM_WANT = 200
 MAX_REPLY_BYTES = 1200
 MAX_SCRAPE_HASHES = 64
 MAX_BATCH = 256  # transport-queue drain bound per pump cycle
+# /v1/health readiness: the pump stamps every cycle and an idle queue
+# wakes it at least every 5 s, so a stamp older than this means the
+# drive loop is wedged (not merely idle)
+PUMP_MAX_AGE_S = 30.0
 
 
 class _PeerRec:
@@ -601,6 +605,23 @@ async def run_sharded_tracker(
 
     server.metrics_provider = _metrics
 
+    # pump liveness for GET /v1/health: the pump stamps every cycle
+    # (it wakes at least every 5 s on an idle queue), so a stale stamp
+    # means the drive loop is wedged and the LB should pull this node
+    pump_state = {"tick": time.monotonic()}
+
+    def _health() -> dict:
+        from torrent_tpu.obs.slo import armed, build_health
+
+        engine = armed()
+        return build_health(
+            pump_age_s=time.monotonic() - pump_state["tick"],
+            pump_max_age_s=PUMP_MAX_AGE_S,
+            slo_report=engine.report() if engine is not None else None,
+        )
+
+    server.health_provider = _health
+
     # sweep enough shards per tick that a full round-robin cycle always
     # completes within one peer TTL, whatever the shard count — with 64
     # shards a one-shard-per-minute cadence would leave dead peers
@@ -616,6 +637,7 @@ async def run_sharded_tracker(
         last_sweep = time.monotonic()
         it = server.__aiter__()
         while True:
+            pump_state["tick"] = time.monotonic()
             try:
                 req = await asyncio.wait_for(it.__anext__(), timeout=5.0)
             except asyncio.TimeoutError:
@@ -638,6 +660,7 @@ async def run_sharded_tracker(
     task = asyncio.create_task(pump())
     task.tracker = tracker  # expose state for tests/stats
     task.store = store
+    task.pump_state = pump_state
     return server, task
 
 

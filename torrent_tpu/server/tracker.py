@@ -278,6 +278,10 @@ class TrackerServer:
         # optional /metrics provider (set by the sharded announce plane:
         # server/shard.run_sharded_tracker wires render_tracker_metrics)
         self.metrics_provider = None
+        # optional /v1/health provider (zero-arg → the obs/slo
+        # build_health dict; run_sharded_tracker wires pump liveness so
+        # the tracker is deployable behind a real load balancer)
+        self.health_provider = None
         # UDP connection ids: id → minted_at (server/tracker.ts:512-516)
         self._conn_ids: dict[int, float] = {}
 
@@ -382,6 +386,23 @@ class TrackerServer:
         elif route == "stats":
             body = bencode({k.encode(): v for k, v in sorted(self.stats.items())})
             await _http_reply(writer, 200, body)
+        elif route == "health" and self.health_provider is not None:
+            # liveness + readiness (obs/slo.build_health): answering at
+            # all is liveness; 200 only when ready, 503 with the
+            # reasons otherwise — the standard LB probe contract
+            try:
+                health = self.health_provider()
+            except Exception:  # a probe bug must not kill the listener
+                await _http_reply(writer, 500, b"health probe failed")
+                return
+            import json as _json
+
+            await _http_reply(
+                writer,
+                200 if health.get("ready") else 503,
+                _json.dumps(health, sort_keys=True).encode(),
+                content_type="application/json",
+            )
         elif route == "metrics" and self.metrics_provider is not None:
             try:
                 body = self.metrics_provider().encode()

@@ -172,6 +172,8 @@ async def _smoke(total_mb: int, piece_kb: int, batch_target: int) -> dict:
     seconds — the rung CI runs on every PR."""
     from torrent_tpu.obs.attrib import attribute
     from torrent_tpu.obs.ledger import pipeline_ledger
+    from torrent_tpu.obs.slo import default_objectives, evaluate_slo
+    from torrent_tpu.obs.timeline import Timeline, TimelineSampler
     from torrent_tpu.parallel.bulk import verify_library_sched
     from torrent_tpu.sched import HashPlaneScheduler, SchedulerConfig
 
@@ -186,10 +188,18 @@ async def _smoke(total_mb: int, piece_kb: int, batch_target: int) -> dict:
             hasher="cpu",
         )
         await sched.start()
+        # a private timeline bracketing the run (sampled manually, no
+        # thread): the record embeds the ring facts + the SLO verdict
+        # over them, so `summarize --trajectory` carries the schema
+        timeline = Timeline(depth=16)
+        sampler = TimelineSampler(timeline, scheduler=sched)
         try:
+            sampler.sample_once()
             t0 = time.perf_counter()
             res = await verify_library_sched([(storage, info)], sched, tenant="bench")
             seconds = time.perf_counter() - t0
+            sampler.sample_once()
+            slo_rep = evaluate_slo(timeline.samples(), default_objectives())
         finally:
             await sched.close()
         rep = attribute(led.snapshot(), prev=prev)
@@ -220,6 +230,28 @@ async def _smoke(total_mb: int, piece_kb: int, batch_target: int) -> dict:
             "stages": rep["stages"],
             "bottleneck": rep["bottleneck"],
             "overlap": rep.get("overlap"),
+        },
+        # the timeline/SLO plane's schema keys (PR 14): ring facts plus
+        # the default-contract verdict over the bracketing samples — a
+        # clean rung must show zero burn and no breach
+        "timeline": {
+            "samples": len(timeline.samples()),
+            "drops": 0,
+            "limiting": (rep.get("bottleneck") or {}).get("stage")
+            if rep.get("bottleneck")
+            else None,
+        },
+        "slo": {
+            "worst": slo_rep.get("worst"),
+            "breach_any": slo_rep.get("breach_any"),
+            "objectives": {
+                name: {
+                    "burn_rate": obj.get("burn_rate"),
+                    "budget_remaining": obj.get("budget_remaining"),
+                    "classification": obj.get("classification"),
+                }
+                for name, obj in sorted(slo_rep.get("objectives", {}).items())
+            },
         },
     }
 

@@ -1227,6 +1227,8 @@ def _cmd_doctor(args) -> int:
         argv.append("--control")
     if getattr(args, "announce", False):
         argv.append("--announce")
+    if getattr(args, "slo", False):
+        argv.append("--slo")
     if getattr(args, "json", False):
         argv.append("--json")
     return doctor_cli(argv)
@@ -1240,7 +1242,93 @@ def _cmd_top(args) -> int:
         argv.append("--once")
     if getattr(args, "fleet", False):
         argv.append("--fleet")
+    if getattr(args, "history", False):
+        argv.append("--history")
     return top_main(argv)
+
+
+def _cmd_replay(args) -> int:
+    """Offline post-mortem replay of a dumped timeline (obs/timeline):
+    the live attributor re-run over historical sample deltas, so "what
+    was limiting at T-5m" is answerable after the process is gone."""
+    import json as _json
+
+    from torrent_tpu.obs.attrib import format_rate
+    from torrent_tpu.obs.slo import parse_objectives
+    from torrent_tpu.obs.timeline import replay_report
+
+    try:
+        with open(args.file) as f:
+            payload = _json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read timeline {args.file}: {e}", file=sys.stderr)
+        return 2
+    objectives = None
+    if args.slo:
+        try:
+            objectives = parse_objectives(args.slo)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    rep = replay_report(payload, objectives=objectives)
+    if args.json:
+        print(_json.dumps(rep, sort_keys=True))
+        return 0
+    print(
+        f"timeline replay: {rep['samples']} samples over {rep['span_s']:.1f}s"
+        + (f" ({rep['drops']} dropped off the ring)" if rep["drops"] else "")
+    )
+    intervals = rep["intervals"][-max(1, args.intervals):]
+    if not intervals:
+        print("no sample intervals recorded")
+        return 0
+    print(f"{'age':>10s} {'limiting':10s} {'util':>6s} {'rate':>12s}  errors")
+    for itv in intervals:
+        sched = itv.get("sched") or {}
+        errs = int(sched.get("shed", 0) or 0) + int(
+            sched.get("failed_pieces", 0) or 0
+        )
+        print(
+            f"T-{itv['age_s']:7.1f}s {itv.get('limiting') or '—':10s} "
+            f"{(itv.get('utilization') or 0) * 100:5.0f}% "
+            f"{format_rate(itv.get('pipeline_bps')):>12s}  "
+            f"{errs if errs else '—'}"
+        )
+    overall = (rep.get("overall") or {}).get("bottleneck")
+    if overall:
+        print(
+            f"overall: {overall['stage']} limited the span — "
+            f"{overall.get('utilization', 0) * 100:.0f}% utilized, "
+            f"{format_rate(overall.get('achieved_bps'))} achieved"
+        )
+    else:
+        print("overall: pipeline idle across the span")
+    from torrent_tpu.tools.top import format_slo_line
+
+    slo = rep.get("slo")
+    for name, obj in sorted(((slo or {}).get("objectives") or {}).items()):
+        print(format_slo_line(name, obj))
+    return 0
+
+
+def _cmd_serve(args) -> int:
+    from torrent_tpu.tools.serve import main as serve_main
+
+    argv = [
+        "--http-port", str(args.http_port),
+        "--udp-port", str(args.udp_port),
+        "--host", args.host,
+        "--interval", str(args.interval),
+        "--shards", str(args.shards),
+        "--dht-port", str(args.dht_port),
+        "--crawl-interval", str(args.crawl_interval),
+        "--timeline-interval", str(args.timeline_interval),
+    ]
+    if args.slo is not None:
+        argv.append("--slo")
+        if args.slo is not True:
+            argv.append(args.slo)
+    return serve_main(argv)
 
 
 def _cmd_bench(args) -> int:
@@ -1594,6 +1682,9 @@ def _cmd_bridge(args) -> int:
         ]
         + (["--autopilot", "--autopilot-interval", str(args.autopilot_interval)]
            if args.autopilot else [])
+        + ((["--slo"] + ([] if args.slo is True else [args.slo])
+            + ["--timeline-interval", str(args.timeline_interval)])
+           if args.slo is not None else [])
         + (["--fault-plan", args.fault_plan] if args.fault_plan else [])
         + (["--dev"] if args.dev else [])
     )
@@ -1985,6 +2076,12 @@ def build_parser() -> argparse.ArgumentParser:
                     "announces from multiple simulated swarms against "
                     "the sharded store; sampled replies well-formed, "
                     "shard counts reconcile")
+    sp.add_argument("--slo", action="store_true",
+                    help="also run the SLO-engine smoke: a FaultPlan "
+                    "fail burst through a --slo bridge must burn the "
+                    "availability budget, flip /v1/health ready→"
+                    "degraded, fire exactly one slo_breach flight "
+                    "dump, and recover")
     sp.add_argument("--lint", action="store_true",
                     help="also run the analysis-plane smoke: all four "
                     "static passes clean against the committed baseline")
@@ -2011,7 +2108,50 @@ def build_parser() -> argparse.ArgumentParser:
                     help="render the swarm-wide fleet view (/v1/fleet: "
                     "straggler scoreboard + limiting process/stage) "
                     "instead of the local pipeline ledger")
+    sp.add_argument("--history", action="store_true",
+                    help="render the timeline view (/v1/timeline: "
+                    "per-stage sparkline rows over the sample ring + "
+                    "SLO burn/budget lines)")
     sp.set_defaults(fn=_cmd_top)
+
+    sp = sub.add_parser(
+        "replay",
+        help="post-mortem replay of a dumped timeline (obs/timeline): "
+        "the bottleneck attributor re-run over historical sample "
+        "deltas — 'what was limiting at T-5m' after the process died",
+    )
+    sp.add_argument("file", help="a TORRENT_TPU_TIMELINE_DIR dump or a "
+                    "saved GET /v1/timeline payload")
+    sp.add_argument("--slo", default=None, metavar="SPEC",
+                    help="also evaluate SLO objectives over the ring "
+                    "(obs/slo spec, e.g. 'availability=0.999;integrity=on')")
+    sp.add_argument("--intervals", type=int, default=12,
+                    help="most-recent intervals to print (default %(default)s)")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the full replay report as JSON")
+    sp.set_defaults(fn=_cmd_replay)
+
+    sp = sub.add_parser(
+        "serve",
+        help="long-running tracker deployment: sharded announce plane + "
+        "DHT indexer crawl loop + /v1/health + /metrics in one command",
+    )
+    sp.add_argument("--http-port", type=int, default=8000)
+    sp.add_argument("--udp-port", type=int, default=6969,
+                    help="negative disables the UDP transport")
+    sp.add_argument("--host", default="0.0.0.0")
+    sp.add_argument("--interval", type=int, default=600)
+    sp.add_argument("--shards", type=int, default=8)
+    sp.add_argument("--dht-port", type=int, default=6881,
+                    help="DHT indexer UDP port (negative disables)")
+    sp.add_argument("--crawl-interval", type=float, default=300.0,
+                    help="seconds between BEP 51 crawl steps")
+    sp.add_argument("--slo", nargs="?", const=True, default=None,
+                    metavar="SPEC",
+                    help="arm the timeline sampler + SLO engine (no SPEC "
+                    "= the default availability+integrity contract)")
+    sp.add_argument("--timeline-interval", type=float, default=2.0)
+    sp.set_defaults(fn=_cmd_serve)
 
     sp = sub.add_parser(
         "bench",
@@ -2096,6 +2236,17 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="S",
                     help="seconds between controller decisions "
                     "(default %(default)s)")
+    sp.add_argument("--slo", nargs="?", const=True, default=None,
+                    metavar="SPEC",
+                    help="arm the timeline sampler + SLO engine "
+                    "(obs/slo spec; no SPEC = the default availability+"
+                    "integrity contract). Serves /v1/timeline, /v1/slo "
+                    "and the torrent_tpu_slo_*/timeline_* series; "
+                    "/v1/health reflects breaches")
+    sp.add_argument("--timeline-interval", type=float, default=1.0,
+                    metavar="S",
+                    help="seconds between timeline samples when --slo "
+                    "is armed (default %(default)s)")
     sp.add_argument("--fault-plan", default=None, metavar="SPEC",
                     help="inject deterministic hash-plane faults "
                     "(sched/faults.py spec; requires --dev or TORRENT_TPU_DEV=1)")

@@ -1022,6 +1022,83 @@ async def _control_smoke() -> str:
     )
 
 
+async def _slo_smoke() -> str:
+    """SLO-engine smoke (``--slo``): a ``--slo``-armed bridge with a
+    deterministic ``FaultPlan`` payload-poison plan. Healthy traffic
+    keeps ``/v1/health`` ready; a burst of poisoned pieces (every piece
+    fails deterministically → ``failed_pieces`` burns the availability
+    budget) must drive ``/v1/slo`` into a fast-burn breach, flip
+    ``/v1/health`` ready→degraded (503), and fire exactly ONE
+    ``slo_breach`` flight-recorder dump; healthy traffic afterwards must
+    clear the breach and restore readiness. Timeline samples are driven
+    manually (``sampler.sample_once()``) so the whole scenario is
+    deterministic on CPU — no cadence races."""
+    import json as _json
+
+    from torrent_tpu.bridge.service import BridgeServer
+    from torrent_tpu.codec.bencode import bencode
+    from torrent_tpu.obs.recorder import flight_recorder
+    from torrent_tpu.sched import FaultPlan
+
+    poison = b"DOCTORPOISON"
+    _http = _http_request
+
+    svc = await BridgeServer(
+        "127.0.0.1", port=0, hasher="cpu",
+        fault_plan=FaultPlan.parse(f"payload={poison.hex()}"),
+        slo="availability=0.99", timeline_interval_s=3600.0,
+        slo_short_samples=4, slo_long_samples=64,
+    ).start()
+    try:
+        await svc._probe_task  # readiness gates on the resolved probe
+        base_dumps = flight_recorder().counts().get("slo_breach", 0)
+        good = bencode({b"pieces": [b"healthy-piece-%d" % i for i in range(8)]})
+        svc.sampler.sample_once()
+        status, _ = await _http(svc.port, "POST", "/v1/digests", good)
+        assert status == 200, f"healthy wave failed: {status}"
+        svc.sampler.sample_once()
+        status, body = await _http(svc.port, "GET", "/v1/health")
+        health = _json.loads(body)
+        assert status == 200 and health["status"] == "ready", health
+
+        # the burst: every piece carries the poison prefix → the whole
+        # launch fails deterministically → failed_pieces burns budget
+        bad = bencode({b"pieces": [poison + b"-%d" % i for i in range(8)]})
+        status, _ = await _http(svc.port, "POST", "/v1/digests", bad)
+        assert status == 500, f"poisoned wave should 500: {status}"
+        svc.sampler.sample_once()
+        status, body = await _http(svc.port, "GET", "/v1/slo")
+        slo = _json.loads(body)
+        avail = slo["report"]["objectives"]["availability"]
+        assert avail["breach"] and avail["classification"] == "fast_burn", avail
+        assert avail["budget_remaining"] < 1.0, avail
+        status, body = await _http(svc.port, "GET", "/v1/health")
+        health = _json.loads(body)
+        assert status == 503 and health["status"] == "degraded", health
+        dumps = flight_recorder().counts().get("slo_breach", 0) - base_dumps
+        assert dumps == 1, f"expected exactly one slo_breach dump, got {dumps}"
+
+        # recovery: healthy waves push the errors out of the short
+        # window; the breach clears and readiness returns
+        for _ in range(5):
+            status, _ = await _http(svc.port, "POST", "/v1/digests", good)
+            assert status == 200
+            svc.sampler.sample_once()
+        status, body = await _http(svc.port, "GET", "/v1/health")
+        health = _json.loads(body)
+        assert status == 200 and health["status"] == "ready", health
+        dumps = flight_recorder().counts().get("slo_breach", 0) - base_dumps
+        assert dumps == 1, f"recovery must not re-dump: {dumps}"
+        burned = avail["budget_remaining"]
+    finally:
+        svc.close()
+        await svc.wait_closed()
+    return (
+        f"availability fast-burn breach (budget {burned * 100:.0f}% left), "
+        "health ready→degraded→ready, exactly one slo_breach dump"
+    )
+
+
 async def _announce_smoke() -> str:
     """Announce-plane smoke (``--announce``): concurrent announce storms
     from multiple simulated swarms against the sharded store, then
@@ -1112,32 +1189,39 @@ def _lint_smoke() -> str:
     )
 
 
+async def _http_request(port: int, method: str, path: str, body: bytes = b""):
+    """Minimal loopback HTTP round-trip (status, payload) — the bridge
+    and SLO smokes share it; doctor must not depend on a client lib."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(
+        f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n".encode() + body
+    )
+    await writer.drain()
+    status_line = await reader.readline()
+    clen = 0
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b""):
+            break
+        if line.lower().startswith(b"content-length:"):
+            clen = int(line.split(b":", 1)[1])
+    payload = await reader.readexactly(clen)
+    writer.close()
+    return int(status_line.split()[1]), payload
+
+
 async def _bridge_smoke() -> None:
     from torrent_tpu.bridge.service import BridgeServer
     from torrent_tpu.codec.bencode import bdecode, bencode
 
     svc = await BridgeServer("127.0.0.1", port=0, hasher="cpu").start()
     try:
-        reader, writer = await asyncio.open_connection("127.0.0.1", svc.port)
-        body = bencode({b"pieces": [b"doctor"]})
-        writer.write(
-            b"POST /v1/digests HTTP/1.1\r\nHost: x\r\nContent-Length: "
-            + str(len(body)).encode()
-            + b"\r\n\r\n"
-            + body
+        status, resp = await _http_request(
+            svc.port, "POST", "/v1/digests",
+            bencode({b"pieces": [b"doctor"]}),
         )
-        await writer.drain()
-        status = await reader.readline()
-        assert b"200" in status, status
-        clen = 0
-        while True:
-            line = await reader.readline()
-            if line in (b"\r\n", b""):
-                break
-            if line.lower().startswith(b"content-length:"):
-                clen = int(line.split(b":", 1)[1])
-        resp = await reader.readexactly(clen)
-        writer.close()
+        assert status == 200, status
         got = bdecode(resp)[b"digests"][0]
         assert got == hashlib.sha1(b"doctor").digest(), "bridge digest wrong"
     finally:
@@ -1218,6 +1302,14 @@ def main(argv=None) -> int:
         "scheduler under the controller must get its lane target grown "
         "and its admission budget pulled toward the limiting stage, while "
         "a disabled controller moves nothing",
+    )
+    ap.add_argument(
+        "--slo",
+        action="store_true",
+        help="also run the SLO-engine smoke: a FaultPlan fail burst "
+        "through a --slo bridge burns the availability budget, flips "
+        "/v1/health ready→degraded, fires exactly one slo_breach "
+        "flight dump, and recovers",
     )
     ap.add_argument(
         "--announce",
@@ -1331,6 +1423,12 @@ def main(argv=None) -> int:
             _report("PASS", "announce plane", detail)
         except Exception as e:
             _report("FAIL", "announce plane", repr(e))
+    if args.slo:
+        try:
+            detail = asyncio.run(asyncio.wait_for(_slo_smoke(), 60))
+            _report("PASS", "slo engine", detail)
+        except Exception as e:
+            _report("FAIL", "slo engine", repr(e))
     if args.fabric:
         with tempfile.TemporaryDirectory(prefix="doctor_fabric_") as tmp:
             try:

@@ -47,9 +47,22 @@ import urllib.request
 
 from torrent_tpu.obs.attrib import format_rate as _fmt_rate
 
-__all__ = ["fetch_fleet", "fetch_pipeline", "render_fleet", "render_top", "main"]
+__all__ = [
+    "fetch_fleet",
+    "fetch_pipeline",
+    "fetch_slo",
+    "fetch_timeline",
+    "format_slo_line",
+    "render_fleet",
+    "render_history",
+    "render_top",
+    "main",
+]
 
 BAR_WIDTH = 26
+# sparkline glyphs for the --history rows (8 levels + a blank for zero)
+SPARKS = " ▁▂▃▄▅▆▇█"
+HISTORY_WIDTH = 60
 
 
 def fetch_pipeline(url: str, timeout: float = 10.0) -> dict:
@@ -66,6 +79,25 @@ def fetch_fleet(url: str, timeout: float = 10.0) -> dict:
         url.rstrip("/") + "/v1/fleet", timeout=timeout
     ) as r:
         return json.loads(r.read().decode())
+
+
+def fetch_timeline(url: str, timeout: float = 10.0) -> dict:
+    """One ``GET /v1/timeline`` read. Raises OSError-family on failure."""
+    with urllib.request.urlopen(
+        url.rstrip("/") + "/v1/timeline", timeout=timeout
+    ) as r:
+        return json.loads(r.read().decode())
+
+
+def fetch_slo(url: str, timeout: float = 10.0) -> dict | None:
+    """One ``GET /v1/slo`` read; None when the route is unreachable."""
+    try:
+        with urllib.request.urlopen(
+            url.rstrip("/") + "/v1/slo", timeout=timeout
+        ) as r:
+            return json.loads(r.read().decode())
+    except (OSError, ValueError):
+        return None
 
 
 def _fmt_bytes(n: int) -> str:
@@ -154,6 +186,84 @@ def render_top(payload: dict, url: str = "") -> str:
     return "\n".join(lines)
 
 
+def format_slo_line(name: str, obj: dict) -> str:
+    """One objective's burn/budget summary line (pure) — shared by
+    ``top --history`` and the ``replay`` CLI so the two never drift."""
+    return (
+        f"slo {name}: burn ×{obj.get('burn_rate', 0.0):.1f} "
+        f"[{obj.get('classification', 'ok')}], budget "
+        f"{obj.get('budget_remaining', 1.0) * 100:.1f}% left"
+        + ("  ** BREACH **" if obj.get("breach") else "")
+    )
+
+
+def _spark(values: list[float], vmax: float | None = None) -> str:
+    """One sparkline row (pure): values scaled into 9 glyph levels."""
+    if not values:
+        return ""
+    top = vmax if vmax else max(values)
+    if top <= 0:
+        return SPARKS[0] * len(values)
+    out = []
+    for v in values:
+        level = int(round(min(1.0, max(0.0, v / top)) * (len(SPARKS) - 1)))
+        out.append(SPARKS[level])
+    return "".join(out)
+
+
+def render_history(timeline_payload: dict, slo_payload: dict | None = None,
+                   url: str = "", width: int = HISTORY_WIDTH) -> str:
+    """Render the ``--history`` frame from a ``/v1/timeline`` payload
+    (pure): per-stage utilization sparklines over the ring's consecutive
+    sample deltas, a pipeline-rate row, and (when ``/v1/slo`` answered
+    with a report) the burn-rate/budget line per objective."""
+    from torrent_tpu.obs.ledger import PIPELINE_STAGES
+    from torrent_tpu.obs.timeline import replay_report
+
+    rep = replay_report(timeline_payload)
+    intervals = rep.get("intervals") or []
+    intervals = intervals[-width:]
+    lines = []
+    head = "torrent-tpu history"
+    if url:
+        head += f" — {url}"
+    head += f"  {rep.get('samples', 0)} samples over {rep.get('span_s', 0.0):.0f}s"
+    if rep.get("drops"):
+        head += f"  ({rep['drops']} dropped)"
+    lines.append(head)
+    if not intervals:
+        lines.append("timeline empty: no sample intervals recorded yet")
+        return "\n".join(lines)
+    # one sparkline row per stage that ever held the limiting verdict:
+    # utilization drawn only on the intervals that stage owned, so the
+    # frame reads as "who owned each slice of the span"
+    names = sorted({itv.get("limiting") for itv in intervals if itv.get("limiting")})
+    order = [s for s in PIPELINE_STAGES if s in names] + [
+        s for s in names if s not in PIPELINE_STAGES
+    ]
+    for name in order:
+        series = [
+            (itv.get("utilization") or 0.0) if itv.get("limiting") == name else 0.0
+            for itv in intervals
+        ]
+        lines.append(f"{name:8s} |{_spark(series, vmax=1.0)}|  limiting intervals")
+    rate = [itv.get("pipeline_bps") or 0.0 for itv in intervals]
+    if any(rate):
+        lines.append(
+            f"{'rate':8s} |{_spark(rate)}|  peak {_fmt_rate(max(rate))}"
+        )
+    overall = (rep.get("overall") or {}).get("bottleneck")
+    if overall:
+        lines.append(
+            f"overall: {overall['stage']} limited the span "
+            f"({overall.get('utilization', 0) * 100:.0f}% utilized)"
+        )
+    report = (slo_payload or {}).get("report")
+    for name, obj in sorted(((report or {}).get("objectives") or {}).items()):
+        lines.append(format_slo_line(name, obj))
+    return "\n".join(lines)
+
+
 def render_fleet(payload: dict, url: str = "") -> str:
     """Render one fleet frame from a ``/v1/fleet`` payload (pure).
 
@@ -210,6 +320,17 @@ def render_fleet(payload: dict, url: str = "") -> str:
         if bn.get("headroom"):
             line += f" ({bn['headroom']}x headroom)"
         lines.append(line)
+    slo = payload.get("slo")
+    if isinstance(slo, dict):
+        # fleet-wide budget health: the worst heartbeat-carried burn
+        # rate (obs/slo digest_summary riding the PR 10 digests)
+        line = (
+            f"budget: worst burn ×{slo.get('worst_burn') or 0.0:.1f} "
+            f"({slo.get('objective')}, pid {slo.get('pid')})"
+        )
+        if slo.get("breaching"):
+            line += f"  ** {slo['breaching']} process(es) in BREACH **"
+        lines.append(line)
     if payload.get("digest_drops"):
         lines.append(
             f"digest drops: {payload['digest_drops']} heartbeat(s) shed "
@@ -243,13 +364,24 @@ def main(argv=None) -> int:
         "scoreboard + limiting process/stage) instead of the local "
         "pipeline ledger",
     )
+    ap.add_argument(
+        "--history", action="store_true",
+        help="render the timeline view (GET /v1/timeline: per-stage "
+        "sparkline rows over the sample ring + SLO burn/budget lines) "
+        "instead of the instantaneous frame",
+    )
     args = ap.parse_args(argv)
-    route = "/v1/fleet" if args.fleet else "/v1/pipeline"
+    route = (
+        "/v1/fleet" if args.fleet
+        else "/v1/timeline" if args.history
+        else "/v1/pipeline"
+    )
     try:
         while True:
             try:
                 payload = (
                     fetch_fleet(args.url) if args.fleet
+                    else fetch_timeline(args.url) if args.history
                     else fetch_pipeline(args.url)
                 )
             except (OSError, ValueError) as e:
@@ -258,6 +390,8 @@ def main(argv=None) -> int:
                 return 1
             frame = (
                 render_fleet(payload, url=args.url) if args.fleet
+                else render_history(payload, fetch_slo(args.url), url=args.url)
+                if args.history
                 else render_top(payload, url=args.url)
             )
             if args.once:

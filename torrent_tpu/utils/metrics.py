@@ -459,6 +459,100 @@ def render_fleet_metrics(rollup: dict) -> str:
         "# TYPE torrent_tpu_fleet_digest_dropped_total counter",
         f"torrent_tpu_fleet_digest_dropped_total {s.get('digest_drops', 0)}",
     ]
+    # fleet-wide SLO budget health: the worst heartbeat-carried burn
+    # rate across reporting processes (absent when no peer armed an
+    # engine — the series simply don't exist)
+    slo = s.get("slo")
+    if isinstance(slo, dict):
+        lines += [
+            "# HELP torrent_tpu_fleet_slo_worst_burn_rate Worst short-window error-budget burn rate across the fleet",
+            "# TYPE torrent_tpu_fleet_slo_worst_burn_rate gauge",
+            "torrent_tpu_fleet_slo_worst_burn_rate"
+            f'{{pid="{slo.get("pid", 0)}",objective="{_esc(str(slo.get("objective", "")))}"}} '
+            f"{slo.get('worst_burn') or 0.0}",
+            "# HELP torrent_tpu_fleet_slo_breaching Reporting processes whose digest carries an active SLO breach",
+            "# TYPE torrent_tpu_fleet_slo_breaching gauge",
+            f"torrent_tpu_fleet_slo_breaching {slo.get('breaching', 0)}",
+        ]
+    return "\n".join(lines) + "\n"
+
+
+def render_timeline_metrics(snapshot: dict) -> str:
+    """Prometheus rendering of a timeline ring
+    (``obs.timeline.Timeline.snapshot()``; the caller may merge a
+    ``sampler_alive`` bool in). Appended to /metrics only while a
+    timeline is armed — the series simply don't exist otherwise.
+    Defensive against partial snapshots: missing keys render as 0."""
+    s = snapshot or {}
+    # ring fill: prefer the O(1) `fill` counter (Timeline.stats()); a
+    # full snapshot's sample list still works
+    samples = s.get("samples") or []
+    fill = s.get("fill")
+    if fill is None:
+        fill = len(samples) if isinstance(samples, list) else 0
+    lines = [
+        "# HELP torrent_tpu_timeline_samples_total Timeline samples captured since start",
+        "# TYPE torrent_tpu_timeline_samples_total counter",
+        f"torrent_tpu_timeline_samples_total {s.get('seq', 0)}",
+        "# HELP torrent_tpu_timeline_dropped_total Samples that fell off the bounded ring",
+        "# TYPE torrent_tpu_timeline_dropped_total counter",
+        f"torrent_tpu_timeline_dropped_total {s.get('drops', 0)}",
+        "# HELP torrent_tpu_timeline_depth Configured ring depth",
+        "# TYPE torrent_tpu_timeline_depth gauge",
+        f"torrent_tpu_timeline_depth {s.get('depth', 0)}",
+        "# HELP torrent_tpu_timeline_ring_fill Samples currently held in the ring",
+        "# TYPE torrent_tpu_timeline_ring_fill gauge",
+        f"torrent_tpu_timeline_ring_fill {fill}",
+    ]
+    if "sampler_alive" in s:
+        lines += [
+            "# HELP torrent_tpu_timeline_sampler_alive Off-loop sampler thread liveness (0 = readiness problem)",
+            "# TYPE torrent_tpu_timeline_sampler_alive gauge",
+            f"torrent_tpu_timeline_sampler_alive {1 if s.get('sampler_alive') else 0}",
+        ]
+    return "\n".join(lines) + "\n"
+
+
+def render_slo_metrics(report: dict | None) -> str:
+    """Prometheus rendering of an SLO evaluation report
+    (``obs.slo.evaluate_slo`` / ``SloEngine.report()``). Appended to
+    /metrics only while an engine is armed. ``None`` (no report yet)
+    renders headers with no samples — never a crash mid-scrape."""
+    objectives = (report or {}).get("objectives") or {}
+    lines = [
+        "# HELP torrent_tpu_slo_budget_remaining Error budget remaining over the long window (1 = untouched)",
+        "# TYPE torrent_tpu_slo_budget_remaining gauge",
+    ]
+    for name in sorted(objectives):
+        obj = objectives[name] if isinstance(objectives[name], dict) else {}
+        lines.append(
+            f'torrent_tpu_slo_budget_remaining{{objective="{_esc(name)}"}} '
+            f"{obj.get('budget_remaining', 1.0)}"
+        )
+    lines += [
+        "# HELP torrent_tpu_slo_burn_rate Error-budget burn rate by window (1 = budget spent exactly at the window length)",
+        "# TYPE torrent_tpu_slo_burn_rate gauge",
+    ]
+    for name in sorted(objectives):
+        obj = objectives[name] if isinstance(objectives[name], dict) else {}
+        lines.append(
+            f'torrent_tpu_slo_burn_rate{{objective="{_esc(name)}",window="short"}} '
+            f"{obj.get('burn_rate', 0.0)}"
+        )
+        lines.append(
+            f'torrent_tpu_slo_burn_rate{{objective="{_esc(name)}",window="long"}} '
+            f"{obj.get('burn_rate_long', 0.0)}"
+        )
+    lines += [
+        "# HELP torrent_tpu_slo_breach Objective breach state (1 = page-now: fast burn or exhausted budget still erroring)",
+        "# TYPE torrent_tpu_slo_breach gauge",
+    ]
+    for name in sorted(objectives):
+        obj = objectives[name] if isinstance(objectives[name], dict) else {}
+        lines.append(
+            f'torrent_tpu_slo_breach{{objective="{_esc(name)}"}} '
+            f"{1 if obj.get('breach') else 0}"
+        )
     return "\n".join(lines) + "\n"
 
 
@@ -708,6 +802,14 @@ class MetricsServer:
                 from torrent_tpu.obs import render_obs_metrics
 
                 text += render_obs_metrics()
+                # SLO-series parity with the bridge: when this process
+                # armed an engine (obs/slo), its budget/burn/breach
+                # series join the session exposition too
+                from torrent_tpu.obs.slo import armed as _slo_armed
+
+                engine = _slo_armed()
+                if engine is not None:
+                    text += render_slo_metrics(engine.report())
                 from torrent_tpu.analysis import sanitizer
 
                 if sanitizer.is_enabled():

@@ -93,6 +93,12 @@ def _normalize(rec: dict, artifact: str) -> dict:
                 # zero burn, so a regression investigator can see
                 # whether the slower record was also BURNING budget
                 "timeline", "slo",
+                # the swarm wire-plane rung schema (bench swarm): the
+                # telemetry facts (block-RTT p99, snubs, endgame
+                # cancels) ride the banked rate, and the embedded
+                # ledger already carries the recv-stage breakdown —
+                # a swarm regression must name the wire, not guess
+                "swarm",
                 # the comparator's full like-for-like shape key
                 "piece_kb", "bytes", "nproc"):
         if key in rec:

@@ -768,7 +768,8 @@ _slo_value = st.none() | st.integers(-10**6, 10**6) | st.floats(
 )
 _slo_sample = st.dictionaries(
     st.sampled_from(
-        ["t", "stages", "sched", "hist", "integrity", "overlap_s", "junk"]
+        ["t", "stages", "sched", "hist", "integrity", "overlap_s", "swarm",
+         "junk"]
     ) | st.text(max_size=5),
     _slo_value,
     max_size=6,
@@ -790,14 +791,16 @@ class TestSloProperties:
             samples,
             parse_objectives(
                 "availability=0.999;p99_ms=50:queue_wait;"
-                "floor_mibps=1;integrity=on"
+                "floor_mibps=1;integrity=on;"
+                "swarm_floor_mibps=1;swarm_snub=0.99"
             ),
             short_samples=3,
             long_samples=8,
         )
         objs = rep["objectives"]
         assert set(objs) == {
-            "availability", "integrity", "latency_queue_wait", "throughput"
+            "availability", "integrity", "latency_queue_wait", "throughput",
+            "swarm_availability", "swarm_throughput",
         }
         for obj in objs.values():
             assert 0.0 <= obj["budget_remaining"] <= 1.0
@@ -839,3 +842,74 @@ class TestSloProperties:
                 "objectives"]["availability"]["burn_rate"]
 
         assert burn(e1 + extra) >= burn(e1)
+
+
+# hostile raw peer records for the swarm rollup: scalars, wrong-typed
+# sub-fields, missing keys, junk keys — everything the pure builder must
+# swallow without crashing (the ISSUE 15 totality satellite)
+_swarm_value = st.none() | st.booleans() | st.integers(-(2**40), 2**40) | \
+    st.floats(allow_nan=True, allow_infinity=True) | st.text(max_size=8) | \
+    st.lists(st.integers(-5, 5) | st.floats(allow_nan=True), max_size=30) | \
+    st.dictionaries(st.text(max_size=6), st.integers(-5, 5), max_size=4)
+_swarm_peer_raw = st.dictionaries(
+    st.sampled_from(
+        ["bytes_down", "bytes_up", "blocks", "msgs", "state", "flag_true_s",
+         "transitions", "depth", "depth_max", "rtt_counts", "rtt_count",
+         "rtt_sum", "snubs", "snubbed", "rejects", "endgame_cancels",
+         "corrupt", "connected_s", "inbound", "junk"]
+    ) | st.text(max_size=5),
+    _swarm_value,
+    max_size=8,
+)
+
+
+class TestSwarmSnapshotProperties:
+    """ISSUE 15 satellite: the swarm wire plane's pure rollup is total
+    over hostile peer states — arbitrary raw dicts produce a
+    well-formed, bounded, deterministic snapshot."""
+
+    @given(
+        st.dictionaries(
+            st.text(max_size=10) | st.integers(-5, 5),
+            _swarm_peer_raw | _swarm_value,
+            max_size=12,
+        ),
+        _swarm_peer_raw | _swarm_value,
+    )
+    @settings(max_examples=200, deadline=None)
+    def test_build_swarm_snapshot_total(self, peer_raws, totals):
+        import json
+
+        from torrent_tpu.obs.swarm import TOP_PEERS, build_swarm_snapshot
+
+        snap = build_swarm_snapshot(peer_raws, totals)
+        # bounded: never more than TOP_PEERS named entries
+        assert len(snap["peers"]) <= TOP_PEERS
+        assert set(snap["counts"]) == {
+            "connected", "snubbed", "choking_us", "interested_in",
+            "unchoked_by_us",
+        }
+        # every named entry is fully normalized (ints/bools/rounded
+        # floats), and the whole snapshot is JSON-serializable with NO
+        # non-finite values (json.dumps would emit Infinity/NaN tokens)
+        text = json.dumps(snap, sort_keys=True, allow_nan=False)
+        # deterministic: same input → same bytes
+        assert text == json.dumps(
+            build_swarm_snapshot(peer_raws, totals), sort_keys=True,
+            allow_nan=False,
+        )
+
+    @given(
+        st.lists(st.integers(0, 2**30), min_size=0, max_size=30),
+        st.integers(-5, 2**30),
+        st.floats(allow_nan=True, allow_infinity=True),
+    )
+    @settings(max_examples=200, deadline=None)
+    def test_rtt_summary_total(self, counts, count, total):
+        from torrent_tpu.obs.swarm import _rtt_summary
+
+        out = _rtt_summary(counts, count, total)
+        assert set(out) == {"count", "mean_s", "p50_s", "p99_s", "p99_overflow"}
+        for key in ("p50_s", "p99_s", "mean_s"):
+            v = out[key]
+            assert v is None or (v == v and abs(v) != float("inf"))

@@ -506,6 +506,64 @@ class TestRendererEdgeCases:
         assert "torrent_tpu_fleet_reporting 1" in text
         assert "torrent_tpu_control_enabled 1" in text
         assert "torrent_tpu_tracker_announces_total 1" in text
+        # the swarm wire-plane families ride render_obs_metrics, so the
+        # full bridge/MetricsServer payload carries both new families
+        assert "torrent_tpu_swarm_peers " in text
+        assert "torrent_tpu_peer_bytes_down_total" in text
+
+
+class TestSwarmRenderer:
+    """The swarm wire-plane renderer (obs/swarm → render_swarm_metrics):
+    fresh registries, hostile/partial snapshots, and the bounded
+    per-peer family's top-K + overflow contract."""
+
+    def test_fresh_registry_renders_clean(self):
+        from torrent_tpu.obs.swarm import SwarmTelemetry
+        from torrent_tpu.utils.metrics import render_swarm_metrics
+
+        text = render_swarm_metrics(SwarmTelemetry().snapshot())
+        prom_lint(text)
+        assert "torrent_tpu_swarm_peers 0" in text
+        assert "torrent_tpu_swarm_connections_total 0" in text
+        assert 'torrent_tpu_swarm_flight_triggers_total{reason="snub_storm"} 0' in text
+
+    def test_partial_snapshot_tolerated(self):
+        from torrent_tpu.utils.metrics import render_swarm_metrics
+
+        prom_lint(render_swarm_metrics({}))
+        prom_lint(render_swarm_metrics(None))
+        # hostile shapes: wrong-typed sub-dicts render as zeros
+        text = render_swarm_metrics(
+            {"counts": {"connected": 3}, "peers": {"x": {"bytes_down": 7}},
+             "overflow": None, "totals": None, "msgs": {"Piece": "bogus"}}
+        )
+        prom_lint(text)
+        assert "torrent_tpu_swarm_peers 3" in text
+        assert 'torrent_tpu_peer_bytes_down_total{peer="x"} 7' in text
+
+    def test_peer_overflow_fold(self):
+        from torrent_tpu.obs.swarm import SwarmTelemetry, TOP_PEERS
+        from torrent_tpu.utils.metrics import render_swarm_metrics
+
+        reg = SwarmTelemetry()
+        n = TOP_PEERS + 5
+        for i in range(n):
+            key = f"p{i:02d}@10.0.0.{i}:6881"
+            reg.peer_connected(key)
+            reg.on_block(key, (i + 1) * 1000, 0.002)
+        snap = reg.snapshot()
+        assert len(snap["peers"]) == TOP_PEERS
+        assert snap["overflow"]["peers"] == n - TOP_PEERS
+        # named peers are the TOP transferors; the fold keeps the rest's
+        # bytes and RTT observations
+        assert snap["overflow"]["bytes_down"] == sum(
+            (i + 1) * 1000 for i in range(n - TOP_PEERS)
+        )
+        assert snap["overflow"]["block_rtt"]["count"] == n - TOP_PEERS
+        text = render_swarm_metrics(snap)
+        prom_lint(text)
+        assert text.count("torrent_tpu_peer_bytes_down_total{") == TOP_PEERS + 1
+        assert 'torrent_tpu_peer_bytes_down_total{peer="overflow"}' in text
 
 
 class TestLiveScrape:

@@ -179,7 +179,7 @@ class TestAttrib:
         assert rep["bottleneck"]["utilization"] == pytest.approx(0.9)
 
     def test_stage_order_constant(self):
-        assert PIPELINE_STAGES == ("read", "stage", "h2d", "launch",
+        assert PIPELINE_STAGES == ("recv", "read", "stage", "h2d", "launch",
                                    "digest", "verdict")
 
 

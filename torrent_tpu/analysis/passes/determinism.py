@@ -106,7 +106,11 @@ SCOPE: dict[str, frozenset[str]] = {
             "_eval_latency",
             "_eval_throughput",
             "_eval_integrity",
+            "_eval_swarm_availability",
+            "_eval_swarm_throughput",
             "_avail_counters",
+            "_swarm_avail_counters",
+            "_swarm_throughput_intervals",
             "_window_delta",
             "_hist_window",
             "_hist_errors",
@@ -114,6 +118,19 @@ SCOPE: dict[str, frozenset[str]] = {
             "_throughput_intervals",
             "_integrity_counters_of",
             "_tail",
+        }
+    ),
+    # the swarm wire plane's pure rollup builders (obs/swarm): the
+    # snapshot feeds /v1/swarm, /metrics, bench records, and flight
+    # dumps — same sorted-iteration / no-clock / no-randomness contract
+    # as the digest builders (the registry finalizes every duration
+    # BEFORE these run)
+    "obs/swarm.py": frozenset(
+        {
+            "build_swarm_snapshot",
+            "_peer_entry",
+            "_fold_entries",
+            "_rtt_summary",
         }
     ),
     # timeline sample builders + the offline replay attributor: samples

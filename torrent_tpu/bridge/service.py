@@ -16,7 +16,8 @@ codec every BitTorrent client already has:
                        trace; without id, the flight recorder's black-
                        box dumps + known trace ids (torrent_tpu/obs)
   GET  /v1/pipeline  → JSON: the pipeline ledger's per-stage snapshot
-                       (read → stage → h2d → launch → digest → verdict)
+                       (recv → read → stage → h2d → launch → digest →
+                       verdict)
                        plus the bottleneck attributor's verdict — which
                        stage limits the pipeline, achieved vs demanded
                        rate (obs/ledger + obs/attrib; `torrent-tpu top`
@@ -38,6 +39,13 @@ codec every BitTorrent client already has:
                        breaker is stuck open past cooldown, the sampler
                        is alive, and no SLO objective is in breach
                        (503 with reasons otherwise)
+  GET  /v1/swarm     → JSON: the swarm wire plane's bounded per-peer
+                       telemetry (obs/swarm): top-K peers + overflow
+                       fold, per-peer message/byte accounting, choke
+                       timelines, block-RTT p50/p99, snub and
+                       endgame-cancel counters, announce health
+                       (`torrent-tpu top --swarm` renders it live; the
+                       session MetricsServer answers the same route)
 
 Every request runs under a trace span: an ``X-Trace-Id`` request header
 is honored (well-formed tokens only) or a fresh id is minted, the id is
@@ -148,7 +156,7 @@ _KNOWN_ROUTES = frozenset(
     {
         "/v1/digests", "/v1/verify", "/v1/info", "/v1/trace", "/metrics",
         "/v1/pipeline", "/v1/fleet", "/v1/control",
-        "/v1/timeline", "/v1/slo", "/v1/health",
+        "/v1/timeline", "/v1/slo", "/v1/health", "/v1/swarm",
         "/v1/fabric/verify", "/v1/fabric/status",
         "/v1/stream/digests", "/v1/stream/verify",
     }
@@ -725,6 +733,8 @@ class BridgeServer:
             return await self._slo_route(writer)
         if method == "GET" and target.split("?")[0] == "/v1/health":
             return await self._health_route(writer)
+        if method == "GET" and target.split("?")[0] == "/v1/swarm":
+            return await self._swarm_route(writer)
         if method == "GET" and target == "/v1/fabric/status":
             return await self._reply(writer, 200, bencode(self._fabric_status()))
         if method != "POST":
@@ -1065,6 +1075,22 @@ class BridgeServer:
         return await self._reply(
             writer, 200 if health["ready"] else 503, body,
             content_type="application/json",
+        )
+
+    async def _swarm_route(self, writer):
+        """``GET /v1/swarm`` — the swarm wire plane's telemetry surface.
+
+        The process-global :mod:`obs/swarm` registry's bounded snapshot:
+        top-K peers + overflow fold, choke timelines, block-RTT
+        summaries, announce health, flight-trigger counters. Always
+        answers (an idle hash-plane sidecar reports zero peers). JSON
+        with sorted keys; pure in-memory reads, safe on the serving
+        loop."""
+        from torrent_tpu.obs.swarm import swarm_telemetry
+
+        body = json.dumps(swarm_telemetry().snapshot(), sort_keys=True).encode()
+        return await self._reply(
+            writer, 200, body, content_type="application/json"
         )
 
     async def _trace_route(self, writer, target: str):

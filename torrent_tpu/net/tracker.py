@@ -590,20 +590,60 @@ async def _scrape_udp(url: str, info_hashes: list[bytes]) -> list[ScrapeEntry]:
 
 # ================================================================= dispatch
 
+# announce-client latency family (log2 buckets, shared obs registry):
+# the swarm tier's "how slow are MY trackers" series, labeled by scheme
+# and outcome so a failing UDP rotation is visible on any /metrics scrape
+ANNOUNCE_CLIENT_FAMILY = "torrent_tpu_announce_client_seconds"
+
+
+# the only schemes the dispatcher speaks; anything else (a hostile
+# announce-list minting one junk scheme per entry) folds into "other"
+# so the label set stays bounded like every other family
+_ANNOUNCE_SCHEMES = frozenset({"http", "https", "udp"})
+
+
+def _observe_announce(scheme: str, ok: bool, seconds: float) -> None:
+    """Record one announce round-trip into the shared histogram
+    registry. Lazy import + never raises: the tracker client must work
+    (and fail) identically if the obs plane is torn down mid-run."""
+    try:
+        from torrent_tpu.obs.hist import histograms
+
+        histograms().get(
+            ANNOUNCE_CLIENT_FAMILY,
+            help="Tracker announce round-trip latency (client side)",
+            scheme=scheme if scheme in _ANNOUNCE_SCHEMES else "other",
+            ok="true" if ok else "false",
+        ).observe(seconds)
+    except Exception:  # pragma: no cover - defensive
+        pass
+
 
 async def announce(url: str, info: AnnounceInfo, proxy=None) -> AnnounceResponse:
     """Announce to a tracker; dispatches on URL scheme (tracker.ts:402-420).
 
     With a SOCKS5 ``proxy``, UDP trackers are refused rather than dialed
-    around the tunnel (a CONNECT proxy cannot carry them)."""
+    around the tunnel (a CONNECT proxy cannot carry them). Every attempt
+    — success or failure — observes its round-trip into the
+    :data:`ANNOUNCE_CLIENT_FAMILY` log2 latency family."""
     scheme = urlsplit(url).scheme
-    if scheme in ("http", "https"):
-        return await _announce_http(url, info, proxy=proxy)
-    if scheme == "udp":
-        if proxy is not None:
-            raise TrackerError("udp tracker skipped: SOCKS5 proxy cannot carry UDP")
-        return await _announce_udp(url, info)
-    raise TrackerError(f"unsupported tracker scheme {scheme!r}")
+    t0 = time.monotonic()
+    ok = False
+    try:
+        if scheme in ("http", "https"):
+            res = await _announce_http(url, info, proxy=proxy)
+        elif scheme == "udp":
+            if proxy is not None:
+                raise TrackerError(
+                    "udp tracker skipped: SOCKS5 proxy cannot carry UDP"
+                )
+            res = await _announce_udp(url, info)
+        else:
+            raise TrackerError(f"unsupported tracker scheme {scheme!r}")
+        ok = True
+        return res
+    finally:
+        _observe_announce(scheme, ok, time.monotonic() - t0)
 
 
 async def scrape(url: str, info_hashes: list[bytes], proxy=None) -> list[ScrapeEntry]:

@@ -57,6 +57,11 @@ from torrent_tpu.obs.ledger import (
     render_pipeline_metrics,
 )
 from torrent_tpu.obs.recorder import FlightRecorder, flight_recorder
+from torrent_tpu.obs.swarm import (
+    SwarmTelemetry,
+    build_swarm_snapshot,
+    swarm_telemetry,
+)
 from torrent_tpu.obs.slo import (
     SloEngine,
     SloObjective,
@@ -89,10 +94,12 @@ __all__ = [
     "SloEngine",
     "SloObjective",
     "Span",
+    "SwarmTelemetry",
     "Timeline",
     "TimelineSampler",
     "Tracer",
     "aggregate_fleet",
+    "build_swarm_snapshot",
     "attribute",
     "build_health",
     "build_sample",
@@ -110,6 +117,7 @@ __all__ = [
     "pipeline_ledger",
     "render_obs_metrics",
     "render_pipeline_metrics",
+    "swarm_telemetry",
     "tracer",
     "valid_trace_id",
 ]
@@ -118,10 +126,15 @@ __all__ = [
 def render_obs_metrics() -> str:
     """The obs plane's /metrics contribution: every latency-histogram
     family, the pipeline ledger's per-stage series + bottleneck verdict,
-    and the flight-recorder dump counters. Appended by both the bridge's
-    ``/metrics`` and the session ``MetricsServer``."""
+    the swarm wire-plane families (``torrent_tpu_swarm_*`` + bounded
+    ``torrent_tpu_peer_*``), and the flight-recorder dump counters.
+    Appended by both the bridge's ``/metrics`` and the session
+    ``MetricsServer``."""
+    from torrent_tpu.utils.metrics import render_swarm_metrics
+
     return (
         histograms().render()
         + render_pipeline_metrics()
+        + render_swarm_metrics(swarm_telemetry().snapshot())
         + flight_recorder().render_metrics()
     )

@@ -6,7 +6,7 @@ host→device transfer was a human reconstructing it from bench logs
 (BENCH_r05). The ledger makes that attribution continuous and
 machine-readable: every stage boundary of the verify pipeline
 
-    read → stage → h2d → launch → digest → verdict
+    recv → read → stage → h2d → launch → digest → verdict
 
 records monotonic busy-seconds, payload bytes, and occupancy into a
 bounded process-global table, and ``obs/attrib.py`` turns any two
@@ -18,6 +18,13 @@ top``, and embedded in every ``torrent-tpu bench`` record.
 
 Stage boundaries (instrumentation sites):
 
+* ``recv``    — the live-swarm wire stage AHEAD of ``read``: seconds a
+  peer loop spent blocked on the socket while requests were in flight
+  (plus download-cap pacing waits) and the payload bytes of downloaded
+  blocks as they land in the piece-assembly buffers
+  (``session/torrent.py``). When the network is the limiting resource,
+  this stage owns the wall and ``doctor --bottleneck`` / ``torrent-tpu
+  replay`` can finally say so instead of blaming disk.
 * ``read``    — storage reads: ``parallel/verify.read_pieces_chunk``
   (byte-path chunks + the fabric sentinel re-hash), the native
   ``io_engine.read_into`` batch path, and the pure-Python
@@ -64,7 +71,7 @@ __all__ = [
 ]
 
 # the canonical stage order (pipeline position, used by renderers)
-PIPELINE_STAGES = ("read", "stage", "h2d", "launch", "digest", "verdict")
+PIPELINE_STAGES = ("recv", "read", "stage", "h2d", "launch", "digest", "verdict")
 
 # unknown stage names fold into "other" past this bound — the ledger's
 # cardinality must stay fixed no matter what a plane_factory plane does

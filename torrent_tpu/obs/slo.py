@@ -111,10 +111,19 @@ DEFAULT_SLO_SPEC = "availability=0.999;integrity=on"
 
 # hist short keys a latency objective may target — must match the
 # sampler's SAMPLE_HIST_FAMILIES (obs/timeline) or the objective could
-# never observe data
-LATENCY_FAMILIES = ("queue_wait", "launch", "request")
+# never observe data. "block_rtt" is the swarm wire tier's family
+# (obs/swarm): a p99 objective over it pages on a slow swarm.
+LATENCY_FAMILIES = ("queue_wait", "launch", "request", "block_rtt")
 
-_KINDS = ("availability", "integrity", "latency", "throughput")
+# fraction of block arrivals the snub-ratio budget tolerates mapping is
+# expressed by the objective's own target (a success ratio, like
+# availability); the swarm download floor shares the throughput budget
+SWARM_THROUGHPUT_BUDGET = 0.1
+
+_KINDS = (
+    "availability", "integrity", "latency", "throughput",
+    "swarm_availability", "swarm_throughput",
+)
 
 
 @dataclass(frozen=True)
@@ -144,8 +153,13 @@ def parse_objectives(spec: str) -> tuple[SloObjective, ...]:
     ``"availability=0.999;p99_ms=50:queue_wait;floor_mibps=10;integrity=on"``.
 
     Keys: ``availability=<ratio in (0,1)>``, ``p99_ms=<ms>[:family]``
-    (family defaults to ``queue_wait``), ``floor_mibps=<MiB/s>``,
-    ``integrity=on|off``. Raises ValueError with the offending pair."""
+    (family defaults to ``queue_wait``; ``block_rtt`` targets the swarm
+    wire tier), ``floor_mibps=<MiB/s>``, ``integrity=on|off``, plus the
+    swarm tier: ``swarm_floor_mibps=<MiB/s>`` (a download-rate floor
+    over the samples' cumulative swarm bytes) and ``swarm_snub=<ratio
+    in (0,1)>`` (snub-ratio availability: the success ratio of block
+    arrivals vs snub events). Raises ValueError with the offending
+    pair."""
     objs: list[SloObjective] = []
     for pair in (spec or "").split(";"):
         pair = pair.strip()
@@ -181,6 +195,22 @@ def parse_objectives(spec: str) -> tuple[SloObjective, ...]:
                 if floor <= 0:
                     raise ValueError("floor_mibps must be positive")
                 objs.append(SloObjective("throughput", "throughput", floor))
+            elif key == "swarm_floor_mibps":
+                floor = float(value) * (1 << 20)
+                if floor <= 0:
+                    raise ValueError("swarm_floor_mibps must be positive")
+                objs.append(
+                    SloObjective("swarm_throughput", "swarm_throughput", floor)
+                )
+            elif key == "swarm_snub":
+                target = float(value)
+                if not 0.0 < target < 1.0:
+                    raise ValueError("swarm_snub target must be in (0, 1)")
+                objs.append(
+                    SloObjective(
+                        "swarm_availability", "swarm_availability", target
+                    )
+                )
             elif key == "integrity":
                 if value not in ("on", "off"):
                     raise ValueError("integrity must be on or off")
@@ -428,6 +458,72 @@ def _eval_throughput(short: list, long: list, obj: SloObjective) -> dict:
     return out
 
 
+def _swarm_of(sample) -> dict:
+    s = sample.get("swarm") if isinstance(sample, dict) else None
+    return s if isinstance(s, dict) else {}
+
+
+def _swarm_avail_counters(sample) -> tuple[float, float]:
+    """(errors, events) cumulative for the snub-ratio budget: snub
+    transitions over block deliveries + snubs — a swarm whose peers
+    keep getting snubbed is failing its users even while bytes trickle."""
+    swarm = _swarm_of(sample)
+    errors = _num(swarm.get("snubs"))
+    events = errors + _num(swarm.get("blocks"))
+    return errors, events
+
+
+def _eval_swarm_availability(short: list, long: list, obj: SloObjective) -> dict:
+    es, ns = _window_delta(short, _swarm_avail_counters)
+    el, nl = _window_delta(long, _swarm_avail_counters)
+    out = _counter_objective(es, ns, el, nl, 1.0 - obj.target)
+    out.update({"kind": obj.kind, "target": obj.target})
+    return out
+
+
+def _swarm_throughput_intervals(
+    samples: list, floor_bps: float
+) -> tuple[float, float, float]:
+    """(slow_intervals, active_intervals, last_bps) over consecutive
+    sample pairs of the swarm download counters: an interval is ACTIVE
+    when blocks arrived; a slow interval downloaded under the floor.
+    Idle intervals (seeding, no download) never burn."""
+
+    def counters(sample):
+        swarm = _swarm_of(sample)
+        return _num(swarm.get("bytes_down")), _num(swarm.get("blocks"))
+
+    slow = active = 0.0
+    last_bps = 0.0
+    for prev, cur in zip(samples, samples[1:]):
+        b0, o0 = counters(prev)
+        b1, o1 = counters(cur)
+        if o1 - o0 <= 0:
+            continue
+        dt = _num(cur.get("t") if isinstance(cur, dict) else 0) - _num(
+            prev.get("t") if isinstance(prev, dict) else 0
+        )
+        if dt <= 0:
+            continue
+        active += 1
+        last_bps = max(0.0, b1 - b0) / dt
+        if last_bps < floor_bps:
+            slow += 1
+    return slow, active, last_bps
+
+
+def _eval_swarm_throughput(short: list, long: list, obj: SloObjective) -> dict:
+    ss, ns, _ = _swarm_throughput_intervals(short, obj.target)
+    sl, nl, last_bps = _swarm_throughput_intervals(long, obj.target)
+    out = _counter_objective(ss, ns, sl, nl, SWARM_THROUGHPUT_BUDGET)
+    out.update({
+        "kind": obj.kind,
+        "target": obj.target,
+        "achieved_bps": round(last_bps, 3),
+    })
+    return out
+
+
 def _integrity_counters_of(sample) -> tuple[float, float]:
     integ = _integrity_of(sample)
     errors = (
@@ -471,6 +567,10 @@ def evaluate_slo(
             per[obj.name] = _eval_latency(short, long, obj)
         elif obj.kind == "throughput":
             per[obj.name] = _eval_throughput(short, long, obj)
+        elif obj.kind == "swarm_availability":
+            per[obj.name] = _eval_swarm_availability(short, long, obj)
+        elif obj.kind == "swarm_throughput":
+            per[obj.name] = _eval_swarm_throughput(short, long, obj)
         elif obj.kind == "integrity":
             per[obj.name] = _eval_integrity(short, long, obj)
     worst = None

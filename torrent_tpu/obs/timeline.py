@@ -79,6 +79,9 @@ SAMPLE_HIST_FAMILIES = (
     ("queue_wait", "torrent_tpu_sched_queue_wait_seconds"),
     ("launch", "torrent_tpu_sched_launch_seconds"),
     ("request", "torrent_tpu_bridge_request_seconds"),
+    # the swarm wire tier (obs/swarm): block round-trip times, so
+    # `p99_ms=…:block_rtt` objectives page on a slow swarm
+    ("block_rtt", "torrent_tpu_swarm_block_rtt_seconds"),
 )
 
 # per-process run token in dump filenames, same rationale as the flight
@@ -161,6 +164,7 @@ def build_sample(
     control: dict | None = None,
     fleet: dict | None = None,
     tracker: dict | None = None,
+    swarm: dict | None = None,
     distrust: int = 0,
 ) -> dict:
     """Assemble one timeline sample from already-taken snapshots.
@@ -196,6 +200,20 @@ def build_sample(
             "peers": int(_num(tracker.get("peers"))),
             "swarms": int(_num(tracker.get("swarms"))),
         }
+    if swarm:
+        # the swarm wire tier (obs/swarm.sample_summary): cumulative
+        # counters the swarm SLO objectives delta — bytes/blocks for the
+        # download-rate floor, snubs/blocks for the snub-ratio budget
+        sample["swarm"] = {
+            "peers": int(_num(swarm.get("peers"))),
+            "snubbed": int(_num(swarm.get("snubbed"))),
+            "bytes_down": int(_num(swarm.get("bytes_down"))),
+            "bytes_up": int(_num(swarm.get("bytes_up"))),
+            "blocks": int(_num(swarm.get("blocks"))),
+            "snubs": int(_num(swarm.get("snubs"))),
+            "announce_failed": int(_num(swarm.get("announce_failed"))),
+            "all_choked": int(_num(swarm.get("all_choked"))),
+        }
     return sample
 
 
@@ -217,6 +235,11 @@ def sample_now(
         hist_snaps[short] = reg.family_snapshot(family)
     sched_snap = scheduler.metrics_snapshot() if scheduler is not None else {}
     tsan_snap = sanitizer.snapshot() if sanitizer.is_enabled() else None
+    from torrent_tpu.obs.swarm import swarm_telemetry
+
+    # None until the process ever saw a peer connection, so swarm-less
+    # samples stay byte-identical to a pre-swarm-plane build
+    swarm = swarm_telemetry().sample_summary()
     return build_sample(
         time.monotonic(),
         pipeline_ledger().snapshot(),
@@ -226,6 +249,7 @@ def sample_now(
         control=control,
         fleet=fleet,
         tracker=tracker,
+        swarm=swarm,
         distrust=distrust,
     )
 

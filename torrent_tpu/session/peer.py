@@ -76,8 +76,21 @@ class PeerConnection:
     bytes_down: int = 0  # payload received from peer
     bytes_up: int = 0  # payload sent to peer
     corrupt_pieces: int = 0  # pieces this peer helped fail verification
-    _rate_mark: tuple[float, int] = (0.0, 0)  # (time, bytes_down) snapshot
-    _up_mark: tuple[float, int] = (0.0, 0)  # (time, bytes_up) snapshot
+    # (time, bytes) marks anchoring the rate window. Initialized to the
+    # REGISTRATION instant in __post_init__ — a (0.0, 0) default would
+    # make the first window span the whole monotonic uptime, reporting a
+    # near-zero rate for a peer that just delivered megabytes (the choke
+    # policy would then mis-rank every fresh connection, and the swarm
+    # telemetry would export the same lie)
+    _rate_mark: tuple[float, int] = None  # (time, bytes_down) snapshot
+    _up_mark: tuple[float, int] = None  # (time, bytes_up) snapshot
+    # when each in-flight request was written (mirror of ``inflight``,
+    # maintained at the same mutation sites): block round-trip times for
+    # the swarm telemetry's RTT histograms
+    req_sent_at: dict[tuple[int, int, int], float] = field(default_factory=dict)
+    # memoized swarm-telemetry key (Torrent._obs_key): the per-message
+    # accounting path must not rebuild the string per 16 KiB block
+    obs_key: str | None = None
 
     last_rx: float = field(default_factory=time.monotonic)
     last_tx: float = field(default_factory=time.monotonic)
@@ -104,6 +117,10 @@ class PeerConnection:
     def __post_init__(self):
         if self.bitfield is None:
             self.bitfield = Bitfield(self.num_pieces)
+        if self._rate_mark is None or self._up_mark is None:
+            now = time.monotonic()
+            self._rate_mark = (now, self.bytes_down)
+            self._up_mark = (now, self.bytes_up)
 
     def dial_address(self) -> tuple[str, int] | None:
         """The address this peer can be dialed back on: its source IP plus

@@ -40,6 +40,8 @@ from torrent_tpu.net import protocol as proto
 from torrent_tpu.net.constants import DEFAULT_NUM_WANT
 from torrent_tpu.net.tracker import TrackerError
 from torrent_tpu.net.types import AnnounceEvent, AnnounceInfo
+from torrent_tpu.obs.ledger import pipeline_ledger
+from torrent_tpu.obs.swarm import swarm_telemetry
 from torrent_tpu.session.peer import PeerConnection
 from torrent_tpu.storage.piece import (
     BLOCK_SIZE,
@@ -55,6 +57,27 @@ from torrent_tpu.utils.log import get_logger
 log = get_logger("session.torrent")
 
 _UNSET = object()  # lazy-field sentinel (None is a meaningful value)
+
+# recv-stage ledger batching: socket-wait seconds and landed block bytes
+# flush to the pipeline ledger once per this many events (or 250 ms of
+# accumulated wait), so the per-message hot path never takes an obs lock
+_RECV_FLUSH_OPS = 32
+_RECV_FLUSH_S = 0.25
+
+
+def _wire_payload_bytes(msg) -> int:
+    """Payload byte count of a decoded wire message for the per-kind
+    telemetry (the variable-length fields; fixed headers are noise)."""
+    block = getattr(msg, "block", None)
+    if block is not None:
+        return len(block)
+    raw = getattr(msg, "raw", None)
+    if raw is not None:
+        return len(raw)
+    payload = getattr(msg, "payload", None)
+    if payload is not None:
+        return len(payload)
+    return 0
 
 
 class TorrentState(Enum):
@@ -319,6 +342,17 @@ class Torrent:
         self.key = random.randbytes(4)
 
         self.on_complete: asyncio.Event = asyncio.Event()
+
+        # Swarm wire-plane observability (obs/swarm): the process-global
+        # bounded per-peer telemetry registry, plus a deterministic
+        # per-torrent trace id so connection lifecycle spans of one
+        # swarm share one trace (`GET /v1/trace?id=swarm-<ih12>`).
+        self._swarm_obs = swarm_telemetry()
+        self._swarm_trace = f"swarm-{metainfo.info_hash.hex()[:12]}"
+        # recv-stage accumulator (flushed in batches — see _recv_charge)
+        self._recv_s = 0.0
+        self._recv_bytes = 0
+        self._recv_ops = 0
 
     # ----------------------------------------------------------- lifecycle
 
@@ -879,8 +913,10 @@ class Torrent:
                 pass
         self._tasks.clear()
         for peer in list(self.peers.values()):
+            self._swarm_obs.peer_dropped(self._obs_key(peer))
             peer.close()
         self.peers.clear()
+        self._recv_flush()  # residual wire charges reach the ledger
         self._checkpoint(include_partials=True)  # stop: keep in-flight work
         if self.trackers:
             try:
@@ -920,6 +956,7 @@ class Torrent:
             interval = self.config.announce_retry
             try:
                 res = await self.trackers.announce(self._announce_info(event))
+                self._swarm_obs.on_announce(True, origin=self._swarm_trace)
                 if event == AnnounceEvent.STARTED:
                     started_sent = True
                 elif event == AnnounceEvent.COMPLETED:
@@ -946,8 +983,13 @@ class Torrent:
                 self._connect_new_peers(res.peers)
             except TrackerError as e:
                 log.warning("announce failed: %s", e)
+                # failure-streak telemetry: ANNOUNCE_STREAK consecutive
+                # failures fire one flight dump (the swarm is coasting
+                # on cached peers), re-armed by the next success
+                self._swarm_obs.on_announce(False, origin=self._swarm_trace)
             except Exception as e:
                 log.warning("announce error: %s", e)
+                self._swarm_obs.on_announce(False, origin=self._swarm_trace)
             self._wake.clear()
             try:
                 await asyncio.wait_for(self._wake.wait(), timeout=interval)
@@ -976,6 +1018,7 @@ class Torrent:
             await self._cancel_and_release(p)
             if not p.am_choking:
                 p.am_choking = True
+                self._swarm_obs.on_state(self._obs_key(p), am_choking=True)
                 try:
                     await proto.send_message(p.writer, proto.Choke())
                 except (ConnectionError, OSError):
@@ -1273,6 +1316,11 @@ class Torrent:
         peer.ext.enabled = ext.supports_extensions(reserved)
         peer.fast = proto.supports_fast(reserved)
         self.peers[peer_id] = peer
+        # connection lifecycle telemetry + tracer span (obs/swarm): one
+        # deterministic trace per torrent collects connect/drop spans
+        self._swarm_obs.peer_connected(
+            self._obs_key(peer), inbound=inbound, trace_id=self._swarm_trace
+        )
         # Opening state message. BEP 6 peers get the compact have_all /
         # have_none forms; everyone else gets the raw bitfield
         # (protocol.ts:108-115 sends the bitfield unconditionally).
@@ -1340,6 +1388,8 @@ class Torrent:
         if self.peers.get(peer.peer_id) is not peer:
             return  # already dropped (or replaced by a newer connection)
         del self.peers[peer.peer_id]
+        self._swarm_obs.peer_dropped(self._obs_key(peer))
+        self._recv_flush()  # a departing peer must not strand recv charges
         self._avail -= peer.bitfield.as_numpy()
         self._rarity_dirty = True
         if self._ss_assigned is not None:
@@ -1370,6 +1420,7 @@ class Torrent:
             self._inflight_release(blk)
         peer.inflight.clear()
         peer.inflight_choked.clear()
+        peer.req_sent_at.clear()
 
     async def _cancel_and_release(self, peer: PeerConnection) -> None:
         """Cancel every outstanding request to ``peer`` on the wire and
@@ -1402,6 +1453,43 @@ class Torrent:
                 await self._ss_grant(peer)
         await self._update_interest(peer)
 
+    # ------------------------------------------- swarm wire observability
+
+    @staticmethod
+    def _obs_key(peer: PeerConnection) -> str:
+        """Stable telemetry key for one connection: a short peer-id
+        prefix plus the transport address (the same facts status() and
+        the ban list already expose — never the full 20-byte id).
+        Memoized on the connection — the per-message accounting path
+        must not rebuild the string per 16 KiB block."""
+        key = peer.obs_key
+        if key is None:
+            host, port = peer.address or ("?", 0)
+            key = peer.obs_key = f"{peer.peer_id[:4].hex()}@{host}:{port}"
+        return key
+
+    def _recv_charge(self, seconds: float, nbytes: int) -> None:
+        """Account wire time/bytes to the ledger's ``recv`` stage.
+
+        Batched: the accumulator flushes once per :data:`_RECV_FLUSH_OPS`
+        events or :data:`_RECV_FLUSH_S` seconds of accumulated wait, so
+        a 16 KiB-block hot loop pays one obs-lock acquisition per batch,
+        not per message. The peer loop runs on the event loop thread, so
+        the accumulator needs no lock of its own."""
+        self._recv_s += seconds
+        self._recv_bytes += nbytes
+        self._recv_ops += 1
+        if self._recv_ops >= _RECV_FLUSH_OPS or self._recv_s >= _RECV_FLUSH_S:
+            self._recv_flush()
+
+    def _recv_flush(self) -> None:
+        if not self._recv_ops:
+            return
+        pipeline_ledger().record("recv", self._recv_bytes, self._recv_s)
+        self._recv_s = 0.0
+        self._recv_bytes = 0
+        self._recv_ops = 0
+
     # ------------------------------------------------------- message loop
 
     async def _peer_loop(self, peer: PeerConnection) -> None:
@@ -1417,10 +1505,18 @@ class Torrent:
         """
         try:
             while not self._stopping:
+                # recv-stage accounting: time blocked on the socket WHILE
+                # this peer owes us blocks is network-limited time (an
+                # idle keepalive wait with nothing requested is not) —
+                # the charge that lets attribution say "the network is
+                # the bottleneck" instead of blaming disk
+                waited_from = time.monotonic() if peer.inflight else None
                 msg = await proto.read_message(peer.reader)
                 if msg is None:
                     break
                 peer.last_rx = time.monotonic()
+                if waited_from is not None:
+                    self._recv_charge(peer.last_rx - waited_from, 0)
                 await self._handle_message(peer, msg)
         except (proto.ProtocolError, asyncio.TimeoutError, ConnectionError, OSError):
             pass
@@ -1428,11 +1524,19 @@ class Torrent:
             self._drop_peer(peer)
 
     async def _handle_message(self, peer: PeerConnection, msg) -> None:
+        # per-message-type byte/count accounting (bounded kind set); the
+        # registry folds unknown kinds and >MAX_TRACKED_PEERS peers, so
+        # this is O(1) dict work under one uncontended leaf lock
+        okey = self._obs_key(peer)
+        self._swarm_obs.on_message(
+            okey, type(msg).__name__, _wire_payload_bytes(msg)
+        )
         match msg:
             case proto.KeepAlive():
                 pass
             case proto.Choke():
                 peer.peer_choking = True
+                self._swarm_obs.on_state(okey, peer_choking=True)
                 if not peer.fast:
                     # BEP 3: choke silently voids outstanding requests.
                     # BEP 6: it doesn't — the peer explicitly rejects each
@@ -1441,9 +1545,11 @@ class Torrent:
                     self._release_inflight(peer)
             case proto.Unchoke():
                 peer.peer_choking = False
+                self._swarm_obs.on_state(okey, peer_choking=False)
                 await self._fill_pipeline(peer)
             case proto.Interested():
                 peer.peer_interested = True
+                self._swarm_obs.on_state(okey, peer_interested=True)
                 # Fast-path unchoke: when reciprocity slots are free, a
                 # newly interested peer starts transferring NOW instead of
                 # idling choked until the next 10 s rechoke tick (the tick
@@ -1456,9 +1562,11 @@ class Torrent:
                     )
                     if unchoked < self.config.unchoke_slots + 1:
                         peer.am_choking = False
+                        self._swarm_obs.on_state(okey, am_choking=False)
                         await proto.send_message(peer.writer, proto.Unchoke())
             case proto.NotInterested():
                 peer.peer_interested = False
+                self._swarm_obs.on_state(okey, peer_interested=False)
             case proto.Have(index):
                 if 0 <= index < self.info.num_pieces:
                     if not peer.bitfield.has(index):
@@ -1537,8 +1645,10 @@ class Torrent:
                 if not peer.fast:
                     raise proto.ProtocolError("reject_request without fast ext")
                 blk = (index, begin, length)
+                self._swarm_obs.on_reject(okey)
                 if blk in peer.inflight:
                     peer.inflight.discard(blk)
+                    peer.req_sent_at.pop(blk, None)
                     self._inflight_release(blk)
                     # Rejecting a request that was *issued under the grant*
                     # (i.e. while choked) withdraws it — otherwise the
@@ -1558,6 +1668,7 @@ class Torrent:
                         peer.snubbed_until = (
                             time.monotonic() + self.config.snub_timeout
                         )
+                        self._swarm_obs.on_snub(okey)
                     else:
                         await self._fill_pipeline(peer)
             case proto.HashRequest():
@@ -2041,9 +2152,11 @@ class Torrent:
         )
         if want and not peer.am_interested:
             peer.am_interested = True
+            self._swarm_obs.on_state(self._obs_key(peer), am_interested=True)
             await proto.send_message(peer.writer, proto.Interested())
         elif not want and peer.am_interested:
             peer.am_interested = False
+            self._swarm_obs.on_state(self._obs_key(peer), am_interested=False)
             await proto.send_message(peer.writer, proto.NotInterested())
         if want:
             # self-gated: no-ops while choked unless allowed-fast applies
@@ -2264,13 +2377,16 @@ class Torrent:
         # one coalesced write + drain for the whole batch: a drain per
         # Request yields to the event loop per 16 KiB asked for
         proto.raise_if_closing(peer.writer)
+        sent_at = time.monotonic()
         for blk in wanted:
             peer.inflight.add(blk)
+            peer.req_sent_at[blk] = sent_at  # block-RTT anchor (obs/swarm)
             if peer.peer_choking:
                 peer.inflight_choked.add(blk)  # issued under an allowed-fast grant
             self._inflight_add(blk)
             peer.writer.write(proto.encode_message(proto.Request(*blk)))
         await peer.writer.drain()
+        self._swarm_obs.on_depth(self._obs_key(peer), len(peer.inflight))
 
     async def _ingest_block(self, peer: PeerConnection, index, begin, block) -> None:
         """(torrent.ts:183-193) + assembly, verification, have broadcast."""
@@ -2282,6 +2398,7 @@ class Torrent:
             # re-requested after resume)
             return
         blk = (index, begin, len(block))
+        req_at = peer.req_sent_at.pop(blk, None)
         if blk in peer.inflight:
             peer.inflight.discard(blk)
             peer.inflight_choked.discard(blk)
@@ -2290,6 +2407,15 @@ class Torrent:
         peer.last_block_rx = time.monotonic()
         peer.snubbed_until = 0.0  # delivering redeems
         peer.rejects_since_block = 0
+        okey = self._obs_key(peer)
+        # block round-trip + byte accounting (obs/swarm); the RTT also
+        # feeds the shared log2 family SLO p99_ms=…:block_rtt reads
+        self._swarm_obs.on_block(
+            okey, len(block),
+            (peer.last_block_rx - req_at) if req_at is not None else None,
+        )
+        self._swarm_obs.on_depth(okey, len(peer.inflight))
+        pacing_s = 0.0
         if self.download_bucket is not None or not self.own_download_bucket.unlimited:
             # pacing inside the peer loop applies TCP backpressure: the
             # reader stops draining this peer until tokens free up. The
@@ -2298,6 +2424,7 @@ class Torrent:
             # queue latency alone can exceed snub_timeout, and cancelling
             # a delivering peer's requests there would churn duplicates.
             peer.pacing = True
+            t_pace = time.monotonic()
             try:
                 if self.download_bucket is not None:
                     await self.download_bucket.take(len(block))
@@ -2305,6 +2432,11 @@ class Torrent:
             finally:
                 peer.pacing = False
                 peer.last_block_rx = time.monotonic()
+                pacing_s = peer.last_block_rx - t_pace
+        # the recv stage owns this block's bytes — plus the download-cap
+        # pacing wait, which models a slow link exactly like the socket
+        # wait does (the ledger's wire tier ahead of `read`)
+        self._recv_charge(pacing_s, len(block))
         if self.bitfield.has(index):
             return  # duplicate from endgame
         partial = self._partials.get(index)
@@ -2356,7 +2488,9 @@ class Torrent:
                 continue
             p.inflight.discard(blk)
             p.inflight_choked.discard(blk)
+            p.req_sent_at.pop(blk, None)
             self._inflight_release(blk)
+            self._swarm_obs.on_endgame_cancel(self._obs_key(p))
             try:
                 await proto.send_message(p.writer, proto.Cancel(*blk))
             except (ConnectionError, OSError):
@@ -2438,6 +2572,10 @@ class Torrent:
             return
         self.state = TorrentState.SEEDING
         self._endgame = False
+        # the download's tail recv charges must be attributable NOW — a
+        # doctor/bench reading /v1/pipeline right after completion must
+        # not miss the last partial batch
+        self._recv_flush()
         if not self._completed_reported:
             # BEP 3: `completed` at most once per download — a piece
             # lost (BEP 54) and re-fetched, or a selection widened and
@@ -2462,6 +2600,7 @@ class Torrent:
             peer = self.peers.get(peer_id)
             if peer is not None:
                 peer.corrupt_pieces += 1
+                self._swarm_obs.on_corrupt(self._obs_key(peer))
         # one corrupt piece = one strike per ADDRESS — two NATed peers
         # sharing an IP must not double-strike it for the same failure
         for ip in {ip for _, ip in contributors}:
@@ -2811,6 +2950,7 @@ class Torrent:
         peer.bytes_up += length
         self.uploaded += length
         peer.last_tx = time.monotonic()
+        self._swarm_obs.on_upload(self._obs_key(peer), length)
 
     # ---------------------------------------------------------- choke loop
 
@@ -2837,6 +2977,7 @@ class Torrent:
                 # is retried even without having delivered (a transient
                 # stall of EVERY peer must not deadlock the session)
                 p.snubbed_until = now + 2 * self.config.snub_timeout
+                self._swarm_obs.on_snub(self._obs_key(p))
                 released_any = True
         if released_any:
             for p in list(self.peers.values()):
@@ -2882,9 +3023,11 @@ class Torrent:
                 try:
                     if should_unchoke and p.am_choking:
                         p.am_choking = False
+                        self._swarm_obs.on_state(self._obs_key(p), am_choking=False)
                         await proto.send_message(p.writer, proto.Unchoke())
                     elif not should_unchoke and not p.am_choking:
                         p.am_choking = True
+                        self._swarm_obs.on_state(self._obs_key(p), am_choking=True)
                         await proto.send_message(p.writer, proto.Choke())
                 except (ConnectionError, OSError):
                     pass

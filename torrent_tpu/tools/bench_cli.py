@@ -39,6 +39,14 @@ Rungs::
                                  # and the announce latency summary, and
                                  # FAILS unless >= 4 shards were
                                  # exercised concurrently
+    torrent-tpu bench swarm      # swarm wire-plane rung: a loopback
+                                 # seed→leech download (real sockets,
+                                 # real tracker, real picker/choke
+                                 # economics), median-of-3 pieces/s;
+                                 # the record embeds the swarm telemetry
+                                 # snapshot (per-peer RTT/choke facts)
+                                 # AND the recv-stage ledger breakdown,
+                                 # so a swarm regression names the wire
 
 ``--smoke`` is an alias for the smoke rung (CI spells it that way).
 Device rungs shell out to the repo's ``bench.py`` / ``.bench/
@@ -87,7 +95,10 @@ __all__ = ["compare_record", "load_trajectory", "main"]
 
 SCHEMA = "torrent-tpu-bench/1"
 TRAJECTORY_SCHEMA = "torrent-tpu-bench-trajectory/1"
-RUNGS = ("smoke", "e2e", "v2", "fabric", "flagship", "controller", "announce")
+RUNGS = (
+    "smoke", "e2e", "v2", "fabric", "flagship", "controller", "announce",
+    "swarm",
+)
 # the announce rung's acceptance floor: the banked rate must come from
 # real cross-shard concurrency, not one hot shard
 ANNOUNCE_MIN_SHARDS_HIT = 4
@@ -553,6 +564,139 @@ async def _announce_storm(
     }
 
 
+async def _swarm_rung(total_mb: int, piece_kb: int) -> dict:
+    """The swarm wire-plane rung: a real two-client loopback download
+    (in-memory tracker, TCP sockets, the full picker/choke/endgame
+    stack), median-of-3 pieces/s. The record embeds the swarm telemetry
+    snapshot's facts (block-RTT p99, choke transitions, endgame
+    cancels) plus the recv-stage ledger breakdown bracketing the
+    final rep — a swarm throughput regression banks WITH evidence of
+    whether the wire, the picker, or verification moved. (Deliberately
+    NOT built on doctor's ``_LoopbackSwarm`` scaffold: each rep times
+    leech-add→completion and recreates the tracker, a rep-scoped shape
+    the smoke harness doesn't need.)"""
+    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.obs.attrib import attribute
+    from torrent_tpu.obs.ledger import pipeline_ledger
+    from torrent_tpu.obs.swarm import swarm_telemetry
+    from torrent_tpu.server.in_memory import run_tracker
+    from torrent_tpu.server.tracker import ServeOptions
+    from torrent_tpu.session.client import Client, ClientConfig
+    from torrent_tpu.tools.make_torrent import make_torrent
+
+    import numpy as np
+
+    rates: list[float] = []
+    swarm_fact: dict = {}
+    rep: dict = {}
+    pieces = 0
+    total = total_mb << 20
+    with tempfile.TemporaryDirectory(prefix="tt_bench_swarm_") as tmp:
+        sd = os.path.join(tmp, "seed")
+        os.makedirs(sd)
+        rng = np.random.default_rng(11)
+        with open(os.path.join(sd, "swarm.bin"), "wb") as f:
+            f.write(rng.integers(0, 256, total, dtype=np.uint8).tobytes())
+
+        async def one_rep(i: int) -> float:
+            nonlocal swarm_fact, rep, pieces
+            led = pipeline_ledger()
+            prev = led.snapshot()
+            # the registry is process-global and cumulative: the facts
+            # embedded in the record are THIS rep's delta, so they
+            # reconcile with the record's own bytes/pieces (an
+            # accumulated 3-rep total would read as a 3x mismatch)
+            base_totals = swarm_telemetry().snapshot().get("totals") or {}
+            server, _ = await run_tracker(
+                ServeOptions(http_port=0, udp_port=None, interval=1)
+            )
+            ann = f"http://127.0.0.1:{server.http_port}/announce"
+            meta = parse_metainfo(
+                make_torrent(
+                    os.path.join(sd, "swarm.bin"), ann,
+                    piece_length=piece_kb << 10,
+                )
+            )
+            ld = os.path.join(tmp, f"leech{i}")
+            os.makedirs(ld)
+            seed = Client(ClientConfig(port=0, enable_upnp=False, resume=False))
+            leech = Client(ClientConfig(port=0, enable_upnp=False, resume=False))
+            await seed.start()
+            await leech.start()
+            try:
+                t1 = await seed.add(meta, sd)
+                assert t1.bitfield.complete, "seed recheck failed"
+                t0 = time.perf_counter()
+                t2 = await leech.add(meta, ld)
+                deadline = t0 + 300.0
+                while not t2.bitfield.complete:
+                    if time.perf_counter() > deadline:
+                        raise RuntimeError("swarm rung download stalled")
+                    await asyncio.sleep(0.02)
+                seconds = time.perf_counter() - t0
+                pieces = meta.info.num_pieces
+                snap = swarm_telemetry().snapshot()
+                totals = snap.get("totals") or {}
+                peer_rtts = [
+                    p.get("block_rtt") or {}
+                    for p in (snap.get("peers") or {}).values()
+                    if (p.get("block_rtt") or {}).get("count")
+                ]
+
+                def delta(key):
+                    return (totals.get(key) or 0) - (base_totals.get(key) or 0)
+
+                swarm_fact = {
+                    # live peers are per-rep already (fresh clients);
+                    # the RTT summary covers the live per-peer records
+                    "peers": snap.get("counts", {}).get("connected"),
+                    "blocks": delta("blocks"),
+                    "bytes_down": delta("bytes_down"),
+                    "snubs": delta("snubs"),
+                    "endgame_cancels": delta("endgame_cancels"),
+                    "block_rtt_p99_s": max(
+                        (r.get("p99_s") or 0.0 for r in peer_rtts),
+                        default=None,
+                    ),
+                }
+            finally:
+                await leech.close()
+                await seed.close()
+                server.close()
+            rep = attribute(led.snapshot(), prev=prev)
+            return pieces / seconds if seconds > 0 else 0.0
+
+        for i in range(3):
+            rates.append(await one_rep(i))
+    value = round(statistics.median(rates), 1) if all(rates) else None
+    return {
+        "schema": SCHEMA,
+        "rung": "swarm",
+        "metric": f"swarm_loopback_{piece_kb}KiB_pieces_per_sec",
+        "value": value,
+        "unit": "pieces/s",
+        "contract": "median-of-3",
+        "rates": [round(r, 1) for r in rates],
+        "pieces": pieces,
+        "bytes": total,
+        "piece_kb": piece_kb,
+        "batch": None,
+        "platform": "cpu",
+        "plane": "cpu",
+        "nproc": os.cpu_count(),
+        "measured_at_utc": _utcnow(),
+        # the wire plane's own evidence: swarm telemetry facts + the
+        # recv-stage breakdown of the final rep
+        "swarm": swarm_fact,
+        "ledger": {
+            "wall_s": rep.get("wall_s"),
+            "stages": rep.get("stages"),
+            "bottleneck": rep.get("bottleneck"),
+            "overlap": rep.get("overlap"),
+        },
+    }
+
+
 # ----------------------------------------------------------- device rungs
 
 
@@ -767,7 +911,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "rung", nargs="?", choices=RUNGS,
         help="named rung to run "
-        "(smoke/e2e/v2/fabric/flagship/controller/announce)",
+        "(smoke/e2e/v2/fabric/flagship/controller/announce/swarm)",
     )
     ap.add_argument(
         "--smoke", action="store_true",
@@ -856,7 +1000,7 @@ def main(argv=None) -> int:
         rung = "smoke"
     if rung is None and args.record is None:
         print("error: name a rung (smoke/e2e/v2/fabric/flagship/controller/"
-              "announce) or pass --record FILE", file=sys.stderr)
+              "announce/swarm) or pass --record FILE", file=sys.stderr)
         return 2
     if rung == "announce" and (
         args.shards < ANNOUNCE_MIN_SHARDS_HIT
@@ -904,6 +1048,8 @@ def main(argv=None) -> int:
                         args.shards, args.numwant,
                     )
                 )
+            elif rung == "swarm":
+                record = asyncio.run(_swarm_rung(args.mb, args.piece_kb))
             elif rung == "fabric":
                 record = _run_fabric_rung(args.timeout)
             else:

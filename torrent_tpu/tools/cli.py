@@ -1229,6 +1229,8 @@ def _cmd_doctor(args) -> int:
         argv.append("--announce")
     if getattr(args, "slo", False):
         argv.append("--slo")
+    if getattr(args, "swarm", False):
+        argv.append("--swarm")
     if getattr(args, "json", False):
         argv.append("--json")
     return doctor_cli(argv)
@@ -1244,6 +1246,8 @@ def _cmd_top(args) -> int:
         argv.append("--fleet")
     if getattr(args, "history", False):
         argv.append("--history")
+    if getattr(args, "swarm", False):
+        argv.append("--swarm")
     return top_main(argv)
 
 
@@ -2082,6 +2086,13 @@ def build_parser() -> argparse.ArgumentParser:
                     "availability budget, flip /v1/health ready→"
                     "degraded, fire exactly one slo_breach flight "
                     "dump, and recover")
+    sp.add_argument("--swarm", action="store_true",
+                    help="also run the swarm wire-plane smoke: a "
+                    "throttled two-peer loopback download must be "
+                    "attributed to the recv stage via /v1/pipeline, "
+                    "/v1/swarm must report bounded per-peer telemetry, "
+                    "and a driven snub storm must fire exactly one "
+                    "flight dump")
     sp.add_argument("--lint", action="store_true",
                     help="also run the analysis-plane smoke: all four "
                     "static passes clean against the committed baseline")
@@ -2112,6 +2123,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="render the timeline view (/v1/timeline: "
                     "per-stage sparkline rows over the sample ring + "
                     "SLO burn/budget lines)")
+    sp.add_argument("--swarm", action="store_true",
+                    help="render the swarm wire-plane view (/v1/swarm: "
+                    "per-peer scoreboard with state flags, pipeline "
+                    "depth, block-RTT p99, snubs, overflow fold)")
     sp.set_defaults(fn=_cmd_top)
 
     sp = sub.add_parser(
@@ -2161,7 +2176,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("rung", nargs="?",
                     choices=("smoke", "e2e", "v2", "fabric", "flagship",
-                             "controller", "announce"))
+                             "controller", "announce", "swarm"))
     sp.add_argument("--smoke", action="store_true",
                     help="alias for the smoke rung (the CI spelling)")
     sp.add_argument("--mb", type=int, default=8,

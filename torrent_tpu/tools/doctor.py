@@ -320,48 +320,208 @@ def _check_native_io() -> None:
         _report("WARN", "native io_engine", "module unavailable; Python fallback")
 
 
+class _LoopbackSwarm:
+    """Shared two-client loopback scaffold for the swarm smokes: tmp
+    payload file → in-memory tracker → seed + leech clients → download
+    to completion. One copy of the port-0/teardown plumbing serves both
+    doctor smokes (the bench swarm rung keeps its own rep-scoped
+    variant — it times each leg and recreates the tracker per rep)."""
+
+    def __init__(self, tmp: str, payload: bytes, name: str,
+                 piece_length: int = 16384, seed_bps: int = 0):
+        self.tmp = tmp
+        self.payload = payload
+        self.name = name
+        self.piece_length = piece_length
+        self.seed_bps = seed_bps  # client-global seed upload cap (0 = off)
+        self.seed = self.leech = self.server = None
+        self.seed_dir = self.leech_dir = None
+        self.torrent = None  # the leech's Torrent once downloaded
+
+    async def __aenter__(self) -> "_LoopbackSwarm":
+        from torrent_tpu.codec.metainfo import parse_metainfo
+        from torrent_tpu.server.in_memory import run_tracker
+        from torrent_tpu.server.tracker import ServeOptions
+        from torrent_tpu.session.client import Client, ClientConfig
+        from torrent_tpu.tools.make_torrent import make_torrent
+
+        self.seed_dir = os.path.join(self.tmp, "seed")
+        os.makedirs(self.seed_dir)
+        with open(os.path.join(self.seed_dir, self.name), "wb") as f:
+            f.write(self.payload)
+        self.server, _ = await run_tracker(
+            ServeOptions(http_port=0, udp_port=None, interval=1)
+        )
+        ann = f"http://127.0.0.1:{self.server.http_port}/announce"
+        self.meta = parse_metainfo(
+            make_torrent(
+                os.path.join(self.seed_dir, self.name), ann,
+                piece_length=self.piece_length,
+            )
+        )
+        self.leech_dir = os.path.join(self.tmp, "leech")
+        os.makedirs(self.leech_dir)
+        self.seed = Client(ClientConfig(
+            port=0, enable_upnp=False, resume=False,
+            max_upload_bps=self.seed_bps,
+        ))
+        self.leech = Client(ClientConfig(port=0, enable_upnp=False, resume=False))
+        await self.seed.start()
+        await self.leech.start()
+        return self
+
+    async def download(self, deadline_polls: int = 1200) -> None:
+        t1 = await self.seed.add(self.meta, self.seed_dir)
+        assert t1.bitfield.complete, "seed recheck failed"
+        self.torrent = await self.leech.add(self.meta, self.leech_dir)
+        for _ in range(deadline_polls):
+            if self.torrent.bitfield.complete:
+                return
+            await asyncio.sleep(0.05)
+        assert self.torrent.bitfield.complete, "download did not complete"
+
+    async def __aexit__(self, *exc) -> None:
+        if self.seed is not None:
+            await self.seed.close()
+        if self.leech is not None:
+            await self.leech.close()
+        if self.server is not None:
+            self.server.close()
+
+
 async def _swarm_smoke(tmp: str) -> None:
     import numpy as np
-
-    from torrent_tpu.codec.metainfo import parse_metainfo
-    from torrent_tpu.server.in_memory import run_tracker
-    from torrent_tpu.server.tracker import ServeOptions
-    from torrent_tpu.session.client import Client, ClientConfig
-    from torrent_tpu.tools.make_torrent import make_torrent
 
     payload = np.random.default_rng(1).integers(
         0, 256, 256 * 1024, dtype=np.uint8
     ).tobytes()
-    sd = os.path.join(tmp, "seed")
-    os.makedirs(sd)
-    with open(os.path.join(sd, "smoke.bin"), "wb") as f:
-        f.write(payload)
-    server, _ = await run_tracker(ServeOptions(http_port=0, udp_port=None, interval=1))
-    ann = f"http://127.0.0.1:{server.http_port}/announce"
-    meta = parse_metainfo(
-        make_torrent(os.path.join(sd, "smoke.bin"), ann, piece_length=16384)
-    )
-    ld = os.path.join(tmp, "leech")
-    os.makedirs(ld)
-    c1 = Client(ClientConfig(port=0, enable_upnp=False, resume=False))
-    c2 = Client(ClientConfig(port=0, enable_upnp=False, resume=False))
-    await c1.start()
-    await c2.start()
-    try:
-        t1 = await c1.add(meta, sd)
-        assert t1.bitfield.complete, "seed recheck failed"
-        t2 = await c2.add(meta, ld)
-        for _ in range(600):
-            if t2.bitfield.complete:
-                break
-            await asyncio.sleep(0.05)
-        assert t2.bitfield.complete, "download did not complete"
-        with open(os.path.join(ld, "smoke.bin"), "rb") as f:
+    async with _LoopbackSwarm(tmp, payload, "smoke.bin") as swarm:
+        await swarm.download(deadline_polls=600)
+        with open(os.path.join(swarm.leech_dir, "smoke.bin"), "rb") as f:
             assert f.read() == payload, "payload mismatch"
+
+
+async def _swarm_wire_smoke(tmp: str) -> str:
+    """Swarm wire-plane smoke (``--swarm``): a two-peer loopback
+    seed→leech download over a THROTTLED link (the seed's client-global
+    upload token bucket models a slow network), checked against the
+    whole observe→attribute→alert stack one layer down:
+
+    - the ledger's ``recv`` stage charged the downloaded bytes, and the
+      bridge's ``/v1/pipeline`` attribution names ``recv`` as the
+      limiting stage — the network, not disk;
+    - ``/v1/swarm`` reports bounded per-peer telemetry: per-peer
+      byte/block accounting, a choke timeline with durations, a
+      block-RTT p99, pipeline depth, and the top-K + overflow contract;
+    - ``/metrics`` carries the ``torrent_tpu_swarm_*`` and
+      ``torrent_tpu_peer_*`` families;
+    - a snub storm driven through the SAME registry API the session
+      uses fires exactly ONE ``snub_storm`` flight dump per transition
+      (further snubs while the storm holds must not re-fire).
+    """
+    import json as _json
+
+    import numpy as np
+
+    from torrent_tpu.bridge.service import BridgeServer
+    from torrent_tpu.obs.ledger import pipeline_ledger
+    from torrent_tpu.obs.recorder import flight_recorder
+    from torrent_tpu.obs.swarm import swarm_telemetry
+
+    # 384 KiB at a 128 KiB/s seed cap: the token bucket's one-second
+    # burst passes the first 128 KiB, the remaining 256 KiB pace at the
+    # cap — ~2 s of wall that only the wire (recv) can own
+    payload = np.random.default_rng(3).integers(
+        0, 256, 384 * 1024, dtype=np.uint8
+    ).tobytes()
+    prev = pipeline_ledger().snapshot()
+    svc = await BridgeServer("127.0.0.1", port=0, hasher="cpu").start()
+    _http = _http_request
+    try:
+        async with _LoopbackSwarm(
+            tmp, payload, "wire.bin", seed_bps=128 * 1024
+        ) as loop_swarm:
+            await loop_swarm.download()
+
+            # (a) recv owns the delta: the download was wire-limited,
+            # so the recv stage must have charged the payload's bytes
+            # and more busy time than any other stage of this interval
+            snap = pipeline_ledger().snapshot()
+            recv = snap["stages"].get("recv") or {}
+            prev_recv = (prev.get("stages") or {}).get("recv") or {}
+            recv_bytes = recv.get("bytes", 0) - prev_recv.get("bytes", 0)
+            assert recv_bytes >= len(payload), (
+                f"recv charged {recv_bytes} B, payload was {len(payload)} B"
+            )
+            status, body = await _http(svc.port, "GET", "/v1/pipeline")
+            assert status == 200, status
+            pipe = _json.loads(body)
+            # attribute the ROUTE's served snapshot against this
+            # smoke's start (the ledger is process-global and
+            # cumulative: another doctor flag's scheduler traffic must
+            # not make a healthy system fail this check — the same
+            # delta discipline bench uses)
+            from torrent_tpu.obs.attrib import attribute
+
+            bn = (attribute(pipe["snapshot"], prev=prev) or {}).get(
+                "bottleneck"
+            ) or {}
+            assert bn.get("stage") == "recv", (
+                f"attribution blamed {bn.get('stage')!r}, expected recv"
+            )
+            assert (pipe.get("attribution") or {}).get("bottleneck"), (
+                "route served no attribution"
+            )
+
+            # (b) /v1/swarm: bounded per-peer telemetry (both ends of
+            # the loopback pair live in this process's registry)
+            status, body = await _http(svc.port, "GET", "/v1/swarm")
+            assert status == 200, status
+            swarm_json = _json.loads(body)
+            assert swarm_json["counts"]["connected"] >= 2, swarm_json["counts"]
+            assert "overflow" in swarm_json and "peers" in swarm_json
+            downloaded = [
+                p for p in swarm_json["peers"].values()
+                if p.get("bytes_down", 0) >= len(payload)
+            ]
+            assert downloaded, "no peer shows the downloaded bytes"
+            p = downloaded[0]
+            assert p["block_rtt"]["count"] > 0
+            assert p["block_rtt"]["p99_s"] is not None
+            assert "choke_timeline" in p and "peer_choking" in p["choke_timeline"]
+            assert p["pipeline"]["depth_max"] > 0
+
+            # (c) the Prometheus families ride both /metrics endpoints
+            status, body = await _http(svc.port, "GET", "/metrics")
+            text = body.decode()
+            assert "torrent_tpu_swarm_peers " in text
+            assert 'torrent_tpu_peer_bytes_down_total{peer="' in text
+
+            # (d) snub-storm trigger: drive the registry with the same
+            # API the session uses — exactly one dump per False→True
+            # transition
+            reg = swarm_telemetry()
+            base = flight_recorder().counts().get("snub_storm", 0)
+            for i in range(2):
+                reg.peer_connected(f"doc{i}@127.0.0.1:{7000 + i}")
+            reg.on_snub("doc0@127.0.0.1:7000")
+            reg.on_snub("doc1@127.0.0.1:7001")
+            storm1 = flight_recorder().counts().get("snub_storm", 0) - base
+            reg.on_snub("doc0@127.0.0.1:7000")  # storm already active
+            storm2 = flight_recorder().counts().get("snub_storm", 0) - base
+            for i in range(2):
+                reg.peer_dropped(f"doc{i}@127.0.0.1:{7000 + i}")  # clears
+            assert storm1 == 1 and storm2 == 1, (
+                f"expected exactly one snub_storm dump, got {storm1}/{storm2}"
+            )
+            rtt_ms = (p["block_rtt"]["p99_s"] or 0.0) * 1e3
     finally:
-        await c1.close()
-        await c2.close()
-        server.close()
+        svc.close()
+        await svc.wait_closed()
+    return (
+        f"recv limiting ({recv_bytes >> 10} KiB wire-charged), "
+        f"block-RTT p99 {rtt_ms:.1f} ms, one snub_storm dump"
+    )
 
 
 async def _sched_smoke() -> str:
@@ -1320,6 +1480,16 @@ def main(argv=None) -> int:
         "reconcile with the store totals and scrape sums",
     )
     ap.add_argument(
+        "--swarm",
+        action="store_true",
+        help="also run the swarm wire-plane smoke: a throttled two-peer "
+        "loopback download whose /v1/pipeline attribution must name the "
+        "new recv stage limiting, /v1/swarm must report bounded "
+        "per-peer telemetry (choke timeline, block-RTT p99, top-K + "
+        "overflow), and a driven snub storm must fire exactly one "
+        "flight dump",
+    )
+    ap.add_argument(
         "--json",
         action="store_true",
         help="emit one JSON object after the checks (machine-readable)",
@@ -1423,6 +1593,13 @@ def main(argv=None) -> int:
             _report("PASS", "announce plane", detail)
         except Exception as e:
             _report("FAIL", "announce plane", repr(e))
+    if args.swarm:
+        with tempfile.TemporaryDirectory(prefix="doctor_wire_") as tmp:
+            try:
+                detail = asyncio.run(asyncio.wait_for(_swarm_wire_smoke(tmp), 90))
+                _report("PASS", "swarm wire plane", detail)
+            except Exception as e:
+                _report("FAIL", "swarm wire plane", repr(e))
     if args.slo:
         try:
             detail = asyncio.run(asyncio.wait_for(_slo_smoke(), 60))

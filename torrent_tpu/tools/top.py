@@ -26,6 +26,18 @@ seconds — that is occupancy, not an error. ``--once`` prints a single
 frame and exits (scripting/tests); the rendering is a pure function of
 the JSON payload, so it is unit-testable without a bridge.
 
+``--swarm`` switches to the wire-plane view: ``GET /v1/swarm`` (the
+bridge, or the session MetricsServer — both answer it) rendered as the
+per-peer scoreboard: top-K peers by transferred bytes with state flags,
+pipeline depth, block-RTT p99, snub counters, and the overflow fold::
+
+    torrent-tpu swarm — http://127.0.0.1:8421  3 peers (1 snubbed)  12.0 MiB down
+    peer                      state  depth  blocks       down    rtt p99
+    1a2b@10.0.0.2:6881        +Ci       16     512    8.0 MiB     3.9 ms
+    ...
+    (+41 more peers: 3.1 MiB down, 2 snubbed)
+    announces: 12 ok / 3 failed (streak 3)
+
 ``--fleet`` switches to the fleet view: ``GET /v1/fleet`` (the bridge,
 or a fabric worker's ``--obs-port`` server) rendered as the straggler
 scoreboard plus the two-level bottleneck verdict::
@@ -51,10 +63,12 @@ __all__ = [
     "fetch_fleet",
     "fetch_pipeline",
     "fetch_slo",
+    "fetch_swarm",
     "fetch_timeline",
     "format_slo_line",
     "render_fleet",
     "render_history",
+    "render_swarm",
     "render_top",
     "main",
 ]
@@ -85,6 +99,14 @@ def fetch_timeline(url: str, timeout: float = 10.0) -> dict:
     """One ``GET /v1/timeline`` read. Raises OSError-family on failure."""
     with urllib.request.urlopen(
         url.rstrip("/") + "/v1/timeline", timeout=timeout
+    ) as r:
+        return json.loads(r.read().decode())
+
+
+def fetch_swarm(url: str, timeout: float = 10.0) -> dict:
+    """One ``GET /v1/swarm`` read. Raises OSError-family on failure."""
+    with urllib.request.urlopen(
+        url.rstrip("/") + "/v1/swarm", timeout=timeout
     ) as r:
         return json.loads(r.read().decode())
 
@@ -264,6 +286,99 @@ def render_history(timeline_payload: dict, slo_payload: dict | None = None,
     return "\n".join(lines)
 
 
+def _fmt_rtt(rtt: dict | None) -> str:
+    """Human p99 RTT from a block_rtt summary (pure)."""
+    rtt = rtt or {}
+    if rtt.get("p99_overflow"):
+        return ">64 s"
+    p99 = rtt.get("p99_s")
+    if p99 is None:
+        return "—"
+    if p99 >= 1.0:
+        return f"{p99:.1f} s"
+    return f"{p99 * 1e3:.1f} ms"
+
+
+def render_swarm(payload: dict, url: str = "") -> str:
+    """Render one swarm frame from a ``/v1/swarm`` payload (pure).
+
+    The per-peer scoreboard: the snapshot's named top-K peers (already
+    ranked by transferred bytes) with wire-state flags (``C`` = peer
+    choking us, ``c`` = we choke it, ``I``/``i`` = interest each way,
+    ``*`` = snubbed), live pipeline depth, block counts, bytes, and the
+    block-RTT p99 upper bound — then the overflow fold and the announce
+    health line."""
+    counts = payload.get("counts") or {}
+    totals = payload.get("totals") or {}
+    peers = {
+        k: v for k, v in (payload.get("peers") or {}).items()
+        if isinstance(v, dict)
+    }
+    lines = []
+    head = "torrent-tpu swarm"
+    if url:
+        head += f" — {url}"
+    head += f"  {counts.get('connected', 0)} peers"
+    if counts.get("snubbed"):
+        head += f" ({counts['snubbed']} snubbed)"
+    head += f"  {_fmt_bytes(totals.get('bytes_down', 0))} down"
+    head += f" / {_fmt_bytes(totals.get('bytes_up', 0))} up"
+    lines.append(head)
+    if not peers:
+        lines.append("swarm idle: no peer telemetry recorded yet")
+    else:
+        lines.append(
+            f"{'peer':26s} {'state':6s} {'depth':>5s} {'blocks':>7s} "
+            f"{'down':>10s} {'up':>10s} {'rtt p99':>9s}"
+        )
+        order = sorted(
+            peers,
+            key=lambda k: (
+                -(peers[k].get("bytes_down", 0) + peers[k].get("bytes_up", 0)),
+                k,
+            ),
+        )
+        for key in order:
+            p = peers[key]
+            state = p.get("state") or {}
+            flags = (
+                ("C" if state.get("peer_choking") else "-")
+                + ("c" if state.get("am_choking") else "-")
+                + ("I" if state.get("peer_interested") else "-")
+                + ("i" if state.get("am_interested") else "-")
+                + ("*" if p.get("snubbed") else " ")
+            )
+            lines.append(
+                f"{key[:26]:26s} {flags:6s} "
+                f"{(p.get('pipeline') or {}).get('depth', 0):>5} "
+                f"{p.get('blocks', 0):>7} "
+                f"{_fmt_bytes(p.get('bytes_down', 0)):>10s} "
+                f"{_fmt_bytes(p.get('bytes_up', 0)):>10s} "
+                f"{_fmt_rtt(p.get('block_rtt')):>9s}"
+            )
+    overflow = payload.get("overflow")
+    if isinstance(overflow, dict):
+        lines.append(
+            f"(+{overflow.get('peers', 0)} more peers: "
+            f"{_fmt_bytes(overflow.get('bytes_down', 0))} down, "
+            f"{overflow.get('snubbed', 0)} snubbed)"
+        )
+    lines.append(
+        f"announces: {totals.get('announce_ok', 0)} ok / "
+        f"{totals.get('announce_failed', 0)} failed"
+        + (
+            f" (streak {totals.get('announce_streak')})"
+            if totals.get("announce_streak")
+            else ""
+        )
+    )
+    triggers = payload.get("triggers") or {}
+    fired = ", ".join(f"{k}×{v}" for k, v in sorted(triggers.items()) if v)
+    if fired:
+        lines.append(f"flight triggers: {fired}")
+    return "\n".join(lines)
+
+
 def render_fleet(payload: dict, url: str = "") -> str:
     """Render one fleet frame from a ``/v1/fleet`` payload (pure).
 
@@ -370,9 +485,17 @@ def main(argv=None) -> int:
         "sparkline rows over the sample ring + SLO burn/budget lines) "
         "instead of the instantaneous frame",
     )
+    ap.add_argument(
+        "--swarm", action="store_true",
+        help="render the swarm wire-plane view (GET /v1/swarm: per-peer "
+        "scoreboard — state flags, pipeline depth, block-RTT p99, "
+        "snubs — plus the overflow fold and announce health) instead "
+        "of the pipeline ledger",
+    )
     args = ap.parse_args(argv)
     route = (
         "/v1/fleet" if args.fleet
+        else "/v1/swarm" if args.swarm
         else "/v1/timeline" if args.history
         else "/v1/pipeline"
     )
@@ -381,6 +504,7 @@ def main(argv=None) -> int:
             try:
                 payload = (
                     fetch_fleet(args.url) if args.fleet
+                    else fetch_swarm(args.url) if args.swarm
                     else fetch_timeline(args.url) if args.history
                     else fetch_pipeline(args.url)
                 )
@@ -390,6 +514,7 @@ def main(argv=None) -> int:
                 return 1
             frame = (
                 render_fleet(payload, url=args.url) if args.fleet
+                else render_swarm(payload, url=args.url) if args.swarm
                 else render_history(payload, fetch_slo(args.url), url=args.url)
                 if args.history
                 else render_top(payload, url=args.url)

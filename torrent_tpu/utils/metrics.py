@@ -665,6 +665,167 @@ def render_tracker_metrics(snapshot: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+# peers named individually on a scrape; the snapshot already folds the
+# rest into its own "overflow" aggregate (obs/swarm.TOP_PEERS), so the
+# per-peer family cardinality is bounded no matter how wide the swarm
+_SWARM_TRIGGERS = ("snub_storm", "all_peers_choked", "announce_failure_streak")
+
+
+def render_swarm_metrics(snapshot: dict) -> str:
+    """Prometheus rendering of the swarm wire plane
+    (``obs.swarm.SwarmTelemetry.snapshot()`` /
+    ``build_swarm_snapshot``).
+
+    Two families: process-level ``torrent_tpu_swarm_*`` (cumulative
+    totals, live counts, message-kind accounting, flight-trigger
+    counters) and bounded per-peer ``torrent_tpu_peer_*`` — the
+    snapshot's top-K named peers plus one ``peer="overflow"`` fold.
+    Defensive against partial snapshots: missing keys render as 0,
+    never a crash mid-scrape."""
+    s = snapshot or {}
+    counts = s.get("counts") or {}
+    totals = s.get("totals") or {}
+    peers = {
+        k: v for k, v in (s.get("peers") or {}).items() if isinstance(v, dict)
+    }
+    overflow = s.get("overflow") if isinstance(s.get("overflow"), dict) else None
+    lines = [
+        "# HELP torrent_tpu_swarm_peers Peers currently connected across all torrents (telemetry view)",
+        "# TYPE torrent_tpu_swarm_peers gauge",
+        f"torrent_tpu_swarm_peers {counts.get('connected', 0)}",
+        "# HELP torrent_tpu_swarm_peers_snubbed Connected peers currently flagged snubbed",
+        "# TYPE torrent_tpu_swarm_peers_snubbed gauge",
+        f"torrent_tpu_swarm_peers_snubbed {counts.get('snubbed', 0)}",
+        "# HELP torrent_tpu_swarm_peers_choking_us Connected peers currently choking us",
+        "# TYPE torrent_tpu_swarm_peers_choking_us gauge",
+        f"torrent_tpu_swarm_peers_choking_us {counts.get('choking_us', 0)}",
+        "# HELP torrent_tpu_swarm_peers_unchoked Connected peers we are currently unchoking",
+        "# TYPE torrent_tpu_swarm_peers_unchoked gauge",
+        f"torrent_tpu_swarm_peers_unchoked {counts.get('unchoked_by_us', 0)}",
+        "# HELP torrent_tpu_swarm_connections_total Peer connections registered since start",
+        "# TYPE torrent_tpu_swarm_connections_total counter",
+        f"torrent_tpu_swarm_connections_total {totals.get('connections', 0)}",
+        "# HELP torrent_tpu_swarm_bytes_total Wire payload bytes by direction",
+        "# TYPE torrent_tpu_swarm_bytes_total counter",
+        f'torrent_tpu_swarm_bytes_total{{direction="down"}} {totals.get("bytes_down", 0)}',
+        f'torrent_tpu_swarm_bytes_total{{direction="up"}} {totals.get("bytes_up", 0)}',
+        "# HELP torrent_tpu_swarm_blocks_total Payload blocks received",
+        "# TYPE torrent_tpu_swarm_blocks_total counter",
+        f"torrent_tpu_swarm_blocks_total {totals.get('blocks', 0)}",
+        "# HELP torrent_tpu_swarm_snubs_total Peer snub transitions observed",
+        "# TYPE torrent_tpu_swarm_snubs_total counter",
+        f"torrent_tpu_swarm_snubs_total {totals.get('snubs', 0)}",
+        "# HELP torrent_tpu_swarm_endgame_cancels_total Duplicate-block cancels broadcast in endgame",
+        "# TYPE torrent_tpu_swarm_endgame_cancels_total counter",
+        f"torrent_tpu_swarm_endgame_cancels_total {totals.get('endgame_cancels', 0)}",
+        "# HELP torrent_tpu_swarm_rejects_total BEP 6 RejectRequests received",
+        "# TYPE torrent_tpu_swarm_rejects_total counter",
+        f"torrent_tpu_swarm_rejects_total {totals.get('rejects', 0)}",
+        "# HELP torrent_tpu_swarm_announce_total Tracker announces by outcome",
+        "# TYPE torrent_tpu_swarm_announce_total counter",
+        f'torrent_tpu_swarm_announce_total{{result="ok"}} {totals.get("announce_ok", 0)}',
+        f'torrent_tpu_swarm_announce_total{{result="failed"}} {totals.get("announce_failed", 0)}',
+        "# HELP torrent_tpu_swarm_announce_failure_streak Consecutive announce failures right now",
+        "# TYPE torrent_tpu_swarm_announce_failure_streak gauge",
+        f"torrent_tpu_swarm_announce_failure_streak {totals.get('announce_streak', 0)}",
+        "# HELP torrent_tpu_swarm_messages_total Wire messages by kind (bounded kind set)",
+        "# TYPE torrent_tpu_swarm_messages_total counter",
+    ]
+    msgs = s.get("msgs") or {}
+    for kind in sorted(msgs):
+        m = msgs[kind] if isinstance(msgs[kind], dict) else {}
+        lines.append(
+            f'torrent_tpu_swarm_messages_total{{kind="{_esc(str(kind))}"}} '
+            f"{m.get('count', 0)}"
+        )
+    lines.append(
+        "# HELP torrent_tpu_swarm_message_bytes_total Wire message payload bytes by kind"
+    )
+    lines.append("# TYPE torrent_tpu_swarm_message_bytes_total counter")
+    for kind in sorted(msgs):
+        m = msgs[kind] if isinstance(msgs[kind], dict) else {}
+        lines.append(
+            f'torrent_tpu_swarm_message_bytes_total{{kind="{_esc(str(kind))}"}} '
+            f"{m.get('bytes', 0)}"
+        )
+    lines.append(
+        "# HELP torrent_tpu_swarm_flight_triggers_total Swarm flight-recorder dumps by trigger"
+    )
+    lines.append("# TYPE torrent_tpu_swarm_flight_triggers_total counter")
+    triggers = s.get("triggers") or {}
+    for reason in _SWARM_TRIGGERS:
+        lines.append(
+            f'torrent_tpu_swarm_flight_triggers_total{{reason="{reason}"}} '
+            f"{triggers.get(reason, 0)}"
+        )
+
+    def _peer_series(name, kind, help_text, get, overflow_get=None):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        for key in sorted(peers):
+            lines.append(f'{name}{{peer="{_esc(str(key))}"}} {get(peers[key])}')
+        if overflow is not None:
+            lines.append(
+                f'{name}{{peer="overflow"}} '
+                f"{(overflow_get or get)(overflow)}"
+            )
+
+    _peer_series(
+        "torrent_tpu_peer_bytes_down_total", "counter",
+        "Payload bytes received from this peer",
+        lambda p: p.get("bytes_down", 0),
+    )
+    _peer_series(
+        "torrent_tpu_peer_bytes_up_total", "counter",
+        "Payload bytes served to this peer",
+        lambda p: p.get("bytes_up", 0),
+    )
+    _peer_series(
+        "torrent_tpu_peer_blocks_total", "counter",
+        "Payload blocks received from this peer",
+        lambda p: p.get("blocks", 0),
+    )
+    from torrent_tpu.obs.hist import BUCKET_BOUNDS as _RTT_BOUNDS
+
+    def _rtt_p99(p):
+        rtt = p.get("block_rtt") or {}
+        if rtt.get("p99_overflow"):
+            # the p99 landed in the +Inf bucket: report the top finite
+            # bound so a `p99 > threshold` alert FIRES — rendering 0
+            # would report best-case latency exactly when latency is
+            # pathological (the PR 14 Infinity/None inversion)
+            return _RTT_BOUNDS[-1]
+        return rtt.get("p99_s") or 0
+
+    _peer_series(
+        "torrent_tpu_peer_block_rtt_p99_seconds", "gauge",
+        "p99 block round-trip upper bound for this peer (log2 buckets; "
+        "overflow reports the top finite bound)",
+        _rtt_p99,
+    )
+    _peer_series(
+        "torrent_tpu_peer_pipeline_depth", "gauge",
+        "Outstanding block requests to this peer right now",
+        lambda p: (p.get("pipeline") or {}).get("depth", 0),
+        # the overflow fold sums live depths across the folded peers
+        overflow_get=lambda o: o.get("depth", 0),
+    )
+    _peer_series(
+        "torrent_tpu_peer_choking_us", "gauge",
+        "1 while this peer is choking us",
+        lambda p: 1 if (p.get("state") or {}).get("peer_choking") else 0,
+        # a 0/1 flag doesn't fold; the overflow row reports the folded
+        # snubbed-peer count's complement as 0 (alerts key on named rows)
+        overflow_get=lambda o: 0,
+    )
+    _peer_series(
+        "torrent_tpu_peer_snubs_total", "counter",
+        "Snub transitions this peer accumulated",
+        lambda p: p.get("snubs", 0),
+    )
+    return "\n".join(lines) + "\n"
+
+
 def render_metrics(client) -> str:
     """The /metrics payload for one Client (Prometheus text format 0.0.4).
 
@@ -739,7 +900,11 @@ def render_metrics(client) -> str:
 
 
 class MetricsServer:
-    """``GET /metrics`` for one Client. Anything else is 404.
+    """``GET /metrics`` + ``GET /v1/swarm`` for one Client; anything
+    else is 404. ``/v1/swarm`` serves the swarm wire plane's bounded
+    per-peer telemetry snapshot (obs/swarm) as JSON — the same payload
+    the bridge's route answers, so ``torrent-tpu top --swarm`` can
+    point at either endpoint.
 
     ``scheduler``: optionally a hash-plane scheduler whose queue/fill/
     shed counters are appended to the session exposition, so one scrape
@@ -788,7 +953,21 @@ class MetricsServer:
                 if line in (b"\r\n", b"\n", b""):
                     break
             parts = request.split()
-            if len(parts) >= 2 and parts[0] == b"GET" and parts[1].split(b"?")[0] == b"/metrics":
+            if (
+                len(parts) >= 2
+                and parts[0] == b"GET"
+                and parts[1].split(b"?")[0] == b"/v1/swarm"
+            ):
+                import json as _json
+
+                from torrent_tpu.obs.swarm import swarm_telemetry
+
+                body = _json.dumps(
+                    swarm_telemetry().snapshot(), sort_keys=True
+                ).encode()
+                status = "200 OK"
+                ctype = "application/json"
+            elif len(parts) >= 2 and parts[0] == b"GET" and parts[1].split(b"?")[0] == b"/metrics":
                 text = render_metrics(self.client)
                 if self.scheduler is not None:
                     text += render_sched_metrics(self.scheduler)

@@ -913,3 +913,121 @@ class TestSwarmSnapshotProperties:
         for key in ("p50_s", "p99_s", "mean_s"):
             v = out[key]
             assert v is None or (v == v and abs(v) != float("inf"))
+
+
+# --------------------------------------------------------------- scenario
+
+
+def _scenario_actor_st():
+    from torrent_tpu.scenario.spec import ACTOR_PARAMS
+
+    def one_kind(kind):
+        table = ACTOR_PARAMS[kind]
+        # an arbitrary subset of the kind's params, each inside its
+        # registry [lo, hi] (hi capped so generated worlds stay small)
+        params = st.lists(
+            st.sampled_from(sorted(table)), unique=True, max_size=len(table)
+        ).flatmap(
+            lambda names: st.fixed_dictionaries(
+                {
+                    n: st.integers(table[n][1], min(table[n][2], 10_000))
+                    for n in names
+                }
+            )
+        )
+        return st.builds(
+            lambda count, ps: {"kind": kind, "count": count, "params": ps},
+            st.integers(1, 1000),
+            params,
+        )
+
+    return st.sampled_from(sorted(ACTOR_PARAMS)).flatmap(one_kind)
+
+
+class TestScenarioSpecProperties:
+    """ScenarioSpec is a wire artifact (library strings, CI flags,
+    bencode blobs): every codec must round-trip exactly, and every
+    parser must be TOTAL — typed ValueError or a valid spec, never a
+    crash — on arbitrary and on hostile near-miss input."""
+
+    _specs = st.builds(
+        lambda name, seed, ticks, groups, slo, short, extra: {
+            "v": 1,
+            "name": name,
+            "seed": seed,
+            "ticks": ticks,
+            "slo": slo,
+            "short_samples": short,
+            "long_samples": short + extra,
+            "actors": groups,
+        },
+        st.text("abcdefghijklmnopqrstuvwxyz0123456789-_", min_size=1,
+                max_size=16),
+        st.integers(0, 2**32 - 1),
+        st.integers(1, 10_000),
+        st.lists(_scenario_actor_st(), min_size=1, max_size=5),
+        st.sampled_from([
+            "availability=0.999",
+            "availability=0.9;integrity=on",
+            "integrity=on",
+            "availability=0.99;p99_ms=250:request",
+        ]),
+        st.integers(1, 64),
+        st.integers(0, 64),
+    )
+
+    @given(_specs)
+    @settings(max_examples=100, deadline=None)
+    def test_all_codecs_roundtrip(self, d):
+        from torrent_tpu.scenario.spec import ScenarioSpec
+
+        spec = ScenarioSpec.from_dict(d)
+        assert ScenarioSpec.parse(spec.serialize()) == spec
+        assert ScenarioSpec.from_dict(spec.to_dict()) == spec
+        assert ScenarioSpec.from_json(spec.to_json()) == spec
+        assert ScenarioSpec.from_bencode(spec.to_bencode()) == spec
+
+    @given(st.text(max_size=200))
+    @settings(max_examples=300)
+    def test_parse_total_on_arbitrary_text(self, text):
+        from torrent_tpu.scenario.spec import ScenarioSpec
+
+        try:
+            spec = ScenarioSpec.parse(text)
+        except ValueError:
+            return  # typed rejection is the contract
+        assert ScenarioSpec.parse(spec.serialize()) == spec
+
+    @given(
+        st.lists(
+            st.tuples(
+                st.sampled_from([
+                    "name", "seed", "ticks", "slo", "actor", "shards",
+                    "tick_ms", "bogus", "wall_p99_ms",
+                ]),
+                st.text("abcdefghijklmnopqrstuvwxyz0123456789-_=:,.|",
+                        max_size=24),
+            ),
+            max_size=8,
+        )
+    )
+    @settings(max_examples=300)
+    def test_parse_total_on_hostile_near_miss_fields(self, pairs):
+        from torrent_tpu.scenario.spec import ScenarioSpec
+
+        text = ";".join(f"{k}={v}" for k, v in pairs)
+        try:
+            spec = ScenarioSpec.parse(text)
+        except ValueError:
+            return
+        assert ScenarioSpec.from_json(spec.to_json()) == spec
+
+    @given(st.binary(max_size=200))
+    @settings(max_examples=200)
+    def test_from_bencode_total_on_arbitrary_bytes(self, blob):
+        from torrent_tpu.scenario.spec import ScenarioSpec
+
+        try:
+            ScenarioSpec.from_bencode(blob)
+        except ValueError:
+            pass  # BencodeError is a ValueError; both are the contract

@@ -64,6 +64,8 @@ class TestPassiveHarvest:
         idx.store = None
         idx.max_hashes = 4
         idx._hashes = {}
+        idx._blooms = {}
+        idx._clock = lambda: 0.0
         idx.harvested = {"get_peers": 0, "announce_peer": 0}
         idx.fed_peers = 0
         for i in range(10):

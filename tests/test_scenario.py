@@ -1,0 +1,332 @@
+"""The scenario engine (torrent_tpu/scenario/) — spec round-trips, the
+library scenarios at reduced population, the bit-identity replay
+contract, and the BEP 33 scrape-side bloom aggregation seam.
+
+Every library scenario runs here scaled down (same seed, same
+behaviors, same objectives, cheaper world) so tier-1 proves the
+defenses ENGAGE — convictions land, clamps hold, bounds bind — without
+paying the full doctor-gate population.
+"""
+
+import hashlib
+import random
+
+import pytest
+
+from torrent_tpu.net.dht import DHTNode, ScrapeBloom
+from torrent_tpu.net.indexer import DhtIndexer
+from torrent_tpu.net.types import AnnounceEvent
+from torrent_tpu.obs.timeline import replay_report
+from torrent_tpu.scenario import (
+    ActorGroup,
+    ScenarioSpec,
+    VirtualClock,
+    budget_statement,
+    build_verdict,
+    canonical_bytes,
+    canonical_verdict,
+    run_scenario,
+)
+from torrent_tpu.scenario.library import SCENARIOS, get, names
+from torrent_tpu.server.shard import ShardedSwarmStore
+
+
+def ih(i: int) -> bytes:
+    return hashlib.sha1(b"scenario-test-swarm-%d" % i).digest()
+
+
+# ------------------------------------------------------------------ spec
+
+
+class TestScenarioSpec:
+    def test_compact_grammar_roundtrip_all_library_entries(self):
+        for name in names():
+            spec = get(name)
+            assert ScenarioSpec.parse(spec.serialize()) == spec
+
+    def test_json_and_bencode_roundtrip_all_library_entries(self):
+        for name in names():
+            spec = get(name)
+            assert ScenarioSpec.from_json(spec.to_json()) == spec
+            assert ScenarioSpec.from_bencode(spec.to_bencode()) == spec
+
+    def test_library_names_sorted_and_get_unknown_lists_them(self):
+        assert names() == sorted(SCENARIOS)
+        with pytest.raises(ValueError, match="sybil-stampede"):
+            get("no-such-scenario")
+
+    def test_parse_rejections_are_typed_and_named(self):
+        base = "name=x;seed=1;ticks=2;slo=availability=0.9;"
+        for text, needle in [
+            ("", "missing"),
+            (base, "no actor"),
+            (base + "actor=martian:count=3", "unknown actor kind"),
+            (base + "actor=honest:count=0", "count"),
+            (base + "actor=honest:numwant=3", "missing count"),
+            (base + "actor=honest:count=1,numwant=-5", "numwant"),
+            (base + "actor=honest:count=1,warp=9", "unknown param"),
+            (base + "bogus=1;actor=honest:count=1", "unknown scenario field"),
+            (base + "seed=2;actor=honest:count=1", "duplicate"),
+            ("name=x;seed=1;ticks=2;slo=gibberish;actor=honest:count=1",
+             "slo"),
+        ]:
+            with pytest.raises(ValueError, match=needle):
+                ScenarioSpec.parse(text)
+
+    def test_slo_pipe_nesting_and_objectives_armed(self):
+        spec = ScenarioSpec.parse(
+            "name=x;seed=1;ticks=2;slo=availability=0.99|integrity=on;"
+            "actor=honest:count=1"
+        )
+        assert spec.slo == "availability=0.99;integrity=on"
+        kinds = {o.kind for o in spec.objectives()}
+        assert {"availability", "integrity"} <= kinds
+        # serialize() re-nests with '|' so the spec stays one field
+        assert "availability=0.99|integrity=on" in spec.serialize()
+
+    def test_from_dict_rejects_unknown_keys_and_versions(self):
+        spec = get("piece-poison")
+        d = spec.to_dict()
+        assert ScenarioSpec.from_dict(d) == spec
+        with pytest.raises(ValueError, match="version"):
+            ScenarioSpec.from_dict({**d, "v": 99})
+        with pytest.raises(ValueError, match="unknown scenario keys"):
+            ScenarioSpec.from_dict({**d, "surprise": 1})
+
+    def test_scaled_reduces_population_keeps_seed_and_objectives(self):
+        spec = get("sybil-stampede")
+        small = spec.scaled(32, ticks=6)
+        assert small.seed == spec.seed and small.slo == spec.slo
+        assert small.ticks == 6
+        assert small.population() < spec.population()
+        assert all(g.count >= 1 for g in small.actors)
+
+    def test_actor_group_defaults_fill_from_registry(self):
+        g = ActorGroup(kind="honest", count=4)
+        assert g.param("numwant") == 30
+        assert ActorGroup(
+            kind="honest", count=4, params=(("numwant", 7),)
+        ).param("numwant") == 7
+
+
+# ------------------------------------------------------- verdict builders
+
+
+class TestVerdictBuilders:
+    def test_budget_statement_shapes(self):
+        assert budget_statement({}) == "no objectives evaluated"
+        s = budget_statement({"objectives": {"availability": {
+            "budget_remaining": 0.5, "burn_rate": 1.25,
+            "classification": "slow_burn",
+        }}})
+        assert "availability: 50.0% budget left" in s
+        assert "burn 1.25" in s and "slow_burn" in s
+
+    def test_build_verdict_breach_becomes_reason(self):
+        spec = get("piece-poison").scaled(4, ticks=2)
+        report = {"objectives": {"integrity": {
+            "breach": True, "burn_rate": 20.0, "classification": "fast_burn",
+        }}}
+        v = build_verdict(spec, report, {"facts": 1}, [])
+        assert v["pass"] is False
+        assert any("integrity" in r for r in v["reasons"])
+        ok = build_verdict(spec, {"objectives": {}}, {}, [])
+        assert ok["pass"] is True and ok["reasons"] == []
+
+    def test_canonical_verdict_strips_wall_only(self):
+        v = {"b": 1, "a": 2, "wall": {"p99_us": 3}}
+        assert canonical_verdict(v) == {"a": 2, "b": 1}
+
+
+# ---------------------------------------------------- library scenarios
+
+
+# population divisor per scenario, chosen so every defense still has a
+# non-trivial hostile population to convict/clamp/evict at tier-1 cost
+_SCALE = {
+    "sybil-stampede": 8,
+    "piece-poison": 2,
+    "churn-storm": 8,
+    "slowloris": 2,
+    "ghost-flood": 2,
+    "token-forge": 2,
+}
+
+
+class TestLibraryScenarios:
+    @pytest.mark.parametrize("name", sorted(SCENARIOS))
+    def test_scaled_scenario_passes_and_replays_bit_identically(self, name):
+        spec = get(name).scaled(_SCALE[name], ticks=10)
+        first = run_scenario(spec)
+        assert first["verdict"]["pass"], first["verdict"]["reasons"]
+        # the satellite determinism contract: a second same-seed run —
+        # new store, new rng, new world — produces byte-equal canonical
+        # verdict + timeline, wall plane excluded
+        second = run_scenario(spec)
+        assert canonical_bytes(
+            first["verdict"], first["timeline"]
+        ) == canonical_bytes(second["verdict"], second["timeline"])
+
+    def test_different_seed_diverges(self):
+        spec = get("churn-storm").scaled(16, ticks=8)
+        import dataclasses
+
+        other = dataclasses.replace(spec, seed=spec.seed + 1)
+        a = run_scenario(spec)
+        b = run_scenario(other)
+        assert canonical_bytes(
+            a["verdict"], a["timeline"]
+        ) != canonical_bytes(b["verdict"], b["timeline"])
+
+    def test_sybil_facts_show_clamping(self):
+        v = run_scenario(get("sybil-stampede").scaled(8, ticks=8))["verdict"]
+        tracker = v["facts"]["tracker"]
+        assert tracker["numwant_clamped"] > 0
+        sybil = next(
+            f for k, f in v["facts"]["behaviors"].items()
+            if k.startswith("sybil")
+        )
+        assert sybil["overflows"] == 0 and sybil["announces"] > 0
+
+    def test_poison_facts_show_full_conviction_and_nobody_else(self):
+        v = run_scenario(get("piece-poison").scaled(2, ticks=10))["verdict"]
+        c = v["facts"]["counters"]
+        assert c["convicted"] == 2  # both scaled poisoners
+        assert c["poison_rejected"] > 0
+        assert c["poison_escapes"] == 0 and c["false_convictions"] == 0
+
+    def test_ghost_flood_keeps_indexer_bounded(self):
+        v = run_scenario(get("ghost-flood").scaled(2, ticks=10))["verdict"]
+        from torrent_tpu.net.indexer import MAX_HASHES
+
+        ghost = next(
+            f for k, f in v["facts"]["behaviors"].items()
+            if k.startswith("ghost")
+        )
+        assert ghost["flood_queries"] > 0
+        assert ghost["indexer_hashes"] <= MAX_HASHES
+        assert ghost["indexer_blooms"] <= MAX_HASHES
+
+    def test_forge_facts_show_rejection_and_valid_control_path(self):
+        v = run_scenario(get("token-forge").scaled(2, ticks=10))["verdict"]
+        forge = next(
+            f for k, f in v["facts"]["behaviors"].items()
+            if k.startswith("forge")
+        )
+        assert forge["forged"] > 0 and forge["rejected"] == forge["forged"]
+        assert forge["valid_ok"] > 0
+        assert v["facts"]["counters"]["forged_accepted"] == 0
+
+    def test_occupancy_oracle_reconciles(self):
+        v = run_scenario(get("churn-storm").scaled(8, ticks=10))["verdict"]
+        occ = v["facts"]["occupancy"]
+        assert occ["expected"] == occ["actual"]
+
+    def test_wall_plane_is_reported_but_not_canonical(self):
+        r = run_scenario(get("piece-poison").scaled(4, ticks=4))
+        wall = r["verdict"]["wall"]
+        assert wall["announces"] > 0 and wall["p99_us"] >= wall["p50_us"]
+        assert "wall" not in canonical_verdict(r["verdict"])
+
+    def test_replay_report_runs_over_scenario_timeline(self):
+        r = run_scenario(get("churn-storm").scaled(16, ticks=6))
+        from torrent_tpu.obs.slo import parse_objectives
+
+        rep = replay_report(
+            r["timeline"], objectives=parse_objectives("availability=0.999")
+        )
+        assert rep["samples"] == len(r["timeline"]["samples"])
+        assert isinstance(rep["intervals"], list) and rep["intervals"]
+        assert rep["slo"] is not None
+
+
+# ------------------------------------------------- determinism seams
+
+
+class TestStoreDeterminismSeams:
+    def _storm(self, store):
+        got = []
+        for k in range(200):
+            out = store.announce(
+                ih(k % 8), b"%020d" % k, f"10.9.0.{k % 256}", 6881,
+                left=k % 2, event=AnnounceEvent.STARTED, numwant=5,
+            )
+            got.append([(p.ip, p.port) for p in out.peers])
+        return got
+
+    def test_same_seed_stores_sample_identically(self):
+        def build():
+            return ShardedSwarmStore(
+                n_shards=4, clock=VirtualClock(1000.0),
+                rng=random.Random(42),
+            )
+
+        assert self._storm(build()) == self._storm(build())
+
+    def test_virtual_clock_drives_ttl_sweep(self):
+        clock = VirtualClock(1000.0)
+        store = ShardedSwarmStore(
+            n_shards=2, peer_ttl=10.0, clock=clock, rng=random.Random(1)
+        )
+        store.announce(ih(0), b"p" * 20, "10.0.0.1", 6881, left=0)
+        clock.advance(11.0)
+        assert store.sweep() == 1
+        assert store.metrics_snapshot()["peers"] == 0
+
+
+# ------------------------------------------- BEP 33 scrape-side blooms
+
+
+class TestScrapeBloomAggregation:
+    def test_unknown_swarm_scrapes_from_attached_blooms(self):
+        store = ShardedSwarmStore(n_shards=2)
+        h = ih(1)
+        assert store.scrape([h]) == [(h, 0, 0, 0)]  # no source: zeros
+        seed_bloom, peer_bloom = ScrapeBloom(), ScrapeBloom()
+        for i in range(40):
+            seed_bloom.insert_ip(f"10.1.0.{i}")
+        for i in range(120):
+            peer_bloom.insert_ip(f"10.2.{i % 4}.{i}")
+        store.attach_bloom_source(
+            lambda x: (seed_bloom, peer_bloom) if x == h else None
+        )
+        (_, complete, downloaded, incomplete), = store.scrape([h])
+        assert downloaded == 0
+        # bloom cardinality estimates: probabilistic but tight at this
+        # fill level (BEP 33 quotes ~3% error well past these counts)
+        assert 30 <= complete <= 50
+        assert 100 <= incomplete <= 140
+        # a hash the source doesn't know stays zeros
+        assert store.scrape([ih(2)]) == [(ih(2), 0, 0, 0)]
+
+    def test_tracker_state_wins_over_blooms(self):
+        store = ShardedSwarmStore(n_shards=2)
+        h = ih(3)
+        store.announce(h, b"q" * 20, "10.0.0.7", 6881, left=0)
+        boom = lambda x: (_ for _ in ()).throw(AssertionError("consulted"))
+        store.attach_bloom_source(boom)
+        assert store.scrape([h]) == [(h, 1, 0, 0)]
+
+    def test_indexer_blooms_fifo_bounded_with_census(self):
+        node = DHTNode(node_id=hashlib.sha1(b"bloom-test").digest())
+        idx = DhtIndexer(node, store=None, max_hashes=16)
+        for i in range(64):
+            idx._observe("get_peers", ih(i), (f"10.3.0.{i % 8}", 1), None,
+                         False)
+        snap = idx.snapshot()
+        assert snap["hashes"] == 16 and snap["blooms"] <= 16
+        # survivors are the newest 16 and their blooms answer scrapes
+        assert idx.blooms_for(ih(63)) is not None
+        assert idx.blooms_for(ih(0)) is None
+
+    def test_indexer_bloom_seed_flag_routes_bfsd(self):
+        node = DHTNode(node_id=hashlib.sha1(b"bloom-test-2").digest())
+        idx = DhtIndexer(node, store=None)
+        h = ih(9)
+        for i in range(30):
+            idx._observe("announce_peer", h, (f"10.4.0.{i}", 1), 6881, True)
+        for i in range(30):
+            idx._observe("announce_peer", h, (f"10.5.0.{i}", 1), 6881, False)
+        seed_bloom, peer_bloom = idx.blooms_for(h)
+        assert 20 <= seed_bloom.estimate() <= 40
+        assert 20 <= peer_bloom.estimate() <= 40

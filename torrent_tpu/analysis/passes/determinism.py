@@ -91,6 +91,13 @@ SCOPE: dict[str, frozenset[str]] = {
             "_digest_sched",
         }
     ),
+    # the scenario plane's spec and verdict builders are pure by
+    # contract: a spec must parse/serialize bit-identically and a
+    # verdict is the artifact two same-seed replays are diffed on —
+    # wall-clock reads, randomness, or unordered iteration anywhere in
+    # these modules would break the doctor --scenario bit-identity gate
+    "scenario/spec.py": frozenset({"*"}),
+    "scenario/verdict.py": frozenset({"*"}),
     # the SLO evaluators are pure functions over timeline samples (the
     # same determinism contract as decide() and the digest builders):
     # the same sample ring must always produce the same burn-rate

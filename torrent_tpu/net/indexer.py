@@ -36,6 +36,7 @@ from torrent_tpu.net.dht import (
     K,
     DHTError,
     DHTNode,
+    ScrapeBloom,
     random_node_id,
     xor_distance,
 )
@@ -64,13 +65,19 @@ class DhtIndexer:
         node: DHTNode,
         store=None,
         max_hashes: int = MAX_HASHES,
+        clock=time.monotonic,
     ):
         self.node = node
         self.store = store
         self.max_hashes = max_hashes
+        self._clock = clock  # determinism seam (scenario virtual time)
         # info_hash -> last harvest monotonic (insertion-ordered: FIFO
         # eviction past the cap keeps a hostile flood bounded)
         self._hashes: dict[bytes, float] = {}
+        # BEP 33 scrape-side aggregation: info_hash -> (BFsd, BFpe).
+        # Evicted in lockstep with _hashes (same FIFO bound), so a
+        # ghost-swarm flood costs a bounded 512 B/hash, never unbounded
+        self._blooms: dict[bytes, tuple[ScrapeBloom, ScrapeBloom]] = {}
         # discovered-but-not-yet-resolved hashes (insertion-ordered set,
         # FIFO-bounded): sampled hashes beyond one crawl's lookup budget
         # — and passively-censused get_peers hashes — wait here so later
@@ -91,10 +98,27 @@ class DhtIndexer:
         """Record a discovered hash; returns True when it is new."""
         fresh = info_hash not in self._hashes
         if fresh and len(self._hashes) >= self.max_hashes:
-            # FIFO: drop the oldest-discovered hash
-            self._hashes.pop(next(iter(self._hashes)))
-        self._hashes[info_hash] = time.monotonic()
+            # FIFO: drop the oldest-discovered hash (+ its blooms — the
+            # bloom table must never outgrow the hash census)
+            oldest = next(iter(self._hashes))
+            self._hashes.pop(oldest)
+            self._blooms.pop(oldest, None)
+        self._hashes[info_hash] = self._clock()
         return fresh
+
+    def _bloom_pair(self, info_hash: bytes) -> tuple[ScrapeBloom, ScrapeBloom]:
+        pair = self._blooms.get(info_hash)
+        if pair is None:
+            pair = self._blooms[info_hash] = (ScrapeBloom(), ScrapeBloom())
+        return pair
+
+    def blooms_for(
+        self, info_hash: bytes
+    ) -> tuple[ScrapeBloom, ScrapeBloom] | None:
+        """BEP 33 ``(seed_bloom, peer_bloom)`` for a harvested hash, or
+        None — the tracker store's ``attach_bloom_source`` contract, so
+        scrapes for DHT-only swarms answer with cardinality estimates."""
+        return self._blooms.get(info_hash)
 
     def _defer_resolve(self, info_hash: bytes) -> None:
         """Queue a hash whose peers are still unknown for a later
@@ -110,6 +134,14 @@ class DhtIndexer:
             return
         self.harvested[kind] += 1
         self._note(info_hash)
+        # BEP 33 blooms: a token-validated announcer lands in BFsd/BFpe
+        # by seed flag; a get_peers querier is a "host requesting peers"
+        # and joins BFpe (the downloader filter) per the BEP
+        seed_bloom, peer_bloom = self._bloom_pair(info_hash)
+        if kind == "announce_peer":
+            (seed_bloom if seed else peer_bloom).insert_ip(addr[0])
+        else:
+            peer_bloom.insert_ip(addr[0])
         if kind == "announce_peer" and self.store is not None and port:
             # a token-validated announcer IS a swarm peer: seed it into
             # the tracker store (seed flag → seeder, else leecher)
@@ -231,6 +263,7 @@ class DhtIndexer:
         return {
             "hashes": len(self._hashes),
             "unresolved": len(self._unresolved),
+            "blooms": len(self._blooms),
             "harvested": dict(self.harvested),
             "fed_peers": self.fed_peers,
             "crawls": self.crawls,

@@ -1,0 +1,93 @@
+"""The bundled scenario library — named hostile-internet playbooks.
+
+Each entry is ONE compact-grammar string (``ScenarioSpec.parse``), so
+the library is greppable, diffable data; behaviors and populations
+live in the spec, never in code. ``get(name)`` parses on demand;
+``doctor --scenario`` and the CI stage pull from here, and tests run
+the same specs at reduced population via ``ScenarioSpec.scaled``.
+"""
+
+from __future__ import annotations
+
+from torrent_tpu.scenario.spec import ScenarioSpec
+
+# name -> compact spec. Conventions: every scenario arms integrity
+# (one distrust event anywhere is an instant fast burn) on top of its
+# own availability target; windows sized to the run so the SLO deltas
+# span real traffic.
+SCENARIOS: dict[str, str] = {
+    # 256 forged identities, fresh peer id every tick, numwant=10000:
+    # the server-side clamp and reservoir sampling must bound every
+    # reply while honest announces stay inside the latency budget.
+    "sybil-stampede": (
+        "name=sybil-stampede;seed=7;ticks=24;tick_ms=1000;peer_ttl_s=900;"
+        "shards=8;wall_p99_ms=250;short_samples=8;long_samples=24;"
+        "slo=availability=0.999|integrity=on;"
+        "actor=honest:count=64,numwant=30,swarms=8;"
+        "actor=sybil:count=256,numwant=10000,swarms=2"
+    ),
+    # four poisoners, every submission a digest mismatch: the sentinel
+    # must convict all four within its strike budget and convict NOBODY
+    # else — the honest population rides along as conviction bait.
+    "piece-poison": (
+        "name=piece-poison;seed=11;ticks=24;tick_ms=1000;peer_ttl_s=900;"
+        "shards=8;wall_p99_ms=250;short_samples=8;long_samples=24;"
+        "slo=availability=0.999|integrity=on;"
+        "actor=honest:count=32,numwant=30,swarms=4;"
+        "actor=poison:count=4,per_tick=1,swarms=1"
+    ),
+    # 512 peers joining/stopping/ghosting against a 10-second TTL: the
+    # end-of-run occupancy reconciliation must balance to the peer —
+    # silent ghosts reclaimed by the sweep, polite stops immediately.
+    "churn-storm": (
+        "name=churn-storm;seed=13;ticks=30;tick_ms=1000;peer_ttl_s=10;"
+        "shards=8;wall_p99_ms=250;short_samples=8;long_samples=30;"
+        "slo=availability=0.999|integrity=on;"
+        "actor=churn:count=512,ghost_pct=5,join_pct=30,stop_pct=20,swarms=32"
+    ),
+    # 48 connection-holders against a 32-slot accept gate: idle
+    # eviction must reclaim the slots each wave; the honest probe
+    # connections shed in the window are the availability cost.
+    "slowloris": (
+        "name=slowloris;seed=17;ticks=36;tick_ms=1000;peer_ttl_s=900;"
+        "shards=8;wall_p99_ms=250;short_samples=8;long_samples=32;"
+        "slo=availability=0.9|integrity=on;"
+        "actor=honest:count=64,numwant=30,swarms=8;"
+        "actor=slowloris:count=48,capacity=32,hold_ticks=12,honest_conns=24,idle_ticks=3"
+    ),
+    # 5120 get_peers queries for hashes nobody has: the indexer census
+    # and its BEP 33 bloom table must hold their FIFO bounds instead of
+    # growing with the flood.
+    "ghost-flood": (
+        "name=ghost-flood;seed=19;ticks=20;tick_ms=1000;peer_ttl_s=900;"
+        "shards=8;wall_p99_ms=250;short_samples=8;long_samples=20;"
+        "slo=availability=0.999|integrity=on;"
+        "actor=honest:count=16,numwant=30,swarms=4;"
+        "actor=ghost:count=4,per_tick=64"
+    ),
+    # eight forgers hammering announce_peer with invented tokens: every
+    # forgery must draw a KRPC 203 and never reach the tracker feed,
+    # while the periodic valid-token control path keeps landing.
+    "token-forge": (
+        "name=token-forge;seed=23;ticks=24;tick_ms=1000;peer_ttl_s=900;"
+        "shards=8;wall_p99_ms=250;short_samples=8;long_samples=24;"
+        "slo=availability=0.999|integrity=on;"
+        "actor=honest:count=16,numwant=30,swarms=4;"
+        "actor=forge:count=8,valid_every=4"
+    ),
+}
+
+
+def names() -> list[str]:
+    return sorted(SCENARIOS)
+
+
+def get(name: str) -> ScenarioSpec:
+    """Parse a library scenario by name; ValueError for unknown names
+    (listing what exists — the doctor flag surfaces this verbatim)."""
+    text = SCENARIOS.get(name)
+    if text is None:
+        raise ValueError(
+            f"unknown scenario {name!r} (one of {', '.join(names())})"
+        )
+    return ScenarioSpec.parse(text)

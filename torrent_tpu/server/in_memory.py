@@ -60,9 +60,19 @@ class FileInfo:
 class InMemoryTracker:
     """Tracker policy over in-process maps; drive with handle()."""
 
-    def __init__(self, interval: int = DEFAULT_ANNOUNCE_INTERVAL):
+    def __init__(
+        self,
+        interval: int = DEFAULT_ANNOUNCE_INTERVAL,
+        clock=time.monotonic,
+        rng: random.Random | None = None,
+    ):
         self.interval = interval
         self.files: dict[bytes, FileInfo] = {}
+        # determinism seams (same contract as ShardedSwarmStore): all
+        # timestamps and peer-selection draws route through these so a
+        # scenario replay with virtual clock + seeded rng is bit-stable
+        self._clock = clock
+        self._rng: random.Random = rng if rng is not None else random  # type: ignore[assignment]
 
     # ------------------------------------------------------------ helpers
 
@@ -70,7 +80,7 @@ class InMemoryTracker:
         """Up to n random peers, excluding the requester (in_memory_tracker.ts:30-51)."""
         candidates = [p for pid, p in info.peers.items() if pid != exclude]
         if len(candidates) > n:
-            candidates = random.sample(candidates, n)
+            candidates = self._rng.sample(candidates, n)
         return [AnnouncePeer(ip=p.ip, port=p.port, peer_id=p.peer_id) for p in candidates]
 
     # ------------------------------------------------------------ announce
@@ -111,7 +121,8 @@ class InMemoryTracker:
                 info.downloaded += 1
 
         info.peers[req.peer_id] = PeerState(
-            peer_id=req.peer_id, ip=req.ip, port=req.port, left=req.left
+            peer_id=req.peer_id, ip=req.ip, port=req.port, left=req.left,
+            last_seen=self._clock(),
         )
         peers = self.random_selection(info, req.peer_id, req.num_want)
         await req.respond(self.interval, info.complete, info.incomplete, peers)
@@ -137,7 +148,7 @@ class InMemoryTracker:
 
     def sweep(self) -> int:
         """Evict idle peers (in_memory_tracker.ts:61-77); returns evictions."""
-        cutoff = time.monotonic() - PEER_TTL
+        cutoff = self._clock() - PEER_TTL
         evicted = 0
         for info in self.files.values():
             for pid in [pid for pid, p in info.peers.items() if p.last_seen < cutoff]:
@@ -164,7 +175,7 @@ class InMemoryTracker:
 
         from torrent_tpu.codec.bencode import bencode
 
-        now = time.monotonic()
+        now = self._clock()
         files = {}
         for ih, info in self.files.items():
             files[ih] = {
@@ -201,7 +212,7 @@ class InMemoryTracker:
         files = decoded.get(b"files")
         if not isinstance(files, dict):
             return False
-        now = time.monotonic()
+        now = self._clock()
         # Parse fully into a scratch dict first — a snapshot that turns
         # out malformed halfway through must not leave partial state.
         loaded: dict[bytes, FileInfo] = {}

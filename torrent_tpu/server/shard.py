@@ -156,6 +156,8 @@ class ShardedSwarmStore:
         peer_ttl: float = PEER_TTL,
         max_numwant: int = MAX_NUM_WANT,
         max_reply_bytes: int = MAX_REPLY_BYTES,
+        clock=time.monotonic,
+        rng: random.Random | None = None,
     ):
         if n_shards < 1:
             raise ValueError("n_shards must be >= 1")
@@ -164,6 +166,16 @@ class ShardedSwarmStore:
         self.peer_ttl = peer_ttl
         self.max_numwant = max_numwant
         self.max_reply_bytes = max_reply_bytes
+        # determinism seams: every timestamp (peer last_seen, TTL
+        # cutoffs) and every reservoir draw routes through these, so a
+        # scenario run with a virtual clock + seeded rng is replayable
+        # bit-for-bit (scenario/engine.py); production defaults unchanged
+        self._clock = clock
+        self._rng: random.Random = rng if rng is not None else random  # type: ignore[assignment]
+        # BEP 33 seam: info_hash -> (seed_bloom, peer_bloom) | None,
+        # consulted by scrape() for swarms the tracker has never seen an
+        # announce for (DHT-harvested knowledge only lives as blooms)
+        self._bloom_source = None
         self._shards = [_Shard() for _ in range(n_shards)]
         self._sweep_cursor = 0
         # store-level counters (scrapes/batches span shards); leaf lock,
@@ -205,7 +217,7 @@ class ShardedSwarmStore:
     ) -> AnnounceOutcome:
         shard = self._shards[self.shard_of(info_hash)]
         want, clamped = self.clamp_numwant(numwant)
-        now = time.monotonic()
+        now = self._clock()
         with shard._shard_lock:
             shard._cells.write("stats")
             shard.announces += 1
@@ -227,7 +239,7 @@ class ShardedSwarmStore:
         for i, it in enumerate(items):
             by_shard.setdefault(self.shard_of(it[0]), []).append(i)
         out: list[AnnounceOutcome | None] = [None] * len(items)
-        now = time.monotonic()
+        now = self._clock()
         for si in sorted(by_shard):
             shard = self._shards[si]
             idxs = by_shard[si]
@@ -331,7 +343,7 @@ class ShardedSwarmStore:
                 if pid != exclude and p.last_seen >= cutoff
             ][:n]
         out: list[AnnouncePeer] = []
-        for i in random.sample(range(len(order)), min(len(order), n + extra)):
+        for i in self._rng.sample(range(len(order)), min(len(order), n + extra)):
             pid = order[i]
             if pid == exclude:
                 continue
@@ -345,11 +357,23 @@ class ShardedSwarmStore:
 
     # ------------------------------------------------------------- scrape
 
+    def attach_bloom_source(self, fn) -> None:
+        """Wire a BEP 33 bloom provider (``net.indexer.DhtIndexer
+        .blooms_for``): ``fn(info_hash) -> (seed_bloom, peer_bloom) |
+        None``. Scrapes for swarms the tracker holds NO peer state for
+        fall back to bloom cardinality estimates, so DHT-harvested
+        swarms scrape as populations instead of zeros while costing the
+        store 0 bytes per swarm. Called OUTSIDE every shard lock (the
+        provider owns its own state)."""
+        self._bloom_source = fn
+
     def scrape(self, info_hashes: list[bytes]) -> list[tuple]:
         """(info_hash, complete, downloaded, incomplete) per hash.
-        Unknown hashes scrape as zeros (the in_memory divergence kept);
-        the request is CAPPED — an unbounded batch is truncated, and an
-        empty scrape returns per-swarm totals only up to the cap."""
+        Unknown hashes scrape as zeros — unless a BEP 33 bloom source is
+        attached, in which case they scrape as the blooms' cardinality
+        estimates (seeders from BFsd, leechers from BFpe); the request
+        is CAPPED — an unbounded batch is truncated, and an empty
+        scrape returns per-swarm totals only up to the cap."""
         hashes = info_hashes[:MAX_SCRAPE_HASHES]
         if not hashes:
             # empty scrape = "everything": bounded walk, shard by shard.
@@ -368,16 +392,33 @@ class ShardedSwarmStore:
             self._stats_cells.write("stats")
             self._scrapes += 1
         out = []
+        unknown: list[int] = []  # out-indices to try the bloom source on
         for h in hashes:
             shard = self._shards[self.shard_of(h)]
             with shard._shard_lock:
                 swarm = shard.swarms.get(h)
                 if swarm is None:
+                    unknown.append(len(out))
                     out.append((h, 0, 0, 0))
                 else:
                     out.append(
                         (h, swarm.complete, swarm.downloaded, swarm.incomplete)
                     )
+        # BEP 33 fallback strictly AFTER the shard-lock walk: the bloom
+        # provider is foreign code and must never run under a leaf lock
+        if self._bloom_source is not None:
+            for i in unknown:
+                h = out[i][0]
+                blooms = self._bloom_source(h)
+                if blooms is None:
+                    continue
+                seed_bloom, peer_bloom = blooms
+                out[i] = (
+                    h,
+                    int(round(seed_bloom.estimate())),
+                    0,
+                    int(round(peer_bloom.estimate())),
+                )
         return out
 
     # ----------------------------------------------------- indexer seam
@@ -395,7 +436,7 @@ class ShardedSwarmStore:
                 f"{ip}:{port}".encode()
             ).digest()[:16]
         shard = self._shards[self.shard_of(info_hash)]
-        now = time.monotonic()
+        now = self._clock()
         with shard._shard_lock:
             shard._cells.write("stats")
             shard.indexed += 1
@@ -413,7 +454,7 @@ class ShardedSwarmStore:
     # -------------------------------------------------------------- sweep
 
     def _sweep_shard(self, shard: _Shard) -> int:
-        cutoff = time.monotonic() - self.peer_ttl
+        cutoff = self._clock() - self.peer_ttl
         evicted = 0
         with shard._shard_lock:
             shard._cells.write("stats")

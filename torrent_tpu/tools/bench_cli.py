@@ -97,7 +97,7 @@ SCHEMA = "torrent-tpu-bench/1"
 TRAJECTORY_SCHEMA = "torrent-tpu-bench-trajectory/1"
 RUNGS = (
     "smoke", "e2e", "v2", "fabric", "flagship", "controller", "announce",
-    "swarm",
+    "swarm", "scenario",
 )
 # the announce rung's acceptance floor: the banked rate must come from
 # real cross-shard concurrency, not one hot shard
@@ -564,6 +564,97 @@ async def _announce_storm(
     }
 
 
+def _scenario_rung(occupancy: int, shards: int) -> dict:
+    """The scenario rung: fill the sharded store to ``occupancy``
+    single-seed swarms (distinct sha1-derived info-hashes, one peer
+    each) on a virtual timeline, then run the bundled churn-storm
+    scenario against that PRE-FILLED store — the banked rate is the
+    wall-plane announces/s the serve stack sustains while holding
+    million-swarm occupancy under live churn. The record's value is
+    ``None`` unless the fill reached the requested occupancy, the SLO
+    verdict passed, and the wall plane held its latency budget."""
+    import hashlib
+    import random
+
+    from torrent_tpu.net.types import AnnounceEvent
+    from torrent_tpu.scenario import VirtualClock, run_scenario
+    from torrent_tpu.scenario.library import get
+    from torrent_tpu.server.shard import ShardedSwarmStore
+
+    spec = get("churn-storm")
+    # same construction run_scenario uses for a fresh store: the engine
+    # adopts the clock/rng, so the prefill and the scenario share one
+    # coherent virtual timeline. churn-storm's short TTL means the
+    # prefill population ages out by the final sweep, so the engine's
+    # exact-occupancy oracle still balances.
+    clock = VirtualClock(float(spec.peer_ttl_s) + 1.0)
+    rng = random.Random(spec.seed)
+    store = ShardedSwarmStore(
+        n_shards=shards, peer_ttl=float(spec.peer_ttl_s),
+        clock=clock, rng=rng,
+    )
+
+    chunk = 10_000
+    t0 = time.perf_counter()
+    for base in range(0, occupancy, chunk):
+        batch = []
+        for i in range(base, min(base + chunk, occupancy)):
+            ih = hashlib.sha1(b"bench-scenario-swarm-%d" % i).digest()
+            pid = b"-BN-" + ih[:16]
+            batch.append((
+                ih, pid,
+                f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}",
+                6881, 0, AnnounceEvent.STARTED, 0,
+            ))
+        store.announce_batch(batch)
+    fill_wall = time.perf_counter() - t0
+    fill_snap = store.metrics_snapshot()
+    occupancy_held = fill_snap["peers"]
+
+    result = run_scenario(spec, store=store)
+    verdict = result["verdict"]
+    wall = verdict["wall"]
+
+    ok = (
+        occupancy_held == occupancy
+        and bool(verdict["pass"])
+        and bool(wall["ok"])
+    )
+    return {
+        "schema": SCHEMA,
+        "rung": "scenario",
+        "metric": f"scenario_churn_{occupancy}sw_announces_per_sec",
+        "value": wall["announces_per_s"] if ok else None,
+        "unit": "announces/s",
+        "contract": "churn-storm verdict PASS at full occupancy",
+        "scenario": spec.name,
+        "seed": spec.seed,
+        "ticks": spec.ticks,
+        "population": verdict["population"],
+        "occupancy": occupancy,
+        "occupancy_held": occupancy_held,
+        "fill_announces_per_sec": (
+            round(occupancy / fill_wall, 1) if fill_wall > 0 else 0.0
+        ),
+        "shards": shards,
+        "verdict_pass": bool(verdict["pass"]),
+        "reasons": verdict["reasons"][:4],
+        "budget": verdict["budget"],
+        # the scenario population is the launch shape for the
+        # like-for-like key
+        "batch": verdict["population"],
+        "platform": "cpu",
+        "nproc": os.cpu_count(),
+        "latency": {
+            "p50_us": wall["p50_us"],
+            "p99_us": wall["p99_us"],
+            "max_us": wall["max_us"],
+        },
+        "measured_at_utc": _utcnow(),
+        "ledger": None,  # scenario verdicts are not a pipeline-ledger path
+    }
+
+
 async def _swarm_rung(total_mb: int, piece_kb: int) -> dict:
     """The swarm wire-plane rung: a real two-client loopback download
     (in-memory tracker, TCP sockets, the full picker/choke/endgame
@@ -911,7 +1002,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "rung", nargs="?", choices=RUNGS,
         help="named rung to run "
-        "(smoke/e2e/v2/fabric/flagship/controller/announce/swarm)",
+        "(smoke/e2e/v2/fabric/flagship/controller/announce/swarm/scenario)",
     )
     ap.add_argument(
         "--smoke", action="store_true",
@@ -959,6 +1050,11 @@ def main(argv=None) -> int:
         "(default %(default)s)",
     )
     ap.add_argument(
+        "--occupancy", type=int, default=1_000_000,
+        help="scenario rung: swarms pre-filled into the store before "
+        "the churn-storm scenario runs (default %(default)s)",
+    )
+    ap.add_argument(
         "--timeout", type=float, default=None,
         help="device-rung subprocess timeout seconds (default: none)",
     )
@@ -1000,7 +1096,8 @@ def main(argv=None) -> int:
         rung = "smoke"
     if rung is None and args.record is None:
         print("error: name a rung (smoke/e2e/v2/fabric/flagship/controller/"
-              "announce/swarm) or pass --record FILE", file=sys.stderr)
+              "announce/swarm/scenario) or pass --record FILE",
+              file=sys.stderr)
         return 2
     if rung == "announce" and (
         args.shards < ANNOUNCE_MIN_SHARDS_HIT
@@ -1050,6 +1147,8 @@ def main(argv=None) -> int:
                 )
             elif rung == "swarm":
                 record = asyncio.run(_swarm_rung(args.mb, args.piece_kb))
+            elif rung == "scenario":
+                record = _scenario_rung(args.occupancy, args.shards)
             elif rung == "fabric":
                 record = _run_fabric_rung(args.timeout)
             else:

@@ -1231,6 +1231,8 @@ def _cmd_doctor(args) -> int:
         argv.append("--slo")
     if getattr(args, "swarm", False):
         argv.append("--swarm")
+    if getattr(args, "scenario", None):
+        argv += ["--scenario", args.scenario]
     if getattr(args, "json", False):
         argv.append("--json")
     return doctor_cli(argv)
@@ -2096,6 +2098,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--lint", action="store_true",
                     help="also run the analysis-plane smoke: all four "
                     "static passes clean against the committed baseline")
+    sp.add_argument("--scenario", metavar="NAMES",
+                    help="also run bundled hostile-internet scenarios "
+                    "(comma-separated names from scenario/library): each "
+                    "runs twice against the real serve stack on a "
+                    "virtual timeline; SLO verdict must pass and the "
+                    "same-seed replay must be bit-identical")
     sp.add_argument("--trace", action="store_true",
                     help="also run the observability smoke: traced "
                     "fault-injected run producing a span tree, latency "

@@ -1349,6 +1349,46 @@ def _lint_smoke() -> str:
     )
 
 
+def _scenario_smoke(name: str) -> str:
+    """Scenario-plane smoke (``--scenario``): run one bundled
+    hostile-internet scenario TWICE against the real serve stack. The
+    verdict must pass (all behavior invariants held, no SLO objective
+    breached), the wall-plane announce latency must hold its budget,
+    and the two same-seed runs must produce bit-identical canonical
+    verdict + timeline bytes — the determinism contract the replay
+    surface depends on."""
+    from torrent_tpu.scenario import canonical_bytes, run_scenario
+    from torrent_tpu.scenario.library import get
+
+    spec = get(name)
+    first = run_scenario(spec)
+    second = run_scenario(spec)
+    b1 = canonical_bytes(first["verdict"], first["timeline"])
+    b2 = canonical_bytes(second["verdict"], second["timeline"])
+    if b1 != b2:
+        raise AssertionError(
+            "same-seed replay diverged: canonical verdict/timeline "
+            f"bytes differ ({len(b1)} vs {len(b2)} bytes)"
+        )
+    verdict = first["verdict"]
+    if not verdict["pass"]:
+        raise AssertionError(
+            "scenario failed: " + "; ".join(verdict["reasons"][:4])
+        )
+    wall = verdict["wall"]
+    if not wall["ok"]:
+        raise AssertionError(
+            f"wall plane over budget: announce p99 {wall['p99_us']}us "
+            f"vs {wall['budget_ms']}ms budget"
+        )
+    return (
+        f"{verdict['population']} actors x {spec.ticks} ticks; "
+        f"{verdict['budget']}; announce p99 {wall['p99_us']}us "
+        f"({wall['announces_per_s']}/s) within {wall['budget_ms']}ms; "
+        "replay bit-identical"
+    )
+
+
 async def _http_request(port: int, method: str, path: str, body: bytes = b""):
     """Minimal loopback HTTP round-trip (status, payload) — the bridge
     and SLO smokes share it; doctor must not depend on a client lib."""
@@ -1480,6 +1520,16 @@ def main(argv=None) -> int:
         "reconcile with the store totals and scrape sums",
     )
     ap.add_argument(
+        "--scenario",
+        metavar="NAMES",
+        help="run bundled hostile-internet scenarios (comma-separated "
+        "names from scenario/library, e.g. sybil-stampede,churn-storm): "
+        "each runs TWICE against the real serve stack on a virtual "
+        "timeline — the SLO verdict must pass, the wall-plane announce "
+        "latency must hold its budget, and the same-seed replay must be "
+        "bit-identical",
+    )
+    ap.add_argument(
         "--swarm",
         action="store_true",
         help="also run the swarm wire-plane smoke: a throttled two-peer "
@@ -1593,6 +1643,15 @@ def main(argv=None) -> int:
             _report("PASS", "announce plane", detail)
         except Exception as e:
             _report("FAIL", "announce plane", repr(e))
+    if args.scenario:
+        for scenario_name in [
+            n.strip() for n in args.scenario.split(",") if n.strip()
+        ]:
+            try:
+                detail = _scenario_smoke(scenario_name)
+                _report("PASS", f"scenario {scenario_name}", detail)
+            except Exception as e:
+                _report("FAIL", f"scenario {scenario_name}", repr(e))
     if args.swarm:
         with tempfile.TemporaryDirectory(prefix="doctor_wire_") as tmp:
             try:

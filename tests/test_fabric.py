@@ -534,6 +534,200 @@ class TestTwoProcessFabric:
         assert rec["sentinel_mismatches"] == 0
 
 
+class TestByzantineReceipts:
+    """The Byzantine verdict layer (ISSUE PR 17): f = 0 heartbeat
+    bit-identity, receipt roots/proofs at f > 0, and the multi-process
+    forger conviction acceptance."""
+
+    # every key a pre-receipt (f = 0) heartbeat carries — the pin: the
+    # receipt plane must add NOTHING here, so f = 0 exchanged bytes are
+    # identical to the pre-PR fabric
+    LEGACY_KEYS = {
+        "pid", "seq", "t", "fp", "span", "degraded", "done",
+        "inflight", "distrust", "redone", "offer", "obs",
+    }
+
+    def _spy(self, ex, seen):
+        orig = ex.transport.exchange
+
+        def exchange(payload):
+            seen.append(payload)
+            return orig(payload)
+
+        ex.transport.exchange = exchange
+
+    def _run_pair(self, tmp_path, cfg, corrupt=None):
+        items1, _, _ = make_library(tmp_path, [12, 20, 7], corrupt=corrupt)
+        items2 = [
+            (Storage(FsStorage(s.method.root), info), info)
+            for (s, info) in items1
+        ]
+        seen0, seen1 = [], []
+
+        async def go():
+            s0 = await cpu_sched().start()
+            s1 = await cpu_sched().start()
+            try:
+                e0 = build_fabric_executor(
+                    items1, s0, nproc=2, pid=0,
+                    heartbeat_dir=str(tmp_path / "hb"), config=cfg,
+                    unit_bytes=8 * PLEN,
+                )
+                e1 = build_fabric_executor(
+                    items2, s1, nproc=2, pid=1,
+                    heartbeat_dir=str(tmp_path / "hb"), config=cfg,
+                    unit_bytes=8 * PLEN,
+                )
+                self._spy(e0, seen0)
+                self._spy(e1, seen1)
+                await asyncio.gather(e0.run(), e1.run())
+            finally:
+                await s0.close()
+                await s1.close()
+            return e0, e1
+
+        e0, e1 = run(go())
+        return e0, e1, seen0, seen1
+
+    def test_f0_heartbeat_keys_and_payload_budget_pinned(self, tmp_path):
+        """ISSUE acceptance: byzantine_f = 0 is bit-identical to the
+        pre-receipt fabric — no receipt keys ever reach the exchanged
+        bytes, and the allgather buffer budget is unchanged."""
+        cfg = FabricConfig(heartbeat_interval=0.05, lapse_after=3.0)
+        e0, e1, seen0, seen1 = self._run_pair(tmp_path, cfg)
+        assert seen0 and seen1
+        for payload in seen0 + seen1:
+            assert set(payload) <= self.LEGACY_KEYS
+            assert "root" not in payload and "evid" not in payload
+        # the f = 0 default leaves every existing caller's buffer
+        # sizing byte-identical
+        from torrent_tpu.fabric import plan_payload_bytes
+
+        assert plan_payload_bytes(e0.plan) == plan_payload_bytes(
+            e0.plan, byzantine_f=0
+        )
+        assert plan_payload_bytes(e0.plan, byzantine_f=1) > plan_payload_bytes(
+            e0.plan
+        )
+        snap = e0.metrics_snapshot()
+        assert snap["quorum_need"] == 1
+        assert snap["audit_checks"] == snap["convictions"] == 0
+
+    def test_f1_receipts_ride_heartbeat_and_audits_pass(self, tmp_path):
+        """Two HONEST processes at f = 1: receipt roots and (empty)
+        evidence ride every heartbeat, full-rate audits all match,
+        nobody is convicted, and the shared view still rejects the
+        genuinely corrupt piece."""
+        cfg = FabricConfig(
+            heartbeat_interval=0.05, lapse_after=3.0,
+            byzantine_f=1, audit_rate=1.0,
+        )
+        e0, e1, seen0, seen1 = self._run_pair(
+            tmp_path, cfg, corrupt=(1, 5)
+        )
+        rooted = [p for p in seen0 + seen1 if "root" in p]
+        assert rooted, "no heartbeat ever carried a receipt root"
+        for payload in seen0 + seen1:
+            assert "evid" in payload  # present (and empty: all honest)
+            assert payload["evid"] == []
+        for a, b in zip(e0.bitfields(), e1.bitfields()):
+            assert (a == b).all()
+        assert not e0.bitfields()[1][5]
+        for ex in (e0, e1):
+            snap = ex.metrics_snapshot()
+            assert snap["quorum_need"] == 2
+            assert snap["audit_checks"] >= 1
+            assert snap["audit_mismatches"] == 0
+            assert snap["convictions"] == 0
+            assert snap["distrusted"] == []
+
+    def test_receipt_proof_roundtrips_and_rejects_tampering(self, tmp_path):
+        """receipt_proof serves a bounded proof that verifies against
+        the published root; any tampered field fails verification; the
+        guards reject unknown units and out-of-span pieces."""
+        from torrent_tpu.fabric import verify_proof
+
+        cfg = FabricConfig(
+            heartbeat_interval=0.05, lapse_after=3.0,
+            byzantine_f=1, audit_rate=1.0,
+        )
+        e0, _, _, _ = self._run_pair(tmp_path, cfg, corrupt=(1, 5))
+        unit = e0.plan.units_for(0)[0]
+        uid = unit.uid
+        for piece in (unit.start, unit.stop - 1):
+            pr = e0.receipt_proof(uid, piece)
+            assert verify_proof(
+                bytes.fromhex(pr["leaf"]), pr["index"],
+                pr["nleaves"], pr["path"], pr["root"],
+            )
+            # single-field tampering: flipped leaf byte, wrong index,
+            # truncated path — none may verify
+            bad_leaf = bytes.fromhex(pr["leaf"])
+            bad_leaf = bytes([bad_leaf[0] ^ 1]) + bad_leaf[1:]
+            assert not verify_proof(
+                bad_leaf, pr["index"], pr["nleaves"], pr["path"], pr["root"]
+            )
+            if pr["nleaves"] > 1:
+                assert not verify_proof(
+                    bytes.fromhex(pr["leaf"]), pr["index"],
+                    pr["nleaves"], pr["path"][:-1], pr["root"],
+                )
+        with pytest.raises(IndexError):
+            e0.receipt_proof(uid, unit.stop)
+        with pytest.raises(KeyError):
+            e0.receipt_proof(10**9, 0)
+
+    def test_three_process_forger_convicted_on_every_process(self, tmp_path):
+        """ISSUE acceptance: byzantine_f = 1, three processes, one
+        forging receipts — the run completes with identical correct
+        bitfields on the honest processes, and the forger is convicted
+        via receipt evidence on EVERY process (symmetric verdicts)."""
+        items, tdir, ddir = make_library(tmp_path, [96, 160], seed=13)
+        total = sum(info.num_pieces for _, info in items)
+        # the forger lies by claiming its WHOLE shard verified-ok, so
+        # the lie is only a lie if a corrupt piece lands in ITS shard:
+        # plan deterministically (same inputs as the workers) and
+        # corrupt the first piece of pid 2's first unit on disk
+        plan = plan_library(
+            [info for _, info in items], nproc=3, unit_bytes=1 << 20
+        )
+        bad_unit = plan.units_for(2)[0]
+        bad_piece = bad_unit.start + 1
+        f = ddir / f"lib{bad_unit.torrent}" / "payload.bin"
+        buf = bytearray(f.read_bytes())
+        buf[bad_piece * PLEN + 11] ^= 0xFF
+        f.write_bytes(bytes(buf))
+        byz = ["--byzantine-f", "1", "--audit-rate", "1.0"]
+        rcs, errs = _spawn_workers(
+            tdir, ddir, tmp_path, 3,
+            extra_by_pid={
+                0: byz, 1: byz,
+                2: byz + ["--fault-plan", "forge_receipts=1"],
+            },
+        )
+        # rc 2 = completed with the one invalid piece — every process
+        # COMPLETES, forger included (exit-code parity)
+        assert rcs == [2, 2, 2], errs
+        recs = [
+            json.loads((tmp_path / f"result_{p}.json").read_text())
+            for p in range(3)
+        ]
+        for rec in recs:
+            assert rec["byzantine_f"] == 1 and rec["quorum_need"] == 2
+            # symmetric termination: all three convicted the forger
+            assert 2 in rec["distrusted"], rec
+            assert rec["convictions"] >= 1
+        honest = recs[:2]
+        assert honest[0]["bitfields"] == honest[1]["bitfields"]
+        assert honest[0]["n_valid"] == honest[0]["n_pieces"] - 1 == total - 1
+        # the forger claimed the corrupt piece ok; the honest view
+        # rejects it anyway
+        assert honest[0]["bitfields"][bad_unit.torrent][bad_piece] == "0"
+        # the audits actually ran — and caught the forged claim
+        assert any(r["audit_checks"] >= 1 for r in honest)
+        assert any(r["audit_mismatches"] >= 1 for r in honest)
+
+
 class TestBridgeFabricRoutes:
     def test_fabric_verify_and_status(self, tmp_path):
         from torrent_tpu.bridge.service import BridgeServer

@@ -1031,3 +1031,83 @@ class TestScenarioSpecProperties:
             ScenarioSpec.from_bencode(blob)
         except ValueError:
             pass  # BencodeError is a ValueError; both are the contract
+
+
+class TestMerkleReceiptProperties:
+    """fabric/receipts.py — the Byzantine verdict layer's commitment
+    scheme. Two contracts: proofs round-trip for EVERY leaf of EVERY
+    tree shape, and no single-bit mutation of a leaf or its proof path
+    survives verification (the property a forged receipt needs broken)."""
+
+    leaves = st.lists(
+        st.tuples(st.binary(max_size=24), st.booleans()),
+        min_size=1,
+        max_size=33,  # crosses several power-of-two split boundaries
+    )
+
+    @staticmethod
+    def _leaves(pairs):
+        from torrent_tpu.fabric.receipts import leaf_hash
+
+        return [
+            leaf_hash(0, j, d.hex(), ok) for j, (d, ok) in enumerate(pairs)
+        ]
+
+    @given(leaves)
+    @settings(max_examples=200)
+    def test_root_proof_roundtrip_total(self, pairs):
+        from torrent_tpu.fabric.receipts import merkle_proof, merkle_root, verify_proof
+
+        leaves = self._leaves(pairs)
+        root = merkle_root(leaves)
+        for j, leaf in enumerate(leaves):
+            proof = merkle_proof(leaves, j)
+            assert verify_proof(leaf, j, len(leaves), proof, root), (
+                f"valid proof rejected at index {j}/{len(leaves)}"
+            )
+
+    @given(leaves, st.data())
+    @settings(max_examples=200)
+    def test_single_bit_mutation_never_verifies(self, pairs, data):
+        from torrent_tpu.fabric.receipts import merkle_proof, merkle_root, verify_proof
+
+        leaves = self._leaves(pairs)
+        root = merkle_root(leaves)
+        j = data.draw(st.integers(0, len(leaves) - 1), label="leaf index")
+        proof = merkle_proof(leaves, j)
+        # mutate ONE bit of the leaf itself... (leaves are raw bytes)
+        bit = data.draw(st.integers(0, len(leaves[j]) * 8 - 1), label="leaf bit")
+        raw = bytearray(leaves[j])
+        raw[bit // 8] ^= 1 << (bit % 8)
+        assert not verify_proof(bytes(raw), j, len(leaves), proof, root)
+        # ...or one bit of any sibling on the (hex) proof path
+        if proof:
+            k = data.draw(st.integers(0, len(proof) - 1), label="path node")
+            bit = data.draw(
+                st.integers(0, len(proof[k]) * 4 - 1), label="path bit"
+            )
+            raw = bytearray(bytes.fromhex(proof[k]))
+            raw[bit // 8] ^= 1 << (bit % 8)
+            mutated = list(proof)
+            mutated[k] = raw.hex()
+            assert not verify_proof(leaves[j], j, len(leaves), mutated, root)
+
+    @given(leaves)
+    @settings(max_examples=100)
+    def test_verify_proof_total_on_malformed_inputs(self, pairs):
+        from torrent_tpu.fabric.receipts import merkle_proof, merkle_root, verify_proof
+
+        leaves = self._leaves(pairs)
+        root = merkle_root(leaves)
+        proof = merkle_proof(leaves, 0)
+        # truncated path, wrong leaf count, garbage hex, bad index: all
+        # must return False, never raise (totality is what lets the
+        # executor feed peer-supplied proof bytes straight in)
+        if proof:
+            assert not verify_proof(leaves[0], 0, len(leaves), proof[:-1], root)
+            assert not verify_proof(
+                leaves[0], 0, len(leaves), ["zz"] * len(proof), root
+            )
+        assert not verify_proof(leaves[0], -1, len(leaves), proof, root)
+        assert not verify_proof(leaves[0], len(leaves), len(leaves), proof, root)
+        assert not verify_proof(leaves[0], 0, 0, proof, root)

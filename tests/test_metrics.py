@@ -137,6 +137,35 @@ class TestRendererEdgeCases:
         assert 'torrent_tpu_fabric_units{pid="2",kind="done"} 4' in text
         assert 'torrent_tpu_fabric_shard_bytes{pid="2"} 0' in text
 
+    def test_fabric_renderer_audit_quorum_fresh_defaults(self):
+        # an f=0 (or half-initialized) snapshot still renders the
+        # Byzantine audit/quorum families, zeroed — scrapes must not
+        # see series flap in and out when byzantine_f changes
+        from torrent_tpu.utils.metrics import render_fabric_metrics
+
+        text = render_fabric_metrics({})
+        prom_lint(text)
+        assert 'torrent_tpu_fabric_audit_checks_total{pid="0"} 0' in text
+        assert 'torrent_tpu_fabric_audit_mismatches_total{pid="0"} 0' in text
+        assert 'torrent_tpu_fabric_quorum_convictions_total{pid="0"} 0' in text
+        assert 'torrent_tpu_fabric_quorum_verifies_total{pid="0"} 0' in text
+        assert 'torrent_tpu_fabric_quorum_need{pid="0"} 1' in text
+
+    def test_fabric_renderer_audit_quorum_partial_snapshot(self):
+        from torrent_tpu.utils.metrics import render_fabric_metrics
+
+        text = render_fabric_metrics({
+            "pid": 1, "state": "running", "byzantine_f": 1,
+            "quorum_need": 2, "audit_checks": 9, "audit_mismatches": 1,
+            "convictions": 1, "quorum_verifies": 3,
+        })
+        prom_lint(text)
+        assert 'torrent_tpu_fabric_audit_checks_total{pid="1"} 9' in text
+        assert 'torrent_tpu_fabric_audit_mismatches_total{pid="1"} 1' in text
+        assert 'torrent_tpu_fabric_quorum_convictions_total{pid="1"} 1' in text
+        assert 'torrent_tpu_fabric_quorum_verifies_total{pid="1"} 3' in text
+        assert 'torrent_tpu_fabric_quorum_need{pid="1"} 2' in text
+
     def test_tsan_renderer_empty_snapshot(self):
         from torrent_tpu.utils.metrics import render_tsan_metrics
 
@@ -510,6 +539,14 @@ class TestRendererEdgeCases:
         # full bridge/MetricsServer payload carries both new families
         assert "torrent_tpu_swarm_peers " in text
         assert "torrent_tpu_peer_bytes_down_total" in text
+        # the Byzantine audit/quorum families ride render_fabric_metrics
+        # unconditionally (zeroed at f=0), so the concatenated payload
+        # always carries them
+        assert "torrent_tpu_fabric_audit_checks_total" in text
+        assert "torrent_tpu_fabric_audit_mismatches_total" in text
+        assert "torrent_tpu_fabric_quorum_convictions_total" in text
+        assert "torrent_tpu_fabric_quorum_verifies_total" in text
+        assert 'torrent_tpu_fabric_quorum_need{pid="0"} 1' in text
 
 
 class TestSwarmRenderer:

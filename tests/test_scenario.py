@@ -150,6 +150,8 @@ _SCALE = {
     "slowloris": 2,
     "ghost-flood": 2,
     "token-forge": 2,
+    "byzantine-fabric": 2,
+    "mixed-adversary": 8,
 }
 
 
@@ -221,6 +223,49 @@ class TestLibraryScenarios:
         v = run_scenario(get("churn-storm").scaled(8, ticks=10))["verdict"]
         occ = v["facts"]["occupancy"]
         assert occ["expected"] == occ["actual"]
+
+    def test_byzantine_facts_show_all_liar_modes_convicted(self):
+        v = run_scenario(get("byzantine-fabric").scaled(2, ticks=10))["verdict"]
+        byz = next(
+            f for k, f in v["facts"]["behaviors"].items()
+            if k.startswith("byzantine")
+        )
+        # every liar archetype present AND convicted, no honest receipt
+        # ever refuted
+        assert byz["caught_forged_root"] > 0
+        assert byz["caught_equivocation"] > 0
+        assert byz["caught_under_hash"] > 0
+        assert byz["false_refutations"] == 0
+        assert byz["honest_verified"] > 0
+
+    def test_mixed_adversary_defenses_hold_together(self):
+        v = run_scenario(get("mixed-adversary").scaled(8, ticks=10))["verdict"]
+        c = v["facts"]["counters"]
+        # piece-poison plane: every scaled poisoner convicted, no one else
+        assert c["convicted"] == 1 and c["false_convictions"] == 0
+        assert c["poison_escapes"] == 0
+        # sybil plane: clamp held under the overlapping attacks
+        sybil = next(
+            f for k, f in v["facts"]["behaviors"].items()
+            if k.startswith("sybil")
+        )
+        assert sybil["overflows"] == 0 and sybil["announces"] > 0
+        # churn plane: occupancy still reconciles to the peer
+        occ = v["facts"]["occupancy"]
+        assert occ["expected"] == occ["actual"]
+
+    def test_multi_group_spec_roundtrips_all_codecs(self):
+        # the 4-group mixed-adversary entry through every codec: the
+        # compact grammar, JSON, and bencode must all round-trip a
+        # MULTI-group population losslessly (group order preserved)
+        spec = get("mixed-adversary")
+        assert len(spec.actors) == 4
+        assert ScenarioSpec.parse(spec.serialize()) == spec
+        assert ScenarioSpec.from_json(spec.to_json()) == spec
+        assert ScenarioSpec.from_bencode(spec.to_bencode()) == spec
+        assert [g.kind for g in spec.actors] == [
+            "honest", "sybil", "churn", "poison",
+        ]
 
     def test_wall_plane_is_reported_but_not_canonical(self):
         r = run_scenario(get("piece-poison").scaled(4, ticks=4))

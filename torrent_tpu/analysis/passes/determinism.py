@@ -43,6 +43,10 @@ PASS_NAME = "determinism"
 # path suffix -> function names in scope ("*" = every function)
 SCOPE: dict[str, frozenset[str]] = {
     "fabric/plan.py": frozenset({"*"}),
+    # the Byzantine receipt plane: Merkle commitments, audit-sample
+    # draws, and proof verification are ALL exchanged (or replayed)
+    # bytes — pure by contract, so the whole module is in scope
+    "fabric/receipts.py": frozenset({"*"}),
     # _own_bits is deliberately NOT in scope: its dict order provably
     # never reaches exchanged bytes (the payload sorts own.items() and
     # _published_done is a set)
@@ -55,6 +59,13 @@ SCOPE: dict[str, frozenset[str]] = {
             "pack_bits",
             "unpack_bits",
             "plan_payload_bytes",
+            # Byzantine receipt builders: roots/evidence ride the
+            # heartbeat, and the quorum grouping/need rules decide the
+            # symmetric coverage every process must agree on
+            "_receipt_payload",
+            "_unit_root",
+            "_quorum_groups",
+            "_unit_need",
         }
     ),
     # the scheduler autopilot's decision core: decisions are pure

@@ -441,8 +441,14 @@ class BridgeServer:
     def _distrust_source(self):
         if not (self._fabric and self._fabric["executors"]):
             return 0
-        return self._fabric["executors"][0].metrics_snapshot().get(
-            "sentinel_mismatches", 0
+        snap = self._fabric["executors"][0].metrics_snapshot()
+        # every way the fabric loses trust in a verdict feeds the SLO
+        # integrity objective: f = 0 sentinel rejections, Byzantine
+        # audit mismatches, and receipt convictions
+        return (
+            snap.get("sentinel_mismatches", 0)
+            + snap.get("audit_mismatches", 0)
+            + snap.get("convictions", 0)
         )
 
     # ----------------------------------------------------------- streaming
@@ -916,6 +922,11 @@ class BridgeServer:
                 b"pieces_verified": s["pieces_verified"],
                 b"sentinel_checks": s["sentinel_checks"],
                 b"sentinel_mismatches": s["sentinel_mismatches"],
+                b"byzantine_f": s.get("byzantine_f", 0),
+                b"quorum_need": s.get("quorum_need", 1),
+                b"audit_checks": s.get("audit_checks", 0),
+                b"audit_mismatches": s.get("audit_mismatches", 0),
+                b"convictions": s.get("convictions", 0),
                 b"stragglers": s["stragglers"],
                 b"heartbeat_age_ms": int(s["heartbeat_age"] * 1000),
                 b"degraded": int(s["degraded"]),

@@ -8,7 +8,12 @@ coalescing and pod-scale sharding compose instead of competing for the
 hash plane. A periodic few-byte heartbeat carries progress and verdict
 bits; survivors adopt orphaned work from lapsed or breaker-degraded
 processes, sentinel-cross-checking adopted verdicts so a bad worker
-cannot poison the global bitfield. Public entry point:
+cannot poison the global bitfield. With ``FabricConfig.byzantine_f >
+0`` the fabric additionally tolerates up to f *lying* processes:
+verdicts travel as Merkle-committed receipts (``fabric/receipts.py``),
+claims are audit-sampled every round, and coverage needs a quorum of
+f + 1 matching receipts (see ``fabric/executor.py``'s module
+docstring). Public entry point:
 ``torrent_tpu.parallel.bulk.verify_library_fabric``.
 """
 
@@ -28,6 +33,15 @@ from torrent_tpu.fabric.plan import (
     WorkUnit,
     adoption_owner,
     plan_library,
+    replica_owners,
+)
+from torrent_tpu.fabric.receipts import (
+    audit_sample,
+    leaf_hash,
+    merkle_proof,
+    merkle_root,
+    unit_leaves,
+    verify_proof,
 )
 
 __all__ = [
@@ -40,11 +54,18 @@ __all__ = [
     "FileHeartbeat",
     "WorkUnit",
     "adoption_owner",
+    "audit_sample",
     "build_fabric_executor",
+    "leaf_hash",
+    "merkle_proof",
+    "merkle_root",
     "pack_bits",
     "plan_library",
     "plan_payload_bytes",
+    "replica_owners",
+    "unit_leaves",
     "unpack_bits",
+    "verify_proof",
 ]
 
 
@@ -85,11 +106,11 @@ def build_fabric_executor(
             nproc = 1 if nproc is None else nproc
             pid = 0 if pid is None else pid
     plan = plan_library([info for _, info in items], nproc, unit_bytes)
+    cfg = config or FabricConfig()
     if transport is None:
         if heartbeat_dir is not None:
             # purge heartbeat files older than the lapse window so a
             # reused dir can't feed this run the previous run's verdicts
-            cfg = config or FabricConfig()
             transport = FileHeartbeat(
                 heartbeat_dir, pid, purge_stale_s=cfg.lapse_after
             )
@@ -106,7 +127,11 @@ def build_fabric_executor(
                     f"{jax.process_count()}); pass heartbeat_dir for the "
                     "shared-filesystem transport instead"
                 )
-            transport = AllgatherHeartbeat(nproc, pid, plan_payload_bytes(plan))
+            # the receipt plane's root/evidence keys only exist at
+            # byzantine_f > 0, and the buffer budget tracks that
+            transport = AllgatherHeartbeat(
+                nproc, pid, plan_payload_bytes(plan, cfg.byzantine_f)
+            )
     return FabricExecutor(
         items,
         plan,

@@ -50,6 +50,30 @@ all heartbeat loops stop on the same round (the collective transport
 requires exactly this). The final per-unit verdict is picked by the
 same deterministic rule everywhere (lowest acceptable publisher pid),
 so :meth:`FabricExecutor.bitfields` is identical on every process.
+
+Byzantine layer (``FabricConfig.byzantine_f > 0``). The sentinel path
+above tolerates *one* liar per adopted unit; ``byzantine_f = f`` turns
+the fabric into a plane spanning untrusted machines. Each unit is
+verified by ``f + 1`` processes up front (:func:`~torrent_tpu.fabric.
+plan.replica_owners`), every published verdict carries a Merkle
+receipt root (``fabric/receipts.py``: leaf = ``(unit, piece, digest,
+ok)``; the root rides the heartbeat, bounded proofs are served on
+demand so AllgatherHeartbeat budgets stay fixed), and a unit only
+counts as covered once ``f + 1`` publishers committed *byte-identical*
+verdicts. Liars are convicted three ways, all symmetric: a root that
+doesn't match its published bits (or two roots for one unit) is a
+free structural conviction on every process; each round every process
+re-hashes a seeded pseudo-random slice of every peer's claimed-ok
+pieces (:func:`~torrent_tpu.fabric.receipts.audit_sample` — the
+schedule is a pure function of plan fingerprint + seed, so audits
+replay bit-identically) and a mismatch convicts with portable
+``(peer, unit, piece)`` evidence that rides the heartbeat and is
+re-verified locally by every receiver; and a distrust pair published
+by ``f + 1`` distinct accusers convicts without local proof (at most
+``f`` of them can be lying). A convicted liar's units re-enter the
+existing adoption/top-up path. At ``f = 0`` none of this exists on
+the wire: behavior and heartbeat bytes are bit-identical to the
+pre-receipt fabric (pinned by test).
 """
 
 from __future__ import annotations
@@ -63,7 +87,13 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from torrent_tpu.fabric.plan import FabricPlan, adoption_owner
+from torrent_tpu.fabric.plan import FabricPlan, adoption_owner, replica_owners
+from torrent_tpu.fabric.receipts import (
+    audit_sample,
+    merkle_proof,
+    merkle_root,
+    unit_leaves,
+)
 from torrent_tpu.obs.fleet import DIGEST_MAX_BYTES, aggregate_fleet, obs_digest
 from torrent_tpu.obs.ledger import pipeline_ledger
 from torrent_tpu.obs.recorder import flight_recorder
@@ -131,6 +161,27 @@ class FabricConfig:
     # File transport only (an extra collective round would break the
     # allgather lockstep — and a dead peer wedges it anyway).
     fault_exit_after_units: int | None = None
+    # ---- Byzantine verdict layer (fabric/receipts.py) ----
+    # lying processes tolerated. 0 = the single-sentinel fast path:
+    # behavior AND heartbeat bytes bit-identical to the pre-receipt
+    # fabric (pinned by test). f > 0: f + 1 replicas verify each unit,
+    # every published verdict commits a Merkle receipt root on the
+    # heartbeat, claims are audit-sampled each round, and coverage
+    # requires f + 1 byte-identical receipts (see module docstring)
+    byzantine_f: int = 0
+    # per-(peer, unit, piece, round) audit probability at f > 0 — the
+    # draw is deterministic given (plan fingerprint, audit_seed), so a
+    # run's audit schedule replays bit-identically. Must be > 0 when
+    # byzantine_f > 0: audits are the only way conflicting honest
+    # verdicts (divergent storage) ever resolve
+    audit_rate: float = 0.05
+    audit_seed: int = 0
+    # TEST/FAULT HOOK (doctor --byzantine, --fault-plan
+    # forge_receipts=1): claim every piece of our own units verified-ok
+    # regardless of what hashing said, with a CONSISTENT receipt root
+    # over the forged bits — the structural check passes, so only
+    # audit re-hashing (or the f = 0 sentinel) can convict this liar
+    forge_receipts: bool = False
 
 
 FAULT_EXIT_CODE = 42  # fault_exit_after_units exits with this
@@ -269,21 +320,31 @@ class AllgatherHeartbeat:
         return peers
 
 
-def plan_payload_bytes(plan: FabricPlan) -> int:
+def plan_payload_bytes(plan: FabricPlan, byzantine_f: int = 0) -> int:
     """Allgather buffer size for a plan: the worst-case heartbeat is
     every unit's verdict bits (hex doubles the packed bytes) plus
     per-unit JSON overhead, a distrust/redone list that can hold one
     entry per (publisher, unit) pair, a fixed envelope, and the
     worst-case fleet obs digest (clamped to DIGEST_MAX_BYTES by
-    construction, so the budget term is exact)."""
+    construction, so the budget term is exact). At ``byzantine_f > 0``
+    the budget grows by the receipt plane's worst case — one 40-hex
+    Merkle root per published unit plus conviction-evidence triples —
+    and ONLY then: the default keeps every ``f = 0`` caller's buffer
+    byte-identical to the pre-receipt sizing."""
     bits_hex = sum((u.npieces + 7) // 8 * 2 for u in plan.units)
-    return (
+    base = (
         4096
         + DIGEST_MAX_BYTES
         + bits_hex
         + 48 * len(plan.units)
         + 24 * len(plan.units) * plan.nproc  # distrust pairs, worst case
     )
+    if byzantine_f > 0:
+        base += (
+            56 * len(plan.units)  # "uid": 40-hex root + JSON overhead
+            + 24 * len(plan.units) * plan.nproc  # evidence triples
+        )
+    return base
 
 
 _PENDING, _INFLIGHT, _DONE = "pending", "inflight", "done"
@@ -308,11 +369,21 @@ class FabricExecutor:
             raise ValueError(f"pid {pid} outside plan's {plan.nproc} processes")
         if transport is None and plan.nproc > 1:
             raise ValueError("multi-process plan needs a heartbeat transport")
+        cfg = config or FabricConfig()
+        if cfg.byzantine_f < 0:
+            raise ValueError(f"byzantine_f must be >= 0, got {cfg.byzantine_f}")
+        if cfg.byzantine_f > 0 and not 0.0 < cfg.audit_rate <= 1.0:
+            # audits are the only resolution path for conflicting honest
+            # verdicts, so a zero rate at f > 0 can deadlock coverage
+            raise ValueError(
+                f"audit_rate must be in (0, 1] when byzantine_f > 0, "
+                f"got {cfg.audit_rate}"
+            )
         self.items = items
         self.plan = plan
         self.pid = pid
         self.scheduler = scheduler
-        self.config = config or FabricConfig()
+        self.config = cfg
         self.transport = transport
         self.progress_cb = progress_cb
         self._fp = plan.fingerprint()
@@ -321,8 +392,23 @@ class FabricExecutor:
         # and the heartbeat span context stays inside the analysis
         # plane's determinism pass
         self._trace_id = fabric_trace_id(self._fp, pid)
-        # local work state
-        self._queue: deque[int] = deque(u.uid for u in plan.units_for(pid))
+        # local work state. At byzantine_f > 0 the queue widens from the
+        # planned shard to every unit whose replica set (f + 1 pids in
+        # ring order from the owner) includes us, so quorum coverage
+        # doesn't wait on top-up elections in the happy path; f = 0
+        # keeps the exact single-owner queue.
+        if cfg.byzantine_f > 0:
+            mine = [
+                u.uid
+                for u in plan.units
+                if pid
+                in replica_owners(
+                    u.uid, plan.owner[u.uid], plan.nproc, cfg.byzantine_f
+                )
+            ]
+        else:
+            mine = [u.uid for u in plan.units_for(pid)]
+        self._queue: deque[int] = deque(mine)
         self._status: dict[int, str] = {u: _PENDING for u in self._queue}
         # verdicts per (unit, publisher): own results live under our own
         # pid; peers' published results are merged in. The deterministic
@@ -343,6 +429,36 @@ class FabricExecutor:
         # merge skips them so stale heartbeat files can't resurrect a
         # superseded rejection
         self._superseded: set[tuple[int, int]] = set()
+        # ---- Byzantine verdict layer (byzantine_f > 0) ----
+        # first root each publisher committed per unit: a SECOND,
+        # different root for the same (publisher, unit) is equivocation
+        # — a free conviction, no re-hash needed
+        self._peer_roots: dict[tuple[int, int], str] = {}
+        self._roots_checked: set[tuple[int, int]] = set()
+        self._root_cache: dict[tuple[int, str], str] = {}
+        # audit plane: (peer, unit, piece) claims already re-hashed; our
+        # own portable conviction evidence rides the heartbeat "evid"
+        # field and is re-verified locally by every receiver
+        self._audited: set[tuple[int, int, int]] = set()
+        self._evidence: list[tuple[int, int, int]] = []
+        self._evid_seen: set[tuple[int, int, int]] = set()
+        # accusation quorum: distrust pairs by distinct peer accuser —
+        # f + 1 accusers convict even without local evidence (at most f
+        # of them can be lying)
+        self._accusations: dict[tuple[int, int], set[int]] = {}
+        # units stuck short of quorum with no untainted verifier left
+        # (honest disagreement = divergent storage): after a few rounds
+        # the quorum requirement is waived — loudly — so the sweep
+        # terminates instead of wedging
+        self._quorum_stuck: dict[int, int] = {}
+        self._quorum_waived: set[int] = set()
+        # False while convictions/evidence recorded since the last
+        # successful exchange have not yet ridden a heartbeat: the loop
+        # must not stop on a round whose MERGE convicted someone, or the
+        # evidence never reaches peers (heartbeat files outlive their
+        # writer, so one flushing exchange is enough). Always True at
+        # f = 0 — termination is bit-identical to the pre-receipt fabric
+        self._trust_flushed = True
         self._yielded: dict[int, float] = {}  # uid -> yield time
         # autopilot rebalancing: unstarted units currently OFFERED to
         # peers with headroom (rides the heartbeat "offer" field; every
@@ -364,6 +480,12 @@ class FabricExecutor:
         self._pieces_verified = 0
         self._sentinel_checks = 0
         self._sentinel_mismatches = 0
+        self._audit_checks = 0
+        self._audit_mismatches = 0
+        self._convictions = 0
+        self._evidence_rejected = 0
+        self._quorum_verifies = 0
+        self._quorum_waivers = 0
         self._stragglers = 0
         self._hb_errors = 0
         self._hb_consec_fail = 0
@@ -390,11 +512,51 @@ class FabricExecutor:
             if self.pid in pubs
         }
 
+    def _quorum_groups(self, uid: int, published_only: bool) -> dict[str, list[int]]:
+        """Non-distrusted publishers of a unit grouped by EXACT verdict
+        bytes (``pack_bits``): the quorum rule counts *matching*
+        receipts, so two publishers differing on one piece are distinct
+        claims. Pure function of exchanged state (determinism-pass
+        scope), so every process groups identically."""
+        groups: dict[str, list[int]] = {}
+        for p in sorted(self._verdicts.get(uid, ())):
+            if (p, uid) in self._distrust:
+                continue
+            if published_only and p == self.pid and uid not in self._published_done:
+                continue
+            groups.setdefault(pack_bits(self._verdicts[uid][p]), []).append(p)
+        return groups
+
+    def _unit_need(self, uid: int) -> int:
+        """Matching receipts required to cover a unit: ``f + 1``,
+        clamped to the processes still eligible to publish it (not
+        distrusted on this unit) — convictions must shrink the quorum
+        or a single convicted liar could wedge termination at small
+        nproc. Symmetric: the distrust set is exchanged state."""
+        if self.config.byzantine_f == 0:
+            return 1
+        eligible = sum(
+            1
+            for p in range(self.plan.nproc)
+            if (p, uid) not in self._distrust
+        )
+        return max(1, min(self.config.byzantine_f + 1, eligible))
+
     def _unit_covered(self, uid: int, published_only: bool = False) -> bool:
-        """An acceptable (non-distrusted) verdict exists for the unit.
+        """An acceptable verdict exists for the unit: at ``f = 0`` any
+        non-distrusted verdict; at ``f > 0`` a quorum of ``f + 1``
+        byte-identical receipts (``_unit_need``-clamped; quorum-waived
+        units fall back to the f = 0 rule so divergent-storage
+        disagreement terminates instead of wedging).
         ``published_only`` restricts our OWN verdicts to those already
         exchanged — the symmetric form every process evaluates equally,
         so heartbeat loops all stop on the same round."""
+        if self.config.byzantine_f > 0 and uid not in self._quorum_waived:
+            need = self._unit_need(uid)
+            return any(
+                len(ps) >= need
+                for ps in self._quorum_groups(uid, published_only).values()
+            )
         for p in self._verdicts.get(uid, ()):
             if (p, uid) in self._distrust:
                 continue
@@ -418,12 +580,26 @@ class FabricExecutor:
         Per unit, the verdict used is the lowest-pid publisher whose
         (publisher, unit) pair is not distrusted — a pure function of
         exchanged state, so every process assembles the identical global
-        bitfield once run() returns."""
+        bitfield once run() returns. At ``byzantine_f > 0`` a quorum
+        group (>= ``_unit_need`` publishers with byte-identical bits)
+        outranks any lone verdict; among qualifying groups the one with
+        the lowest member pid wins — still a pure function of exchanged
+        state."""
         out = [np.zeros(info.num_pieces, dtype=bool) for _, info in self.items]
         for u in self.plan.units:
             pubs = self._verdicts.get(u.uid)
             if not pubs:
                 continue
+            if self.config.byzantine_f > 0:
+                need = self._unit_need(u.uid)
+                quorum = sorted(
+                    (min(ps), key)
+                    for key, ps in self._quorum_groups(u.uid, False).items()
+                    if len(ps) >= need
+                )
+                if quorum:
+                    out[u.torrent][u.start : u.stop] = pubs[quorum[0][0]]
+                    continue
             ok = [p for p in sorted(pubs) if (p, u.uid) not in self._distrust]
             pick = ok[0] if ok else sorted(pubs)[0]
             out[u.torrent][u.start : u.stop] = pubs[pick]
@@ -573,6 +749,12 @@ class FabricExecutor:
             futs.append((fut, ck.keep, nb))
         while futs:
             await drain_one()
+        if self.config.forge_receipts:
+            # TEST/FAULT HOOK: lie — claim the whole unit verified-ok.
+            # The receipt root is computed over these forged bits, so
+            # the commitment is self-consistent and only an audit
+            # re-hash (or the f = 0 sentinel) can convict us.
+            bits[:] = True
         self._verdicts.setdefault(uid, {})[self.pid] = bits
         self._status[uid] = _DONE
         self._units_done += 1
@@ -621,7 +803,14 @@ class FabricExecutor:
                         "consecutive exchanges; aborting the sweep"
                     )
                     return
-            if self._covered_published():
+            # at f > 0 a round's merge can convict a publisher — which
+            # both completes our coverage (the convicted pair leaves the
+            # quorum denominator) and records evidence the payload built
+            # BEFORE the merge never carried. Stopping here would strand
+            # that evidence locally; peers would waive quorum instead of
+            # convicting the same liar. One more flushing round fixes it
+            # (heartbeat files outlive their writer). Vacuous at f = 0.
+            if self._covered_published() and self._trust_flushed:
                 return
             await asyncio.sleep(self.config.heartbeat_interval)
 
@@ -651,6 +840,8 @@ class FabricExecutor:
             # rebalance actuator is on and the straggler streak fired)
             "offer": sorted(self._offered),
         }
+        if self.config.byzantine_f > 0:
+            payload.update(self._receipt_payload(own))
         if self.config.carry_obs_digest:
             payload["obs"] = self._build_obs_digest()
         try:
@@ -680,10 +871,32 @@ class FabricExecutor:
                 self._peer_advance[p] = (seq, time.monotonic())
             for pair in pl.get("distrust", []):
                 pair = (int(pair[0]), int(pair[1]))
-                if pair not in self._superseded:
-                    self._distrust.add(pair)
+                if self.config.byzantine_f == 0:
+                    # f = 0: peers are trusted reporters — merge blindly
+                    # (the pre-receipt fast path, bit-identical)
+                    if pair not in self._superseded:
+                        self._distrust.add(pair)
+                elif p != pair[0]:
+                    # f > 0: a bare distrust pair is an ACCUSATION, not
+                    # a verdict — f liars could otherwise evict honest
+                    # publishers by gossip alone. Conviction needs local
+                    # proof (structural check, audit, or re-verified
+                    # evidence) or f + 1 distinct accusers
+                    # (_audit_round); self-accusations never count.
+                    self._accusations.setdefault(pair, set()).add(p)
         await self._merge_and_adopt()
         self._check_stragglers()
+        if self.config.byzantine_f > 0:
+            # the merge above may have convicted (audit/evidence/
+            # structural) AFTER this round's payload was built — those
+            # verdicts must still ride a future heartbeat before the
+            # loop may stop (see _heartbeat_loop)
+            self._trust_flushed = (
+                payload["distrust"]
+                == sorted([p, u] for p, u in self._distrust)
+                and payload.get("evid", [])
+                == sorted([p, u, pc] for p, u, pc in self._evidence)
+            )
         return True
 
     @staticmethod
@@ -802,6 +1015,11 @@ class FabricExecutor:
                     self._checked.discard(pair)
                     self._verdicts.get(pair[1], {}).pop(p, None)
                     self._superseded.add(pair)
+                    # a legitimate re-verification publishes NEW bits
+                    # under a NEW root: forget the old commitment so the
+                    # equivocation check doesn't convict the redo
+                    self._peer_roots.pop(pair, None)
+                    self._roots_checked.discard(pair)
             for uid_s, hexbits in pl.get("done", {}).items():
                 uid = int(uid_s)
                 if p in self._verdicts.get(uid, ()):
@@ -811,6 +1029,13 @@ class FabricExecutor:
                 except (ValueError, IndexError):
                     continue
                 self._verdicts.setdefault(uid, {})[p] = bits
+        # 1a. Byzantine verdict layer: structural receipt checks, peer
+        # evidence re-verification, accusation quorum, audit sampling —
+        # BEFORE the adoption phases so this round's convictions feed
+        # the same round's orphan set (symmetric conviction → symmetric
+        # re-verification).
+        if cfg.byzantine_f > 0:
+            await self._audit_round()
         # 1b. cross-check foreign verdicts held from any UNAVAILABLE
         # publisher — including ones accepted while it was still healthy
         # (the lapse came later): one sentinel re-hash per (publisher,
@@ -960,6 +1185,12 @@ class FabricExecutor:
                     uid, owner,
                     "lapsed" if owner in lapsed else "degraded/distrusted",
                 )
+        # 5. Byzantine quorum top-up: a unit whose replicas have all
+        # published (or lapsed / been convicted) but whose best matching
+        # receipt group is still short of f + 1 needs MORE independent
+        # verifiers — elected deterministically from the survivors.
+        if cfg.byzantine_f > 0:
+            self._quorum_topup(survivors, unavailable, inflight_elsewhere)
 
     async def _sentinel_check(self, uid: int, bits: np.ndarray) -> bool:
         """Re-hash one reportedly-valid piece of a foreign unit against
@@ -971,7 +1202,16 @@ class FabricExecutor:
         if not len(true_rows):
             return True
         piece = unit.start + int(true_rows[0])
-        storage, info = self.items[unit.torrent]
+        self._sentinel_checks += 1
+        return await self._rehash_piece(unit.torrent, piece)
+
+    async def _rehash_piece(self, torrent: int, piece: int) -> bool:
+        """Local ground truth for one piece: read + CPU sha1 against the
+        info dict. Shared by the f = 0 sentinel gate and the f > 0
+        audit/evidence paths, so every trust decision rests on the same
+        primitive — and the work is ledger-accounted like any other
+        pipeline stage entry."""
+        storage, info = self.items[torrent]
 
         def rehash() -> bool:
             import hashlib
@@ -980,8 +1220,6 @@ class FabricExecutor:
             from torrent_tpu.storage.piece import piece_length
             from torrent_tpu.storage.storage import StorageError
 
-            # sentinel work is real pipeline work: account the read and
-            # the CPU re-hash to the ledger like any other stage entry
             led = pipeline_ledger()
             try:
                 with led.track("read") as tracked:
@@ -996,8 +1234,292 @@ class FabricExecutor:
                 and digest == info.pieces[piece]
             )
 
-        self._sentinel_checks += 1
         return await asyncio.to_thread(rehash)
+
+    # --------------------------------------- Byzantine layer (f > 0)
+
+    def _unit_root(self, uid: int, bits: np.ndarray) -> str:
+        """Merkle receipt root for one unit's verdict bits, cached by
+        packed-bits value (publishers re-commit the same root every
+        round). The leaf set is a pure function of the bits plus the
+        torrent's expected piece digests, so ANY process can recompute
+        any publisher's root — which is what makes a forged root a
+        free structural conviction. Exchanged bytes: determinism-pass
+        scope."""
+        key = (uid, pack_bits(bits))
+        root = self._root_cache.get(key)
+        if root is None:
+            unit = self.plan.units[uid]
+            _, info = self.items[unit.torrent]
+            digests = [
+                info.pieces[p].hex() for p in range(unit.start, unit.stop)
+            ]
+            root = merkle_root(unit_leaves(uid, unit.start, bits, digests))
+            self._root_cache[key] = root
+        return root
+
+    def _receipt_payload(self, own: dict[int, np.ndarray]) -> dict:
+        """Byzantine additions to the heartbeat payload — f > 0 ONLY
+        (at f = 0 these keys are absent and the heartbeat stays
+        bit-identical to the pre-receipt fabric, pinned by test): a
+        receipt root per own published unit, and our portable
+        conviction evidence. Exchanged bytes: determinism-pass
+        scope."""
+        return {
+            "root": {
+                str(uid): self._unit_root(uid, bits)
+                for uid, bits in sorted(own.items())
+            },
+            "evid": sorted([p, u, pc] for p, u, pc in self._evidence),
+        }
+
+    def receipt_proof(self, uid: int, piece: int) -> dict:
+        """Bounded Merkle proof for one leaf of OUR OWN unit receipt —
+        served on demand (log(npieces) siblings) rather than on the
+        heartbeat, so AllgatherHeartbeat buffer budgets stay fixed no
+        matter how many proofs are requested."""
+        if not 0 <= uid < len(self.plan.units):
+            raise KeyError(f"no local verdict for unit {uid}")
+        unit = self.plan.units[uid]
+        bits = self._verdicts.get(uid, {}).get(self.pid)
+        if bits is None:
+            raise KeyError(f"no local verdict for unit {uid}")
+        if not unit.start <= piece < unit.stop:
+            raise IndexError(
+                f"piece {piece} outside unit {uid}'s span "
+                f"[{unit.start}, {unit.stop})"
+            )
+        _, info = self.items[unit.torrent]
+        digests = [info.pieces[p].hex() for p in range(unit.start, unit.stop)]
+        leaves = unit_leaves(uid, unit.start, bits, digests)
+        i = piece - unit.start
+        return {
+            "uid": uid,
+            "piece": piece,
+            "index": i,
+            "nleaves": len(leaves),
+            "leaf": leaves[i].hex(),
+            "ok": bool(bits[i]),
+            "path": merkle_proof(leaves, i),
+            "root": self._unit_root(uid, bits),
+        }
+
+    def _convict(
+        self, p: int, uid: int, piece: int, kind: str, local: bool = True
+    ) -> None:
+        """Convict a (publisher, unit) pair on receipt evidence. The
+        pair-membership guard makes the flight dump exactly-once per
+        pair per process. ``local=False`` marks gossip-derived
+        convictions (accusation quorum), which must not resurrect a
+        superseded pair — local proof may, because fresh evidence about
+        a re-published verdict is fresh truth."""
+        pair = (p, uid)
+        if pair in self._distrust:
+            return
+        if pair in self._superseded:
+            if not local:
+                return
+            self._superseded.discard(pair)
+        self._distrust.add(pair)
+        self._convictions += 1
+        if piece >= 0:
+            ev = (p, uid, piece)
+            self._evid_seen.add(ev)
+            if ev not in self._evidence:
+                self._evidence.append(ev)
+        log.warning(
+            "fabric byzantine: convicting peer %d on unit %d (%s%s)",
+            p, uid, kind, f", piece {piece}" if piece >= 0 else "",
+        )
+        flight_recorder().trigger(
+            "fabric_distrust",
+            detail={
+                "peer": p,
+                "unit": uid,
+                "pid": self.pid,
+                "piece": piece,
+                "kind": kind,
+            },
+            trace_ids=(self._trace_id,),
+            snapshots={"fabric": self.metrics_snapshot()},
+        )
+
+    async def _audit_round(self) -> None:
+        """One round of the Byzantine verdict layer, after the verdict
+        merge and before adoption (so convictions feed the same round's
+        orphan set). Four sub-passes, each over sorted state so every
+        process walks them identically:
+
+        * **structural** — a published root must equal the root
+          recomputed from the published bits, and a publisher must
+          never commit two different roots for one unit
+          (equivocation). Both are visible to every process for free.
+        * **evidence** — peers' (peer, unit, piece) conviction
+          evidence is re-verified LOCALLY (the accused's merged bits
+          claim the piece ok; our re-hash says bad) before we convict.
+        * **accusation quorum** — a pair accused by >= f + 1 distinct
+          peers convicts without local proof: at most f can be lying.
+        * **audits** — re-hash this round's seeded pseudo-random slice
+          of every peer's claimed-ok pieces (receipts.audit_sample);
+          an actually-bad claimed-ok piece convicts with portable
+          evidence.
+        """
+        cfg = self.config
+        # structural: roots vs published bits, and equivocation
+        for p in sorted(self._peer_seen):
+            roots = self._peer_seen[p].get("root")
+            if not isinstance(roots, dict):
+                continue
+            for uid_s in sorted(roots):
+                try:
+                    uid = int(uid_s)
+                    self.plan.units[uid]
+                except (ValueError, IndexError):
+                    continue
+                root = roots[uid_s]
+                pair = (p, uid)
+                prev = self._peer_roots.get(pair)
+                if prev is None:
+                    self._peer_roots[pair] = root
+                elif prev != root:
+                    self._convict(p, uid, -1, "equivocation")
+                    continue
+                if pair in self._roots_checked or pair in self._distrust:
+                    continue
+                bits = self._verdicts.get(uid, {}).get(p)
+                if bits is None:
+                    continue  # bits not merged yet: re-check next round
+                self._roots_checked.add(pair)
+                if self._unit_root(uid, bits) != root:
+                    self._convict(p, uid, -1, "forged-root")
+        # evidence: re-verify peers' conviction evidence locally
+        for p in sorted(self._peer_seen):
+            for ev in self._peer_seen[p].get("evid", []):
+                try:
+                    acc, uid, piece = int(ev[0]), int(ev[1]), int(ev[2])
+                    unit = self.plan.units[uid]
+                except (ValueError, TypeError, IndexError):
+                    continue
+                key = (acc, uid, piece)
+                if key in self._evid_seen:
+                    continue
+                if not unit.start <= piece < unit.stop:
+                    self._evid_seen.add(key)
+                    self._evidence_rejected += 1
+                    continue
+                bits = self._verdicts.get(uid, {}).get(acc)
+                if bits is None:
+                    continue  # no claim merged yet: retry next round
+                self._evid_seen.add(key)
+                if (acc, uid) in self._distrust:
+                    continue
+                if not bool(bits[piece - unit.start]):
+                    self._evidence_rejected += 1  # claim doesn't say ok
+                    continue
+                if await self._rehash_piece(unit.torrent, piece):
+                    self._evidence_rejected += 1  # piece is fine
+                    continue
+                self._convict(acc, uid, piece, "evidence")
+        # accusation quorum: f + 1 distinct accusers convict
+        for pair in sorted(self._accusations):
+            if len(self._accusations[pair]) >= cfg.byzantine_f + 1:
+                self._convict(pair[0], pair[1], -1, "accusation-quorum",
+                              local=False)
+        # audits: this round's sample of every peer's claimed-ok pieces
+        for uid in sorted(self._verdicts):
+            unit = self.plan.units[uid]
+            for p in sorted(self._verdicts[uid]):
+                if p == self.pid or (p, uid) in self._distrust:
+                    continue
+                bits = self._verdicts[uid][p]
+                for i in np.flatnonzero(bits):
+                    piece = unit.start + int(i)
+                    key = (p, uid, piece)
+                    if key in self._audited:
+                        continue
+                    if not audit_sample(
+                        self._fp, cfg.audit_seed, self._seq,
+                        p, uid, piece, cfg.audit_rate,
+                    ):
+                        continue
+                    self._audited.add(key)
+                    self._audit_checks += 1
+                    if not await self._rehash_piece(unit.torrent, piece):
+                        self._audit_mismatches += 1
+                        self._convict(p, uid, piece, "audit")
+                        break  # one bad leaf retires the whole pair
+
+    def _quorum_topup(
+        self, survivors, unavailable: set[int], inflight_elsewhere: set[int]
+    ) -> None:
+        """Elect extra verifiers for units short of quorum. Only fires
+        once a unit's normal pipeline has run dry — every replica owner
+        has published, lapsed, or been convicted — so the happy path
+        never double-assigns. The election (rotation over sorted
+        candidates by uid) is a pure function of exchanged state, so
+        every process elects the same helpers. A unit with NO untainted
+        candidate left (honest publishers disagreeing: divergent
+        storage) gets its quorum requirement waived after a few rounds
+        — loudly — so the sweep terminates instead of wedging."""
+        f = self.config.byzantine_f
+        for u in self.plan.units:
+            uid = u.uid
+            if self._unit_covered(uid):
+                self._quorum_stuck.pop(uid, None)
+                continue
+            pubs = sorted(self._verdicts.get(uid, ()))
+            replicas = replica_owners(uid, self.plan.owner[uid], self.plan.nproc, f)
+            waiting = any(
+                r not in unavailable
+                and (r, uid) not in self._distrust
+                and r not in pubs
+                for r in replicas
+            )
+            if waiting or uid in inflight_elsewhere or uid in self._yielded:
+                continue
+            groups = self._quorum_groups(uid, False)
+            best = max((len(ps) for ps in groups.values()), default=0)
+            missing = self._unit_need(uid) - best
+            if missing <= 0:
+                continue
+            candidates = [
+                s
+                for s in sorted(survivors)
+                if (s, uid) not in self._distrust and s not in pubs
+            ]
+            if not candidates:
+                first = self._quorum_stuck.setdefault(uid, self._seq)
+                if (
+                    self._seq - first >= 3
+                    and uid not in self._quorum_waived
+                    and best > 0
+                ):
+                    self._quorum_waived.add(uid)
+                    self._quorum_waivers += 1
+                    log.error(
+                        "fabric quorum: unit %d stuck at %d/%d matching "
+                        "receipts with no untainted verifier left "
+                        "(publishers disagree — divergent storage?); "
+                        "waiving quorum so the sweep terminates",
+                        uid, best, self._unit_need(uid),
+                    )
+                continue
+            self._quorum_stuck.pop(uid, None)
+            k = min(missing, len(candidates))
+            helpers = sorted(
+                candidates[(uid + j) % len(candidates)] for j in range(k)
+            )
+            if self.pid not in helpers:
+                continue
+            if self._status.get(uid) in (_PENDING, _INFLIGHT, _DONE):
+                continue
+            self._status[uid] = _PENDING
+            self._queue.append(uid)
+            self._quorum_verifies += 1
+            log.warning(
+                "fabric quorum: joining unit %d (best %d/%d matching "
+                "receipts)", uid, best, self._unit_need(uid),
+            )
 
     def _refresh_degraded(self) -> None:
         """Self-diagnose a stuck-open sha1 lane breaker from the
@@ -1050,6 +1572,13 @@ class FabricExecutor:
             "stragglers": self._stragglers,
             "degraded": self._degraded,
         }
+        if self.config.byzantine_f > 0:
+            # audit/quorum counters ride the digest ONLY at f > 0: at
+            # f = 0 the key set (and so the heartbeat bytes) must stay
+            # bit-identical to the pre-receipt fabric
+            unit["audits"] = self._audit_checks
+            unit["audit_miss"] = self._audit_mismatches
+            unit["convict"] = self._convictions
         return obs_digest(
             scheduler=self.scheduler, base=self._obs_base, unit=unit
         )
@@ -1136,6 +1665,19 @@ class FabricExecutor:
             "inflight_bytes": self._inflight_bytes,
             "sentinel_checks": self._sentinel_checks,
             "sentinel_mismatches": self._sentinel_mismatches,
+            "byzantine_f": self.config.byzantine_f,
+            "quorum_need": (
+                min(self.config.byzantine_f + 1, self.plan.nproc)
+                if self.config.byzantine_f > 0
+                else 1
+            ),
+            "audit_checks": self._audit_checks,
+            "audit_mismatches": self._audit_mismatches,
+            "convictions": self._convictions,
+            "evidence_rejected": self._evidence_rejected,
+            "quorum_verifies": self._quorum_verifies,
+            "quorum_waivers": self._quorum_waivers,
+            "distrusted": sorted({p for p, _ in self._distrust}),
             "stragglers": self._stragglers,
             "heartbeat_errors": self._hb_errors,
             "heartbeat_age": (

@@ -113,6 +113,22 @@ def plan_library(
     return FabricPlan(nproc, tuple(units), tuple(owner))
 
 
+def replica_owners(uid: int, owner: int, nproc: int, byzantine_f: int) -> tuple[int, ...]:
+    """The processes that must independently verify a unit under
+    ``byzantine_f = f``.
+
+    A quorum verdict needs ``f + 1`` matching receipts, so ``f + 1``
+    processes (clamped to ``nproc``) verify each unit up front: the
+    planned owner plus the next ``f`` pids in ring order. Pure function
+    of the plan — every process computes the same replica sets, so the
+    widened assignment needs no coordination, and ``f = 0`` degenerates
+    to exactly ``(owner,)`` (the single-owner fast path)."""
+    if nproc < 1:
+        raise ValueError(f"nproc must be >= 1, got {nproc}")
+    need = min(byzantine_f + 1, nproc)
+    return tuple(sorted((owner + k) % nproc for k in range(need)))
+
+
 def adoption_owner(uid: int, survivors: list[int]) -> int:
     """Which surviving process adopts an orphaned unit.
 

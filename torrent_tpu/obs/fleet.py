@@ -316,7 +316,16 @@ def aggregate_fleet(
     ]
     median = _median(rates)
     scoreboard = []
-    totals = {"pieces_verified": 0, "units_done": 0, "bytes": 0}
+    totals = {
+        "pieces_verified": 0,
+        "units_done": 0,
+        "bytes": 0,
+        # Byzantine receipt plane (digest keys exist only at f > 0,
+        # so these stay 0 on a trusted fabric)
+        "audit_checks": 0,
+        "audit_mismatches": 0,
+        "convictions": 0,
+    }
     for pid in sorted(set(range(nproc)) | set(pids)):
         digest = digests.get(pid) if isinstance(digests.get(pid), dict) else {}
         unit = digest.get("unit") or {}
@@ -345,6 +354,9 @@ def aggregate_fleet(
             "units_adopted": int(unit.get("adopted", 0)),
             "pieces_verified": int(unit.get("pieces", 0)),
             "stragglers": int(unit.get("stragglers", 0)),
+            "audit_checks": int(unit.get("audits", 0)),
+            "audit_mismatches": int(unit.get("audit_miss", 0)),
+            "convictions": int(unit.get("convict", 0)),
             "degraded": bool(unit.get("degraded"))
             or status == "degraded",
             # units a survivor must absorb when this process is out
@@ -358,6 +370,9 @@ def aggregate_fleet(
         totals["pieces_verified"] += row["pieces_verified"]
         totals["units_done"] += row["units_done"]
         totals["bytes"] += rep["pipeline_bytes"] if rep else 0
+        totals["audit_checks"] += row["audit_checks"]
+        totals["audit_mismatches"] += row["audit_mismatches"]
+        totals["convictions"] += row["convictions"]
     # fleet bottleneck: longest activity wall wins (the straggler IS the
     # fleet's critical path); ties toward hotter limiting stage, then
     # lower pid (max keeps the first — lowest — pid on full ties)

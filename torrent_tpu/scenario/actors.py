@@ -32,6 +32,12 @@ import hashlib
 from torrent_tpu.codec.bencode import bencode
 from torrent_tpu.net.types import AnnounceEvent
 
+# Promoted to the live session in PR 17: the scenario plane attacks
+# the SAME AcceptGate class the real accept path runs (virtual ticks
+# here, monotonic seconds there) — re-exported so scenario code keeps
+# its historical import site.
+from torrent_tpu.session.torrent import AcceptGate
+
 __all__ = ["Behavior", "AcceptGate", "build_behaviors", "BEHAVIOR_KINDS"]
 
 
@@ -52,38 +58,6 @@ def _pid(kind: str, gi: int, i: int, salt: int = 0) -> bytes:
 def _ip(kind: str, gi: int, i: int) -> str:
     d = _h("scn-ip", kind, gi, i)
     return f"10.{d[0]}.{d[1]}.{d[2]}"
-
-
-class AcceptGate:
-    """The session accept loop in miniature: ``capacity`` slots, a
-    slot's holder evicted once idle for ``idle_ticks`` virtual ticks.
-    This is the defense slowloris probes: connections that never make
-    progress must be reclaimed, not held forever."""
-
-    def __init__(self, capacity: int, idle_ticks: int):
-        self.capacity = capacity
-        self.idle_ticks = idle_ticks
-        self.slots: dict[tuple, int] = {}  # key -> last activity tick
-        self.evicted_idle = 0
-
-    def connect(self, key: tuple, tick: int) -> bool:
-        if key in self.slots:
-            self.slots[key] = tick
-            return True
-        if len(self.slots) >= self.capacity:
-            return False
-        self.slots[key] = tick
-        return True
-
-    def release(self, key: tuple) -> None:
-        self.slots.pop(key, None)
-
-    def sweep(self, tick: int) -> int:
-        dead = [k for k, last in self.slots.items() if tick - last >= self.idle_ticks]
-        for k in dead:
-            del self.slots[k]
-        self.evicted_idle += len(dead)
-        return len(dead)
 
 
 class Behavior:
@@ -520,11 +494,172 @@ class ForgeBehavior(Behavior):
         return out
 
 
+class ByzantineBehavior(Behavior):
+    """Byzantine receipt publishers against the fabric's Merkle
+    receipt plane (``fabric/receipts.py`` — the SAME pure primitives
+    the live verify fabric exchanges at ``byzantine_f > 0``). The
+    population splits into ``honest_pct`` honest publishers and three
+    liar archetypes by index:
+
+    * **forged-root** — claims every piece ok under a root committed
+      over invented digests; the auditor's ground-truth root
+      recomputation must convict.
+    * **equivocation** — commits two DIFFERENT roots for the same unit
+      across its two ticks; first-root pinning must convict on the
+      second.
+    * **under-hash** — hashed only a prefix of the unit but claims all
+      of it; the root matches its own lazy leaves, so only proof
+      verification against the TRUE leaf catches it.
+
+    Every honest receipt is proof-checked too: a single refuted honest
+    receipt (false refutation) fails the run — zero false convictions
+    is part of the verdict, exactly like the poison plane."""
+
+    kind = "byzantine"
+
+    def setup(self, world) -> None:
+        from torrent_tpu.fabric.receipts import merkle_root
+
+        g = self.group
+        self.pieces = g.param("pieces")
+        self.honest_pct = g.param("honest_pct")
+        self.n_honest = g.count * self.honest_pct // 100
+        self.first_root: dict[tuple[int, int], str] = {}
+        self.convicted: set[int] = set()
+        self.caught: dict[str, int] = {
+            "forged-root": 0, "equivocation": 0, "under-hash": 0,
+        }
+        self.false_refutations = 0
+        self.honest_verified = 0
+        self.receipts = 0
+        self._empty_root = merkle_root([])
+
+    def _mode(self, i: int) -> str:
+        if i < self.n_honest:
+            return "honest"
+        return ("forged-root", "equivocation", "under-hash")[i % 3]
+
+    def _true_digests(self, i: int, unit: int) -> list[str]:
+        return [
+            _h("byz-digest", self.gi, i, unit, j).hex()
+            for j in range(self.pieces)
+        ]
+
+    def step(self, world) -> None:
+        from torrent_tpu.fabric.receipts import (
+            leaf_hash,
+            merkle_proof,
+            merkle_root,
+            verify_proof,
+        )
+
+        # each unit spans two ticks: consistent publishers re-commit
+        # the same root on the second tick, equivocators switch roots —
+        # the only lie that needs history to catch
+        unit = world.tick // 2
+        second_tick = world.tick % 2 == 1
+        for i in range(self.group.count):
+            if i in self.convicted:
+                continue  # convicted publishers are dropped outright
+            mode = self._mode(i)
+            true_digests = self._true_digests(i, unit)
+            true_leaves = [
+                leaf_hash(unit, j, d, True)
+                for j, d in enumerate(true_digests)
+            ]
+            if mode == "forged-root":
+                # all-ok claim over invented digests
+                lied = [
+                    _h("byz-lie", self.gi, i, unit, j).hex()
+                    for j in range(self.pieces)
+                ]
+                leaves = [
+                    leaf_hash(unit, j, d, True) for j, d in enumerate(lied)
+                ]
+            elif mode == "equivocation" and second_tick:
+                # same unit, different committed leaf set → new root
+                leaves = [
+                    leaf_hash(unit, j, _h("byz-equiv", self.gi, i, unit, j).hex(), True)
+                    for j in range(self.pieces)
+                ]
+            elif mode == "under-hash":
+                # hashed only the first piece, claims every piece ok:
+                # the root is self-consistent over its lazy leaves
+                leaves = [true_leaves[0]] + [
+                    leaf_hash(unit, j, "", True)
+                    for j in range(1, self.pieces)
+                ]
+            else:  # honest (and the equivocator's innocent first tick)
+                leaves = true_leaves
+            root = merkle_root(leaves)
+            self.receipts += 1
+            # ---- the auditor (ground truth in hand) ----
+            key = (i, unit)
+            pinned = self.first_root.setdefault(key, root)
+            if pinned != root:
+                self.caught["equivocation"] += 1
+                self.convicted.add(i)
+                continue
+            sample = unit % self.pieces
+            proof = merkle_proof(leaves, sample)
+            proof_ok = verify_proof(
+                true_leaves[sample], sample, len(leaves), proof, root
+            )
+            true_root = merkle_root(true_leaves)
+            if mode == "honest" or (mode == "equivocation" and not second_tick):
+                if root == true_root and proof_ok:
+                    self.honest_verified += 1
+                    world.record_ok()
+                else:
+                    self.false_refutations += 1
+                    world.record_failed()
+            elif root != true_root and sample > 0 and not proof_ok:
+                # under-hash: root recomputation AND the sampled proof
+                # disagree with ground truth (sample 0 is the one piece
+                # it really hashed — wait for a later unit's sample)
+                self.caught[mode] += 1
+                self.convicted.add(i)
+            elif mode == "forged-root" and root != true_root:
+                self.caught[mode] += 1
+                self.convicted.add(i)
+
+    def facts(self, world) -> dict:
+        return {
+            "receipts": self.receipts,
+            "convicted": len(self.convicted),
+            "caught_forged_root": self.caught["forged-root"],
+            "caught_equivocation": self.caught["equivocation"],
+            "caught_under_hash": self.caught["under-hash"],
+            "honest_verified": self.honest_verified,
+            "false_refutations": self.false_refutations,
+        }
+
+    def failures(self, world) -> list[str]:
+        out = []
+        liars = [
+            i for i in range(self.group.count) if self._mode(i) != "honest"
+        ]
+        free = [i for i in liars if i not in self.convicted]
+        if free:
+            out.append(
+                f"{len(free)}/{len(liars)} byzantine publishers escaped "
+                f"conviction (first: {self._mode(free[0])} #{free[0]})"
+            )
+        if self.false_refutations:
+            out.append(
+                f"{self.false_refutations} honest receipts were refuted"
+            )
+        if self.n_honest and not self.honest_verified:
+            out.append("no honest receipt ever verified (auditor inert)")
+        return out
+
+
 BEHAVIOR_KINDS: dict[str, type[Behavior]] = {
     cls.kind: cls
     for cls in (
         HonestBehavior, SybilBehavior, PoisonBehavior, ChurnBehavior,
         SlowlorisBehavior, GhostBehavior, ForgeBehavior,
+        ByzantineBehavior,
     )
 }
 

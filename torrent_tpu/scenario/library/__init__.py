@@ -75,6 +75,31 @@ SCENARIOS: dict[str, str] = {
         "actor=honest:count=16,numwant=30,swarms=4;"
         "actor=forge:count=8,valid_every=4"
     ),
+    # 24 byzantine receipt publishers (a quarter honest bait, the rest
+    # forged-root / equivocating / under-hashing liars by turns)
+    # against the fabric's Merkle receipt primitives: every liar must
+    # be convicted — root recomputation, first-root pinning, sampled
+    # proof verification — and NO honest receipt refuted.
+    "byzantine-fabric": (
+        "name=byzantine-fabric;seed=29;ticks=24;tick_ms=1000;peer_ttl_s=900;"
+        "shards=8;wall_p99_ms=250;short_samples=8;long_samples=24;"
+        "slo=availability=0.999|integrity=on;"
+        "actor=honest:count=32,numwant=30,swarms=4;"
+        "actor=byzantine:count=24,pieces=8,honest_pct=25"
+    ),
+    # the kitchen-sink adversary: sybil stampede + churn storm + piece
+    # poisoners in ONE population — defenses must not regress when the
+    # attacks overlap (clamps hold, occupancy reconciles, every
+    # poisoner convicted, nobody else).
+    "mixed-adversary": (
+        "name=mixed-adversary;seed=31;ticks=30;tick_ms=1000;peer_ttl_s=10;"
+        "shards=8;wall_p99_ms=250;short_samples=8;long_samples=30;"
+        "slo=availability=0.999|integrity=on;"
+        "actor=honest:count=64,numwant=30,swarms=8;"
+        "actor=sybil:count=128,numwant=10000,swarms=2;"
+        "actor=churn:count=256,ghost_pct=5,join_pct=30,stop_pct=20,swarms=16;"
+        "actor=poison:count=4,per_tick=1,swarms=1"
+    ),
 }
 
 

@@ -84,6 +84,16 @@ ACTOR_PARAMS: dict[str, dict[str, tuple[int, int, int]]] = {
     "forge": {
         "valid_every": (4, 1, 100_000),
     },
+    # Byzantine receipt publishers against the verify fabric's Merkle
+    # receipt plane (fabric/receipts.py): forged roots, equivocating
+    # receipts, and under-hashing workers. The ground-truth auditor
+    # must convict every liar (root recomputation, first-root pinning,
+    # proof verification) and refute NO honest receipt. honest_pct of
+    # the population publishes honest receipts as refutation bait.
+    "byzantine": {
+        "pieces": (8, 1, 4096),
+        "honest_pct": (25, 0, 100),
+    },
 }
 
 MAX_ACTOR_GROUPS = 64

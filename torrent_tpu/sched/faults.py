@@ -88,6 +88,12 @@ class FaultPlan:
     read_latency_s: float = 0.0
     # permanent device loss: every launch past this ordinal raises
     dead_after: int | None = None
+    # fabric-level lying worker (doctor --byzantine): the process
+    # publishes forged verify receipts — every piece claimed ok with a
+    # consistent Merkle root. Consumed by the CLI's fabric-verify path
+    # (FabricConfig.forge_receipts), NOT by FaultyPlane: the lie
+    # happens at the verdict layer, above the hash plane
+    forge_receipts: bool = False
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -105,7 +111,7 @@ class FaultPlan:
             key, value = key.strip(), value.strip()
             if key not in (
                 "fail_first", "fail_launches", "payload", "latency_ms",
-                "read_latency_ms", "dead_after",
+                "read_latency_ms", "dead_after", "forge_receipts",
             ):
                 raise ValueError(f"unknown fault-plan key {key!r}")
             try:
@@ -123,6 +129,8 @@ class FaultPlan:
                     kw["read_latency_s"] = float(value) / 1e3
                 elif key == "dead_after":
                     kw["dead_after"] = int(value)
+                elif key == "forge_receipts":
+                    kw["forge_receipts"] = bool(int(value))
             except Exception as e:  # int()/fromhex() failures with context
                 raise ValueError(f"bad fault-plan value {part!r}: {e}") from e
         plan = cls(**kw)

@@ -89,6 +89,57 @@ class TorrentState(Enum):
     SEEDING = "seeding"
 
 
+class AcceptGate:
+    """Admission + idle-reclamation bookkeeping for the accept path:
+    ``capacity`` slots, a slot's holder evicted once idle for
+    ``idle_after`` units of the caller's clock. This is the defense
+    slowloris probes — connections that never make progress must be
+    reclaimed, not held forever.
+
+    Clock-agnostic on purpose: the live session feeds it monotonic
+    seconds (``idle_after`` = ``peer_timeout``) while the scenario
+    plane (``scenario/actors.py``) drives the SAME class with virtual
+    ticks, so the chaos suite exercises exactly the eviction policy
+    production runs."""
+
+    def __init__(self, capacity: int, idle_after: float):
+        self.capacity = capacity
+        self.idle_after = idle_after
+        self.slots: dict = {}  # key -> last activity instant
+        self.evicted_idle = 0
+
+    def connect(self, key, now) -> bool:
+        """Admit (or refresh) ``key``; False when every slot is held."""
+        if key in self.slots:
+            self.slots[key] = now
+            return True
+        if len(self.slots) >= self.capacity:
+            return False
+        self.slots[key] = now
+        return True
+
+    def touch(self, key, now) -> None:
+        """Record activity for an already-admitted key (no-op for
+        unknown keys: the caller's peer map is authoritative)."""
+        if key in self.slots:
+            self.slots[key] = now
+
+    def release(self, key) -> None:
+        self.slots.pop(key, None)
+
+    def sweep(self, now) -> list:
+        """Evict every slot idle past ``idle_after``; returns the
+        evicted keys (admission order — dict order is deterministic)."""
+        dead = [
+            k for k, last in self.slots.items()
+            if now - last >= self.idle_after
+        ]
+        for k in dead:
+            del self.slots[k]
+        self.evicted_idle += len(dead)
+        return dead
+
+
 @dataclass
 class _PartialPiece:
     """A piece being assembled in memory before verification."""
@@ -240,6 +291,13 @@ class Torrent:
         self.state = TorrentState.STOPPED
         self.bitfield = Bitfield(self.info.num_pieces)
         self.peers: dict[bytes, PeerConnection] = {}
+        # slot admission + slowloris idle-reclamation bookkeeping; the
+        # peers dict stays authoritative — the gate mirrors it so the
+        # eviction policy (and its counter) is the same object the
+        # scenario plane attacks
+        self._accept_gate = AcceptGate(
+            self.config.max_peers, self.config.peer_timeout
+        )
         self._partials: dict[int, _PartialPiece] = {}
         # TPU ingest-verification micro-batching (see _verify_piece_data)
         self._verify_pending: list = []
@@ -1316,6 +1374,7 @@ class Torrent:
         peer.ext.enabled = ext.supports_extensions(reserved)
         peer.fast = proto.supports_fast(reserved)
         self.peers[peer_id] = peer
+        self._accept_gate.connect(peer_id, time.monotonic())
         # connection lifecycle telemetry + tracer span (obs/swarm): one
         # deterministic trace per torrent collects connect/drop spans
         self._swarm_obs.peer_connected(
@@ -1388,6 +1447,7 @@ class Torrent:
         if self.peers.get(peer.peer_id) is not peer:
             return  # already dropped (or replaced by a newer connection)
         del self.peers[peer.peer_id]
+        self._accept_gate.release(peer.peer_id)
         self._swarm_obs.peer_dropped(self._obs_key(peer))
         self._recv_flush()  # a departing peer must not strand recv charges
         self._avail -= peer.bitfield.as_numpy()
@@ -3278,7 +3338,8 @@ class Torrent:
 
     async def _idle_sweep_loop(self) -> None:
         """Drop peers silent past ``peer_timeout`` (the per-message
-        ``wait_for`` this replaces — see _peer_loop).
+        ``wait_for`` this replaces — see _peer_loop), with the
+        which-slot-is-dead decision delegated to :class:`AcceptGate`.
 
         Teardown must be unconditional: a graceful ``close()`` waits for
         the transport's send buffer to drain, and a dead peer that
@@ -3295,17 +3356,26 @@ class Torrent:
         interval = max(1.0, self.config.peer_timeout / 4)
         while not self._stopping:
             await asyncio.sleep(interval)
-            cutoff = time.monotonic() - self.config.peer_timeout
-            for p in list(self.peers.values()):
-                if p.last_rx < cutoff:
-                    log.debug("peer %r idle past timeout — dropping", p.peer_id[:8])
-                    transport = getattr(p.writer, "transport", None)
-                    if transport is not None:
-                        try:
-                            transport.abort()
-                        except Exception:
-                            pass
-                    self._drop_peer(p)
+            # the AcceptGate owns the idle-eviction decision (and its
+            # evicted_idle counter — the same object the scenario
+            # plane's slowloris suite attacks); rx activity is synced
+            # here rather than on every message, which is equivalent at
+            # sweep granularity
+            now = time.monotonic()
+            for p in self.peers.values():
+                self._accept_gate.touch(p.peer_id, p.last_rx)
+            for peer_id in self._accept_gate.sweep(now):
+                p = self.peers.get(peer_id)
+                if p is None:
+                    continue
+                log.debug("peer %r idle past timeout — dropping", p.peer_id[:8])
+                transport = getattr(p.writer, "transport", None)
+                if transport is not None:
+                    try:
+                        transport.abort()
+                    except Exception:
+                        pass
+                self._drop_peer(p)
 
     # ------------------------------------------------------------- status
 
@@ -3321,6 +3391,7 @@ class Torrent:
             "state": self.state.value,
             "pieces": f"{self.bitfield.count()}/{self.info.num_pieces}",
             "peers": len(self.peers),
+            "idle_evicted": self._accept_gate.evicted_idle,
             "downloaded": self.downloaded,
             "uploaded": self.uploaded,
             "left": self.left,

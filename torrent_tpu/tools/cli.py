@@ -961,14 +961,17 @@ async def _fabric_verify(args) -> int:
     from torrent_tpu.sched import FaultPlan, HashPlaneScheduler, SchedulerConfig
 
     plane_factory = None
+    forge_receipts = False
     if args.fault_plan:
         # deterministic chaos, same spec language as the bridge and
         # doctor (sched/faults.py) — e.g. latency_ms throttles h2d so
-        # doctor --fleet can prove cross-process bottleneck attribution
+        # doctor --fleet can prove cross-process bottleneck attribution;
+        # forge_receipts=1 turns THIS worker into the Byzantine liar
+        # doctor --byzantine convicts
         try:
-            plane_factory = FaultPlan.parse(args.fault_plan).plane_factory(
-                hasher=args.hasher
-            )
+            fault_plan = FaultPlan.parse(args.fault_plan)
+            forge_receipts = fault_plan.forge_receipts
+            plane_factory = fault_plan.plane_factory(hasher=args.hasher)
         except ValueError as e:
             print(f"error: bad --fault-plan: {e}", file=sys.stderr)
             return 2
@@ -982,6 +985,10 @@ async def _fabric_verify(args) -> int:
         heartbeat_interval=args.heartbeat_interval,
         lapse_after=args.lapse_after,
         fault_exit_after_units=args.die_after_units,
+        byzantine_f=args.byzantine_f,
+        audit_rate=args.audit_rate,
+        audit_seed=args.audit_seed,
+        forge_receipts=forge_receipts,
     )
     executors: list = []
     obs_server = None
@@ -1034,6 +1041,12 @@ async def _fabric_verify(args) -> int:
         "pieces_verified": snap["pieces_verified"],
         "sentinel_checks": snap["sentinel_checks"],
         "sentinel_mismatches": snap["sentinel_mismatches"],
+        "byzantine_f": snap["byzantine_f"],
+        "quorum_need": snap["quorum_need"],
+        "audit_checks": snap["audit_checks"],
+        "audit_mismatches": snap["audit_mismatches"],
+        "convictions": snap["convictions"],
+        "distrusted": snap["distrusted"],
         "stragglers": snap["stragglers"],
         "seconds": res.seconds,
         # this process's pipeline-ledger breakdown (bench fabric embeds
@@ -1215,6 +1228,8 @@ def _cmd_doctor(args) -> int:
         argv.append("--v2")
     if getattr(args, "fabric", False):
         argv.append("--fabric")
+    if getattr(args, "byzantine", False):
+        argv.append("--byzantine")
     if getattr(args, "fleet", False):
         argv.append("--fleet")
     if getattr(args, "lint", False):
@@ -1991,8 +2006,21 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--fault-plan", default=None, metavar="SPEC",
                     help="inject deterministic hash-plane faults "
                     "(sched/faults.py spec, e.g. 'latency_ms=200' to "
-                    "throttle h2d); doctor --fleet uses this to prove "
-                    "cross-process bottleneck attribution")
+                    "throttle h2d, 'forge_receipts=1' to lie at the "
+                    "verdict layer); doctor --fleet / --byzantine use "
+                    "this to prove attribution and conviction")
+    sp.add_argument("--byzantine-f", type=int, default=0, metavar="F",
+                    help="lying processes tolerated: f+1 replicas verify "
+                    "each unit, verdicts carry Merkle receipt roots, "
+                    "claims are audit-sampled, coverage needs f+1 "
+                    "matching receipts (0 = trusted fast path)")
+    sp.add_argument("--audit-rate", type=float, default=0.05,
+                    help="per-(peer,unit,piece,round) audit probability "
+                    "at --byzantine-f > 0 (deterministic given the plan "
+                    "fingerprint + --audit-seed)")
+    sp.add_argument("--audit-seed", type=int, default=0,
+                    help="audit-sampling seed (same seed = bit-identical "
+                    "audit schedule)")
     # deterministic worker-death injection for doctor --fabric / tests
     sp.add_argument("--die-after-units", type=int, default=None,
                     help=argparse.SUPPRESS)
@@ -2072,6 +2100,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also run the verify-fabric self-test: two local "
                     "worker processes plan/execute/heartbeat, one dies "
                     "mid-run, the survivor adopts its shard")
+    sp.add_argument("--byzantine", action="store_true",
+                    help="also run the Byzantine-fabric self-test: two "
+                    "workers at byzantine_f=1, one publishing forged "
+                    "Merkle receipts; the audit plane must convict the "
+                    "liar on both processes with identical bitfields "
+                    "and exactly one fabric_distrust flight dump each")
     sp.add_argument("--fleet", action="store_true",
                     help="also run the fleet-observability smoke: two "
                     "workers, one h2d-throttled; the healthy peer's "

@@ -773,6 +773,139 @@ def _fabric_smoke(tmp: str) -> str:
     )
 
 
+def _byzantine_smoke(tmp: str) -> str:
+    """Byzantine-fabric self-test (``--byzantine``): one 96-piece
+    torrent with ONE genuinely corrupt piece, TWO real fabric-verify
+    workers at ``byzantine_f=1`` / ``audit_rate=1.0``, worker 1 lying
+    via ``--fault-plan forge_receipts=1`` (every piece claimed ok under
+    a consistent Merkle root, so only audit re-hashing can catch it).
+    Worker 0's audit must convict the liar with portable evidence;
+    worker 1 must re-verify that evidence against its own storage and
+    convict ITSELF — symmetric termination: identical exit codes,
+    bit-identical global bitfields rejecting exactly the corrupt piece,
+    the liar in both distrusted sets, and exactly one
+    ``fabric_distrust`` flight dump per process."""
+    import json
+
+    import numpy as np
+
+    from torrent_tpu.tools.make_torrent import make_torrent
+
+    plen = 16384
+    npieces = 96
+    bad_piece = 70
+    rng = np.random.default_rng(3)
+    tdir = os.path.join(tmp, "torrents")
+    ddir = os.path.join(tmp, "data")
+    os.makedirs(tdir)
+    root_dir = os.path.join(ddir, "byz0")
+    os.makedirs(root_dir)
+    payload = os.path.join(root_dir, "payload.bin")
+    with open(payload, "wb") as f:
+        f.write(
+            rng.integers(
+                0, 256, (npieces - 1) * plen + plen // 3, dtype=np.uint8
+            ).tobytes()
+        )
+    with open(os.path.join(tdir, "byz0.torrent"), "wb") as f:
+        f.write(
+            make_torrent(payload, "http://t.invalid/announce", piece_length=plen)
+        )
+    # corrupt one piece AFTER hashing: every honest verdict must reject
+    # exactly this piece, and the forger's all-ok claim about it is the
+    # lie the audit plane has to catch
+    with open(payload, "r+b") as f:
+        f.seek(bad_piece * plen)
+        chunk = f.read(64)
+        f.seek(bad_piece * plen)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    hb = os.path.join(tmp, "hb")
+    env = dict(os.environ)
+    env.pop(_AXON_VAR, None)  # workers must never register a device plugin
+    env["JAX_PLATFORMS"] = "cpu"
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    workers = []
+    for p in range(2):
+        flight = os.path.join(tmp, f"flight_{p}")
+        os.makedirs(flight)
+        cmd = [
+            sys.executable, "-m", "torrent_tpu", "fabric-verify", tdir, ddir,
+            "--hasher", "cpu", "--num-processes", "2", "--process-id", str(p),
+            "--heartbeat-dir", hb, "--heartbeat-interval", "0.1",
+            "--lapse-after", "2.0", "--unit-mb", "1", "--batch-target", "64",
+            "--byzantine-f", "1", "--audit-rate", "1.0",
+            "--result-file", os.path.join(tmp, f"result_{p}.json"),
+        ]
+        if p == 1:
+            cmd += ["--fault-plan", "forge_receipts=1"]
+        wenv = dict(env)
+        wenv["TORRENT_TPU_FLIGHT_DIR"] = flight
+        workers.append(
+            subprocess.Popen(
+                cmd, env=wenv, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+        )
+    codes = []
+    try:
+        for p, w in enumerate(workers):
+            _, err = w.communicate(timeout=180)
+            # one genuinely corrupt piece -> n_valid != n_pieces -> rc 2
+            assert w.returncode == 2, (
+                f"worker {p} should exit 2 (one corrupt piece), got "
+                f"{w.returncode}:\n{err[-2000:]}"
+            )
+            codes.append(w.returncode)
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.communicate()
+    assert codes[0] == codes[1], f"exit-code parity broken: {codes}"
+    recs = []
+    for p in range(2):
+        with open(os.path.join(tmp, f"result_{p}.json")) as f:
+            recs.append(json.load(f))
+    assert recs[0]["bitfields"] == recs[1]["bitfields"], (
+        "global bitfields diverge between the honest worker and the liar"
+    )
+    bits = recs[0]["bitfields"][0]  # "0"/"1" chars, one per piece
+    assert recs[0]["n_valid"] == npieces - 1 and bits[bad_piece] == "0", (
+        f"corrupt piece survived the quorum: {recs[0]['n_valid']}/{npieces}, "
+        f"bit {bits[bad_piece]!r}"
+    )
+    for p, rec in enumerate(recs):
+        assert rec["byzantine_f"] == 1 and rec["quorum_need"] == 2, rec
+        assert 1 in rec["distrusted"], (
+            f"worker {p} never convicted the liar: {rec['distrusted']}"
+        )
+        assert rec["convictions"] >= 1, f"worker {p}: no conviction recorded"
+        dumps = [
+            n for n in os.listdir(os.path.join(tmp, f"flight_{p}"))
+            if n.startswith("blackbox_")
+        ]
+        assert len(dumps) == 1, (
+            f"worker {p}: expected exactly one fabric_distrust flight "
+            f"dump, found {dumps}"
+        )
+        with open(os.path.join(tmp, f"flight_{p}", dumps[0])) as f:
+            dump = json.load(f)
+        assert dump.get("reason") == "fabric_distrust", dump.get("reason")
+    assert recs[0]["audit_checks"] >= 1, "honest worker ran no audits"
+    assert recs[0]["audit_mismatches"] >= 1, (
+        "honest worker audits never caught the forged claim"
+    )
+    return (
+        f"liar convicted on both processes ({recs[0]['audit_checks']}+"
+        f"{recs[1]['audit_checks']} audits, "
+        f"{recs[0]['audit_mismatches']} mismatch); bitfields identical, "
+        f"{recs[0]['n_valid']}/{npieces} pieces valid, 1 flight dump each"
+    )
+
+
 def _fleet_smoke(tmp: str) -> str:
     """Fleet-observability self-test (``--fleet``): two real
     fabric-verify worker subprocesses over the shared-directory
@@ -1467,6 +1600,16 @@ def main(argv=None) -> int:
         "dies mid-run, the survivor adopts and sentinel-checks its shard",
     )
     ap.add_argument(
+        "--byzantine",
+        action="store_true",
+        help="also run the Byzantine-fabric self-test: two worker "
+        "processes at byzantine_f=1, one publishing forged Merkle "
+        "receipts over a genuinely corrupt piece; the audit plane must "
+        "convict the liar with portable evidence on BOTH processes, "
+        "bitfields must stay identical, and each process must dump "
+        "exactly one fabric_distrust flight recording",
+    )
+    ap.add_argument(
         "--fleet",
         action="store_true",
         help="also run the fleet-observability smoke: two worker "
@@ -1673,6 +1816,14 @@ def main(argv=None) -> int:
                 _report("PASS", "verify fabric", detail)
             except Exception as e:
                 _report("FAIL", "verify fabric", repr(e))
+    if args.byzantine:
+        with tempfile.TemporaryDirectory(prefix="doctor_byz_") as tmp:
+            try:
+                # bounded by the workers' communicate(timeout) inside
+                detail = _byzantine_smoke(tmp)
+                _report("PASS", "byzantine fabric", detail)
+            except Exception as e:
+                _report("FAIL", "byzantine fabric", repr(e))
     if args.fleet:
         with tempfile.TemporaryDirectory(prefix="doctor_fleet_") as tmp:
             try:

@@ -271,6 +271,21 @@ def render_fabric_metrics(snapshot: dict) -> str:
         "# HELP torrent_tpu_fabric_sentinel_mismatches_total Foreign verdicts rejected by the sentinel cross-check",
         "# TYPE torrent_tpu_fabric_sentinel_mismatches_total counter",
         f"torrent_tpu_fabric_sentinel_mismatches_total{{{pid}}} {s.get('sentinel_mismatches', 0)}",
+        "# HELP torrent_tpu_fabric_audit_checks_total Peer claimed-ok pieces re-hashed by the Byzantine audit sampler",
+        "# TYPE torrent_tpu_fabric_audit_checks_total counter",
+        f"torrent_tpu_fabric_audit_checks_total{{{pid}}} {s.get('audit_checks', 0)}",
+        "# HELP torrent_tpu_fabric_audit_mismatches_total Audited claimed-ok pieces that re-hashed bad (each files conviction evidence)",
+        "# TYPE torrent_tpu_fabric_audit_mismatches_total counter",
+        f"torrent_tpu_fabric_audit_mismatches_total{{{pid}}} {s.get('audit_mismatches', 0)}",
+        "# HELP torrent_tpu_fabric_quorum_convictions_total (publisher, unit) pairs convicted on receipt evidence (structural, audit, evidence, or accusation quorum)",
+        "# TYPE torrent_tpu_fabric_quorum_convictions_total counter",
+        f"torrent_tpu_fabric_quorum_convictions_total{{{pid}}} {s.get('convictions', 0)}",
+        "# HELP torrent_tpu_fabric_quorum_verifies_total Units this process verified as an elected quorum top-up helper",
+        "# TYPE torrent_tpu_fabric_quorum_verifies_total counter",
+        f"torrent_tpu_fabric_quorum_verifies_total{{{pid}}} {s.get('quorum_verifies', 0)}",
+        "# HELP torrent_tpu_fabric_quorum_need Matching receipts required to cover a unit (byzantine_f + 1, clamped to nproc; 1 = the f=0 sentinel fast path)",
+        "# TYPE torrent_tpu_fabric_quorum_need gauge",
+        f"torrent_tpu_fabric_quorum_need{{{pid}}} {s.get('quorum_need', 1)}",
         "# HELP torrent_tpu_fabric_stragglers_total Units flagged in flight past the straggler threshold",
         "# TYPE torrent_tpu_fabric_stragglers_total counter",
         f"torrent_tpu_fabric_stragglers_total{{{pid}}} {s.get('stragglers', 0)}",

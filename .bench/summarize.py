@@ -99,6 +99,13 @@ def _normalize(rec: dict, artifact: str) -> dict:
                 # ledger already carries the recv-stage breakdown —
                 # a swarm regression must name the wire, not guess
                 "swarm",
+                # the seeder-plane rung schema (bench seed): the crowd
+                # size, block service tail, and the egress fallback
+                # matrix + choke counters ride the banked upload rate —
+                # an upload regression must say whether zero-copy
+                # disengaged, the reactor shed, or rotation stalled
+                "leechers", "block_p50_ms", "block_p99_ms", "blocks",
+                "bytes_up", "serve",
                 # the comparator's full like-for-like shape key
                 "piece_kb", "bytes", "nproc"):
         if key in rec:

@@ -915,6 +915,81 @@ class TestSwarmSnapshotProperties:
             assert v is None or (v == v and abs(v) != float("inf"))
 
 
+# hostile raw serve records for the seeder-plane rollup (ISSUE 19): the
+# same totality contract the swarm builder carries — arbitrary scalars,
+# wrong-typed sub-fields, junk keys must roll up, never crash
+_serve_peer_raw = st.dictionaries(
+    st.sampled_from(
+        ["key", "bytes_up", "blocks", "paths", "rejects", "peers", "junk"]
+    ) | st.text(max_size=5),
+    _swarm_value,
+    max_size=6,
+)
+_serve_rounds = st.dictionaries(
+    st.sampled_from(["counts", "count", "sum", "last", "junk"])
+    | st.text(max_size=5),
+    _swarm_value,
+    max_size=5,
+)
+
+
+class TestServeSnapshotProperties:
+    """ISSUE 19 satellite: the seeder plane's pure rollup is total over
+    hostile inputs — arbitrary raws/totals/paths/rounds produce a
+    well-formed, bounded, deterministic, JSON-safe snapshot."""
+
+    @given(
+        st.dictionaries(
+            st.text(max_size=10) | st.integers(-5, 5),
+            _serve_peer_raw | _swarm_value,
+            max_size=12,
+        ),
+        _serve_peer_raw | _swarm_value,
+        st.dictionaries(st.text(max_size=8), _swarm_value, max_size=6)
+        | _swarm_value,
+        _serve_rounds | _swarm_value,
+    )
+    @settings(max_examples=200, deadline=None)
+    def test_build_serve_snapshot_total(self, peer_raws, totals, paths, rounds):
+        import json
+
+        from torrent_tpu.serve_plane.telemetry import (
+            TOP_PEERS,
+            build_serve_snapshot,
+        )
+
+        snap = build_serve_snapshot(peer_raws, totals, paths, rounds)
+        assert len(snap["peers"]) <= TOP_PEERS
+        assert set(snap["counts"]) == {"serving"}
+        assert set(snap["choke"]) == {"round_s", "round_counts", "last"}
+        text = json.dumps(snap, sort_keys=True, allow_nan=False)
+        assert text == json.dumps(
+            build_serve_snapshot(peer_raws, totals, paths, rounds),
+            sort_keys=True, allow_nan=False,
+        )
+
+    @given(
+        st.dictionaries(
+            st.text(max_size=10), _serve_peer_raw | _swarm_value, max_size=12
+        ),
+        st.dictionaries(st.text(max_size=8), _swarm_value, max_size=6),
+    )
+    @settings(max_examples=100, deadline=None)
+    def test_serve_snapshot_renders_lintable_metrics(self, peer_raws, totals):
+        """The renderer downstream of the builder is total too: any
+        snapshot the builder can produce renders as well-formed
+        Prometheus exposition (the /metrics scrape can never 500)."""
+        import sys
+
+        from torrent_tpu.serve_plane.telemetry import build_serve_snapshot
+        from torrent_tpu.utils.metrics import render_serve_metrics
+
+        sys.path.insert(0, __file__.rsplit("/", 1)[0])
+        from test_metrics import prom_lint
+
+        prom_lint(render_serve_metrics(build_serve_snapshot(peer_raws, totals)))
+
+
 # --------------------------------------------------------------- scenario
 
 

@@ -507,7 +507,14 @@ class TestRendererEdgeCases:
             render_tsan_metrics,
         )
 
+        from torrent_tpu.serve_plane.telemetry import serve_telemetry
+
         pipeline_ledger().record("read", 1024, 0.01)  # ledger series live
+        # activate the serve plane so its families join the payload
+        serve_telemetry().on_egress("concat@1.1.1.1:1", "sendfile", 16384)
+        serve_telemetry().on_choke_round(
+            0.002, unchoked=1, interested=1, optimistic=None, rotated=False
+        )
         sched = HashPlaneScheduler(SchedulerConfig(), hasher="cpu")
         pilot = SchedulerAutopilot(sched, ControlConfig())
         store = ShardedSwarmStore(n_shards=2)
@@ -547,6 +554,25 @@ class TestRendererEdgeCases:
         assert "torrent_tpu_fabric_quorum_convictions_total" in text
         assert "torrent_tpu_fabric_quorum_verifies_total" in text
         assert 'torrent_tpu_fabric_quorum_need{pid="0"} 1' in text
+        # the seeder-plane families ride render_obs_metrics only once
+        # the process has served (tracker-only scrapes stay lean): the
+        # activation above came from the global registry poke
+        assert "torrent_tpu_serve_peers" in text
+        assert 'torrent_tpu_serve_bytes_total{path="sendfile"}' in text
+        assert "torrent_tpu_serve_choke_round_seconds_bucket" in text
+
+    def test_concat_omits_serve_until_active(self):
+        """A process that never served renders NO torrent_tpu_serve_*
+        series (checked on a private registry — the global one may have
+        been activated by other tests in this session)."""
+        from torrent_tpu.serve_plane.telemetry import ServeTelemetry
+        from torrent_tpu.utils.metrics import render_serve_metrics
+
+        reg = ServeTelemetry()
+        assert not reg.active()
+        # the render_obs_metrics gate: active() False → contributes ""
+        text = render_serve_metrics(reg.snapshot())
+        prom_lint(text)  # rendering a fresh one is still well-formed
 
 
 class TestSwarmRenderer:
@@ -601,6 +627,84 @@ class TestSwarmRenderer:
         prom_lint(text)
         assert text.count("torrent_tpu_peer_bytes_down_total{") == TOP_PEERS + 1
         assert 'torrent_tpu_peer_bytes_down_total{peer="overflow"}' in text
+
+
+class TestServeRenderer:
+    """The seeder-plane renderer (serve_plane/telemetry →
+    render_serve_metrics): fresh registries, hostile/partial snapshots,
+    the fixed-label egress/reject families, the choke-round histogram,
+    and the per-peer top-K + overflow contract."""
+
+    def test_fresh_registry_renders_clean(self):
+        from torrent_tpu.serve_plane.telemetry import ServeTelemetry
+        from torrent_tpu.utils.metrics import render_serve_metrics
+
+        text = render_serve_metrics(ServeTelemetry().snapshot())
+        prom_lint(text)
+        assert "torrent_tpu_serve_peers 0" in text
+        # the fixed egress/reject label sets render even at zero, so
+        # dashboards see the full fallback matrix from scrape one
+        assert 'torrent_tpu_serve_bytes_total{path="sendfile"} 0' in text
+        assert 'torrent_tpu_serve_blocks_total{path="preadv"} 0' in text
+        assert 'torrent_tpu_serve_rejects_total{reason="per_ip"} 0' in text
+        assert 'torrent_tpu_serve_rejects_total{reason="choked"} 0' in text
+        assert "torrent_tpu_serve_choke_rounds_total 0" in text
+
+    def test_partial_snapshot_tolerated(self):
+        from torrent_tpu.utils.metrics import render_serve_metrics
+
+        prom_lint(render_serve_metrics({}))
+        prom_lint(render_serve_metrics(None))
+        # hostile shapes: wrong-typed sub-dicts render as zeros
+        text = render_serve_metrics(
+            {"counts": {"serving": 2}, "peers": {"x": {"bytes_up": 9}},
+             "overflow": None, "paths": "bogus", "choke": None,
+             "totals": {"blocks": "NaNsense"}}
+        )
+        prom_lint(text)
+        assert "torrent_tpu_serve_peers 2" in text
+        assert 'torrent_tpu_serve_peer_bytes_total{peer="x"} 9' in text
+
+    def test_choke_round_histogram_lints(self):
+        from torrent_tpu.serve_plane.telemetry import ServeTelemetry
+        from torrent_tpu.utils.metrics import render_serve_metrics
+
+        reg = ServeTelemetry()
+        for d in (0.0005, 0.002, 0.03):
+            reg.on_choke_round(d, unchoked=2, interested=5,
+                               optimistic="o@1:1", rotated=True)
+        text = render_serve_metrics(reg.snapshot())
+        # prom_lint pins the _bucket/_sum/_count suffixes to a
+        # histogram-typed family and the unique-series rule catches a
+        # repeated le= bound
+        prom_lint(text)
+        assert "torrent_tpu_serve_choke_round_seconds_count 3" in text
+        assert 'le="+Inf"} 3' in text
+        assert "torrent_tpu_serve_unchoked 2" in text
+        assert "torrent_tpu_serve_interested 5" in text
+        assert "torrent_tpu_serve_optimistic_rotations_total 3" in text
+
+    def test_peer_overflow_fold(self):
+        from torrent_tpu.serve_plane.telemetry import (
+            TOP_PEERS,
+            ServeTelemetry,
+        )
+        from torrent_tpu.utils.metrics import render_serve_metrics
+
+        reg = ServeTelemetry()
+        n = TOP_PEERS + 4
+        for i in range(n):
+            key = f"s{i:02d}@10.0.0.{i}:6881"
+            reg.peer_serving(key)
+            reg.on_egress(key, "sendfile", (i + 1) * 1000)
+        snap = reg.snapshot()
+        assert len(snap["peers"]) == TOP_PEERS
+        text = render_serve_metrics(snap)
+        prom_lint(text)
+        assert text.count("torrent_tpu_serve_peer_bytes_total{") == TOP_PEERS + 1
+        assert 'torrent_tpu_serve_peer_bytes_total{peer="overflow"}' in text
+        # the fold keeps the un-named peers' bytes: smallest uploaders
+        assert f'peer="overflow"}} {sum((i + 1) * 1000 for i in range(4))}' in text
 
 
 class TestLiveScrape:

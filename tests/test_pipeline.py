@@ -180,7 +180,7 @@ class TestAttrib:
 
     def test_stage_order_constant(self):
         assert PIPELINE_STAGES == ("recv", "read", "stage", "h2d", "launch",
-                                   "digest", "verdict")
+                                   "digest", "verdict", "egress")
 
 
 class TestRenderer:

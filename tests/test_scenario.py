@@ -149,6 +149,7 @@ _SCALE = {
     "churn-storm": 8,
     "slowloris": 2,
     "ghost-flood": 2,
+    "leecher-stampede": 8,
     "token-forge": 2,
     "byzantine-fabric": 2,
     "mixed-adversary": 8,
@@ -218,6 +219,23 @@ class TestLibraryScenarios:
         assert forge["forged"] > 0 and forge["rejected"] == forge["forged"]
         assert forge["valid_ok"] > 0
         assert v["facts"]["counters"]["forged_accepted"] == 0
+
+    def test_leecher_facts_show_clamp_and_bounded_feeding(self):
+        v = run_scenario(
+            get("leecher-stampede").scaled(8, ticks=10)
+        )["verdict"]
+        lee = next(
+            f for k, f in v["facts"]["behaviors"].items()
+            if k.startswith("leecher")
+        )
+        # the per-IP clamp bounded the shared-address horde, unchoke
+        # slots never exceeded slots + optimistic, every admitted
+        # honest leecher was fed, and the discovery slot rotated
+        assert lee["per_ip_rejected"] > 0
+        assert lee["admitted"] < lee["admitted"] + lee["per_ip_rejected"]
+        assert lee["max_unchoked"] <= 16 + 1
+        assert lee["honest_fed"] == lee["honest_admitted"] > 0
+        assert lee["optimistic_rotations"] > 0
 
     def test_occupancy_oracle_reconciles(self):
         v = run_scenario(get("churn-storm").scaled(8, ticks=10))["verdict"]

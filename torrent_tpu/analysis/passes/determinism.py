@@ -109,6 +109,16 @@ SCOPE: dict[str, frozenset[str]] = {
     # these modules would break the doctor --scenario bit-identity gate
     "scenario/spec.py": frozenset({"*"}),
     "scenario/verdict.py": frozenset({"*"}),
+    # the seeder plane's snapshot builders: the serve snapshot rides
+    # /v1/swarm and the bench seed record (banked artifacts diffed
+    # across runs), so the rollup must be bit-stable over equal raws
+    "serve_plane/telemetry.py": frozenset(
+        {
+            "build_serve_snapshot",
+            "_serve_peer_entry",
+            "_serve_fold_entries",
+        }
+    ),
     # the SLO evaluators are pure functions over timeline samples (the
     # same determinism contract as decide() and the digest builders):
     # the same sample ring must always produce the same burn-rate

@@ -1098,8 +1098,15 @@ class BridgeServer:
         with sorted keys; pure in-memory reads, safe on the serving
         loop."""
         from torrent_tpu.obs.swarm import swarm_telemetry
+        from torrent_tpu.serve_plane.telemetry import serve_telemetry
 
-        body = json.dumps(swarm_telemetry().snapshot(), sort_keys=True).encode()
+        payload = swarm_telemetry().snapshot()
+        serve_obs = serve_telemetry()
+        if serve_obs.active():
+            # serving-side entries ride along once this process has
+            # actually served (same additive rule as /metrics)
+            payload["serve"] = serve_obs.snapshot()
+        body = json.dumps(payload, sort_keys=True).encode()
         return await self._reply(
             writer, 200, body, content_type="application/json"
         )

@@ -377,6 +377,18 @@ def raise_if_closing(writer) -> None:
 
 
 async def send_message(writer: asyncio.StreamWriter, msg: PeerMsg) -> None:
+    # the serve plane's zero-copy egress holds this lock across its
+    # header-write + sendfile pair (asyncio forbids transport.write
+    # while a sendfile is in flight) — every other sender on the same
+    # connection must serialize behind it. Absent on leecher-only and
+    # test writers: plain writes already append atomically.
+    lock = getattr(writer, "_tt_send_lock", None)
+    if lock is not None:
+        async with lock:
+            raise_if_closing(writer)
+            writer.write(encode_message(msg))
+            await writer.drain()
+        return
     raise_if_closing(writer)
     writer.write(encode_message(msg))
     await writer.drain()

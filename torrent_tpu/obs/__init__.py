@@ -127,14 +127,25 @@ def render_obs_metrics() -> str:
     """The obs plane's /metrics contribution: every latency-histogram
     family, the pipeline ledger's per-stage series + bottleneck verdict,
     the swarm wire-plane families (``torrent_tpu_swarm_*`` + bounded
-    ``torrent_tpu_peer_*``), and the flight-recorder dump counters.
-    Appended by both the bridge's ``/metrics`` and the session
-    ``MetricsServer``."""
-    from torrent_tpu.utils.metrics import render_swarm_metrics
+    ``torrent_tpu_peer_*``), the seeder plane's ``torrent_tpu_serve_*``
+    (only once this process has actually served — tracker-only scrapes
+    stay lean), and the flight-recorder dump counters. Appended by both
+    the bridge's ``/metrics`` and the session ``MetricsServer``."""
+    from torrent_tpu.serve_plane.telemetry import serve_telemetry
+    from torrent_tpu.utils.metrics import (
+        render_serve_metrics,
+        render_swarm_metrics,
+    )
 
+    serve_obs = serve_telemetry()
     return (
         histograms().render()
         + render_pipeline_metrics()
         + render_swarm_metrics(swarm_telemetry().snapshot())
+        + (
+            render_serve_metrics(serve_obs.snapshot())
+            if serve_obs.active()
+            else ""
+        )
         + flight_recorder().render_metrics()
     )

@@ -70,8 +70,11 @@ __all__ = [
     "render_pipeline_metrics",
 ]
 
-# the canonical stage order (pipeline position, used by renderers)
-PIPELINE_STAGES = ("recv", "read", "stage", "h2d", "launch", "digest", "verdict")
+# the canonical stage order (pipeline position, used by renderers).
+# "egress" is the serving direction — blocks leaving through the seeder
+# plane — appended after the verify chain so download attribution
+# reports keep their familiar shape.
+PIPELINE_STAGES = ("recv", "read", "stage", "h2d", "launch", "digest", "verdict", "egress")
 
 # unknown stage names fold into "other" past this bound — the ledger's
 # cardinality must stay fixed no matter what a plane_factory plane does

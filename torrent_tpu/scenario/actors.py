@@ -342,6 +342,137 @@ class SlowlorisBehavior(Behavior):
         return out
 
 
+class LeecherBehavior(Behavior):
+    """Leecher stampede against the seeder plane's two defenses: the
+    accept gate's per-IP clamp and the DRR choke economics
+    (``serve_plane/choke.py`` — the SAME class the live session's
+    ``_choke_loop`` runs, driven here with virtual ticks).
+
+    ``honest_pct`` of the population are honest leechers — unique IPs,
+    real reciprocation weights; the rest are a stampede horde packed
+    onto ``stampede_ips`` shared addresses that never reciprocates.
+    Everyone dials in at tick 0; each subsequent tick is one unchoke
+    round where every fed peer drinks a quantum (charged back, so the
+    queue rotates). The contract: the per-IP clamp bounds the horde,
+    no round unchokes more than ``slots`` + 1 peers, the optimistic
+    slot rotates, and every admitted honest leecher is fed at least
+    once before the run ends."""
+
+    kind = "leecher"
+
+    def setup(self, world) -> None:
+        from torrent_tpu.serve_plane.choke import ChokeEconomics
+
+        g = self.group
+        self.slots = g.param("slots")
+        self.per_ip = g.param("per_ip")
+        self.stampede_ips = g.param("stampede_ips")
+        self.honest_n = g.count * g.param("honest_pct") // 100
+        self.stampede_n = g.count - self.honest_n
+        self.quantum = g.param("quantum_kb") * 1024
+        # idle_after far past the run: eviction is slowloris's exam,
+        # not this one's — here the per-IP clamp is the front door
+        self.gate = AcceptGate(
+            g.param("capacity"), 1 << 30, per_ip=self.per_ip
+        )
+        # one virtual tick = one whole unchoke round, so the product's
+        # cap (8 quanta, tuned for continuous charging between rounds)
+        # would saturate in a handful of ticks and flatten the queue
+        # order into a key tie-break; size the cap past the run instead
+        self.econ = ChokeEconomics(
+            self.slots,
+            quantum=self.quantum,
+            seed=int.from_bytes(_h("leecher-econ", self.gi)[:8], "big"),
+            cap_rounds=128,
+        )
+        self.admitted: list[str] = []
+        self.weights: dict[str, float] = {}
+        self.honest_admitted = 0
+        self.honest_shed = 0
+        self.honest_fed: set[str] = set()
+        self.max_unchoked = 0
+        self.stampede_unchokes = 0
+
+    def _connect_all(self, world, tick: int) -> None:
+        # the horde races in first — the worst case for the honest crowd
+        for i in range(self.stampede_n):
+            key = f"s{self.gi}.{i}"
+            ip = _ip("leecher-horde", self.gi, i % self.stampede_ips)
+            if self.gate.connect(key, tick, ip=ip):
+                self.admitted.append(key)
+                self.weights[key] = 0.0  # never reciprocates
+        for i in range(self.honest_n):
+            key = f"h{self.gi}.{i}"
+            if self.gate.connect(key, tick, ip=_ip(self.kind, self.gi, i)):
+                self.admitted.append(key)
+                d = _h("leecher-rate", self.gi, i)
+                self.weights[key] = 0.25 + d[0] / 1024
+                self.honest_admitted += 1
+            else:
+                self.honest_shed += 1
+                world.record_shed()
+
+    def step(self, world) -> None:
+        if world.tick == 0:
+            self._connect_all(world, world.tick)
+        if not self.admitted:
+            return
+        verdict = self.econ.round(self.weights)
+        fed = verdict.all_unchoked()
+        self.max_unchoked = max(self.max_unchoked, len(fed))
+        for key in fed:
+            # every fed peer drinks its unchoke dry and is charged for
+            # it — the same spend-on-egress the session does (one tick
+            # here is a whole unchoke round; real egress dwarfs the
+            # accrual quantum), so the queue rotates instead of
+            # freezing on the first winners
+            self.econ.charge(key, self.econ.deficit(key))
+            if key.startswith("h"):
+                self.honest_fed.add(key)
+                world.record_ok()
+            else:
+                self.stampede_unchokes += 1
+
+    def facts(self, world) -> dict:
+        return {
+            "admitted": len(self.admitted),
+            "per_ip_rejected": self.gate.rejected_per_ip,
+            "capacity_rejected": self.gate.rejected_capacity,
+            "honest_admitted": self.honest_admitted,
+            "honest_shed": self.honest_shed,
+            "honest_fed": len(self.honest_fed),
+            "max_unchoked": self.max_unchoked,
+            "stampede_unchokes": self.stampede_unchokes,
+            "rounds": self.econ.rounds,
+            "optimistic_rotations": self.econ.rotations,
+        }
+
+    def failures(self, world) -> list[str]:
+        out = []
+        if self.max_unchoked > self.slots + 1:
+            out.append(
+                f"choke round unchoked {self.max_unchoked} peers "
+                f"(bound is slots + optimistic = {self.slots + 1})"
+            )
+        if (
+            self.stampede_n > self.per_ip * self.stampede_ips
+            and not self.gate.rejected_per_ip
+        ):
+            out.append(
+                f"per-IP clamp never fired against a {self.stampede_n}"
+                f"-strong horde on {self.stampede_ips} addresses"
+            )
+        starved = self.honest_admitted - len(self.honest_fed)
+        if starved > 0:
+            out.append(
+                f"{starved}/{self.honest_admitted} honest leechers were "
+                "never unchoked (starved by the horde)"
+            )
+        if len(self.admitted) > self.slots and not self.econ.rotations:
+            out.append("the optimistic unchoke slot never rotated")
+        return out
+
+
 class GhostBehavior(Behavior):
     """Ghost-swarm flood: ``per_tick`` bencoded ``get_peers`` queries
     per flooder per tick, each for a hash nobody has — straight into
@@ -658,8 +789,8 @@ BEHAVIOR_KINDS: dict[str, type[Behavior]] = {
     cls.kind: cls
     for cls in (
         HonestBehavior, SybilBehavior, PoisonBehavior, ChurnBehavior,
-        SlowlorisBehavior, GhostBehavior, ForgeBehavior,
-        ByzantineBehavior,
+        SlowlorisBehavior, LeecherBehavior, GhostBehavior,
+        ForgeBehavior, ByzantineBehavior,
     )
 }
 

@@ -87,6 +87,19 @@ SCENARIOS: dict[str, str] = {
         "actor=honest:count=32,numwant=30,swarms=4;"
         "actor=byzantine:count=24,pieces=8,honest_pct=25"
     ),
+    # a two-thousand-strong leecher crowd against the seeder plane: a
+    # 1600-peer horde packed onto 4 shared addresses must be clamped
+    # by the per-IP accept limit while the DRR choke economics keeps
+    # unchoke slots bounded at slots+1, rotates the optimistic slot,
+    # and feeds every admitted honest leecher at least once.
+    "leecher-stampede": (
+        "name=leecher-stampede;seed=37;ticks=72;tick_ms=1000;peer_ttl_s=900;"
+        "shards=8;wall_p99_ms=250;short_samples=8;long_samples=64;"
+        "slo=availability=0.999|integrity=on;"
+        "actor=honest:count=16,numwant=30,swarms=4;"
+        "actor=leecher:count=2000,capacity=512,per_ip=8,slots=16,"
+        "honest_pct=20,stampede_ips=4,quantum_kb=16"
+    ),
     # the kitchen-sink adversary: sybil stampede + churn storm + piece
     # poisoners in ONE population — defenses must not regress when the
     # attacks overlap (clamps hold, occupancy reconciles, every

@@ -84,6 +84,21 @@ ACTOR_PARAMS: dict[str, dict[str, tuple[int, int, int]]] = {
     "forge": {
         "valid_every": (4, 1, 100_000),
     },
+    # leecher stampede against the seeder plane: a shared-IP horde
+    # (count - honest_pct% actors spread over ``stampede_ips``
+    # addresses, never reciprocating) and an honest crowd (unique IPs,
+    # real reciprocation weights) contend for the accept gate's per-IP
+    # clamp and the DRR choke economics. The clamp must bound the
+    # horde, unchoke slots must stay at ``slots`` + 1 (optimistic), and
+    # every admitted honest leecher must be fed before the run ends.
+    "leecher": {
+        "capacity": (512, 1, 1_000_000),
+        "per_ip": (8, 1, 1_000_000),
+        "slots": (8, 1, 100_000),
+        "honest_pct": (20, 0, 100),
+        "stampede_ips": (4, 1, 1_000_000),
+        "quantum_kb": (16, 1, 100_000),
+    },
     # Byzantine receipt publishers against the verify fabric's Merkle
     # receipt plane (fabric/receipts.py): forged roots, equivocating
     # receipts, and under-hashing workers. The ground-truth auditor

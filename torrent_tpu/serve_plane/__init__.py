@@ -1,0 +1,44 @@
+"""The crowd seeder plane: connection-scaled serving for one process.
+
+``session/torrent.py`` grew up as a leecher with a serving reflex: every
+peer loop served requests inline, uploads were ranked by a thin choke
+round, and each block crossed userspace twice on its way out. This
+package is the serving side grown into a subsystem of its own:
+
+* :mod:`.reactor` — a bounded reactor pool multiplexing peer request
+  queues: per-peer FIFO backpressure, batch draining, cancel-by-predicate
+  for BEP 6 rejects.
+* :mod:`.egress` — zero-copy block egress: ``os.sendfile`` when the
+  requested span maps contiguously into one real file, pooled ``preadv``
+  staging when the fd is there but sendfile is not, buffered copy
+  otherwise — with a per-connection fallback matrix recording which path
+  served every block.
+* :mod:`.choke` — upload choke economics on the PR 1 DRR byte-weight
+  idiom: deficits accrue per round by reciprocation weight, egress
+  spends them, a seeded optimistic slot rotates, and starvation is
+  structurally impossible (a choked candidate accrues every round).
+* :mod:`.telemetry` — the bounded serve-side registry + the pure
+  :func:`~torrent_tpu.serve_plane.telemetry.build_serve_snapshot`
+  rollup behind ``torrent_tpu_serve_*`` metrics.
+"""
+
+from torrent_tpu.serve_plane.choke import ChokeEconomics, RoundResult
+from torrent_tpu.serve_plane.egress import EgressEngine
+from torrent_tpu.serve_plane.reactor import ReactorPool
+from torrent_tpu.serve_plane.telemetry import (
+    EGRESS_PATHS,
+    ServeTelemetry,
+    build_serve_snapshot,
+    serve_telemetry,
+)
+
+__all__ = [
+    "EGRESS_PATHS",
+    "ChokeEconomics",
+    "EgressEngine",
+    "ReactorPool",
+    "RoundResult",
+    "ServeTelemetry",
+    "build_serve_snapshot",
+    "serve_telemetry",
+]

@@ -102,20 +102,44 @@ class AcceptGate:
     ticks, so the chaos suite exercises exactly the eviction policy
     production runs."""
 
-    def __init__(self, capacity: int, idle_after: float):
+    def __init__(self, capacity: int, idle_after: float, per_ip: int = 0):
         self.capacity = capacity
         self.idle_after = idle_after
+        # per-address admission clamp (0 = off): a stampede from one
+        # address — NAT abuse or a sybil fleet — can hold at most this
+        # many slots, leaving the rest for the crowd
+        self.per_ip = int(per_ip)
         self.slots: dict = {}  # key -> last activity instant
+        self._ips: dict = {}  # key -> admitting address
+        self._ip_counts: dict = {}  # address -> live slots
         self.evicted_idle = 0
+        self.rejected_per_ip = 0
+        self.rejected_capacity = 0
+        # why the latest connect() returned False ("per_ip"/"capacity")
+        self.last_reject: str | None = None
 
-    def connect(self, key, now) -> bool:
-        """Admit (or refresh) ``key``; False when every slot is held."""
+    def connect(self, key, now, ip=None) -> bool:
+        """Admit (or refresh) ``key``; False when every slot is held or
+        ``ip`` already holds :attr:`per_ip` slots."""
         if key in self.slots:
             self.slots[key] = now
             return True
+        if (
+            self.per_ip > 0
+            and ip is not None
+            and self._ip_counts.get(ip, 0) >= self.per_ip
+        ):
+            self.rejected_per_ip += 1
+            self.last_reject = "per_ip"
+            return False
         if len(self.slots) >= self.capacity:
+            self.rejected_capacity += 1
+            self.last_reject = "capacity"
             return False
         self.slots[key] = now
+        if ip is not None:
+            self._ips[key] = ip
+            self._ip_counts[ip] = self._ip_counts.get(ip, 0) + 1
         return True
 
     def touch(self, key, now) -> None:
@@ -124,8 +148,18 @@ class AcceptGate:
         if key in self.slots:
             self.slots[key] = now
 
+    def _forget_ip(self, key) -> None:
+        ip = self._ips.pop(key, None)
+        if ip is not None:
+            left = self._ip_counts.get(ip, 0) - 1
+            if left > 0:
+                self._ip_counts[ip] = left
+            else:
+                self._ip_counts.pop(ip, None)
+
     def release(self, key) -> None:
         self.slots.pop(key, None)
+        self._forget_ip(key)
 
     def sweep(self, now) -> list:
         """Evict every slot idle past ``idle_after``; returns the
@@ -136,6 +170,7 @@ class AcceptGate:
         ]
         for k in dead:
             del self.slots[k]
+            self._forget_ip(k)
         self.evicted_idle += len(dead)
         return dead
 
@@ -217,6 +252,20 @@ class TorrentConfig:
     # tighter of the two limits wins
     max_upload_bps: int = 0
     max_download_bps: int = 0
+    # ---- serve plane (torrent_tpu/serve_plane/) -----------------------
+    # AcceptGate per-address admission clamp (0 = off): a stampede from
+    # one address can hold at most this many slots. Off by default —
+    # loopback test rigs and NATed swarms legitimately share addresses.
+    per_ip_limit: int = 0
+    # reactor pool: worker count, per-peer pending-request bound (past
+    # it the session answers BEP 6 rejects — bounded hostile demand),
+    # and requests drained per peer per turn (round-robin fairness)
+    serve_reactor_workers: int = 4
+    serve_queue_depth: int = 64
+    serve_batch: int = 8
+    # DRR choke-economics quantum: deficit bytes a weight-1.0 candidate
+    # accrues per unchoke round (one 16 KiB block by default)
+    choke_quantum: int = 16384
 
     def __post_init__(self):
         if self.encryption not in ("disabled", "enabled", "required"):
@@ -296,7 +345,9 @@ class Torrent:
         # eviction policy (and its counter) is the same object the
         # scenario plane attacks
         self._accept_gate = AcceptGate(
-            self.config.max_peers, self.config.peer_timeout
+            self.config.max_peers,
+            self.config.peer_timeout,
+            per_ip=self.config.per_ip_limit,
         )
         self._partials: dict[int, _PartialPiece] = {}
         # TPU ingest-verification micro-batching (see _verify_piece_data)
@@ -411,6 +462,36 @@ class Torrent:
         self._recv_s = 0.0
         self._recv_bytes = 0
         self._recv_ops = 0
+
+        # The crowd seeder plane (torrent_tpu/serve_plane/): bounded
+        # reactor multiplexing peer request queues, zero-copy block
+        # egress, and DRR choke economics — one set per torrent, all
+        # feeding the process-global serve telemetry registry.
+        from torrent_tpu.serve_plane.choke import ChokeEconomics
+        from torrent_tpu.serve_plane.egress import EgressEngine
+        from torrent_tpu.serve_plane.reactor import ReactorPool
+        from torrent_tpu.serve_plane.telemetry import serve_telemetry
+
+        self._serve_obs = serve_telemetry()
+        self._egress = EgressEngine(storage, telemetry=self._serve_obs)
+        self._serve_reactor = ReactorPool(
+            self._reactor_serve,
+            workers=self.config.serve_reactor_workers,
+            per_peer_queue=self.config.serve_queue_depth,
+            batch=self.config.serve_batch,
+        )
+        # deterministic per-torrent seed: the optimistic-slot rotation
+        # replays identically for one info-hash (scenario discipline)
+        self._serve_econ = ChokeEconomics(
+            slots=self.config.unchoke_slots,
+            quantum=self.config.choke_quantum,
+            seed=int.from_bytes(metainfo.info_hash[:8], "big"),
+        )
+        # egress-stage ledger accumulator (flushed in batches, the
+        # _recv_charge discipline — see _egress_charge)
+        self._egress_s = 0.0
+        self._egress_bytes = 0
+        self._egress_ops = 0
 
     # ----------------------------------------------------------- lifecycle
 
@@ -726,6 +807,10 @@ class Torrent:
         self._spawn(self._choke_loop(), name="choke")
         self._spawn(self._keepalive_loop(), name="keepalive")
         self._spawn(self._idle_sweep_loop(), name="idle-sweep")
+        # the serve reactor: inbound Requests queue per peer and a
+        # bounded worker pool drains them (serve_plane/reactor.py);
+        # workers ride _spawn so stop() tears them down with the rest
+        self._serve_reactor.start(self._spawn)
         if not self.private:
             self._spawn(self._pex_loop(), name="pex")
         self._spawn_seed_loops()
@@ -961,6 +1046,7 @@ class Torrent:
     async def stop(self) -> None:
         self._stopping = True
         self._wake_all_waiters()  # parked stream readers abort, not hang
+        self._serve_reactor.forget()  # workers die with _tasks below
         tasks = list(self._tasks)
         for t in tasks:
             t.cancel()
@@ -975,6 +1061,7 @@ class Torrent:
             peer.close()
         self.peers.clear()
         self._recv_flush()  # residual wire charges reach the ledger
+        self._egress_flush()  # and residual serve charges with them
         self._checkpoint(include_partials=True)  # stop: keep in-flight work
         if self.trackers:
             try:
@@ -1363,6 +1450,18 @@ class Torrent:
         if address and self.ip_filter is not None and self.ip_filter.blocked(address[0]):
             writer.close()  # blocklisted ranges are refused inbound too
             return
+        # the AcceptGate is the front door: slot admission + the per-IP
+        # clamp (a one-address stampede is turned away HERE, before a
+        # PeerConnection or a peer loop exists for it)
+        if not self._accept_gate.connect(
+            peer_id, time.monotonic(), ip=address[0] if address else None
+        ):
+            self._serve_obs.on_reject(
+                self._gate_key(peer_id, address),
+                self._accept_gate.last_reject or "capacity",
+            )
+            writer.close()
+            return
         peer = PeerConnection(
             peer_id=peer_id,
             reader=reader,
@@ -1374,7 +1473,13 @@ class Torrent:
         peer.ext.enabled = ext.supports_extensions(reserved)
         peer.fast = proto.supports_fast(reserved)
         self.peers[peer_id] = peer
-        self._accept_gate.connect(peer_id, time.monotonic())
+        # serialize frame sends: zero-copy egress holds this lock across
+        # header + sendfile (asyncio forbids transport.write while a
+        # sendfile is in flight), and proto.send_message honors it
+        try:
+            writer._tt_send_lock = asyncio.Lock()
+        except AttributeError:
+            pass  # slotted writer fakes: no sendfile path for them anyway
         # connection lifecycle telemetry + tracer span (obs/swarm): one
         # deterministic trace per torrent collects connect/drop spans
         self._swarm_obs.peer_connected(
@@ -1448,7 +1553,9 @@ class Torrent:
             return  # already dropped (or replaced by a newer connection)
         del self.peers[peer.peer_id]
         self._accept_gate.release(peer.peer_id)
+        self._serve_reactor.drop(peer.peer_id)  # queued requests die too
         self._swarm_obs.peer_dropped(self._obs_key(peer))
+        self._serve_obs.peer_gone(self._obs_key(peer))
         self._recv_flush()  # a departing peer must not strand recv charges
         self._avail -= peer.bitfield.as_numpy()
         self._rarity_dirty = True
@@ -1549,6 +1656,31 @@ class Torrent:
         self._recv_s = 0.0
         self._recv_bytes = 0
         self._recv_ops = 0
+
+    @staticmethod
+    def _gate_key(peer_id, address) -> str:
+        """Telemetry key for a connection refused BEFORE a
+        PeerConnection existed (the accept-gate reject path)."""
+        host, port = address or ("?", 0)
+        return f"{peer_id[:4].hex()}@{host}:{port}"
+
+    def _egress_charge(self, seconds: float, nbytes: int) -> None:
+        """Account serve time/bytes to the ledger's ``egress`` stage
+        (batched, the ``_recv_charge`` discipline — a seeder pushing
+        thousands of blocks a second pays one obs-lock per batch)."""
+        self._egress_s += seconds
+        self._egress_bytes += nbytes
+        self._egress_ops += 1
+        if self._egress_ops >= _RECV_FLUSH_OPS or self._egress_s >= _RECV_FLUSH_S:
+            self._egress_flush()
+
+    def _egress_flush(self) -> None:
+        if not self._egress_ops:
+            return
+        pipeline_ledger().record("egress", self._egress_bytes, self._egress_s)
+        self._egress_s = 0.0
+        self._egress_bytes = 0
+        self._egress_ops = 0
 
     # ------------------------------------------------------- message loop
 
@@ -1673,11 +1805,44 @@ class Torrent:
                     raise proto.ProtocolError("bad bitfield")
                 await self._replace_bitfield(peer, new_bf)
             case proto.Request(index, begin, length):
-                await self._serve_request(peer, index, begin, length)
+                # malformed requests kill the connection HERE, in the
+                # peer loop (queueing them would soften the protocol
+                # error into a swallowed worker exception)
+                if not validate_requested_block(self.info, index, begin, length):
+                    raise proto.ProtocolError("invalid request")
+                if self._serve_reactor.running:
+                    # the reactor decouples the wire from the disk: the
+                    # request queues per peer; a full queue is answered
+                    # with an explicit reject (bounded hostile demand)
+                    if not self._serve_reactor.submit(
+                        peer.peer_id, (index, begin, length)
+                    ):
+                        self._serve_obs.on_reject(okey, "backpressure")
+                        if peer.fast:
+                            await proto.send_message(
+                                peer.writer,
+                                proto.RejectRequest(index, begin, length),
+                            )
+                else:
+                    # no pool (stopped torrent, direct-drive tests):
+                    # serve inline, the legacy path
+                    await self._serve_request(peer, index, begin, length)
             case proto.Piece(index, begin, block):
                 await self._ingest_block(peer, index, begin, block)
             case proto.Cancel(index, begin, length):
-                pass  # we serve requests synchronously; nothing queued to cancel
+                # requests still queued in the reactor are cancellable
+                # (in-flight ones are not — we serve them; BEP 3 allows
+                # either). Fast peers get the explicit BEP 6 reject.
+                gone = self._serve_reactor.cancel(
+                    peer.peer_id, lambda it: it == (index, begin, length)
+                )
+                if gone:
+                    self._serve_obs.on_queue_cancel(len(gone))
+                    if peer.fast:
+                        for (ci, cb, cl) in gone:
+                            await proto.send_message(
+                                peer.writer, proto.RejectRequest(ci, cb, cl)
+                            )
             case proto.HaveAll() | proto.HaveNone():
                 if not peer.fast:
                     raise proto.ProtocolError("have_all/have_none without fast ext")
@@ -2885,6 +3050,67 @@ class Torrent:
             await asyncio.sleep(0.05)
             return await make_read()
 
+    async def _reactor_serve(self, key, item) -> None:
+        """ReactorPool drain callback: resolve the peer (it may have
+        left while the request queued) and serve. Connection-level
+        failures tear the peer down here — the worker pool must survive
+        any one peer's death."""
+        peer = self.peers.get(key)
+        if peer is None:
+            return
+        index, begin, length = item
+        try:
+            await self._serve_request(peer, index, begin, length)
+        except (proto.ProtocolError, ConnectionError, OSError):
+            # a torn frame (zero-copy mid-send failure) or a dead socket:
+            # the stream is unusable — abort, don't let it desync
+            transport = getattr(peer.writer, "transport", None)
+            if transport is not None:
+                try:
+                    transport.abort()
+                except Exception:
+                    pass
+            self._drop_peer(peer)
+
+    async def _serve_zero_copy(self, peer: PeerConnection, index, begin, length) -> str | None:
+        """Try the serve_plane egress engine: ``"sendfile"``/``"preadv"``
+        when the span went out zero-copy(-ish), ``None`` when the caller
+        must serve through the buffered piece-cache path. Only plaintext
+        writers are eligible — MSE wraps every byte in RC4, so splicing
+        raw file bytes past the cipher would corrupt the stream."""
+        from torrent_tpu.net.mse import WrappedWriter
+
+        if isinstance(peer.writer, WrappedWriter):
+            return None
+        offset = index * self.info.piece_length + begin
+        if self._egress.classify(offset, length) is None:
+            return None
+        # the span is fd-backed and EOF-checked: debit the upload caps
+        # now (the copy path debits after its read for the same reason —
+        # a read that can still fail must not burn cap budget; here the
+        # only failure mode left is the connection itself)
+        if self.upload_bucket is not None and not self.upload_bucket.unlimited:
+            await self.upload_bucket.take(length)
+        if not self.own_upload_bucket.unlimited:
+            await self.own_upload_bucket.take(length)
+        t0 = time.monotonic()
+        path = await self._egress.send_block(peer.writer, index, begin, length)
+        if path is not None:
+            self._egress_charge(time.monotonic() - t0, length)
+        return path
+
+    def _serve_done(self, peer: PeerConnection, length: int, path: str) -> None:
+        """Common post-egress accounting: transfer counters, swarm +
+        serve telemetry (the fallback matrix), and the DRR deficit
+        spend that makes the choke economics bite."""
+        peer.bytes_up += length
+        self.uploaded += length
+        peer.last_tx = time.monotonic()
+        okey = self._obs_key(peer)
+        self._swarm_obs.on_upload(okey, length)
+        self._serve_obs.on_egress(okey, path, length)
+        self._serve_econ.charge(peer.peer_id, length)
+
     async def _serve_request(self, peer: PeerConnection, index, begin, length) -> None:
         """request handler (torrent.ts:158-176), gated on our choke state.
 
@@ -2908,6 +3134,10 @@ class Torrent:
             await refuse()
             return
         if peer.am_choking and not (peer.fast and index in peer.allowed_fast_out):
+            # the economics said no: count it, so a crowd hammering
+            # through its choke shows up in the serve telemetry even
+            # though BEP 3 peers get no wire-level answer
+            self._serve_obs.on_reject(self._obs_key(peer), "choked")
             await refuse()
             return
         if not self.bitfield.has(index):
@@ -2923,6 +3153,16 @@ class Torrent:
             # that saw the real bitfield before the mode flipped on are
             # exempt; refusing them would stall legitimate requests)
             await refuse()
+            return
+        # Zero-copy egress first (serve_plane/egress.py): an fs-backed
+        # span that maps contiguously into one file skips the piece
+        # cache entirely — header + kernel splice (or one pooled preadv)
+        # instead of pread/slice/append. Anything ineligible (memory
+        # backends, pad spans, file boundaries, MSE) falls through to
+        # the buffered tiers below, which remain the universal path.
+        zpath = await self._serve_zero_copy(peer, index, begin, length)
+        if zpath is not None:
+            self._serve_done(peer, length, zpath)
             return
         # Serve through a small LRU of whole pieces: peers request a
         # piece as ~16-64 sequential 16 KiB blocks, so reading the piece
@@ -3006,11 +3246,10 @@ class Torrent:
             await self.upload_bucket.take(length)
         if not self.own_upload_bucket.unlimited:
             await self.own_upload_bucket.take(length)  # per-torrent layer
+        t0 = time.monotonic()
         await proto.send_message(peer.writer, proto.Piece(index, begin, block))
-        peer.bytes_up += length
-        self.uploaded += length
-        peer.last_tx = time.monotonic()
-        self._swarm_obs.on_upload(self._obs_key(peer), length)
+        self._egress_charge(time.monotonic() - t0, length)
+        self._serve_done(peer, length, "copy")
 
     # ---------------------------------------------------------- choke loop
 
@@ -3051,35 +3290,42 @@ class Torrent:
                         continue
 
     async def _choke_loop(self) -> None:
-        """Unchoke top reciprocators + one optimistic random (BEP 3).
+        """Unchoke by DRR deficit + one seeded optimistic slot (BEP 3
+        semantics, serve_plane/choke.py economics).
 
-        Leeching ranks by download rate (tit-for-tat); seeding ranks by
-        upload rate (serve whoever drains us fastest)."""
-        optimistic: bytes | None = None
-        rounds = 0
+        Leeching weighs candidates by download rate (tit-for-tat);
+        seeding by upload rate (serve whoever drains us fastest). The
+        rates feed :class:`ChokeEconomics` as DRR weights: deficits
+        accrue per round, actual egress spends them (``_serve_done``),
+        and a candidate that keeps losing keeps accruing — so the
+        ranking preserves the old rate order while making starvation
+        structurally impossible. Round duration, slot occupancy, and
+        optimistic rotation land in the serve telemetry."""
+        econ = self._serve_econ
         while not self._stopping:
             await asyncio.sleep(self.config.choke_interval)
             if self.paused:
                 continue  # pause() choked everyone; stay that way
+            t0 = time.monotonic()
             await self._release_snubbed()
             peers = list(self.peers.values())
             interested = [p for p in peers if p.peer_interested]
-            if self.state == TorrentState.SEEDING:
-                # a seed downloads nothing — reciprocity is meaningless.
-                # Serve the peers that drain us fastest (max swarm
-                # dissemination); the optimistic slot still rotates in
-                # newcomers with no rate history.
-                interested.sort(key=lambda p: p.upload_rate(), reverse=True)
-            else:
-                interested.sort(key=lambda p: p.download_rate(), reverse=True)
-            unchoke = set(id(p) for p in interested[: self.config.unchoke_slots])
-            if rounds % 3 == 0 or optimistic not in self.peers:
-                rest = [p for p in interested[self.config.unchoke_slots :]]
-                optimistic = random.choice(rest).peer_id if rest else None
-            if optimistic in self.peers:
-                unchoke.add(id(self.peers[optimistic]))
+            seeding = self.state == TorrentState.SEEDING
+            rates = {
+                p.peer_id: (p.upload_rate() if seeding else p.download_rate())
+                for p in interested
+            }
+            # normalize to DRR weights: the fastest reciprocator accrues
+            # a full quantum per round, the rest proportionally (with
+            # the economics' floor so newcomers accrue too)
+            top = max(rates.values(), default=0.0)
+            econ.slots = max(0, self.config.unchoke_slots)
+            verdict = econ.round(
+                {pid: (r / top if top > 0 else 0.0) for pid, r in rates.items()}
+            )
+            unchoke_ids = set(verdict.all_unchoked())
             for p in peers:
-                should_unchoke = id(p) in unchoke
+                should_unchoke = p.peer_id in unchoke_ids
                 try:
                     if should_unchoke and p.am_choking:
                         p.am_choking = False
@@ -3092,7 +3338,18 @@ class Torrent:
                 except (ConnectionError, OSError):
                     pass
                 p.snapshot_rate()
-            rounds += 1
+            opt_peer = (
+                self.peers.get(verdict.optimistic)
+                if verdict.optimistic is not None
+                else None
+            )
+            self._serve_obs.on_choke_round(
+                time.monotonic() - t0,
+                unchoked=len(verdict.unchoked),
+                interested=len(interested),
+                optimistic=self._obs_key(opt_peer) if opt_peer else None,
+                rotated=verdict.rotated,
+            )
 
     def _dialable_addr(self, p: PeerConnection) -> tuple[str, int] | None:
         """The address other peers could actually connect to.
@@ -3364,7 +3621,9 @@ class Torrent:
             now = time.monotonic()
             for p in self.peers.values():
                 self._accept_gate.touch(p.peer_id, p.last_rx)
-            for peer_id in self._accept_gate.sweep(now):
+            evicted = self._accept_gate.sweep(now)
+            self._serve_obs.on_gate_evictions(len(evicted))
+            for peer_id in evicted:
                 p = self.peers.get(peer_id)
                 if p is None:
                     continue
@@ -3409,4 +3668,15 @@ class Torrent:
             "partials": len(self._partials),
             "max_upload_bps": self.config.max_upload_bps,
             "max_download_bps": self.config.max_download_bps,
+            "serve": {
+                "reactor_running": self._serve_reactor.running,
+                "queued": sum(
+                    self._serve_reactor.depth(pid) for pid in self.peers
+                ),
+                "rejected_backpressure": self._serve_reactor.rejected,
+                "rejected_per_ip": self._accept_gate.rejected_per_ip,
+                "choke_rounds": self._serve_econ.rounds,
+                "optimistic_rotations": self._serve_econ.rotations,
+                "egress_paths": dict(self._egress.served),
+            },
         }

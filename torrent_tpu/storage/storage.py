@@ -131,6 +131,27 @@ class Storage:
             yield path, seg_start - start, chunk
             remaining -= chunk
 
+    def contiguous_span(self, offset: int, length: int) -> tuple[tuple[str, ...], int] | None:
+        """Resolve ``[offset, offset+length)`` to ``(path, file_offset)``
+        when the whole range lives inside ONE real file.
+
+        ``None`` for anything else — pad spans, file boundaries, bad
+        ranges — which is the serve plane's signal to take the buffered
+        copy path instead of zero-copy egress.
+        """
+        if length <= 0:
+            return None
+        try:
+            segs = list(self.segments(offset, length))
+        except StorageError:
+            return None
+        if len(segs) != 1:
+            return None
+        path, foff, chunk = segs[0]
+        if path is None or chunk != length:
+            return None
+        return path, foff
+
     # ------------------------------------------------------------ get/set
 
     def get(self, offset: int, length: int) -> bytes:
@@ -462,6 +483,13 @@ class FsStorage:
                     raise StorageError(f"cannot open {path}: {e}") from e
                 self._handles[path] = f
             return f
+
+    def open_read_handle(self, path: tuple[str, ...]):
+        """The cached read handle, for zero-copy egress (sendfile /
+        preadv). The handle is shared with every other reader: callers
+        must stick to positional IO (``os.sendfile``/``os.preadv``) and
+        never seek or close it."""
+        return self._open_read(path)
 
     def get(self, path: tuple[str, ...], offset: int, length: int) -> bytes:
         f = self._open_read(path)

@@ -97,7 +97,7 @@ SCHEMA = "torrent-tpu-bench/1"
 TRAJECTORY_SCHEMA = "torrent-tpu-bench-trajectory/1"
 RUNGS = (
     "smoke", "e2e", "v2", "fabric", "flagship", "controller", "announce",
-    "swarm", "scenario",
+    "swarm", "scenario", "seed",
 )
 # the announce rung's acceptance floor: the banked rate must come from
 # real cross-shard concurrency, not one hot shard
@@ -788,6 +788,222 @@ async def _swarm_rung(total_mb: int, piece_kb: int) -> dict:
     }
 
 
+async def _seed_rung(total_mb: int, piece_kb: int, leechers: int) -> dict:
+    """The seeder-plane rung: ONE seeding client serving ``leechers``
+    concurrent raw-wire loopback leechers, each pulling the FULL payload
+    (staggered piece order spreads the read offsets). Banks sustained
+    upload MiB/s measured from the serve telemetry's ``bytes_up`` delta
+    — the bytes the egress plane actually pushed, duplicates included —
+    plus block service p50/p99 (request-send to Piece-receipt on the
+    leecher side, so choke-rotation queueing is IN the tail) and the
+    egress fallback matrix (sendfile/preadv/copy deltas): an upload
+    regression banks WITH evidence of whether zero-copy disengaged, the
+    reactor shed, or the choke rotation stalled.
+
+    Leech protocol discipline: a choked BEP 3 peer's requests are
+    silently dropped, and every drop is bracketed by a later Unchoke —
+    so the loop re-arms its whole request window on each Unchoke and
+    keeps the window under ``serve_queue_depth`` (no backpressure sheds
+    of our own traffic, no re-request timers, no mid-frame read
+    cancellation)."""
+    from torrent_tpu.codec.metainfo import parse_metainfo
+    from torrent_tpu.net import protocol as proto
+    from torrent_tpu.obs.attrib import attribute
+    from torrent_tpu.obs.ledger import pipeline_ledger
+    from torrent_tpu.serve_plane.telemetry import serve_telemetry
+    from torrent_tpu.session.client import Client, ClientConfig
+    from torrent_tpu.session.torrent import TorrentConfig
+    from torrent_tpu.tools.make_torrent import make_torrent
+
+    import numpy as np
+
+    piece_len = piece_kb << 10
+    block = 16384
+    window = 32  # outstanding per leecher, < serve_queue_depth (64)
+    total = total_mb << 20
+    # fewer slots than leechers: the crowd must contend, so the banked
+    # p99 includes real choke-rotation waits (the economics under test)
+    slots = max(4, leechers // 8)
+    with tempfile.TemporaryDirectory(prefix="tt_bench_seed_") as tmp:
+        sd = os.path.join(tmp, "seed")
+        os.makedirs(sd)
+        rng = np.random.default_rng(17)
+        payload = rng.integers(0, 256, total, dtype=np.uint8).tobytes()
+        with open(os.path.join(sd, "seed.bin"), "wb") as f:
+            f.write(payload)
+        meta = parse_metainfo(
+            make_torrent(
+                os.path.join(sd, "seed.bin"), "http://127.0.0.1:1/announce",
+                piece_length=piece_len,
+            )
+        )
+        n_pieces = meta.info.num_pieces
+        seed = Client(ClientConfig(
+            port=0, enable_upnp=False, resume=False,
+            torrent=TorrentConfig(
+                max_peers=leechers + 8,
+                choke_interval=0.25,
+                unchoke_slots=slots,
+            ),
+        ))
+        obs = serve_telemetry()
+        base_tot = obs.snapshot().get("totals") or {}
+        base_paths = {
+            k: dict(v)
+            for k, v in (obs.snapshot().get("paths") or {}).items()
+        }
+        led = pipeline_ledger()
+        prev = led.snapshot()
+        lat: list[float] = []
+        writers: list = []
+        await seed.start()
+        try:
+            t = await seed.add(meta, sd)
+            assert t.bitfield.complete, "seed recheck failed"
+
+            async def leech(i: int) -> None:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", seed.port
+                )
+                writers.append(writer)
+                pid = (b"-BR0001-" + f"{i:012d}".encode())[:20]
+                await proto.send_handshake(writer, meta.info_hash, pid)
+                await proto.read_handshake_head(reader)
+                await proto.read_handshake_peer_id(reader)
+                await proto.send_message(writer, proto.Interested())
+                need: dict[tuple[int, int], int] = {}
+                for j in range(n_pieces):
+                    p = (i * 7 + j) % n_pieces
+                    plen = min(piece_len, total - p * piece_len)
+                    for off in range(0, plen, block):
+                        need[(p, off)] = min(block, plen - off)
+                pending: dict[tuple[int, int], float] = {}
+
+                async def pump() -> None:
+                    now = time.perf_counter()
+                    for (p, off), ln in need.items():
+                        if len(pending) >= window:
+                            break
+                        if (p, off) not in pending:
+                            pending[(p, off)] = now
+                            await proto.send_message(
+                                writer, proto.Request(p, off, ln)
+                            )
+
+                unchoked = False
+                while need:
+                    msg = await proto.read_message(reader)
+                    if isinstance(msg, proto.Unchoke):
+                        # everything in flight may have been shed by a
+                        # choke tick — re-arm the whole window
+                        unchoked = True
+                        pending.clear()
+                        await pump()
+                    elif isinstance(msg, proto.Choke):
+                        unchoked = False
+                    elif isinstance(msg, proto.Piece):
+                        key = (msg.index, msg.begin)
+                        sent = pending.pop(key, None)
+                        if sent is not None:
+                            lat.append(time.perf_counter() - sent)
+                        ln = need.pop(key, None)
+                        if ln is not None:
+                            base = msg.index * piece_len + msg.begin
+                            if msg.block != payload[base:base + ln]:
+                                raise RuntimeError(
+                                    f"leecher {i}: block {key} diverges"
+                                )
+                        if unchoked:
+                            await pump()
+
+            t0 = time.perf_counter()
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*(leech(i) for i in range(leechers))), 600
+                )
+            except asyncio.TimeoutError:
+                raise RuntimeError(
+                    f"seed rung stalled ({leechers} leechers, "
+                    f"{total_mb} MiB each)"
+                ) from None
+            wall = time.perf_counter() - t0
+        finally:
+            for w in writers:
+                w.close()
+            await seed.close()
+
+    snap = obs.snapshot()
+    tot = snap.get("totals") or {}
+
+    def delta(key):
+        return (tot.get(key) or 0) - (base_tot.get(key) or 0)
+
+    paths = {
+        k: {
+            "blocks": v.get("blocks", 0)
+            - (base_paths.get(k) or {}).get("blocks", 0),
+            "bytes": v.get("bytes", 0)
+            - (base_paths.get(k) or {}).get("bytes", 0),
+        }
+        for k, v in (snap.get("paths") or {}).items()
+    }
+    zero_copy = sum(
+        paths.get(k, {}).get("blocks", 0) for k in ("sendfile", "preadv")
+    )
+    if zero_copy <= 0:
+        raise RuntimeError(
+            f"no zero-copy egress on a contiguous single-file layout "
+            f"(fallback matrix: {paths})"
+        )
+    if delta("optimistic_rotations") <= 0:
+        raise RuntimeError(
+            f"optimistic slot never rotated over {leechers} leechers "
+            f"vs {slots} slots"
+        )
+    lat.sort()
+    rep = attribute(led.snapshot(), prev=prev)
+    return {
+        "schema": SCHEMA,
+        "rung": "seed",
+        "metric": f"seed_{leechers}leech_{piece_kb}KiB_upload_MiB_per_sec",
+        "value": round(delta("bytes_up") / (1 << 20) / wall, 1)
+        if wall > 0 else None,
+        "unit": "MiB/s",
+        "contract": "sustained, full payload per leecher, dupes counted",
+        "leechers": leechers,
+        "block_p50_ms": round(lat[len(lat) // 2] * 1e3, 2) if lat else None,
+        "block_p99_ms": round(lat[int(0.99 * (len(lat) - 1))] * 1e3, 2)
+        if lat else None,
+        "blocks": delta("blocks"),
+        "bytes": total * leechers,
+        "bytes_up": delta("bytes_up"),
+        "piece_kb": piece_kb,
+        "batch": None,
+        "platform": "cpu",
+        "plane": "cpu",
+        "nproc": os.cpu_count(),
+        "measured_at_utc": _utcnow(),
+        # the serve plane's own evidence: the egress fallback matrix +
+        # reject/rotation counters bracketing the run
+        "serve": {
+            "paths": paths,
+            "unchoke_slots": slots,
+            "rounds": delta("rounds"),
+            "optimistic_rotations": delta("optimistic_rotations"),
+            "rejects_backpressure": delta("rejects_backpressure"),
+            "rejects_choked": delta("rejects_choked"),
+            "rejects_capacity": delta("rejects_capacity"),
+            "rejects_per_ip": delta("rejects_per_ip"),
+        },
+        "ledger": {
+            "wall_s": rep.get("wall_s"),
+            "stages": rep.get("stages"),
+            "bottleneck": rep.get("bottleneck"),
+            "overlap": rep.get("overlap"),
+        },
+    }
+
+
 # ----------------------------------------------------------- device rungs
 
 
@@ -1001,8 +1217,8 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "rung", nargs="?", choices=RUNGS,
-        help="named rung to run "
-        "(smoke/e2e/v2/fabric/flagship/controller/announce/swarm/scenario)",
+        help="named rung to run (smoke/e2e/v2/fabric/flagship/"
+        "controller/announce/swarm/scenario/seed)",
     )
     ap.add_argument(
         "--smoke", action="store_true",
@@ -1047,6 +1263,11 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--numwant", type=int, default=30,
         help="announce rung: peers requested per announce "
+        "(default %(default)s)",
+    )
+    ap.add_argument(
+        "--leechers", type=int, default=64,
+        help="seed rung: concurrent raw-wire loopback leechers "
         "(default %(default)s)",
     )
     ap.add_argument(
@@ -1096,7 +1317,7 @@ def main(argv=None) -> int:
         rung = "smoke"
     if rung is None and args.record is None:
         print("error: name a rung (smoke/e2e/v2/fabric/flagship/controller/"
-              "announce/swarm/scenario) or pass --record FILE",
+              "announce/swarm/scenario/seed) or pass --record FILE",
               file=sys.stderr)
         return 2
     if rung == "announce" and (
@@ -1147,6 +1368,10 @@ def main(argv=None) -> int:
                 )
             elif rung == "swarm":
                 record = asyncio.run(_swarm_rung(args.mb, args.piece_kb))
+            elif rung == "seed":
+                record = asyncio.run(
+                    _seed_rung(args.mb, args.piece_kb, args.leechers)
+                )
             elif rung == "scenario":
                 record = _scenario_rung(args.occupancy, args.shards)
             elif rung == "fabric":

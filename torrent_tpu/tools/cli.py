@@ -1248,6 +1248,8 @@ def _cmd_doctor(args) -> int:
         argv.append("--swarm")
     if getattr(args, "scenario", None):
         argv += ["--scenario", args.scenario]
+    if getattr(args, "seed", False):
+        argv.append("--seed")
     if getattr(args, "json", False):
         argv.append("--json")
     return doctor_cli(argv)
@@ -1366,6 +1368,7 @@ def _cmd_bench(args) -> int:
              "--clients", str(args.clients), "--swarms", str(args.swarms),
              "--per-client", str(args.per_client),
              "--shards", str(args.shards), "--numwant", str(args.numwant),
+             "--leechers", str(args.leechers),
              "--tolerance", str(args.tolerance)]
     if args.timeout is not None:
         argv += ["--timeout", str(args.timeout)]
@@ -2138,6 +2141,12 @@ def build_parser() -> argparse.ArgumentParser:
                     "runs twice against the real serve stack on a "
                     "virtual timeline; SLO verdict must pass and the "
                     "same-seed replay must be bit-identical")
+    sp.add_argument("--seed", action="store_true",
+                    help="also run the seeder-plane smoke: raw-wire "
+                    "leechers against a real seeding client; every "
+                    "piece must arrive bit-exact, /v1/swarm must carry "
+                    "the serve sub-document, and the choke economics "
+                    "must rotate the optimistic slot")
     sp.add_argument("--trace", action="store_true",
                     help="also run the observability smoke: traced "
                     "fault-injected run producing a span tree, latency "
@@ -2218,7 +2227,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("rung", nargs="?",
                     choices=("smoke", "e2e", "v2", "fabric", "flagship",
-                             "controller", "announce", "swarm"))
+                             "controller", "announce", "swarm", "seed"))
     sp.add_argument("--smoke", action="store_true",
                     help="alias for the smoke rung (the CI spelling)")
     sp.add_argument("--mb", type=int, default=8,
@@ -2239,6 +2248,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="announce rung store shard count")
     sp.add_argument("--numwant", type=int, default=30,
                     help="announce rung peers requested per announce")
+    sp.add_argument("--leechers", type=int, default=64,
+                    help="seed rung concurrent loopback leechers "
+                    "(default %(default)s)")
     sp.add_argument("--timeout", type=float, default=None,
                     help="device-rung subprocess timeout seconds")
     sp.add_argument("--out", default=None, help="also write the record here")

@@ -685,6 +685,13 @@ def render_tracker_metrics(snapshot: dict) -> str:
 # per-peer family cardinality is bounded no matter how wide the swarm
 _SWARM_TRIGGERS = ("snub_storm", "all_peers_choked", "announce_failure_streak")
 
+# the serve plane's fixed egress fallback matrix and bounded reject
+# reasons; literals here (obs.hist imports this module, so importing
+# serve_plane.telemetry back would cycle) — parity is pinned by a test
+# against serve_plane.telemetry.EGRESS_PATHS/REJECT_REASONS
+_SERVE_PATHS = ("sendfile", "preadv", "copy")
+_SERVE_REJECT_REASONS = ("backpressure", "per_ip", "capacity", "choked")
+
 
 def render_swarm_metrics(snapshot: dict) -> str:
     """Prometheus rendering of the swarm wire plane
@@ -841,6 +848,144 @@ def render_swarm_metrics(snapshot: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_serve_metrics(snapshot: dict) -> str:
+    """Prometheus rendering of the seeder plane
+    (``serve_plane.telemetry.ServeTelemetry.snapshot()`` /
+    ``build_serve_snapshot``).
+
+    Process-level ``torrent_tpu_serve_*``: egress bytes/blocks by path
+    (the zero-copy fallback matrix — ``sendfile``/``preadv``/``copy``),
+    reject accounting by reason, choke-round counters plus a real
+    log2-bucket duration histogram, and accept-gate evictions. Bounded
+    per-peer ``torrent_tpu_serve_peer_*``: the snapshot's top-K
+    uploaded-to peers plus one ``peer="overflow"`` fold. Defensive
+    against partial snapshots: missing keys render as 0, never a crash
+    mid-scrape."""
+    s = snapshot if isinstance(snapshot, dict) else {}
+
+    def _d(v):
+        return v if isinstance(v, dict) else {}
+
+    def _n(v):
+        ok = isinstance(v, (int, float)) and not isinstance(v, bool)
+        return v if ok else 0
+
+    counts = _d(s.get("counts"))
+    totals = _d(s.get("totals"))
+    paths = {
+        k: v for k, v in _d(s.get("paths")).items() if isinstance(v, dict)
+    }
+    choke = _d(s.get("choke"))
+    last = _d(choke.get("last"))
+    round_s = _d(choke.get("round_s"))
+    peers = {
+        k: v for k, v in _d(s.get("peers")).items() if isinstance(v, dict)
+    }
+    overflow = s.get("overflow") if isinstance(s.get("overflow"), dict) else None
+    lines = [
+        "# HELP torrent_tpu_serve_peers Peers currently tracked by the serve plane",
+        "# TYPE torrent_tpu_serve_peers gauge",
+        f"torrent_tpu_serve_peers {_n(counts.get('serving'))}",
+        "# HELP torrent_tpu_serve_bytes_total Payload bytes served by egress path",
+        "# TYPE torrent_tpu_serve_bytes_total counter",
+    ]
+    # the fixed fallback-matrix columns always render (a dashboard can
+    # rate() them from first scrape); unexpected extras append sorted
+    path_names = list(_SERVE_PATHS) + sorted(
+        k for k in paths if k not in _SERVE_PATHS
+    )
+    for p in path_names:
+        row = paths.get(p) or {}
+        lines.append(
+            f'torrent_tpu_serve_bytes_total{{path="{_esc(str(p))}"}} '
+            f"{_n(row.get('bytes'))}"
+        )
+    lines.append(
+        "# HELP torrent_tpu_serve_blocks_total Payload blocks served by egress path"
+    )
+    lines.append("# TYPE torrent_tpu_serve_blocks_total counter")
+    for p in path_names:
+        row = paths.get(p) or {}
+        lines.append(
+            f'torrent_tpu_serve_blocks_total{{path="{_esc(str(p))}"}} '
+            f"{_n(row.get('blocks'))}"
+        )
+    lines.append(
+        "# HELP torrent_tpu_serve_rejects_total Serve-side rejections by reason"
+    )
+    lines.append("# TYPE torrent_tpu_serve_rejects_total counter")
+    for reason in _SERVE_REJECT_REASONS:
+        lines.append(
+            f'torrent_tpu_serve_rejects_total{{reason="{reason}"}} '
+            f"{_n(totals.get(f'rejects_{reason}'))}"
+        )
+    lines += [
+        "# HELP torrent_tpu_serve_gate_evictions_total Idle peers evicted by the accept gate",
+        "# TYPE torrent_tpu_serve_gate_evictions_total counter",
+        f"torrent_tpu_serve_gate_evictions_total {_n(totals.get('gate_evictions'))}",
+        "# HELP torrent_tpu_serve_queue_cancels_total Queued requests removed by BEP 3 Cancel before a worker served them",
+        "# TYPE torrent_tpu_serve_queue_cancels_total counter",
+        f"torrent_tpu_serve_queue_cancels_total {_n(totals.get('queue_cancels'))}",
+        "# HELP torrent_tpu_serve_choke_rounds_total Unchoke rounds completed",
+        "# TYPE torrent_tpu_serve_choke_rounds_total counter",
+        f"torrent_tpu_serve_choke_rounds_total {_n(totals.get('rounds'))}",
+        "# HELP torrent_tpu_serve_optimistic_rotations_total Optimistic unchoke slot rotations",
+        "# TYPE torrent_tpu_serve_optimistic_rotations_total counter",
+        f"torrent_tpu_serve_optimistic_rotations_total {_n(totals.get('optimistic_rotations'))}",
+        "# HELP torrent_tpu_serve_unchoked Peers unchoked by the last choke round",
+        "# TYPE torrent_tpu_serve_unchoked gauge",
+        f"torrent_tpu_serve_unchoked {_n(last.get('unchoked'))}",
+        "# HELP torrent_tpu_serve_interested Interested candidates seen by the last choke round",
+        "# TYPE torrent_tpu_serve_interested gauge",
+        f"torrent_tpu_serve_interested {_n(last.get('interested'))}",
+        "# HELP torrent_tpu_serve_choke_round_seconds Choke-round wall duration (log2 buckets)",
+        "# TYPE torrent_tpu_serve_choke_round_seconds histogram",
+    ]
+    from torrent_tpu.obs.hist import BUCKET_BOUNDS as _ROUND_BOUNDS
+
+    bucket_counts = choke.get("round_counts")
+    bucket_counts = bucket_counts if isinstance(bucket_counts, list) else []
+    cum = 0
+    for i, bound in enumerate(_ROUND_BOUNDS):
+        c = bucket_counts[i] if i < len(bucket_counts) else 0
+        cum += c if isinstance(c, int) else 0
+        lines.append(
+            f'torrent_tpu_serve_choke_round_seconds_bucket{{le="{bound:.10g}"}} {cum}'
+        )
+    count = _n(round_s.get("count"))
+    lines.append(
+        f'torrent_tpu_serve_choke_round_seconds_bucket{{le="+Inf"}} {count}'
+    )
+    total_s = _n(round_s.get("mean_s")) * count
+    lines.append(f"torrent_tpu_serve_choke_round_seconds_sum {total_s:.9g}")
+    lines.append(f"torrent_tpu_serve_choke_round_seconds_count {count}")
+
+    def _serve_peer_series(name, kind, help_text, get):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        for key in sorted(peers):
+            lines.append(f'{name}{{peer="{_esc(str(key))}"}} {get(peers[key])}')
+        if overflow is not None:
+            lines.append(f'{name}{{peer="overflow"}} {get(overflow)}')
+
+    _serve_peer_series(
+        "torrent_tpu_serve_peer_bytes_total", "counter",
+        "Payload bytes served to this peer",
+        lambda p: _n(p.get("bytes_up")),
+    )
+    _serve_peer_series(
+        "torrent_tpu_serve_peer_blocks_total", "counter",
+        "Payload blocks served to this peer",
+        lambda p: _n(p.get("blocks")),
+    )
+    _serve_peer_series(
+        "torrent_tpu_serve_peer_rejects_total", "counter",
+        "Requests from this peer rejected by the serve plane",
+        lambda p: _n(p.get("rejects")),
+    )
+    return "\n".join(lines) + "\n"
+
+
 def render_metrics(client) -> str:
     """The /metrics payload for one Client (Prometheus text format 0.0.4).
 
@@ -976,10 +1121,15 @@ class MetricsServer:
                 import json as _json
 
                 from torrent_tpu.obs.swarm import swarm_telemetry
+                from torrent_tpu.serve_plane.telemetry import serve_telemetry
 
-                body = _json.dumps(
-                    swarm_telemetry().snapshot(), sort_keys=True
-                ).encode()
+                payload = swarm_telemetry().snapshot()
+                serve_obs = serve_telemetry()
+                if serve_obs.active():
+                    # the serving-side view rides the same endpoint: who
+                    # we are feeding, over which egress paths
+                    payload["serve"] = serve_obs.snapshot()
+                body = _json.dumps(payload, sort_keys=True).encode()
                 status = "200 OK"
                 ctype = "application/json"
             elif len(parts) >= 2 and parts[0] == b"GET" and parts[1].split(b"?")[0] == b"/metrics":

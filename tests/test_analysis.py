@@ -5,7 +5,7 @@ Three layers of coverage:
 * **Seeded-violation fixtures** — per pass, a minimal synthetic package
   carrying exactly the hazard the pass exists to catch, plus a clean
   fixture that must produce zero findings (false-positive guard).
-* **Self-run** — the six passes over the real ``torrent_tpu`` package
+* **Self-run** — the eight passes over the real ``torrent_tpu`` package
   must produce findings ⊆ the committed baseline (the `torrent-tpu
   lint` gate), every baseline entry must carry a real justification,
   and the findings PR 13 *fixed* (rather than baselined) must stay
@@ -278,6 +278,7 @@ class TestDeterminismPass:
     def test_wallclock_and_random_in_plan(self, tmp_path):
         root = _fixture_pkg(tmp_path, {
             "fabric/plan.py": """
+            # determinism-scope: module
             import time, random
 
             def fingerprint(units):
@@ -293,6 +294,7 @@ class TestDeterminismPass:
     def test_unordered_iteration_flagged_and_sorted_exempt(self, tmp_path):
         root = _fixture_pkg(tmp_path, {
             "fabric/plan.py": """
+            # determinism-scope: module
             def fingerprint(verdicts):
                 bad = [k for k in verdicts.items()]
                 good = [k for k in sorted(verdicts.items())]
@@ -305,6 +307,7 @@ class TestDeterminismPass:
     def test_set_annotation_tracked(self, tmp_path):
         root = _fixture_pkg(tmp_path, {
             "fabric/plan.py": """
+            # determinism-scope: module
             class T:
                 def __init__(self):
                     self._distrust: set[int] = set()
@@ -319,7 +322,8 @@ class TestDeterminismPass:
         findings, _ = run_passes(root, ["determinism"])
         assert any("set-typed" in f.message for f in findings)
 
-    def test_out_of_scope_function_exempt(self, tmp_path):
+    def test_unmarked_function_exempt(self, tmp_path):
+        # no marker anywhere: nothing is in scope, whatever the path
         root = _fixture_pkg(tmp_path, {
             "fabric/executor.py": """
             import time
@@ -329,6 +333,239 @@ class TestDeterminismPass:
             """,
         })
         findings, _ = run_passes(root, ["determinism"])
+        assert findings == []
+
+    def test_function_marker_scopes_one_def(self, tmp_path):
+        # marker above a def (and on a def line) governs just that
+        # function; the sibling stays exempt
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import time
+
+            # determinism-scope
+            def governed():
+                return time.time()
+
+            def free():
+                return time.time()
+
+            def also_governed():  # determinism-scope
+                return time.time()
+            """,
+        })
+        findings, _ = run_passes(root, ["determinism"])
+        assert {f.symbol for f in findings} == {"governed", "also_governed"}
+
+    def test_marker_survives_decorator(self, tmp_path):
+        # fn.node.lineno is the def line even when decorated, so the
+        # marker sits between the decorator and the def
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import functools, time
+
+            @functools.lru_cache
+            # determinism-scope
+            def governed():
+                return time.time()
+            """,
+        })
+        findings, _ = run_passes(root, ["determinism"])
+        assert [f.symbol for f in findings] == ["governed"]
+
+    def test_stale_marker_is_a_finding(self, tmp_path):
+        # a bare marker attached to no def must not silently drop a
+        # builder from scope
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            X = 1
+            # determinism-scope
+
+            Y = 2
+            """,
+        })
+        findings, _ = run_passes(root, ["determinism"])
+        assert len(findings) == 1
+        assert "governs no function" in findings[0].message
+        assert findings[0].line == 3  # fixture strings open with a newline
+
+
+class TestWireTaintPass:
+    def test_direct_flow_caught_with_trace(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            def handle(buf):
+                msg = bdecode(buf)
+                n = msg["length"]
+                return bytearray(n)
+            """,
+        })
+        findings, _ = run_passes(root, ["wire-taint"])
+        assert len(findings) == 1
+        f = findings[0]
+        assert "bencode decode reaches allocation size" in f.message
+        # the finding carries the machine-traced flow, source -> sink,
+        # with enough steps to read as an attack path (>= 3)
+        assert len(f.flow) >= 3
+        assert "bencode decode" in f.flow[0][2]
+        assert all(path == "pkg/net/mod.py" for path, _, _ in f.flow)
+
+    def test_flow_through_helper_function(self, tmp_path):
+        # interprocedural: the source is inside a callee, the sink in
+        # the caller — the summary fixpoint must connect them
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            def parse(buf):
+                return bdecode(buf)
+
+            def handle(buf):
+                msg = parse(buf)
+                return bytearray(msg["length"])
+            """,
+        })
+        findings, _ = run_passes(root, ["wire-taint"])
+        assert len(findings) == 1
+        assert len(findings[0].flow) >= 3
+
+    def test_barrier_call_clears_taint(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            def handle(buf):
+                msg = bdecode(buf)
+                n = min(msg["length"], 16384)
+                return bytearray(n)
+            """,
+        })
+        findings, _ = run_passes(root, ["wire-taint"])
+        assert findings == []
+
+    def test_clamp_guard_clears_taint(self, tmp_path):
+        # the structural `if x > CAP: raise` idiom sanitizes x
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            def handle(buf):
+                msg = bdecode(buf)
+                n = msg["length"]
+                if n > 16384:
+                    raise ValueError(n)
+                return bytearray(n)
+            """,
+        })
+        findings, _ = run_passes(root, ["wire-taint"])
+        assert findings == []
+
+    def test_sanitized_by_suppresses_registered_barrier_only(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            def ok(buf):
+                msg = bdecode(buf)
+                return bytearray(msg["length"])  # sanitized-by: len-guard
+
+            def bad(buf):
+                msg = bdecode(buf)
+                return bytearray(msg["length"])  # sanitized-by: wishful
+            """,
+        })
+        findings, _ = run_passes(root, ["wire-taint"])
+        assert len(findings) == 1
+        assert "unregistered barrier 'wishful'" in findings[0].message
+
+    def test_clean_fixture_zero_findings(self, tmp_path):
+        # locally-derived sizes never touch the taint lattice
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            PIECE = 16384
+
+            def handle(i):
+                return bytearray(PIECE * (i % 4))
+            """,
+        })
+        findings, _ = run_passes(root, ["wire-taint"])
+        assert findings == []
+
+
+class TestBoundedStatePass:
+    def test_unbounded_remote_keyed_dict_caught(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            class Table:
+                def __init__(self):
+                    self.peers = {}
+
+                def on_announce(self, peer_id, addr):
+                    self.peers[peer_id] = addr
+            """,
+        })
+        findings, _ = run_passes(root, ["bounded-state"])
+        assert len(findings) == 1
+        assert "no statically visible cap" in findings[0].message
+        assert findings[0].symbol == "Table.peers"
+
+    def test_len_guard_is_cap_evidence(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            MAX = 64
+
+            class Table:
+                def __init__(self):
+                    self.peers = {}
+
+                def on_announce(self, peer_id, addr):
+                    if len(self.peers) >= MAX:
+                        return
+                    self.peers[peer_id] = addr
+            """,
+        })
+        findings, _ = run_passes(root, ["bounded-state"])
+        assert findings == []
+
+    def test_bounded_by_suppression_and_nonexistent_cap(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            MAX_PEERS = 64
+
+            class Table:
+                def __init__(self):
+                    self.capped = {}
+                    self.wishful = {}
+
+                def on_announce(self, peer_id, addr):
+                    self.capped[peer_id] = addr  # bounded-by: MAX_PEERS
+                    self.wishful[peer_id] = addr  # bounded-by: NO_SUCH_CAP
+            """,
+        })
+        findings, _ = run_passes(root, ["bounded-state"])
+        assert len(findings) == 1
+        assert "nonexistent cap 'NO_SUCH_CAP'" in findings[0].message
+
+    def test_deque_maxlen_exempt(self, tmp_path):
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            import collections
+
+            class Table:
+                def __init__(self):
+                    self.recent = collections.deque(maxlen=128)
+
+                def on_announce(self, peer_id):
+                    self.recent.append(peer_id)
+            """,
+        })
+        findings, _ = run_passes(root, ["bounded-state"])
+        assert findings == []
+
+    def test_locally_keyed_dict_clean(self, tmp_path):
+        # no remote-shaped name in the key: not this pass's business
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            class Lanes:
+                def __init__(self):
+                    self.by_stage = {}
+
+                def note(self, stage, v):
+                    self.by_stage[stage] = v
+            """,
+        })
+        findings, _ = run_passes(root, ["bounded-state"])
         assert findings == []
 
 
@@ -527,6 +764,52 @@ class TestGuardedStatePass:
             "mutation of C.pinned outside its guard _lock" in f.message
             for f in findings
         )
+
+    def test_guarded_by_nonexistent_lock_is_a_finding(self, tmp_path):
+        # declaring a guard the class never constructs is a typo or a
+        # rename survivor, not a valid suppression
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.x = 0
+
+                def bump(self):
+                    self.x += 1  # guarded-by: _loch
+            """,
+        })
+        findings, _ = run_passes(root, ["guarded-state"])
+        assert any(
+            "guarded-by names '_loch', which is not a lock of C"
+            in f.message
+            for f in findings
+        ), [f.format() for f in findings]
+
+    def test_unconsumed_guarded_by_annotation_is_a_finding(self, tmp_path):
+        # an annotation on a line with no attribute write documents a
+        # discipline the checker never sees
+        root = _fixture_pkg(tmp_path, {
+            "mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.y = 0
+
+                def bump(self):
+                    # guarded-by: _lock
+                    with self._lock:
+                        self.y += 1
+            """,
+        })
+        findings, _ = run_passes(root, ["guarded-state"])
+        assert any(
+            "sits on no attribute write" in f.message for f in findings
+        ), [f.format() for f in findings]
 
     def test_loop_confined_state_is_silent(self, tmp_path):
         # a lock-owning class whose OTHER attributes are never mutated
@@ -867,10 +1150,28 @@ class TestSelfRun:
             },
             "determinism": {
                 "fabric/plan.py": """
+                # determinism-scope: module
                 import time
 
                 def fingerprint():
                     return time.time()
+                """,
+            },
+            "wire-taint": {
+                "net/mod.py": """
+                def handle(buf):
+                    msg = bdecode(buf)
+                    return bytearray(msg["length"])
+                """,
+            },
+            "bounded-state": {
+                "net/mod.py": """
+                class Table:
+                    def __init__(self):
+                        self.peers = {}
+
+                    def on_announce(self, peer_id, addr):
+                        self.peers[peer_id] = addr
                 """,
             },
             "guarded-state": {
@@ -925,15 +1226,26 @@ class TestSelfRun:
         # gate is green against the fresh baseline
         assert lint_main(["--root", str(root), "--baseline", str(bl)]) == 0
 
-    def test_update_baseline_roundtrip_six_passes(self, tmp_path, capsys):
+    def test_update_baseline_roundtrip_eight_passes(self, tmp_path, capsys):
         """One violation per pass -> baseline -> green gate, with all
-        six pass names represented in the written baseline."""
+        eight pass names represented in the written baseline."""
         root = _fixture_pkg(tmp_path, {
             "net/mod.py": """
             import time
 
             async def bad():
                 time.sleep(1)
+
+            def taint(buf):
+                msg = bdecode(buf)
+                return bytearray(msg["length"])
+
+            class Table:
+                def __init__(self):
+                    self.peers = {}
+
+                def on_announce(self, peer_id, addr):
+                    self.peers[peer_id] = addr
             """,
             "mod.py": """
             import threading
@@ -970,6 +1282,7 @@ class TestSelfRun:
                     pool.checkin(slot)
             """,
             "fabric/plan.py": """
+            # determinism-scope: module
             import time
 
             def fingerprint():
@@ -1059,6 +1372,102 @@ class TestSelfRun:
                         "--baseline", str(tmp_path / "bl.json")])
         assert rc == 2
         assert not (tmp_path / "bl.json").exists()
+
+    def test_sarif_taint_finding_carries_code_flow(self, tmp_path):
+        """Taint findings emit SARIF codeFlows: source -> propagation ->
+        sink, every step with a uri/startLine/message."""
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            def handle(buf):
+                msg = bdecode(buf)
+                n = msg["length"]
+                return bytearray(n)
+            """,
+        })
+        sarif = tmp_path / "out.sarif"
+        rc = lint_main(["--root", str(root), "--sarif", str(sarif),
+                        "--baseline", str(tmp_path / "nope.json")])
+        assert rc == 1
+        doc = json.loads(sarif.read_text())
+        taint = [r for r in doc["runs"][0]["results"]
+                 if r["ruleId"] == "wire-taint"]
+        assert len(taint) == 1
+        steps = taint[0]["codeFlows"][0]["threadFlows"][0]["locations"]
+        assert len(steps) >= 3
+        for step in steps:
+            loc = step["location"]
+            assert loc["physicalLocation"]["artifactLocation"]["uri"]
+            assert loc["physicalLocation"]["region"]["startLine"] >= 1
+            assert loc["message"]["text"]
+
+    def test_prune_stale_drops_only_dead_entries(self, tmp_path, capsys):
+        # one live finding, one stale baseline entry: prune keeps the
+        # live one (justification intact) and prints what it dropped
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            import time
+
+            async def bad():
+                time.sleep(1)
+            """,
+        })
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps({
+            "version": 1,
+            "findings": [
+                {
+                    "pass": "blocking-in-async",
+                    "path": "pkg/net/mod.py",
+                    "symbol": "bad",
+                    "message": "blocking call time.sleep in coroutine",
+                    "justification": "reviewed: fixture",
+                },
+                {
+                    "pass": "blocking-in-async",
+                    "path": "pkg/net/gone.py",
+                    "symbol": "deleted_fn",
+                    "message": "blocking call time.sleep in coroutine",
+                    "justification": "reviewed: long gone",
+                },
+            ],
+        }))
+        rc = lint_main(["--root", str(root), "--baseline", str(bl),
+                        "--prune-stale"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "pruned:" in out and "gone.py" in out
+        doc = json.loads(bl.read_text())
+        assert len(doc["findings"]) == 1
+        assert doc["findings"][0]["symbol"] == "bad"
+        assert doc["findings"][0]["justification"] == "reviewed: fixture"
+        # the pruned baseline still gates green
+        assert lint_main(["--root", str(root), "--baseline", str(bl)]) == 0
+
+    def test_prune_stale_noop_when_clean(self, tmp_path, capsys):
+        root = _fixture_pkg(tmp_path, {
+            "net/mod.py": """
+            import time
+
+            async def bad():
+                time.sleep(1)
+            """,
+        })
+        bl = tmp_path / "bl.json"
+        assert lint_main(["--root", str(root), "--baseline", str(bl),
+                          "--update-baseline"]) == 0
+        before = bl.read_text()
+        assert lint_main(["--root", str(root), "--baseline", str(bl),
+                          "--prune-stale"]) == 0
+        assert "nothing to prune" in capsys.readouterr().out
+        assert bl.read_text() == before
+
+    def test_prune_stale_refuses_pass_subset(self, tmp_path, capsys):
+        # under --passes, entries of skipped passes all look stale —
+        # pruning would delete them and their justifications
+        rc = lint_main(["--passes", "lock-order", "--prune-stale",
+                        "--baseline", str(tmp_path / "bl.json")])
+        assert rc == 2
+        assert "requires a full run" in capsys.readouterr().err
 
     def test_lint_json_report(self, tmp_path, capsys):
         root = _fixture_pkg(tmp_path, {

@@ -312,6 +312,34 @@ class TestHostileInputHardening:
 
         run(go())
 
+    def test_announce_flood_of_fresh_hashes_churns_store(self, monkeypatch):
+        """wire-taint/bounded-state hardening: token-valid announces for
+        ever-fresh info-hashes must churn peer_store at the hash-count
+        cap, not grow it for a full TTL window."""
+        from torrent_tpu.net import dht as dht_mod
+
+        monkeypatch.setattr(dht_mod, "MAX_STORED_HASHES", 3)
+
+        async def go():
+            a = await DHTNode(host="127.0.0.1").start()
+            b = await DHTNode(host="127.0.0.1").start()
+            try:
+                hashes = [nid(0x1000 + i) for i in range(5)]
+                _, _, token = await a.get_peers(("127.0.0.1", b.port), hashes[0])
+                for ih in hashes:
+                    await a.announce_peer(("127.0.0.1", b.port), ih, 6881, token)
+                assert len(b.peer_store) == 3
+                # newest survive, oldest evicted in insertion order
+                assert hashes[-1] in b.peer_store
+                assert hashes[0] not in b.peer_store
+                # seed marks never orphan a hash the store dropped
+                assert set(b.seed_marks) <= set(b.peer_store)
+            finally:
+                a.close()
+                b.close()
+
+        run(go())
+
 
 class TestBep42:
     """BEP 42 DHT security: node ids derived from external IPs."""

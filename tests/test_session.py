@@ -467,6 +467,37 @@ class TestPoisonedPeerBan:
 
         run(go())
 
+    def test_strike_and_ban_tables_capped(self, monkeypatch):
+        """bounded-state hardening: strike/ban state is keyed by
+        attacker-minted IPs, so both tables must churn at capacity
+        instead of growing for the life of the session."""
+        from torrent_tpu.session import torrent as torrent_mod
+
+        monkeypatch.setattr(torrent_mod, "MAX_CORRUPTION_IPS", 3)
+        monkeypatch.setattr(torrent_mod, "MAX_BANNED_IPS", 2)
+
+        async def go():
+            t, _ = TestSchedulerUnits().make_torrent()
+            t.config.max_corrupt_pieces = 100  # strikes only, no bans yet
+            # the repeat offender accumulates strikes...
+            for _ in range(3):
+                t._credit_corruption({(b"A" * 20, "9.0.0.1")})
+            # ...then a burst of fresh one-strike IPs hits the cap: the
+            # least-incriminated entry is evicted, never the offender
+            for i in range(5):
+                t._credit_corruption({(b"A" * 20, f"1.0.0.{i}")})
+            assert len(t._corruption) == 3
+            assert "9.0.0.1" in t._corruption
+            # ban list: FIFO churn at capacity
+            t.config.max_corrupt_pieces = 1
+            for i in range(4):
+                t._credit_corruption({(b"B" * 20, f"2.0.0.{i}")})
+            assert len(t._banned) == 2
+            assert "2.0.0.3" in t._banned  # newest ban live
+            assert "2.0.0.0" not in t._banned  # oldest aged out
+
+        run(go())
+
     def test_absolve_decays_strikes(self):
         """A verified piece sheds a strike — honest co-contributors of a
         poisoner are not collaterally banned."""

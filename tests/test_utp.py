@@ -904,6 +904,38 @@ class TestPathMtu:
         run(go())
 
 
+class TestAcceptCap:
+    """bounded-state hardening: a spoofed-source SYN flood must not grow
+    per-connection state past MAX_LIVE_CONNS — at capacity fresh SYNs
+    get ST_RESET and no UtpConnection is allocated."""
+
+    def test_syn_flood_refused_at_capacity(self, monkeypatch):
+        monkeypatch.setattr(utp, "MAX_LIVE_CONNS", 1)
+
+        async def go():
+            server = await _echo_pair()
+            try:
+                reader, writer = await utp.open_utp_connection(
+                    "127.0.0.1", server.port, timeout=5
+                )
+                assert len(server._conns) == 1
+                sent = []
+                monkeypatch.setattr(
+                    server, "sendto", lambda data, addr: sent.append((data, addr))
+                )
+                syn = utp.encode_packet(utp.ST_SYN, 777, 1, 0)
+                server.datagram_received(syn, ("127.0.0.2", 40000))
+                assert len(server._conns) == 1  # refused, not grown
+                assert sent, "capacity refusal must answer, not black-hole"
+                ptype = utp.decode_packet(sent[-1][0])[0]
+                assert ptype == utp.ST_RESET
+                writer.close()
+            finally:
+                server.close()
+
+        run(go())
+
+
 class TestAdviceFixes:
     """Round-2 ADVICE items: ooo FIN, hostile-sender windows, dial keying."""
 

@@ -28,6 +28,11 @@ class Finding:
     line: int       # 1-based; informational only (not part of the key)
     symbol: str     # enclosing qualname ("Class.method", "<module>")
     message: str    # stable description — no line numbers, no volatile state
+    # taint flow: ((path, line, note), ...) source→propagation→sink steps.
+    # Informational like ``line`` — rendered as SARIF codeFlows, never
+    # part of the key (a flow re-route through the same sink is the
+    # same accepted finding).
+    flow: tuple = field(default=(), compare=False)
 
     @property
     def key(self) -> str:

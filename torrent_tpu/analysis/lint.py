@@ -1,17 +1,20 @@
 """``torrent-tpu lint`` / ``python -m torrent_tpu.analysis`` — the gate.
 
-Runs the six analysis passes over the package and compares the
+Runs the eight analysis passes over the package and compares the
 findings against the committed baseline (``torrent_tpu/
 analysis_baseline.json``): exit 0 when every finding is baselined (each baseline
 entry carries a reviewed justification), exit 1 on any NEW finding.
 Stale baseline entries (the finding was fixed) are reported but do not
-fail — refresh with ``--update-baseline``.
+fail — refresh with ``--update-baseline`` or drop just them with
+``--prune-stale``. Taint findings (wire-taint) carry their full
+source→propagation→sink flow, emitted as SARIF ``codeFlows``.
 
     torrent-tpu lint                      # gate against the baseline
     torrent-tpu lint --json               # machine-readable findings
     torrent-tpu lint --graph              # lock-order graph + attr->guard map
     torrent-tpu lint --sarif out.sarif    # SARIF 2.1.0 report (CI annotations)
     torrent-tpu lint --update-baseline    # re-baseline (keeps justifications)
+    torrent-tpu lint --prune-stale        # drop baseline entries nothing matches
     torrent-tpu lint --no-baseline        # raw findings, exit 1 if any
 """
 
@@ -76,6 +79,32 @@ def sarif_report(findings, baseline) -> dict:
             ],
             "partialFingerprints": {"torrentTpuFindingKey": f.key},
         }
+        if f.flow:
+            # dataflow findings are attack paths, not line numbers: one
+            # threadFlow from the decode boundary through every
+            # propagation hop to the sink
+            result["codeFlows"] = [
+                {
+                    "threadFlows": [
+                        {
+                            "locations": [
+                                {
+                                    "location": {
+                                        "physicalLocation": {
+                                            "artifactLocation": {"uri": path},
+                                            "region": {
+                                                "startLine": max(1, line)
+                                            },
+                                        },
+                                        "message": {"text": note},
+                                    }
+                                }
+                                for (path, line, note) in f.flow
+                            ]
+                        }
+                    ]
+                }
+            ]
         if entry is not None:
             result["suppressions"] = [
                 {
@@ -140,6 +169,11 @@ def main(argv=None) -> int:
         help="rewrite the baseline from current findings (justifications "
         "on unchanged entries are preserved; new entries get a TODO)",
     )
+    ap.add_argument(
+        "--prune-stale", action="store_true",
+        help="rewrite the baseline WITHOUT entries no current finding "
+        "matches (fixed debt); justifications on live entries are kept",
+    )
     ap.add_argument("--json", action="store_true", help="JSON findings report")
     ap.add_argument(
         "--graph", action="store_true",
@@ -202,6 +236,49 @@ def main(argv=None) -> int:
                 f"sarif written: {args.sarif} ({len(findings)} results)",
                 file=sys.stderr,
             )
+        return 0
+
+    if args.prune_stale:
+        if pass_names is not None:
+            # a subset run can't tell "fixed" from "pass not run": every
+            # entry of a skipped pass would look stale and be deleted
+            print(
+                "error: --prune-stale requires a full run (drop --passes)",
+                file=sys.stderr,
+            )
+            return 2
+        prev = load_baseline(baseline_path)
+        diff = diff_baseline(findings, prev)
+        if not diff.stale:
+            print("baseline has no stale entries — nothing to prune")
+            return 0
+        live = {k: e for k, e in prev.items()
+                if k not in {e.key for e in diff.stale}}
+        with open(baseline_path, "w") as fh:
+            json.dump(
+                {
+                    "version": 1,
+                    "findings": [
+                        {
+                            "pass": e.pass_name,
+                            "path": e.path,
+                            "symbol": e.symbol,
+                            "message": e.message,
+                            "justification": e.justification,
+                        }
+                        for e in live.values()
+                    ],
+                },
+                fh,
+                indent=2,
+            )
+            fh.write("\n")
+        for e in diff.stale:
+            print(f"pruned: {e.key}")
+        print(
+            f"baseline written: {baseline_path} "
+            f"({len(live)} entries, {len(diff.stale)} pruned)"
+        )
         return 0
 
     baseline = {} if args.no_baseline else load_baseline(baseline_path)

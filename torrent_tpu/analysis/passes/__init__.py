@@ -14,11 +14,13 @@ from pathlib import Path
 
 from torrent_tpu.analysis.passes import (
     blocking_async,
+    bounded_state,
     determinism,
     device_under_lock,
     guarded_state,
     lifecycle,
     lock_order,
+    wire_taint,
 )
 from torrent_tpu.analysis.passes.common import ModuleFile, PackageIndex
 
@@ -29,6 +31,8 @@ PASSES = {
     determinism.PASS_NAME: determinism,
     guarded_state.PASS_NAME: guarded_state,
     lifecycle.PASS_NAME: lifecycle,
+    wire_taint.PASS_NAME: wire_taint,
+    bounded_state.PASS_NAME: bounded_state,
 }
 
 ALL_PASS_NAMES = tuple(PASSES)

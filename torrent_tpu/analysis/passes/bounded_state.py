@@ -1,0 +1,347 @@
+"""bounded-state: remote-keyed collections must have a visible cap.
+
+The repo's cardinality discipline — tenant eviction, MAX_FLEET_PIDS,
+histogram label caps, per-trace span caps, the per-IP accept clamp —
+has been enforced by hand, one incident at a time. This pass turns it
+into a gate: any instance collection (dict/set/list/defaultdict/
+OrderedDict/deque) that *grows* under a key or value derived from the
+remote (peer IP/id, info-hash, origin, tenant, trace id — by name, or
+wire-tainted per the dataflow engine) must show one of:
+
+* a **len-guard**: a ``len(self.attr)`` comparison anywhere in the
+  class (the ``if len(self._hashes) >= self.max_hashes: evict`` idiom);
+* a **deque maxlen** at construction;
+* a **slice truncation** (``del self.attr[n:]`` / ``self.attr[n:] = []``);
+* a ``# bounded-by: <cap>`` annotation on the construction or growth
+  line, naming the symbol that bounds it out-of-band.
+
+Plain per-key ``del``/``.pop`` (TTL expiry) is deliberately NOT
+accepted: expiring old entries does not bound how many fresh keys an
+attacker can mint inside one TTL window — exactly the bug class this
+pass exists to catch. An annotation naming a cap symbol that does not
+exist in the module/class is itself a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from torrent_tpu.analysis.findings import Finding
+from torrent_tpu.analysis.passes.common import MUTATING_METHODS, PackageIndex
+from torrent_tpu.analysis.passes.dataflow import Registries, TaintAnalysis, _base_path
+
+PASS_NAME = "bounded-state"
+
+# substrings that mark a name as remote-derived (attacker-mintable).
+# Deliberately concrete: generic names ("key", "token", "target",
+# "host") false-positive on every internal map — a peer-keyed map that
+# hides behind a generic name needs the taint engine to catch it, or a
+# reviewer; this list is the *name* channel only.
+REMOTE_KEY_MARKERS = (
+    "info_hash", "infohash", "peer_id", "peer", "addr", "ip_",
+    "origin", "tenant", "trace_id", "node_id", "sender",
+)
+# exact names (short forms too risky for substring matching)
+REMOTE_KEY_EXACT = frozenset({"ih", "ip", "addr"})
+
+GROW_METHODS = frozenset(
+    {"setdefault", "add", "append", "appendleft", "insert", "extend",
+     "extendleft", "update"}
+)
+
+_COLLECTION_CALLS = frozenset(
+    {"dict", "set", "list", "defaultdict", "OrderedDict", "Counter", "deque"}
+)
+
+_BOUNDED_RE = re.compile(r"#\s*bounded-by:\s*([A-Za-z_][\w.]*)")
+
+
+@dataclass
+class _Collection:
+    cls: str
+    attr: str
+    module: str
+    line: int                      # construction line in __init__
+    capped: bool = False           # len-guard / maxlen / truncation seen
+    growth: list = field(default_factory=list)  # (line, fn, key_remote?)
+
+
+def _collection_ctor(value) -> bool:
+    """Is this __init__ RHS an empty/growable collection?"""
+    if isinstance(value, (ast.Dict, ast.Set, ast.List)):
+        return True
+    if isinstance(value, ast.Call):
+        from torrent_tpu.analysis.passes.common import tail_name
+
+        name = tail_name(value.func)
+        if name in _COLLECTION_CALLS:
+            if name == "deque":
+                for kw in value.keywords:
+                    if kw.arg == "maxlen" and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None
+                    ):
+                        return False  # bounded by construction
+            return True
+    return False
+
+
+def _names_in(expr) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+        elif isinstance(node, ast.arg):
+            out.add(node.arg)
+    return out
+
+
+def _looks_remote(expr) -> bool:
+    for name in _names_in(expr):
+        low = name.lower()
+        if low in REMOTE_KEY_EXACT:
+            return True
+        if any(m in low for m in REMOTE_KEY_MARKERS):
+            return True
+    return False
+
+
+def _is_tainted(expr, taint_engine) -> bool:
+    if taint_engine is None:
+        return False
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if taint_engine.trace_of(_base_path(node)) is not None:
+                return True
+    return False
+
+
+def _self_attr_of(expr) -> str | None:
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+def taint_analysis_for(index: PackageIndex, regs: Registries) -> TaintAnalysis:
+    """Memoized on the index: wire-taint and bounded-state share one
+    interprocedural run when driven from the same ``run_passes``."""
+    cached = getattr(index, "_taint_cache", None)
+    if cached is None:
+        cached = TaintAnalysis(index, regs)
+        index._taint_cache = cached
+    return cached
+
+
+def annotations_by_line(source: str) -> dict[int, str]:
+    out: dict[int, str] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _BOUNDED_RE.search(text)
+        if m:
+            out[i] = m.group(1)
+    return out
+
+
+def _module_symbols(tree: ast.Module, cls_name: str | None) -> set[str]:
+    """Names a ``# bounded-by: <cap>`` annotation may legally cite:
+    module globals, imports, class attributes, self attributes and
+    parameters of the class's methods."""
+    syms: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    syms.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            syms.add(node.target.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                syms.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ClassDef):
+            syms.add(node.name)
+            if cls_name is not None and node.name != cls_name:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            syms.add(t.id)
+                        else:
+                            a = _self_attr_of(t)
+                            if a:
+                                syms.add(a)
+                elif isinstance(sub, ast.AnnAssign):
+                    if isinstance(sub.target, ast.Name):
+                        syms.add(sub.target.id)
+                    else:
+                        a = _self_attr_of(sub.target)
+                        if a:
+                            syms.add(a)
+                elif isinstance(sub, ast.arg):
+                    syms.add(sub.arg)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            syms.add(node.name)
+    return syms
+
+
+def run(index, files) -> list[Finding]:
+    from torrent_tpu.analysis.passes import wire_taint
+
+    analysis = taint_analysis_for(index, wire_taint.registries())
+    trees = {mf.path: mf.tree for mf in files}
+    ann = {mf.path: annotations_by_line(mf.source) for mf in files}
+
+    # -- collect per-class collections from __init__
+    colls: dict[tuple[str, str, str], _Collection] = {}
+    for fn in index.functions:
+        if fn.cls is None or fn.name != "__init__":
+            continue
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            else:
+                continue
+            for tgt in targets:
+                attr = _self_attr_of(tgt)
+                if attr and _collection_ctor(node.value):
+                    colls[(fn.module, fn.cls, attr)] = _Collection(
+                        fn.cls, attr, fn.module, node.lineno
+                    )
+
+    # -- scan every method of those classes for growth + cap evidence
+    engines: dict[int, object] = {}
+    for fn in index.functions:
+        if fn.cls is None:
+            continue
+        relevant = [c for (m, c_, a), c in colls.items()
+                    if m == fn.module and c_ == fn.cls]
+        if not relevant:
+            continue
+        by_attr = {c.attr: c for c in relevant}
+        lazy_engine = [None]
+
+        def engine():
+            if lazy_engine[0] is None:
+                if id(fn) not in engines:
+                    engines[id(fn)] = analysis.function_taint(fn)
+                lazy_engine[0] = engines[id(fn)]
+            return lazy_engine[0]
+
+        for node in ast.walk(fn.node):
+            # len(self.attr) compared against anything => capacity-aware
+            # (covers ``len(self.peers) + len(self._dialing) >= cap`` too)
+            if isinstance(node, ast.Compare):
+                for sub in ast.walk(node):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and sub.func.id == "len"
+                        and sub.args
+                    ):
+                        a = _self_attr_of(sub.args[0])
+                        if a in by_attr:
+                            by_attr[a].capped = True
+            # del self.attr[n:] / self.attr[n:] = ... truncation
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and isinstance(
+                        t.slice, ast.Slice
+                    ):
+                        a = _self_attr_of(t.value)
+                        if a in by_attr:
+                            by_attr[a].capped = True
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        if isinstance(t.slice, ast.Slice):
+                            a = _self_attr_of(t.value)
+                            if a in by_attr:
+                                by_attr[a].capped = True
+                            continue
+                        # self.attr[key] = value / += delta — growth
+                        a = _self_attr_of(t.value)
+                        if a in by_attr:
+                            remote = _looks_remote(t.slice) or _is_tainted(
+                                t.slice, engine()
+                            )
+                            by_attr[a].growth.append(
+                                (node.lineno, fn.qualname, remote)
+                            )
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in GROW_METHODS
+                    and f.attr in MUTATING_METHODS
+                ):
+                    a = _self_attr_of(f.value)
+                    if a in by_attr:
+                        probe = ast.Tuple(
+                            elts=list(node.args)
+                            + [kw.value for kw in node.keywords],
+                            ctx=ast.Load(),
+                        )
+                        remote = _looks_remote(probe) or _is_tainted(
+                            probe, engine()
+                        )
+                        by_attr[a].growth.append(
+                            (node.lineno, fn.qualname, remote)
+                        )
+
+    # -- report
+    findings: list[Finding] = []
+    for (module, cls, attr), coll in sorted(colls.items()):
+        remote_growth = [(ln, fn_q) for (ln, fn_q, r) in coll.growth if r]
+        if not remote_growth or coll.capped:
+            continue
+        lines = ann.get(module, {})
+        cap = lines.get(coll.line)
+        grow_line, grow_fn = remote_growth[0]
+        if cap is None:
+            for ln, _fn_q in remote_growth:
+                if ln in lines:
+                    cap = lines[ln]
+                    break
+        symbol = f"{cls}.{attr}"
+        if cap is not None:
+            syms = _module_symbols(trees[module], cls)
+            if cap.split(".")[-1] in syms or cap in syms:
+                continue  # bounded out-of-band by a real symbol
+            findings.append(
+                Finding(
+                    PASS_NAME,
+                    module,
+                    coll.line,
+                    symbol,
+                    f"bounded-by names nonexistent cap {cap!r} — the "
+                    f"annotation is inert; name a real symbol or add an "
+                    f"eviction path",
+                )
+            )
+            continue
+        findings.append(
+            Finding(
+                PASS_NAME,
+                module,
+                grow_line,
+                symbol,
+                f"remote-keyed collection grows in {grow_fn} with no "
+                f"statically visible cap (no len-guard, maxlen, or "
+                f"truncation; TTL expiry does not bound fresh keys) — "
+                f"add eviction or # bounded-by: <cap>",
+            )
+        )
+    return findings

@@ -323,7 +323,7 @@ class PackageIndex:
             self._index_module(mf)
         for fn in self.functions:
             _FnWalker(fn).walk()
-        self._resolved: dict[int, FunctionInfo | None] = {}
+        self._resolved: dict = {}  # id(CallSite) | ("expr", id(node)) -> fn
         self._trans_acquires: dict[int, frozenset[str]] = {}
         self._trans_device: dict[int, bool] = {}
         self._fixpoint()
@@ -378,6 +378,18 @@ class PackageIndex:
         if key in self._resolved:
             return self._resolved[key]
         out = self._resolve_uncached(caller, site)
+        self._resolved[key] = out
+        return out
+
+    def resolve_call(self, caller: FunctionInfo, func_expr) -> FunctionInfo | None:
+        """Resolve a call by its ``func`` expression node. Unlike
+        :meth:`resolve`, safe for ad-hoc queries: AST nodes live as long
+        as the index, so the cache key cannot be reused the way the id
+        of a thrown-away CallSite can."""
+        key = ("expr", id(func_expr))
+        if key in self._resolved:
+            return self._resolved[key]
+        out = self._resolve_uncached(caller, CallSite(func_expr, (), 0))
         self._resolved[key] = out
         return out
 

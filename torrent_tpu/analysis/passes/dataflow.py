@@ -1,0 +1,710 @@
+"""Forward interprocedural taint dataflow over the PackageIndex.
+
+Everything this system serves first arrives as attacker-controlled wire
+input — bencode frames, peer-wire messages, DHT packets, tracker
+announces. The concurrency passes gate *when* code runs; nothing gated
+*where remote bytes flow*. This engine closes that hole: a forward
+abstract interpretation of each function (assignments, calls, returns,
+match-case destructuring), field-sensitive for decoded message
+dicts/dataclasses, composed interprocedurally through function
+summaries iterated to a fixpoint over the same conservatively-resolved
+call graph the lockset pass uses.
+
+Abstract state per function: a map of **taint paths** — ``("msg",)``
+for a whole decoded message, ``("msg", "length")`` for one field — to
+the :class:`FlowTrace` that explains *how* the value got tainted (the
+raw material of SARIF ``codeFlows``). A path is tainted when it or any
+prefix is in the map, unless the exact path has been *sanitized* by a
+registered validation barrier.
+
+Three registries (owned by the ``wire-taint`` pass, passed in):
+
+* **sources** — calls whose return value is wire bytes
+  (``bdecode``, ``decode_message`` …) and functions whose *parameters*
+  arrive tainted (datagram handlers, bridge request bodies);
+* **barriers** — validation choke points. Two shapes: a *value barrier*
+  returns a clean version of its argument (``min(x, CAP)``); a *guard
+  barrier* is called for effect (``validate_requested_block(...)``) and
+  sanitizes the argument paths for the rest of the function. The
+  clamp idiom ``if x > CAP: raise`` is recognized structurally: a
+  comparison of a tainted path against anything inside an ``if`` whose
+  body unconditionally escapes (raise/return/continue/break) sanitizes
+  that path afterward.
+* **sinks** — calls where a remote-sized value becomes dangerous:
+  allocation sizes, slab/row indices, IO offsets+lengths, file-path
+  construction, loop bounds, admission charges.
+
+Soundness direction: like every static pass here this
+**under-approximates** — loops are walked once, branches union into one
+state, cross-object attribute flows and unresolvable calls are not
+traversed. A clean report is not a proof; a finding is a real,
+machine-traced attack path from a decode boundary to a sink.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from torrent_tpu.analysis.passes.common import (
+    FunctionInfo,
+    PackageIndex,
+    dotted_name,
+    tail_name,
+)
+
+# taint paths: ("var",) or ("var", "field") — one level of field
+# sensitivity is enough to tell msg.length from msg.index
+Path = tuple[str, ...]
+
+# calls that return a value bounded by data the process already holds
+# (len of a received buffer can never exceed the buffer), or an
+# intrinsically clean scalar — implicit value barriers
+_CLEAN_CALLS = frozenset({"len", "bool", "id", "hash", "isinstance", "type"})
+
+# a value pushed through these keeps its provenance
+_IDENTITY_CALLS = frozenset({"int", "float", "str", "abs", "bytes", "bytearray",
+                             "list", "tuple", "dict", "set", "frozenset",
+                             "sorted", "reversed", "enumerate", "zip", "iter",
+                             "next", "repr", "ord", "chr", "sum", "max"})
+# NB: ``min`` is deliberately NOT identity — min(x, CAP) is the clamp
+# idiom, a value barrier. ``max`` stays identity (max raises the value).
+_VALUE_BARRIER_CALLS = frozenset({"min"})
+
+
+@dataclass(frozen=True)
+class FlowStep:
+    """One hop of a taint flow (== one SARIF threadFlow location)."""
+
+    path: str   # repo-relative module path
+    line: int
+    note: str   # human-readable: what happened at this hop
+
+    def as_tuple(self) -> tuple:
+        return (self.path, self.line, self.note)
+
+
+@dataclass(frozen=True)
+class FlowTrace:
+    """Provenance of one tainted value: source step + propagation steps.
+
+    ``root`` distinguishes true wire sources ("source") from the
+    all-params-tainted summary runs (the param's name), so summary
+    consumers know which parameter a flow entered through.
+    """
+
+    root: str
+    steps: tuple[FlowStep, ...]
+
+    def extend(self, step: FlowStep) -> "FlowTrace":
+        # bound the trace: a pathological chain must not OOM the linter;
+        # keep the source and the most recent hops
+        steps = self.steps
+        if len(steps) >= 12:
+            steps = steps[:1] + steps[-10:]
+        return FlowTrace(self.root, steps + (step,))
+
+
+@dataclass(frozen=True)
+class SinkHit:
+    """A tainted value reaching a sink inside some function."""
+
+    kind: str            # sink family ("allocation size", "loop bound" …)
+    sink_note: str       # what the sink call is
+    module: str
+    line: int
+    trace: FlowTrace     # full flow: source … propagation … (sink appended)
+
+
+@dataclass
+class Summary:
+    """Interprocedural behavior of one function, fixpointed."""
+
+    returns_source: bool = False          # return is wire-tainted outright
+    param_to_return: set[str] = field(default_factory=set)
+    # param name -> sink hits a tainted argument would cause inside
+    param_sinks: dict[str, list[SinkHit]] = field(default_factory=dict)
+    # trace explaining returns_source (for codeFlows through helpers)
+    return_trace: FlowTrace | None = None
+
+
+class Registries:
+    """The wire-taint pass's source/sink/barrier model, decoupled from
+    the engine so fixtures can run with a tiny synthetic model."""
+
+    def __init__(
+        self,
+        source_calls: dict[str, str],          # tail/dotted name -> note
+        source_params: dict[str, frozenset[str]],  # fn qualname tail -> params
+        barrier_calls: frozenset[str],         # tail names (guard barriers)
+        sink_calls: dict[str, tuple[str, tuple[int, ...] | None]],
+        sink_dotted: dict[str, tuple[str, tuple[int, ...] | None]],
+    ):
+        self.source_calls = source_calls
+        self.source_params = source_params
+        self.barrier_calls = barrier_calls
+        self.sink_calls = sink_calls        # tail name -> (kind, arg idxs|None=all)
+        self.sink_dotted = sink_dotted      # dotted name -> same
+
+
+def _base_path(expr) -> Path | None:
+    """Taint path of an expression that *names* a value: a local
+    ``x`` -> ("x",); ``x.f`` -> ("x","f"); ``self.f`` -> ("self","f");
+    ``x[k]``/``x.f[k]`` collapse to their base path (container taint is
+    per-container, element reads inherit it)."""
+    if isinstance(expr, ast.Name):
+        return (expr.id,)
+    if isinstance(expr, ast.Attribute):
+        base = _base_path(expr.value)
+        if base is None:
+            return None
+        if len(base) >= 2:          # one level of field sensitivity
+            return base
+        return base + (expr.attr,)
+    if isinstance(expr, ast.Subscript):
+        return _base_path(expr.value)
+    return None
+
+
+class _Engine:
+    """One analysis run of one function body."""
+
+    def __init__(
+        self,
+        index: PackageIndex,
+        fn: FunctionInfo,
+        regs: Registries,
+        summaries: dict[int, Summary],
+        taint_params: bool,
+    ):
+        self.index = index
+        self.fn = fn
+        self.regs = regs
+        self.summaries = summaries
+        self.taint: dict[Path, FlowTrace] = {}
+        self.sanitized: set[Path] = set()
+        self.hits: list[SinkHit] = []
+        self.returns: list[FlowTrace] = []
+        node = fn.node
+        params: list[str] = []
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            for a in (
+                list(args.posonlyargs) + list(args.args)
+                + list(args.kwonlyargs)
+                + ([args.vararg] if args.vararg else [])
+                + ([args.kwarg] if args.kwarg else [])
+            ):
+                if a.arg not in ("self", "cls"):
+                    params.append(a.arg)
+        self.params = params
+        if taint_params:
+            for p in params:
+                self.taint[(p,)] = FlowTrace(
+                    p,
+                    (FlowStep(fn.module, node.lineno,
+                              f"parameter {p} of {fn.qualname}"),),
+                )
+        else:
+            # declared param sources: handlers whose arguments ARE the wire
+            for key, names in regs.source_params.items():
+                if fn.qualname == key or fn.name == key:
+                    for p in params:
+                        if p in names:
+                            self.taint[(p,)] = FlowTrace(
+                                "source",
+                                (FlowStep(
+                                    fn.module, node.lineno,
+                                    f"untrusted wire input: parameter {p} "
+                                    f"of {fn.qualname}"),),
+                            )
+
+    # ---------------------------------------------------------- queries
+
+    def trace_of(self, path: Path | None) -> FlowTrace | None:
+        if path is None:
+            return None
+        if path in self.sanitized:
+            return None
+        for n in range(len(path), 0, -1):
+            pre = path[:n]
+            if pre in self.sanitized:
+                return None
+            t = self.taint.get(pre)
+            if t is not None:
+                return t
+        return None
+
+    def _sanitize(self, path: Path | None, line: int) -> None:
+        if path is None:
+            return
+        self.sanitized.add(path)
+        # sanitizing a whole variable also clears its fields
+        if len(path) == 1:
+            for p in list(self.taint):
+                if p[0] == path[0]:
+                    self.taint.pop(p)
+            self.taint.pop(path, None)
+
+    # ------------------------------------------------------- expressions
+
+    def eval(self, expr) -> FlowTrace | None:
+        """Taint trace of an expression's value, or None when clean."""
+        if expr is None or isinstance(expr, ast.Constant):
+            return None
+        if isinstance(expr, (ast.Name, ast.Attribute, ast.Subscript)):
+            t = self.trace_of(_base_path(expr))
+            if t is not None and isinstance(expr, ast.Attribute):
+                base = _base_path(expr.value)
+                if base is not None and self.trace_of(base) is t:
+                    return t.extend(FlowStep(
+                        self.fn.module, expr.lineno,
+                        f"field read .{expr.attr}"))
+            if isinstance(expr, ast.Subscript):
+                # index taint matters too: d[tainted] as a VALUE is
+                # whatever the container held; not propagated here
+                pass
+            return t
+        if isinstance(expr, ast.Call):
+            return self._eval_call(expr)
+        if isinstance(expr, ast.BinOp):
+            return self.eval(expr.left) or self.eval(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.eval(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                t = self.eval(v)
+                if t:
+                    return t
+            return None
+        if isinstance(expr, ast.Compare):
+            return None  # a bool is not a size
+        if isinstance(expr, ast.IfExp):
+            return self.eval(expr.body) or self.eval(expr.orelse)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            for e in expr.elts:
+                t = self.eval(e)
+                if t:
+                    return t
+            return None
+        if isinstance(expr, ast.Dict):
+            for e in list(expr.keys) + list(expr.values):
+                t = self.eval(e)
+                if t:
+                    return t
+            return None
+        if isinstance(expr, ast.Starred):
+            return self.eval(expr.value)
+        if isinstance(expr, ast.JoinedStr):
+            for v in expr.values:
+                t = self.eval(v)
+                if t:
+                    return t
+            return None
+        if isinstance(expr, ast.FormattedValue):
+            return self.eval(expr.value)
+        if isinstance(expr, ast.Slice):
+            return self.eval(expr.lower) or self.eval(expr.upper) or self.eval(expr.step)
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            t = None
+            for gen in expr.generators:
+                gt = self.eval(gen.iter)
+                if gt:
+                    tgt = _base_path(gen.target)
+                    if tgt:
+                        self.taint[tgt] = gt.extend(FlowStep(
+                            self.fn.module, expr.lineno, "iteration element"))
+                    t = t or gt
+            return t or self.eval(expr.elt if hasattr(expr, "elt") else None)
+        if isinstance(expr, ast.DictComp):
+            for gen in expr.generators:
+                t = self.eval(gen.iter)
+                if t:
+                    return t
+            return self.eval(expr.key) or self.eval(expr.value)
+        if isinstance(expr, ast.Await):
+            return self.eval(expr.value)
+        if isinstance(expr, ast.NamedExpr):
+            t = self.eval(expr.value)
+            tgt = _base_path(expr.target)
+            if tgt is not None:
+                if t:
+                    self.taint[tgt] = t
+                else:
+                    self.taint.pop(tgt, None)
+            return t
+        return None
+
+    def _call_name(self, call: ast.Call) -> tuple[str | None, str | None]:
+        return tail_name(call.func), dotted_name(call.func)
+
+    def _eval_call(self, call: ast.Call) -> FlowTrace | None:
+        tail, dn = self._call_name(call)
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        arg_traces = [self.eval(a) for a in args]
+
+        # ---- sinks first: the call consumes the value as-is
+        self._check_sink(call, tail, dn, args, arg_traces)
+
+        # ---- barriers
+        if tail in self.regs.barrier_calls or (dn and dn in self.regs.barrier_calls):
+            for a in args:
+                self._sanitize(_base_path(a), call.lineno)
+            return None
+        if tail in _VALUE_BARRIER_CALLS:
+            return None
+        if tail in _CLEAN_CALLS:
+            return None
+
+        # ---- sources
+        note = None
+        if dn and dn in self.regs.source_calls:
+            note = self.regs.source_calls[dn]
+        elif tail in self.regs.source_calls:
+            note = self.regs.source_calls[tail]
+        if note is not None:
+            return FlowTrace("source", (FlowStep(
+                self.fn.module, call.lineno, f"untrusted wire input: {note}"),))
+
+        # ---- interprocedural: resolved callee summary
+        callee = self.index.resolve_call(self.fn, call.func)
+        if callee is not None:
+            summ = self.summaries.get(id(callee))
+            if summ is not None:
+                # param-position mapping: positional args only (methods
+                # drop self in the summary's param list)
+                names = _callee_params(callee)
+                for i, (a, t) in enumerate(zip(call.args, arg_traces)):
+                    if t is None or i >= len(names):
+                        continue
+                    pname = names[i]
+                    for hit in summ.param_sinks.get(pname, ()):
+                        self.hits.append(SinkHit(
+                            hit.kind, hit.sink_note, hit.module, hit.line,
+                            _splice(t, self.fn, call, callee, hit),
+                        ))
+                for kw in call.keywords:
+                    t = self.eval(kw.value)
+                    if t is None or kw.arg is None:
+                        continue
+                    for hit in summ.param_sinks.get(kw.arg, ()):
+                        self.hits.append(SinkHit(
+                            hit.kind, hit.sink_note, hit.module, hit.line,
+                            _splice(t, self.fn, call, callee, hit),
+                        ))
+                if summ.returns_source:
+                    base = summ.return_trace or FlowTrace("source", ())
+                    return base.extend(FlowStep(
+                        self.fn.module, call.lineno,
+                        f"returned by {callee.qualname}()"))
+                ret_params = summ.param_to_return
+                for i, (a, t) in enumerate(zip(call.args, arg_traces)):
+                    if t is not None and i < len(names) and names[i] in ret_params:
+                        return t.extend(FlowStep(
+                            self.fn.module, call.lineno,
+                            f"flows through {callee.qualname}()"))
+                for kw in call.keywords:
+                    if kw.arg in ret_params:
+                        t = self.eval(kw.value)
+                        if t is not None:
+                            return t.extend(FlowStep(
+                                self.fn.module, call.lineno,
+                                f"flows through {callee.qualname}()"))
+                return None
+
+        # ---- unresolved call: identity builtins propagate, methods on a
+        # tainted receiver stay tainted (payload.split(), d.get(k) …)
+        if tail in _IDENTITY_CALLS:
+            for t in arg_traces:
+                if t is not None:
+                    return t
+            return None
+        if isinstance(call.func, ast.Attribute):
+            t = self.trace_of(_base_path(call.func.value))
+            if t is not None:
+                return t.extend(FlowStep(
+                    self.fn.module, call.lineno, f"via .{call.func.attr}()"))
+        return None
+
+    def _check_sink(self, call, tail, dn, args, arg_traces) -> None:
+        spec = None
+        if dn and dn in self.regs.sink_dotted:
+            spec = self.regs.sink_dotted[dn]
+        elif tail in self.regs.sink_calls:
+            spec = self.regs.sink_calls[tail]
+        if spec is None:
+            return
+        kind, idxs = spec
+        for i, (a, t) in enumerate(zip(args, arg_traces)):
+            if t is None:
+                continue
+            if idxs is not None and i not in idxs:
+                continue
+            name = dn or tail or "?"
+            self.hits.append(SinkHit(
+                kind, f"{name}()", self.fn.module, call.lineno,
+                t.extend(FlowStep(
+                    self.fn.module, call.lineno,
+                    f"reaches {kind} sink {name}()")),
+            ))
+
+    # -------------------------------------------------------- statements
+
+    def run(self) -> None:
+        self._stmts(self.fn.node.body)
+
+    def _stmts(self, body) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _escapes(self, body) -> bool:
+        return any(
+            isinstance(s, (ast.Raise, ast.Return, ast.Continue, ast.Break))
+            for s in body
+        )
+
+    def _stmt(self, stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # separate FunctionInfo
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            t = self.eval(value)
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            for tgt in targets:
+                self._assign(tgt, t, stmt, aug=isinstance(stmt, ast.AugAssign))
+            return
+        if isinstance(stmt, ast.If):
+            self._clamp_guard(stmt)
+            self.eval(stmt.test)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = stmt.iter
+            # range(tainted) loop bound is checked by eval's sink pass
+            t = self.eval(it)
+            if t is not None:
+                tgt = _base_path(stmt.target)
+                if tgt is not None:
+                    self.taint[tgt] = t.extend(FlowStep(
+                        self.fn.module, stmt.lineno, "iteration element"))
+                elif isinstance(stmt.target, ast.Tuple):
+                    for e in stmt.target.elts:
+                        p = _base_path(e)
+                        if p is not None:
+                            self.taint[p] = t.extend(FlowStep(
+                                self.fn.module, stmt.lineno,
+                                "iteration element"))
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self.eval(stmt.test)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                t = self.eval(item.context_expr)
+                if item.optional_vars is not None:
+                    p = _base_path(item.optional_vars)
+                    if p is not None and t is not None:
+                        self.taint[p] = t
+            self._stmts(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for h in stmt.handlers:
+                self._stmts(h.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Match):
+            subj = self.eval(stmt.subject)
+            for case in stmt.cases:
+                if subj is not None:
+                    for name, line in _pattern_bindings(case.pattern):
+                        self.taint[(name,)] = subj.extend(FlowStep(
+                            self.fn.module, line,
+                            f"destructured into {name}"))
+                self._stmts(case.body)
+            return
+        if isinstance(stmt, ast.Return):
+            t = self.eval(stmt.value)
+            if t is not None:
+                self.returns.append(t)
+            return
+        if isinstance(stmt, ast.Expr):
+            self.eval(stmt.value)
+            return
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            if isinstance(stmt, ast.Assert):
+                self._assert_guard(stmt)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                p = _base_path(tgt)
+                if p is not None:
+                    self.taint.pop(p, None)
+            return
+        # Global/Nonlocal/Pass/Import...: nothing to do
+
+    def _assign(self, tgt, t: FlowTrace | None, stmt, aug: bool = False) -> None:
+        if isinstance(tgt, ast.Tuple):
+            for e in tgt.elts:
+                self._assign(e, t, stmt, aug)
+            return
+        p = _base_path(tgt)
+        if p is None:
+            return
+        if t is not None:
+            self.taint[p] = t.extend(FlowStep(
+                self.fn.module, stmt.lineno,
+                f"assigned to {'.'.join(p)}"))
+            self.sanitized.discard(p)
+        elif not aug and isinstance(tgt, ast.Name):
+            # a clean re-assignment kills the old taint (linear walk)
+            for q in list(self.taint):
+                if q[0] == p[0]:
+                    self.taint.pop(q)
+
+    def _clamp_guard(self, stmt: ast.If) -> None:
+        """``if <tainted cmp …>: raise/return/continue/break`` sanitizes
+        the tainted comparison operand afterward — the repo's clamp
+        idiom (``if length > MAX_MESSAGE_LEN: raise``)."""
+        test = stmt.test
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            test = test.operand
+        if not isinstance(test, ast.Compare):
+            return
+        if not self._escapes(stmt.body):
+            return
+        for side in [test.left] + list(test.comparators):
+            p = _base_path(side)
+            if p is not None and self.trace_of(p) is not None:
+                self._sanitize(p, stmt.lineno)
+            # ``if not 0 <= x < cap: raise`` with x inside a len() etc.
+            if isinstance(side, ast.Call):
+                for a in side.args:
+                    q = _base_path(a)
+                    if q is not None and self.trace_of(q) is not None:
+                        self._sanitize(q, stmt.lineno)
+
+    def _assert_guard(self, stmt: ast.Assert) -> None:
+        test = stmt.test
+        if isinstance(test, ast.Compare):
+            for side in [test.left] + list(test.comparators):
+                p = _base_path(side)
+                if p is not None and self.trace_of(p) is not None:
+                    self._sanitize(p, stmt.lineno)
+
+
+def _pattern_bindings(pattern) -> list[tuple[str, int]]:
+    """Names a match-case pattern binds from the subject."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(pattern):
+        if isinstance(node, ast.MatchAs) and node.name:
+            out.append((node.name, node.lineno))
+        elif isinstance(node, ast.MatchStar) and node.name:
+            out.append((node.name, node.lineno))
+        elif isinstance(node, ast.MatchMapping) and node.rest:
+            out.append((node.rest, node.lineno))
+    return out
+
+
+def _callee_params(fn: FunctionInfo) -> list[str]:
+    node = fn.node
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return []
+    names = [a.arg for a in list(node.args.posonlyargs) + list(node.args.args)]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def _splice(caller_trace: FlowTrace, fn: FunctionInfo, call: ast.Call,
+            callee: FunctionInfo, hit: SinkHit) -> FlowTrace:
+    """Join a caller-side trace to a callee-side sink trace: source …
+    call-site hop … the callee's own propagation steps."""
+    t = caller_trace.extend(FlowStep(
+        fn.module, call.lineno, f"passed into {callee.qualname}()"))
+    # drop the callee trace's synthetic "parameter" root step, keep the rest
+    inner = tuple(s for s in hit.trace.steps[1:])
+    steps = t.steps + inner
+    if len(steps) > 16:
+        steps = steps[:3] + steps[-13:]
+    return FlowTrace(caller_trace.root, steps)
+
+
+# ---------------------------------------------------------------- driver
+
+
+class TaintAnalysis:
+    """Whole-package run: summaries to fixpoint, then source-mode hits."""
+
+    def __init__(self, index: PackageIndex, regs: Registries):
+        self.index = index
+        self.regs = regs
+        self.summaries: dict[int, Summary] = {
+            id(fn): Summary() for fn in index.functions
+        }
+        self._fixpoint()
+        self.hits: list[SinkHit] = self._collect()
+
+    def _summarize(self, fn: FunctionInfo) -> Summary:
+        eng = _Engine(self.index, fn, self.regs, self.summaries,
+                      taint_params=True)
+        eng.run()
+        s = Summary()
+        for t in eng.returns:
+            if t.root == "source":
+                s.returns_source = True
+                if s.return_trace is None:
+                    s.return_trace = t
+            else:
+                s.param_to_return.add(t.root)
+        for hit in eng.hits:
+            if hit.trace.root == "source":
+                continue  # a true source flow; reported by _collect
+            s.param_sinks.setdefault(hit.trace.root, []).append(hit)
+        # a function that CALLS a source and returns it is itself a
+        # source; handled because eval tags those traces root="source"
+        return s
+
+    def _fixpoint(self) -> None:
+        # iterate until summaries stabilize; depth of real call chains
+        # here is small — cap the rounds to stay linter-fast
+        for _ in range(6):
+            changed = False
+            for fn in self.index.functions:
+                new = self._summarize(fn)
+                old = self.summaries[id(fn)]
+                if (
+                    new.returns_source != old.returns_source
+                    or new.param_to_return != old.param_to_return
+                    or {k: len(v) for k, v in new.param_sinks.items()}
+                    != {k: len(v) for k, v in old.param_sinks.items()}
+                ):
+                    changed = True
+                self.summaries[id(fn)] = new
+            if not changed:
+                break
+
+    def _collect(self) -> list[SinkHit]:
+        hits: list[SinkHit] = []
+        for fn in self.index.functions:
+            eng = _Engine(self.index, fn, self.regs, self.summaries,
+                          taint_params=False)
+            eng.run()
+            hits.extend(h for h in eng.hits if h.trace.root == "source")
+        return hits
+
+    def function_taint(self, fn: FunctionInfo) -> "_Engine":
+        """Re-run one function in source mode and return the engine (the
+        bounded-state pass reads its final taint map)."""
+        eng = _Engine(self.index, fn, self.regs, self.summaries,
+                      taint_params=False)
+        eng.run()
+        return eng

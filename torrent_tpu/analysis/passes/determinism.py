@@ -7,12 +7,23 @@ coverage/adoption rules assume each process evaluates the same state.
 Wall-clock reads, randomness, and unordered ``set``/``dict`` iteration
 are the three ways nondeterminism leaks into those bytes.
 
-Scope is explicit (``SCOPE``): all of ``fabric/plan.py``, the executor
-functions that build, merge, or consume exchanged heartbeat state, and
-the obs-plane helpers whose output rides those heartbeats
-(``obs/tracer.py``'s span-context builders — trace ids and span
-payloads exchanged between processes must be as bit-stable as the
-verdicts they annotate). Within scope, the pass flags:
+Scope is declared IN the code it governs, by marker comment, and
+discovered from the PackageIndex (for five PRs the scope lived here as
+a hand-grown module list — which meant a new heartbeat/digest builder
+silently dodged the pass until someone remembered to edit the linter):
+
+* ``# determinism-scope: module`` anywhere in a file (conventionally
+  right under the module docstring) puts every function of that module
+  in scope — for modules that are pure by contract end to end
+  (``fabric/plan.py``, ``fabric/receipts.py``, ``scenario/spec.py``,
+  ``scenario/verdict.py``);
+* ``# determinism-scope`` on a ``def`` line, or on the line directly
+  above it, puts that one function in scope — for modules where only
+  the exchanged-bytes builders are held to the contract.
+
+A marker that governs nothing (not ``: module``, not attached to any
+``def``) is itself a finding — a misplaced marker must not silently
+drop a builder from scope. Within scope, the pass flags:
 
 * wall-clock reads (``time.time``, ``datetime.now`` …) — cross-host
   clock skew turns these into divergent values;
@@ -30,6 +41,7 @@ annotations in the class ``__init__``.
 from __future__ import annotations
 
 import ast
+import re
 
 from torrent_tpu.analysis.findings import Finding
 from torrent_tpu.analysis.passes.common import (
@@ -40,141 +52,8 @@ from torrent_tpu.analysis.passes.common import (
 
 PASS_NAME = "determinism"
 
-# path suffix -> function names in scope ("*" = every function)
-SCOPE: dict[str, frozenset[str]] = {
-    "fabric/plan.py": frozenset({"*"}),
-    # the Byzantine receipt plane: Merkle commitments, audit-sample
-    # draws, and proof verification are ALL exchanged (or replayed)
-    # bytes — pure by contract, so the whole module is in scope
-    "fabric/receipts.py": frozenset({"*"}),
-    # _own_bits is deliberately NOT in scope: its dict order provably
-    # never reaches exchanged bytes (the payload sorts own.items() and
-    # _published_done is a set)
-    "fabric/executor.py": frozenset(
-        {
-            "_heartbeat_once",
-            "_build_obs_digest",
-            "_rebalance_offers",
-            "bitfields",
-            "pack_bits",
-            "unpack_bits",
-            "plan_payload_bytes",
-            # Byzantine receipt builders: roots/evidence ride the
-            # heartbeat, and the quorum grouping/need rules decide the
-            # symmetric coverage every process must agree on
-            "_receipt_payload",
-            "_unit_root",
-            "_quorum_groups",
-            "_unit_need",
-        }
-    ),
-    # the scheduler autopilot's decision core: decisions are pure
-    # functions of snapshot deltas — the same sequence of snapshots
-    # must always produce the same sequence of actuator moves (and the
-    # rebalance offers ride the heartbeat exchange), so the decision
-    # functions are held to the exchanged-bytes rules
-    "sched/control.py": frozenset(
-        {
-            "decide",
-            "build_inputs",
-            "initial_state",
-            "decision_summary",
-            "_confirmed_stage",
-            "_lane_decisions",
-            "_admission_decision",
-            "_backend_decisions",
-        }
-    ),
-    # span context carried in fabric heartbeat payloads: the obs plane's
-    # contribution to exchanged bytes must obey the same rules
-    "obs/tracer.py": frozenset({"fabric_trace_id", "heartbeat_span_context"}),
-    # the fleet obs digest rides the same heartbeats: every builder that
-    # shapes exchanged digest bytes is held to the same bit-stability
-    # rules (monotonic-only, no randomness, sorted iteration)
-    "obs/fleet.py": frozenset(
-        {
-            "build_obs_digest",
-            "clamp_digest",
-            "digest_bytes",
-            "obs_digest",
-            "_digest_stages",
-            "_digest_hist",
-            "_digest_sched",
-        }
-    ),
-    # the scenario plane's spec and verdict builders are pure by
-    # contract: a spec must parse/serialize bit-identically and a
-    # verdict is the artifact two same-seed replays are diffed on —
-    # wall-clock reads, randomness, or unordered iteration anywhere in
-    # these modules would break the doctor --scenario bit-identity gate
-    "scenario/spec.py": frozenset({"*"}),
-    "scenario/verdict.py": frozenset({"*"}),
-    # the seeder plane's snapshot builders: the serve snapshot rides
-    # /v1/swarm and the bench seed record (banked artifacts diffed
-    # across runs), so the rollup must be bit-stable over equal raws
-    "serve_plane/telemetry.py": frozenset(
-        {
-            "build_serve_snapshot",
-            "_serve_peer_entry",
-            "_serve_fold_entries",
-        }
-    ),
-    # the SLO evaluators are pure functions over timeline samples (the
-    # same determinism contract as decide() and the digest builders):
-    # the same sample ring must always produce the same burn-rate
-    # verdicts, breach transitions, and health strings — and the
-    # digest_summary rides the heartbeat exchange
-    "obs/slo.py": frozenset(
-        {
-            "evaluate_slo",
-            "digest_summary",
-            "build_health",
-            "_counter_objective",
-            "_eval_availability",
-            "_eval_latency",
-            "_eval_throughput",
-            "_eval_integrity",
-            "_eval_swarm_availability",
-            "_eval_swarm_throughput",
-            "_avail_counters",
-            "_swarm_avail_counters",
-            "_swarm_throughput_intervals",
-            "_window_delta",
-            "_hist_window",
-            "_hist_errors",
-            "_p99_estimate",
-            "_throughput_intervals",
-            "_integrity_counters_of",
-            "_tail",
-        }
-    ),
-    # the swarm wire plane's pure rollup builders (obs/swarm): the
-    # snapshot feeds /v1/swarm, /metrics, bench records, and flight
-    # dumps — same sorted-iteration / no-clock / no-randomness contract
-    # as the digest builders (the registry finalizes every duration
-    # BEFORE these run)
-    "obs/swarm.py": frozenset(
-        {
-            "build_swarm_snapshot",
-            "_peer_entry",
-            "_fold_entries",
-            "_rtt_summary",
-        }
-    ),
-    # timeline sample builders + the offline replay attributor: samples
-    # are dumped/replayed bytes (and the builders feed the digest-shaped
-    # encodings), so they obey the same rules — the monotonic capture
-    # instant is PASSED IN by the sampler, never read inside
-    "obs/timeline.py": frozenset(
-        {
-            "build_sample",
-            "replay_report",
-            "_sample_sched",
-            "_integrity_counters",
-            "_sample_to_ledger",
-        }
-    ),
-}
+# ``# determinism-scope`` (function) / ``# determinism-scope: module``
+_MARKER_RE = re.compile(r"#\s*determinism-scope(?::\s*(module))?\s*$")
 
 WALL_CLOCK = frozenset(
     {"time.time", "time.time_ns", "time.ctime", "datetime.now", "datetime.utcnow"}
@@ -187,11 +66,24 @@ ORDER_INSENSITIVE_SINKS = frozenset(
 )
 
 
-def _scope_functions(path: str) -> frozenset[str] | None:
-    for suffix, names in SCOPE.items():
-        if path.endswith(suffix):
-            return names
-    return None
+def _module_markers(source: str) -> tuple[bool, set[int]]:
+    """Scan one module for scope markers.
+
+    Returns ``(module_wide, lines)``: whether a ``: module`` marker puts
+    the whole file in scope, and the 1-based lines of bare per-function
+    markers (each must sit on a ``def`` line or directly above one).
+    """
+    module_wide = False
+    lines: set[int] = set()
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _MARKER_RE.search(text)
+        if m is None:
+            continue
+        if m.group(1) == "module":
+            module_wide = True
+        else:
+            lines.add(i)
+    return module_wide, lines
 
 
 def _set_typed_attrs(tree: ast.Module) -> set[str]:
@@ -289,11 +181,18 @@ class _DetWalker(ast.NodeVisitor):
 def run(index: PackageIndex, files=None) -> list[Finding]:
     findings: list[Finding] = []
     set_attrs_by_module: dict[str, set[str]] = {}
+    markers: dict[str, tuple[bool, set[int]]] = {}
     for mf in index.files:
         set_attrs_by_module[mf.path] = _set_typed_attrs(mf.tree)
+        markers[mf.path] = _module_markers(mf.source)
+    # per-function marker lines that actually attached to a def
+    governing: dict[str, set[int]] = {path: set() for path in markers}
     for fn in index.functions:
-        names = _scope_functions(fn.module)
-        if names is None or ("*" not in names and fn.name not in names):
+        module_wide, lines = markers.get(fn.module, (False, set()))
+        # fn.node.lineno is the ``def`` line even when decorated
+        attached = {fn.node.lineno, fn.node.lineno - 1} & lines
+        governing[fn.module] |= attached
+        if not (module_wide or attached):
             continue
         w = _DetWalker(set_attrs_by_module.get(fn.module, set()))
         for stmt in fn.node.body:
@@ -306,6 +205,21 @@ def run(index: PackageIndex, files=None) -> list[Finding]:
                     line,
                     fn.qualname,
                     f"{what} in deterministic scope",
+                )
+            )
+    # a bare marker that attached to no def is stale: the function it
+    # once governed moved or was renamed, and is now silently unchecked
+    for path, (_, lines) in markers.items():
+        for line in sorted(lines - governing[path]):
+            findings.append(
+                Finding(
+                    PASS_NAME,
+                    path,
+                    line,
+                    "determinism-scope marker",
+                    "determinism-scope marker governs no function "
+                    "(not on a def line or the line above one) — "
+                    "move it or delete it",
                 )
             )
     return findings

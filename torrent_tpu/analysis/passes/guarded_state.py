@@ -42,7 +42,10 @@ Exemptions (what keeps the pass precise enough to gate):
   against the declaration instead of the inference), and
   ``# guarded-by: none`` declares the attribute deliberately unguarded
   (documented loop-confinement / benign monotonic flag) and exempts it
-  entirely.
+  entirely. Annotations are themselves checked: one naming a lock the
+  class never constructs, or sitting on a line no attribute write
+  occupies, is a finding — a typo'd declaration must not silently
+  disable the check.
 
 Like every static pass here this under-approximates: cross-object
 mutations (``lane.x += 1`` from the scheduler) and ambiguous calls are
@@ -68,7 +71,10 @@ PASS_NAME = "guarded-state"
 EXEMPT_METHODS = frozenset({"__init__", "__post_init__", "__new__", "__del__"})
 
 # annotation syntax: "# guarded-by: <lock-attr>" or "# guarded-by: none"
-_ANNOTATION_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z_0-9]*|none)")
+# (end-anchored so prose mentions wrapped in ``...`` don't parse)
+_ANNOTATION_RE = re.compile(
+    r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z_0-9]*|none)\s*$"
+)
 
 # call shapes whose function-valued argument runs on another thread
 _THREAD_HANDOFF_TAILS = frozenset(
@@ -288,16 +294,16 @@ class AttrGuard:
 
 def _declared_guards(
     fns: list[FunctionInfo], ann: dict[int, str]
-) -> dict[str, str]:
-    """{attr: declared guard} from ``# guarded-by:`` comments sitting on
-    the attribute's write lines."""
-    out: dict[str, str] = {}
+) -> dict[str, tuple[str, int, str]]:
+    """{attr: (declared guard, line, qualname)} from ``# guarded-by:``
+    comments sitting on the attribute's write lines."""
+    out: dict[str, tuple[str, int, str]] = {}
     if not ann:
         return out
     for fn in fns:
         for site in fn.attrs:
             if site.write and site.line in ann:
-                out[site.attr] = ann[site.line]
+                out[site.attr] = (ann[site.line], site.line, fn.qualname)
     return out
 
 
@@ -310,12 +316,27 @@ def _analyze_class(
     reachable: set[int],
     findings: list[Finding],
     guards_out: list[AttrGuard] | None = None,
+    consumed: set[int] | None = None,
 ) -> None:
+    declared = _declared_guards(fns, ann)
+    if consumed is not None:
+        consumed.update(line for _, line, _ in declared.values())
     locks = _class_locks(fns)
     if not locks:
+        # no locks means nothing to check against — but a declaration
+        # naming a guard here is already wrong, not merely unchecked
+        for attr, (name, line, qual) in sorted(declared.items()):
+            if name != "none":
+                findings.append(
+                    Finding(
+                        PASS_NAME, module, line, qual,
+                        f"guarded-by names {name!r}, but {cls} "
+                        "constructs no locks — fix the annotation or "
+                        "add the lock",
+                    )
+                )
         return
     ctxs = _caller_contexts(index, fns)
-    declared = _declared_guards(fns, ann)
 
     # per-attr post-publication access sites, each expanded to one
     # virtual site per caller context: effs = {local held ∪ c}
@@ -334,17 +355,31 @@ def _analyze_class(
 
     for attr in sorted(set(writes) | set(declared)):
         decl = declared.get(attr)
-        if decl == "none":
+        if decl is not None and decl[0] == "none":
             if guards_out is not None:
                 guards_out.append(
                     AttrGuard(cls, attr, frozenset(), "annotated-none", module)
                 )
             continue
+        if decl is not None and decl[0] not in locks:
+            # a declared guard that names no lock of the class is a
+            # typo or a survivor of a rename: every mutation site
+            # that trusts it is silently unchecked
+            name, dline, dqual = decl
+            findings.append(
+                Finding(
+                    PASS_NAME, module, dline, dqual,
+                    f"guarded-by names {name!r}, which is not a lock "
+                    f"of {cls} ({', '.join(sorted(locks))}) — fix "
+                    "the annotation or add the lock",
+                )
+            )
+            continue
         w = writes.get(attr, [])
         if not w:
             continue  # immutable after publication
         if decl is not None:
-            guard = frozenset({decl})
+            guard = frozenset({decl[0]})
             source = "annotated"
         else:
             locked = [
@@ -405,11 +440,27 @@ def run(index: PackageIndex, files=None) -> list[Finding]:
     findings: list[Finding] = []
     reachable = _entry_reachable(index)
     ann_by_module = {mf.path: _annotations(mf.source) for mf in index.files}
+    consumed_by_module: dict[str, set[int]] = {
+        path: set() for path in ann_by_module
+    }
     for (module, cls), fns in sorted(_class_groups(index).items()):
         _analyze_class(
             index, module, cls, fns, ann_by_module.get(module, {}),
             reachable, findings,
+            consumed=consumed_by_module.setdefault(module, set()),
         )
+    # an annotation no attribute write consumed is stale or misplaced —
+    # it documents a guard discipline the checker never sees
+    for module, ann in ann_by_module.items():
+        for line in sorted(set(ann) - consumed_by_module[module]):
+            findings.append(
+                Finding(
+                    PASS_NAME, module, line, "guarded-by annotation",
+                    f"guarded-by: {ann[line]} sits on no attribute "
+                    "write — move it onto a self.<attr> assignment "
+                    "or delete it",
+                )
+            )
     return dedupe_findings(findings)
 
 

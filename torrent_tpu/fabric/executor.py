@@ -103,11 +103,13 @@ from torrent_tpu.utils.log import get_logger
 log = get_logger("fabric")
 
 
+# determinism-scope
 def pack_bits(bits: np.ndarray) -> str:
     """bool verdict vector -> hex (the heartbeat's few-byte encoding)."""
     return np.packbits(np.asarray(bits, dtype=bool)).tobytes().hex()
 
 
+# determinism-scope
 def unpack_bits(hexstr: str, n: int) -> np.ndarray:
     raw = np.frombuffer(bytes.fromhex(hexstr), dtype=np.uint8)
     bits = np.unpackbits(raw)[:n]
@@ -320,6 +322,7 @@ class AllgatherHeartbeat:
         return peers
 
 
+# determinism-scope
 def plan_payload_bytes(plan: FabricPlan, byzantine_f: int = 0) -> int:
     """Allgather buffer size for a plan: the worst-case heartbeat is
     every unit's verdict bits (hex doubles the packed bytes) plus
@@ -512,6 +515,7 @@ class FabricExecutor:
             if self.pid in pubs
         }
 
+    # determinism-scope
     def _quorum_groups(self, uid: int, published_only: bool) -> dict[str, list[int]]:
         """Non-distrusted publishers of a unit grouped by EXACT verdict
         bytes (``pack_bits``): the quorum rule counts *matching*
@@ -527,6 +531,7 @@ class FabricExecutor:
             groups.setdefault(pack_bits(self._verdicts[uid][p]), []).append(p)
         return groups
 
+    # determinism-scope
     def _unit_need(self, uid: int) -> int:
         """Matching receipts required to cover a unit: ``f + 1``,
         clamped to the processes still eligible to publish it (not
@@ -574,6 +579,7 @@ class FabricExecutor:
             for u in self.plan.units
         )
 
+    # determinism-scope
     def bitfields(self) -> list[np.ndarray]:
         """Global per-torrent bitfields from the merged verdict view.
 
@@ -814,6 +820,7 @@ class FabricExecutor:
                 return
             await asyncio.sleep(self.config.heartbeat_interval)
 
+    # determinism-scope
     async def _heartbeat_once(self) -> None:
         self._refresh_degraded()
         self._update_rebalance()
@@ -910,6 +917,7 @@ class FabricExecutor:
             if isinstance(r, dict) and "pid" in r
         }
 
+    # determinism-scope
     def _rebalance_offers(self, rollup: dict) -> list[int]:
         """Unstarted units this process should offer to peers, given a
         fleet rollup (``fleet_snapshot``): everything still PENDING in
@@ -1238,6 +1246,7 @@ class FabricExecutor:
 
     # --------------------------------------- Byzantine layer (f > 0)
 
+    # determinism-scope
     def _unit_root(self, uid: int, bits: np.ndarray) -> str:
         """Merkle receipt root for one unit's verdict bits, cached by
         packed-bits value (publishers re-commit the same root every
@@ -1258,6 +1267,7 @@ class FabricExecutor:
             self._root_cache[key] = root
         return root
 
+    # determinism-scope
     def _receipt_payload(self, own: dict[int, np.ndarray]) -> dict:
         """Byzantine additions to the heartbeat payload — f > 0 ONLY
         (at f = 0 these keys are absent and the heartbeat stays
@@ -1559,6 +1569,7 @@ class FabricExecutor:
 
     # ------------------------------------------------------------- fleet
 
+    # determinism-scope
     def _build_obs_digest(self) -> dict:
         """This process's heartbeat-carried obs digest (obs/fleet.py).
         In the determinism pass's scope — exchanged bytes: counters and

@@ -18,6 +18,9 @@ deterministic integer, so the plan — and its :meth:`FabricPlan.
 fingerprint` — is identical on every process given the same library.
 """
 
+# determinism-scope: module
+# (plan fingerprints are exchanged proof-of-agreement bytes)
+
 from __future__ import annotations
 
 import hashlib

@@ -32,6 +32,9 @@ domain-separated with ``0x00``, interior nodes with ``0x01``, and an
 hashes); the commitment binds a *claim*, not content secrecy.
 """
 
+# determinism-scope: module
+# (Merkle commitments, audit draws, proofs: all exchanged/replayed bytes)
+
 from __future__ import annotations
 
 import hashlib

@@ -46,6 +46,10 @@ RPC_TIMEOUT = 3.0
 TOKEN_ROTATE_SECS = 300
 PEER_TTL_SECS = 30 * 60
 MAX_PEERS_PER_HASH = 2000
+# distinct info-hashes with live peer stores: a token-valid announce
+# flood of FRESH hashes would otherwise grow resident state unbounded
+# inside one TTL window (the sweep only drops stores that expired empty)
+MAX_STORED_HASHES = 4096
 BOOTSTRAP_TARGET_RETRIES = 2
 
 # BEP 44 storage: bencoded values are capped at 1000 bytes, salts at 64;
@@ -695,6 +699,16 @@ class DHTNode:
                 return
             from torrent_tpu.net.types import normalize_peer_host
 
+            if (
+                info_hash not in self.peer_store
+                and len(self.peer_store) >= MAX_STORED_HASHES
+            ):
+                # at hash-count capacity a fresh hash evicts the oldest
+                # store (insertion order) with its seed marks — announce
+                # floods churn the store instead of growing it
+                oldest = next(iter(self.peer_store))
+                self.peer_store.pop(oldest, None)
+                self.seed_marks.pop(oldest, None)
             store = self.peer_store.setdefault(info_hash, {})
             key = (normalize_peer_host(addr[0]), port)
             if len(store) < MAX_PEERS_PER_HASH or key in store:
@@ -704,7 +718,9 @@ class DHTNode:
                 # BEP 33: the last announce's seed flag wins (no empty
                 # set is ever created for flagless announces)
                 if a.get(b"seed"):
-                    self.seed_marks.setdefault(info_hash, set()).add(key)
+                    # evicted in lockstep with its store (here and in the
+                    # sweep): never holds a hash peer_store doesn't
+                    self.seed_marks.setdefault(info_hash, set()).add(key)  # bounded-by: peer_store
                 else:
                     marks = self.seed_marks.get(info_hash)
                     if marks is not None:

@@ -109,7 +109,9 @@ class DhtIndexer:
     def _bloom_pair(self, info_hash: bytes) -> tuple[ScrapeBloom, ScrapeBloom]:
         pair = self._blooms.get(info_hash)
         if pair is None:
-            pair = self._blooms[info_hash] = (ScrapeBloom(), ScrapeBloom())
+            # evicted in lockstep with _hashes in _note: the bloom table
+            # never outgrows the hash census
+            pair = self._blooms[info_hash] = (ScrapeBloom(), ScrapeBloom())  # bounded-by: max_hashes
         return pair
 
     def blooms_for(

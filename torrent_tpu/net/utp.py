@@ -86,6 +86,12 @@ PAD_EXT = 0x7A
 # DEMONSTRATED extension tolerance: loopback peers (our own stack), or
 # a peer that itself sent a BEP 29 extension (its encoder implies the
 # framing loop). See UtpConnection._ext_tolerant.
+# live connections per endpoint (dialed + accepted): each SYN from a
+# distinct (addr, conn_id) mints a UtpConnection plus an accept task, so
+# without a cap a spoofed-source SYN flood grows state unbounded — at
+# capacity new accepts are refused with ST_RESET (dials still raise)
+MAX_LIVE_CONNS = 1024
+
 MTU_RAISE_ENABLED = True
 MTU_RAISE_INTERVAL = 5.0  # first upward probe / post-success cadence
 MTU_RAISE_BACKOFF_MAX = 120.0  # failed probes back off exponentially to this
@@ -964,6 +970,10 @@ class UtpEndpoint(asyncio.DatagramProtocol):
             if self.on_accept is None:
                 self.sendto(encode_packet(ST_RESET, conn_id, 0, seq), addr)
                 return
+            if len(self._conns) >= MAX_LIVE_CONNS:
+                # accept-path cardinality clamp: refuse, don't grow
+                self.sendto(encode_packet(ST_RESET, conn_id, 0, seq), addr)
+                return
             # acceptor: recv with conn_id+1, send with conn_id
             conn = UtpConnection(
                 self, addr, recv_id=(conn_id + 1) & 0xFFFF, send_id=conn_id
@@ -986,7 +996,7 @@ class UtpEndpoint(asyncio.DatagramProtocol):
                 conn._mtu_ladder = MTU_LADDER_LOOPBACK
             conn._arm_mtu_raise()  # adopted a stepped-down budget? probe up
             self._conns[(addr, conn.recv_id)] = conn
-            self._by_send[(addr, conn.send_id)] = conn
+            self._by_send[(addr, conn.send_id)] = conn  # bounded-by: _conns
             conn._send_state()  # SYN-ACK
             task = asyncio.get_running_loop().create_task(
                 self.on_accept(conn.reader, _UtpWriter(conn))

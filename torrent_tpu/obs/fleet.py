@@ -85,11 +85,13 @@ STRAGGLER_RATIO = 0.5
 # heartbeat exchange and must be bit-stable across re-runs)
 
 
+# determinism-scope
 def digest_bytes(digest: dict) -> int:
     """Wire size of a digest under the heartbeat's JSON encoding."""
     return len(json.dumps(digest, sort_keys=True).encode())
 
 
+# determinism-scope
 def _digest_stages(stages: dict) -> dict:
     out = {}
     for name in sorted(stages):
@@ -104,6 +106,7 @@ def _digest_stages(stages: dict) -> dict:
     return out
 
 
+# determinism-scope
 def _digest_hist(hist_snaps: dict) -> dict:
     out = {}
     for short in sorted(hist_snaps):
@@ -125,6 +128,7 @@ def _digest_hist(hist_snaps: dict) -> dict:
     return out
 
 
+# determinism-scope
 def _digest_sched(sched_snap: dict) -> dict:
     breakers = sched_snap.get("breakers") or {}
     named = {}
@@ -151,6 +155,7 @@ def _digest_sched(sched_snap: dict) -> dict:
     return out
 
 
+# determinism-scope
 def build_obs_digest(
     ledger_snap: dict,
     base_snap: dict | None,
@@ -193,6 +198,7 @@ def build_obs_digest(
     return clamp_digest(digest)
 
 
+# determinism-scope
 def clamp_digest(digest: dict, max_bytes: int = DIGEST_MAX_BYTES) -> dict:
     """Enforce the digest size bound. Drop order is fixed — histogram
     summaries first (recoverable from /metrics), then the scheduler
@@ -212,6 +218,7 @@ def clamp_digest(digest: dict, max_bytes: int = DIGEST_MAX_BYTES) -> dict:
     }
 
 
+# determinism-scope
 def obs_digest(
     scheduler=None, base: dict | None = None, unit: dict | None = None
 ) -> dict:

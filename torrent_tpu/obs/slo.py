@@ -242,6 +242,7 @@ def parse_objectives(spec: str) -> tuple[SloObjective, ...]:
 from torrent_tpu.obs.timeline import _num  # noqa: E402
 
 
+# determinism-scope
 def _tail(samples: list, n: int) -> list:
     n = max(2, int(n))
     return samples[-n:] if len(samples) > n else samples
@@ -257,6 +258,7 @@ def _integrity_of(sample) -> dict:
     return s if isinstance(s, dict) else {}
 
 
+# determinism-scope
 def _counter_objective(
     errors_short: float,
     events_short: float,
@@ -294,6 +296,7 @@ def _counter_objective(
     }
 
 
+# determinism-scope
 def _avail_counters(sample) -> tuple[float, float]:
     """(errors, events) cumulative: shed + retry-exhausted failures over
     everything the scheduler was asked to process."""
@@ -303,6 +306,7 @@ def _avail_counters(sample) -> tuple[float, float]:
     return errors, events
 
 
+# determinism-scope
 def _window_delta(samples: list, extract) -> tuple[float, float]:
     """Delta of ``extract(sample) -> (errors, events)`` across a window
     (first vs last sample), clamped at 0 for counter resets."""
@@ -313,6 +317,7 @@ def _window_delta(samples: list, extract) -> tuple[float, float]:
     return max(0.0, e1 - e0), max(0.0, n1 - n0)
 
 
+# determinism-scope
 def _eval_availability(short: list, long: list, obj: SloObjective) -> dict:
     es, ns = _window_delta(short, _avail_counters)
     el, nl = _window_delta(long, _avail_counters)
@@ -321,6 +326,7 @@ def _eval_availability(short: list, long: list, obj: SloObjective) -> dict:
     return out
 
 
+# determinism-scope
 def _hist_window(samples: list, family: str) -> tuple[dict, float, float]:
     """(bucket-count deltas, total count delta) for one histogram
     family across a window; sparse string-keyed buckets like the
@@ -346,6 +352,7 @@ def _hist_window(samples: list, family: str) -> tuple[dict, float, float]:
     return deltas, count, total
 
 
+# determinism-scope
 def _hist_errors(bucket_deltas: dict, target_s: float) -> float:
     """Observations whose bucket lies entirely above the target bound
     (conservative: a bucket straddling the target does not count)."""
@@ -365,6 +372,7 @@ def _hist_errors(bucket_deltas: dict, target_s: float) -> float:
     return errors
 
 
+# determinism-scope
 def _p99_estimate(bucket_deltas: dict, count: float) -> float | None:
     """Upper-bound p99 estimate from log2 bucket deltas."""
     if count <= 0:
@@ -392,6 +400,7 @@ def _p99_estimate(bucket_deltas: dict, count: float) -> float | None:
     return None
 
 
+# determinism-scope
 def _eval_latency(short: list, long: list, obj: SloObjective) -> dict:
     bs, cs, _ = _hist_window(short, obj.family)
     bl, cl, _ = _hist_window(long, obj.family)
@@ -416,6 +425,7 @@ def _eval_latency(short: list, long: list, obj: SloObjective) -> dict:
     return out
 
 
+# determinism-scope
 def _throughput_intervals(samples: list, floor_bps: float) -> tuple[float, float, float]:
     """(slow_intervals, active_intervals, last_bps) over consecutive
     sample pairs: an interval is ACTIVE when verdict ops moved; a slow
@@ -446,6 +456,7 @@ def _throughput_intervals(samples: list, floor_bps: float) -> tuple[float, float
     return slow, active, last_bps
 
 
+# determinism-scope
 def _eval_throughput(short: list, long: list, obj: SloObjective) -> dict:
     ss, ns, _ = _throughput_intervals(short, obj.target)
     sl, nl, last_bps = _throughput_intervals(long, obj.target)
@@ -463,6 +474,7 @@ def _swarm_of(sample) -> dict:
     return s if isinstance(s, dict) else {}
 
 
+# determinism-scope
 def _swarm_avail_counters(sample) -> tuple[float, float]:
     """(errors, events) cumulative for the snub-ratio budget: snub
     transitions over block deliveries + snubs — a swarm whose peers
@@ -473,6 +485,7 @@ def _swarm_avail_counters(sample) -> tuple[float, float]:
     return errors, events
 
 
+# determinism-scope
 def _eval_swarm_availability(short: list, long: list, obj: SloObjective) -> dict:
     es, ns = _window_delta(short, _swarm_avail_counters)
     el, nl = _window_delta(long, _swarm_avail_counters)
@@ -481,6 +494,7 @@ def _eval_swarm_availability(short: list, long: list, obj: SloObjective) -> dict
     return out
 
 
+# determinism-scope
 def _swarm_throughput_intervals(
     samples: list, floor_bps: float
 ) -> tuple[float, float, float]:
@@ -512,6 +526,7 @@ def _swarm_throughput_intervals(
     return slow, active, last_bps
 
 
+# determinism-scope
 def _eval_swarm_throughput(short: list, long: list, obj: SloObjective) -> dict:
     ss, ns, _ = _swarm_throughput_intervals(short, obj.target)
     sl, nl, last_bps = _swarm_throughput_intervals(long, obj.target)
@@ -524,6 +539,7 @@ def _eval_swarm_throughput(short: list, long: list, obj: SloObjective) -> dict:
     return out
 
 
+# determinism-scope
 def _integrity_counters_of(sample) -> tuple[float, float]:
     integ = _integrity_of(sample)
     errors = (
@@ -534,6 +550,7 @@ def _integrity_counters_of(sample) -> tuple[float, float]:
     return errors, 0.0
 
 
+# determinism-scope
 def _eval_integrity(short: list, long: list, obj: SloObjective) -> dict:
     es, _ = _window_delta(short, _integrity_counters_of)
     el, _ = _window_delta(long, _integrity_counters_of)
@@ -547,6 +564,7 @@ def _eval_integrity(short: list, long: list, obj: SloObjective) -> dict:
     return out
 
 
+# determinism-scope
 def evaluate_slo(
     samples: list,
     objectives: tuple[SloObjective, ...],
@@ -607,6 +625,7 @@ def evaluate_slo(
     }
 
 
+# determinism-scope
 def digest_summary(report: dict | None) -> dict | None:
     """The compact form the fleet obs digest carries (worst burn rate +
     breach flag), so ``top --fleet`` shows fleet-wide budget health."""
@@ -625,6 +644,7 @@ def digest_summary(report: dict | None) -> dict | None:
 # ----------------------------------------------------------------- health
 
 
+# determinism-scope
 def build_health(
     probe_ok: bool | None = None,
     breakers: dict | None = None,

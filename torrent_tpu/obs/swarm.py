@@ -199,6 +199,7 @@ def _as_int(value, default: int = 0) -> int:
     return int(_as_float(value, float(default)))
 
 
+# determinism-scope
 def _rtt_summary(counts: list, count, total) -> dict:
     """p50/p99 upper-bound estimates from log2 bucket counts (pure).
     The overflow bucket has no finite upper bound: a quantile landing
@@ -228,6 +229,7 @@ def _rtt_summary(counts: list, count, total) -> dict:
     return out
 
 
+# determinism-scope
 def _peer_entry(raw: dict) -> dict:
     """One snapshot peer entry from a finalized raw record (pure,
     total: every field goes through the defensive scalar parsers)."""
@@ -274,6 +276,7 @@ def _peer_entry(raw: dict) -> dict:
     }
 
 
+# determinism-scope
 def _fold_entries(raws: list) -> dict:
     """Aggregate raw peer records into one overflow entry (pure):
     counters sum, RTT buckets merge elementwise. A raw carrying its own
@@ -317,6 +320,7 @@ def _fold_entries(raws: list) -> dict:
     return folded
 
 
+# determinism-scope
 def build_swarm_snapshot(peer_raws: dict, totals: dict, top_k: int = TOP_PEERS) -> dict:
     """The pure swarm rollup over finalized raw records.
 

@@ -107,6 +107,7 @@ def _num(value, default: float = 0.0) -> float:
     return f if f == f and abs(f) != float("inf") else default
 
 
+# determinism-scope
 def _integrity_counters(sched_snap: dict, tsan_snap: dict | None, distrust: int) -> dict:
     """Cumulative integrity-event counters: breaker open-transitions,
     currently-open lanes, lockset races, distrust events. Any of these
@@ -130,6 +131,7 @@ def _integrity_counters(sched_snap: dict, tsan_snap: dict | None, distrust: int)
     }
 
 
+# determinism-scope
 def _sample_sched(sched_snap: dict) -> dict:
     """The fleet digest's scheduler summary plus the two extra counters
     the SLO availability objective needs: total served pieces (the
@@ -155,6 +157,7 @@ def _sample_sched(sched_snap: dict) -> dict:
     return out
 
 
+# determinism-scope
 def build_sample(
     t_mono: float,
     ledger_snap: dict,
@@ -477,6 +480,7 @@ class TimelineSampler:
 # ----------------------------------------------------------------- replay
 
 
+# determinism-scope
 def _sample_to_ledger(sample: dict) -> dict:
     """Reconstruct a ledger-shaped snapshot from one timeline sample so
     ``obs/attrib.attribute`` runs unchanged over HISTORICAL counters —
@@ -507,6 +511,7 @@ def _sample_to_ledger(sample: dict) -> dict:
     }
 
 
+# determinism-scope
 def replay_report(timeline_snap: dict, objectives=None) -> dict:
     """Offline replay of a dumped (or fetched) timeline.
 

@@ -234,7 +234,9 @@ class Tracer:
             else:
                 self._traces.move_to_end(span.trace_id)
             if len(spans) >= self._max_spans:
-                self._dropped[span.trace_id] = (
+                # keyed only by traces live in _traces and popped when
+                # they evict — cardinality rides the trace ring's cap
+                self._dropped[span.trace_id] = (  # bounded-by: _max_traces
                     self._dropped.get(span.trace_id, 0) + 1
                 )
             else:
@@ -296,6 +298,7 @@ class Tracer:
 # ------------------------------------------------------ fabric context
 
 
+# determinism-scope
 def fabric_trace_id(plan_fingerprint: str, pid: int) -> str:
     """Deterministic fabric trace id: every process derives it from the
     plan fingerprint it already agrees on, so no random bytes need to
@@ -303,6 +306,7 @@ def fabric_trace_id(plan_fingerprint: str, pid: int) -> str:
     return f"fabric-{plan_fingerprint[:12]}-p{pid}"
 
 
+# determinism-scope
 def heartbeat_span_context(trace_id: str, seq: int) -> dict:
     """The span context a fabric heartbeat payload carries. In the
     analysis plane's determinism scope: literal keys, monotonic-free,

@@ -18,6 +18,9 @@ in the determinism pass SCOPE (``analysis/passes/determinism.py``) and
 every iteration is sorted.
 """
 
+# determinism-scope: module
+# (specs must parse/serialize bit-identically across replays)
+
 from __future__ import annotations
 
 import json

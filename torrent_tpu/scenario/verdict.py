@@ -12,6 +12,9 @@ IO; every iteration is sorted. ``canonical_verdict`` strips the wall
 plane, leaving exactly the bytes a same-seed replay must reproduce.
 """
 
+# determinism-scope: module
+# (the verdict is the artifact same-seed replays are diffed on)
+
 from __future__ import annotations
 
 import json

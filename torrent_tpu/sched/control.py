@@ -143,6 +143,7 @@ class ControlConfig:
 # dict iteration sorted)
 
 
+# determinism-scope
 def initial_state() -> dict:
     """The controller's fold state: tick counter, bottleneck streak,
     the last tick the admission shrink condition confirmed, per-lane
@@ -156,6 +157,7 @@ def initial_state() -> dict:
     }
 
 
+# determinism-scope
 def build_inputs(
     led_snap: dict,
     prev_led: dict | None,
@@ -220,6 +222,7 @@ def build_inputs(
     }
 
 
+# determinism-scope
 def _confirmed_stage(inputs: dict, state: dict, cfg: ControlConfig):
     """(stage, streak, confirmed): the bottleneck verdict gated by the
     utilization/headroom thresholds, its consecutive-tick streak, and
@@ -239,6 +242,7 @@ def _confirmed_stage(inputs: dict, state: dict, cfg: ControlConfig):
     return stage, streak, confirmed
 
 
+# determinism-scope
 def _lane_decisions(inputs, state, cfg, stage, streak, confirmed) -> list[dict]:
     """Batch-target + flush-deadline actions (per lane, hysteresis- and
     cooldown-guarded). Grow when a confirmed per-launch-cost stage
@@ -310,6 +314,7 @@ def _lane_decisions(inputs, state, cfg, stage, streak, confirmed) -> list[dict]:
     return actions
 
 
+# determinism-scope
 def _admission_decision(inputs, state, cfg, stage, confirmed) -> list[dict]:
     """Admission-budget action: while a bottleneck is confirmed, admit
     no faster than it drains; recover the budget once the shrink
@@ -356,6 +361,7 @@ def _admission_decision(inputs, state, cfg, stage, confirmed) -> list[dict]:
     return []
 
 
+# determinism-scope
 def _backend_decisions(inputs, state, cfg, stage, streak, confirmed) -> list[dict]:
     """Backend-steering actions with the trial protocol: switch to the
     alternative on a confirmed launch-limited verdict, evaluate one
@@ -413,6 +419,7 @@ def _backend_decisions(inputs, state, cfg, stage, streak, confirmed) -> list[dic
     return actions
 
 
+# determinism-scope
 def decide(inputs: dict, state: dict, cfg: ControlConfig) -> tuple[dict, dict]:
     """One controller decision: pure function of (inputs, state, cfg).
 
@@ -454,6 +461,7 @@ def decide(inputs: dict, state: dict, cfg: ControlConfig) -> tuple[dict, dict]:
     return decision, st
 
 
+# determinism-scope
 def decision_summary(status: dict) -> str:
     """One human line for top/doctor: the verdict and what moved."""
     if not status:

@@ -82,6 +82,7 @@ class _PeerServe:
 # iteration — every duration below was bucketed by the registry already)
 
 
+# determinism-scope
 def _serve_peer_entry(raw: dict) -> dict:
     """One snapshot peer entry from a raw serve record (pure, total)."""
     paths = raw.get("paths")
@@ -101,6 +102,7 @@ def _serve_peer_entry(raw: dict) -> dict:
     }
 
 
+# determinism-scope
 def _serve_fold_entries(raws: list) -> dict:
     """Aggregate raw serve records into one overflow entry (pure):
     counters sum, path matrices merge key-wise. A raw carrying its own
@@ -135,6 +137,7 @@ def _serve_fold_entries(raws: list) -> dict:
     return folded
 
 
+# determinism-scope
 def build_serve_snapshot(
     peer_raws: dict,
     totals: dict,

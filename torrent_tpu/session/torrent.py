@@ -64,6 +64,12 @@ _UNSET = object()  # lazy-field sentinel (None is a meaningful value)
 _RECV_FLUSH_OPS = 32
 _RECV_FLUSH_S = 0.25
 
+# failure-detection cardinality caps: both tables key on peer IP, which
+# an attacker mints freely — strike/ban state must churn at capacity,
+# never grow for the life of the session
+MAX_CORRUPTION_IPS = 8192
+MAX_BANNED_IPS = 4096
+
 
 def _wire_payload_bytes(msg) -> int:
     """Payload byte count of a decoded wire message for the per-kind
@@ -139,7 +145,9 @@ class AcceptGate:
         self.slots[key] = now
         if ip is not None:
             self._ips[key] = ip
-            self._ip_counts[ip] = self._ip_counts.get(ip, 0) + 1
+            # one entry per admitting address of a LIVE slot (released in
+            # _forget_ip): cardinality ≤ the slot capacity checked above
+            self._ip_counts[ip] = self._ip_counts.get(ip, 0) + 1  # bounded-by: capacity
         return True
 
     def touch(self, key, now) -> None:
@@ -369,7 +377,7 @@ class Torrent:
         # co-contributed with a poisoner shed the suspicion). At the
         # threshold the address is banned for the session.
         self._corruption: Counter = Counter()  # ip -> strikes
-        self._banned: set[str] = set()  # by IP
+        self._banned: dict[str, None] = {}  # by IP, insertion-ordered
         # Incremental scheduler state: per-piece availability counts, a
         # rarity-ordered pick queue (rebuilt lazily when dirty), and a
         # multiset of blocks in flight across all peers — keeps block
@@ -2831,9 +2839,22 @@ class Torrent:
         for ip in {ip for _, ip in contributors}:
             if ip is None or ip in self._banned:
                 continue
+            if (
+                ip not in self._corruption
+                and len(self._corruption) >= MAX_CORRUPTION_IPS
+            ):
+                # strike table at capacity: forget the least-incriminated
+                # address rather than grow per attacker-minted IP
+                drop = min(self._corruption, key=self._corruption.__getitem__)
+                del self._corruption[drop]
             self._corruption[ip] += 1
             if self._corruption[ip] >= self.config.max_corrupt_pieces:
-                self._banned.add(ip)
+                if len(self._banned) >= MAX_BANNED_IPS:
+                    # ban list full: the oldest ban ages out (FIFO) — an
+                    # attacker cycling addresses churns the list instead
+                    # of growing it for the life of the session
+                    del self._banned[next(iter(self._banned))]
+                self._banned[ip] = None
                 log.warning(
                     "banning %s: %d corrupt pieces", ip, self._corruption[ip]
                 )

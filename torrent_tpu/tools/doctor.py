@@ -1460,7 +1460,7 @@ async def _announce_smoke() -> str:
 
 
 def _lint_smoke() -> str:
-    """Analysis-plane smoke (``--lint``): run all six static passes
+    """Analysis-plane smoke (``--lint``): run all eight static passes
     over the installed package and require a clean gate — zero findings
     beyond the committed baseline (= what `torrent-tpu lint` enforces)."""
     from torrent_tpu.analysis.findings import diff_baseline, load_baseline
@@ -1763,7 +1763,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--lint",
         action="store_true",
-        help="also run the analysis-plane smoke: all six static passes "
+        help="also run the analysis-plane smoke: all eight static passes "
         "over the installed package, clean against the committed baseline",
     )
     ap.add_argument(
